@@ -1,0 +1,12 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware. Must run before any jax
+import, hence the env mutation at module import time."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,140 @@
+"""Decimal codec tests — semantics mirrored from the reference's
+lib/decimal/decimal_test.go coverage: roundtrips, special values, scale
+calibration, staleness markers."""
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.ops import decimal as dec
+
+
+def roundtrip(vals):
+    m, e = dec.float_to_decimal(np.asarray(vals, dtype=np.float64))
+    return dec.decimal_to_float(m, e)
+
+
+class TestFloatToDecimal:
+    def test_empty(self):
+        m, e = dec.float_to_decimal(np.array([], dtype=np.float64))
+        assert m.size == 0
+
+    def test_integers_exact(self):
+        vals = np.array([0.0, 1, -1, 12345, -98765, 10, 100, 1e6, 123456789012345.0])
+        out = roundtrip(vals)
+        np.testing.assert_array_equal(out, vals)
+
+    def test_common_exponent_strips_zeros(self):
+        m, e = dec.float_to_decimal(np.array([100.0, 200.0, 300.0]))
+        assert e == 2
+        np.testing.assert_array_equal(m, [1, 2, 3])
+
+    def test_decimal_fractions_exact(self):
+        vals = np.array([0.1, 0.25, 1.5, -3.75, 123.456, 0.001, 9.99])
+        out = roundtrip(vals)
+        np.testing.assert_array_equal(out, vals)
+
+    def test_mixed_scales(self):
+        vals = np.array([1e-3, 1.0, 1e3])
+        m, e = dec.float_to_decimal(vals)
+        assert e == -3
+        np.testing.assert_array_equal(m, [1, 1000, 1000000])
+
+    def test_random_floats_narrow_spread_near_exact(self):
+        # Values within ~2 decades keep full float64 precision.
+        rng = np.random.default_rng(42)
+        vals = rng.uniform(1.0, 100.0, 1000)
+        out = roundtrip(vals)
+        np.testing.assert_allclose(out, vals, rtol=1e-13)
+
+    def test_random_floats_wide_spread(self):
+        # A shared decimal exponent across ~8 decades costs digits on the
+        # small end (same trade-off as the reference's CalibrateScale).
+        rng = np.random.default_rng(42)
+        vals = rng.standard_normal(1000) * np.exp(rng.uniform(-5, 5, 1000))
+        out = roundtrip(vals)
+        np.testing.assert_allclose(out, vals, rtol=1e-8)
+
+    def test_huge_spread_is_lossy_but_close(self):
+        vals = np.array([1e-300, 1e300])
+        out = roundtrip(vals)
+        # 1e300 must survive; 1e-300 may collapse given the shared exponent.
+        assert out[1] == pytest.approx(1e300, rel=1e-12)
+
+    def test_specials(self):
+        vals = np.array([np.nan, np.inf, -np.inf, 1.0])
+        out = roundtrip(vals)
+        assert np.isnan(out[0])
+        assert np.isposinf(out[1])
+        assert np.isneginf(out[2])
+        assert out[3] == 1.0
+
+    def test_stale_nan_preserved_bit_exact(self):
+        vals = np.array([dec.STALE_NAN, np.nan, 5.0])
+        m, e = dec.float_to_decimal(vals)
+        assert m[0] == dec.V_STALE_NAN
+        assert m[1] == dec.V_NAN
+        out = dec.decimal_to_float(m, e)
+        assert dec.is_stale_nan(out[:1]).all()
+        assert not dec.is_stale_nan(out[1:2]).any()  # plain NaN stays plain
+
+    def test_negative_zero(self):
+        out = roundtrip(np.array([-0.0, 0.0]))
+        np.testing.assert_array_equal(out, [0.0, 0.0])
+
+    def test_single_value(self):
+        for v in (3.0, 0.02, -7e9, 6.62607015e-34):
+            out = roundtrip(np.array([v]))
+            assert out[0] == pytest.approx(v, rel=1e-13)
+
+
+class TestCalibrateScale:
+    def test_same_exp(self):
+        a = np.array([1, 2], dtype=np.int64)
+        b = np.array([3, 4], dtype=np.int64)
+        a2, b2, e = dec.calibrate_scale(a, 0, b, 0)
+        assert e == 0
+        np.testing.assert_array_equal(a2, a)
+        np.testing.assert_array_equal(b2, b)
+
+    def test_scale_down_b(self):
+        a = np.array([15, 25], dtype=np.int64)   # e=-1 -> 1.5, 2.5
+        b = np.array([3, 4], dtype=np.int64)     # e=0  -> 3, 4
+        a2, b2, e = dec.calibrate_scale(a, -1, b, 0)
+        assert e == -1
+        np.testing.assert_array_equal(a2, [15, 25])
+        np.testing.assert_array_equal(b2, [30, 40])
+
+    def test_specials_pass_through(self):
+        a = np.array([dec.V_STALE_NAN, 10], dtype=np.int64)
+        b = np.array([5], dtype=np.int64)
+        a2, b2, e = dec.calibrate_scale(a, -2, b, 0)
+        assert a2[0] == dec.V_STALE_NAN
+        assert e == -2
+        assert b2[0] == 500
+
+    def test_values_preserved(self):
+        rng = np.random.default_rng(7)
+        av = np.round(rng.uniform(-100, 100, 50), 3)
+        bv = np.round(rng.uniform(-1e6, 1e6, 50), 1)
+        am, ae = dec.float_to_decimal(av)
+        bm, be = dec.float_to_decimal(bv)
+        a2, b2, e = dec.calibrate_scale(am, ae, bm, be)
+        np.testing.assert_allclose(dec.decimal_to_float(a2, e), av, rtol=1e-10)
+        np.testing.assert_allclose(dec.decimal_to_float(b2, e), bv, rtol=1e-10)
+
+
+class TestReviewRegressions:
+    def test_tiny_values_do_not_hit_sentinels(self):
+        # 1e-300 must not overflow into V_NAN (pow10 overflow guard)
+        m, e = dec.float_to_decimal(np.array([1e-300, 2e-308]))
+        assert m[0] != dec.V_NAN and m[1] != dec.V_NAN
+        out = dec.decimal_to_float(m, e)
+        assert out[0] == pytest.approx(1e-300, rel=1e-8)
+
+    def test_calibrate_all_zero_b_keeps_a(self):
+        a = np.array([123456], dtype=np.int64)
+        b = np.array([0, dec.V_STALE_NAN], dtype=np.int64)
+        a2, b2, e = dec.calibrate_scale(a, -25, b, 0)
+        assert e == -25
+        np.testing.assert_array_equal(a2, a)
+        assert b2[1] == dec.V_STALE_NAN

@@ -1,0 +1,173 @@
+"""Codec tests — coverage modeled on the reference's
+lib/encoding/encoding_test.go + int_test.go + nearest_delta*_test.go:
+varint roundtrips, marshal-type selection, lossy precision bounds,
+timestamp validation."""
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.ops import encoding as enc
+from victoriametrics_tpu.ops import varint
+from victoriametrics_tpu.ops.nearest_delta import (
+    nearest_delta2_decode, nearest_delta2_encode, nearest_delta_decode,
+    nearest_delta_encode)
+
+
+class TestVarint:
+    def test_roundtrip_simple(self):
+        vals = np.array([0, 1, -1, 63, -64, 64, -65, 1 << 40, -(1 << 40)],
+                        dtype=np.int64)
+        data = varint.marshal_varint64s(vals)
+        out = varint.unmarshal_varint64s(data, vals.size)
+        np.testing.assert_array_equal(out, vals)
+
+    def test_roundtrip_extremes(self):
+        vals = np.array([(1 << 62), -(1 << 62), (1 << 63) - 1, -(1 << 63)],
+                        dtype=np.int64)
+        out = varint.unmarshal_varint64s(varint.marshal_varint64s(vals), 4)
+        np.testing.assert_array_equal(out, vals)
+
+    def test_roundtrip_random(self):
+        rng = np.random.default_rng(3)
+        for size in (1, 2, 100, 8192):
+            vals = rng.integers(-(1 << 62), 1 << 62, size, dtype=np.int64)
+            out = varint.unmarshal_varint64s(varint.marshal_varint64s(vals), size)
+            np.testing.assert_array_equal(out, vals)
+
+    def test_small_values_one_byte(self):
+        vals = np.arange(-64, 64, dtype=np.int64)
+        data = varint.marshal_varint64s(vals)
+        assert len(data) == vals.size
+
+    def test_empty(self):
+        assert varint.marshal_varint64s(np.array([], dtype=np.int64)) == b""
+        assert varint.unmarshal_varint64s(b"").size == 0
+
+    def test_varuint_scalar(self):
+        for x in (0, 1, 127, 128, 300, 1 << 32, (1 << 64) - 1):
+            data = varint.marshal_varuint64(x)
+            v, off = varint.unmarshal_varuint64(data)
+            assert v == x and off == len(data)
+
+
+class TestNearestDelta:
+    def test_lossless_roundtrip(self):
+        rng = np.random.default_rng(1)
+        v = rng.integers(-(1 << 50), 1 << 50, 1000, dtype=np.int64)
+        first, d = nearest_delta_encode(v, 64)
+        np.testing.assert_array_equal(nearest_delta_decode(first, d), v)
+
+    def test_lossy_bounded_error(self):
+        rng = np.random.default_rng(2)
+        v = np.cumsum(rng.integers(-1000, 1000, 500)).astype(np.int64) + 10**9
+        for bits in (4, 8, 16, 32):
+            first, d = nearest_delta_encode(v, bits)
+            out = nearest_delta_decode(first, d)
+            # error per step bounded by delta magnitude / 2^(bits-1); with
+            # error feedback it never accumulates beyond one step's rounding.
+            max_err = np.abs(np.diff(v)).max() / (1 << (bits - 1)) + 1
+            assert np.abs(out - v).max() <= max_err
+
+    def test_delta2_lossless_roundtrip(self):
+        rng = np.random.default_rng(4)
+        v = np.cumsum(np.cumsum(rng.integers(-5, 5, 300))).astype(np.int64)
+        first, fd, d2 = nearest_delta2_encode(v, 64)
+        np.testing.assert_array_equal(nearest_delta2_decode(first, fd, d2), v)
+
+    def test_delta2_linear_is_zeros(self):
+        v = np.arange(0, 10000, 15, dtype=np.int64)
+        _, _, d2 = nearest_delta2_encode(v, 64)
+        assert (d2 == 0).all()
+
+
+class TestMarshalInt64Array:
+    def roundtrip(self, v, bits=64):
+        data, mt, first = enc.marshal_int64_array(v, bits)
+        return enc.unmarshal_int64_array(data, mt, first, v.size), mt
+
+    def test_const(self):
+        v = np.full(100, 42, dtype=np.int64)
+        out, mt = self.roundtrip(v)
+        assert mt == enc.MarshalType.CONST
+        np.testing.assert_array_equal(out, v)
+
+    def test_delta_const(self):
+        v = np.arange(1000, 9000, 15, dtype=np.int64)
+        out, mt = self.roundtrip(v)
+        assert mt == enc.MarshalType.DELTA_CONST
+        np.testing.assert_array_equal(out, v)
+
+    def test_counter_uses_delta2(self):
+        rng = np.random.default_rng(5)
+        v = np.cumsum(rng.integers(0, 100, 500)).astype(np.int64)
+        out, mt = self.roundtrip(v)
+        assert mt in (enc.MarshalType.NEAREST_DELTA2,
+                      enc.MarshalType.ZSTD_NEAREST_DELTA2)
+        np.testing.assert_array_equal(out, v)
+
+    def test_gauge_uses_delta(self):
+        rng = np.random.default_rng(6)
+        v = rng.integers(-1000, 1000, 500).astype(np.int64)
+        out, mt = self.roundtrip(v)
+        assert mt in (enc.MarshalType.NEAREST_DELTA,
+                      enc.MarshalType.ZSTD_NEAREST_DELTA)
+        np.testing.assert_array_equal(out, v)
+
+    def test_compressible_uses_zstd(self):
+        # long, highly regular but not delta-const payload
+        v = np.cumsum(np.tile([1, 2, 3, 4], 2048)).astype(np.int64)
+        data, mt, first = enc.marshal_int64_array(v, 64)
+        assert mt in (enc.MarshalType.ZSTD_NEAREST_DELTA2,
+                      enc.MarshalType.ZSTD_NEAREST_DELTA)
+        out = enc.unmarshal_int64_array(data, mt, first, v.size)
+        np.testing.assert_array_equal(out, v)
+
+    def test_tiny_blocks_not_compressed(self):
+        v = np.array([1, 5, 2, 9, 3], dtype=np.int64)
+        _, mt, _ = enc.marshal_int64_array(v, 64)
+        assert mt not in (enc.MarshalType.ZSTD_NEAREST_DELTA,
+                          enc.MarshalType.ZSTD_NEAREST_DELTA2)
+
+    def test_single_value(self):
+        v = np.array([-7], dtype=np.int64)
+        out, mt = self.roundtrip(v)
+        assert mt == enc.MarshalType.CONST
+        np.testing.assert_array_equal(out, v)
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            enc.marshal_int64_array(np.array([], dtype=np.int64))
+
+
+class TestTimestamps:
+    def test_scrape_timestamps_compact(self):
+        # 8k timestamps at fixed 15s interval -> DELTA_CONST, ~few bytes
+        ts = np.arange(0, 8192 * 15000, 15000, dtype=np.int64) + 1700000000000
+        data, mt, first = enc.marshal_timestamps(ts)
+        assert mt == enc.MarshalType.DELTA_CONST
+        assert len(data) < 8
+        out = enc.unmarshal_timestamps(data, mt, first, ts.size)
+        np.testing.assert_array_equal(out, ts)
+
+    def test_jittered_timestamps(self):
+        rng = np.random.default_rng(8)
+        ts = (np.arange(4096, dtype=np.int64) * 15000 + 1700000000000
+              + rng.integers(-50, 50, 4096))
+        data, mt, first = enc.marshal_timestamps(ts)
+        out = enc.unmarshal_timestamps(data, mt, first, ts.size)
+        np.testing.assert_array_equal(out, ts)
+
+    def test_validation_clamps(self):
+        out = enc.ensure_non_decreasing_sequence(
+            np.array([1, 5, 3, 7, 6], dtype=np.int64))
+        np.testing.assert_array_equal(out, [1, 5, 5, 7, 7])
+
+
+class TestVarintMalformed:
+    def test_unterminated_trailing_varint_raises(self):
+        with pytest.raises(ValueError):
+            varint.unmarshal_varint64s(b"\x01\x81", 1)
+
+    def test_all_continuation_raises(self):
+        with pytest.raises(ValueError):
+            varint.unmarshal_varint64s(b"\x80")

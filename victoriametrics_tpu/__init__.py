@@ -1,0 +1,25 @@
+"""victoriametrics_tpu — a TPU-native time-series monitoring framework.
+
+A brand-new implementation of the capabilities of VictoriaMetrics
+(reference: /root/reference), redesigned host/device:
+
+- Host plane (Python + C-extensions): storage files, LSM index, wire
+  protocols, HTTP APIs, cluster RPC.
+- Device plane (JAX/XLA/Pallas on TPU): block decode, windowed rollups
+  (``rate`` / ``*_over_time``), and segment-reduced aggregations
+  (``sum/avg/topk by(...)``) over (series, step) tiles, sharded across a
+  ``jax.sharding.Mesh``.
+
+Layer map mirrors SURVEY.md:
+  utils/    — L0 runtime utils (logging, time, memory)
+  ops/      — L1 codecs (decimal, varint, nearest-delta) + device kernels
+  storage/  — L2-L4 file formats, LSM partitions, inverted index
+  parallel/ — L5 cluster RPC + mesh sharding
+  ingest/   — L6 protocol parsers, relabeling, stream aggregation
+  query/    — L7 MetricsQL parser + evaluator
+  httpapi/  — L8 HTTP surface
+  models/   — flagship jittable device pipelines (query "models")
+  apps/     — L9 processes (vmsingle, vmstorage, vminsert, vmselect, ...)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,27 @@
+"""zstd block compression (reference lib/encoding/compress.go:13-38 and
+lib/encoding/zstd — the reference's single cgo/native dependency).
+
+Uses the CPython `zstandard` package (libzstd-backed). Level 1 by default:
+block payloads are small (<64KB) and this host has few cores, so speed wins;
+the reference reaches the same trade-off via its cgo fast path.
+"""
+
+from __future__ import annotations
+
+import zstandard
+
+_compressors: dict[int, zstandard.ZstdCompressor] = {}
+_decompressor = zstandard.ZstdDecompressor()
+
+DEFAULT_LEVEL = 1
+
+
+def compress(data: bytes, level: int = DEFAULT_LEVEL) -> bytes:
+    c = _compressors.get(level)
+    if c is None:
+        c = _compressors[level] = zstandard.ZstdCompressor(level=level)
+    return c.compress(data)
+
+
+def decompress(data: bytes, max_size: int = 1 << 30) -> bytes:
+    return _decompressor.decompress(data, max_output_size=max_size)

@@ -1,0 +1,226 @@
+"""Decimal codec: float64[] <-> (int64 mantissas, common decimal exponent).
+
+Capability parity with reference lib/decimal/decimal.go (AppendFloatToDecimal
+decimal.go:173, AppendDecimalToFloat decimal.go:100, CalibrateScale
+decimal.go:13, StaleNaN handling decimal.go:394-427), re-designed as
+vectorized NumPy: per-value (mantissa, exponent) decomposition with trailing
+-zero stripping runs as fixed-trip masked loops so the same code path can be
+traced by JAX later.
+
+Values are stored in blocks as int64 mantissas sharing one decimal exponent:
+``v ~= m * 10^e``. Special float values map to reserved int64 constants so
+they survive integer codecs:
+
+  NaN        -> V_NAN
+  StaleNaN   -> V_STALE_NAN  (Prometheus staleness marker, bits 0x7ff0000000000002)
+  +Inf/-Inf  -> V_INF_POS / V_INF_NEG
+
+Normal mantissas are bounded by MAX_MANTISSA (1e17, ~17 significant digits —
+beyond float64's precision) so deltas of two mantissas never overflow int64.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Reserved int64 sentinels (chosen to leave |m| <= MAX_MANTISSA for normal values).
+V_NAN = -(1 << 63)
+V_STALE_NAN = -(1 << 63) + 1
+V_INF_NEG = -(1 << 63) + 2
+V_INF_POS = (1 << 63) - 1
+
+MAX_MANTISSA = 10 ** 17
+_SIG_DIGITS = 17  # max significant decimal digits kept
+
+# Prometheus staleness marker: a specific quiet-NaN bit pattern.
+STALE_NAN_BITS = np.uint64(0x7FF0000000000002)
+STALE_NAN = float(np.uint64(STALE_NAN_BITS).view(np.float64))
+
+_MIN_EXP = -320
+_MAX_EXP = 310
+
+
+def is_stale_nan(values: np.ndarray) -> np.ndarray:
+    """Elementwise test for the staleness-marker NaN (bit-exact)."""
+    v = np.asarray(values, dtype=np.float64)
+    return v.view(np.uint64) == STALE_NAN_BITS
+
+
+def _pow10_float(e):
+    """10^e as float64; exact for |e| <= 22."""
+    return np.power(10.0, np.asarray(e, dtype=np.float64))
+
+
+def float_to_decimal(values: np.ndarray) -> tuple[np.ndarray, int]:
+    """Convert float64 array to (int64 mantissas, common exponent).
+
+    Lossy only when values span more decimal orders than MAX_MANTISSA allows;
+    "nice" decimal values (integers, few decimal places) round-trip exactly.
+    """
+    v = np.asarray(values, dtype=np.float64)
+    n = v.size
+    if n == 0:
+        return np.zeros(0, dtype=np.int64), 0
+
+    stale = is_stale_nan(v)
+    nan = np.isnan(v) & ~stale
+    posinf = np.isposinf(v)
+    neginf = np.isneginf(v)
+    zero = v == 0.0
+    special = stale | nan | posinf | neginf
+    normal = ~special & ~zero
+
+    m = np.zeros(n, dtype=np.int64)
+    e = np.zeros(n, dtype=np.int64)
+
+    if normal.any():
+        vn = np.where(normal, v, 1.0)
+        exp10 = np.floor(np.log10(np.abs(vn))).astype(np.int64)
+
+        def _scale_up(x, e):
+            # x * 10^e for e >= 0 without overflowing the float64 power:
+            # split the exponent so each factor stays finite (10^e overflows
+            # above e=308 even when the product x*10^e is tiny).
+            e1 = np.minimum(e, 300)
+            e2 = e - e1
+            return x * _pow10_float(e1) * _pow10_float(e2)
+
+        def _decompose(digits):
+            ei = np.clip(exp10 - (digits - 1), _MIN_EXP, _MAX_EXP)
+            # m = round(v / 10^ei); for negative ei multiply by 10^-ei (exact
+            # float64 power of ten for small magnitudes) to minimise error.
+            scaled = np.where(ei < 0, _scale_up(vn, -ei), vn / _pow10_float(ei))
+            mi = np.round(scaled)
+            # Guard against 1-off exponent from floor(log10) at power edges.
+            over = np.abs(mi) >= 10 ** digits
+            if over.any():
+                ei = np.where(over, ei + 1, ei)
+                scaled = np.where(ei < 0, _scale_up(vn, -ei), vn / _pow10_float(ei))
+                mi = np.round(scaled)
+            # Clamp before the int64 cast: a residual overflow must saturate
+            # at MAX_MANTISSA, never wrap into the reserved sentinel range.
+            mi = np.clip(mi, -MAX_MANTISSA, MAX_MANTISSA)
+            return mi.astype(np.int64), ei
+
+        # Two-stage extraction: 15 significant digits reconstruct bit-exactly
+        # for decimal-representable values (scrape payloads are decimal text),
+        # giving small mantissas that strip to e.g. exp=-3. Values needing
+        # full float64 precision (e.g. 2/3) fall back to 17 digits.
+        m15, e15 = _decompose(15)
+        recon = np.where(e15 < 0,
+                         m15.astype(np.float64) / _pow10_float(-e15),
+                         m15.astype(np.float64) * _pow10_float(e15))
+        exact15 = recon == vn
+        m17, e17 = _decompose(_SIG_DIGITS)
+        mi = np.where(exact15, m15, m17)
+        ei = np.where(exact15, e15, e17)
+        # Strip trailing decimal zeros (fixed-trip masked loop, max 17 iters).
+        for _ in range(_SIG_DIGITS):
+            can = (mi != 0) & (mi % 10 == 0) & normal
+            if not can.any():
+                break
+            mi = np.where(can, mi // 10, mi)
+            ei = np.where(can, ei + 1, ei)
+        m = np.where(normal, mi, m)
+        e = np.where(normal, ei, e)
+
+    if normal.any():
+        e_norm = e[normal]
+        m_norm = m[normal]
+        # Common exponent: as small as possible without overflowing mantissas.
+        # Scaling m from exponent E down to `exp` multiplies it by 10^(E-exp);
+        # the largest allowed up-shift for m is floor(log10(MAX_MANTISSA/|m|)).
+        absm = np.abs(m_norm).astype(np.float64)
+        absm = np.maximum(absm, 1.0)
+        allowed_up = np.floor(np.log10(MAX_MANTISSA / absm)).astype(np.int64)
+        exp = int(min(e_norm.min(), _MAX_EXP))
+        exp_floor = int((e_norm - allowed_up).max())
+        if exp_floor > exp:
+            exp = exp_floor
+        exp = max(min(exp, _MAX_EXP), _MIN_EXP)
+        # Rescale all normal mantissas to the common exponent.
+        shift = e - exp
+        up = normal & (shift > 0)
+        down = normal & (shift < 0)
+        if up.any():
+            factor = np.power(10.0, np.where(up, shift, 0).astype(np.float64))
+            m = np.where(up, (m.astype(np.float64) * factor).astype(np.int64), m)
+        if down.any():
+            # Lossy: value has more precision than the common scale can hold.
+            # Shifts beyond 18 decimal places collapse the mantissa to zero.
+            dshift = np.minimum(np.where(down, -shift, 1), 19).astype(np.float64)
+            factor = np.power(10.0, dshift)
+            m = np.where(down, np.round(m.astype(np.float64) / factor).astype(np.int64), m)
+    else:
+        exp = 0
+
+    m = np.where(stale, V_STALE_NAN, m)
+    m = np.where(nan, V_NAN, m)
+    m = np.where(posinf, V_INF_POS, m)
+    m = np.where(neginf, V_INF_NEG, m)
+    return m, int(exp)
+
+
+def decimal_to_float(ints: np.ndarray, exponent: int) -> np.ndarray:
+    """Convert (int64 mantissas, exponent) back to float64 values.
+
+    Division by an exact power of ten is used for negative exponents so that
+    typical decimal values round-trip bit-exactly.
+    """
+    m = np.asarray(ints, dtype=np.int64)
+    stale = m == V_STALE_NAN
+    nan = m == V_NAN
+    posinf = m == V_INF_POS
+    neginf = m == V_INF_NEG
+    special = stale | nan | posinf | neginf
+
+    mf = np.where(special, 0, m).astype(np.float64)
+    if exponent == 0:
+        out = mf
+    elif exponent < 0:
+        if exponent >= -22:
+            out = mf / _pow10_float(-exponent)
+        else:
+            out = mf * _pow10_float(exponent)
+    else:
+        out = mf * _pow10_float(exponent)
+
+    out = np.where(stale, STALE_NAN, out)
+    out = np.where(nan, np.nan, out)
+    out = np.where(posinf, np.inf, out)
+    out = np.where(neginf, -np.inf, out)
+    return out
+
+
+def calibrate_scale(a: np.ndarray, a_exp: int, b: np.ndarray, b_exp: int
+                    ) -> tuple[np.ndarray, np.ndarray, int]:
+    """Bring two mantissa arrays to a common exponent (reference
+    CalibrateScale, decimal.go:13). Scales the larger-exponent side up unless
+    that overflows MAX_MANTISSA, in which case the smaller side loses digits.
+    """
+    if a_exp == b_exp:
+        return a, b, a_exp
+    if a_exp > b_exp:
+        b2, a2, e = calibrate_scale(b, b_exp, a, a_exp)
+        return a2, b2, e
+
+    # a_exp < b_exp: try to shift b down to a_exp.
+    def _specials(x):
+        return (x == V_STALE_NAN) | (x == V_NAN) | (x == V_INF_POS) | (x == V_INF_NEG)
+
+    shift = b_exp - a_exp
+    bsp = _specials(b)
+    babs = np.abs(np.where(bsp, 0, b)).astype(np.float64)
+    maxb = babs.max() if b.size else 0.0
+    if maxb == 0.0:
+        # b has no normal mantissas — zeros scale to any exponent for free.
+        return a, b, a_exp
+    if shift <= 18 and maxb * (10.0 ** shift) <= MAX_MANTISSA:
+        factor = np.int64(10) ** np.int64(shift)
+        b2 = np.where(bsp, b, b * factor)
+        return a, b2, a_exp
+    # Can't shift b down fully: shift a up (lossy on a).
+    asp = _specials(a)
+    factor = 10.0 ** shift
+    a2 = np.where(asp, a, np.round(np.where(asp, 0, a).astype(np.float64) / factor).astype(np.int64))
+    return a2, b, b_exp
