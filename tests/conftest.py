@@ -1,12 +1,21 @@
 """Test config: force JAX onto a virtual 8-device CPU mesh so multi-chip
-sharding paths are exercised without TPU hardware. Must run before any jax
-import, hence the env mutation at module import time."""
+sharding paths are exercised without TPU hardware.
+
+The axon TPU plugin in this image overrides JAX_PLATFORMS at import time, so
+the env var alone is not enough — we also update jax.config after import.
+Set VMTPU_TEST_TPU=1 to run the suite against the real chip instead.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+if not os.environ.get("VMTPU_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

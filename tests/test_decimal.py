@@ -138,3 +138,9 @@ class TestReviewRegressions:
         assert e == -25
         np.testing.assert_array_equal(a2, a)
         assert b2[1] == dec.V_STALE_NAN
+
+    def test_large_mantissa_upshift_exact(self):
+        # int64 up-shift must stay exact above 2^53
+        vals = np.array([0.1, 1900000000000001.0])
+        out = roundtrip(vals)
+        np.testing.assert_array_equal(out, vals)

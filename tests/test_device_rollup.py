@@ -1,0 +1,160 @@
+"""Device rollup kernels vs the NumPy oracle, including the sharded mesh
+paths on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.ops import rollup_np
+from victoriametrics_tpu.ops.device_rollup import (
+    aggregate_groups, pack_series, rollup_aggregate_tile, rollup_tile)
+from victoriametrics_tpu.ops.rollup_np import RollupConfig
+from victoriametrics_tpu.parallel import mesh as meshlib
+
+START = 1_753_700_000_000  # unix ms
+
+
+def make_series(rng, n, kind="gauge", interval=15_000, jitter=True):
+    ts = np.arange(n, dtype=np.int64) * interval + START
+    if jitter:
+        ts = ts + rng.integers(-2000, 2000, n)
+        ts.sort()
+    if kind == "gauge":
+        v = np.round(rng.uniform(0, 100, n), 3)
+    elif kind == "counter":
+        v = np.cumsum(rng.integers(0, 50, n)).astype(np.float64)
+    elif kind == "counter_resets":
+        v = np.cumsum(rng.integers(0, 50, n)).astype(np.float64)
+        for p in rng.integers(1, n, 3):
+            v[p:] -= v[p]  # hard reset to 0 at p
+        v = np.abs(v)
+    return ts, v
+
+
+CFG = RollupConfig(start=START + 600_000, end=START + 1_800_000,
+                   step=60_000, window=300_000)
+
+FUNCS = list(rollup_np.SUPPORTED)
+
+
+@pytest.fixture(scope="module")
+def ragged_data():
+    rng = np.random.default_rng(11)
+    series = []
+    for i in range(17):
+        kind = ("gauge", "counter", "counter_resets")[i % 3]
+        n = int(rng.integers(3, 200))
+        series.append(make_series(rng, n, kind))
+    # edge cases: single sample, two samples, empty-window series (all before
+    # query range), sparse series with big gaps
+    series.append((np.array([START + 700_000]), np.array([42.0])))
+    series.append((np.array([START + 700_000, START + 710_000]),
+                   np.array([1.0, 5.0])))
+    series.append((np.array([START - 50_000]), np.array([7.0])))
+    sp_ts = np.array([START, START + 900_000, START + 1_700_000])
+    series.append((sp_ts, np.array([1.0, 100.0, 3.0])))
+    return series
+
+
+@pytest.mark.parametrize("func", FUNCS)
+def test_rollup_matches_oracle(ragged_data, func):
+    series = ragged_data
+    ts, vals, counts = pack_series(series, CFG.start)
+    got = np.asarray(rollup_tile(func, jnp.asarray(ts), jnp.asarray(vals),
+                                 jnp.asarray(counts), CFG))
+    # stddev/stdvar use prefix-sum moments: ~1e-8 absolute noise relative to
+    # the data scale (exactly-zero variances come back ~1e-7); all other
+    # funcs must match the oracle to fp association order.
+    atol = 1e-4 if func.startswith("std") else 1e-9
+    for i, (s_ts, s_v) in enumerate(series):
+        want = rollup_np.rollup(func, s_ts, s_v, CFG)
+        np.testing.assert_allclose(
+            got[i], want, rtol=1e-6 if func.startswith("std") else 1e-9,
+            atol=atol, equal_nan=True, err_msg=f"series {i} func {func}")
+
+
+@pytest.mark.parametrize("aggr", ["sum", "count", "avg", "min", "max", "stddev"])
+def test_aggregate_groups_matches_numpy(ragged_data, aggr):
+    series = ragged_data
+    ts, vals, counts = pack_series(series, CFG.start)
+    S = len(series)
+    rng = np.random.default_rng(5)
+    gids = rng.integers(0, 4, S).astype(np.int32)
+    rolled = np.asarray(rollup_tile("rate", jnp.asarray(ts), jnp.asarray(vals),
+                                    jnp.asarray(counts), CFG))
+    got = np.asarray(aggregate_groups(aggr, jnp.asarray(rolled),
+                                      jnp.asarray(gids), 4))
+    T = rolled.shape[1]
+    want = np.full((4, T), np.nan)
+    for g in range(4):
+        rows = rolled[gids == g]
+        for t in range(T):
+            col = rows[:, t]
+            col = col[~np.isnan(col)]
+            if col.size == 0:
+                continue
+            want[g, t] = dict(
+                sum=col.sum(), count=float(col.size), avg=col.mean(),
+                min=col.min(), max=col.max(), stddev=col.std())[aggr]
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9, equal_nan=True)
+
+
+def test_fused_tile_equals_two_stage(ragged_data):
+    series = ragged_data
+    ts, vals, counts = pack_series(series, CFG.start)
+    gids = np.arange(len(series), dtype=np.int32) % 3
+    fused = np.asarray(rollup_aggregate_tile(
+        "rate", "sum", jnp.asarray(ts), jnp.asarray(vals),
+        jnp.asarray(counts), jnp.asarray(gids), CFG, 3))
+    rolled = rollup_tile("rate", jnp.asarray(ts), jnp.asarray(vals),
+                         jnp.asarray(counts), CFG)
+    two = np.asarray(aggregate_groups("sum", rolled, jnp.asarray(gids), 3))
+    np.testing.assert_allclose(fused, two, equal_nan=True)
+
+
+class TestMesh:
+    def _data(self, S=32, n=120):
+        rng = np.random.default_rng(23)
+        series = [make_series(rng, int(rng.integers(5, n)),
+                              ("gauge", "counter")[i % 2]) for i in range(S)]
+        ts, vals, counts = pack_series(series, CFG.start)
+        gids = (np.arange(S) % 5).astype(np.int32)
+        return series, ts, vals, counts, gids
+
+    @pytest.mark.parametrize("aggr", ["sum", "avg", "max", "count"])
+    def test_series_sharded_matches_single_device(self, aggr):
+        series, ts, vals, counts, gids = self._data()
+        mesh = meshlib.make_mesh(n_series=8, n_time=1)
+        fn = meshlib.sharded_rollup_aggregate(mesh, "rate", aggr, CFG, 5)
+        got = np.asarray(fn(jnp.asarray(ts), jnp.asarray(vals),
+                            jnp.asarray(counts), jnp.asarray(gids)))
+        rolled = rollup_tile("rate", jnp.asarray(ts), jnp.asarray(vals),
+                             jnp.asarray(counts), CFG)
+        want = np.asarray(aggregate_groups(aggr, rolled, jnp.asarray(gids), 5))
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9,
+                                   equal_nan=True)
+
+    def test_time_sharded_matches_single_device(self):
+        # sequence-parallel: samples split into contiguous time chunks
+        rng = np.random.default_rng(31)
+        S, N = 8, 512
+        interval = 10_000
+        ts = np.tile(np.arange(N, dtype=np.int64) * interval, (S, 1))
+        vals = np.cumsum(rng.integers(0, 20, (S, N)), axis=1).astype(np.float64)
+        cfg = RollupConfig(start=0, end=N * interval - interval,
+                           step=interval * 4, window=interval * 8)
+        T = (cfg.end - cfg.start) // cfg.step + 1
+        assert T % 4 == 0
+        mesh = meshlib.make_mesh(n_series=2, n_time=4)
+        valid = np.ones((S, N), dtype=bool)
+        halo = 16  # > window/interval + 1
+        fn = meshlib.time_sharded_rollup(mesh, "rate", cfg, halo)
+        got = np.asarray(fn(jnp.asarray(ts.astype(np.int32)),
+                            jnp.asarray(vals), jnp.asarray(valid)))
+        counts = np.full(S, N, dtype=np.int32)
+        want = np.asarray(rollup_tile("rate", jnp.asarray(ts.astype(np.int32)),
+                                      jnp.asarray(vals), jnp.asarray(counts),
+                                      cfg))
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9,
+                                   equal_nan=True)

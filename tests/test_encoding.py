@@ -171,3 +171,7 @@ class TestVarintMalformed:
     def test_all_continuation_raises(self):
         with pytest.raises(ValueError):
             varint.unmarshal_varint64s(b"\x80")
+
+    def test_overlong_varint_raises(self):
+        with pytest.raises(ValueError):
+            varint.unmarshal_varint64s(b"\x81" * 10 + b"\x01", 1)

@@ -102,18 +102,22 @@ def float_to_decimal(values: np.ndarray) -> tuple[np.ndarray, int]:
             mi = np.clip(mi, -MAX_MANTISSA, MAX_MANTISSA)
             return mi.astype(np.int64), ei
 
-        # Two-stage extraction: 15 significant digits reconstruct bit-exactly
-        # for decimal-representable values (scrape payloads are decimal text),
-        # giving small mantissas that strip to e.g. exp=-3. Values needing
-        # full float64 precision (e.g. 2/3) fall back to 17 digits.
+        # Three-way extraction, first match wins:
+        # 1. integer-valued floats up to MAX_MANTISSA: direct int64 cast is
+        #    exact (scaling by powers of ten would round above 2^53);
+        # 2. 15 significant digits when they reconstruct bit-exactly
+        #    (decimal-representable scrape text), giving small mantissas;
+        # 3. 17 digits for values needing full float64 precision (e.g. 2/3).
+        is_int = (vn == np.floor(vn)) & (np.abs(vn) <= MAX_MANTISSA)
         m15, e15 = _decompose(15)
         recon = np.where(e15 < 0,
                          m15.astype(np.float64) / _pow10_float(-e15),
                          m15.astype(np.float64) * _pow10_float(e15))
         exact15 = recon == vn
         m17, e17 = _decompose(_SIG_DIGITS)
-        mi = np.where(exact15, m15, m17)
-        ei = np.where(exact15, e15, e17)
+        mi = np.where(is_int, np.where(is_int, vn, 0.0).astype(np.int64),
+                      np.where(exact15, m15, m17))
+        ei = np.where(is_int, 0, np.where(exact15, e15, e17))
         # Strip trailing decimal zeros (fixed-trip masked loop, max 17 iters).
         for _ in range(_SIG_DIGITS):
             can = (mi != 0) & (mi % 10 == 0) & normal
@@ -143,8 +147,11 @@ def float_to_decimal(values: np.ndarray) -> tuple[np.ndarray, int]:
         up = normal & (shift > 0)
         down = normal & (shift < 0)
         if up.any():
-            factor = np.power(10.0, np.where(up, shift, 0).astype(np.float64))
-            m = np.where(up, (m.astype(np.float64) * factor).astype(np.int64), m)
+            # Exact int64 multiply: the shifted product is bounded by
+            # MAX_MANTISSA (1e17 < 2^63) by construction of allowed_up, and a
+            # float64 multiply here would corrupt mantissas above 2^53.
+            factor = np.power(np.int64(10), np.where(up, shift, 0).astype(np.int64))
+            m = np.where(up, m * factor, m)
         if down.any():
             # Lossy: value has more precision than the common scale can hold.
             # Shifts beyond 18 decimal places collapse the mantissa to zero.
@@ -174,12 +181,20 @@ def decimal_to_float(ints: np.ndarray, exponent: int) -> np.ndarray:
     neginf = m == V_INF_NEG
     special = stale | nan | posinf | neginf
 
-    mf = np.where(special, 0, m).astype(np.float64)
+    mn = np.where(special, 0, m)
+    mf = mn.astype(np.float64)
     if exponent == 0:
         out = mf
     elif exponent < 0:
         if exponent >= -22:
             out = mf / _pow10_float(-exponent)
+            if exponent >= -18:
+                # Mantissas above 2^53 round in the int64->float64 cast; when
+                # the division is exact in integers, divide first instead.
+                p = np.int64(10) ** np.int64(-exponent)
+                q = mn // p
+                exact = (mn - q * p == 0)
+                out = np.where(exact, q.astype(np.float64), out)
         else:
             out = mf * _pow10_float(exponent)
     else:

@@ -1,0 +1,347 @@
+"""TPU rollup kernels: windowed rollups over (series, sample) tiles.
+
+This is the device half of the query engine's north-star hot loop (the
+reference's rollupConfig.doInternal window walk, rollup.go:688-825, and the
+unpack+merge workers around it). Instead of a per-series sliding-window scan,
+everything is expressed as dense, fixed-shape array ops XLA can fuse and tile:
+
+- window endpoints: vmapped ``searchsorted`` over padded timestamp rows
+  (the idx-hint binary search of rollup.go:825 becomes one batched gather)
+- sum/count/avg/stddev/stdvar/deriv: cumulative-moment prefix sums, window
+  value = cum[hi] - cum[lo]
+- min/max: sparse-table RMQ (O(N log N) precompute, two gathers per window)
+- counter resets: prefix sum of negative jumps (removeCounterResets,
+  rollup.go:921, as an associative scan)
+- rate/delta/increase continuity: "real previous value" = gather at lo-1
+
+Inputs are padded ragged tiles:
+  ts:     int32 [S, N]  sample timestamps, ms, relative to cfg.start,
+                        padded with TS_PAD (must exceed any window bound)
+  values: float  [S, N] padded with anything (masked via counts)
+  counts: int32 [S]     valid samples per row
+
+Empty windows produce NaN, matching the ops/rollup_np.py oracle, which this
+module must agree with bit-for-bit up to float association order.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .rollup_np import RollupConfig
+
+TS_PAD = np.int32(2**31 - 1)
+
+
+def _valid_mask(counts: jnp.ndarray, n: int) -> jnp.ndarray:
+    return jnp.arange(n, dtype=jnp.int32)[None, :] < counts[:, None]
+
+
+def _cum0(x: jnp.ndarray) -> jnp.ndarray:
+    """Prefix sum with leading zero along axis 1: out[:, i] = sum(x[:, :i])."""
+    return jnp.pad(jnp.cumsum(x, axis=1), ((0, 0), (1, 0)))
+
+
+def _window_bounds(ts: jnp.ndarray, cfg: RollupConfig) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (lo, hi) int32 [S, T]: half-open sample index range per output
+    step, plus the relative output grid."""
+    T = (cfg.end - cfg.start) // cfg.step + 1
+    grid = (jnp.arange(T, dtype=jnp.int64) * cfg.step)
+    lookback = cfg.lookback
+    lo_t = (grid - lookback).astype(jnp.int32)
+    hi_t = grid.astype(jnp.int32)
+    lo = jax.vmap(lambda row: jnp.searchsorted(row, lo_t, side="right"))(ts)
+    hi = jax.vmap(lambda row: jnp.searchsorted(row, hi_t, side="right"))(ts)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32), grid
+
+
+def _gather(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise gather: x [S, N], idx [S, T] -> [S, T], idx clipped."""
+    idx = jnp.clip(idx, 0, x.shape[1] - 1)
+    return jnp.take_along_axis(x, idx, axis=1)
+
+
+def _rmq_tables(x: jnp.ndarray, op: Callable, pad_val) -> list[jnp.ndarray]:
+    """Sparse-table RMQ precompute: tables[l][s, i] = op over x[s, i:i+2^l]."""
+    n = x.shape[1]
+    levels = max(int(np.ceil(np.log2(max(n, 1)))) + 1, 1)
+    t = x
+    tables = [t]
+    for l in range(1, levels):
+        half = 1 << (l - 1)
+        shifted = jnp.concatenate(
+            [t[:, half:], jnp.full((x.shape[0], half), pad_val, x.dtype)], axis=1)
+        t = op(t, shifted)
+        tables.append(t)
+    return tables
+
+
+def _rmq_query(tables: list[jnp.ndarray], lo: jnp.ndarray, hi: jnp.ndarray,
+               op: Callable) -> jnp.ndarray:
+    """Range op over [lo, hi) via two overlapping power-of-two windows."""
+    length = jnp.maximum(hi - lo, 1)
+    k = jnp.floor(jnp.log2(length.astype(jnp.float32))).astype(jnp.int32)
+    k = jnp.clip(k, 0, len(tables) - 1)
+    stacked = jnp.stack(tables)  # [L, S, N]
+    S, T = lo.shape
+    s_idx = jnp.arange(S, dtype=jnp.int32)[:, None]
+    a = stacked[k, s_idx, jnp.clip(lo, 0, tables[0].shape[1] - 1)]
+    b_pos = jnp.clip(hi - (1 << k), 0, tables[0].shape[1] - 1)
+    b = stacked[k, s_idx, b_pos]
+    return op(a, b)
+
+
+def _remove_counter_resets(v: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Monotonize counters: add back the lost base at each reset (prefix sum
+    of negative jumps). Pad positions contribute nothing."""
+    vm = jnp.where(valid, v, 0.0)
+    prev = jnp.concatenate([vm[:, :1], vm[:, :-1]], axis=1)
+    pair_valid = valid & jnp.concatenate(
+        [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+    drop = jnp.where(pair_valid & (vm < prev), prev - vm, 0.0)
+    return v + jnp.cumsum(drop, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("func", "cfg"))
+def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
+                counts: jnp.ndarray, cfg: RollupConfig) -> jnp.ndarray:
+    """Windowed rollup over a padded tile -> [S, T] float array (NaN = gap)."""
+    S, N = ts.shape
+    dtype = values.dtype
+    nan = jnp.asarray(jnp.nan, dtype)
+    valid = _valid_mask(counts, N)
+    lo, hi, grid = _window_bounds(ts, cfg)
+    n_win = (hi - lo).astype(dtype)
+    have = hi > lo
+    has_prev = lo >= 1
+
+    vm = jnp.where(valid, values, 0.0)
+    tsf = jnp.where(valid, ts, 0).astype(dtype)
+
+    def masked(x, cond=None):
+        c = have if cond is None else cond
+        return jnp.where(c, x, nan)
+
+    if func in ("count_over_time",):
+        return masked(n_win)
+    if func == "present_over_time":
+        return masked(jnp.ones_like(n_win))
+
+    if func == "sum_over_time":
+        c = _cum0(vm)
+        return masked(_gather(c, hi) - _gather(c, lo))
+    if func == "avg_over_time":
+        c = _cum0(vm)
+        return masked((_gather(c, hi) - _gather(c, lo)) / n_win)
+    if func in ("stddev_over_time", "stdvar_over_time"):
+        # Center by the per-series mean first: variance is shift-invariant
+        # and this keeps the E[x^2]-E[x]^2 cancellation well-conditioned.
+        total = jnp.sum(vm, axis=1, keepdims=True)
+        cnt_all = jnp.maximum(counts[:, None].astype(dtype), 1.0)
+        centered = jnp.where(valid, values - total / cnt_all, 0.0)
+        c1 = _cum0(centered)
+        c2 = _cum0(centered * centered)
+        s1 = _gather(c1, hi) - _gather(c1, lo)
+        s2 = _gather(c2, hi) - _gather(c2, lo)
+        var = jnp.maximum(s2 / n_win - (s1 / n_win) ** 2, 0.0)
+        return masked(jnp.sqrt(var) if func == "stddev_over_time" else var)
+    if func == "min_over_time":
+        x = jnp.where(valid, values, jnp.inf)
+        t = _rmq_tables(x, jnp.minimum, jnp.inf)
+        return masked(_rmq_query(t, lo, hi, jnp.minimum))
+    if func == "max_over_time":
+        x = jnp.where(valid, values, -jnp.inf)
+        t = _rmq_tables(x, jnp.maximum, -jnp.inf)
+        return masked(_rmq_query(t, lo, hi, jnp.maximum))
+    if func == "first_over_time":
+        return masked(_gather(values, lo))
+    if func in ("last_over_time", "default_rollup"):
+        return masked(_gather(values, hi - 1))
+    # Timestamps in the tile are relative to cfg.start (int32 rebase);
+    # t-valued funcs add the base back to return absolute unix seconds.
+    base_s = jnp.asarray(cfg.start, dtype) / 1e3
+    if func == "tfirst_over_time":
+        return masked(_gather(tsf, lo) / 1e3 + base_s)
+    if func in ("tlast_over_time", "timestamp"):
+        return masked(_gather(tsf, hi - 1) / 1e3 + base_s)
+    if func == "lag":
+        return masked((grid.astype(dtype)[None, :] - _gather(tsf, hi - 1)) / 1e3)
+    if func == "changes":
+        prev_col = jnp.concatenate([vm[:, :1], vm[:, :-1]], axis=1)
+        pair_valid = valid & jnp.concatenate(
+            [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+        chg = jnp.where(pair_valid & (vm != prev_col), 1.0, 0.0)
+        c = _cum0(chg)
+        # chg[i] is the transition (i-1, i); window changes = chg[lo..hi-1],
+        # which already includes the boundary transition from the real prev
+        # value when lo >= 1. With no prev (lo == 0) start from chg[1].
+        inner_lo = jnp.maximum(lo, 1)
+        return masked(_gather(c, hi) - _gather(c, inner_lo))
+
+    if func == "delta":
+        v_last = _gather(values, hi - 1)
+        base = jnp.where(has_prev, _gather(values, lo - 1), _gather(values, lo))
+        return masked(v_last - base)
+    if func == "idelta":
+        two = hi - lo >= 2
+        v_last = _gather(values, hi - 1)
+        prev = jnp.where(two, _gather(values, hi - 2),
+                         _gather(values, lo - 1))
+        return masked(v_last - prev, have & (two | has_prev))
+
+    if func in ("increase", "increase_pure", "rate", "irate"):
+        cv = _remove_counter_resets(values, valid)
+        c_last = _gather(cv, hi - 1)
+        c_first = _gather(cv, lo)
+        c_prev = _gather(cv, lo - 1)
+        base = jnp.where(has_prev, c_prev, c_first)
+        if func in ("increase", "increase_pure"):
+            return masked(c_last - base)
+        t_last = _gather(tsf, hi - 1)
+        t_first = _gather(tsf, lo)
+        t_prev = _gather(tsf, lo - 1)
+        if func == "rate":
+            two = hi - lo >= 2
+            ok = have & (has_prev | two)
+            dt = jnp.where(has_prev, t_last - t_prev, t_last - t_first) / 1e3
+            dv = c_last - base
+            return masked(jnp.where(dt > 0, dv / dt, nan), ok)
+        # irate: last two samples
+        two = hi - lo >= 2
+        ok = have & (two | has_prev)
+        c_l2 = jnp.where(two, _gather(cv, hi - 2), c_prev)
+        t_l2 = jnp.where(two, _gather(tsf, hi - 2), t_prev)
+        dt = (t_last - t_l2) / 1e3
+        return masked(jnp.where(dt > 0, (c_last - c_l2) / dt, nan), ok)
+
+    if func == "deriv_fast":
+        v_last = _gather(values, hi - 1)
+        t_last = _gather(tsf, hi - 1)
+        two = hi - lo >= 2
+        base_v = jnp.where(has_prev, _gather(values, lo - 1), _gather(values, lo))
+        base_t = jnp.where(has_prev, _gather(tsf, lo - 1), _gather(tsf, lo))
+        ok = have & (has_prev | two)
+        dt = (t_last - base_t) / 1e3
+        return masked(jnp.where(dt > 0, (v_last - base_v) / dt, nan), ok)
+
+    if func == "deriv":
+        # least-squares slope via cumulative moments, t in seconds relative
+        # to each window's first sample (subtracted analytically to keep
+        # f32-path cancellation manageable)
+        ts_s = tsf / 1e3
+        c_t = _cum0(jnp.where(valid, ts_s, 0.0))
+        c_tt = _cum0(jnp.where(valid, ts_s * ts_s, 0.0))
+        c_v = _cum0(vm)
+        c_tv = _cum0(jnp.where(valid, ts_s * values, 0.0))
+        st = _gather(c_t, hi) - _gather(c_t, lo)
+        stt = _gather(c_tt, hi) - _gather(c_tt, lo)
+        sv = _gather(c_v, hi) - _gather(c_v, lo)
+        stv = _gather(c_tv, hi) - _gather(c_tv, lo)
+        t0 = _gather(ts_s, lo)
+        # shift t -> t - t0: st' = st - n*t0; stt' = stt - 2 t0 st + n t0²;
+        # stv' = stv - t0*sv
+        st_ = st - n_win * t0
+        stt_ = stt - 2 * t0 * st + n_win * t0 * t0
+        stv_ = stv - t0 * sv
+        den = n_win * stt_ - st_ * st_
+        ok = have & (hi - lo >= 2)
+        return masked(jnp.where(den != 0, (n_win * stv_ - st_ * sv) / den, nan), ok)
+
+    if func == "lifetime":
+        t_last = _gather(tsf, hi - 1)
+        t_first = jnp.where(has_prev, tsf[:, :1], _gather(tsf, lo))
+        return masked((t_last - t_first) / 1e3)
+    if func == "scrape_interval":
+        t_last = _gather(tsf, hi - 1)
+        t_first = _gather(tsf, lo)
+        t_prev = _gather(tsf, lo - 1)
+        two = hi - lo >= 2
+        ok = have & (has_prev | two)
+        dt = jnp.where(has_prev, t_last - t_prev, t_last - t_first) / 1e3
+        cnt = jnp.where(has_prev, n_win, n_win - 1)
+        return masked(jnp.where(cnt > 0, dt / cnt, nan), ok)
+
+    raise ValueError(f"unsupported device rollup func {func!r}")
+
+
+# ---------------------------------------------------------------------------
+# Grouped aggregation over series (the incremental-aggregation analog:
+# aggr_incremental.go:18-67 becomes one segment-reduction).
+# ---------------------------------------------------------------------------
+
+AGGR_FUNCS = ("sum", "count", "avg", "min", "max", "group", "stddev", "stdvar")
+
+
+def aggregate_groups(aggr: str, rolled: jnp.ndarray, group_ids: jnp.ndarray,
+                     num_groups: int) -> jnp.ndarray:
+    """Aggregate per-series rollup results [S, T] into [G, T] by group id.
+    NaN inputs mean 'series absent at this step' and are skipped; groups with
+    no live series at a step yield NaN."""
+    present = ~jnp.isnan(rolled)
+    zeroed = jnp.where(present, rolled, 0.0)
+    cnt = jax.ops.segment_sum(present.astype(rolled.dtype), group_ids,
+                              num_segments=num_groups)
+    nan = jnp.asarray(jnp.nan, rolled.dtype)
+    if aggr in ("sum", "avg", "stddev", "stdvar"):
+        s1 = jax.ops.segment_sum(zeroed, group_ids, num_segments=num_groups)
+        if aggr == "sum":
+            out = s1
+        elif aggr == "avg":
+            out = s1 / cnt
+        else:
+            s2 = jax.ops.segment_sum(zeroed * zeroed, group_ids,
+                                     num_segments=num_groups)
+            var = jnp.maximum(s2 / cnt - (s1 / cnt) ** 2, 0.0)
+            out = jnp.sqrt(var) if aggr == "stddev" else var
+    elif aggr == "count":
+        out = cnt
+    elif aggr == "min":
+        out = jax.ops.segment_min(jnp.where(present, rolled, jnp.inf),
+                                  group_ids, num_segments=num_groups)
+    elif aggr == "max":
+        out = jax.ops.segment_max(jnp.where(present, rolled, -jnp.inf),
+                                  group_ids, num_segments=num_groups)
+    elif aggr == "group":
+        out = jnp.ones((num_groups, rolled.shape[1]), rolled.dtype)
+    else:
+        raise ValueError(f"unsupported aggregate {aggr!r}")
+    return jnp.where(cnt > 0, out, nan)
+
+
+@functools.partial(jax.jit, static_argnames=("rollup_func", "aggr", "cfg", "num_groups"))
+def rollup_aggregate_tile(rollup_func: str, aggr: str, ts: jnp.ndarray,
+                          values: jnp.ndarray, counts: jnp.ndarray,
+                          group_ids: jnp.ndarray, cfg: RollupConfig,
+                          num_groups: int) -> jnp.ndarray:
+    """Fused aggr(rollup(m[d])) over one tile -> [G, T]."""
+    rolled = rollup_tile(rollup_func, ts, values, counts, cfg)
+    return aggregate_groups(aggr, rolled, group_ids, num_groups)
+
+
+def pack_series(series: list[tuple[np.ndarray, np.ndarray]], start_ms: int,
+                n_pad: int | None = None, dtype=np.float64
+                ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side packing: ragged [(ts_ms, values)] -> padded tile arrays
+    (ts_rel int32 [S, N], values [S, N], counts int32 [S]).
+
+    Timestamps are re-based to start_ms so they fit int32 (range limit ~24.8
+    days; the evaluator chunks longer ranges)."""
+    S = len(series)
+    counts = np.array([len(t) for t, _ in series], dtype=np.int32)
+    N = n_pad or (int(counts.max()) if S else 1)
+    N = max(N, 1)
+    ts = np.full((S, N), TS_PAD, dtype=np.int32)
+    vals = np.zeros((S, N), dtype=dtype)
+    for i, (t, v) in enumerate(series):
+        c = counts[i]
+        rel = np.asarray(t, dtype=np.int64) - start_ms
+        if c and (rel.max() >= TS_PAD or rel.min() <= -(2**31)):
+            raise ValueError("time range too wide for int32 tile; chunk the query")
+        ts[i, :c] = rel.astype(np.int32)
+        vals[i, :c] = v
+    return ts, vals, counts

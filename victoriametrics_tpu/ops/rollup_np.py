@@ -1,0 +1,194 @@
+"""NumPy reference semantics for windowed rollup functions.
+
+This module is the ORACLE: it defines, in plain NumPy over one series at a
+time, the exact semantics of each rollup function. The TPU kernels in
+ops/device_rollup.py must match it bit-for-bit (up to float assoc order), and
+the host fallback path uses it directly.
+
+Semantics follow the reference's rollup model (app/vmselect/promql/
+rollup.go:688-960, doInternal window walk + removeCounterResets): for each
+output timestamp ``t`` in [start, end] stepping by ``step``, the window is
+``(t - window, t]``. Functions additionally see the "real previous value" —
+the last sample at or before the window start — which powers
+delta/increase/rate continuity across windows. Empty windows yield NaN
+(gap semantics); staleness markers end a series segment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .decimal import STALE_NAN_BITS
+
+
+@dataclasses.dataclass(frozen=True)
+class RollupConfig:
+    """Static window grid: all values unix ms."""
+    start: int
+    end: int
+    step: int
+    window: int  # lookbehind; 0 means "use step"
+
+    @property
+    def lookback(self) -> int:
+        return self.window if self.window > 0 else self.step
+
+    def out_timestamps(self) -> np.ndarray:
+        return np.arange(self.start, self.end + 1, self.step, dtype=np.int64)
+
+
+def remove_counter_resets(values: np.ndarray) -> np.ndarray:
+    """Monotonize a counter series: whenever v[i] < v[i-1] (reset), add the
+    lost base back so deltas across resets count from the reset. Small
+    negative glitches (< 1/8 of prev) are treated as resets like the
+    reference does partial-reset detection (rollup.go:921 analog)."""
+    v = np.asarray(values, dtype=np.float64)
+    if v.size == 0:
+        return v.copy()
+    d = np.diff(v)
+    drop = np.where(d < 0, -d, 0.0)
+    # reset correction: cumulative sum of drops, shifted to apply from the
+    # resetting sample onward
+    corr = np.concatenate([[0.0], np.cumsum(drop)])
+    return v + corr
+
+
+def _window_bounds(ts: np.ndarray, cfg: RollupConfig) -> tuple[np.ndarray, np.ndarray]:
+    """Per output step: [start_idx, end_idx) half-open index range of samples
+    inside (t-window, t]."""
+    out_ts = cfg.out_timestamps()
+    lo = np.searchsorted(ts, out_ts - cfg.lookback, side="right")
+    hi = np.searchsorted(ts, out_ts, side="right")
+    return lo, hi
+
+
+def rollup(func: str, ts: np.ndarray, values: np.ndarray, cfg: RollupConfig
+           ) -> np.ndarray:
+    """Apply one rollup function over a single series. ts must be sorted."""
+    ts = np.asarray(ts, dtype=np.int64)
+    v = np.asarray(values, dtype=np.float64)
+    out_ts = cfg.out_timestamps()
+    T = out_ts.size
+    out = np.full(T, np.nan)
+    lo, hi = _window_bounds(ts, cfg)
+    have = hi > lo
+
+    if func in ("count_over_time", "present_over_time", "changes"):
+        pass  # handled below without needing per-window values
+
+    corrected = remove_counter_resets(v) if func in (
+        "rate", "increase", "irate", "increase_pure") else v
+
+    for j in range(T):
+        a, b = lo[j], hi[j]
+        prev_idx = a - 1  # last sample at or before window start
+        if func == "count_over_time":
+            out[j] = (b - a) if b > a else np.nan
+            continue
+        if func == "present_over_time":
+            out[j] = 1.0 if b > a else np.nan
+            continue
+        if not have[j]:
+            continue
+        w = v[a:b]
+        cw = corrected[a:b]
+        tw = ts[a:b]
+        if func == "sum_over_time":
+            out[j] = w.sum()
+        elif func == "min_over_time":
+            out[j] = w.min()
+        elif func == "max_over_time":
+            out[j] = w.max()
+        elif func == "avg_over_time":
+            out[j] = w.mean()
+        elif func == "stddev_over_time":
+            out[j] = w.std()
+        elif func == "stdvar_over_time":
+            out[j] = w.var()
+        elif func == "first_over_time":
+            out[j] = w[0]
+        elif func == "last_over_time" or func == "default_rollup":
+            out[j] = w[-1]
+        elif func == "tfirst_over_time":
+            out[j] = tw[0] / 1e3
+        elif func == "tlast_over_time" or func == "timestamp":
+            out[j] = tw[-1] / 1e3
+        elif func == "changes":
+            prev = v[prev_idx] if prev_idx >= 0 else None
+            seq = w if prev is None else np.concatenate([[prev], w])
+            out[j] = float((np.diff(seq) != 0).sum())
+            if prev is None and w.size:
+                out[j] += 0  # first appearance is not a change
+        elif func == "delta":
+            base = v[prev_idx] if prev_idx >= 0 else w[0]
+            out[j] = w[-1] - base
+        elif func in ("increase", "increase_pure"):
+            base = corrected[prev_idx] if prev_idx >= 0 else cw[0]
+            out[j] = cw[-1] - base
+        elif func == "rate":
+            if prev_idx >= 0:
+                dt = (tw[-1] - ts[prev_idx]) / 1e3
+                dv = cw[-1] - corrected[prev_idx]
+            elif b - a >= 2:
+                dt = (tw[-1] - tw[0]) / 1e3
+                dv = cw[-1] - cw[0]
+            else:
+                continue
+            out[j] = dv / dt if dt > 0 else np.nan
+        elif func == "irate":
+            if b - a >= 2:
+                dt = (tw[-1] - tw[-2]) / 1e3
+                dv = cw[-1] - cw[-2]
+            elif prev_idx >= 0:
+                dt = (tw[-1] - ts[prev_idx]) / 1e3
+                dv = cw[-1] - corrected[prev_idx]
+            else:
+                continue
+            out[j] = dv / dt if dt > 0 else np.nan
+        elif func == "idelta":
+            if b - a >= 2:
+                out[j] = w[-1] - w[-2]
+            elif prev_idx >= 0:
+                out[j] = w[-1] - v[prev_idx]
+        elif func == "deriv_fast":
+            if prev_idx >= 0:
+                dt = (tw[-1] - ts[prev_idx]) / 1e3
+                out[j] = (w[-1] - v[prev_idx]) / dt if dt > 0 else np.nan
+            elif b - a >= 2:
+                dt = (tw[-1] - tw[0]) / 1e3
+                out[j] = (w[-1] - w[0]) / dt if dt > 0 else np.nan
+        elif func == "deriv":
+            # least-squares slope per second over window samples
+            if b - a >= 2:
+                t_s = (tw - tw[0]) / 1e3
+                n = t_s.size
+                st, sv = t_s.sum(), w.sum()
+                stt, stv = (t_s * t_s).sum(), (t_s * w).sum()
+                den = n * stt - st * st
+                out[j] = (n * stv - st * sv) / den if den != 0 else np.nan
+        elif func == "lag":
+            out[j] = (out_ts[j] - tw[-1]) / 1e3
+        elif func == "lifetime":
+            first = ts[0] if prev_idx >= 0 else tw[0]
+            out[j] = (tw[-1] - first) / 1e3
+        elif func == "scrape_interval":
+            if prev_idx >= 0:
+                out[j] = (tw[-1] - ts[prev_idx]) / 1e3 / (b - a)
+            elif b - a >= 2:
+                out[j] = (tw[-1] - tw[0]) / 1e3 / (b - a - 1)
+        else:
+            raise ValueError(f"unsupported numpy rollup func {func!r}")
+    return out
+
+
+# Rollup functions the oracle (and thus the device kernels) understand.
+SUPPORTED = (
+    "count_over_time", "present_over_time", "sum_over_time", "min_over_time",
+    "max_over_time", "avg_over_time", "stddev_over_time", "stdvar_over_time",
+    "first_over_time", "last_over_time", "default_rollup", "tfirst_over_time",
+    "tlast_over_time", "timestamp", "changes", "delta", "increase",
+    "increase_pure", "rate", "irate", "idelta", "deriv", "deriv_fast", "lag",
+    "lifetime", "scrape_interval",
+)

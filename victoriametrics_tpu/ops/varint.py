@@ -68,6 +68,10 @@ def unmarshal_varint64s(data: bytes, count: int | None = None) -> np.ndarray:
     starts = np.empty_like(ends)
     starts[0] = 0
     starts[1:] = ends[:-1] + 1
+    if ((ends - starts) >= 10).any():
+        # int64 varints are at most 10 bytes; longer means corruption, and
+        # uint64 shifts >= 64 would otherwise decode silently to garbage.
+        raise ValueError("varint: too long encoded varint")
     # position of each byte within its value
     idx = np.arange(b.size, dtype=np.int64)
     start_per_byte = np.repeat(starts, ends - starts + 1)

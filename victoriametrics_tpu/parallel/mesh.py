@@ -1,0 +1,173 @@
+"""Device-mesh sharding for the query engine.
+
+The reference scales reads by fanning a query out to every vmstorage node and
+merging per-node partial aggregates (lib/vmselectapi scatter-gather +
+aggr_incremental.go map-reduce). On TPU the same shape becomes: shard the
+series axis over a `jax.sharding.Mesh`, compute per-shard segment-reductions,
+and psum partials over ICI — replacing the per-worker merge loop with one XLA
+collective.
+
+Two parallel axes are first-class:
+
+- AXIS_SERIES ("series"): data-parallel over series. Each device rolls up its
+  series shard and psums the [G, T] group partials.
+- AXIS_TIME ("time"): sequence-parallel over the *sample* axis (the
+  long-context analog). Each device holds a contiguous time-slice of every
+  series' samples; rollup windows crossing the slice boundary need the tail
+  of the left neighbor, exchanged with `lax.ppermute` (ring halo exchange,
+  like ring attention passes KV blocks).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.device_rollup import aggregate_groups, rollup_tile
+from ..ops.rollup_np import RollupConfig
+
+AXIS_SERIES = "series"
+AXIS_TIME = "time"
+
+
+def make_mesh(n_series: int | None = None, n_time: int = 1,
+              devices=None) -> Mesh:
+    """Build a (series, time) mesh over the available devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if n_series is None:
+        n_series = n // n_time
+    if n_series * n_time != n:
+        raise ValueError(f"mesh {n_series}x{n_time} != {n} devices")
+    arr = np.asarray(devices).reshape(n_series, n_time)
+    return Mesh(arr, (AXIS_SERIES, AXIS_TIME))
+
+
+def sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
+                             cfg: RollupConfig, num_groups: int):
+    """Build a jitted aggr(rollup(...)) running series-sharded on the mesh.
+
+    Inputs: ts [S, N] int32, values [S, N], counts [S] int32,
+    group_ids [S] int32; S must be divisible by the series-axis size.
+    Output: [G, T] fully replicated.
+    """
+
+    # psum raw moments across shards, finalize after — combining *finished*
+    # per-shard stats would be wrong for avg/stddev.
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(AXIS_SERIES, None), P(AXIS_SERIES, None),
+                  P(AXIS_SERIES), P(AXIS_SERIES)),
+        out_specs=P())
+    def step_moments(ts, values, counts, group_ids):
+        rolled = rollup_tile(rollup_func, ts, values, counts, cfg)
+        present = ~jnp.isnan(rolled)
+        zeroed = jnp.where(present, rolled, 0.0)
+        seg = functools.partial(jax.ops.segment_sum, segment_ids=group_ids,
+                                num_segments=num_groups)
+        cnt = jax.lax.psum(seg(present.astype(rolled.dtype)), AXIS_SERIES)
+        nan = jnp.asarray(jnp.nan, rolled.dtype)
+        if aggr in ("sum", "avg", "stddev", "stdvar"):
+            s1 = jax.lax.psum(seg(zeroed), AXIS_SERIES)
+            if aggr == "sum":
+                out = s1
+            elif aggr == "avg":
+                out = s1 / cnt
+            else:
+                s2 = jax.lax.psum(seg(zeroed * zeroed), AXIS_SERIES)
+                var = jnp.maximum(s2 / cnt - (s1 / cnt) ** 2, 0.0)
+                out = jnp.sqrt(var) if aggr == "stddev" else var
+        elif aggr == "count":
+            out = cnt
+        elif aggr == "min":
+            out = jax.lax.pmin(
+                jax.ops.segment_min(jnp.where(present, rolled, jnp.inf),
+                                    group_ids, num_segments=num_groups),
+                AXIS_SERIES)
+        elif aggr == "max":
+            out = jax.lax.pmax(
+                jax.ops.segment_max(jnp.where(present, rolled, -jnp.inf),
+                                    group_ids, num_segments=num_groups),
+                AXIS_SERIES)
+        elif aggr == "group":
+            out = jnp.ones((num_groups, rolled.shape[1]), rolled.dtype)
+        else:
+            raise ValueError(f"unsupported aggregate {aggr!r}")
+        return jnp.where(cnt > 0, out, nan)
+
+    return jax.jit(step_moments)
+
+
+def time_sharded_rollup(mesh: Mesh, rollup_func: str, cfg: RollupConfig,
+                        halo: int):
+    """Sequence-parallel rollup: the sample axis is sharded over AXIS_TIME.
+
+    Each device holds a contiguous chunk of every series' samples (padded to
+    equal chunk length; chunk boundaries aligned to time so chunk i's samples
+    all precede chunk i+1's). Before rolling up, each device receives the
+    trailing `halo` samples of its left neighbor via lax.ppermute — enough to
+    cover one lookback window plus the real-prev-value gather — then computes
+    only the output steps whose windows it owns.
+
+    Output-step ownership: step j belongs to the device whose time range
+    contains the step's timestamp; here we simply split the T output steps
+    contiguously across AXIS_TIME and all-gather at the end.
+
+    Counter-reset correction stays exact across chunks because the halo
+    overlap lets each device reconstruct resets local to its windows; resets
+    older than one window+halo do not affect windowed rollups (they cancel in
+    the window difference).
+    """
+    n_time = mesh.shape[AXIS_TIME]
+    T_total = (cfg.end - cfg.start) // cfg.step + 1
+    if T_total % n_time:
+        raise ValueError(f"T={T_total} not divisible by time axis {n_time}")
+    t_shard = T_total // n_time
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(AXIS_SERIES, AXIS_TIME), P(AXIS_SERIES, AXIS_TIME),
+                  P(AXIS_SERIES, AXIS_TIME)),
+        out_specs=P(AXIS_SERIES, AXIS_TIME))
+    def step(ts, values, valid):
+        # ring halo: receive left neighbor's tail
+        idx = jax.lax.axis_index(AXIS_TIME)
+        perm = [(i, (i + 1) % n_time) for i in range(n_time)]
+        tail_ts = jax.lax.ppermute(ts[:, -halo:], AXIS_TIME, perm)
+        tail_v = jax.lax.ppermute(values[:, -halo:], AXIS_TIME, perm)
+        tail_ok = jax.lax.ppermute(valid[:, -halo:], AXIS_TIME, perm)
+        # device 0 has no left neighbor: its received halo is garbage; mask.
+        tail_ok = jnp.where(idx == 0, False, tail_ok)
+        ts_ext = jnp.concatenate([tail_ts, ts], axis=1)
+        v_ext = jnp.concatenate([tail_v, values], axis=1)
+        ok_ext = jnp.concatenate([tail_ok, valid], axis=1)
+        counts = jnp.sum(ok_ext, axis=1).astype(jnp.int32)
+        # Compact valid samples to the front (stable sort on the invalid
+        # flag keeps time order: halo precedes local by construction).
+        order = jnp.argsort(jnp.where(ok_ext, 0, 1), axis=1, stable=True)
+        ts_c = jnp.take_along_axis(jnp.where(ok_ext, ts_ext, 2**31 - 1), order, axis=1)
+        v_c = jnp.take_along_axis(jnp.where(ok_ext, v_ext, 0.0), order, axis=1)
+        # local output grid slice
+        local_cfg = RollupConfig(
+            start=cfg.start, end=cfg.start + (t_shard - 1) * cfg.step,
+            step=cfg.step, window=cfg.window)
+        shift = idx * t_shard * cfg.step
+        rolled = rollup_tile_shifted(rollup_func, ts_c, v_c, counts,
+                                     local_cfg, shift)
+        return rolled
+
+    return jax.jit(step)
+
+
+TS_BIG = np.int32(2**30)
+
+
+def rollup_tile_shifted(func, ts, values, counts, cfg, shift):
+    """rollup_tile with the output grid shifted by a traced offset (used by
+    time-sharded evaluation where each device owns a grid slice)."""
+    return rollup_tile(func, ts - shift, values, counts, cfg)

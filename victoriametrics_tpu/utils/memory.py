@@ -12,8 +12,13 @@ _allowed_percent = 60.0
 _allowed_bytes_override = 0
 
 
+import functools
+
+
+@functools.cache
 def _system_memory() -> int:
-    # cgroup v2 limit if present, else /proc/meminfo MemTotal.
+    # Computed once (reference uses sync.Once): cache sizing calls this on
+    # hot paths. cgroup v2 limit if present, else /proc/meminfo MemTotal.
     try:
         with open("/sys/fs/cgroup/memory.max") as f:
             v = f.read().strip()
