@@ -1,0 +1,80 @@
+"""Benchmark: samples/sec scanned by the TPU query pipeline.
+
+Workload modeled on BASELINE.md config 2 (`sum by(instance)(rate(m[5m]))`
+range query over high-cardinality counters): 8192 counter series x 1440
+samples (6h @ 15s), rate over 5m windows on a 60s step grid, summed into
+1024 groups — all on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
+
+vs_baseline divides by 1e8 samples/sec — the order of the reference's
+single-core block-unpack + rollup scan rate (its netstorage unpack workers
++ rollupConfig.Do; BASELINE.md notes the repo publishes capacity figures,
+not absolute scan rates, so this is the documented working assumption).
+
+Methodology: queries run against the HBM tile cache (models/tile_cache.py)
+after one cold populating query — matching how the reference benchmarks
+range queries against its RAM blockcache/page-cache-hot parts. The cold
+(chunked-H2D) rate is measured too and reported inside the metric label.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from victoriametrics_tpu.models.rollup_pipeline import (QueryPipeline,
+                                                            synth_workload)
+    from victoriametrics_tpu.models.tile_cache import TileCache
+    from victoriametrics_tpu.ops.rollup_np import RollupConfig
+
+    start = 1_753_700_000_000
+    n_series, n_samples, num_groups = 8192, 1440, 1024
+    cfg = RollupConfig(start=start, end=start + 6 * 3600_000,
+                       step=60_000, window=300_000)
+    pipe = QueryPipeline(cfg=cfg, rollup_func="rate", aggr="sum",
+                         num_groups=num_groups)
+    host_tiles = synth_workload(n_series, n_samples, cfg, num_groups,
+                                dtype=np.float32)
+
+    fn = jax.jit(pipe.jitted())
+    cache = TileCache(capacity_bytes=2 << 30)
+    samples = n_series * n_samples
+
+    # compile once, then measure a true cold query: chunked H2D + compute
+    fn(*cache.get_or_put(("bench", 0), lambda: host_tiles)).block_until_ready()
+    cache.invalidate()
+    t0 = time.perf_counter()
+    tiles = cache.get_or_put(("bench", 0), lambda: host_tiles)
+    fn(*tiles).block_until_ready()
+    cold_s = time.perf_counter() - t0
+
+    # hot: cache-resident tiles, as in steady-state serving
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        tiles = cache.get_or_put(("bench", 0), lambda: host_tiles)
+        fn(*tiles).block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+
+    rate = samples / dt
+    cold_rate = samples / cold_s
+    baseline = 1e8  # single-core reference scan rate (see module docstring)
+    print(json.dumps({
+        "metric": ("hot-shard sum by(rate) scan, 8192x1440 f32, HBM tile "
+                   f"cache (cold incl chunked H2D: {cold_rate/1e6:.0f}M/s)"),
+        "value": round(rate),
+        "unit": "samples/sec",
+        "vs_baseline": round(rate / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -135,7 +135,9 @@ class TestMesh:
         np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9,
                                    equal_nan=True)
 
-    def test_time_sharded_matches_single_device(self):
+    @pytest.mark.parametrize("func", ["rate", "sum_over_time", "timestamp",
+                                      "max_over_time", "changes"])
+    def test_time_sharded_matches_single_device(self, func):
         # sequence-parallel: samples split into contiguous time chunks
         rng = np.random.default_rng(31)
         S, N = 8, 512
@@ -149,12 +151,17 @@ class TestMesh:
         mesh = meshlib.make_mesh(n_series=2, n_time=4)
         valid = np.ones((S, N), dtype=bool)
         halo = 16  # > window/interval + 1
-        fn = meshlib.time_sharded_rollup(mesh, "rate", cfg, halo)
+        fn = meshlib.time_sharded_rollup(mesh, func, cfg, halo)
         got = np.asarray(fn(jnp.asarray(ts.astype(np.int32)),
                             jnp.asarray(vals), jnp.asarray(valid)))
         counts = np.full(S, N, dtype=np.int32)
-        want = np.asarray(rollup_tile("rate", jnp.asarray(ts.astype(np.int32)),
+        want = np.asarray(rollup_tile(func, jnp.asarray(ts.astype(np.int32)),
                                       jnp.asarray(vals), jnp.asarray(counts),
                                       cfg))
         np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9,
                                    equal_nan=True)
+
+    def test_time_sharded_rejects_whole_series_funcs(self):
+        mesh = meshlib.make_mesh(n_series=2, n_time=4)
+        with pytest.raises(ValueError, match="whole-series"):
+            meshlib.time_sharded_rollup(mesh, "lifetime", CFG, 8)

@@ -1,0 +1,71 @@
+"""The flagship device pipeline: aggr(rollup(selector[window])) as one
+jittable program — the TPU replacement for the reference's query hot path
+(netstorage unpack workers + rollupConfig.Do + incremental aggregation,
+app/vmselect/promql/eval.go:1690-1900).
+
+`QueryPipeline` binds the static query shape (window grid, rollup func,
+aggregate, group count) and exposes:
+
+- forward(ts, values, counts, group_ids) -> [G, T]   single-device
+- sharded(mesh)(...) -> [G, T]                       series-sharded + psum
+
+This module is what `__graft_entry__.entry()` and `bench.py` drive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.device_rollup import pack_series, rollup_aggregate_tile
+from ..ops.rollup_np import RollupConfig
+from ..parallel import mesh as meshlib
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryPipeline:
+    cfg: RollupConfig
+    rollup_func: str = "rate"
+    aggr: str = "sum"
+    num_groups: int = 256
+
+    def forward(self, ts, values, counts, group_ids):
+        return rollup_aggregate_tile(
+            self.rollup_func, self.aggr, ts, values, counts, group_ids,
+            self.cfg, self.num_groups)
+
+    def jitted(self):
+        """A (fn, example_args) pair; fn closes over the static config so it
+        is directly jittable over array args."""
+        cfg, rf, ag, ng = self.cfg, self.rollup_func, self.aggr, self.num_groups
+
+        def fn(ts, values, counts, group_ids):
+            return rollup_aggregate_tile(rf, ag, ts, values, counts,
+                                         group_ids, cfg, ng)
+        return fn
+
+    def sharded(self, mesh):
+        return meshlib.sharded_rollup_aggregate(
+            mesh, self.rollup_func, self.aggr, self.cfg, self.num_groups)
+
+
+def synth_workload(n_series: int, n_samples: int, cfg: RollupConfig,
+                   num_groups: int, dtype=np.float32, seed: int = 0):
+    """Synthetic TSBS-devops-like tile: counter series at 15s-ish intervals,
+    grouped n_series/num_groups-to-1 (the `by (instance)` shape)."""
+    rng = np.random.default_rng(seed)
+    interval = max((cfg.end - cfg.start) // max(n_samples - 1, 1), 1)
+    base = np.arange(n_samples, dtype=np.int64) * interval + cfg.start
+    series = []
+    for _ in range(n_series):
+        ts = base + rng.integers(-interval // 4, interval // 4 + 1, n_samples)
+        ts.sort()
+        v = np.cumsum(rng.integers(0, 50, n_samples)).astype(np.float64)
+        series.append((ts, v))
+    ts_t, v_t, counts = pack_series(series, cfg.start, dtype=dtype)
+    gids = (np.arange(n_series) % num_groups).astype(np.int32)
+    return ts_t, v_t, counts, gids

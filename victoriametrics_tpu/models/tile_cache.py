@@ -1,0 +1,101 @@
+"""HBM-resident tile cache for the query engine.
+
+The reference keeps decompressed index blocks in a RAM blockcache sized at
+10% of memory (lib/blockcache, lib/storage/part.go:15-22) and relies on the
+page cache for data blocks; repeated queries run hot. The TPU analog: packed
+(series, sample) tiles live in HBM between queries, keyed by (part id, tile
+id, revision). Evictions are LRU by bytes.
+
+Uploads are chunked: the axon tunnel (and PCIe generally) sustains much
+higher bandwidth on medium transfers than on one huge contiguous put
+(measured on this host: ~1.4 GB/s at 8MB vs ~0.2 GB/s at 64MB), so
+device_put goes up in <=8MB slices re-assembled on device.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+UPLOAD_CHUNK_BYTES = 8 << 20
+
+
+def chunked_device_put(x: np.ndarray, device=None) -> jax.Array:
+    """device_put in <=8MB row-slices, concatenated on device."""
+    device = device or jax.devices()[0]
+    nbytes = x.nbytes
+    if nbytes <= UPLOAD_CHUNK_BYTES or x.ndim == 0 or x.shape[0] <= 1:
+        return jax.device_put(x, device)
+    rows_per_chunk = max(1, UPLOAD_CHUNK_BYTES // max(x.nbytes // x.shape[0], 1))
+    parts = [jax.device_put(x[i:i + rows_per_chunk], device)
+             for i in range(0, x.shape[0], rows_per_chunk)]
+    return jnp.concatenate(parts, axis=0)
+
+
+class TileCache:
+    """LRU byte-bounded cache of device-resident pytrees."""
+
+    def __init__(self, capacity_bytes: int, device=None):
+        self.capacity = capacity_bytes
+        self.device = device or jax.devices()[0]
+        self._lock = threading.Lock()
+        self._entries: collections.OrderedDict[object, tuple] = \
+            collections.OrderedDict()
+        self._sizes: dict[object, int] = {}
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def _tree_bytes(self, tree) -> int:
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in jax.tree_util.tree_leaves(tree))
+
+    def get(self, key):
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return self._entries[key]
+            self.misses += 1
+            return None
+
+    def put(self, key, host_tree):
+        """Upload a pytree of numpy arrays; returns the device tree."""
+        dev_tree = jax.tree_util.tree_map(
+            lambda a: chunked_device_put(np.asarray(a), self.device), host_tree)
+        size = self._tree_bytes(dev_tree)
+        with self._lock:
+            if key in self._entries:
+                self._bytes -= self._sizes.pop(key)
+                del self._entries[key]
+            while self._bytes + size > self.capacity and self._entries:
+                old, _ = self._entries.popitem(last=False)
+                self._bytes -= self._sizes.pop(old)
+            self._entries[key] = dev_tree
+            self._sizes[key] = size
+            self._bytes += size
+        return dev_tree
+
+    def get_or_put(self, key, make_host_tree):
+        cached = self.get(key)
+        if cached is not None:
+            return cached
+        return self.put(key, make_host_tree())
+
+    def invalidate(self, key=None):
+        with self._lock:
+            if key is None:
+                self._entries.clear()
+                self._sizes.clear()
+                self._bytes = 0
+            elif key in self._entries:
+                self._bytes -= self._sizes.pop(key)
+                del self._entries[key]
+
+    @property
+    def size_bytes(self) -> int:
+        return self._bytes

@@ -51,10 +51,12 @@ def _window_bounds(ts: jnp.ndarray, cfg: RollupConfig) -> tuple[jnp.ndarray, jnp
     """Returns (lo, hi) int32 [S, T]: half-open sample index range per output
     step, plus the relative output grid."""
     T = (cfg.end - cfg.start) // cfg.step + 1
-    grid = (jnp.arange(T, dtype=jnp.int64) * cfg.step)
+    # int32 throughout: tile timestamps are rebased so the grid fits, and
+    # this keeps the kernel independent of the jax_enable_x64 flag.
+    grid = (jnp.arange(T, dtype=jnp.int32) * np.int32(cfg.step))
     lookback = cfg.lookback
-    lo_t = (grid - lookback).astype(jnp.int32)
-    hi_t = grid.astype(jnp.int32)
+    lo_t = grid - np.int32(lookback)
+    hi_t = grid
     lo = jax.vmap(lambda row: jnp.searchsorted(row, lo_t, side="right"))(ts)
     hi = jax.vmap(lambda row: jnp.searchsorted(row, hi_t, side="right"))(ts)
     return lo.astype(jnp.int32), hi.astype(jnp.int32), grid
@@ -277,40 +279,69 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
 AGGR_FUNCS = ("sum", "count", "avg", "min", "max", "group", "stddev", "stdvar")
 
 
-def aggregate_groups(aggr: str, rolled: jnp.ndarray, group_ids: jnp.ndarray,
-                     num_groups: int) -> jnp.ndarray:
-    """Aggregate per-series rollup results [S, T] into [G, T] by group id.
-    NaN inputs mean 'series absent at this step' and are skipped; groups with
-    no live series at a step yield NaN."""
+def partial_group_moments(aggr: str, rolled: jnp.ndarray,
+                          group_ids: jnp.ndarray, num_groups: int
+                          ) -> dict[str, tuple[jnp.ndarray, str]]:
+    """Per-shard segment moments for one aggregate: {name: (array [G, T],
+    cross-shard reduce kind 'sum'|'min'|'max')}. Splitting moments from
+    finalization lets the mesh layer psum/pmin/pmax the moments across
+    shards before finalizing — combining *finished* per-shard stats would be
+    wrong for avg/stddev."""
     present = ~jnp.isnan(rolled)
     zeroed = jnp.where(present, rolled, 0.0)
-    cnt = jax.ops.segment_sum(present.astype(rolled.dtype), group_ids,
-                              num_segments=num_groups)
-    nan = jnp.asarray(jnp.nan, rolled.dtype)
+    seg = functools.partial(jax.ops.segment_sum, segment_ids=group_ids,
+                            num_segments=num_groups)
+    m = {"cnt": (seg(present.astype(rolled.dtype)), "sum")}
     if aggr in ("sum", "avg", "stddev", "stdvar"):
-        s1 = jax.ops.segment_sum(zeroed, group_ids, num_segments=num_groups)
-        if aggr == "sum":
-            out = s1
-        elif aggr == "avg":
-            out = s1 / cnt
-        else:
-            s2 = jax.ops.segment_sum(zeroed * zeroed, group_ids,
-                                     num_segments=num_groups)
-            var = jnp.maximum(s2 / cnt - (s1 / cnt) ** 2, 0.0)
-            out = jnp.sqrt(var) if aggr == "stddev" else var
+        m["s1"] = (seg(zeroed), "sum")
+    if aggr in ("stddev", "stdvar"):
+        m["s2"] = (seg(zeroed * zeroed), "sum")
+    if aggr == "min":
+        m["min"] = (jax.ops.segment_min(jnp.where(present, rolled, jnp.inf),
+                                        group_ids, num_segments=num_groups),
+                    "min")
+    if aggr == "max":
+        m["max"] = (jax.ops.segment_max(jnp.where(present, rolled, -jnp.inf),
+                                        group_ids, num_segments=num_groups),
+                    "max")
+    if aggr not in AGGR_FUNCS:
+        raise ValueError(f"unsupported aggregate {aggr!r}")
+    return m
+
+
+def finalize_group_moments(aggr: str, m: dict[str, tuple[jnp.ndarray, str]]
+                           ) -> jnp.ndarray:
+    """Finalize (possibly cross-shard-reduced) moments into the [G, T]
+    aggregate. Groups with no live series at a step yield NaN."""
+    cnt = m["cnt"][0]
+    nan = jnp.asarray(jnp.nan, cnt.dtype)
+    if aggr == "sum":
+        out = m["s1"][0]
+    elif aggr == "avg":
+        out = m["s1"][0] / cnt
+    elif aggr in ("stddev", "stdvar"):
+        mean = m["s1"][0] / cnt
+        var = jnp.maximum(m["s2"][0] / cnt - mean * mean, 0.0)
+        out = jnp.sqrt(var) if aggr == "stddev" else var
     elif aggr == "count":
         out = cnt
     elif aggr == "min":
-        out = jax.ops.segment_min(jnp.where(present, rolled, jnp.inf),
-                                  group_ids, num_segments=num_groups)
+        out = m["min"][0]
     elif aggr == "max":
-        out = jax.ops.segment_max(jnp.where(present, rolled, -jnp.inf),
-                                  group_ids, num_segments=num_groups)
+        out = m["max"][0]
     elif aggr == "group":
-        out = jnp.ones((num_groups, rolled.shape[1]), rolled.dtype)
+        out = jnp.ones_like(cnt)
     else:
         raise ValueError(f"unsupported aggregate {aggr!r}")
     return jnp.where(cnt > 0, out, nan)
+
+
+def aggregate_groups(aggr: str, rolled: jnp.ndarray, group_ids: jnp.ndarray,
+                     num_groups: int) -> jnp.ndarray:
+    """Aggregate per-series rollup results [S, T] into [G, T] by group id.
+    NaN inputs mean 'series absent at this step' and are skipped."""
+    return finalize_group_moments(
+        aggr, partial_group_moments(aggr, rolled, group_ids, num_groups))
 
 
 @functools.partial(jax.jit, static_argnames=("rollup_func", "aggr", "cfg", "num_groups"))

@@ -41,9 +41,9 @@ class RollupConfig:
 
 def remove_counter_resets(values: np.ndarray) -> np.ndarray:
     """Monotonize a counter series: whenever v[i] < v[i-1] (reset), add the
-    lost base back so deltas across resets count from the reset. Small
-    negative glitches (< 1/8 of prev) are treated as resets like the
-    reference does partial-reset detection (rollup.go:921 analog)."""
+    lost base back so deltas across resets count from the reset value
+    (rollup.go:921 removeCounterResets analog). Every negative delta is
+    treated as a full reset."""
     v = np.asarray(values, dtype=np.float64)
     if v.size == 0:
         return v.copy()

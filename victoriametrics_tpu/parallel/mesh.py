@@ -28,7 +28,8 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.device_rollup import aggregate_groups, rollup_tile
+from ..ops.device_rollup import (finalize_group_moments,
+                                 partial_group_moments, rollup_tile)
 from ..ops.rollup_np import RollupConfig
 
 AXIS_SERIES = "series"
@@ -57,8 +58,9 @@ def sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
     Output: [G, T] fully replicated.
     """
 
-    # psum raw moments across shards, finalize after — combining *finished*
-    # per-shard stats would be wrong for avg/stddev.
+    _CROSS_REDUCE = {"sum": jax.lax.psum, "min": jax.lax.pmin,
+                     "max": jax.lax.pmax}
+
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(AXIS_SERIES, None), P(AXIS_SERIES, None),
@@ -66,39 +68,13 @@ def sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
         out_specs=P())
     def step_moments(ts, values, counts, group_ids):
         rolled = rollup_tile(rollup_func, ts, values, counts, cfg)
-        present = ~jnp.isnan(rolled)
-        zeroed = jnp.where(present, rolled, 0.0)
-        seg = functools.partial(jax.ops.segment_sum, segment_ids=group_ids,
-                                num_segments=num_groups)
-        cnt = jax.lax.psum(seg(present.astype(rolled.dtype)), AXIS_SERIES)
-        nan = jnp.asarray(jnp.nan, rolled.dtype)
-        if aggr in ("sum", "avg", "stddev", "stdvar"):
-            s1 = jax.lax.psum(seg(zeroed), AXIS_SERIES)
-            if aggr == "sum":
-                out = s1
-            elif aggr == "avg":
-                out = s1 / cnt
-            else:
-                s2 = jax.lax.psum(seg(zeroed * zeroed), AXIS_SERIES)
-                var = jnp.maximum(s2 / cnt - (s1 / cnt) ** 2, 0.0)
-                out = jnp.sqrt(var) if aggr == "stddev" else var
-        elif aggr == "count":
-            out = cnt
-        elif aggr == "min":
-            out = jax.lax.pmin(
-                jax.ops.segment_min(jnp.where(present, rolled, jnp.inf),
-                                    group_ids, num_segments=num_groups),
-                AXIS_SERIES)
-        elif aggr == "max":
-            out = jax.lax.pmax(
-                jax.ops.segment_max(jnp.where(present, rolled, -jnp.inf),
-                                    group_ids, num_segments=num_groups),
-                AXIS_SERIES)
-        elif aggr == "group":
-            out = jnp.ones((num_groups, rolled.shape[1]), rolled.dtype)
-        else:
-            raise ValueError(f"unsupported aggregate {aggr!r}")
-        return jnp.where(cnt > 0, out, nan)
+        # psum/pmin/pmax the raw moments across shards, then finalize —
+        # the moment split lives in ops.device_rollup so the single-device
+        # and sharded paths share one aggregation definition.
+        moments = partial_group_moments(aggr, rolled, group_ids, num_groups)
+        reduced = {k: (_CROSS_REDUCE[kind](arr, AXIS_SERIES), kind)
+                   for k, (arr, kind) in moments.items()}
+        return finalize_group_moments(aggr, reduced)
 
     return jax.jit(step_moments)
 
@@ -123,6 +99,10 @@ def time_sharded_rollup(mesh: Mesh, rollup_func: str, cfg: RollupConfig,
     older than one window+halo do not affect windowed rollups (they cancel in
     the window difference).
     """
+    if rollup_func in _TIME_SHARD_UNSUPPORTED:
+        raise ValueError(
+            f"{rollup_func} needs whole-series context (first sample) and "
+            "cannot run on the time-sharded path; use series sharding")
     n_time = mesh.shape[AXIS_TIME]
     T_total = (cfg.end - cfg.start) // cfg.step + 1
     if T_total % n_time:
@@ -164,10 +144,18 @@ def time_sharded_rollup(mesh: Mesh, rollup_func: str, cfg: RollupConfig,
     return jax.jit(step)
 
 
-TS_BIG = np.int32(2**30)
+# Funcs needing whole-series context that chunked time sharding cannot see.
+_TIME_SHARD_UNSUPPORTED = frozenset({"lifetime"})
+
+# Funcs returning absolute times: rollup_tile adds cfg.start back, so the
+# chunk's grid shift must be re-added on top.
+_TIME_VALUED = frozenset({"tfirst_over_time", "tlast_over_time", "timestamp"})
 
 
 def rollup_tile_shifted(func, ts, values, counts, cfg, shift):
     """rollup_tile with the output grid shifted by a traced offset (used by
     time-sharded evaluation where each device owns a grid slice)."""
-    return rollup_tile(func, ts - shift, values, counts, cfg)
+    out = rollup_tile(func, ts - shift, values, counts, cfg)
+    if func in _TIME_VALUED:
+        out = out + shift.astype(out.dtype) / 1e3
+    return out
