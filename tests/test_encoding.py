@@ -175,3 +175,16 @@ class TestVarintMalformed:
     def test_overlong_varint_raises(self):
         with pytest.raises(ValueError):
             varint.unmarshal_varint64s(b"\x81" * 10 + b"\x01", 1)
+
+
+class TestSentinelLossyEncode:
+    def test_delta2_lossy_with_sentinels_no_overflow(self):
+        v = np.array([0, (1 << 63) - 1, -(1 << 63) + 5, 7], dtype=np.int64)
+        first, fd, d2 = nearest_delta2_encode(v, 32)
+        out = nearest_delta2_decode(first, fd, d2)
+        assert out.dtype == np.int64  # wrapped, no crash
+
+    def test_delta_lossy_with_sentinels_no_overflow(self):
+        v = np.array([5, -(1 << 63) + 1, 5], dtype=np.int64)
+        first, d = nearest_delta_encode(v, 16)
+        nearest_delta_decode(first, d)

@@ -1,0 +1,331 @@
+"""Storage engine tests — coverage modeled on the reference's
+lib/storage/storage_test.go, index_db_test.go, partition behaviors:
+roundtrips through flush/merge/restart, tag-filter search semantics,
+deletes, snapshots, dedup, retention."""
+
+import os
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.storage.block import Block, rows_to_blocks
+from victoriametrics_tpu.storage.index_db import IndexDB
+from victoriametrics_tpu.storage.mergeset import Table as MsTable
+from victoriametrics_tpu.storage.metric_name import MetricName
+from victoriametrics_tpu.storage.storage import Storage
+from victoriametrics_tpu.storage.tag_filters import TagFilter, filters_from_dict
+from victoriametrics_tpu.storage.tsid import TSID, generate_tsid
+
+T0 = 1_753_700_000_000
+
+
+class TestMetricName:
+    def test_marshal_roundtrip(self):
+        mn = MetricName.from_dict(
+            {"__name__": "http_requests", "job": "api", "instance": "h1:9090"})
+        out = MetricName.unmarshal(mn.marshal())
+        assert out == mn
+        assert out.to_dict()["job"] == "api"
+
+    def test_label_sorting_canonical(self):
+        a = MetricName.from_labels([("b", "2"), ("a", "1"), ("__name__", "m")])
+        b = MetricName.from_labels([("a", "1"), ("__name__", "m"), ("b", "2")])
+        assert a.marshal() == b.marshal()
+
+    def test_escaping_weird_bytes(self):
+        mn = MetricName.from_labels(
+            [("__name__", b"m\x00etric"), (b"k\x01ey", b"v\x02al\x00ue")])
+        out = MetricName.unmarshal(mn.marshal())
+        assert out == mn
+
+    def test_empty_value_dropped(self):
+        mn = MetricName.from_dict({"__name__": "m", "empty": ""})
+        assert mn.labels == []
+
+
+class TestMergeset:
+    def test_add_search_flush_reopen(self, tmp_path):
+        p = str(tmp_path / "ms")
+        t = MsTable(p)
+        items = [f"key{i:05d}".encode() for i in range(1000)]
+        t.add_items(items)
+        assert list(t.search_prefix(b"key0001")) == \
+            [f"key0001{j}".encode() for j in range(10)]
+        t.flush_to_disk()
+        t.close()
+        t2 = MsTable(p)
+        assert list(t2.search_prefix(b"key00999")) == [b"key00999"]
+        assert t2.has_item(b"key00000")
+        assert not t2.has_item(b"nope")
+        t2.close()
+
+    def test_dedup_across_parts(self, tmp_path):
+        t = MsTable(str(tmp_path / "ms"))
+        t.add_items([b"x", b"y"])
+        t.flush_to_disk()
+        t.add_items([b"x", b"z"])
+        assert list(t.iter_from(b"")) == [b"x", b"y", b"z"]
+        t.close()
+
+    def test_large_flush_triggers_file_parts(self, tmp_path):
+        t = MsTable(str(tmp_path / "ms"))
+        for batch in range(5):
+            t.add_items([os.urandom(24) for _ in range(40_000)])
+        t.flush_to_disk()
+        n = sum(1 for _ in t.iter_from(b""))
+        assert n == 200_000
+        t.close()
+
+
+class TestBlocks:
+    def test_block_roundtrip(self):
+        tsid = TSID(1, 2, 3, 4)
+        ts = np.arange(100, dtype=np.int64) * 15000 + T0
+        vals = np.round(np.random.default_rng(0).uniform(0, 100, 100), 2)
+        blk = Block.from_floats(tsid, ts, vals)
+        h, td, vd = blk.marshal()
+        out = Block.unmarshal(h, td, vd)
+        np.testing.assert_array_equal(out.timestamps, ts)
+        np.testing.assert_allclose(out.float_values(), vals, rtol=1e-12)
+        assert out.tsid == tsid
+
+    def test_rows_split_at_8k(self):
+        tsid = TSID(1, 2, 3, 4)
+        n = 20_000
+        ts = np.arange(n, dtype=np.int64) * 1000 + T0
+        vals = np.ones(n)
+        blocks = list(rows_to_blocks(tsid, ts, vals))
+        assert [b.rows for b in blocks] == [8192, 8192, 3616]
+
+
+def mk_storage(tmp_path, **kw):
+    return Storage(str(tmp_path / "s"), **kw)
+
+
+def write_sample_data(s, n_series=20, n_samples=50):
+    rows = []
+    for i in range(n_series):
+        mn = {"__name__": "cpu_usage" if i % 2 == 0 else "mem_usage",
+              "instance": f"host{i % 5}", "core": str(i)}
+        for j in range(n_samples):
+            rows.append((mn, T0 + j * 15000, float(i * 1000 + j)))
+    s.add_rows(rows)
+    return n_series * n_samples
+
+
+class TestStorage:
+    def test_write_search_roundtrip(self, tmp_path):
+        s = mk_storage(tmp_path)
+        write_sample_data(s)
+        res = s.search_series(filters_from_dict({"__name__": "cpu_usage"}),
+                              T0, T0 + 10_000_000)
+        assert len(res) == 10
+        one = [r for r in res if r.metric_name.get_label(b"core") == b"0"][0]
+        assert one.timestamps.size == 50
+        np.testing.assert_allclose(one.values, np.arange(50.0))
+        s.close()
+
+    def test_filters(self, tmp_path):
+        s = mk_storage(tmp_path)
+        write_sample_data(s)
+        f = filters_from_dict({"__name__": "cpu_usage", "instance": "host0"})
+        res = s.search_series(f, T0, T0 + 10_000_000)
+        assert len(res) == 2  # cores 0 and 10
+        # negative filter
+        f = filters_from_dict({"__name__": "cpu_usage",
+                               "instance": ("!=", "host0")})
+        assert len(s.search_series(f, T0, T0 + 10_000_000)) == 8
+        # regex
+        f = filters_from_dict({"__name__": ("=~", "cpu_.*")})
+        assert len(s.search_series(f, T0, T0 + 10_000_000)) == 10
+        # regex alternation uses or-values
+        f = filters_from_dict({"__name__": ("=~", "cpu_usage|mem_usage")})
+        assert len(s.search_series(f, T0, T0 + 10_000_000)) == 20
+        s.close()
+
+    def test_persistence_across_reopen(self, tmp_path):
+        s = mk_storage(tmp_path)
+        write_sample_data(s)
+        s.close()
+        s2 = mk_storage(tmp_path)
+        res = s2.search_series(filters_from_dict({"__name__": "cpu_usage"}),
+                               T0, T0 + 10_000_000)
+        assert len(res) == 10
+        assert res[0].timestamps.size == 50
+        s2.close()
+
+    def test_flush_and_merge_preserve_data(self, tmp_path):
+        s = mk_storage(tmp_path)
+        write_sample_data(s)
+        s.force_flush()
+        write_sample_data(s)  # duplicates!
+        s.force_merge()
+        res = s.search_series(filters_from_dict({"__name__": "cpu_usage"}),
+                              T0, T0 + 10_000_000)
+        # duplicate timestamps collapse at query time
+        assert len(res) == 10
+        assert res[0].timestamps.size == 50
+        s.close()
+
+    def test_label_apis(self, tmp_path):
+        s = mk_storage(tmp_path)
+        write_sample_data(s)
+        assert s.label_names() == ["__name__", "core", "instance"]
+        assert s.label_values("instance") == [f"host{i}" for i in range(5)]
+        assert s.label_values("__name__") == ["cpu_usage", "mem_usage"]
+        assert s.series_count() == 20
+        s.close()
+
+    def test_delete_series(self, tmp_path):
+        s = mk_storage(tmp_path)
+        write_sample_data(s)
+        n = s.delete_series(filters_from_dict({"__name__": "mem_usage"}))
+        assert n == 10
+        assert s.search_series(filters_from_dict({"__name__": "mem_usage"}),
+                               T0, T0 + 10_000_000) == []
+        # survives merge and reopen
+        s.force_merge()
+        s.close()
+        s2 = mk_storage(tmp_path)
+        assert s2.search_series(filters_from_dict({"__name__": "mem_usage"}),
+                                T0, T0 + 10_000_000) == []
+        assert len(s2.search_series(filters_from_dict({"__name__": "cpu_usage"}),
+                                    T0, T0 + 10_000_000)) == 10
+        s2.close()
+
+    def test_snapshot_restore(self, tmp_path):
+        s = mk_storage(tmp_path)
+        write_sample_data(s)
+        name = s.create_snapshot()
+        assert name in s.list_snapshots()
+        snap = os.path.join(s.snapshots_dir(), name)
+        s.close()
+        # "restore": open a storage rooted at the snapshot layout
+        dst = tmp_path / "restored"
+        os.makedirs(dst)
+        os.rename(os.path.join(snap, "data"), dst / "data")
+        os.rename(os.path.join(snap, "indexdb"), dst / "indexdb")
+        s2 = Storage(str(dst))
+        res = s2.search_series(filters_from_dict({"__name__": "cpu_usage"}),
+                               T0, T0 + 10_000_000)
+        assert len(res) == 10
+        s2.close()
+
+    def test_dedup_interval(self, tmp_path):
+        s = mk_storage(tmp_path, dedup_interval_ms=60_000)
+        rows = [({"__name__": "m"}, T0 + i * 15_000, float(i))
+                for i in range(40)]
+        s.add_rows(rows)
+        res = s.search_series(filters_from_dict({"__name__": "m"}),
+                              T0, T0 + 10_000_000)
+        # 40 samples @15s -> one survivor per occupied 60s bucket
+        want = len({(T0 + i * 15_000) // 60_000 for i in range(40)})
+        assert res[0].timestamps.size == want
+        # each survivor is the last sample of its bucket
+        assert res[0].values[0] == 2.0
+        s.close()
+
+    def test_stale_nan_roundtrip(self, tmp_path):
+        from victoriametrics_tpu.ops import decimal as dec
+        s = mk_storage(tmp_path)
+        s.add_rows([({"__name__": "m"}, T0, 5.0),
+                    ({"__name__": "m"}, T0 + 1000, dec.STALE_NAN)])
+        s.force_flush()
+        res = s.search_series(filters_from_dict({"__name__": "m"}),
+                              T0, T0 + 10_000)
+        assert dec.is_stale_nan(res[0].values[1:2]).all()
+        s.close()
+
+    def test_multi_month_partitions(self, tmp_path):
+        s = mk_storage(tmp_path)
+        month = 31 * 86_400_000
+        s.add_rows([({"__name__": "m"}, T0, 1.0),
+                    ({"__name__": "m"}, T0 + month, 2.0),
+                    ({"__name__": "m"}, T0 + 2 * month, 3.0)])
+        s.force_flush()
+        assert len(s.table.partition_names) == 3
+        res = s.search_series(filters_from_dict({"__name__": "m"}),
+                              T0, T0 + 3 * month)
+        assert res[0].values.tolist() == [1.0, 2.0, 3.0]
+        # partial range hits only overlapping partitions
+        res = s.search_series(filters_from_dict({"__name__": "m"}),
+                              T0 + month, T0 + month)
+        assert res[0].values.tolist() == [2.0]
+        s.close()
+
+    def test_retention_drops_old_partitions(self, tmp_path):
+        s = mk_storage(tmp_path, retention_ms=40 * 86_400_000)
+        import time as _t
+        now = int(_t.time() * 1e3)
+        s.add_rows([({"__name__": "m"}, now - 100 * 86_400_000, 1.0),
+                    ({"__name__": "m"}, now, 2.0)])
+        s.force_flush()
+        assert len(s.table.partition_names) >= 2
+        dropped = s.enforce_retention()
+        assert dropped >= 1
+        res = s.search_series(filters_from_dict({"__name__": "m"}),
+                              now - 200 * 86_400_000, now)
+        assert res[0].values.tolist() == [2.0]
+        s.close()
+
+    def test_flock_exclusive(self, tmp_path):
+        s = mk_storage(tmp_path)
+        with pytest.raises(RuntimeError, match="locked"):
+            Storage(str(tmp_path / "s"))
+        s.close()
+
+    def test_tsdb_status(self, tmp_path):
+        s = mk_storage(tmp_path)
+        write_sample_data(s)
+        st = s.tsdb_status()
+        assert st["totalSeries"] == 20
+        top = {e["name"]: e["count"] for e in st["seriesCountByMetricName"]}
+        assert top == {"cpu_usage": 10, "mem_usage": 10}
+        s.close()
+
+    def test_register_metric_names(self, tmp_path):
+        s = mk_storage(tmp_path)
+        s.register_metric_names([{"__name__": "registered", "a": "b"}])
+        assert s.series_count() == 1
+        assert s.label_values("__name__") == ["registered"]
+        s.close()
+
+
+class TestConcurrency:
+    def test_concurrent_read_write_with_merges(self, tmp_path):
+        """Regression: thread-unsafe shared zstd ctx segfaulted; merges
+        closing parts under readers corrupted reads."""
+        import threading
+        s = mk_storage(tmp_path)
+        errs = []
+
+        def writer(tid):
+            try:
+                for j in range(15):
+                    s.add_rows([({"__name__": "conc", "i": str(k),
+                                  "t": str(tid)}, T0 + j * 1000, float(j))
+                                for k in range(40)])
+                    if j % 5 == 0:
+                        s.force_flush()
+            except Exception as e:
+                errs.append(e)
+
+        def reader():
+            try:
+                for _ in range(25):
+                    s.search_series(filters_from_dict({"__name__": "conc"}),
+                                    T0, T0 + 100_000)
+            except Exception as e:
+                errs.append(e)
+
+        ths = ([threading.Thread(target=writer, args=(i,)) for i in range(2)]
+               + [threading.Thread(target=reader) for _ in range(2)])
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert errs == []
+        res = s.search_series(filters_from_dict({"__name__": "conc"}),
+                              T0, T0 + 100_000)
+        assert len(res) == 80
+        s.close()

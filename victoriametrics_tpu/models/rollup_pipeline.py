@@ -39,8 +39,8 @@ class QueryPipeline:
             self.cfg, self.num_groups)
 
     def jitted(self):
-        """A (fn, example_args) pair; fn closes over the static config so it
-        is directly jittable over array args."""
+        """A forward function closing over the static config, directly
+        jittable over its array args."""
         cfg, rf, ag, ng = self.cfg, self.rollup_func, self.aggr, self.num_groups
 
         def fn(ts, values, counts, group_ids):

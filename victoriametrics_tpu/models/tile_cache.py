@@ -64,10 +64,14 @@ class TileCache:
             return None
 
     def put(self, key, host_tree):
-        """Upload a pytree of numpy arrays; returns the device tree."""
+        """Upload a pytree of numpy arrays; returns the device tree. A tree
+        larger than the whole cache budget is uploaded and returned but NOT
+        retained (it would evict everything and still overcommit HBM)."""
         dev_tree = jax.tree_util.tree_map(
             lambda a: chunked_device_put(np.asarray(a), self.device), host_tree)
         size = self._tree_bytes(dev_tree)
+        if size > self.capacity:
+            return dev_tree
         with self._lock:
             if key in self._entries:
                 self._bytes -= self._sizes.pop(key)

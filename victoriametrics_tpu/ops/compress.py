@@ -4,24 +4,43 @@ lib/encoding/zstd — the reference's single cgo/native dependency).
 Uses the CPython `zstandard` package (libzstd-backed). Level 1 by default:
 block payloads are small (<64KB) and this host has few cores, so speed wins;
 the reference reaches the same trade-off via its cgo fast path.
+
+(De)compressor objects are NOT thread-safe for concurrent use, so they are
+kept thread-local — the storage engine decompresses from query threads while
+flusher threads compress.
 """
 
 from __future__ import annotations
 
-import zstandard
+import threading
 
-_compressors: dict[int, zstandard.ZstdCompressor] = {}
-_decompressor = zstandard.ZstdDecompressor()
+import zstandard
 
 DEFAULT_LEVEL = 1
 
+_tls = threading.local()
+
+
+def _compressor(level: int) -> zstandard.ZstdCompressor:
+    cs = getattr(_tls, "compressors", None)
+    if cs is None:
+        cs = _tls.compressors = {}
+    c = cs.get(level)
+    if c is None:
+        c = cs[level] = zstandard.ZstdCompressor(level=level)
+    return c
+
+
+def _decompressor() -> zstandard.ZstdDecompressor:
+    d = getattr(_tls, "decompressor", None)
+    if d is None:
+        d = _tls.decompressor = zstandard.ZstdDecompressor()
+    return d
+
 
 def compress(data: bytes, level: int = DEFAULT_LEVEL) -> bytes:
-    c = _compressors.get(level)
-    if c is None:
-        c = _compressors[level] = zstandard.ZstdCompressor(level=level)
-    return c.compress(data)
+    return _compressor(level).compress(data)
 
 
 def decompress(data: bytes, max_size: int = 1 << 30) -> bytes:
-    return _decompressor.decompress(data, max_output_size=max_size)
+    return _decompressor().decompress(data, max_output_size=max_size)

@@ -92,7 +92,10 @@ def marshal_int64_array(values: np.ndarray, precision_bits: int = 64
     if is_const(v):
         return b"", MarshalType.CONST, int(v[0])
     if is_delta_const(v):
-        d = int(v[1]) - int(v[0])
+        # wrapping int64 subtraction: sentinel mantissas (stale NaN / inf)
+        # sit near the int64 bounds and must round-trip via two's complement
+        with np.errstate(over="ignore"):
+            d = int(v[1] - v[0])
         return marshal_varint64s(np.array([d], dtype=np.int64)), \
             MarshalType.DELTA_CONST, int(v[0])
     if is_gauge(v):

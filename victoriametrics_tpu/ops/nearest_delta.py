@@ -35,6 +35,13 @@ def round_to_precision_bits(d: np.ndarray, precision_bits: int) -> np.ndarray:
     return np.where(d < 0, -rounded, rounded)
 
 
+def _wrap64(x: int) -> int:
+    """Wrap an unbounded Python int to two's-complement int64 (the lossy
+    encode loops must match the wrapping array arithmetic of the lossless
+    path when sentinel mantissas sit near the int64 bounds)."""
+    return ((x + (1 << 63)) & ((1 << 64) - 1)) - (1 << 63)
+
+
 def _round_scalar(d: int, precision_bits: int) -> int:
     if precision_bits >= 64:
         return d
@@ -55,8 +62,8 @@ def nearest_delta_encode(values: np.ndarray, precision_bits: int
     out = np.empty(v.size - 1, dtype=np.int64)
     rec = int(v[0])
     for i in range(1, v.size):
-        d = _round_scalar(int(v[i]) - rec, precision_bits)
-        rec += d
+        d = _round_scalar(_wrap64(int(v[i]) - rec), precision_bits)
+        rec = _wrap64(rec + d)
         out[i - 1] = d
     return int(v[0]), out
 
@@ -76,17 +83,18 @@ def nearest_delta2_encode(values: np.ndarray, precision_bits: int
     if v.size < 2:
         raise ValueError("nearest_delta2: need >= 2 values")
     if precision_bits >= 64:
-        d1 = v[1:] - v[:-1]
+        d1 = v[1:] - v[:-1]  # wrapping int64 (sentinels near the bounds)
         return int(v[0]), int(d1[0]), (d1[1:] - d1[:-1])
     out = np.empty(v.size - 2, dtype=np.int64)
+    first_delta = _wrap64(int(v[1]) - int(v[0]))
     rec = int(v[1])
-    rec_d = int(v[1]) - int(v[0])
+    rec_d = first_delta
     for i in range(2, v.size):
-        d2 = _round_scalar(int(v[i]) - rec - rec_d, precision_bits)
-        rec_d += d2
-        rec += rec_d
+        d2 = _round_scalar(_wrap64(int(v[i]) - rec - rec_d), precision_bits)
+        rec_d = _wrap64(rec_d + d2)
+        rec = _wrap64(rec + rec_d)
         out[i - 2] = d2
-    return int(v[0]), int(v[1]) - int(v[0]), out
+    return int(v[0]), first_delta, out
 
 
 def nearest_delta2_decode(first: int, first_delta: int, d2: np.ndarray) -> np.ndarray:

@@ -1,0 +1,52 @@
+"""Sample deduplication (reference lib/storage/dedup.go:14-85).
+
+Keeps one sample per dedup interval: the one with the highest timestamp;
+on equal timestamps the larger value wins unless one is a staleness marker
+(stale markers take precedence so series-end is preserved).
+Applied at merge time (final dedup) and query time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import decimal as dec
+
+
+def needs_dedup(timestamps: np.ndarray, interval_ms: int) -> bool:
+    if interval_ms <= 0 or timestamps.size < 2:
+        return False
+    d = np.diff(timestamps // interval_ms)
+    return bool((d == 0).any())
+
+
+def deduplicate(timestamps: np.ndarray, values: np.ndarray, interval_ms: int
+                ) -> tuple[np.ndarray, np.ndarray]:
+    """values may be float64 or int64 mantissas; rows must be time-sorted."""
+    if not needs_dedup(timestamps, interval_ms):
+        return timestamps, values
+    buckets = timestamps // interval_ms
+    # last index of each bucket run
+    last = np.flatnonzero(np.diff(buckets, append=buckets[-1] + 1) != 0)
+    keep_ts = timestamps[last]
+    keep_vals = values[last].copy()
+    # within a run ending at `last[i]`, if several samples share the max
+    # timestamp, prefer stale marker then larger value
+    starts = np.concatenate([[0], last[:-1] + 1])
+    for i, (a, b) in enumerate(zip(starts, last + 1)):
+        if b - a < 2:
+            continue
+        tmax = timestamps[b - 1]
+        ties = np.flatnonzero(timestamps[a:b] == tmax) + a
+        if ties.size < 2:
+            continue
+        vals = values[ties]
+        if np.issubdtype(vals.dtype, np.floating):
+            stale = dec.is_stale_nan(vals)
+        else:
+            stale = vals == dec.V_STALE_NAN
+        if stale.any():
+            keep_vals[i] = vals[np.flatnonzero(stale)[-1]]
+        else:
+            keep_vals[i] = vals.max()
+    return keep_ts, keep_vals

@@ -1,0 +1,308 @@
+"""Inverted index over the mergeset (reference lib/storage/index_db.go).
+
+Eight key namespaces (index_db.go:35-71 analog), all items in one mergeset
+table, 1-byte namespace prefix:
+
+  0  metricName(marshaled)        -> TSID          global series registry
+  1  tag(k 0x01 v) 0x00 metricID  -> (exists)      posting lists
+  2  metricID(8B BE)              -> TSID
+  3  metricID(8B BE)              -> metricName
+  4  metricID(8B BE)              -> (deleted)     tombstones
+  5  date(4B BE) metricID         -> (exists)      per-day series
+  6  date(4B BE) tag 0x00 metricID-> (exists)      per-day postings
+  7  date(4B BE) metricName       -> TSID          per-day registry
+
+The metric group is indexed as tag key b"" (like the reference). Values use
+the escaped metric-name encoding so 0x00/0x01 separators are unambiguous and
+prefix scans work.
+
+Set algebra over posting lists uses sorted uint64 numpy arrays — the
+uint64set analog; intersections/unions/subtractions are vectorized.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+import numpy as np
+
+from .mergeset import Table
+from .metric_name import MetricName, escape, unescape
+from .tag_filters import TagFilter
+from .tsid import TSID
+
+NS_NAME_TO_TSID = b"\x00"
+NS_TAG_TO_MID = b"\x01"
+NS_MID_TO_TSID = b"\x02"
+NS_MID_TO_NAME = b"\x03"
+NS_DELETED = b"\x04"
+NS_DATE_TO_MID = b"\x05"
+NS_DATE_TAG_TO_MID = b"\x06"
+NS_DATE_NAME_TO_TSID = b"\x07"
+
+_U64 = struct.Struct(">Q")
+_U32 = struct.Struct(">I")
+
+MS_PER_DAY = 86_400_000
+
+
+def date_of_ms(ts_ms: int) -> int:
+    return ts_ms // MS_PER_DAY
+
+
+def _tag_key_bytes(key: bytes, value: bytes) -> bytes:
+    return escape(key) + b"\x01" + escape(value) + b"\x00"
+
+
+class IndexDB:
+    """One index table + in-memory deleted-set cache."""
+
+    def __init__(self, path: str):
+        self.table = Table(path)
+        self._lock = threading.Lock()
+        self._deleted = self._load_deleted()
+
+    def close(self):
+        self.table.close()
+
+    def flush(self):
+        self.table.flush_to_disk()
+
+    # -- writes ------------------------------------------------------------
+
+    def create_indexes_for_metric(self, mn: MetricName, tsid: TSID) -> None:
+        """Global (date-independent) indexes for a new series
+        (createGlobalIndexes, index_db.go:428 analog)."""
+        name_raw = mn.marshal()
+        tsid_b = tsid.marshal()
+        mid = _U64.pack(tsid.metric_id)
+        items = [
+            NS_NAME_TO_TSID + name_raw + b"\x00" + tsid_b,
+            NS_MID_TO_TSID + mid + tsid_b,
+            NS_MID_TO_NAME + mid + name_raw,
+            NS_TAG_TO_MID + _tag_key_bytes(b"", mn.metric_group) + mid,
+        ]
+        for k, v in mn.labels:
+            items.append(NS_TAG_TO_MID + _tag_key_bytes(k, v) + mid)
+        self.table.add_items(items)
+
+    def create_per_day_indexes(self, mn: MetricName, tsid: TSID, date: int) -> None:
+        """(date, X) indexes binding the series to one day
+        (updatePerDateData analog, storage.go:2261)."""
+        d = _U32.pack(date)
+        mid = _U64.pack(tsid.metric_id)
+        items = [
+            NS_DATE_TO_MID + d + mid,
+            NS_DATE_NAME_TO_TSID + d + mn.marshal() + b"\x00" + tsid.marshal(),
+            NS_DATE_TAG_TO_MID + d + _tag_key_bytes(b"", mn.metric_group) + mid,
+        ]
+        for k, v in mn.labels:
+            items.append(NS_DATE_TAG_TO_MID + d + _tag_key_bytes(k, v) + mid)
+        self.table.add_items(items)
+
+    def delete_series_by_ids(self, metric_ids: np.ndarray) -> int:
+        items = [NS_DELETED + _U64.pack(int(m)) for m in metric_ids]
+        self.table.add_items(items)
+        with self._lock:
+            self._deleted = np.union1d(self._deleted, metric_ids)
+        return len(items)
+
+    # -- point lookups -----------------------------------------------------
+
+    def get_tsid_by_name(self, mn_marshaled: bytes) -> TSID | None:
+        prefix = NS_NAME_TO_TSID + mn_marshaled + b"\x00"
+        for item in self.table.search_prefix(prefix):
+            return TSID.unmarshal(item[len(prefix):])
+        return None
+
+    def get_metric_name_by_id(self, metric_id: int) -> MetricName | None:
+        prefix = NS_MID_TO_NAME + _U64.pack(metric_id)
+        for item in self.table.search_prefix(prefix):
+            return MetricName.unmarshal(item[len(prefix):])
+        return None
+
+    def get_tsid_by_id(self, metric_id: int) -> TSID | None:
+        prefix = NS_MID_TO_TSID + _U64.pack(metric_id)
+        for item in self.table.search_prefix(prefix):
+            return TSID.unmarshal(item[len(prefix):])
+        return None
+
+    def has_date_metric_id(self, date: int, metric_id: int) -> bool:
+        return self.table.has_item(
+            NS_DATE_TO_MID + _U32.pack(date) + _U64.pack(metric_id))
+
+    # -- deleted set -------------------------------------------------------
+
+    def _load_deleted(self) -> np.ndarray:
+        ids = [_U64.unpack(item[1:9])[0]
+               for item in self.table.search_prefix(NS_DELETED)]
+        return np.array(sorted(ids), dtype=np.uint64)
+
+    @property
+    def deleted_metric_ids(self) -> np.ndarray:
+        with self._lock:
+            return self._deleted
+
+    # -- posting scans -----------------------------------------------------
+
+    def _postings_for_tag(self, key: bytes, value: bytes,
+                          date: int | None = None) -> np.ndarray:
+        if date is None:
+            prefix = NS_TAG_TO_MID + _tag_key_bytes(key, value)
+        else:
+            prefix = NS_DATE_TAG_TO_MID + _U32.pack(date) + _tag_key_bytes(key, value)
+        ids = [_U64.unpack(item[-8:])[0]
+               for item in self.table.search_prefix(prefix)]
+        return np.array(sorted(ids), dtype=np.uint64)
+
+    def _iter_tag_values(self, key: bytes, date: int | None = None):
+        """Yield (value, metric_id) pairs for one tag key."""
+        if date is None:
+            prefix = NS_TAG_TO_MID + escape(key) + b"\x01"
+        else:
+            prefix = NS_DATE_TAG_TO_MID + _U32.pack(date) + escape(key) + b"\x01"
+        plen = len(prefix)
+        for item in self.table.search_prefix(prefix):
+            body = item[plen:]
+            sep = body.rindex(b"\x00")
+            yield unescape(body[:sep]), _U64.unpack(body[sep + 1:sep + 9])[0]
+
+    def _metric_ids_for_date(self, date: int) -> np.ndarray:
+        prefix = NS_DATE_TO_MID + _U32.pack(date)
+        ids = [_U64.unpack(item[-8:])[0]
+               for item in self.table.search_prefix(prefix)]
+        return np.array(sorted(ids), dtype=np.uint64)
+
+    def _all_metric_ids(self) -> np.ndarray:
+        ids = [_U64.unpack(item[1:9])[0]
+               for item in self.table.search_prefix(NS_MID_TO_TSID)]
+        return np.array(sorted(ids), dtype=np.uint64)
+
+    def _metric_ids_for_filter(self, tf: TagFilter, date: int | None) -> np.ndarray:
+        """Posting set for the *positive form* of the filter, i.e. ids whose
+        label value matches value/regex ignoring negation (negation is set
+        subtraction in the caller)."""
+        if tf.or_values is not None:
+            sets = [self._postings_for_tag(tf.key, v, date)
+                    for v in tf.or_values if v != b""]
+            sets = [s for s in sets if s.size]
+            return (np.unique(np.concatenate(sets))
+                    if sets else np.array([], dtype=np.uint64))
+        ids = [mid for v, mid in self._iter_tag_values(tf.key, date)
+               if bool(tf._re.match(v.decode("utf-8", "replace")))]
+        return np.unique(np.array(ids, dtype=np.uint64)) if ids else \
+            np.array([], dtype=np.uint64)
+
+    # -- search ------------------------------------------------------------
+
+    MAX_DAYS_PER_DAY_INDEX = 40
+
+    def search_metric_ids(self, filters: list[TagFilter],
+                          min_ts: int | None = None,
+                          max_ts: int | None = None) -> np.ndarray:
+        """Resolve tag filters to a sorted metricID array
+        (searchMetricIDs, index_db.go:1685 analog)."""
+        use_dates: list[int] | None = None
+        if min_ts is not None and max_ts is not None:
+            d0, d1 = date_of_ms(min_ts), date_of_ms(max_ts)
+            if d1 - d0 + 1 <= self.MAX_DAYS_PER_DAY_INDEX:
+                use_dates = list(range(d0, d1 + 1))
+
+        def filter_set(tf: TagFilter) -> np.ndarray:
+            if use_dates is not None:
+                sets = [self._metric_ids_for_filter(tf, d) for d in use_dates]
+                sets = [s for s in sets if s.size]
+                return (np.unique(np.concatenate(sets)) if sets
+                        else np.array([], dtype=np.uint64))
+            return self._metric_ids_for_filter(tf, None)
+
+        # Strong positives (don't match a missing label) seed the result via
+        # posting intersections; everything else refines it. A missing label
+        # reads as empty value "" (Prometheus matcher semantics).
+        strong = [tf for tf in filters
+                  if not tf.negate and not tf.is_empty_match]
+        rest = [tf for tf in filters if tf not in strong]
+
+        if strong:
+            result: np.ndarray | None = None
+            for tf in strong:
+                s = filter_set(tf)
+                result = s if result is None else \
+                    np.intersect1d(result, s, assume_unique=True)
+                if result.size == 0:
+                    return result
+        else:
+            # no strong positive: start from the day universe (or everything)
+            if use_dates is not None:
+                sets = [self._metric_ids_for_date(d) for d in use_dates]
+                sets = [s for s in sets if s.size]
+                result = (np.unique(np.concatenate(sets)) if sets
+                          else np.array([], dtype=np.uint64))
+            else:
+                result = self._all_metric_ids()
+
+        for tf in rest:
+            if result.size == 0:
+                break
+            pos = TagFilter(tf.key, tf.value, negate=False, regex=tf.regex)
+            matched = filter_set(pos)
+            if tf.negate:
+                survivors = np.setdiff1d(result, matched, assume_unique=True)
+                if not tf.is_empty_match:
+                    # e.g. x!="" / x!~"a?": a missing label would match the
+                    # positive form, so only ids that HAVE the key survive
+                    have_key = self._ids_with_key(tf.key, use_dates)
+                    survivors = np.intersect1d(survivors, have_key,
+                                               assume_unique=True)
+                result = survivors
+            else:
+                # positive filter matching empty (x="" or x=~"a?"): keep ids
+                # that either match the positive form or lack the label
+                have_key = self._ids_with_key(tf.key, use_dates)
+                lacking = np.setdiff1d(result, have_key, assume_unique=True)
+                matching = np.intersect1d(result, matched, assume_unique=True)
+                result = np.union1d(lacking, matching)
+
+        # drop tombstoned series
+        if self._deleted.size:
+            result = np.setdiff1d(result, self._deleted, assume_unique=True)
+        return result
+
+    def _ids_with_key(self, key: bytes, use_dates) -> np.ndarray:
+        ids = set()
+        dates = use_dates if use_dates is not None else [None]
+        for d in dates:
+            for _, mid in self._iter_tag_values(key, d):
+                ids.add(mid)
+        return np.array(sorted(ids), dtype=np.uint64)
+
+    def search_tsids(self, filters: list[TagFilter],
+                     min_ts: int | None = None,
+                     max_ts: int | None = None) -> list[TSID]:
+        mids = self.search_metric_ids(filters, min_ts, max_ts)
+        out = []
+        for mid in mids:
+            t = self.get_tsid_by_id(int(mid))
+            if t is not None:
+                out.append(t)
+        out.sort()
+        return out
+
+    # -- label APIs --------------------------------------------------------
+
+    def label_names(self, min_ts=None, max_ts=None) -> list[str]:
+        """Distinct label keys (SearchLabelNames analog)."""
+        seen_keys = set()
+        for item in self.table.search_prefix(NS_TAG_TO_MID):
+            body = item[1:]
+            seen_keys.add(body[:body.index(b"\x01")])
+        names = {unescape(k).decode("utf-8", "replace")
+                 for k in seen_keys if k != b""}
+        names.add("__name__")
+        return sorted(names)
+
+    def label_values(self, key: str, min_ts=None, max_ts=None) -> list[str]:
+        kb = b"" if key == "__name__" else key.encode()
+        vals = {v for v, _ in self._iter_tag_values(kb)}
+        return sorted(v.decode("utf-8", "replace") for v in vals)

@@ -1,0 +1,318 @@
+"""Sorted-byte-string LSM (capability of reference lib/mergeset: Table with
+AddItems/search/CreateSnapshotAt, table.go:74,349,663; prefix-compressed 64KB
+blocks, encoding.go:18-47).
+
+Design (simplified for a single-writer host plane, same observable shape):
+
+- pending items -> sorted in-memory parts (list[bytes]) -> immutable file
+  parts, with merges collapsing duplicates (set semantics).
+- file part layout: `items.bin` = concatenated zstd blocks of prefix-
+  compressed items; `index.bin` = zstd'd block directory (first item,
+  offset, size, count per block); `metadata.json`.
+- search: merged iteration over pending/memory/file parts via heapq.merge;
+  prefix scans binary-search the block directory.
+- snapshots: hardlinks of immutable part files (fs.go:182 analog).
+"""
+
+from __future__ import annotations
+
+import bisect
+import heapq
+import itertools
+import json
+import os
+import struct
+import threading
+
+from ..ops import compress as zstd
+from ..ops.varint import marshal_varuint64, unmarshal_varuint64
+from ..utils import logger
+
+MAX_BLOCK_BYTES = 64 << 10
+MAX_INMEMORY_PARTS = 15
+MAX_PENDING_ITEMS = 64 << 10
+
+
+def _encode_block(items: list[bytes]) -> bytes:
+    """Prefix-compress a run of sorted items, then zstd."""
+    out = bytearray()
+    prev = b""
+    for it in items:
+        common = os.path.commonprefix([prev, it])
+        cp = len(common)
+        out += marshal_varuint64(cp)
+        out += marshal_varuint64(len(it) - cp)
+        out += it[cp:]
+        prev = it
+    return zstd.compress(bytes(out))
+
+
+def _decode_block(data: bytes, count: int) -> list[bytes]:
+    raw = zstd.decompress(data)
+    items = []
+    prev = b""
+    off = 0
+    for _ in range(count):
+        cp, off = unmarshal_varuint64(raw, off)
+        sl, off = unmarshal_varuint64(raw, off)
+        it = prev[:cp] + raw[off:off + sl]
+        off += sl
+        items.append(it)
+        prev = it
+    if off != len(raw):
+        raise ValueError("mergeset block: trailing garbage")
+    return items
+
+
+class _FilePart:
+    """Immutable on-disk sorted run."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        self.item_count = meta["item_count"]
+        idx_raw = zstd.decompress(
+            open(os.path.join(path, "index.bin"), "rb").read())
+        self.blocks = []  # (first_item, offset, size, count)
+        off = 0
+        while off < len(idx_raw):
+            flen, off = unmarshal_varuint64(idx_raw, off)
+            first = idx_raw[off:off + flen]
+            off += flen
+            boff, off = unmarshal_varuint64(idx_raw, off)
+            bsize, off = unmarshal_varuint64(idx_raw, off)
+            cnt, off = unmarshal_varuint64(idx_raw, off)
+            self.blocks.append((first, boff, bsize, cnt))
+        self._firsts = [b[0] for b in self.blocks]
+        self._f = open(os.path.join(path, "items.bin"), "rb")
+        self._lock = threading.Lock()
+
+    def close(self):
+        self._f.close()
+
+    def _read_block(self, i: int) -> list[bytes]:
+        first, off, size, cnt = self.blocks[i]
+        with self._lock:
+            self._f.seek(off)
+            data = self._f.read(size)
+        return _decode_block(data, cnt)
+
+    def iter_from(self, start: bytes):
+        """Yield items >= start in order."""
+        i = bisect.bisect_right(self._firsts, start) - 1
+        i = max(i, 0)
+        for bi in range(i, len(self.blocks)):
+            for it in self._read_block(bi):
+                if it >= start:
+                    yield it
+
+    def iter_all(self):
+        for bi in range(len(self.blocks)):
+            yield from self._read_block(bi)
+
+    @staticmethod
+    def write(path: str, items_iter, tmp_suffix=".tmp") -> int:
+        """Stream sorted unique items into a new part dir; returns count."""
+        tmp = path + tmp_suffix
+        os.makedirs(tmp, exist_ok=True)
+        index = bytearray()
+        count = 0
+        with open(os.path.join(tmp, "items.bin"), "wb") as f:
+            block: list[bytes] = []
+            bbytes = 0
+
+            def flush_block():
+                nonlocal block, bbytes
+                if not block:
+                    return
+                data = _encode_block(block)
+                off = f.tell()
+                index.extend(marshal_varuint64(len(block[0])))
+                index.extend(block[0])
+                index.extend(marshal_varuint64(off))
+                index.extend(marshal_varuint64(len(data)))
+                index.extend(marshal_varuint64(len(block)))
+                f.write(data)
+                block = []
+                bbytes = 0
+
+            for it in items_iter:
+                block.append(it)
+                bbytes += len(it) + 4
+                count += 1
+                if bbytes >= MAX_BLOCK_BYTES:
+                    flush_block()
+            flush_block()
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(tmp, "index.bin"), "wb") as f:
+            f.write(zstd.compress(bytes(index)))
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(tmp, "metadata.json"), "w") as f:
+            json.dump({"item_count": count}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+        return count
+
+
+def _dedup_sorted(it):
+    prev = None
+    for x in it:
+        if x != prev:
+            yield x
+            prev = x
+
+
+class Table:
+    """The mergeset table: add_items / prefix search / snapshot."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._lock = threading.RLock()
+        self._pending: list[bytes] = []
+        self._mem_parts: list[list[bytes]] = []
+        self._file_parts: list[_FilePart] = []
+        self._part_seq = itertools.count()
+        self._open_existing()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _open_existing(self):
+        names = sorted(n for n in os.listdir(self.path)
+                       if not n.endswith(".tmp") and
+                       os.path.isdir(os.path.join(self.path, n)))
+        for n in names:
+            try:
+                self._file_parts.append(_FilePart(os.path.join(self.path, n)))
+            except (OSError, ValueError) as e:
+                logger.warnf("mergeset: dropping broken part %s: %s", n, e)
+        # tmp dirs are leftovers from a crash mid-write
+        for n in os.listdir(self.path):
+            if n.endswith(".tmp"):
+                import shutil
+                shutil.rmtree(os.path.join(self.path, n), ignore_errors=True)
+        if self._file_parts:
+            seqs = [int(os.path.basename(p.path).split("_")[1])
+                    for p in self._file_parts]
+            self._part_seq = itertools.count(max(seqs) + 1)
+
+    def close(self):
+        with self._lock:
+            self.flush_to_disk()
+            for p in self._file_parts:
+                p.close()
+            self._file_parts.clear()
+
+    # -- writes ------------------------------------------------------------
+
+    def add_items(self, items) -> None:
+        with self._lock:
+            self._pending.extend(items)
+            if len(self._pending) >= MAX_PENDING_ITEMS:
+                self._flush_pending_locked()
+                if len(self._mem_parts) > MAX_INMEMORY_PARTS:
+                    self._merge_mem_to_file_locked()
+
+    def _flush_pending_locked(self):
+        if not self._pending:
+            return
+        part = sorted(set(self._pending))
+        self._pending = []
+        self._mem_parts.append(part)
+
+    def _merge_mem_to_file_locked(self):
+        if not self._mem_parts:
+            return
+        merged = _dedup_sorted(heapq.merge(*self._mem_parts))
+        name = f"part_{next(self._part_seq):016d}"
+        p = os.path.join(self.path, name)
+        _FilePart.write(p, merged)
+        self._mem_parts = []
+        self._file_parts.append(_FilePart(p))
+        if len(self._file_parts) > MAX_INMEMORY_PARTS:
+            self._merge_file_parts_locked()
+
+    def _merge_file_parts_locked(self):
+        olds = self._file_parts
+        merged = _dedup_sorted(heapq.merge(*[p.iter_all() for p in olds]))
+        name = f"part_{next(self._part_seq):016d}"
+        p = os.path.join(self.path, name)
+        _FilePart.write(p, merged)
+        self._file_parts = [_FilePart(p)]
+        for old in olds:
+            # Unlink only: concurrent readers may still iterate `old`; the
+            # open fds keep the data alive until the last reference drops
+            # (the part-refcount pattern, via Python GC).
+            import shutil
+            shutil.rmtree(old.path, ignore_errors=True)
+
+    def flush_to_disk(self):
+        """Durably persist everything buffered (shutdown / snapshot prep)."""
+        with self._lock:
+            self._flush_pending_locked()
+            self._merge_mem_to_file_locked()
+
+    def force_merge(self):
+        with self._lock:
+            self.flush_to_disk()
+            if len(self._file_parts) > 1:
+                self._merge_file_parts_locked()
+
+    # -- reads -------------------------------------------------------------
+
+    def _sources_from(self, start: bytes):
+        with self._lock:
+            pending = sorted(set(self._pending)) if self._pending else []
+            mems = list(self._mem_parts)
+            files = list(self._file_parts)
+        srcs = []
+        if pending:
+            i = bisect.bisect_left(pending, start)
+            srcs.append(iter(pending[i:]))
+        for m in mems:
+            i = bisect.bisect_left(m, start)
+            srcs.append(iter(m[i:]))
+        for fp in files:
+            srcs.append(fp.iter_from(start))
+        return srcs
+
+    def iter_from(self, start: bytes):
+        """All items >= start, sorted, deduped."""
+        return _dedup_sorted(heapq.merge(*self._sources_from(start)))
+
+    def search_prefix(self, prefix: bytes):
+        """All items with the given prefix."""
+        for it in self.iter_from(prefix):
+            if not it.startswith(prefix):
+                return
+            yield it
+
+    def has_item(self, item: bytes) -> bool:
+        for it in self.iter_from(item):
+            return it == item
+        return False
+
+    def item_count(self) -> int:
+        with self._lock:
+            n = len(self._pending) + sum(len(m) for m in self._mem_parts)
+            n += sum(p.item_count for p in self._file_parts)
+        return n  # approximate: duplicates across parts counted once each
+
+    # -- snapshots ---------------------------------------------------------
+
+    def create_snapshot_at(self, dst: str):
+        """Hardlink-copy all immutable file parts (in-memory state is flushed
+        first, like reference CreateSnapshotAt table.go:349)."""
+        self.flush_to_disk()
+        os.makedirs(dst, exist_ok=True)
+        with self._lock:
+            for fp in self._file_parts:
+                name = os.path.basename(fp.path)
+                pdst = os.path.join(dst, name)
+                os.makedirs(pdst, exist_ok=True)
+                for fn in os.listdir(fp.path):
+                    os.link(os.path.join(fp.path, fn), os.path.join(pdst, fn))

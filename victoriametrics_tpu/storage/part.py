@@ -1,0 +1,192 @@
+"""Immutable part files (reference lib/storage/part.go:30-48,
+metaindex_row.go, part_header.go:19).
+
+Anatomy (same as the reference):
+  timestamps.bin  concatenated timestamp payloads
+  values.bin      concatenated value payloads
+  index.bin       zstd index blocks of up to 256 BlockHeaders each
+  metaindex.bin   zstd array of metaindex rows: (first_tsid, block_count,
+                  index_offset, index_size, min_ts, max_ts)
+  metadata.json   {rows, blocks, min_ts, max_ts}
+
+Parts are written once to a .tmp dir, fsynced, then renamed — the atomic
+immutable-part property that makes snapshots hardlinks (fs.go:71,182).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+from ..ops import compress as zstd
+from .block import Block, BlockHeader
+from .tsid import TSID
+
+HEADERS_PER_INDEX_BLOCK = 256
+_META_ROW = struct.Struct(">24sIQIqq")
+
+
+class MetaindexRow:
+    __slots__ = ("first_tsid", "block_count", "index_offset", "index_size",
+                 "min_ts", "max_ts")
+
+
+class PartWriter:
+    """Streams blocks (sorted by (tsid, min_ts)) into a new part dir."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.tmp = path + ".tmp"
+        os.makedirs(self.tmp, exist_ok=True)
+        self._ts_f = open(os.path.join(self.tmp, "timestamps.bin"), "wb")
+        self._val_f = open(os.path.join(self.tmp, "values.bin"), "wb")
+        self._idx_f = open(os.path.join(self.tmp, "index.bin"), "wb")
+        self._meta_rows = bytearray()
+        self._hdrs: list[bytes] = []
+        self._hdr_block_first: TSID | None = None
+        self._hdr_min_ts = 1 << 62
+        self._hdr_max_ts = -(1 << 62)
+        self.rows = 0
+        self.blocks = 0
+        self.min_ts = 1 << 62
+        self.max_ts = -(1 << 62)
+        self._prev_key = None
+
+    def write_block(self, blk: Block) -> None:
+        h, ts_data, val_data = blk.marshal()
+        key = (blk.tsid.sort_key(), h.min_ts)
+        if self._prev_key is not None and key < self._prev_key:
+            raise ValueError("part writer: blocks out of order")
+        self._prev_key = key
+        h.ts_offset = self._ts_f.tell()
+        h.val_offset = self._val_f.tell()
+        self._ts_f.write(ts_data)
+        self._val_f.write(val_data)
+        if self._hdr_block_first is None:
+            self._hdr_block_first = blk.tsid
+        self._hdrs.append(h.marshal())
+        self._hdr_min_ts = min(self._hdr_min_ts, h.min_ts)
+        self._hdr_max_ts = max(self._hdr_max_ts, h.max_ts)
+        self.rows += h.rows
+        self.blocks += 1
+        self.min_ts = min(self.min_ts, h.min_ts)
+        self.max_ts = max(self.max_ts, h.max_ts)
+        if len(self._hdrs) >= HEADERS_PER_INDEX_BLOCK:
+            self._flush_index_block()
+
+    def _flush_index_block(self):
+        if not self._hdrs:
+            return
+        data = zstd.compress(b"".join(self._hdrs))
+        off = self._idx_f.tell()
+        self._meta_rows += _META_ROW.pack(
+            self._hdr_block_first.marshal(), len(self._hdrs), off, len(data),
+            self._hdr_min_ts, self._hdr_max_ts)
+        self._idx_f.write(data)
+        self._hdrs = []
+        self._hdr_block_first = None
+        self._hdr_min_ts = 1 << 62
+        self._hdr_max_ts = -(1 << 62)
+
+    def close(self) -> str:
+        """Finalize: fsync everything, rename into place."""
+        self._flush_index_block()
+        for f in (self._ts_f, self._val_f, self._idx_f):
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+        with open(os.path.join(self.tmp, "metaindex.bin"), "wb") as f:
+            f.write(zstd.compress(bytes(self._meta_rows)))
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(self.tmp, "metadata.json"), "w") as f:
+            json.dump({"rows": self.rows, "blocks": self.blocks,
+                       "min_ts": self.min_ts, "max_ts": self.max_ts}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(self.tmp, self.path)
+        return self.path
+
+    def abort(self):
+        import shutil
+        for f in (self._ts_f, self._val_f, self._idx_f):
+            try:
+                f.close()
+            except OSError:
+                pass
+        shutil.rmtree(self.tmp, ignore_errors=True)
+
+
+class Part:
+    """Open immutable part: metaindex in RAM, payloads read on demand."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        self.rows = meta["rows"]
+        self.blocks = meta["blocks"]
+        self.min_ts = meta["min_ts"]
+        self.max_ts = meta["max_ts"]
+        raw = zstd.decompress(open(os.path.join(path, "metaindex.bin"), "rb").read())
+        self.meta_rows: list[MetaindexRow] = []
+        for off in range(0, len(raw), _META_ROW.size):
+            tsid_b, cnt, ioff, isize, mn, mx = _META_ROW.unpack_from(raw, off)
+            r = MetaindexRow()
+            r.first_tsid = TSID.unmarshal(tsid_b)
+            r.block_count = cnt
+            r.index_offset = ioff
+            r.index_size = isize
+            r.min_ts = mn
+            r.max_ts = mx
+            self.meta_rows.append(r)
+        self._idx_f = open(os.path.join(path, "index.bin"), "rb")
+        self._ts_f = open(os.path.join(path, "timestamps.bin"), "rb")
+        self._val_f = open(os.path.join(path, "values.bin"), "rb")
+        import threading
+        self._lock = threading.Lock()
+
+    def close(self):
+        for f in (self._idx_f, self._ts_f, self._val_f):
+            f.close()
+
+    def _read(self, f, off: int, size: int) -> bytes:
+        with self._lock:
+            f.seek(off)
+            return f.read(size)
+
+    def read_headers(self, row: MetaindexRow) -> list[BlockHeader]:
+        raw = zstd.decompress(self._read(self._idx_f, row.index_offset,
+                                         row.index_size))
+        return [BlockHeader.unmarshal(raw, o)
+                for o in range(0, len(raw), BlockHeader.SIZE)]
+
+    def read_block(self, h: BlockHeader) -> Block:
+        ts_data = self._read(self._ts_f, h.ts_offset, h.ts_size)
+        val_data = self._read(self._val_f, h.val_offset, h.val_size)
+        return Block.unmarshal(h, ts_data, val_data)
+
+    def iter_headers(self, tsid_set: set | None = None,
+                     min_ts: int | None = None, max_ts: int | None = None):
+        """Yield BlockHeaders matching the tsid set / time range, in
+        (tsid, min_ts) order (partSearch analog)."""
+        for row in self.meta_rows:
+            if min_ts is not None and row.max_ts < min_ts:
+                continue
+            if max_ts is not None and row.min_ts > max_ts:
+                continue
+            for h in self.read_headers(row):
+                if tsid_set is not None and h.tsid.metric_id not in tsid_set:
+                    continue
+                if min_ts is not None and h.max_ts < min_ts:
+                    continue
+                if max_ts is not None and h.min_ts > max_ts:
+                    continue
+                yield h
+
+    def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None):
+        for h in self.iter_headers(tsid_set, min_ts, max_ts):
+            yield self.read_block(h)

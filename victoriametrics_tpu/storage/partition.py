@@ -1,0 +1,332 @@
+"""Monthly partition LSM (reference lib/storage/partition.go:75).
+
+Write path per partition (partition.go:461-877 analog, single-writer):
+  pending raw rows -> (flush, 2s or size cap) in-memory parts
+  in-memory parts  -> (flush, 5s durability) small file parts
+  small parts      -> merged into bigger parts (k-way by (tsid, min_ts)),
+                      dropping deleted series and out-of-retention rows
+
+parts.json lists live file parts; it is rewritten atomically after every
+structural change so a crash leaves either the old or the new part set
+(partition.go:282-295 analog). Unlisted dirs are removed at open.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+
+from ..utils import logger
+from .block import MAX_ROWS_PER_BLOCK, Block, rows_to_blocks
+from .dedup import deduplicate
+from .part import Part, PartWriter
+
+MAX_PENDING_ROWS = 256 << 10
+MAX_SMALL_PARTS = 15
+
+
+class InmemoryPart:
+    """Sorted blocks held in RAM (inmemoryPart analog)."""
+
+    def __init__(self, blocks: list[Block]):
+        self.block_list = blocks
+        self.rows = sum(b.rows for b in blocks)
+        self.min_ts = min((int(b.timestamps[0]) for b in blocks),
+                          default=1 << 62)
+        self.max_ts = max((int(b.timestamps[-1]) for b in blocks),
+                          default=-(1 << 62))
+
+    def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None):
+        for b in self.block_list:
+            if tsid_set is not None and b.tsid.metric_id not in tsid_set:
+                continue
+            if min_ts is not None and int(b.timestamps[-1]) < min_ts:
+                continue
+            if max_ts is not None and int(b.timestamps[0]) > max_ts:
+                continue
+            yield b
+
+
+def _rows_to_inmemory_part(rows: list, precision_bits: int = 64) -> InmemoryPart:
+    """rows: list of (TSID, ts_ms, float_value). Sorts by (tsid, ts) and
+    builds <=8k-row blocks (createInmemoryPart, partition.go:877 analog)."""
+    rows.sort(key=lambda r: (r[0].sort_key(), r[1]))
+    blocks = []
+    i = 0
+    n = len(rows)
+    while i < n:
+        j = i
+        tsid = rows[i][0]
+        while j < n and rows[j][0].metric_id == tsid.metric_id:
+            j += 1
+        ts = np.array([r[1] for r in rows[i:j]], dtype=np.int64)
+        vals = np.array([r[2] for r in rows[i:j]], dtype=np.float64)
+        blocks.extend(rows_to_blocks(tsid, ts, vals, precision_bits))
+        i = j
+    return InmemoryPart(blocks)
+
+
+def _merge_block_streams(sources, deleted_ids: np.ndarray | None,
+                         min_valid_ts: int | None,
+                         dedup_interval: int = 0):
+    """K-way merge of block iterators into (tsid, ts)-ordered blocks, with
+    tombstone / retention / dedup filtering (mergeBlockStreams, merge.go:19
+    analog). Yields Blocks."""
+    del_set = set(int(x) for x in deleted_ids) if deleted_ids is not None else set()
+
+    def keyed(src):
+        for b in src:
+            yield ((b.tsid.sort_key(), int(b.timestamps[0])), b)
+
+    pending_tsid = None
+    pend_ts: list[np.ndarray] = []
+    pend_vals: list[np.ndarray] = []
+    pend_scales: list[int] = []
+
+    def flush():
+        nonlocal pend_ts, pend_vals, pend_scales, pending_tsid
+        if pending_tsid is None:
+            return []
+        from ..ops import decimal as dec
+        # merge rows of one series across source blocks
+        ts = np.concatenate(pend_ts)
+        if len(set(pend_scales)) == 1:
+            vals = np.concatenate(pend_vals)
+            scale = pend_scales[0]
+        else:
+            floats = np.concatenate([
+                dec.decimal_to_float(v, s)
+                for v, s in zip(pend_vals, pend_scales)])
+            vals, scale = dec.float_to_decimal(floats)
+        order = np.argsort(ts, kind="stable")
+        ts = ts[order]
+        vals = vals[order]
+        if min_valid_ts is not None:
+            keep = ts >= min_valid_ts
+            ts, vals = ts[keep], vals[keep]
+        if dedup_interval > 0:
+            ts, vals = deduplicate(ts, vals, dedup_interval)
+        out = []
+        tsid = pending_tsid
+        for i in range(0, ts.size, MAX_ROWS_PER_BLOCK):
+            j = min(i + MAX_ROWS_PER_BLOCK, ts.size)
+            if j > i:
+                out.append(Block(tsid, ts[i:j], vals[i:j], scale))
+        pending_tsid = None
+        pend_ts, pend_vals, pend_scales = [], [], []
+        return out
+
+    for _, b in heapq.merge(*(keyed(s) for s in sources), key=lambda kv: kv[0]):
+        if b.tsid.metric_id in del_set:
+            continue
+        if pending_tsid is not None and b.tsid.metric_id != pending_tsid.metric_id:
+            yield from flush()
+        if pending_tsid is None:
+            pending_tsid = b.tsid
+        pend_ts.append(b.timestamps)
+        pend_vals.append(b.values)
+        pend_scales.append(b.scale)
+    yield from flush()
+
+
+class Partition:
+    """One month of data ("2006_01" naming, time.go:79 analog)."""
+
+    def __init__(self, path: str, name: str, dedup_interval_ms: int = 0):
+        self.path = path
+        self.name = name
+        self.dedup_interval_ms = dedup_interval_ms
+        self._lock = threading.RLock()
+        self._pending: list = []
+        self._mem_parts: list[InmemoryPart] = []
+        self._file_parts: list[Part] = []
+        self._seq = itertools.count()
+        os.makedirs(path, exist_ok=True)
+        self._open_existing()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _parts_json(self):
+        return os.path.join(self.path, "parts.json")
+
+    def _write_parts_json_locked(self):
+        names = [os.path.basename(p.path) for p in self._file_parts]
+        tmp = self._parts_json() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"parts": names}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._parts_json())
+
+    def _open_existing(self):
+        listed = []
+        if os.path.exists(self._parts_json()):
+            with open(self._parts_json()) as f:
+                listed = json.load(f)["parts"]
+        live = set()
+        for name in listed:
+            p = os.path.join(self.path, name)
+            try:
+                self._file_parts.append(Part(p))
+                live.add(name)
+            except (OSError, ValueError, KeyError) as e:
+                logger.errorf("partition %s: cannot open part %s: %s",
+                              self.name, name, e)
+        # remove crash leftovers (unlisted dirs, tmp dirs)
+        for name in os.listdir(self.path):
+            full = os.path.join(self.path, name)
+            if name == "parts.json" or not os.path.isdir(full):
+                continue
+            if name not in live:
+                shutil.rmtree(full, ignore_errors=True)
+        if self._file_parts:
+            seqs = [int(os.path.basename(p.path).split("_")[1])
+                    for p in self._file_parts]
+            self._seq = itertools.count(max(seqs) + 1)
+
+    def close(self):
+        with self._lock:
+            for p in self._file_parts:
+                p.close()
+            self._file_parts = []
+
+    # -- writes ------------------------------------------------------------
+
+    def add_rows(self, rows) -> None:
+        """rows: list of (TSID, ts_ms, float_value)."""
+        with self._lock:
+            self._pending.extend(rows)
+            if len(self._pending) >= MAX_PENDING_ROWS:
+                self._flush_pending_locked()
+
+    def _flush_pending_locked(self):
+        if not self._pending:
+            return
+        rows, self._pending = self._pending, []
+        self._mem_parts.append(_rows_to_inmemory_part(rows))
+
+    def flush_pending(self):
+        with self._lock:
+            self._flush_pending_locked()
+
+    def flush_to_disk(self):
+        """pending + in-memory parts -> one small file part (durable)."""
+        with self._lock:
+            self._flush_pending_locked()
+            if not self._mem_parts:
+                return
+            mems, self._mem_parts = self._mem_parts, []
+            self._write_merged_locked([m.iter_blocks() for m in mems])
+            if len(self._file_parts) > MAX_SMALL_PARTS:
+                self._merge_file_parts_locked(self._file_parts)
+
+    def _write_merged_locked(self, sources, deleted_ids=None, min_valid_ts=None):
+        name = f"p_{next(self._seq):016d}"
+        w = PartWriter(os.path.join(self.path, name))
+        wrote = False
+        try:
+            for b in _merge_block_streams(sources, deleted_ids, min_valid_ts,
+                                          self.dedup_interval_ms):
+                w.write_block(b)
+                wrote = True
+            if not wrote:
+                w.abort()
+                return None
+            w.close()
+        except BaseException:
+            w.abort()
+            raise
+        p = Part(os.path.join(self.path, name))
+        self._file_parts.append(p)
+        self._write_parts_json_locked()
+        return p
+
+    def _merge_file_parts_locked(self, parts, deleted_ids=None,
+                                 min_valid_ts=None):
+        olds = list(parts)
+        if not olds:
+            return
+        survivors = [p for p in self._file_parts if p not in olds]
+        name = f"p_{next(self._seq):016d}"
+        w = PartWriter(os.path.join(self.path, name))
+        wrote = False
+        try:
+            for b in _merge_block_streams([p.iter_blocks() for p in olds],
+                                          deleted_ids, min_valid_ts,
+                                          self.dedup_interval_ms):
+                w.write_block(b)
+                wrote = True
+            if wrote:
+                w.close()
+            else:
+                w.abort()
+        except BaseException:
+            w.abort()
+            raise
+        self._file_parts = survivors + (
+            [Part(os.path.join(self.path, name))] if wrote else [])
+        self._write_parts_json_locked()
+        for old in olds:
+            # Unlink only: concurrent readers may still iterate `old`; open
+            # fds keep the data alive until the last reference drops (the
+            # reference's part-refcount pattern, here via Python GC).
+            shutil.rmtree(old.path, ignore_errors=True)
+
+    def force_merge(self, deleted_ids=None, min_valid_ts=None):
+        """Merge everything into one part, applying tombstones/retention
+        (the /internal/force_merge + final-dedup path)."""
+        with self._lock:
+            self._flush_pending_locked()
+            mems, self._mem_parts = self._mem_parts, []
+            if mems:
+                self._write_merged_locked([m.iter_blocks() for m in mems])
+            if self._file_parts:
+                self._merge_file_parts_locked(self._file_parts, deleted_ids,
+                                              min_valid_ts)
+
+    # -- reads -------------------------------------------------------------
+
+    def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None):
+        """Blocks from all parts (NOT cross-part merged; the search layer
+        merges rows per series)."""
+        with self._lock:
+            pending = list(self._pending)
+            mems = list(self._mem_parts)
+            files = list(self._file_parts)
+        if pending:
+            mems = mems + [_rows_to_inmemory_part(pending)]
+        for src in mems:
+            yield from src.iter_blocks(tsid_set, min_ts, max_ts)
+        for p in files:
+            yield from p.iter_blocks(tsid_set, min_ts, max_ts)
+
+    @property
+    def rows(self) -> int:
+        with self._lock:
+            return (len(self._pending)
+                    + sum(m.rows for m in self._mem_parts)
+                    + sum(p.rows for p in self._file_parts))
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot_to(self, dst: str):
+        """Hardlink immutable parts (MustCreateSnapshotAt analog,
+        partition.go:1992). Flush first so RAM state is included."""
+        self.flush_to_disk()
+        os.makedirs(dst, exist_ok=True)
+        with self._lock:
+            for p in self._file_parts:
+                name = os.path.basename(p.path)
+                pdst = os.path.join(dst, name)
+                os.makedirs(pdst, exist_ok=True)
+                for fn in os.listdir(p.path):
+                    os.link(os.path.join(p.path, fn), os.path.join(pdst, fn))
+            names = [os.path.basename(p.path) for p in self._file_parts]
+        with open(os.path.join(dst, "parts.json"), "w") as f:
+            json.dump({"parts": names}, f)

@@ -1,0 +1,318 @@
+"""Top-level Storage (reference lib/storage/storage.go:43,180).
+
+Owns: monthly-partitioned data table, inverted index, TSID cache, per-day
+index cache, deletion tombstones, snapshots, background flushers, retention.
+
+The public API mirrors the reference's Storage surface: AddRows, Search
+(here: search_series / iter_series_blocks), SearchLabelNames/Values,
+DeleteSeries, CreateSnapshot, RegisterMetricNames, GetTSDBStatus, ForceFlush/
+ForceMerge — re-shaped for a Python host plane feeding a TPU query engine.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+
+from ..utils import logger
+from .dedup import deduplicate
+from .index_db import IndexDB, date_of_ms
+from .metric_name import MetricName
+from .table import Table
+from .tag_filters import TagFilter
+from .tsid import MetricIDGenerator, TSID, generate_tsid
+
+DEFAULT_RETENTION_MS = 31 * 13 * 86_400_000  # ~13 months, like the reference
+
+
+class SeriesData:
+    """Decoded query result for one series."""
+
+    __slots__ = ("metric_name", "timestamps", "values")
+
+    def __init__(self, metric_name: MetricName, timestamps: np.ndarray,
+                 values: np.ndarray):
+        self.metric_name = metric_name
+        self.timestamps = timestamps
+        self.values = values
+
+
+class Storage:
+    def __init__(self, path: str, retention_ms: int = DEFAULT_RETENTION_MS,
+                 dedup_interval_ms: int = 0):
+        self.path = path
+        self.retention_ms = retention_ms
+        self.dedup_interval_ms = dedup_interval_ms
+        os.makedirs(path, exist_ok=True)
+        self._flock_f = open(os.path.join(path, "flock.lock"), "w")
+        try:
+            fcntl.flock(self._flock_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BlockingIOError:
+            raise RuntimeError(f"storage at {path} is locked by another process")
+        self.idb = IndexDB(os.path.join(path, "indexdb"))
+        self.table = Table(os.path.join(path, "data"), dedup_interval_ms)
+        self._tsid_cache: dict[bytes, TSID] = {}
+        self._day_cache: set[tuple[int, int]] = set()  # (metric_id, date)
+        self._mid_gen = MetricIDGenerator()
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._readonly = False
+        self.rows_added = 0
+        self.slow_row_inserts = 0
+        self.new_series_created = 0
+        self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
+        self._flusher.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        self._stop.set()
+        self._flusher.join(timeout=10)
+        self.table.flush_to_disk()
+        self.idb.flush()
+        self.table.close()
+        self.idb.close()
+        fcntl.flock(self._flock_f, fcntl.LOCK_UN)
+        self._flock_f.close()
+
+    def _flush_loop(self):
+        last_disk = time.monotonic()
+        while not self._stop.wait(2.0):
+            try:
+                self.table.flush_pending()
+                if time.monotonic() - last_disk >= 5.0:
+                    self.table.flush_to_disk()
+                    self.idb.flush()
+                    last_disk = time.monotonic()
+            except Exception as e:  # pragma: no cover
+                logger.errorf("storage flusher: %s", e)
+
+    @property
+    def is_readonly(self) -> bool:
+        return self._readonly
+
+    def set_readonly(self, ro: bool):
+        self._readonly = ro
+
+    # -- writes ------------------------------------------------------------
+
+    def _resolve_tsid(self, mn: MetricName, raw: bytes) -> TSID:
+        tsid = self._tsid_cache.get(raw)
+        if tsid is not None:
+            return tsid
+        self.slow_row_inserts += 1
+        tsid = self.idb.get_tsid_by_name(raw)
+        if tsid is None:
+            tsid = generate_tsid(mn, self._mid_gen.next_id())
+            self.idb.create_indexes_for_metric(mn, tsid)
+            self.new_series_created += 1
+        self._tsid_cache[raw] = tsid
+        return tsid
+
+    def add_rows(self, rows) -> int:
+        """rows: iterable of (MetricName | dict | list[(k,v)], ts_ms, value).
+        Returns rows added (AddRows/Storage.add analog, storage.go:1655,1874).
+        """
+        if self._readonly:
+            raise RuntimeError("storage is read-only")
+        out = []
+        with self._lock:
+            for labels, ts, val in rows:
+                if isinstance(labels, MetricName):
+                    mn = labels
+                elif isinstance(labels, dict):
+                    mn = MetricName.from_dict(labels)
+                else:
+                    mn = MetricName.from_labels(labels)
+                raw = mn.marshal()
+                tsid = self._resolve_tsid(mn, raw)
+                date = date_of_ms(ts)
+                key = (tsid.metric_id, date)
+                if key not in self._day_cache:
+                    self.idb.create_per_day_indexes(mn, tsid, date)
+                    self._day_cache.add(key)
+                out.append((tsid, int(ts), float(val)))
+        self.table.add_rows(out)
+        self.rows_added += len(out)
+        return len(out)
+
+    def register_metric_names(self, metric_names) -> None:
+        """Create index entries without samples (RegisterMetricNames,
+        storage.go:1524)."""
+        with self._lock:
+            for labels in metric_names:
+                mn = labels if isinstance(labels, MetricName) else \
+                    MetricName.from_dict(labels)
+                self._resolve_tsid(mn, mn.marshal())
+
+    # -- reads -------------------------------------------------------------
+
+    def search_metric_names(self, filters: list[TagFilter], min_ts: int,
+                            max_ts: int, limit: int = 2**31) -> list[MetricName]:
+        mids = self.idb.search_metric_ids(filters, min_ts, max_ts)
+        out = []
+        for mid in mids[:limit]:
+            mn = self.idb.get_metric_name_by_id(int(mid))
+            if mn is not None:
+                out.append(mn)
+        return out
+
+    def iter_series_blocks(self, filters: list[TagFilter], min_ts: int,
+                           max_ts: int):
+        """Raw matching blocks in (tsid, min_ts) order — the input to the
+        TPU tile packer (Search.NextMetricBlock analog, search.go:275)."""
+        tsids = self.idb.search_tsids(filters, min_ts, max_ts)
+        tsid_set = {t.metric_id for t in tsids}
+        if not tsid_set:
+            return
+        yield from self.table.iter_blocks(tsid_set, min_ts, max_ts)
+
+    def search_series(self, filters: list[TagFilter], min_ts: int,
+                      max_ts: int, dedup_interval_ms: int | None = None,
+                      max_series: int | None = None) -> list[SeriesData]:
+        """Decoded per-series rows, cross-part merged, deduped, clipped."""
+        from ..ops import decimal as dec_ops
+        interval = (self.dedup_interval_ms if dedup_interval_ms is None
+                    else dedup_interval_ms)
+        per_mid: dict[int, list] = {}
+        for blk in self.iter_series_blocks(filters, min_ts, max_ts):
+            per_mid.setdefault(blk.tsid.metric_id, []).append(blk)
+        if max_series is not None and len(per_mid) > max_series:
+            raise ResourceWarning(
+                f"query matches {len(per_mid)} series, limit {max_series}")
+        out = []
+        for mid, blocks in per_mid.items():
+            mn = self.idb.get_metric_name_by_id(mid)
+            if mn is None:
+                continue
+            ts = np.concatenate([b.timestamps for b in blocks])
+            vals = np.concatenate([b.float_values() for b in blocks])
+            order = np.argsort(ts, kind="stable")
+            ts, vals = ts[order], vals[order]
+            keep = (ts >= min_ts) & (ts <= max_ts)
+            ts, vals = ts[keep], vals[keep]
+            if ts.size == 0:
+                continue
+            if interval > 0:
+                ts, vals = deduplicate(ts, vals, interval)
+            # collapse exact-duplicate timestamps (replica merges)
+            if ts.size > 1:
+                dup = np.concatenate([ts[1:] == ts[:-1], [False]])
+                if dup.any():
+                    ts, vals = ts[~dup], vals[~dup]
+            out.append(SeriesData(mn, ts, vals))
+        out.sort(key=lambda s: s.metric_name.marshal())
+        return out
+
+    def label_names(self, min_ts=None, max_ts=None) -> list[str]:
+        return self.idb.label_names(min_ts, max_ts)
+
+    def label_values(self, key: str, min_ts=None, max_ts=None) -> list[str]:
+        return self.idb.label_values(key, min_ts, max_ts)
+
+    def series_count(self) -> int:
+        return int(self.idb._all_metric_ids().size)
+
+    def tsdb_status(self, date: int | None = None, topn: int = 10) -> dict:
+        """Cardinality explorer data (GetTSDBStatus, index_db.go:1284)."""
+        by_metric: dict[bytes, int] = {}
+        by_label: dict[bytes, int] = {}
+        by_pair: dict[bytes, int] = {}
+        mids = (self.idb._metric_ids_for_date(date) if date is not None
+                else self.idb._all_metric_ids())
+        for mid in mids:
+            mn = self.idb.get_metric_name_by_id(int(mid))
+            if mn is None:
+                continue
+            by_metric[mn.metric_group] = by_metric.get(mn.metric_group, 0) + 1
+            for k, v in mn.labels:
+                by_label[k] = by_label.get(k, 0) + 1
+                pair = k + b"=" + v
+                by_pair[pair] = by_pair.get(pair, 0) + 1
+
+        def top(d):
+            return [{"name": k.decode("utf-8", "replace"), "count": c}
+                    for k, c in sorted(d.items(), key=lambda kv: -kv[1])[:topn]]
+
+        return {
+            "totalSeries": int(mids.size),
+            "seriesCountByMetricName": top(by_metric),
+            "seriesCountByLabelName": top(by_label),
+            "seriesCountByLabelValuePair": top(by_pair),
+        }
+
+    # -- deletes -----------------------------------------------------------
+
+    def delete_series(self, filters: list[TagFilter]) -> int:
+        """Tombstone matching series (DeleteSeries, storage.go:1345). Data
+        blocks are dropped at the next merge."""
+        mids = self.idb.search_metric_ids(filters)
+        if mids.size:
+            self.idb.delete_series_by_ids(mids)
+            with self._lock:
+                self._tsid_cache = {
+                    raw: t for raw, t in self._tsid_cache.items()
+                    if t.metric_id not in set(int(m) for m in mids)}
+        return int(mids.size)
+
+    # -- maintenance -------------------------------------------------------
+
+    def force_flush(self):
+        self.table.flush_to_disk()
+        self.idb.flush()
+
+    def force_merge(self):
+        self.table.force_merge(self.idb.deleted_metric_ids,
+                               self.min_valid_ts)
+
+    @property
+    def min_valid_ts(self) -> int:
+        return int(time.time() * 1e3) - self.retention_ms
+
+    def enforce_retention(self) -> int:
+        return self.table.enforce_retention(self.min_valid_ts)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshots_dir(self) -> str:
+        return os.path.join(self.path, "snapshots")
+
+    def create_snapshot(self) -> str:
+        """Instant snapshot via hardlinks (MustCreateSnapshot,
+        storage.go:411); name format YYYYMMDDhhmmss-seq."""
+        name = time.strftime("%Y%m%d%H%M%S") + f"-{int(time.time_ns()) % 10000:04d}"
+        dst = os.path.join(self.snapshots_dir(), name)
+        self.table.snapshot_to(os.path.join(dst, "data"))
+        self.idb.table.create_snapshot_at(os.path.join(dst, "indexdb"))
+        logger.infof("storage: created snapshot %s", name)
+        return name
+
+    def list_snapshots(self) -> list[str]:
+        d = self.snapshots_dir()
+        if not os.path.isdir(d):
+            return []
+        return sorted(os.listdir(d))
+
+    def delete_snapshot(self, name: str) -> bool:
+        full = os.path.join(self.snapshots_dir(), name)
+        if not os.path.isdir(full):
+            return False
+        shutil.rmtree(full)
+        return True
+
+    # -- metrics -----------------------------------------------------------
+
+    def metrics(self) -> dict[str, float]:
+        return {
+            "vm_rows_added_to_storage_total": self.rows_added,
+            "vm_rows": self.table.rows,
+            "vm_new_timeseries_created_total": self.new_series_created,
+            "vm_slow_row_inserts_total": self.slow_row_inserts,
+            "vm_timeseries_total": self.series_count(),
+            "vm_partitions": len(self.table.partition_names),
+        }

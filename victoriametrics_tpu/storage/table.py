@@ -1,0 +1,130 @@
+"""Table: monthly partitions + retention (reference lib/storage/table.go:27,
+retentionWatcher table.go:428)."""
+
+from __future__ import annotations
+
+import datetime
+import os
+import shutil
+import threading
+
+from ..utils import logger
+from .partition import Partition
+
+
+def partition_name_for_ts(ts_ms: int) -> str:
+    d = datetime.datetime.fromtimestamp(ts_ms / 1e3, tz=datetime.timezone.utc)
+    return f"{d.year:04d}_{d.month:02d}"
+
+
+def _partition_bounds(name: str) -> tuple[int, int]:
+    y, m = int(name[:4]), int(name[5:7])
+    start = datetime.datetime(y, m, 1, tzinfo=datetime.timezone.utc)
+    end = (datetime.datetime(y + 1, 1, 1, tzinfo=datetime.timezone.utc)
+           if m == 12 else
+           datetime.datetime(y, m + 1, 1, tzinfo=datetime.timezone.utc))
+    return int(start.timestamp() * 1e3), int(end.timestamp() * 1e3) - 1
+
+
+class Table:
+    def __init__(self, path: str, dedup_interval_ms: int = 0):
+        self.path = path
+        self.dedup_interval_ms = dedup_interval_ms
+        self._lock = threading.RLock()
+        self._partitions: dict[str, Partition] = {}
+        os.makedirs(path, exist_ok=True)
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            if os.path.isdir(full) and len(name) == 7 and name[4] == "_":
+                self._partitions[name] = Partition(full, name,
+                                                   dedup_interval_ms)
+
+    def close(self):
+        with self._lock:
+            for p in self._partitions.values():
+                p.close()
+            self._partitions.clear()
+
+    def partition_for_ts(self, ts_ms: int) -> Partition:
+        name = partition_name_for_ts(ts_ms)
+        with self._lock:
+            p = self._partitions.get(name)
+            if p is None:
+                p = Partition(os.path.join(self.path, name), name,
+                              self.dedup_interval_ms)
+                self._partitions[name] = p
+            return p
+
+    def add_rows(self, rows) -> None:
+        """rows: [(TSID, ts_ms, float)] — routed to monthly partitions
+        (MustAddRows, table.go:300)."""
+        by_part: dict[str, list] = {}
+        for r in rows:
+            by_part.setdefault(partition_name_for_ts(r[1]), []).append(r)
+        for name, rs in by_part.items():
+            self.partition_for_ts(rs[0][1]).add_rows(rs)
+
+    def partitions_for_range(self, min_ts: int, max_ts: int) -> list[Partition]:
+        with self._lock:
+            out = []
+            for name, p in sorted(self._partitions.items()):
+                lo, hi = _partition_bounds(name)
+                if hi >= min_ts and lo <= max_ts:
+                    out.append(p)
+            return out
+
+    def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None):
+        parts = (self.partitions_for_range(min_ts if min_ts is not None else -(1 << 62),
+                                           max_ts if max_ts is not None else 1 << 62))
+        for p in parts:
+            yield from p.iter_blocks(tsid_set, min_ts, max_ts)
+
+    def enforce_retention(self, min_valid_ts: int) -> int:
+        """Drop partitions entirely older than retention; returns count
+        (retentionWatcher analog)."""
+        dropped = 0
+        with self._lock:
+            for name in list(self._partitions):
+                _, hi = _partition_bounds(name)
+                if hi < min_valid_ts:
+                    p = self._partitions.pop(name)
+                    p.close()
+                    shutil.rmtree(p.path, ignore_errors=True)
+                    logger.infof("table: dropped partition %s (retention)", name)
+                    dropped += 1
+        return dropped
+
+    def flush_pending(self):
+        with self._lock:
+            parts = list(self._partitions.values())
+        for p in parts:
+            p.flush_pending()
+
+    def flush_to_disk(self):
+        with self._lock:
+            parts = list(self._partitions.values())
+        for p in parts:
+            p.flush_to_disk()
+
+    def force_merge(self, deleted_ids=None, min_valid_ts=None):
+        with self._lock:
+            parts = list(self._partitions.values())
+        for p in parts:
+            p.force_merge(deleted_ids, min_valid_ts)
+
+    def snapshot_to(self, dst: str):
+        os.makedirs(dst, exist_ok=True)
+        with self._lock:
+            parts = list(self._partitions.values())
+        for p in parts:
+            p.snapshot_to(os.path.join(dst, p.name))
+
+    @property
+    def rows(self) -> int:
+        with self._lock:
+            return sum(p.rows for p in self._partitions.values())
+
+    @property
+    def partition_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._partitions)

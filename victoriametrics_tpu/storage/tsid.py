@@ -1,0 +1,83 @@
+"""TSID: the sortable numeric series identity (reference lib/storage/tsid.go:17,
+generated at index_db.go:412).
+
+Sort order clusters blocks of related series together on disk:
+(metric_group_id, job_id, instance_id, metric_id). metric_id alone is
+globally unique and is the key used by posting lists and caches.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+
+import xxhash
+
+_FMT = struct.Struct(">QIIQ")  # group, job, instance, metric
+
+
+class TSID:
+    __slots__ = ("metric_group_id", "job_id", "instance_id", "metric_id")
+
+    SIZE = _FMT.size
+
+    def __init__(self, metric_group_id=0, job_id=0, instance_id=0, metric_id=0):
+        self.metric_group_id = metric_group_id
+        self.job_id = job_id
+        self.instance_id = instance_id
+        self.metric_id = metric_id
+
+    def marshal(self) -> bytes:
+        return _FMT.pack(self.metric_group_id, self.job_id, self.instance_id,
+                         self.metric_id)
+
+    @classmethod
+    def unmarshal(cls, data: bytes, offset: int = 0) -> "TSID":
+        g, j, i, m = _FMT.unpack_from(data, offset)
+        return cls(g, j, i, m)
+
+    def sort_key(self) -> tuple:
+        return (self.metric_group_id, self.job_id, self.instance_id,
+                self.metric_id)
+
+    def __lt__(self, other):
+        return self.sort_key() < other.sort_key()
+
+    def __eq__(self, other):
+        return self.sort_key() == other.sort_key()
+
+    def __hash__(self):
+        return hash(self.metric_id)
+
+    def __repr__(self):
+        return (f"TSID(g={self.metric_group_id:x}, j={self.job_id:x}, "
+                f"i={self.instance_id:x}, m={self.metric_id:x})")
+
+
+class MetricIDGenerator:
+    """Unique metric_id source: coarse-time-seeded counter (reference
+    generateUniqueMetricID uses an atomic counter seeded from nanotime so ids
+    stay unique across restarts without persistence)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = time.time_ns() & ((1 << 62) - 1)
+
+    def next_id(self) -> int:
+        with self._lock:
+            self._next += 1
+            return self._next
+
+
+def generate_tsid(mn, metric_id: int) -> TSID:
+    """Derive the clustering hash fields from the metric name."""
+    t = TSID(metric_id=metric_id)
+    t.metric_group_id = xxhash.xxh64_intdigest(mn.metric_group)
+    job = mn.get_label(b"job")
+    if job:
+        t.job_id = xxhash.xxh32_intdigest(job)
+    inst = mn.get_label(b"instance")
+    if inst:
+        t.instance_id = xxhash.xxh32_intdigest(inst)
+    return t
