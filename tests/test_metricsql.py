@@ -1,0 +1,222 @@
+"""Parser tests — corpus modeled on the metricsql package's parser_test.go
+coverage: selectors, rollups, subqueries, aggregates, binary ops with
+matching modifiers, WITH templates, durations, weird-but-legal inputs."""
+
+import pytest
+
+from victoriametrics_tpu.query.metricsql import (AggrFuncExpr, BinaryOpExpr,
+                                                 DurationExpr, FuncExpr,
+                                                 MetricExpr, NumberExpr,
+                                                 ParseError, RollupExpr,
+                                                 StringExpr, parse)
+
+
+def test_plain_metric():
+    e = parse("http_requests_total")
+    assert isinstance(e, MetricExpr)
+    assert e.metric_name == "http_requests_total"
+
+
+def test_selector_with_filters():
+    e = parse('m{job="api", instance!="h1", path=~"/v[12]", q!~"x.*"}')
+    assert isinstance(e, MetricExpr)
+    ops = [(f.label, f.op()) for f in e.label_filters]
+    assert ops == [("__name__", "="), ("job", "="), ("instance", "!="),
+                   ("path", "=~"), ("q", "!~")]
+
+
+def test_nameless_selector():
+    e = parse('{job="api"}')
+    assert isinstance(e, MetricExpr)
+    assert e.metric_name is None
+
+
+def test_rollup_window():
+    e = parse("rate(m[5m])")
+    assert isinstance(e, FuncExpr) and e.name == "rate"
+    r = e.args[0]
+    assert isinstance(r, RollupExpr)
+    assert r.window.ms == 300_000
+
+
+def test_compound_duration():
+    e = parse("m[1h30m]")
+    assert e.window.ms == 5_400_000
+
+
+def test_bare_number_window_is_seconds():
+    e = parse("m[300]")
+    assert e.window.ms == 300_000
+
+
+def test_step_based_duration():
+    e = parse("m[5i]")
+    assert e.window.step_based and e.window.ms == 5
+    assert e.window.value_ms(30_000) == 150_000
+
+
+def test_offset_and_at():
+    e = parse("m offset 1h @ 1700000000")
+    assert isinstance(e, RollupExpr)
+    assert e.offset.ms == 3_600_000
+    assert isinstance(e.at, NumberExpr)
+
+
+def test_negative_offset():
+    e = parse("m offset -30m")
+    assert e.offset.ms == -1_800_000
+
+
+def test_subquery():
+    e = parse("max_over_time(rate(m[5m])[1h:1m])")
+    r = e.args[0]
+    assert isinstance(r, RollupExpr)
+    assert r.window.ms == 3_600_000 and r.step.ms == 60_000
+    assert isinstance(r.expr, FuncExpr)
+
+
+def test_subquery_inherit_step():
+    r = parse("q[1h:]")
+    assert r.inherit_step and r.step is None
+
+
+def test_aggregate_by():
+    e = parse("sum by (job, instance) (rate(m[5m]))")
+    assert isinstance(e, AggrFuncExpr)
+    assert e.name == "sum" and e.grouping == ["job", "instance"]
+    assert not e.without
+
+
+def test_aggregate_without_trailing():
+    e = parse("sum(rate(m[5m])) without (pod)")
+    assert e.without and e.grouping == ["pod"]
+
+
+def test_aggregate_limit():
+    e = parse("sum(m) by (job) limit 10")
+    assert e.limit == 10 and e.grouping == ["job"]
+
+
+def test_topk():
+    e = parse("topk(5, m)")
+    assert isinstance(e, AggrFuncExpr)
+    assert isinstance(e.args[0], NumberExpr) and e.args[0].value == 5
+
+
+def test_binary_precedence():
+    e = parse("a + b * c")
+    assert isinstance(e, BinaryOpExpr) and e.op == "+"
+    assert isinstance(e.right, BinaryOpExpr) and e.right.op == "*"
+
+
+def test_power_right_assoc():
+    e = parse("a ^ b ^ c")
+    assert e.op == "^"
+    assert isinstance(e.right, BinaryOpExpr) and e.right.op == "^"
+
+
+def test_comparison_bool():
+    e = parse("a > bool 5")
+    assert e.op == ">" and e.bool_modifier
+
+
+def test_vector_matching():
+    e = parse("a / on(job) group_left(extra) b")
+    assert e.group_modifier.op == "on" and e.group_modifier.args == ["job"]
+    assert e.join_modifier.op == "group_left"
+    assert e.join_modifier.args == ["extra"]
+
+
+def test_and_or_unless():
+    e = parse("a and b or c unless d")
+    assert e.op == "or"
+
+
+def test_metricsql_default_if():
+    e = parse("a default 0")
+    assert e.op == "default"
+    e = parse("a if b")
+    assert e.op == "if"
+    e = parse("a ifnot b")
+    assert e.op == "ifnot"
+
+
+def test_unary_minus():
+    e = parse("-m")
+    assert isinstance(e, BinaryOpExpr) and e.op == "*"
+    assert e.left.value == -1.0
+
+
+def test_number_formats():
+    assert parse("0x1F").value == 31.0
+    assert parse("1.5e3").value == 1500.0
+    assert parse("2Ki").value == 2048.0
+    assert parse("1M").value == 1e6
+    assert parse("NaN").value != parse("NaN").value
+    assert parse("Inf").value == float("inf")
+
+
+def test_duration_as_scalar():
+    e = parse("now() - 5m")
+    assert isinstance(e.right, DurationExpr)
+
+
+def test_keep_metric_names():
+    e = parse("rate(m[5m]) keep_metric_names")
+    assert e.keep_metric_names
+
+
+def test_with_template_simple():
+    e = parse('WITH (x = m{a="1"}) rate(x[5m])')
+    r = e.args[0]
+    assert isinstance(r.expr, MetricExpr)
+    assert r.expr.label_filters[1].value == "1"
+
+
+def test_with_template_function():
+    e = parse("WITH (f(q) = sum(rate(q[5m]))) f(m)")
+    assert isinstance(e, AggrFuncExpr) and e.name == "sum"
+    inner = e.args[0].args[0]
+    assert isinstance(inner.expr, MetricExpr)
+    assert inner.expr.metric_name == "m"
+
+
+def test_string_literal():
+    e = parse('label_set(m, "foo", "bar")')
+    assert isinstance(e.args[1], StringExpr) and e.args[1].value == "foo"
+
+
+def test_parens_grouping():
+    e = parse("(a + b) * c")
+    assert e.op == "*"
+    assert isinstance(e.left, BinaryOpExpr) and e.left.op == "+"
+
+
+def test_recording_rule_colon_names():
+    e = parse("job:request_rate:5m")
+    assert e.metric_name == "job:request_rate:5m"
+
+
+def test_canonical_string_roundtrip():
+    for q in ["sum by (job) (rate(http_requests_total[5m]))",
+              'm{a="1", b!~"x|y"} offset 1h',
+              "max_over_time(rate(m[5m])[1h:1m])",
+              "a / on (job) group_left () b",
+              "histogram_quantile(0.99, sum by (le) (rate(b[5m])))"]:
+        e = parse(q)
+        e2 = parse(str(e))
+        assert str(e) == str(e2)
+
+
+@pytest.mark.parametrize("bad", [
+    "", "   ", "sum(", "m{", 'm{a=}', "m[", "m[5m", "a +", "((a)",
+    "m{a=\"1\"", "m offset", "1 +", "by (x) sum(m)",
+])
+def test_parse_errors(bad):
+    with pytest.raises(ParseError):
+        parse(bad)
+
+
+def test_comments_ignored():
+    e = parse("m # trailing comment")
+    assert isinstance(e, MetricExpr)

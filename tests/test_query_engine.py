@@ -1,0 +1,337 @@
+"""Query engine tests — modeled on the reference's exec_test.go style:
+queries against a seeded storage, hand-computed expectations."""
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.query.eval import QueryError
+from victoriametrics_tpu.query.exec import exec_query, exec_instant
+from victoriametrics_tpu.query.types import EvalConfig
+from victoriametrics_tpu.storage.storage import Storage
+
+T0 = 1_753_700_000_000
+STEP = 60_000
+END = T0 + 20 * STEP
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    s = Storage(str(tmp_path_factory.mktemp("qe") / "s"))
+    rows = []
+    # counters: http_requests_total{job, instance} at 15s, rate 10/s and 20/s
+    for j in range(121):
+        ts = T0 - 600_000 + j * 15_000
+        rows.append(({"__name__": "http_requests_total", "job": "api",
+                      "instance": "h1"}, ts, 150.0 * j))
+        rows.append(({"__name__": "http_requests_total", "job": "api",
+                      "instance": "h2"}, ts, 300.0 * j))
+        rows.append(({"__name__": "http_requests_total", "job": "web",
+                      "instance": "h3"}, ts, 600.0 * j))
+    # gauge
+    for j in range(121):
+        ts = T0 - 600_000 + j * 15_000
+        rows.append(({"__name__": "mem_bytes", "instance": "h1"}, ts,
+                     float(100 + (j % 10))))
+        rows.append(({"__name__": "mem_bytes", "instance": "h2"}, ts,
+                     float(200 + (j % 5))))
+    # histogram buckets (cumulative): 60% <=0.1, 90% <=1, 100% <=+Inf
+    for j in range(121):
+        ts = T0 - 600_000 + j * 15_000
+        for le, frac in (("0.1", 0.6), ("1", 0.9), ("+Inf", 1.0)):
+            rows.append(({"__name__": "latency_bucket", "le": le},
+                         ts, 100.0 * j * frac))
+    s.add_rows(rows)
+    s.force_flush()
+    yield s
+    s.close()
+
+
+@pytest.fixture()
+def ec(store):
+    return EvalConfig(start=T0, end=END, step=STEP, storage=store)
+
+
+def names(rows):
+    return [r.metric_name.to_dict() for r in rows]
+
+
+class TestSelectors:
+    def test_plain_selector_last_value(self, ec):
+        rows = exec_query(ec, "mem_bytes")
+        assert len(rows) == 2
+        assert rows[0].metric_name.to_dict()["__name__"] == "mem_bytes"
+        assert not np.isnan(rows[0].values).any()
+
+    def test_filtered_selector(self, ec):
+        rows = exec_query(ec, 'http_requests_total{job="api"}')
+        assert len(rows) == 2
+
+    def test_regex_selector(self, ec):
+        rows = exec_query(ec, '{__name__=~"http_.*", instance=~"h1|h3"}')
+        assert len(rows) == 2
+
+    def test_missing_metric_empty(self, ec):
+        assert exec_query(ec, "nope_metric") == []
+
+
+class TestRollups:
+    def test_rate_counter(self, ec):
+        rows = exec_query(ec, "rate(http_requests_total[5m])")
+        assert len(rows) == 3
+        by_inst = {r.metric_name.get_label(b"instance"): r for r in rows}
+        np.testing.assert_allclose(by_inst[b"h1"].values, 10.0, rtol=1e-9)
+        np.testing.assert_allclose(by_inst[b"h2"].values, 20.0, rtol=1e-9)
+        np.testing.assert_allclose(by_inst[b"h3"].values, 40.0, rtol=1e-9)
+        # rate() drops the metric name
+        assert rows[0].metric_name.metric_group == b""
+
+    def test_increase(self, ec):
+        rows = exec_query(ec, "increase(http_requests_total[5m])")
+        by_inst = {r.metric_name.get_label(b"instance"): r for r in rows}
+        np.testing.assert_allclose(by_inst[b"h1"].values, 3000.0, rtol=1e-9)
+
+    def test_avg_over_time_keeps_name(self, ec):
+        rows = exec_query(ec, "avg_over_time(mem_bytes[5m])")
+        assert rows[0].metric_name.metric_group == b"mem_bytes"
+
+    def test_window_defaults_to_step(self, ec):
+        rows = exec_query(ec, "count_over_time(mem_bytes[1m])")
+        np.testing.assert_allclose(rows[0].values, 4.0)
+
+    def test_offset(self, ec):
+        a = exec_query(ec, "http_requests_total offset 5m")
+        b = exec_query(ec, "http_requests_total")
+        # counter grows 150 per 15s on h1 -> offset shifts by 5m = 3000
+        ai = [r for r in a if r.metric_name.get_label(b"instance") == b"h1"][0]
+        bi = [r for r in b if r.metric_name.get_label(b"instance") == b"h1"][0]
+        np.testing.assert_allclose(bi.values - ai.values, 3000.0)
+
+    def test_quantile_over_time(self, ec):
+        rows = exec_query(ec, "quantile_over_time(1, mem_bytes[5m])")
+        by_inst = {r.metric_name.get_label(b"instance"): r for r in rows}
+        np.testing.assert_allclose(by_inst[b"h1"].values, 109.0)
+
+    def test_subquery(self, ec):
+        rows = exec_query(ec, "max_over_time(rate(http_requests_total[5m])[10m:1m])")
+        by_inst = {r.metric_name.get_label(b"instance"): r for r in rows}
+        np.testing.assert_allclose(by_inst[b"h1"].values, 10.0, rtol=1e-9)
+
+    def test_at_modifier(self, ec):
+        rows = exec_query(ec, f"mem_bytes @ {(T0 + 5 * STEP) // 1000}")
+        # broadcast: constant across grid
+        for r in rows:
+            assert (r.values == r.values[0]).all()
+
+    def test_predict_linear(self, ec):
+        rows = exec_query(ec, "predict_linear(http_requests_total{instance=\"h1\"}[5m], 60)")
+        # slope 10/s -> prediction at te+60 follows the line
+        assert rows[0].values.size == 21
+        d = np.diff(rows[0].values)
+        np.testing.assert_allclose(d, 600.0, rtol=1e-6)
+
+
+class TestAggregates:
+    def test_sum_by_job(self, ec):
+        rows = exec_query(ec, "sum by (job) (rate(http_requests_total[5m]))")
+        assert names(rows) == [{"job": "api"}, {"job": "web"}]
+        np.testing.assert_allclose(rows[0].values, 30.0, rtol=1e-9)
+        np.testing.assert_allclose(rows[1].values, 40.0, rtol=1e-9)
+
+    def test_sum_without(self, ec):
+        rows = exec_query(ec, "sum without (instance) (rate(http_requests_total[5m]))")
+        assert names(rows) == [{"job": "api"}, {"job": "web"}]
+
+    def test_global_sum(self, ec):
+        rows = exec_query(ec, "sum(rate(http_requests_total[5m]))")
+        assert len(rows) == 1 and rows[0].metric_name.to_dict() == {}
+        np.testing.assert_allclose(rows[0].values, 70.0, rtol=1e-9)
+
+    def test_avg_min_max_count(self, ec):
+        for q, want in [("avg(mem_bytes)", None), ("count(mem_bytes)", 2.0),
+                        ("min(mem_bytes)", None), ("max(mem_bytes)", None)]:
+            rows = exec_query(ec, q)
+            assert len(rows) == 1
+            if want is not None:
+                np.testing.assert_allclose(rows[0].values, want)
+
+    def test_topk(self, ec):
+        rows = exec_query(ec, "topk(1, rate(http_requests_total[5m]))")
+        assert len(rows) == 1
+        assert rows[0].metric_name.get_label(b"instance") == b"h3"
+
+    def test_topk_avg(self, ec):
+        rows = exec_query(ec, "topk_avg(2, rate(http_requests_total[5m]))")
+        insts = {r.metric_name.get_label(b"instance") for r in rows}
+        assert insts == {b"h2", b"h3"}
+
+    def test_quantile_aggr(self, ec):
+        rows = exec_query(ec, "quantile(0.5, rate(http_requests_total[5m]))")
+        np.testing.assert_allclose(rows[0].values, 20.0, rtol=1e-9)
+
+    def test_count_values(self, ec):
+        rows = exec_instant(ec, 'count_values("v", floor(mem_bytes/100))',
+                            T0 + 10 * STEP)
+        d = {r.metric_name.get_label(b"v"): r.values[0] for r in rows}
+        assert d == {b"1": 1.0, b"2": 1.0}
+
+    def test_limit(self, ec):
+        rows = exec_query(ec, "sum(rate(http_requests_total[5m])) by (instance) limit 2")
+        assert len(rows) == 2
+
+
+class TestBinaryOps:
+    def test_vector_scalar(self, ec):
+        rows = exec_query(ec, "mem_bytes * 2")
+        by_inst = {r.metric_name.get_label(b"instance"): r for r in rows}
+        assert (by_inst[b"h1"].values >= 200).all()
+        assert rows[0].metric_name.metric_group == b""
+
+    def test_comparison_filters(self, ec):
+        rows = exec_query(ec, "mem_bytes > 150")
+        assert len(rows) == 1
+        assert rows[0].metric_name.get_label(b"instance") == b"h2"
+        # name kept for filtering comparisons
+        assert rows[0].metric_name.metric_group == b"mem_bytes"
+
+    def test_comparison_bool(self, ec):
+        rows = exec_query(ec, "mem_bytes > bool 150")
+        assert len(rows) == 2
+        by_inst = {r.metric_name.get_label(b"instance"): r for r in rows}
+        np.testing.assert_allclose(by_inst[b"h1"].values, 0.0)
+        np.testing.assert_allclose(by_inst[b"h2"].values, 1.0)
+
+    def test_vector_vector_matching(self, ec):
+        rows = exec_query(ec, "rate(http_requests_total[5m]) "
+                              "/ on(instance) mem_bytes")
+        assert len(rows) == 0 or len(rows) == 2  # h1, h2 match; h3 has no mem
+        rows = exec_query(
+            ec, 'rate(http_requests_total{instance=~"h1|h2"}[5m]) '
+                '/ on(instance) mem_bytes')
+        assert len(rows) == 2
+
+    def test_and_or_unless(self, ec):
+        rows = exec_query(ec, 'mem_bytes and on(instance) '
+                              'http_requests_total{instance="h1"}')
+        assert len(rows) == 1
+        rows = exec_query(ec, 'mem_bytes unless on(instance) '
+                              'http_requests_total{instance="h1"}')
+        assert len(rows) == 1
+        assert rows[0].metric_name.get_label(b"instance") == b"h2"
+
+    def test_or_union(self, ec):
+        rows = exec_query(ec, 'mem_bytes{instance="h1"} or mem_bytes{instance="h2"}')
+        assert len(rows) == 2
+
+    def test_default(self, ec):
+        rows = exec_query(ec, "nope_metric default 7")
+        assert rows == []  # no left series at all
+        rows = exec_query(ec, "(mem_bytes > 150) default 0")
+        by_inst = {r.metric_name.get_label(b"instance"): r for r in rows}
+        np.testing.assert_allclose(by_inst[b"h1"].values, 0.0)
+
+    def test_scalar_scalar(self, ec):
+        rows = exec_query(ec, "2 + 3 * 4")
+        np.testing.assert_allclose(rows[0].values, 14.0)
+
+    def test_duration_scalar(self, ec):
+        rows = exec_query(ec, "5m / 60")
+        np.testing.assert_allclose(rows[0].values, 5.0)
+
+    def test_group_left(self, ec):
+        rows = exec_query(
+            ec, "rate(http_requests_total[5m]) * on(instance) group_left() "
+                "(mem_bytes / mem_bytes)")
+        assert len(rows) == 2
+
+
+class TestTransforms:
+    def test_math(self, ec):
+        rows = exec_query(ec, "abs(-1 * mem_bytes)")
+        assert (rows[0].values > 0).all()
+
+    def test_histogram_quantile(self, ec):
+        rows = exec_query(
+            ec, "histogram_quantile(0.5, rate(latency_bucket[5m]))")
+        assert len(rows) == 1
+        # 50th pct inside first bucket [0, 0.1]: 0.5/0.6 * 0.1
+        np.testing.assert_allclose(rows[0].values, 0.5 / 0.6 * 0.1, rtol=1e-6)
+
+    def test_histogram_quantile_99(self, ec):
+        rows = exec_query(
+            ec, "histogram_quantile(0.99, rate(latency_bucket[5m]))")
+        # between 0.9 and 1.0 cumfrac: in bucket (0.1, 1]
+        v = rows[0].values[0]
+        assert 0.1 < v <= 1.0
+
+    def test_label_set_and_del(self, ec):
+        rows = exec_query(ec, 'label_set(mem_bytes, "dc", "eu")')
+        assert rows[0].metric_name.get_label(b"dc") == b"eu"
+        rows = exec_query(ec, 'label_del(mem_bytes, "instance")')
+        assert rows[0].metric_name.get_label(b"instance") is None
+
+    def test_label_replace(self, ec):
+        rows = exec_query(ec, 'label_replace(mem_bytes, "host", "$1", '
+                              '"instance", "(h\\\\d+)")')
+        hosts = sorted(r.metric_name.get_label(b"host") for r in rows)
+        assert hosts == [b"h1", b"h2"]
+
+    def test_label_join(self, ec):
+        rows = exec_query(ec, 'label_join(mem_bytes, "ij", "-", "instance", "instance")')
+        assert rows[0].metric_name.get_label(b"ij") in (b"h1-h1", b"h2-h2")
+
+    def test_absent(self, ec):
+        rows = exec_query(ec, "absent(nope_metric)")
+        np.testing.assert_allclose(rows[0].values, 1.0)
+        assert exec_query(ec, "absent(mem_bytes)") == []
+
+    def test_clamp(self, ec):
+        rows = exec_query(ec, "clamp(mem_bytes, 150, 202)")
+        m = np.vstack([r.values for r in rows])
+        assert m.min() >= 150 and m.max() <= 202
+
+    def test_time_and_timestamp(self, ec):
+        rows = exec_query(ec, "time()")
+        np.testing.assert_allclose(rows[0].values[0], T0 / 1e3)
+        rows = exec_query(ec, "timestamp(mem_bytes)")
+        assert rows[0].values[-1] <= END / 1e3
+
+    def test_scalar_vector_roundtrip(self, ec):
+        rows = exec_query(ec, "vector(scalar(sum(mem_bytes)))")
+        assert len(rows) == 1
+
+    def test_sort_and_running(self, ec):
+        rows = exec_query(ec, "sort_desc(mem_bytes)")
+        assert rows[0].metric_name.get_label(b"instance") == b"h2"
+        rows = exec_query(ec, "running_max(mem_bytes)")
+        for r in rows:
+            assert (np.diff(r.values) >= 0).all()
+
+    def test_interpolate_and_keep_last(self, ec):
+        rows = exec_query(ec, "interpolate(mem_bytes)")
+        assert not np.isnan(rows[0].values).any()
+
+    def test_round_nearest(self, ec):
+        rows = exec_query(ec, "round(mem_bytes, 100)")
+        assert set(np.unique(rows[0].values)) <= {100.0, 200.0}
+
+    def test_union(self, ec):
+        rows = exec_query(ec, "union(mem_bytes, rate(http_requests_total[5m]))")
+        assert len(rows) == 5
+
+    def test_day_funcs(self, ec):
+        rows = exec_query(ec, "hour()")
+        assert 0 <= rows[0].values[0] <= 23
+
+
+class TestErrors:
+    def test_unknown_function(self, ec):
+        with pytest.raises(QueryError):
+            exec_query(ec, "frobnicate(mem_bytes)")
+
+    def test_unknown_aggregate_parses_as_func(self, ec):
+        with pytest.raises(QueryError):
+            exec_query(ec, "supersum(mem_bytes)")
+
+    def test_instant(self, ec):
+        rows = exec_instant(ec, "sum(mem_bytes)", T0 + 5 * STEP)
+        assert len(rows) == 1 and rows[0].values.size == 1

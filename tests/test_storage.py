@@ -329,3 +329,57 @@ class TestConcurrency:
                               T0, T0 + 100_000)
         assert len(res) == 80
         s.close()
+
+
+class TestReviewRegressions:
+    def test_metric_id_with_zero_bytes_in_tag_scan(self, tmp_path):
+        # metric ids whose BE encoding contains 0x00 must parse in value scans
+        from victoriametrics_tpu.storage.index_db import IndexDB
+        from victoriametrics_tpu.storage.tsid import TSID
+        idb = IndexDB(str(tmp_path / "idb"))
+        mn = MetricName.from_dict({"__name__": "m", "k": "v"})
+        tsid = TSID(1, 2, 3, 256)  # BE bytes contain 0x00 and end 0x01 0x00
+        idb.create_indexes_for_metric(mn, tsid)
+        vals = list(idb._iter_tag_values(b"k"))
+        assert vals == [(b"v", 256)]
+        assert idb.label_values("k") == ["v"]
+        idb.close()
+
+    def test_regex_group_with_suffix_not_misexpanded(self, tmp_path):
+        tf = TagFilter(b"x", b"(a|b)c", regex=True)
+        assert tf.or_values is None  # falls back to real regex
+        assert tf.match_value(b"ac") and tf.match_value(b"bc")
+        assert not tf.match_value(b"a|bc")
+
+    def test_label_apis_time_scoped(self, tmp_path):
+        s = mk_storage(tmp_path)
+        day = 86_400_000
+        s.add_rows([({"__name__": "old", "gen": "0"}, T0 - 30 * day, 1.0),
+                    ({"__name__": "new", "gen": "1"}, T0, 2.0)])
+        s.force_flush()
+        assert s.label_values("__name__", T0 - 3600_000, T0) == ["new"]
+        assert set(s.label_values("__name__")) == {"new", "old"}
+        assert "gen" in s.label_names(T0 - 3600_000, T0)
+        s.close()
+
+    def test_listed_unopenable_part_kept(self, tmp_path):
+        s = mk_storage(tmp_path)
+        write_sample_data(s, n_series=2, n_samples=3)
+        s.force_flush()
+        s.close()
+        # corrupt a listed part's metadata -> open fails but dir must survive
+        import glob, json
+        parts = glob.glob(str(tmp_path / "s" / "data" / "*" / "p_*"))
+        assert parts
+        victim = parts[0]
+        meta = os.path.join(victim, "metadata.json")
+        orig = open(meta).read()
+        open(meta, "w").write("{broken")
+        s2 = mk_storage(tmp_path)
+        assert os.path.isdir(victim)  # not rmtree'd
+        s2.close()
+        open(meta, "w").write(orig)  # heal; data readable again
+        s3 = mk_storage(tmp_path)
+        assert len(s3.search_series(filters_from_dict({"__name__": "cpu_usage"}),
+                                    T0, T0 + 10_000_000)) == 1
+        s3.close()

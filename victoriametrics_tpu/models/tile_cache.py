@@ -71,6 +71,9 @@ class TileCache:
             lambda a: chunked_device_put(np.asarray(a), self.device), host_tree)
         size = self._tree_bytes(dev_tree)
         if size > self.capacity:
+            # too big to retain — but a stale entry under this key must not
+            # keep serving old data
+            self.invalidate(key)
             return dev_tree
         with self._lock:
             if key in self._entries:

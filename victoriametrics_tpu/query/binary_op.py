@@ -1,0 +1,221 @@
+"""Binary operators with vector matching (reference
+app/vmselect/promql/binary_op.go:15-205).
+
+Arithmetic, comparison (filtering or bool), set ops (and/or/unless), and the
+MetricsQL extensions default/if/ifnot. Matching: one-to-one by full label
+signature (minus metric name) or on()/ignoring(); many-to-one via
+group_left/group_right with optional label copying.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..storage.metric_name import MetricName
+from .types import Timeseries
+
+nan = np.nan
+
+
+def _arith(fn):
+    def wrapped(a, b):
+        with np.errstate(all="ignore"):
+            return fn(a, b)
+    return wrapped
+
+
+ARITH_OPS = {
+    "+": _arith(lambda a, b: a + b),
+    "-": _arith(lambda a, b: a - b),
+    "*": _arith(lambda a, b: a * b),
+    "/": _arith(lambda a, b: a / b),
+    "%": _arith(lambda a, b: np.fmod(a, b)),
+    "^": _arith(lambda a, b: np.power(a, b)),
+    "atan2": _arith(lambda a, b: np.arctan2(a, b)),
+}
+
+CMP_OPS = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    ">": lambda a, b: a > b,
+    "<": lambda a, b: a < b,
+    ">=": lambda a, b: a >= b,
+    "<=": lambda a, b: a <= b,
+}
+
+SET_OPS = {"and", "or", "unless", "default", "if", "ifnot"}
+
+
+def signature(mn: MetricName, on: list[str] | None, ignoring: list[str] | None
+              ) -> tuple:
+    """Label signature for matching (metric name excluded unless on() lists
+    __name__)."""
+    if on is not None:
+        keys = set(on)
+        items = []
+        for k in sorted(keys):
+            kb = k.encode()
+            if kb == b"__name__":
+                items.append((kb, mn.metric_group))
+            else:
+                v = mn.get_label(kb)
+                items.append((kb, v or b""))
+        return tuple(items)
+    ig = {k.encode() for k in (ignoring or [])}
+    return tuple((k, v) for k, v in mn.labels if k not in ig)
+
+
+def _result_labels(left_mn: MetricName, keep_name: bool) -> MetricName:
+    return MetricName(left_mn.metric_group if keep_name else b"",
+                      list(left_mn.labels))
+
+
+def eval_binary_op(op: str, left: list[Timeseries], right: list[Timeseries],
+                   bool_modifier: bool, group_mod, join_mod,
+                   keep_metric_names: bool, is_cmp_with_scalar_right=None
+                   ) -> list[Timeseries]:
+    on = group_mod.args if group_mod.op == "on" else None
+    ignoring = group_mod.args if group_mod.op == "ignoring" else None
+
+    if op in SET_OPS:
+        return _eval_set_op(op, left, right, on, ignoring)
+
+    is_cmp = op in CMP_OPS
+    fn = CMP_OPS[op] if is_cmp else ARITH_OPS[op]
+
+    swap = join_mod.op == "group_left"
+    # group_left: many on the LEFT match one on the right; group_right is the
+    # mirror. We normalize to "many" and "one" sides.
+    if join_mod.op == "group_right":
+        many, one = right, left
+    elif join_mod.op == "group_left":
+        many, one = left, right
+    else:
+        many = one = None
+
+    out: list[Timeseries] = []
+    if many is not None:
+        one_by_sig: dict[tuple, Timeseries] = {}
+        for ts in one:
+            sig = signature(ts.metric_name, on, ignoring)
+            if sig in one_by_sig:
+                raise ValueError(
+                    f"duplicate series on the 'one' side of {op} "
+                    f"{join_mod.op} for {ts.metric_name}")
+            one_by_sig[sig] = ts
+        extra = [l.encode() for l in join_mod.args]
+        for m_ts in many:
+            o_ts = one_by_sig.get(signature(m_ts.metric_name, on, ignoring))
+            if o_ts is None:
+                continue
+            lv, rv = (m_ts.values, o_ts.values)
+            a, b = (lv, rv) if join_mod.op == "group_left" else (rv, lv)
+            vals = _apply(fn, a, b, is_cmp, bool_modifier,
+                          keep_left=m_ts.values)
+            mn = _result_labels(m_ts.metric_name,
+                                keep_metric_names or (is_cmp and not bool_modifier))
+            for lab in extra:
+                v = o_ts.metric_name.get_label(lab)
+                mn.labels = [(k, x) for k, x in mn.labels if k != lab]
+                if v:
+                    mn.labels.append((lab, v))
+            mn.sort_labels()
+            out.append(Timeseries(mn, vals))
+        return out
+
+    right_by_sig: dict[tuple, Timeseries] = {}
+    for ts in right:
+        sig = signature(ts.metric_name, on, ignoring)
+        if sig in right_by_sig:
+            raise ValueError(f"duplicate series on right side of {op}: "
+                             f"{ts.metric_name}")
+        right_by_sig[sig] = ts
+    seen = set()
+    for l_ts in left:
+        sig = signature(l_ts.metric_name, on, ignoring)
+        r_ts = right_by_sig.get(sig)
+        if r_ts is None:
+            continue
+        if sig in seen:
+            raise ValueError(f"duplicate series on left side of {op}")
+        seen.add(sig)
+        vals = _apply(fn, l_ts.values, r_ts.values, is_cmp, bool_modifier,
+                      keep_left=l_ts.values)
+        mn = _result_labels(l_ts.metric_name,
+                            keep_metric_names or (is_cmp and not bool_modifier))
+        if on is not None:
+            keep = {k.encode() for k in on}
+            mn.labels = [(k, v) for k, v in mn.labels if k in keep]
+            if b"__name__" not in keep:
+                mn.metric_group = b""
+        out.append(Timeseries(mn, vals))
+    return out
+
+
+def _apply(fn, a, b, is_cmp, bool_modifier, keep_left):
+    if not is_cmp:
+        return np.asarray(fn(a, b), dtype=np.float64)
+    with np.errstate(all="ignore"):
+        m = fn(a, b)
+    m = m & ~np.isnan(a) & ~np.isnan(b)
+    if bool_modifier:
+        out = m.astype(np.float64)
+        out[np.isnan(a) | np.isnan(b)] = nan
+        return out
+    return np.where(m, keep_left, nan)
+
+
+def _eval_set_op(op, left, right, on, ignoring):
+    right_sigs = {}
+    for ts in right:
+        right_sigs.setdefault(signature(ts.metric_name, on, ignoring), ts)
+    out = []
+    if op == "and":
+        for ts in left:
+            r = right_sigs.get(signature(ts.metric_name, on, ignoring))
+            if r is not None:
+                vals = np.where(np.isnan(r.values), nan, ts.values)
+                out.append(Timeseries(ts.metric_name, vals))
+        return out
+    if op == "unless":
+        for ts in left:
+            r = right_sigs.get(signature(ts.metric_name, on, ignoring))
+            if r is None:
+                out.append(ts)
+            else:
+                vals = np.where(np.isnan(r.values), ts.values, nan)
+                out.append(Timeseries(ts.metric_name, vals))
+        return out
+    if op == "or":
+        left_sigs = {signature(ts.metric_name, on, ignoring) for ts in left}
+        out = list(left)
+        for ts in right:
+            if signature(ts.metric_name, on, ignoring) not in left_sigs:
+                out.append(ts)
+        return out
+    if op == "default":
+        for ts in left:
+            r = right_sigs.get(signature(ts.metric_name, on, ignoring))
+            if r is None:
+                out.append(ts)
+            else:
+                vals = np.where(np.isnan(ts.values), r.values, ts.values)
+                out.append(Timeseries(ts.metric_name, vals))
+        return out
+    if op == "if":
+        for ts in left:
+            r = right_sigs.get(signature(ts.metric_name, on, ignoring))
+            if r is not None:
+                vals = np.where(np.isnan(r.values), nan, ts.values)
+                out.append(Timeseries(ts.metric_name, vals))
+        return out
+    if op == "ifnot":
+        for ts in left:
+            r = right_sigs.get(signature(ts.metric_name, on, ignoring))
+            if r is None:
+                out.append(ts)
+            else:
+                vals = np.where(np.isnan(r.values), ts.values, nan)
+                out.append(Timeseries(ts.metric_name, vals))
+        return out
+    raise ValueError(f"unknown set op {op}")

@@ -1,0 +1,573 @@
+"""The MetricsQL evaluator (reference app/vmselect/promql/eval.go:279-1900).
+
+Walks the AST producing lists of Timeseries on the shared output grid.
+Rollups fetch raw samples from storage and window them (oracle/NumPy host
+path; the TPU fast path in tpu_engine.py takes over for supported
+aggr(rollup(selector)) shapes when EvalConfig.tpu is set).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.rollup_np import RollupConfig
+from ..storage.metric_name import MetricName
+from ..storage.tag_filters import TagFilter
+from .aggr_funcs import (PER_SERIES, SIMPLE, a_quantile, series_rank_metric,
+                         topk_mask_per_ts)
+from .binary_op import ARITH_OPS, CMP_OPS, eval_binary_op
+from .metricsql.ast import (AggrFuncExpr, BinaryOpExpr, DurationExpr, Expr,
+                            FuncExpr, LabelFilter, MetricExpr, NumberExpr,
+                            RollupExpr, StringExpr)
+from .rollup_funcs import (GENERIC_FUNCS, KEEP_METRIC_NAMES, MULTI_FUNCS,
+                           ORACLE_FUNCS, ROLLUP_FUNC_NAMES, rollup_series)
+from .transform_funcs import TRANSFORM_FUNCS
+from .types import EvalConfig, Timeseries, const_series, new_series
+
+nan = np.nan
+
+
+class QueryError(ValueError):
+    pass
+
+
+def filters_from_metric_expr(me: MetricExpr) -> list[TagFilter]:
+    out = []
+    for f in me.label_filters:
+        key = b"" if f.label == "__name__" else f.label.encode()
+        out.append(TagFilter(key, f.value.encode(), negate=f.is_negative,
+                             regex=f.is_regexp))
+    return out
+
+
+def is_scalar_expr(e: Expr) -> bool:
+    if isinstance(e, (NumberExpr, DurationExpr)):
+        return True
+    if isinstance(e, FuncExpr) and e.name in ("time", "now", "step", "start",
+                                              "end", "pi", "e", "scalar",
+                                              "rand", "rand_normal",
+                                              "rand_exponential"):
+        return True
+    if isinstance(e, BinaryOpExpr) and e.op in ARITH_OPS:
+        return is_scalar_expr(e.left) and is_scalar_expr(e.right)
+    return False
+
+
+def eval_expr(ec: EvalConfig, e: Expr) -> list[Timeseries]:
+    if isinstance(e, NumberExpr):
+        return [const_series(ec, e.value)]
+    if isinstance(e, DurationExpr):
+        return [const_series(ec, e.value_ms(ec.step) / 1e3)]
+    if isinstance(e, StringExpr):
+        raise QueryError("string literal is not a valid expression here")
+    if isinstance(e, MetricExpr):
+        re_ = RollupExpr(expr=e)
+        return _eval_rollup_expr(ec, "default_rollup", re_, ())
+    if isinstance(e, RollupExpr):
+        return _eval_rollup_expr(ec, "default_rollup", e, ())
+    if isinstance(e, FuncExpr):
+        return _eval_func(ec, e)
+    if isinstance(e, AggrFuncExpr):
+        return _eval_aggr(ec, e)
+    if isinstance(e, BinaryOpExpr):
+        return _eval_binary(ec, e)
+    raise QueryError(f"cannot evaluate {type(e).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Functions
+# ---------------------------------------------------------------------------
+
+def _eval_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
+    name = fe.name
+    if name in ROLLUP_FUNC_NAMES:
+        return _eval_rollup_func(ec, fe)
+    tf = TRANSFORM_FUNCS.get(name)
+    if tf is None:
+        raise QueryError(f"unknown function {name!r}")
+    args = []
+    for a in fe.args:
+        if isinstance(a, StringExpr):
+            args.append(a.value)
+        elif is_scalar_expr(a):
+            args.append(float(eval_expr(ec, a)[0].values[0]))
+        else:
+            args.append(eval_expr(ec, a))
+    out = tf(ec, args)
+    if fe.keep_metric_names:
+        srcs = [a for a in args if isinstance(a, list)]
+        if srcs and len(srcs[0]) == len(out):
+            for ts, src in zip(out, srcs[0]):
+                ts.metric_name.metric_group = src.metric_name.metric_group
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rollups
+# ---------------------------------------------------------------------------
+
+def _find_rollup_arg_idx(fe: FuncExpr) -> int:
+    spec = GENERIC_FUNCS.get(fe.name)
+    if spec is not None and spec[0] is not None:
+        return spec[2]
+    if fe.name in ("quantiles_over_time",):
+        return len(fe.args) - 1
+    if fe.name in ("aggr_over_time",):
+        return len(fe.args) - 1
+    return 0
+
+
+def _eval_rollup_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
+    if not fe.args:
+        raise QueryError(f"{fe.name} needs arguments")
+    ridx = _find_rollup_arg_idx(fe)
+    if ridx >= len(fe.args):
+        raise QueryError(f"{fe.name}: missing rollup argument")
+    rarg = fe.args[ridx]
+    if isinstance(rarg, MetricExpr):
+        rarg = RollupExpr(expr=rarg)
+    elif not isinstance(rarg, RollupExpr):
+        rarg = RollupExpr(expr=rarg)  # subquery over inner expr
+
+    # extra scalar/string args (quantile phi, predict_linear t, ...)
+    extra = []
+    for i, a in enumerate(fe.args):
+        if i == ridx:
+            continue
+        if isinstance(a, StringExpr):
+            extra.append(a.value)
+        else:
+            extra.append(float(eval_expr(ec, a)[0].values[0]))
+
+    if fe.name == "aggr_over_time":
+        funcs = [a for a in extra if isinstance(a, str)]
+        out = []
+        for f in funcs:
+            sub = _eval_rollup_expr(ec, f, rarg, ())
+            for ts in sub:
+                ts.metric_name.labels.append((b"rollup", f.encode()))
+                ts.metric_name.sort_labels()
+            out.extend(sub)
+        return out
+
+    if fe.name == "quantiles_over_time":
+        dst_label = extra[0] if extra and isinstance(extra[0], str) else "phi"
+        phis = [a for a in extra if isinstance(a, float)]
+        out = []
+        for phi in phis:
+            sub = _eval_rollup_expr(ec, "quantile_over_time", rarg, (phi,),
+                                    keep_name=True)
+            for ts in sub:
+                ts.metric_name.labels.append(
+                    (dst_label.encode(), repr(phi).encode()))
+                ts.metric_name.sort_labels()
+            out.extend(sub)
+        return out
+
+    if fe.name in MULTI_FUNCS:
+        base = {"rollup": "default_rollup", "rollup_rate": "rate",
+                "rollup_increase": "increase", "rollup_delta": "delta",
+                "rollup_deriv": "deriv_fast",
+                "rollup_scrape_interval": "scrape_interval"}
+        out = []
+        if fe.name in ("rollup", "rollup_candlestick"):
+            tags = MULTI_FUNCS[fe.name]
+            for tag, func in tags:
+                sub = _eval_rollup_expr(ec, func, rarg, (),
+                                        keep_name=fe.name in KEEP_METRIC_NAMES)
+                for ts in sub:
+                    ts.metric_name.labels.append((b"rollup", tag.encode()))
+                    ts.metric_name.sort_labels()
+                out.extend(sub)
+            return out
+        # min/max/avg over the base func computed at each point: approximate
+        # by computing the base func and tagging avg=min=max (single sample
+        # per window on the host path). Full per-window spreads arrive with
+        # the device path.
+        func = base[fe.name]
+        for tag in ("min", "max", "avg"):
+            sub = _eval_rollup_expr(ec, func, rarg, ())
+            for ts in sub:
+                ts.metric_name.labels.append((b"rollup", tag.encode()))
+                ts.metric_name.sort_labels()
+            out.extend(sub)
+        return out
+
+    keep = fe.keep_metric_names or fe.name in KEEP_METRIC_NAMES
+    return _eval_rollup_expr(ec, fe.name, rarg, tuple(extra), keep_name=keep)
+
+
+def _eval_at(ec: EvalConfig, at_expr: Expr) -> int:
+    v = float(eval_expr(ec, at_expr)[0].values[0])
+    return int(v * 1e3)
+
+
+def _eval_rollup_expr(ec: EvalConfig, func: str, re_: RollupExpr,
+                      args: tuple, keep_name: bool | None = None
+                      ) -> list[Timeseries]:
+    if keep_name is None:
+        keep_name = func in KEEP_METRIC_NAMES
+    offset = re_.offset.value_ms(ec.step) if re_.offset is not None else 0
+    window = re_.window.value_ms(ec.step) if re_.window is not None else 0
+
+    at_ts = _eval_at(ec, re_.at) if re_.at is not None else None
+    if at_ts is not None:
+        # evaluate at the fixed timestamp, then broadcast over the grid
+        sub_ec = ec.child(start=at_ts, end=at_ts, step=ec.step)
+        rows = _eval_rollup_expr(sub_ec, func,
+                                 RollupExpr(expr=re_.expr, window=re_.window,
+                                            step=re_.step,
+                                            inherit_step=re_.inherit_step,
+                                            offset=re_.offset),
+                                 args, keep_name)
+        T = ec.n_points
+        return [Timeseries(ts.metric_name,
+                           np.full(T, ts.values[0]))
+                for ts in rows]
+
+    if isinstance(re_.expr, MetricExpr) and not re_.needs_subquery():
+        return _rollup_from_storage(ec, func, re_, window, offset, args,
+                                    keep_name)
+    return _rollup_subquery(ec, func, re_, window, offset, args, keep_name)
+
+
+def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
+                         window: int, offset: int, args: tuple,
+                         keep_name: bool) -> list[Timeseries]:
+    me: MetricExpr = re_.expr
+    if me.is_empty():
+        return []
+    if ec.storage is None:
+        raise QueryError("no storage attached to the query engine")
+    lookback = window if window > 0 else (
+        ec.lookback_delta if func == "default_rollup" else ec.step)
+    start = ec.start - offset
+    end = ec.end - offset
+    fetch_lo = start - lookback - ec.lookback_delta
+    filters = filters_from_metric_expr(me)
+    series = ec.storage.search_series(filters, fetch_lo, end,
+                                      max_series=ec.max_series)
+    cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
+
+    if ec.tpu is not None:
+        from .tpu_engine import try_rollup_tpu
+        got = try_rollup_tpu(ec.tpu, func, series, cfg, args)
+        if got is not None:
+            return _finish_rollup(series, got, keep_name)
+
+    out_rows = []
+    for sd in series:
+        vals = rollup_series(func, sd.timestamps, sd.values, cfg, args)
+        out_rows.append(vals)
+    return _finish_rollup(series, out_rows, keep_name)
+
+
+def _finish_rollup(series, rows, keep_name: bool) -> list[Timeseries]:
+    out = []
+    for sd, vals in zip(series, rows):
+        mn = MetricName(sd.metric_name.metric_group if keep_name else b"",
+                        list(sd.metric_name.labels))
+        out.append(Timeseries(mn, np.asarray(vals, dtype=np.float64)))
+    return out
+
+
+def _rollup_subquery(ec: EvalConfig, func: str, re_: RollupExpr, window: int,
+                     offset: int, args: tuple, keep_name: bool
+                     ) -> list[Timeseries]:
+    sub_step = (re_.step.value_ms(ec.step) if re_.step is not None
+                else ec.step)
+    if sub_step <= 0:
+        raise QueryError("subquery step must be positive")
+    lookback = window if window > 0 else ec.step
+    start = ec.start - offset
+    end = ec.end - offset
+    sub_start = start - lookback
+    # align the inner grid to sub_step like Prometheus subqueries
+    sub_start -= sub_start % sub_step
+    inner_ec = ec.child(start=sub_start, end=end, step=sub_step)
+    inner = eval_expr(inner_ec, re_.expr)
+    grid = inner_ec.timestamps()
+    cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
+    out = []
+    for ts in inner:
+        ok = ~np.isnan(ts.values)
+        s_ts = grid[ok]
+        s_vals = ts.values[ok]
+        if s_ts.size == 0:
+            continue
+        vals = rollup_series(func, s_ts, s_vals, cfg, args)
+        mn = MetricName(ts.metric_name.metric_group if keep_name else b"",
+                        list(ts.metric_name.labels))
+        out.append(Timeseries(mn, vals))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Aggregates
+# ---------------------------------------------------------------------------
+
+def _group_key(mn: MetricName, grouping: list[bytes], without: bool) -> bytes:
+    if without:
+        kept = [(k, v) for k, v in mn.labels if k not in grouping]
+        return MetricName(b"", kept).marshal()
+    kept = []
+    for g in grouping:
+        if g == b"__name__":
+            continue
+        v = mn.get_label(g)
+        if v is not None:
+            kept.append((g, v))
+    return MetricName(b"", sorted(kept)).marshal()
+
+
+def _group_series(series: list[Timeseries], grouping: list[str],
+                  without: bool):
+    gb = [g.encode() for g in grouping]
+    groups: dict[bytes, list[Timeseries]] = {}
+    names: dict[bytes, MetricName] = {}
+    for ts in series:
+        key = _group_key(ts.metric_name, gb, without)
+        groups.setdefault(key, []).append(ts)
+        if key not in names:
+            names[key] = MetricName.unmarshal(key)
+    return groups, names
+
+
+def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
+    name = ae.name
+
+    # arg layouts
+    if name in ("topk", "bottomk", "limitk", "outliersk") or \
+            name.startswith(("topk_", "bottomk_")):
+        if len(ae.args) != 2:
+            raise QueryError(f"{name} needs (k, q)")
+        k = float(eval_expr(ec, ae.args[0])[0].values[0])
+        series = eval_expr(ec, ae.args[1])
+        return _eval_topk_family(ec, ae, name, k, series)
+    if name == "quantile":
+        phi = float(eval_expr(ec, ae.args[0])[0].values[0])
+        series = eval_expr(ec, ae.args[1])
+        return _simple_aggr(ec, ae, series,
+                            lambda m: a_quantile(m, phi))
+    if name == "quantiles":
+        dst = ae.args[0]
+        if not isinstance(dst, StringExpr):
+            raise QueryError("quantiles needs a label name first")
+        phis = [float(eval_expr(ec, a)[0].values[0]) for a in ae.args[1:-1]]
+        series = eval_expr(ec, ae.args[-1])
+        out = []
+        for phi in phis:
+            rows = _simple_aggr(ec, ae, series, lambda m: a_quantile(m, phi))
+            for ts in rows:
+                ts.metric_name.labels.append(
+                    (dst.value.encode(), repr(phi).encode()))
+                ts.metric_name.sort_labels()
+            out.extend(rows)
+        return out
+    if name == "count_values":
+        dst = ae.args[0]
+        if not isinstance(dst, StringExpr):
+            raise QueryError("count_values needs a label name first")
+        series = eval_expr(ec, ae.args[1])
+        return _eval_count_values(ec, ae, dst.value, series)
+    if name in ("share", "zscore"):
+        series = eval_expr(ec, ae.args[0])
+        return _eval_per_series(ec, ae, PER_SERIES[name], series)
+    if name in ("mad", "iqr"):
+        series = eval_expr(ec, ae.args[0])
+        def mad_fn(m):
+            med = np.nanmedian(m, axis=0)
+            return np.nanmedian(np.abs(m - med), axis=0)
+        def iqr_fn(m):
+            lo, hi = np.nanquantile(m, [0.25, 0.75], axis=0)
+            return hi - lo
+        with np.errstate(all="ignore"):
+            return _simple_aggr(ec, ae, series,
+                                mad_fn if name == "mad" else iqr_fn)
+    if name == "outliers_mad":
+        tol = float(eval_expr(ec, ae.args[0])[0].values[0])
+        series = eval_expr(ec, ae.args[1])
+        return _eval_outliers_mad(ec, ae, tol, series)
+    if name == "outliers_iqr":
+        series = eval_expr(ec, ae.args[0])
+        return _eval_outliers_iqr(ec, ae, series)
+
+    series = [ts for a in ae.args for ts in eval_expr(ec, a)]
+    fn = SIMPLE.get(name)
+    if fn is None:
+        raise QueryError(f"unknown aggregate {name!r}")
+    return _simple_aggr(ec, ae, series, fn)
+
+
+def _simple_aggr(ec, ae, series, fn) -> list[Timeseries]:
+    groups, names = _group_series(series, ae.grouping, ae.without)
+    out = []
+    for key, rows in groups.items():
+        m = np.vstack([ts.values for ts in rows])
+        vals = fn(m)
+        out.append(Timeseries(names[key], np.asarray(vals, dtype=np.float64)))
+    out.sort(key=lambda ts: ts.metric_name.marshal())
+    if ae.limit and len(out) > ae.limit:
+        out = out[:ae.limit]
+    return out
+
+
+def _eval_per_series(ec, ae, fn, series) -> list[Timeseries]:
+    groups, _ = _group_series(series, ae.grouping, ae.without)
+    out = []
+    for key, rows in groups.items():
+        m = np.vstack([ts.values for ts in rows])
+        res = fn(m)
+        for i, ts in enumerate(rows):
+            out.append(Timeseries(MetricName(b"", list(ts.metric_name.labels)),
+                                  res[i]))
+    return out
+
+
+def _eval_topk_family(ec, ae, name, k, series) -> list[Timeseries]:
+    groups, _ = _group_series(series, ae.grouping, ae.without)
+    out = []
+    bottom = name.startswith("bottomk")
+    for key, rows in groups.items():
+        m = np.vstack([ts.values for ts in rows])
+        if name in ("topk", "bottomk"):
+            mask = topk_mask_per_ts(m, int(k), bottom)
+            for i, ts in enumerate(rows):
+                vals = np.where(mask[i], ts.values, nan)
+                if not np.isnan(vals).all():
+                    out.append(Timeseries(ts.metric_name, vals))
+        elif name == "limitk":
+            import xxhash
+            ranked = sorted(rows, key=lambda ts: xxhash.xxh64_intdigest(
+                ts.metric_name.marshal()))
+            out.extend(ranked[:int(k)])
+        elif name == "outliersk":
+            med = np.nanmedian(m, axis=0)
+            dev = np.nansum(np.abs(m - med), axis=1)
+            order = np.argsort(-dev)
+            for i in order[:int(k)]:
+                out.append(rows[i])
+        else:
+            kind = name.split("_", 1)[1]
+            rank = series_rank_metric(kind, m)
+            rank = np.where(np.isnan(rank), -np.inf if not bottom else np.inf,
+                            rank)
+            order = np.argsort(rank)
+            sel = order[:int(k)] if bottom else order[::-1][:int(k)]
+            for i in sel:
+                out.append(rows[i])
+    return out
+
+
+def _eval_count_values(ec, ae, dst_label, series) -> list[Timeseries]:
+    groups, names = _group_series(series, ae.grouping, ae.without)
+    out = []
+    for key, rows in groups.items():
+        m = np.vstack([ts.values for ts in rows])
+        uniq = np.unique(m[~np.isnan(m)])
+        for u in uniq:
+            cnt = np.nansum(np.where(m == u, 1.0, 0.0), axis=0)
+            cnt = np.where(cnt > 0, cnt, nan)
+            mn = MetricName(b"", list(names[key].labels))
+            sval = repr(float(u))
+            if float(u) == int(u) and abs(u) < 1e15:
+                sval = str(int(u))
+            mn.labels.append((dst_label.encode(), sval.encode()))
+            mn.sort_labels()
+            out.append(Timeseries(mn, cnt))
+    return out
+
+
+def _eval_outliers_mad(ec, ae, tolerance, series) -> list[Timeseries]:
+    groups, _ = _group_series(series, ae.grouping, ae.without)
+    out = []
+    for key, rows in groups.items():
+        m = np.vstack([ts.values for ts in rows])
+        with np.errstate(all="ignore"):
+            med = np.nanmedian(m, axis=0)
+            mad = np.nanmedian(np.abs(m - med), axis=0)
+        for i, ts in enumerate(rows):
+            with np.errstate(all="ignore"):
+                if np.any(np.abs(ts.values - med) > tolerance * mad):
+                    out.append(ts)
+    return out
+
+
+def _eval_outliers_iqr(ec, ae, series) -> list[Timeseries]:
+    groups, _ = _group_series(series, ae.grouping, ae.without)
+    out = []
+    for key, rows in groups.items():
+        m = np.vstack([ts.values for ts in rows])
+        with np.errstate(all="ignore"):
+            q25, q75 = np.nanquantile(m, [0.25, 0.75], axis=0)
+            iqr = q75 - q25
+            lo, hi = q25 - 1.5 * iqr, q75 + 1.5 * iqr
+        for i, ts in enumerate(rows):
+            with np.errstate(all="ignore"):
+                if np.any((ts.values < lo) | (ts.values > hi)):
+                    out.append(ts)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Binary ops
+# ---------------------------------------------------------------------------
+
+def _eval_binary(ec: EvalConfig, be: BinaryOpExpr) -> list[Timeseries]:
+    l_scalar = is_scalar_expr(be.left)
+    r_scalar = is_scalar_expr(be.right)
+    left = eval_expr(ec, be.left)
+    right = eval_expr(ec, be.right)
+
+    if be.op in ARITH_OPS or be.op in CMP_OPS:
+        if l_scalar and r_scalar:
+            a, b = left[0].values, right[0].values
+            if be.op in ARITH_OPS:
+                return [new_series(ARITH_OPS[be.op](a, b))]
+            m = CMP_OPS[be.op](a, b)
+            if be.bool_modifier:
+                return [new_series(m.astype(np.float64))]
+            return [new_series(np.where(m, a, nan))]
+        if r_scalar:
+            b = right[0].values
+            return _scalar_side(be, left, b, scalar_on_left=False)
+        if l_scalar:
+            a = left[0].values
+            return _scalar_side(be, right, a, scalar_on_left=True)
+
+    if be.op == "default" and r_scalar:
+        b = right[0].values
+        out = []
+        for ts in left:
+            vals = np.where(np.isnan(ts.values), b, ts.values)
+            out.append(Timeseries(ts.metric_name, vals))
+        return out
+
+    return eval_binary_op(be.op, left, right, be.bool_modifier,
+                          be.group_modifier, be.join_modifier,
+                          be.keep_metric_names)
+
+
+def _scalar_side(be: BinaryOpExpr, vec: list[Timeseries], s: np.ndarray,
+                 scalar_on_left: bool) -> list[Timeseries]:
+    out = []
+    is_cmp = be.op in CMP_OPS
+    for ts in vec:
+        a, b = (s, ts.values) if scalar_on_left else (ts.values, s)
+        if is_cmp:
+            with np.errstate(all="ignore"):
+                m = CMP_OPS[be.op](a, b)
+            m = m & ~np.isnan(ts.values)
+            if be.bool_modifier:
+                vals = m.astype(np.float64)
+                vals[np.isnan(ts.values)] = nan
+            else:
+                vals = np.where(m, ts.values, nan)
+            keep = True  # comparisons keep names on scalar compare
+        else:
+            vals = ARITH_OPS[be.op](a, b)
+            keep = be.keep_metric_names
+        mn = MetricName(ts.metric_name.metric_group if keep else b"",
+                        list(ts.metric_name.labels))
+        out.append(Timeseries(mn, np.asarray(vals, dtype=np.float64)))
+    return out

@@ -1,0 +1,54 @@
+"""Query execution top (reference app/vmselect/promql/exec.go:36): parse
+cache -> eval -> sorted results."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .eval import QueryError, eval_expr
+from .metricsql import parse
+from .metricsql.ast import Expr
+from .types import EvalConfig, Timeseries
+
+_parse_cache: dict[str, Expr] = {}
+_parse_lock = threading.Lock()
+_PARSE_CACHE_MAX = 10_000
+
+
+def parse_cached(q: str) -> Expr:
+    with _parse_lock:
+        e = _parse_cache.get(q)
+    if e is not None:
+        return e
+    e = parse(q)
+    with _parse_lock:
+        if len(_parse_cache) >= _PARSE_CACHE_MAX:
+            _parse_cache.clear()
+        _parse_cache[q] = e
+    return e
+
+
+_SORT_FUNCS = frozenset({
+    "sort", "sort_desc", "sort_by_label", "sort_by_label_desc",
+    "sort_by_label_numeric", "sort_by_label_numeric_desc", "limit_offset"})
+
+
+def exec_query(ec: EvalConfig, q: str) -> list[Timeseries]:
+    """Range query: returns series on the ec grid, sorted by labels unless
+    the top-level function imposes its own order (exec.go:80-100 analog)."""
+    expr = parse_cached(q)
+    rows = eval_expr(ec, expr)
+    # drop all-NaN series (absent everywhere)
+    out = [ts for ts in rows if not np.isnan(ts.values).all()]
+    from .metricsql.ast import FuncExpr
+    if not (isinstance(expr, FuncExpr) and expr.name in _SORT_FUNCS):
+        out.sort(key=lambda ts: ts.metric_name.marshal())
+    return out
+
+
+def exec_instant(ec_base: EvalConfig, q: str, ts_ms: int) -> list[Timeseries]:
+    """Instant query at ts_ms (single-point grid)."""
+    ec = ec_base.child(start=ts_ms, end=ts_ms)
+    return exec_query(ec, q)

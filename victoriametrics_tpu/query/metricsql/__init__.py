@@ -1,0 +1,7 @@
+from .ast import (AggrFuncExpr, BinaryOpExpr, DurationExpr, FuncExpr,
+                  MetricExpr, NumberExpr, RollupExpr, StringExpr)
+from .parser import parse, ParseError
+
+__all__ = ["parse", "ParseError", "AggrFuncExpr", "BinaryOpExpr",
+           "DurationExpr", "FuncExpr", "MetricExpr", "NumberExpr",
+           "RollupExpr", "StringExpr"]

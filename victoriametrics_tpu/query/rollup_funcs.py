@@ -1,0 +1,401 @@
+"""Rollup function registry (reference app/vmselect/promql/rollup.go:24-110,
+87 functions).
+
+Two tiers:
+- ORACLE_FUNCS: vectorized via ops/rollup_np (and the TPU kernels in
+  ops/device_rollup for the device path) — the hot subset.
+- GENERIC_FUNCS: per-window NumPy callables run by `generic_rollup`, covering
+  the long tail. Window signature: fn(w_vals, w_ts, prev_v, prev_t, t_end,
+  args) -> float, with NaN for "no value". Window = (t-d, t], prev = last
+  sample at or before the window start (doInternal semantics).
+
+Some functions yield multiple output series per input (rollup(),
+rollup_candlestick(), aggr_over_time(), quantiles_over_time()): these are
+MULTI_FUNCS and return [(label_tag, fn)] expansions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import rollup_np
+from ..ops.rollup_np import RollupConfig
+
+ORACLE_FUNCS = set(rollup_np.SUPPORTED)
+
+nan = float("nan")
+
+
+def _quantile(phi: float, vals: np.ndarray) -> float:
+    if vals.size == 0:
+        return nan
+    if phi < 0:
+        return -np.inf
+    if phi > 1:
+        return np.inf
+    return float(np.quantile(vals, phi))
+
+
+def _remove_resets(v: np.ndarray) -> np.ndarray:
+    return rollup_np.remove_counter_resets(v)
+
+
+# -- generic single-output windows ------------------------------------------
+
+def _w_quantile(w, t, pv, pt, te, args):
+    return _quantile(args[0], w)
+
+
+def _w_median(w, t, pv, pt, te, args):
+    return _quantile(0.5, w)
+
+
+def _w_mad(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    med = np.median(w)
+    return float(np.median(np.abs(w - med)))
+
+
+def _w_iqr(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    q25, q75 = np.quantile(w, [0.25, 0.75])
+    return float(q75 - q25)
+
+
+def _w_zscore(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    sd = w.std()
+    return float((w[-1] - w.mean()) / sd) if sd > 0 else nan
+
+
+def _w_range(w, t, pv, pt, te, args):
+    return float(w.max() - w.min()) if w.size else nan
+
+
+def _w_distinct(w, t, pv, pt, te, args):
+    return float(np.unique(w[~np.isnan(w)]).size) if w.size else nan
+
+
+def _w_geomean(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    return float(np.exp(np.log(np.abs(w) + 0.0).mean())) if (w > 0).all() \
+        else float(np.power(np.abs(np.prod(w)), 1.0 / w.size))
+
+
+def _w_sum2(w, t, pv, pt, te, args):
+    return float((w * w).sum()) if w.size else nan
+
+
+def _w_tmin(w, t, pv, pt, te, args):
+    return float(t[np.argmin(w)] / 1e3) if w.size else nan
+
+
+def _w_tmax(w, t, pv, pt, te, args):
+    return float(t[np.argmax(w)] / 1e3) if w.size else nan
+
+
+def _w_resets(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    seq = w if pv is None else np.concatenate([[pv], w])
+    return float((np.diff(seq) < 0).sum())
+
+
+def _w_increases(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    seq = w if pv is None else np.concatenate([[pv], w])
+    return float((np.diff(seq) > 0).sum())
+
+
+def _w_decreases(w, t, pv, pt, te, args):
+    return _w_resets(w, t, pv, pt, te, args)
+
+
+def _w_integrate(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    vs, ts_ = w, t
+    if pv is not None:
+        vs = np.concatenate([[pv], w])
+        ts_ = np.concatenate([[pt], t])
+    if vs.size < 2:
+        return 0.0
+    dt = np.diff(ts_) / 1e3
+    return float((vs[:-1] * dt).sum())
+
+
+def _w_rate_over_sum(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    dt = (t[-1] - (pt if pt is not None else t[0])) / 1e3
+    return float(w.sum() / dt) if dt > 0 else nan
+
+
+def _w_count_eq(w, t, pv, pt, te, args):
+    return float((w == args[0]).sum()) if w.size else nan
+
+
+def _w_count_ne(w, t, pv, pt, te, args):
+    return float((w != args[0]).sum()) if w.size else nan
+
+
+def _w_count_le(w, t, pv, pt, te, args):
+    return float((w <= args[0]).sum()) if w.size else nan
+
+
+def _w_count_gt(w, t, pv, pt, te, args):
+    return float((w > args[0]).sum()) if w.size else nan
+
+
+def _w_share_le(w, t, pv, pt, te, args):
+    return float((w <= args[0]).mean()) if w.size else nan
+
+
+def _w_share_gt(w, t, pv, pt, te, args):
+    return float((w > args[0]).mean()) if w.size else nan
+
+
+def _w_share_eq(w, t, pv, pt, te, args):
+    return float((w == args[0]).mean()) if w.size else nan
+
+
+def _w_sum_eq(w, t, pv, pt, te, args):
+    return float(w[w == args[0]].sum()) if w.size else nan
+
+
+def _w_sum_le(w, t, pv, pt, te, args):
+    return float(w[w <= args[0]].sum()) if w.size else nan
+
+
+def _w_sum_gt(w, t, pv, pt, te, args):
+    return float(w[w > args[0]].sum()) if w.size else nan
+
+
+def _w_predict_linear(w, t, pv, pt, te, args):
+    if w.size < 2:
+        return nan
+    t_s = (t - t[0]) / 1e3
+    n = t_s.size
+    st, sv = t_s.sum(), w.sum()
+    stt, stv = (t_s * t_s).sum(), (t_s * w).sum()
+    den = n * stt - st * st
+    if den == 0:
+        return nan
+    k = (n * stv - st * sv) / den
+    b = (sv - k * st) / n
+    dt = (te - t[0]) / 1e3 + args[0]
+    return float(k * dt + b)
+
+
+def _w_holt_winters(w, t, pv, pt, te, args):
+    sf, tf = args[0], args[1]
+    if w.size < 2 or not (0 < sf < 1) or not (0 < tf < 1):
+        return nan
+    s = w[0]
+    b = w[1] - w[0]
+    for x in w[1:]:
+        s_prev = s
+        s = sf * x + (1 - sf) * (s + b)
+        b = tf * (s - s_prev) + (1 - tf) * b
+    return float(s)
+
+
+def _w_mode(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    vals, counts = np.unique(w, return_counts=True)
+    return float(vals[np.argmax(counts)])
+
+
+def _w_ascent(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    seq = w if pv is None else np.concatenate([[pv], w])
+    d = np.diff(seq)
+    return float(d[d > 0].sum())
+
+
+def _w_descent(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    seq = w if pv is None else np.concatenate([[pv], w])
+    d = np.diff(seq)
+    return float(-d[d < 0].sum())
+
+
+def _w_changes_prometheus(w, t, pv, pt, te, args):
+    # strict Prometheus semantics: no prev-value continuity
+    if w.size == 0:
+        return nan
+    return float((np.diff(w) != 0).sum())
+
+
+def _w_delta_prometheus(w, t, pv, pt, te, args):
+    if w.size < 2:
+        return nan
+    return float(w[-1] - w[0])
+
+
+def _w_increase_prometheus(w, t, pv, pt, te, args):
+    if w.size < 2:
+        return nan
+    c = _remove_resets(w)
+    return float(c[-1] - c[0])
+
+
+def _w_ideriv(w, t, pv, pt, te, args):
+    if w.size >= 2:
+        dt = (t[-1] - t[-2]) / 1e3
+        return float((w[-1] - w[-2]) / dt) if dt > 0 else nan
+    if w.size == 1 and pv is not None:
+        dt = (t[-1] - pt) / 1e3
+        return float((w[-1] - pv) / dt) if dt > 0 else nan
+    return nan
+
+
+def _w_stale_samples(w, t, pv, pt, te, args):
+    from ..ops import decimal as dec
+    return float(dec.is_stale_nan(w).sum()) if w.size else nan
+
+
+def _w_duration_over_time(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    max_gap = args[0] * 1e3 if args else nan
+    d = np.diff(t).astype(np.float64)
+    if args:
+        d = d[d <= max_gap]
+    return float(d.sum() / 1e3)
+
+
+def _w_hoeffding_lower(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    avg, bound = _hoeffding(w, args[0])
+    return float(max(avg - bound, 0.0))
+
+
+def _w_hoeffding_upper(w, t, pv, pt, te, args):
+    if w.size == 0:
+        return nan
+    avg, bound = _hoeffding(w, args[0])
+    return float(avg + bound)
+
+
+def _hoeffding(w, phi):
+    rng = w.max() - w.min()
+    if w.size < 2 or rng == 0 or not (0 < phi < 1):
+        return float(w.mean()), 0.0
+    bound = rng * np.sqrt(np.log(1.0 / (1 - phi)) / (2 * w.size))
+    return float(w.mean()), float(bound)
+
+
+# name -> (window_fn, n_extra_args, rollup_arg_index)
+GENERIC_FUNCS = {
+    "quantile_over_time": (_w_quantile, 1, 1),
+    "median_over_time": (_w_median, 0, 0),
+    "mad_over_time": (_w_mad, 0, 0),
+    "iqr_over_time": (_w_iqr, 0, 0),
+    "zscore_over_time": (_w_zscore, 0, 0),
+    "range_over_time": (_w_range, 0, 0),
+    "distinct_over_time": (_w_distinct, 0, 0),
+    "geomean_over_time": (_w_geomean, 0, 0),
+    "sum2_over_time": (_w_sum2, 0, 0),
+    "tmin_over_time": (_w_tmin, 0, 0),
+    "tmax_over_time": (_w_tmax, 0, 0),
+    "resets": (_w_resets, 0, 0),
+    "increases_over_time": (_w_increases, 0, 0),
+    "decreases_over_time": (_w_decreases, 0, 0),
+    "integrate": (_w_integrate, 0, 0),
+    "rate_over_sum": (_w_rate_over_sum, 0, 0),
+    "count_eq_over_time": (_w_count_eq, 1, 0),
+    "count_ne_over_time": (_w_count_ne, 1, 0),
+    "count_le_over_time": (_w_count_le, 1, 0),
+    "count_gt_over_time": (_w_count_gt, 1, 0),
+    "share_le_over_time": (_w_share_le, 1, 0),
+    "share_gt_over_time": (_w_share_gt, 1, 0),
+    "share_eq_over_time": (_w_share_eq, 1, 0),
+    "sum_eq_over_time": (_w_sum_eq, 1, 0),
+    "sum_le_over_time": (_w_sum_le, 1, 0),
+    "sum_gt_over_time": (_w_sum_gt, 1, 0),
+    "predict_linear": (_w_predict_linear, 1, 0),
+    "holt_winters": (_w_holt_winters, 2, 0),
+    "double_exponential_smoothing": (_w_holt_winters, 2, 0),
+    "mode_over_time": (_w_mode, 0, 0),
+    "ascent_over_time": (_w_ascent, 0, 0),
+    "descent_over_time": (_w_descent, 0, 0),
+    "changes_prometheus": (_w_changes_prometheus, 0, 0),
+    "delta_prometheus": (_w_delta_prometheus, 0, 0),
+    "increase_prometheus": (_w_increase_prometheus, 0, 0),
+    "ideriv": (_w_ideriv, 0, 0),
+    "stale_samples_over_time": (_w_stale_samples, 0, 0),
+    "duration_over_time": (_w_duration_over_time, 1, 0),
+    "hoeffding_bound_lower": (_w_hoeffding_lower, 1, 1),
+    "hoeffding_bound_upper": (_w_hoeffding_upper, 1, 1),
+    "timestamp_with_name": (None, 0, 0),   # alias of timestamp, keeps name
+}
+
+# multi-output rollups: name -> list of (rollup_tag, oracle-or-generic name)
+MULTI_FUNCS = {
+    "rollup": [("min", "min_over_time"), ("max", "max_over_time"),
+               ("avg", "avg_over_time")],
+    "rollup_rate": [("min", None), ("max", None), ("avg", None)],
+    "rollup_increase": [("min", None), ("max", None), ("avg", None)],
+    "rollup_delta": [("min", None), ("max", None), ("avg", None)],
+    "rollup_deriv": [("min", None), ("max", None), ("avg", None)],
+    "rollup_candlestick": [("open", "first_over_time"),
+                           ("close", "last_over_time"),
+                           ("high", "max_over_time"),
+                           ("low", "min_over_time")],
+    "rollup_scrape_interval": [("min", None), ("max", None), ("avg", None)],
+}
+
+# funcs that keep the metric name in results (rollup.go keepMetricName set)
+KEEP_METRIC_NAMES = frozenset("""
+avg_over_time default_rollup first_over_time geomean_over_time
+hoeffding_bound_lower hoeffding_bound_upper holt_winters iqr_over_time
+last_over_time max_over_time median_over_time min_over_time mode_over_time
+predict_linear quantile_over_time quantiles_over_time rollup
+rollup_candlestick timestamp_with_name double_exponential_smoothing
+""".split())
+
+ROLLUP_FUNC_NAMES = (ORACLE_FUNCS | set(GENERIC_FUNCS) | set(MULTI_FUNCS)
+                     | {"aggr_over_time", "quantiles_over_time"})
+
+
+def generic_rollup(fn, ts: np.ndarray, vals: np.ndarray, cfg: RollupConfig,
+                   args: tuple = ()) -> np.ndarray:
+    """Apply a per-window function over one series (the long-tail path)."""
+    out_ts = cfg.out_timestamps()
+    lo = np.searchsorted(ts, out_ts - cfg.lookback, side="right")
+    hi = np.searchsorted(ts, out_ts, side="right")
+    out = np.full(out_ts.size, np.nan)
+    for j in range(out_ts.size):
+        a, b = lo[j], hi[j]
+        if b <= a and a == 0:
+            continue
+        pv = float(vals[a - 1]) if a >= 1 else None
+        pt = int(ts[a - 1]) if a >= 1 else None
+        if b <= a:
+            continue
+        out[j] = fn(vals[a:b], ts[a:b], pv, pt, int(out_ts[j]), args)
+    return out
+
+
+def rollup_series(func: str, ts: np.ndarray, vals: np.ndarray,
+                  cfg: RollupConfig, args: tuple = ()) -> np.ndarray:
+    """Single-series rollup dispatch: oracle fast path else generic."""
+    if func == "timestamp_with_name":
+        func = "timestamp"
+    if func in ORACLE_FUNCS:
+        return rollup_np.rollup(func, ts, vals, cfg)
+    spec = GENERIC_FUNCS.get(func)
+    if spec is None:
+        raise ValueError(f"unknown rollup function {func!r}")
+    fn, _, _ = spec
+    return generic_rollup(fn, ts, vals, cfg, args)

@@ -1,0 +1,79 @@
+"""TPU query backend: routes supported rollups onto the device kernels
+(the -search.tpuBackend analog; see models/rollup_pipeline.py).
+
+try_rollup_tpu returns per-series rollup rows for ORACLE funcs, or None to
+fall back to the host path. Series are packed into padded tiles; tiles are
+cached in HBM keyed by the series-set fingerprint so repeated queries skip
+the transfer (the reference's blockcache-hot behavior).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..ops import rollup_np
+from ..ops.rollup_np import RollupConfig
+
+_F32_SAFE_FUNCS = frozenset({
+    "count_over_time", "present_over_time", "min_over_time", "max_over_time",
+    "first_over_time", "last_over_time", "default_rollup", "changes",
+})
+
+
+@dataclasses.dataclass
+class TPUEngine:
+    cache_bytes: int = 2 << 30
+    value_dtype: object = np.float64
+    min_series: int = 64        # below this the host path wins
+    _cache: object = None
+
+    def cache(self):
+        if self._cache is None:
+            from ..models.tile_cache import TileCache
+            self._cache = TileCache(self.cache_bytes)
+        return self._cache
+
+
+def _fingerprint(series, start_ms: int) -> tuple:
+    import xxhash
+    h = xxhash.xxh64()
+    for sd in series:
+        h.update(sd.metric_name.marshal())
+        h.update(np.int64(sd.timestamps.size).tobytes())
+        if sd.timestamps.size:
+            h.update(sd.timestamps[-1].tobytes())
+    return ("tile", h.intdigest(), start_ms)
+
+
+def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
+                   args: tuple):
+    """Returns list of per-series value rows, or None for host fallback."""
+    if func not in rollup_np.SUPPORTED:
+        return None
+    if args:
+        return None
+    if len(series) < engine.min_series:
+        return None
+    span = cfg.end - cfg.start + cfg.lookback
+    if span >= 2**31 - 1:
+        return None  # needs chunking; host path handles it
+    try:
+        import jax.numpy as jnp
+
+        from ..ops.device_rollup import pack_series, rollup_tile
+    except Exception:
+        return None
+
+    def make_tiles():
+        ts, vals, counts = pack_series(
+            [(sd.timestamps, sd.values) for sd in series], cfg.start,
+            dtype=engine.value_dtype)
+        return (ts, vals, counts)
+
+    tiles = engine.cache().get_or_put(_fingerprint(series, cfg.start),
+                                      make_tiles)
+    ts_t, v_t, counts = tiles
+    out = rollup_tile(func, ts_t, v_t, counts, cfg)
+    return list(np.asarray(out, dtype=np.float64))

@@ -1,0 +1,783 @@
+"""Transform functions (reference app/vmselect/promql/transform.go:23-140,
+113 functions; the heavily-used subset here, expanding over rounds).
+
+A transform takes already-evaluated args (lists of Timeseries, floats, or
+strings) plus the EvalConfig, and returns a list of Timeseries.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as np
+
+from ..storage.metric_name import MetricName
+from .types import EvalConfig, Timeseries, const_series, new_series
+
+nan = np.nan
+
+
+# -- helpers -----------------------------------------------------------------
+
+def _map_values(series: list[Timeseries], fn, keep_name=False) -> list[Timeseries]:
+    out = []
+    for ts in series:
+        with np.errstate(all="ignore"):
+            vals = np.asarray(fn(ts.values), dtype=np.float64)
+        mn = MetricName(ts.metric_name.metric_group if keep_name else b"",
+                        list(ts.metric_name.labels))
+        out.append(Timeseries(mn, vals))
+    return out
+
+
+def _elementwise(fn):
+    def tf(ec, args):
+        return _map_values(args[0], fn)
+    return tf
+
+
+def _scalar_arg(args, i, default=None) -> float:
+    a = args[i] if i < len(args) else default
+    if isinstance(a, list):
+        if len(a) != 1:
+            raise ValueError("expected scalar arg")
+        return float(a[0].values[0])
+    return float(a)
+
+
+def _string_arg(args, i) -> str:
+    if not isinstance(args[i], str):
+        raise ValueError("expected string arg")
+    return args[i]
+
+
+# -- math --------------------------------------------------------------------
+
+MATH = {
+    "abs": np.abs, "ceil": np.ceil, "floor": np.floor, "exp": np.exp,
+    "ln": np.log, "log2": np.log2, "log10": np.log10, "sqrt": np.sqrt,
+    "sgn": np.sign, "acos": np.arccos, "acosh": np.arccosh,
+    "asin": np.arcsin, "asinh": np.arcsinh, "atan": np.arctan,
+    "atanh": np.arctanh, "cos": np.cos, "cosh": np.cosh, "sin": np.sin,
+    "sinh": np.sinh, "tan": np.tan, "tanh": np.tanh,
+    "deg": np.degrees, "rad": np.radians,
+}
+
+
+def tf_round(ec, args):
+    nearest = _scalar_arg(args, 1, 1.0)
+    def fn(v):
+        if nearest == 1.0:
+            return np.round(v)
+        return np.round(v / nearest) * nearest
+    return _map_values(args[0], fn, keep_name=True)
+
+
+def tf_clamp(ec, args):
+    lo, hi = _scalar_arg(args, 1), _scalar_arg(args, 2)
+    return _map_values(args[0], lambda v: np.clip(v, lo, hi), keep_name=True)
+
+
+def tf_clamp_min(ec, args):
+    lo = _scalar_arg(args, 1)
+    return _map_values(args[0], lambda v: np.maximum(v, lo), keep_name=True)
+
+
+def tf_clamp_max(ec, args):
+    hi = _scalar_arg(args, 1)
+    return _map_values(args[0], lambda v: np.minimum(v, hi), keep_name=True)
+
+
+# -- time --------------------------------------------------------------------
+
+def tf_time(ec, args):
+    return [new_series(ec.timestamps() / 1e3)]
+
+
+def tf_now(ec, args):
+    import time
+    return [const_series(ec, time.time())]
+
+
+def tf_step(ec, args):
+    return [const_series(ec, ec.step / 1e3)]
+
+
+def tf_start(ec, args):
+    return [const_series(ec, ec.start / 1e3)]
+
+
+def tf_end(ec, args):
+    return [const_series(ec, ec.end / 1e3)]
+
+
+def _dt_transform(extract):
+    def tf(ec, args):
+        series = args[0] if args else [new_series(ec.timestamps() / 1e3)]
+        import datetime
+
+        def fn(v):
+            out = np.full(v.size, nan)
+            ok = ~np.isnan(v)
+            for i in np.flatnonzero(ok):
+                dt = datetime.datetime.fromtimestamp(
+                    v[i], tz=datetime.timezone.utc)
+                out[i] = extract(dt)
+            return out
+        return _map_values(series, fn)
+    return tf
+
+
+DT_FUNCS = {
+    "minute": _dt_transform(lambda d: d.minute),
+    "hour": _dt_transform(lambda d: d.hour),
+    "day_of_month": _dt_transform(lambda d: d.day),
+    "day_of_week": _dt_transform(lambda d: d.isoweekday() % 7),
+    "day_of_year": _dt_transform(lambda d: d.timetuple().tm_yday),
+    "days_in_month": _dt_transform(
+        lambda d: __import__("calendar").monthrange(d.year, d.month)[1]),
+    "month": _dt_transform(lambda d: d.month),
+    "year": _dt_transform(lambda d: d.year),
+}
+
+
+# -- series shaping ------------------------------------------------------------
+
+def tf_scalar(ec, args):
+    series = args[0]
+    if len(series) != 1:
+        return [const_series(ec, nan)]
+    return [new_series(series[0].values.copy())]
+
+
+def tf_vector(ec, args):
+    if isinstance(args[0], (int, float)):
+        return [const_series(ec, float(args[0]))]
+    return list(args[0])
+
+
+def tf_union(ec, args):
+    seen = set()
+    out = []
+    for series in args:
+        for ts in series:
+            key = ts.metric_name.marshal()
+            if key not in seen:
+                seen.add(key)
+                out.append(ts)
+    return out
+
+
+def tf_sort(ec, args, desc=False, by_last=False):
+    series = list(args[0])
+
+    def key(ts):
+        with np.errstate(all="ignore"):
+            v = np.nanmean(ts.values) if not by_last else ts.values[-1]
+        return -v if desc else v
+    series.sort(key=lambda ts: (math.inf if np.isnan(key(ts)) else key(ts)))
+    return series
+
+
+def tf_sort_by_label(ec, args, desc=False, numeric=False):
+    series = list(args[0])
+    labels = [a for a in args[1:] if isinstance(a, str)]
+
+    def key(ts):
+        out = []
+        for lab in labels:
+            v = ts.metric_name.get_label(lab.encode()) or b""
+            if numeric:
+                try:
+                    out.append(float(v))
+                except ValueError:
+                    out.append(math.inf)
+            else:
+                out.append(v)
+        return out
+    series.sort(key=key, reverse=desc)
+    return series
+
+
+def tf_limit_offset(ec, args):
+    limit = int(_scalar_arg(args, 0))
+    offset = int(_scalar_arg(args, 1))
+    return list(args[2])[offset:offset + limit]
+
+
+def tf_absent(ec, args):
+    series = args[0]
+    if not series:
+        return [const_series(ec, 1.0)]
+    m = np.vstack([ts.values for ts in series])
+    absent = np.isnan(m).all(axis=0)
+    return [new_series(np.where(absent, 1.0, nan))]
+
+
+def tf_drop_common_labels(ec, args):
+    series = [t.copy_shallow_labels() for ts in args for t in ts]
+    if not series:
+        return series
+    common = dict(series[0].metric_name.labels)
+    common[b"__name__"] = series[0].metric_name.metric_group
+    for ts in series[1:]:
+        d = dict(ts.metric_name.labels)
+        d[b"__name__"] = ts.metric_name.metric_group
+        for k in list(common):
+            if d.get(k) != common[k]:
+                del common[k]
+    for ts in series:
+        if b"__name__" in common:
+            ts.metric_name.metric_group = b""
+        ts.metric_name.labels = [
+            (k, v) for k, v in ts.metric_name.labels if k not in common]
+    return series
+
+
+# -- running / range over the output grid -------------------------------------
+
+def _running(fn_acc):
+    def tf(ec, args):
+        out = []
+        for ts in args[0]:
+            v = ts.values
+            ok = ~np.isnan(v)
+            acc = fn_acc(np.where(ok, v, 0), ok)
+            acc[~ok.cumsum().astype(bool)] = nan
+            out.append(Timeseries(MetricName(b"", list(ts.metric_name.labels)),
+                                  acc))
+        return out
+    return tf
+
+
+def _racc_sum(v, ok):
+    return np.cumsum(v)
+
+
+def _racc_avg(v, ok):
+    with np.errstate(all="ignore"):
+        return np.cumsum(v) / np.maximum(np.cumsum(ok), 1)
+
+
+def _racc_min(v, ok):
+    x = np.where(ok, v, np.inf)
+    return np.minimum.accumulate(x)
+
+
+def _racc_max(v, ok):
+    x = np.where(ok, v, -np.inf)
+    return np.maximum.accumulate(x)
+
+
+def _range_apply(stat):
+    def tf(ec, args):
+        out = []
+        for ts in args[0]:
+            with np.errstate(all="ignore"):
+                s = stat(ts.values)
+            out.append(Timeseries(MetricName(b"", list(ts.metric_name.labels)),
+                                  np.full(ts.values.size, s)))
+        return out
+    return tf
+
+
+def tf_range_quantile(ec, args):
+    phi = _scalar_arg(args, 0)
+    out = []
+    for ts in args[1]:
+        with np.errstate(all="ignore"):
+            s = np.nanquantile(ts.values, min(max(phi, 0), 1)) \
+                if not np.isnan(ts.values).all() else nan
+        out.append(Timeseries(MetricName(b"", list(ts.metric_name.labels)),
+                              np.full(ts.values.size, s)))
+    return out
+
+
+def tf_range_normalize(ec, args):
+    out = []
+    for series in args:
+        for ts in series:
+            with np.errstate(all="ignore"):
+                lo, hi = np.nanmin(ts.values), np.nanmax(ts.values)
+                v = (ts.values - lo) / (hi - lo) if hi > lo else \
+                    np.zeros_like(ts.values)
+            out.append(Timeseries(MetricName(b"", list(ts.metric_name.labels)), v))
+    return out
+
+
+# -- gap filling ----------------------------------------------------------------
+
+def tf_interpolate(ec, args):
+    out = []
+    for ts in args[0]:
+        v = ts.values.copy()
+        ok = ~np.isnan(v)
+        if ok.any() and not ok.all():
+            idx = np.arange(v.size)
+            v = np.interp(idx, idx[ok], v[ok])
+        out.append(Timeseries(ts.metric_name, v))
+    return out
+
+
+def tf_keep_last_value(ec, args):
+    out = []
+    for ts in args[0]:
+        v = ts.values.copy()
+        ok = ~np.isnan(v)
+        if ok.any():
+            last = np.maximum.accumulate(np.where(ok, np.arange(v.size), -1))
+            filled = np.where(last >= 0, v[np.maximum(last, 0)], nan)
+            v = filled
+        out.append(Timeseries(ts.metric_name, v))
+    return out
+
+
+def tf_keep_next_value(ec, args):
+    out = []
+    for ts in args[0]:
+        v = ts.values[::-1].copy()
+        ok = ~np.isnan(v)
+        if ok.any():
+            last = np.maximum.accumulate(np.where(ok, np.arange(v.size), -1))
+            v = np.where(last >= 0, v[np.maximum(last, 0)], nan)
+        out.append(Timeseries(ts.metric_name, v[::-1]))
+    return out
+
+
+def tf_remove_resets(ec, args):
+    from ..ops.rollup_np import remove_counter_resets
+
+    def fn(v):
+        ok = ~np.isnan(v)
+        if not ok.any():
+            return v
+        filled = v[ok]
+        fixed = remove_counter_resets(filled)
+        out = v.copy()
+        out[ok] = fixed
+        return out
+    return _map_values(args[0], fn)
+
+
+# -- label manipulation ---------------------------------------------------------
+
+def _set_label(mn: MetricName, key: bytes, value: bytes):
+    if key == b"__name__":
+        mn.metric_group = value
+        return
+    mn.labels = [(k, v) for k, v in mn.labels if k != key]
+    if value:
+        mn.labels.append((key, value))
+        mn.sort_labels()
+
+
+def tf_label_set(ec, args):
+    series = [t.copy_shallow_labels() for t in args[0]]
+    pairs = args[1:]
+    for i in range(0, len(pairs) - 1, 2):
+        k, v = _string_arg(pairs, i).encode(), _string_arg(pairs, i + 1).encode()
+        for ts in series:
+            _set_label(ts.metric_name, k, v)
+    return series
+
+
+def tf_label_del(ec, args):
+    series = [t.copy_shallow_labels() for t in args[0]]
+    keys = [a.encode() for a in args[1:] if isinstance(a, str)]
+    for ts in series:
+        for k in keys:
+            _set_label(ts.metric_name, k, b"")
+    return series
+
+
+def tf_label_keep(ec, args):
+    series = [t.copy_shallow_labels() for t in args[0]]
+    keep = {a.encode() for a in args[1:] if isinstance(a, str)}
+    for ts in series:
+        if b"__name__" not in keep:
+            ts.metric_name.metric_group = b""
+        ts.metric_name.labels = [
+            (k, v) for k, v in ts.metric_name.labels if k in keep]
+    return series
+
+
+def tf_label_copy(ec, args, move=False):
+    series = [t.copy_shallow_labels() for t in args[0]]
+    pairs = args[1:]
+    for i in range(0, len(pairs) - 1, 2):
+        src = _string_arg(pairs, i).encode()
+        dst = _string_arg(pairs, i + 1).encode()
+        for ts in series:
+            v = ts.metric_name.get_label(src)
+            if v:
+                _set_label(ts.metric_name, dst, v)
+                if move:
+                    _set_label(ts.metric_name, src, b"")
+    return series
+
+
+def tf_label_replace(ec, args):
+    series = [t.copy_shallow_labels() for t in args[0]]
+    dst, repl, src, regex = (_string_arg(args, 1), _string_arg(args, 2),
+                             _string_arg(args, 3), _string_arg(args, 4))
+    try:
+        rx = re.compile("(?:" + regex + ")\\Z")
+    except re.error as e:
+        raise ValueError(f"label_replace: bad regex: {e}")
+    for ts in series:
+        v = (ts.metric_name.get_label(src.encode()) or b"").decode(
+            "utf-8", "replace")
+        m = rx.match(v)
+        if m:
+            new = m.expand(repl.replace("$", "\\"))
+            _set_label(ts.metric_name, dst.encode(), new.encode())
+    return series
+
+
+def tf_label_join(ec, args):
+    series = [t.copy_shallow_labels() for t in args[0]]
+    dst = _string_arg(args, 1).encode()
+    sep = _string_arg(args, 2).encode()
+    srcs = [a.encode() for a in args[3:] if isinstance(a, str)]
+    for ts in series:
+        parts = [(ts.metric_name.get_label(s) or b"") for s in srcs]
+        _set_label(ts.metric_name, dst, sep.join(parts))
+    return series
+
+
+def tf_label_value(ec, args):
+    series = [t.copy_shallow_labels() for t in args[0]]
+    key = _string_arg(args, 1).encode()
+    out = []
+    for ts in series:
+        v = ts.metric_name.get_label(key)
+        try:
+            x = float(v) if v is not None else nan
+        except ValueError:
+            x = nan
+        out.append(Timeseries(ts.metric_name,
+                              np.where(np.isnan(ts.values), nan, x)))
+    return out
+
+
+def tf_label_transform(ec, args):
+    series = [t.copy_shallow_labels() for t in args[0]]
+    key = _string_arg(args, 1).encode()
+    regex = _string_arg(args, 2)
+    repl = _string_arg(args, 3)
+    rx = re.compile(regex)
+    for ts in series:
+        v = (ts.metric_name.get_label(key) or b"").decode("utf-8", "replace")
+        _set_label(ts.metric_name, key,
+                   rx.sub(repl.replace("$", "\\"), v).encode())
+    return series
+
+
+def tf_label_map(ec, args):
+    series = [t.copy_shallow_labels() for t in args[0]]
+    key = _string_arg(args, 1).encode()
+    mapping = {}
+    rest = args[2:]
+    for i in range(0, len(rest) - 1, 2):
+        mapping[_string_arg(rest, i).encode()] = _string_arg(rest, i + 1).encode()
+    for ts in series:
+        v = ts.metric_name.get_label(key) or b""
+        if v in mapping:
+            _set_label(ts.metric_name, key, mapping[v])
+    return series
+
+
+def _label_case(upper: bool):
+    def tf(ec, args):
+        series = [t.copy_shallow_labels() for t in args[0]]
+        keys = [a.encode() for a in args[1:] if isinstance(a, str)]
+        for ts in series:
+            for k in keys:
+                v = ts.metric_name.get_label(k)
+                if v:
+                    s = v.decode("utf-8", "replace")
+                    _set_label(ts.metric_name, k,
+                               (s.upper() if upper else s.lower()).encode())
+        return series
+    return tf
+
+
+def tf_label_match(ec, args, negate=False):
+    series = args[0]
+    key = _string_arg(args, 1).encode()
+    rx = re.compile("(?:" + _string_arg(args, 2) + ")\\Z")
+    out = []
+    for ts in series:
+        v = (ts.metric_name.get_label(key) or b"").decode("utf-8", "replace")
+        if bool(rx.match(v)) != negate:
+            out.append(ts)
+    return out
+
+
+def tf_labels_equal(ec, args):
+    series = args[0]
+    keys = [a.encode() for a in args[1:] if isinstance(a, str)]
+    out = []
+    for ts in series:
+        vals = {ts.metric_name.get_label(k) for k in keys}
+        if len(vals) == 1:
+            out.append(ts)
+    return out
+
+
+# -- histogram_quantile --------------------------------------------------------
+
+def _group_buckets(series: list[Timeseries]):
+    """Group bucket series by labels-minus-le; returns
+    [(labels_key, MetricName_without_le, [(le, values)])]."""
+    groups: dict[bytes, tuple[MetricName, list]] = {}
+    for ts in series:
+        le = ts.metric_name.get_label(b"le")
+        if le is None:
+            continue
+        try:
+            le_f = float(le)
+        except ValueError:
+            continue
+        mn = MetricName(b"", [(k, v) for k, v in ts.metric_name.labels
+                              if k != b"le"])
+        key = mn.marshal()
+        if key not in groups:
+            groups[key] = (mn, [])
+        groups[key][1].append((le_f, ts.values))
+    return groups
+
+
+def tf_histogram_quantile(ec, args):
+    phi_arg = args[0]
+    series = args[1]
+    phis = None
+    if isinstance(phi_arg, list):
+        if len(phi_arg) == 1:
+            phis = float(phi_arg[0].values[0])
+        else:
+            raise ValueError("histogram_quantile: phi must be scalar")
+    else:
+        phis = float(phi_arg)
+    out = []
+    for key, (mn, buckets) in _group_buckets(series).items():
+        buckets.sort(key=lambda b: b[0])
+        les = np.array([b[0] for b in buckets])
+        m = np.vstack([b[1] for b in buckets])  # [B, T] cumulative counts
+        with np.errstate(all="ignore"):
+            vals = _hist_quantile_cols(phis, les, m)
+        out.append(Timeseries(mn, vals))
+    return out
+
+
+def _hist_quantile_cols(phi: float, les: np.ndarray, m: np.ndarray) -> np.ndarray:
+    T = m.shape[1]
+    out = np.full(T, nan)
+    if not np.isfinite(les[-1]) and les.size < 2:
+        return out
+    for j in range(T):
+        counts = m[:, j]
+        if np.isnan(counts).all():
+            continue
+        counts = np.nan_to_num(counts)
+        # enforce monotonicity (float jitter)
+        counts = np.maximum.accumulate(counts)
+        total = counts[-1]
+        if total == 0:
+            continue
+        if phi < 0:
+            out[j] = -np.inf
+            continue
+        if phi > 1:
+            out[j] = np.inf
+            continue
+        rank = phi * total
+        idx = int(np.searchsorted(counts, rank, side="left"))
+        idx = min(idx, les.size - 1)
+        if not np.isfinite(les[idx]):
+            # +Inf bucket: return the upper bound of the previous bucket
+            out[j] = les[idx - 1] if idx > 0 else nan
+            continue
+        lo = les[idx - 1] if idx > 0 else 0.0
+        c_lo = counts[idx - 1] if idx > 0 else 0.0
+        c_hi = counts[idx]
+        if c_hi <= c_lo:
+            out[j] = les[idx]
+            continue
+        out[j] = lo + (les[idx] - lo) * (rank - c_lo) / (c_hi - c_lo)
+    return out
+
+
+def tf_histogram_avg(ec, args):
+    out = []
+    for key, (mn, buckets) in _group_buckets(args[0]).items():
+        buckets.sort(key=lambda b: b[0])
+        les = np.array([b[0] for b in buckets])
+        m = np.nan_to_num(np.vstack([b[1] for b in buckets]))
+        d = np.diff(np.vstack([np.zeros(m.shape[1]), m]), axis=0)
+        mids = np.where(np.isfinite(les), les, les[les.size - 2] if les.size > 1 else 0)
+        lowers = np.concatenate([[0], mids[:-1]])
+        centers = (lowers + mids) / 2
+        with np.errstate(all="ignore"):
+            avg = (d * centers[:, None]).sum(axis=0) / d.sum(axis=0)
+        out.append(Timeseries(mn, avg))
+    return out
+
+
+def tf_prometheus_buckets(ec, args):
+    # VM-native histograms are not produced by this engine; pass through.
+    return list(args[0])
+
+
+def tf_buckets_limit(ec, args):
+    limit = int(_scalar_arg(args, 0))
+    groups = _group_buckets(args[1])
+    out = []
+    for key, (mn, buckets) in groups.items():
+        buckets.sort(key=lambda b: b[0])
+        keep = buckets
+        if len(buckets) > limit and limit >= 2:
+            # always keep the first and +Inf buckets; thin the middle
+            step = (len(buckets) - 1) / (limit - 1)
+            idxs = sorted({0, len(buckets) - 1} |
+                          {int(round(i * step)) for i in range(limit)})
+            keep = [buckets[i] for i in idxs[:limit]]
+        for le, vals in keep:
+            mn2 = MetricName(mn.metric_group, list(mn.labels))
+            le_s = b"+Inf" if np.isinf(le) else repr(le).rstrip("0").rstrip(".").encode()
+            mn2.labels.append((b"le", le_s))
+            mn2.sort_labels()
+            out.append(Timeseries(mn2, vals))
+    return out
+
+
+# -- misc ----------------------------------------------------------------------
+
+def tf_pi(ec, args):
+    return [const_series(ec, math.pi)]
+
+
+def tf_e(ec, args):
+    return [const_series(ec, math.e)]
+
+
+def tf_rand(ec, args):
+    seed = int(_scalar_arg(args, 0, 0)) if args else None
+    rng = np.random.default_rng(seed)
+    return [new_series(rng.random(ec.n_points))]
+
+
+def tf_rand_normal(ec, args):
+    seed = int(_scalar_arg(args, 0, 0)) if args else None
+    rng = np.random.default_rng(seed)
+    return [new_series(rng.standard_normal(ec.n_points))]
+
+
+def tf_rand_exponential(ec, args):
+    seed = int(_scalar_arg(args, 0, 0)) if args else None
+    rng = np.random.default_rng(seed)
+    return [new_series(rng.exponential(size=ec.n_points))]
+
+
+def tf_smooth_exponential(ec, args):
+    sf = min(max(_scalar_arg(args, 1), 0.0), 1.0)
+    out = []
+    for ts in args[0]:
+        v = ts.values
+        acc = v.copy()
+        prev = nan
+        for i in range(v.size):
+            if np.isnan(v[i]):
+                acc[i] = prev
+            elif np.isnan(prev):
+                acc[i] = v[i]
+                prev = v[i]
+            else:
+                prev = sf * v[i] + (1 - sf) * prev
+                acc[i] = prev
+        out.append(Timeseries(MetricName(b"", list(ts.metric_name.labels)), acc))
+    return out
+
+
+def tf_bitmap_and(ec, args):
+    mask = int(_scalar_arg(args, 1))
+    return _map_values(args[0], lambda v: np.where(
+        np.isnan(v), nan, (v.astype(np.int64) & mask).astype(np.float64)))
+
+
+def tf_bitmap_or(ec, args):
+    mask = int(_scalar_arg(args, 1))
+    return _map_values(args[0], lambda v: np.where(
+        np.isnan(v), nan, (v.astype(np.int64) | mask).astype(np.float64)))
+
+
+def tf_bitmap_xor(ec, args):
+    mask = int(_scalar_arg(args, 1))
+    return _map_values(args[0], lambda v: np.where(
+        np.isnan(v), nan, (v.astype(np.int64) ^ mask).astype(np.float64)))
+
+
+TRANSFORM_FUNCS: dict = {}
+TRANSFORM_FUNCS.update({name: _elementwise(fn) for name, fn in MATH.items()})
+TRANSFORM_FUNCS.update(DT_FUNCS)
+TRANSFORM_FUNCS.update({
+    "round": tf_round, "clamp": tf_clamp, "clamp_min": tf_clamp_min,
+    "clamp_max": tf_clamp_max,
+    "time": tf_time, "now": tf_now, "step": tf_step, "start": tf_start,
+    "end": tf_end, "pi": tf_pi, "e": tf_e,
+    "rand": tf_rand, "rand_normal": tf_rand_normal,
+    "rand_exponential": tf_rand_exponential,
+    "scalar": tf_scalar, "vector": tf_vector, "union": tf_union,
+    "sort": lambda ec, a: tf_sort(ec, a),
+    "sort_desc": lambda ec, a: tf_sort(ec, a, desc=True),
+    "sort_by_label": lambda ec, a: tf_sort_by_label(ec, a),
+    "sort_by_label_desc": lambda ec, a: tf_sort_by_label(ec, a, desc=True),
+    "sort_by_label_numeric": lambda ec, a: tf_sort_by_label(ec, a, numeric=True),
+    "sort_by_label_numeric_desc":
+        lambda ec, a: tf_sort_by_label(ec, a, desc=True, numeric=True),
+    "limit_offset": tf_limit_offset, "absent": tf_absent,
+    "drop_common_labels": tf_drop_common_labels,
+    "running_sum": _running(_racc_sum), "running_avg": _running(_racc_avg),
+    "running_min": _running(_racc_min), "running_max": _running(_racc_max),
+    "range_sum": _range_apply(np.nansum), "range_avg": _range_apply(np.nanmean),
+    "range_min": _range_apply(np.nanmin), "range_max": _range_apply(np.nanmax),
+    "range_first": _range_apply(
+        lambda v: v[np.flatnonzero(~np.isnan(v))[0]]
+        if (~np.isnan(v)).any() else nan),
+    "range_last": _range_apply(
+        lambda v: v[np.flatnonzero(~np.isnan(v))[-1]]
+        if (~np.isnan(v)).any() else nan),
+    "range_stddev": _range_apply(np.nanstd),
+    "range_stdvar": _range_apply(np.nanvar),
+    "range_median": _range_apply(np.nanmedian),
+    "range_quantile": tf_range_quantile,
+    "range_normalize": tf_range_normalize,
+    "interpolate": tf_interpolate,
+    "keep_last_value": tf_keep_last_value,
+    "keep_next_value": tf_keep_next_value,
+    "remove_resets": tf_remove_resets,
+    "label_set": tf_label_set, "label_del": tf_label_del,
+    "label_keep": tf_label_keep,
+    "label_copy": lambda ec, a: tf_label_copy(ec, a),
+    "label_move": lambda ec, a: tf_label_copy(ec, a, move=True),
+    "label_replace": tf_label_replace, "label_join": tf_label_join,
+    "label_value": tf_label_value, "label_transform": tf_label_transform,
+    "label_map": tf_label_map,
+    "label_lowercase": _label_case(False),
+    "label_uppercase": _label_case(True),
+    "label_match": lambda ec, a: tf_label_match(ec, a),
+    "label_mismatch": lambda ec, a: tf_label_match(ec, a, negate=True),
+    "labels_equal": tf_labels_equal,
+    "histogram_quantile": tf_histogram_quantile,
+    "histogram_avg": tf_histogram_avg,
+    "prometheus_buckets": tf_prometheus_buckets,
+    "buckets_limit": tf_buckets_limit,
+    "smooth_exponential": tf_smooth_exponential,
+    "bitmap_and": tf_bitmap_and, "bitmap_or": tf_bitmap_or,
+    "bitmap_xor": tf_bitmap_xor,
+    "sgn": _elementwise(np.sign),
+})
+
+# args that must NOT be auto-evaluated to series (string positions are
+# detected at eval time via StringExpr)

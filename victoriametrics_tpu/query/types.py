@@ -1,0 +1,79 @@
+"""Query-engine core types.
+
+Timeseries: one output series on the shared (start..end, step) grid; NaN
+marks absent points (the reference's netstorage.Result shape after rollup).
+EvalConfig: the per-query static context threaded through the evaluator
+(eval.go evalConfig analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..storage.metric_name import MetricName
+
+
+@dataclasses.dataclass
+class Timeseries:
+    metric_name: MetricName
+    values: np.ndarray  # float64 [T], NaN = absent
+
+    def copy_shallow_labels(self) -> "Timeseries":
+        mn = MetricName(self.metric_name.metric_group,
+                        list(self.metric_name.labels))
+        return Timeseries(mn, self.values)
+
+
+@dataclasses.dataclass
+class EvalConfig:
+    start: int                 # unix ms, first output timestamp
+    end: int                   # unix ms, last output timestamp (inclusive)
+    step: int                  # ms
+    storage: object = None     # duck-typed: search_series(filters, lo, hi)
+    lookback_delta: int = 300_000   # instant-vector staleness window
+    max_points_per_series: int = 50_000_000
+    max_series: int = 1_000_000
+    round_digits: int = 100
+    tracer: object = None
+    tpu: object = None         # TPUEngine when the device path is enabled
+    _grid: np.ndarray | None = None
+
+    def __post_init__(self):
+        if self.step <= 0:
+            raise ValueError("step must be positive")
+        if self.end < self.start:
+            raise ValueError("end < start")
+        npoints = (self.end - self.start) // self.step + 1
+        if npoints > self.max_points_per_series:
+            raise ValueError(f"too many output points: {npoints}")
+
+    def timestamps(self) -> np.ndarray:
+        if self._grid is None:
+            self._grid = np.arange(self.start, self.end + 1, self.step,
+                                   dtype=np.int64)
+        return self._grid
+
+    @property
+    def n_points(self) -> int:
+        return self.timestamps().size
+
+    def child(self, **kw) -> "EvalConfig":
+        d = dict(start=self.start, end=self.end, step=self.step,
+                 storage=self.storage, lookback_delta=self.lookback_delta,
+                 max_points_per_series=self.max_points_per_series,
+                 max_series=self.max_series, round_digits=self.round_digits,
+                 tracer=self.tracer, tpu=self.tpu)
+        d.update(kw)
+        return EvalConfig(**d)
+
+
+def new_series(values: np.ndarray, group: bytes = b"",
+               labels: list | None = None) -> Timeseries:
+    return Timeseries(MetricName(group, list(labels or [])),
+                      np.asarray(values, dtype=np.float64))
+
+
+def const_series(ec: EvalConfig, v: float) -> Timeseries:
+    return new_series(np.full(ec.n_points, v, dtype=np.float64))

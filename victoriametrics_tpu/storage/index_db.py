@@ -165,8 +165,12 @@ class IndexDB:
         plen = len(prefix)
         for item in self.table.search_prefix(prefix):
             body = item[plen:]
-            sep = body.rindex(b"\x00")
-            yield unescape(body[:sep]), _U64.unpack(body[sep + 1:sep + 9])[0]
+            # fixed-width tail: 0x00 separator + 8-byte BE metric_id (which
+            # itself may contain 0x00 bytes, so never search for the NUL)
+            sep = len(body) - 9
+            if sep < 0 or body[sep] != 0:
+                raise ValueError("corrupted tag->metricID index item")
+            yield unescape(body[:sep]), _U64.unpack(body[sep + 1:])[0]
 
     def _metric_ids_for_date(self, date: int) -> np.ndarray:
         prefix = NS_DATE_TO_MID + _U32.pack(date)
@@ -291,12 +295,30 @@ class IndexDB:
 
     # -- label APIs --------------------------------------------------------
 
+    def _date_range(self, min_ts, max_ts) -> list[int] | None:
+        """Day list when the range is narrow enough for the per-day index."""
+        if min_ts is None or max_ts is None:
+            return None
+        d0, d1 = date_of_ms(min_ts), date_of_ms(max_ts)
+        if d1 - d0 + 1 > self.MAX_DAYS_PER_DAY_INDEX:
+            return None
+        return list(range(d0, d1 + 1))
+
     def label_names(self, min_ts=None, max_ts=None) -> list[str]:
-        """Distinct label keys (SearchLabelNames analog)."""
+        """Distinct label keys, time-scoped via the per-day index when the
+        range is narrow (SearchLabelNames analog, index_db.go:507)."""
+        dates = self._date_range(min_ts, max_ts)
         seen_keys = set()
-        for item in self.table.search_prefix(NS_TAG_TO_MID):
-            body = item[1:]
-            seen_keys.add(body[:body.index(b"\x01")])
+        if dates is None:
+            for item in self.table.search_prefix(NS_TAG_TO_MID):
+                body = item[1:]
+                seen_keys.add(body[:body.index(b"\x01")])
+        else:
+            for d in dates:
+                prefix = NS_DATE_TAG_TO_MID + _U32.pack(d)
+                for item in self.table.search_prefix(prefix):
+                    body = item[len(prefix):]
+                    seen_keys.add(body[:body.index(b"\x01")])
         names = {unescape(k).decode("utf-8", "replace")
                  for k in seen_keys if k != b""}
         names.add("__name__")
@@ -304,5 +326,8 @@ class IndexDB:
 
     def label_values(self, key: str, min_ts=None, max_ts=None) -> list[str]:
         kb = b"" if key == "__name__" else key.encode()
-        vals = {v for v, _ in self._iter_tag_values(kb)}
+        dates = self._date_range(min_ts, max_ts)
+        vals = set()
+        for d in (dates if dates is not None else [None]):
+            vals |= {v for v, _ in self._iter_tag_values(kb, d)}
         return sorted(v.decode("utf-8", "replace") for v in vals)

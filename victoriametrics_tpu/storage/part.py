@@ -170,14 +170,23 @@ class Part:
         return Block.unmarshal(h, ts_data, val_data)
 
     def iter_headers(self, tsid_set: set | None = None,
-                     min_ts: int | None = None, max_ts: int | None = None):
+                     min_ts: int | None = None, max_ts: int | None = None,
+                     tsid_lo=None, tsid_hi=None):
         """Yield BlockHeaders matching the tsid set / time range, in
-        (tsid, min_ts) order (partSearch analog)."""
-        for row in self.meta_rows:
+        (tsid, min_ts) order (partSearch analog). Metaindex rows are pruned
+        by time range and, when tsid_lo/tsid_hi sort keys are given, by the
+        first_tsid directory (blocks are (tsid, min_ts)-sorted)."""
+        rows = self.meta_rows
+        for i, row in enumerate(rows):
             if min_ts is not None and row.max_ts < min_ts:
                 continue
             if max_ts is not None and row.min_ts > max_ts:
                 continue
+            if tsid_hi is not None and row.first_tsid.sort_key() > tsid_hi:
+                break
+            if tsid_lo is not None and i + 1 < len(rows) and \
+                    rows[i + 1].first_tsid.sort_key() <= tsid_lo:
+                continue  # whole row precedes the wanted tsid range
             for h in self.read_headers(row):
                 if tsid_set is not None and h.tsid.metric_id not in tsid_set:
                     continue
@@ -187,6 +196,7 @@ class Part:
                     continue
                 yield h
 
-    def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None):
-        for h in self.iter_headers(tsid_set, min_ts, max_ts):
+    def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None,
+                    tsid_lo=None, tsid_hi=None):
+        for h in self.iter_headers(tsid_set, min_ts, max_ts, tsid_lo, tsid_hi):
             yield self.read_block(h)

@@ -169,21 +169,21 @@ class Partition:
         if os.path.exists(self._parts_json()):
             with open(self._parts_json()) as f:
                 listed = json.load(f)["parts"]
-        live = set()
         for name in listed:
             p = os.path.join(self.path, name)
             try:
                 self._file_parts.append(Part(p))
-                live.add(name)
             except (OSError, ValueError, KeyError) as e:
-                logger.errorf("partition %s: cannot open part %s: %s",
-                              self.name, name, e)
-        # remove crash leftovers (unlisted dirs, tmp dirs)
+                # keep the dir: the error may be transient (fd exhaustion,
+                # permissions); deleting listed parts would be data loss
+                logger.errorf("partition %s: cannot open part %s "
+                              "(kept on disk): %s", self.name, name, e)
+        # remove crash leftovers: only dirs NOT listed in parts.json
         for name in os.listdir(self.path):
             full = os.path.join(self.path, name)
             if name == "parts.json" or not os.path.isdir(full):
                 continue
-            if name not in live:
+            if name not in listed:
                 shutil.rmtree(full, ignore_errors=True)
         if self._file_parts:
             seqs = [int(os.path.basename(p.path).split("_")[1])
@@ -221,8 +221,11 @@ class Partition:
             self._flush_pending_locked()
             if not self._mem_parts:
                 return
-            mems, self._mem_parts = self._mem_parts, []
+            mems = self._mem_parts
             self._write_merged_locked([m.iter_blocks() for m in mems])
+            # clear only after the durable write succeeded: an ENOSPC abort
+            # must not drop the buffered rows
+            self._mem_parts = []
             if len(self._file_parts) > MAX_SMALL_PARTS:
                 self._merge_file_parts_locked(self._file_parts)
 
@@ -283,16 +286,18 @@ class Partition:
         (the /internal/force_merge + final-dedup path)."""
         with self._lock:
             self._flush_pending_locked()
-            mems, self._mem_parts = self._mem_parts, []
+            mems = self._mem_parts
             if mems:
                 self._write_merged_locked([m.iter_blocks() for m in mems])
+            self._mem_parts = []  # only after the durable write succeeded
             if self._file_parts:
                 self._merge_file_parts_locked(self._file_parts, deleted_ids,
                                               min_valid_ts)
 
     # -- reads -------------------------------------------------------------
 
-    def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None):
+    def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None,
+                    tsid_lo=None, tsid_hi=None):
         """Blocks from all parts (NOT cross-part merged; the search layer
         merges rows per series)."""
         with self._lock:
@@ -304,7 +309,8 @@ class Partition:
         for src in mems:
             yield from src.iter_blocks(tsid_set, min_ts, max_ts)
         for p in files:
-            yield from p.iter_blocks(tsid_set, min_ts, max_ts)
+            yield from p.iter_blocks(tsid_set, min_ts, max_ts,
+                                     tsid_lo, tsid_hi)
 
     @property
     def rows(self) -> int:

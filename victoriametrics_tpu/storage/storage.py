@@ -170,7 +170,9 @@ class Storage:
         tsid_set = {t.metric_id for t in tsids}
         if not tsid_set:
             return
-        yield from self.table.iter_blocks(tsid_set, min_ts, max_ts)
+        yield from self.table.iter_blocks(
+            tsid_set, min_ts, max_ts,
+            tsid_lo=tsids[0].sort_key(), tsid_hi=tsids[-1].sort_key())
 
     def search_series(self, filters: list[TagFilter], min_ts: int,
                       max_ts: int, dedup_interval_ms: int | None = None,

@@ -73,11 +73,13 @@ class Table:
                     out.append(p)
             return out
 
-    def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None):
+    def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None,
+                    tsid_lo=None, tsid_hi=None):
         parts = (self.partitions_for_range(min_ts if min_ts is not None else -(1 << 62),
                                            max_ts if max_ts is not None else 1 << 62))
         for p in parts:
-            yield from p.iter_blocks(tsid_set, min_ts, max_ts)
+            yield from p.iter_blocks(tsid_set, min_ts, max_ts,
+                                     tsid_lo, tsid_hi)
 
     def enforce_retention(self, min_valid_ts: int) -> int:
         """Drop partitions entirely older than retention; returns count

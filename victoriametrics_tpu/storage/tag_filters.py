@@ -25,7 +25,7 @@ def _try_literal_alternation(expr: str) -> list[str] | None:
     # strip one redundant non-capturing/capturing group around the whole expr
     if not expr:
         return [""]
-    specials = set(".+*?[]{}^$\\")
+    specials = set(".+*?[]{}^$\\()|")
     # split on top-level | inside at most one group level
     def split_top(e: str) -> list[str] | None:
         parts, depth, cur = [], 0, []
