@@ -1,0 +1,108 @@
+"""apptest harness (reference apptest/: spawns real binaries on localhost,
+drives them over HTTP with typed helpers). Provides an in-process vmsingle
+fixture for speed plus a subprocess spawner for process-level tests."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class VmSingleProc:
+    """vmsingle in a subprocess (apptest/app.go analog)."""
+
+    def __init__(self, data_path: str, port: int = 0, extra_flags=()):
+        import socket
+        if port == 0:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+        self.port = port
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "victoriametrics_tpu.apps.vmsingle",
+             f"-storageDataPath={data_path}",
+             f"-httpListenAddr=127.0.0.1:{port}", *extra_flags],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        self._wait_ready()
+
+    def _wait_ready(self, timeout=30):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{self.port}/health", timeout=1):
+                    return
+            except OSError:
+                if self.proc.poll() is not None:
+                    out = self.proc.stdout.read().decode()
+                    raise RuntimeError(f"vmsingle died:\n{out}")
+                time.sleep(0.1)
+        raise TimeoutError("vmsingle did not become ready")
+
+    def stop(self):
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+class Client:
+    """HTTP driver (apptest/client.go analog)."""
+
+    def __init__(self, port: int, host="127.0.0.1"):
+        self.base = f"http://{host}:{port}"
+
+    def get(self, path: str, **params) -> tuple[int, bytes]:
+        url = self.base + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params, doseq=True)
+        try:
+            with urllib.request.urlopen(url, timeout=30) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def post(self, path: str, body: bytes = b"", headers=None, **params
+             ) -> tuple[int, bytes]:
+        url = self.base + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params, doseq=True)
+        req = urllib.request.Request(url, data=body, method="POST",
+                                     headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    # typed helpers (apptest/model.go analog)
+
+    def query_range(self, query: str, start, end, step) -> dict:
+        code, body = self.get("/api/v1/query_range", query=query,
+                              start=start, end=end, step=step)
+        assert code == 200, body
+        return json.loads(body)
+
+    def query(self, query: str, time_s=None) -> dict:
+        params = {"query": query}
+        if time_s is not None:
+            params["time"] = time_s
+        code, body = self.get("/api/v1/query", **params)
+        assert code == 200, body
+        return json.loads(body)
+
+    def force_flush(self):
+        code, _ = self.get("/internal/force_flush")
+        assert code == 200

@@ -1,0 +1,248 @@
+"""End-to-end HTTP API tests (apptest/tests analog): every ingest protocol
+in, Prometheus API out. Uses an in-process server for speed plus one real
+subprocess test."""
+
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.ingest import remote_write
+from tests.apptest_helpers import Client, VmSingleProc
+
+T0 = 1_753_700_000_000
+
+
+@pytest.fixture()
+def app(tmp_path):
+    """In-process vmsingle."""
+    from victoriametrics_tpu.apps.vmsingle import build, parse_flags
+    args = parse_flags([f"-storageDataPath={tmp_path}/data",
+                        "-httpListenAddr=127.0.0.1:0"])
+    storage, srv, api = build(args)
+    srv.start()
+    yield Client(srv.port)
+    srv.stop()
+    storage.close()
+
+
+def ingest_remote_write(app, n_series=4, n_samples=20):
+    series = []
+    for i in range(n_series):
+        labels = [("__name__", "rw_metric"), ("idx", str(i))]
+        samples = [(T0 + j * 15_000, float(i * 100 + j))
+                   for j in range(n_samples)]
+        series.append((labels, samples))
+    body = remote_write.build_write_request(series)
+    code, resp = app.post("/api/v1/write", body,
+                          headers={"Content-Encoding": "snappy"})
+    assert code == 204, resp
+
+
+class TestRemoteWrite:
+    def test_write_then_query_range(self, app):
+        ingest_remote_write(app)
+        res = app.query_range("rw_metric", T0 / 1e3, (T0 + 300_000) / 1e3, 15)
+        assert res["status"] == "success"
+        assert len(res["data"]["result"]) == 4
+        s0 = [r for r in res["data"]["result"]
+              if r["metric"]["idx"] == "0"][0]
+        assert s0["values"][0][1] == "0"
+        assert s0["metric"]["__name__"] == "rw_metric"
+
+    def test_zstd_encoding(self, app):
+        body = remote_write.build_write_request(
+            [([("__name__", "zm")], [(T0, 5.0)])], compress="zstd")
+        code, _ = app.post("/api/v1/write", body,
+                           headers={"Content-Encoding": "zstd"})
+        assert code == 204
+        res = app.query("zm", T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["value"][1] == "5"
+
+    def test_instant_query_and_rate(self, app):
+        ingest_remote_write(app)
+        res = app.query("sum(rate(rw_metric[1m]))", (T0 + 290_000) / 1e3)
+        v = float(res["data"]["result"][0]["value"][1])
+        # each series grows 1 per 15s -> rate 1/15 x 4 series
+        assert abs(v - 4 / 15) < 1e-9
+
+
+class TestOtherProtocols:
+    def test_influx_line(self, app):
+        line = f"cpu,host=h1 usage_user=42.5,usage_system=7 {T0 * 1_000_000}"
+        code, _ = app.post("/write", line.encode())
+        assert code == 204
+        res = app.query("cpu_usage_user", T0 / 1e3 + 10)
+        r = res["data"]["result"][0]
+        assert r["metric"] == {"__name__": "cpu_usage_user", "host": "h1"}
+        assert r["value"][1] == "42.5"
+
+    def test_jsonl_import_export_roundtrip(self, app):
+        line = json.dumps({"metric": {"__name__": "jm", "a": "b"},
+                           "values": [1.5, 2.5],
+                           "timestamps": [T0, T0 + 60_000]})
+        code, _ = app.post("/api/v1/import", line.encode())
+        assert code == 204
+        code, body = app.get("/api/v1/export", **{"match[]": "jm"})
+        assert code == 200
+        out = json.loads(body.splitlines()[0])
+        assert out["metric"] == {"__name__": "jm", "a": "b"}
+        assert out["values"] == [1.5, 2.5]
+        assert out["timestamps"] == [T0, T0 + 60_000]
+
+    def test_prometheus_text_import(self, app):
+        text = f'pm{{x="1"}} 3.5 {T0}\npm{{x="2"}} 4.5 {T0}\n'
+        code, _ = app.post("/api/v1/import/prometheus", text.encode())
+        assert code == 204
+        res = app.query("sum(pm)", T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["value"][1] == "8"
+
+    def test_csv_import(self, app):
+        csv = "h1,42.5,1753700000\nh2,7.5,1753700000\n"
+        code, _ = app.post("/api/v1/import/csv", csv.encode(),
+                           format="1:label:host,2:metric:temp,3:time:unix_s")
+        assert code == 204
+        res = app.query("temp", T0 / 1e3 + 10)
+        assert len(res["data"]["result"]) == 2
+
+    def test_graphite(self, app):
+        line = f"foo.bar.baz;dc=east 10.5 {T0 // 1000}"
+        code, _ = app.post("/graphite", line.encode())
+        assert code == 204
+        res = app.query('{__name__="foo.bar.baz"}', T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["metric"]["dc"] == "east"
+
+    def test_opentsdb_http(self, app):
+        body = json.dumps([{"metric": "ot.m", "timestamp": T0 // 1000,
+                            "value": 9.5, "tags": {"t": "x"}}])
+        code, _ = app.post("/api/put", body.encode())
+        assert code == 204
+        res = app.query('{__name__="ot.m"}', T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["value"][1] == "9.5"
+
+    def test_datadog_v1(self, app):
+        body = json.dumps({"series": [{
+            "metric": "dd.metric", "points": [[T0 // 1000, 3.25]],
+            "host": "h9", "tags": ["env:prod"]}]})
+        code, _ = app.post("/datadog/api/v1/series", body.encode())
+        assert code == 202
+        res = app.query("dd_metric", T0 / 1e3 + 10)
+        m = res["data"]["result"][0]["metric"]
+        assert m["host"] == "h9" and m["env"] == "prod"
+
+    def test_datadog_v2(self, app):
+        body = json.dumps({"series": [{
+            "metric": "dd2.m", "points": [{"timestamp": T0 // 1000,
+                                           "value": 1.5}],
+            "resources": [{"type": "host", "name": "h3"}]}]})
+        code, _ = app.post("/datadog/api/v2/series", body.encode())
+        assert code == 202
+        res = app.query("dd2_m", T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["metric"]["host"] == "h3"
+
+    def test_newrelic(self, app):
+        body = json.dumps([{"Events": [{
+            "eventType": "SystemSample", "timestamp": T0 // 1000,
+            "cpuPercent": 12.5, "hostname": "nr1"}]}])
+        code, _ = app.post("/newrelic/infra/v2/metrics/events/bulk",
+                           body.encode())
+        assert code == 202
+        res = app.query("system_sample_cpu_percent", T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["metric"]["hostname"] == "nr1"
+
+
+class TestMetadataAPIs:
+    def test_series_labels_values(self, app):
+        ingest_remote_write(app)
+        code, body = app.get("/api/v1/series", **{
+            "match[]": "rw_metric", "start": T0 / 1e3,
+            "end": (T0 + 600_000) / 1e3})
+        data = json.loads(body)["data"]
+        assert len(data) == 4
+        code, body = app.get("/api/v1/labels", start=T0 / 1e3,
+                             end=(T0 + 600_000) / 1e3)
+        assert "idx" in json.loads(body)["data"]
+        code, body = app.get("/api/v1/label/idx/values", start=T0 / 1e3,
+                             end=(T0 + 600_000) / 1e3)
+        assert json.loads(body)["data"] == ["0", "1", "2", "3"]
+
+    def test_status_tsdb(self, app):
+        ingest_remote_write(app)
+        code, body = app.get("/api/v1/status/tsdb")
+        data = json.loads(body)["data"]
+        assert data["totalSeries"] == 4
+
+    def test_delete_series(self, app):
+        ingest_remote_write(app)
+        code, _ = app.post("/api/v1/admin/tsdb/delete_series", b"",
+                           **{"match[]": 'rw_metric{idx="0"}'})
+        assert code == 204
+        res = app.query_range("rw_metric", T0 / 1e3, (T0 + 300_000) / 1e3, 15)
+        assert len(res["data"]["result"]) == 3
+
+    def test_federate(self, app):
+        now = time.time()
+        text = f'fm{{x="1"}} 3.5 {int(now * 1000)}\n'
+        app.post("/api/v1/import/prometheus", text.encode())
+        code, body = app.get("/federate", **{"match[]": "fm"})
+        assert code == 200
+        assert b'fm{x="1"} 3.5' in body
+
+    def test_top_and_active_queries(self, app):
+        ingest_remote_write(app)
+        app.query("rw_metric", T0 / 1e3)
+        code, body = app.get("/api/v1/status/top_queries")
+        data = json.loads(body)
+        assert any(e["query"] == "rw_metric" for e in data["topByCount"])
+        code, body = app.get("/api/v1/status/active_queries")
+        assert code == 200
+
+    def test_metrics_page(self, app):
+        ingest_remote_write(app)
+        code, body = app.get("/metrics")
+        assert code == 200
+        assert b"vm_rows_inserted_total" in body
+
+    def test_snapshots(self, app):
+        ingest_remote_write(app)
+        app.force_flush()
+        code, body = app.get("/snapshot/create")
+        name = json.loads(body)["snapshot"]
+        code, body = app.get("/snapshot/list")
+        assert name in json.loads(body)["snapshots"]
+        code, _ = app.get("/snapshot/delete", snapshot=name)
+        assert code == 200
+
+    def test_errors(self, app):
+        code, body = app.get("/api/v1/query")
+        assert code == 422
+        code, body = app.get("/api/v1/query_range", query="rate(",
+                             start="0", end="1", step="15")
+        assert code == 422
+        assert json.loads(body)["status"] == "error"
+        code, _ = app.get("/nope/nope")
+        assert code == 404
+
+
+class TestSubprocess:
+    def test_real_process_lifecycle(self, tmp_path):
+        """Spawn the actual vmsingle process, ingest, query, restart, verify
+        persistence (the apptest way)."""
+        app = VmSingleProc(str(tmp_path / "data"))
+        c = Client(app.port)
+        line = json.dumps({"metric": {"__name__": "persisted"},
+                           "values": [7.0], "timestamps": [T0]})
+        code, _ = c.post("/api/v1/import", line.encode())
+        assert code == 204
+        c.force_flush()
+        res = c.query("persisted", T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["value"][1] == "7"
+        app.stop()
+        # restart on same data dir
+        app2 = VmSingleProc(str(tmp_path / "data"))
+        c2 = Client(app2.port)
+        res = c2.query("persisted", T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["value"][1] == "7"
+        app2.stop()

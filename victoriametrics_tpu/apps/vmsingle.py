@@ -1,0 +1,107 @@
+"""vmsingle: the single-binary server (reference app/victoria-metrics/
+main.go:53-125) — storage + query engine + HTTP API in one process.
+
+Flags follow the reference's conventions (-storageDataPath,
+-httpListenAddr, -retentionPeriod, -dedup.minScrapeInterval); every flag is
+also settable via env var VM_<FLAGNAME> (lib/envflag analog).
+
+Run: python -m victoriametrics_tpu.apps.vmsingle -storageDataPath=/tmp/vm
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+from ..utils import logger
+
+
+def parse_flags(argv=None):
+    p = argparse.ArgumentParser(prog="vmsingle", prefix_chars="-")
+    p.add_argument("-storageDataPath", default="victoria-metrics-data")
+    p.add_argument("-httpListenAddr", default=":8428")
+    p.add_argument("-retentionPeriod", default="13m",
+                   help="duration: 30d, 13m(onths) etc")
+    p.add_argument("-dedup.minScrapeInterval", dest="dedup_interval",
+                   default="0s")
+    p.add_argument("-search.maxUniqueTimeseries", dest="max_series",
+                   type=int, default=300_000)
+    p.add_argument("-search.maxStalenessInterval", dest="lookback",
+                   default="5m")
+    p.add_argument("-search.tpuBackend", dest="tpu", action="store_true",
+                   help="route supported rollups to the TPU")
+    p.add_argument("-loggerLevel", default="INFO")
+    args, _ = p.parse_known_args(argv)
+    # env overrides: VM_STORAGEDATAPATH etc (envflag analog)
+    for name in vars(args):
+        env = os.environ.get("VM_" + name.upper().replace(".", "_"))
+        if env is not None:
+            cur = getattr(args, name)
+            setattr(args, name,
+                    type(cur)(env) if not isinstance(cur, bool)
+                    else env not in ("0", "false", ""))
+    return args
+
+
+def _dur_ms(s: str, months_ok=False) -> int:
+    from ..query.metricsql.parser import parse_duration_ms
+    s = s.strip()
+    if months_ok and s.endswith("m") and s[:-1].isdigit():
+        # retentionPeriod bare "13m" means months per reference semantics
+        return int(float(s[:-1]) * 31 * 86_400_000)
+    ms, step_based = parse_duration_ms(s)
+    return int(ms)
+
+
+def build(args):
+    from ..httpapi.prometheus_api import PrometheusAPI
+    from ..httpapi.server import HTTPServer
+    from ..storage.storage import Storage
+
+    retention = _dur_ms(args.retentionPeriod, months_ok=True)
+    dedup = _dur_ms(args.dedup_interval) if args.dedup_interval != "0s" else 0
+    storage = Storage(args.storageDataPath, retention_ms=retention,
+                      dedup_interval_ms=dedup)
+    tpu_engine = None
+    if args.tpu:
+        from ..query.tpu_engine import TPUEngine
+        tpu_engine = TPUEngine()
+    host, _, port = args.httpListenAddr.rpartition(":")
+    srv = HTTPServer(host or "0.0.0.0", int(port))
+    api = PrometheusAPI(storage, tpu_engine,
+                        lookback_delta=_dur_ms(args.lookback),
+                        max_series=args.max_series)
+    api.register(srv)
+    return storage, srv, api
+
+
+def main(argv=None):
+    import threading
+
+    args = parse_flags(argv)
+    logger.set_level(args.loggerLevel)
+    storage, srv, _api = build(args)
+    logger.infof("vmsingle started: data=%s listen=%s",
+                 args.storageDataPath, args.httpListenAddr)
+
+    # serve from a daemon thread; the main thread blocks on the stop event.
+    # Calling HTTPServer.shutdown() from inside a signal handler interrupting
+    # serve_forever deadlocks (shutdown() joins the loop it interrupted).
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    srv.start()
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        logger.infof("vmsingle: shutting down")
+        srv.stop()
+        storage.close()
+        logger.infof("vmsingle: shutdown complete")
+
+
+if __name__ == "__main__":
+    main()

