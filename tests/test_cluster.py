@@ -1,0 +1,281 @@
+"""Cluster tests (reference apptest/tests/{sharding,replication,
+vmsingle_vmselect_rpc}_test.go): N vmstorage nodes with real TCP RPC on
+localhost, vminsert sharding/replication/rerouting, vmselect scatter-gather
+with partial results."""
+
+import json
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.httpapi.prometheus_api import PrometheusAPI
+from victoriametrics_tpu.httpapi.server import HTTPServer
+from victoriametrics_tpu.parallel.cluster_api import (ClusterStorage,
+                                                      PartialResultError,
+                                                      StorageNodeClient,
+                                                      make_storage_handlers)
+from victoriametrics_tpu.parallel.consistenthash import ConsistentHash
+from victoriametrics_tpu.parallel.rpc import (HELLO_INSERT, HELLO_SELECT,
+                                              RPCServer)
+from victoriametrics_tpu.query.exec import exec_query
+from victoriametrics_tpu.query.types import EvalConfig
+from victoriametrics_tpu.storage.storage import Storage
+from victoriametrics_tpu.storage.tag_filters import filters_from_dict
+
+T0 = 1_753_700_000_000
+
+
+class StorageNode:
+    """One in-process vmstorage with real TCP RPC servers."""
+
+    def __init__(self, path):
+        self.storage = Storage(str(path))
+        handlers = make_storage_handlers(self.storage)
+        self.insert_srv = RPCServer("127.0.0.1", 0, HELLO_INSERT, handlers)
+        self.select_srv = RPCServer("127.0.0.1", 0, HELLO_SELECT, handlers)
+        self.insert_srv.start()
+        self.select_srv.start()
+
+    def client(self):
+        return StorageNodeClient("127.0.0.1", self.insert_srv.port,
+                                 self.select_srv.port)
+
+    def stop(self):
+        self.insert_srv.stop()
+        self.select_srv.stop()
+        self.storage.close()
+
+
+@pytest.fixture()
+def nodes3(tmp_path):
+    nodes = [StorageNode(tmp_path / f"n{i}") for i in range(3)]
+    yield nodes
+    for n in nodes:
+        try:
+            n.stop()
+        except Exception:
+            pass
+
+
+def seed_rows(n_series=30, n_samples=10):
+    rows = []
+    for i in range(n_series):
+        for j in range(n_samples):
+            rows.append(({"__name__": "cm", "idx": str(i)},
+                        T0 + j * 15_000, float(i * 100 + j)))
+    return rows
+
+
+class TestConsistentHash:
+    def test_stable_and_balanced(self):
+        ch = ConsistentHash(["a", "b", "c"])
+        keys = [f"key{i}".encode() for i in range(3000)]
+        place = [ch.nodes_for_key(k, 1)[0] for k in keys]
+        # stable
+        assert place == [ch.nodes_for_key(k, 1)[0] for k in keys]
+        # balanced within 30%
+        counts = [place.count(i) for i in range(3)]
+        assert min(counts) > 1000 * 0.7
+        # replication gives distinct nodes
+        reps = ch.nodes_for_key(b"x", 3)
+        assert len(set(reps)) == 3
+
+    def test_exclusion_reroutes_minimally(self):
+        ch = ConsistentHash(["a", "b", "c"])
+        keys = [f"key{i}".encode() for i in range(1000)]
+        base = [ch.nodes_for_key(k, 1)[0] for k in keys]
+        moved = 0
+        for k, b in zip(keys, base):
+            n = ch.nodes_for_key(k, 1, {2})[0]
+            if b != 2 and n != b:
+                moved += 1
+        assert moved == 0  # only keys on the excluded node move
+
+
+class TestClusterWriteRead:
+    def test_sharding_distributes_series(self, nodes3):
+        cluster = ClusterStorage([n.client() for n in nodes3])
+        cluster.add_rows(seed_rows())
+        for n in nodes3:
+            n.storage.force_flush()
+        per_node = [n.storage.series_count() for n in nodes3]
+        assert sum(per_node) == 30       # every series exactly once (RF=1)
+        assert all(c > 0 for c in per_node)  # spread across all nodes
+        res = cluster.search_series(
+            filters_from_dict({"__name__": "cm"}), T0, T0 + 10_000_000)
+        assert len(res) == 30
+        assert all(r.timestamps.size == 10 for r in res)
+        cluster.close()
+
+    def test_replication_and_dedup(self, nodes3):
+        cluster = ClusterStorage([n.client() for n in nodes3],
+                                 replication_factor=2)
+        cluster.add_rows(seed_rows())
+        per_node = [n.storage.series_count() for n in nodes3]
+        assert sum(per_node) == 60       # each series on exactly 2 nodes
+        res = cluster.search_series(
+            filters_from_dict({"__name__": "cm"}), T0, T0 + 10_000_000)
+        assert len(res) == 30            # replica dedup at read time
+        assert all(r.timestamps.size == 10 for r in res)
+        cluster.close()
+
+    def test_node_failure_rf2_full_results(self, nodes3):
+        cluster = ClusterStorage([n.client() for n in nodes3],
+                                 replication_factor=2)
+        cluster.add_rows(seed_rows())
+        nodes3[0].stop()
+        res = cluster.search_series(
+            filters_from_dict({"__name__": "cm"}), T0, T0 + 10_000_000)
+        assert cluster.last_partial      # a node failed...
+        assert len(res) == 30            # ...but RF=2 kept every series
+        cluster.close()
+
+    def test_node_failure_rf1_partial(self, nodes3):
+        cluster = ClusterStorage([n.client() for n in nodes3])
+        cluster.add_rows(seed_rows())
+        nodes3[1].stop()
+        res = cluster.search_series(
+            filters_from_dict({"__name__": "cm"}), T0, T0 + 10_000_000)
+        assert cluster.last_partial
+        assert 0 < len(res) < 30
+        cluster.close()
+
+    def test_deny_partial_response(self, nodes3):
+        cluster = ClusterStorage([n.client() for n in nodes3],
+                                 deny_partial_response=True)
+        cluster.add_rows(seed_rows())
+        nodes3[2].stop()
+        with pytest.raises(PartialResultError):
+            cluster.search_series(filters_from_dict({"__name__": "cm"}),
+                                  T0, T0 + 10_000_000)
+        cluster.close()
+
+    def test_write_rerouting_on_dead_node(self, nodes3):
+        clients = [n.client() for n in nodes3]
+        cluster = ClusterStorage(clients)
+        nodes3[0].stop()
+        cluster.add_rows(seed_rows())    # must not raise
+        assert cluster.reroutes >= 0
+        alive = [nodes3[1], nodes3[2]]
+        total = sum(n.storage.series_count() for n in alive)
+        assert total == 30               # everything landed on healthy nodes
+        cluster.close()
+
+    def test_label_apis_and_delete(self, nodes3):
+        cluster = ClusterStorage([n.client() for n in nodes3])
+        cluster.add_rows(seed_rows(n_series=6))
+        assert cluster.label_names() == ["__name__", "idx"]
+        assert cluster.label_values("idx") == [str(i) for i in range(6)]
+        assert cluster.series_count() == 6
+        st = cluster.tsdb_status()
+        assert st["totalSeries"] == 6
+        assert cluster.delete_series(
+            filters_from_dict({"idx": "0"})) == 1
+        res = cluster.search_series(filters_from_dict({"__name__": "cm"}),
+                                    T0, T0 + 10_000_000)
+        assert len(res) == 5
+        cluster.close()
+
+
+class TestClusterQueryEngine:
+    def test_metricsql_over_cluster(self, nodes3):
+        """vmselect semantics: the full query engine over ClusterStorage."""
+        cluster = ClusterStorage([n.client() for n in nodes3],
+                                 replication_factor=2)
+        rows = []
+        for i in range(12):
+            for j in range(41):
+                rows.append(({"__name__": "reqs", "inst": f"h{i % 4}",
+                              "cpu": str(i)}, T0 + j * 15_000,
+                             float(10 * j)))  # rate 2/3 per series
+        cluster.add_rows(rows)
+        ec = EvalConfig(start=T0 + 300_000, end=T0 + 600_000, step=60_000,
+                        storage=cluster)
+        out = exec_query(ec, "sum by (inst) (rate(reqs[5m]))")
+        assert len(out) == 4
+        for ts in out:
+            np.testing.assert_allclose(ts.values, 3 * 10 / 15, rtol=1e-9)
+        cluster.close()
+
+    def test_http_cluster_roundtrip(self, nodes3, tmp_path):
+        """vminsert + vmselect HTTP front-ends over the same nodes."""
+        from tests.apptest_helpers import Client
+        insert_cluster = ClusterStorage([n.client() for n in nodes3])
+        select_cluster = ClusterStorage([n.client() for n in nodes3])
+        isrv = HTTPServer("127.0.0.1", 0)
+        PrometheusAPI(insert_cluster).register(isrv, mode="insert")
+        isrv.start()
+        ssrv = HTTPServer("127.0.0.1", 0)
+        PrometheusAPI(select_cluster).register(ssrv, mode="select")
+        ssrv.start()
+        ic, sc = Client(isrv.port), Client(ssrv.port)
+        line = json.dumps({"metric": {"__name__": "hm", "a": "b"},
+                           "values": [4.5], "timestamps": [T0]})
+        code, _ = ic.post("/api/v1/import", line.encode())
+        assert code == 204
+        res = sc.query("hm", T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["value"][1] == "4.5"
+        assert res["isPartial"] is False
+        # insert node must not serve queries, select node must not ingest
+        code, _ = ic.get("/api/v1/query", query="hm")
+        assert code == 404
+        code, _ = sc.post("/api/v1/import", line.encode())
+        assert code == 404
+        isrv.stop()
+        ssrv.stop()
+        insert_cluster.close()
+        select_cluster.close()
+
+
+class TestRPCFailureHandling:
+    def test_no_deadlock_on_dead_node_concurrent_calls(self, tmp_path):
+        """Regression: RPCClient.close() under the connection lock
+        self-deadlocked when a transport error hit mid-call, hanging every
+        later caller (found by kill -9 probing a real cluster)."""
+        import threading
+        node = StorageNode(tmp_path / "n")
+        client = node.client()
+        client.write_rows([(b"m", T0, 1.0)])  # establish connections
+        node.stop()  # sockets die under the client
+        errs, done = [], []
+
+        def caller():
+            try:
+                client.search_series(
+                    filters_from_dict({"__name__": "m"}), T0, T0 + 1000)
+            except Exception as e:
+                errs.append(type(e).__name__)
+            done.append(1)
+
+        ths = [threading.Thread(target=caller, daemon=True) for _ in range(3)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=15)
+        assert len(done) == 3, "callers deadlocked on the connection lock"
+        assert len(errs) == 3  # all failed cleanly, none hung
+        client.close()
+
+    def test_stale_connection_retries_after_node_restart(self, tmp_path):
+        """A kept-alive connection to a restarted node must transparently
+        reconnect (write lands in the send buffer; failure shows at read)."""
+        node = StorageNode(tmp_path / "n")
+        insert_port = node.insert_srv.port
+        select_port = node.select_srv.port
+        client = StorageNodeClient("127.0.0.1", insert_port, select_port)
+        client.write_rows([(b"m1", T0, 1.0)])
+        node.insert_srv.stop()
+        node.select_srv.stop()
+        # restart RPC servers on the same ports over the same storage
+        from victoriametrics_tpu.parallel.rpc import RPCServer
+        handlers = make_storage_handlers(node.storage)
+        node.insert_srv = RPCServer("127.0.0.1", insert_port, HELLO_INSERT,
+                                    handlers)
+        node.select_srv = RPCServer("127.0.0.1", select_port, HELLO_SELECT,
+                                    handlers)
+        node.insert_srv.start()
+        node.select_srv.start()
+        client.write_rows([(b"m2", T0, 2.0)])  # must not raise
+        assert node.storage.series_count() >= 1
+        client.close()
+        node.stop()

@@ -1,0 +1,76 @@
+"""vminsert: ingestion router (reference app/vminsert in cluster mode):
+accepts every ingest protocol over HTTP and shards rows across vmstorage
+nodes by consistent hash with replication + rerouting."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+
+from ..utils import logger
+
+
+def parse_flags(argv=None):
+    p = argparse.ArgumentParser(prog="vminsert")
+    p.add_argument("-storageNode", action="append", default=[],
+                   help="host:insertPort:selectPort, repeatable")
+    p.add_argument("-httpListenAddr", default=":8480")
+    p.add_argument("-replicationFactor", type=int, default=1)
+    p.add_argument("-loggerLevel", default="INFO")
+    args, _ = p.parse_known_args(argv)
+    env = os.environ.get("VM_STORAGENODE")
+    if env:
+        args.storageNode = env.split(",")
+    return args
+
+
+def make_nodes(specs: list[str]):
+    from ..parallel.cluster_api import StorageNodeClient
+    nodes = []
+    for spec in specs:
+        host, ip_, sp_ = spec.rsplit(":", 2)
+        nodes.append(StorageNodeClient(host, int(ip_), int(sp_)))
+    return nodes
+
+
+def build(args):
+    from ..httpapi.prometheus_api import PrometheusAPI
+    from ..httpapi.server import HTTPServer
+    from ..parallel.cluster_api import ClusterStorage
+
+    if not args.storageNode:
+        raise SystemExit("vminsert: at least one -storageNode is required")
+    cluster = ClusterStorage(make_nodes(args.storageNode),
+                             replication_factor=args.replicationFactor)
+    hh, _, hp = args.httpListenAddr.rpartition(":")
+    srv = HTTPServer(hh or "0.0.0.0", int(hp))
+    api = PrometheusAPI(cluster)
+    api.register(srv, mode="insert")
+    return cluster, srv, api
+
+
+def main(argv=None):
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1)
+    args = parse_flags(argv)
+    logger.set_level(args.loggerLevel)
+    cluster, srv, _ = build(args)
+    srv.start()
+    logger.infof("vminsert started: nodes=%d rf=%d http=%d",
+                 len(cluster.nodes), cluster.rf, srv.port)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        srv.stop()
+        cluster.close()
+        logger.infof("vminsert: shutdown complete")
+
+
+if __name__ == "__main__":
+    main()

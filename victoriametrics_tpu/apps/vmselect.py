@@ -1,0 +1,74 @@
+"""vmselect: query node (reference app/vmselect in cluster mode): the full
+MetricsQL engine over a scatter-gather ClusterStorage backend, with
+partial-result tracking (isPartial) and -search.denyPartialResponse."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+
+from ..utils import logger
+from .vminsert import make_nodes
+
+
+def parse_flags(argv=None):
+    p = argparse.ArgumentParser(prog="vmselect")
+    p.add_argument("-storageNode", action="append", default=[],
+                   help="host:insertPort:selectPort, repeatable")
+    p.add_argument("-httpListenAddr", default=":8481")
+    p.add_argument("-search.denyPartialResponse", dest="deny_partial",
+                   action="store_true")
+    p.add_argument("-search.tpuBackend", dest="tpu", action="store_true")
+    p.add_argument("-loggerLevel", default="INFO")
+    args, _ = p.parse_known_args(argv)
+    env = os.environ.get("VM_STORAGENODE")
+    if env:
+        args.storageNode = env.split(",")
+    return args
+
+
+def build(args):
+    from ..httpapi.prometheus_api import PrometheusAPI
+    from ..httpapi.server import HTTPServer
+    from ..parallel.cluster_api import ClusterStorage
+
+    if not args.storageNode:
+        raise SystemExit("vmselect: at least one -storageNode is required")
+    cluster = ClusterStorage(make_nodes(args.storageNode),
+                             deny_partial_response=args.deny_partial)
+    tpu_engine = None
+    if args.tpu:
+        from ..query.tpu_engine import TPUEngine
+        tpu_engine = TPUEngine()
+    hh, _, hp = args.httpListenAddr.rpartition(":")
+    srv = HTTPServer(hh or "0.0.0.0", int(hp))
+    api = PrometheusAPI(cluster, tpu_engine)
+    api.register(srv, mode="select")
+    return cluster, srv, api
+
+
+def main(argv=None):
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1)
+    args = parse_flags(argv)
+    logger.set_level(args.loggerLevel)
+    cluster, srv, _ = build(args)
+    srv.start()
+    logger.infof("vmselect started: nodes=%d http=%d", len(cluster.nodes),
+                 srv.port)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        srv.stop()
+        cluster.close()
+        logger.infof("vmselect: shutdown complete")
+
+
+if __name__ == "__main__":
+    main()

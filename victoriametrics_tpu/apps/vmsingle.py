@@ -79,6 +79,8 @@ def build(args):
 
 def main(argv=None):
     import threading
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1)
 
     args = parse_flags(argv)
     logger.set_level(args.loggerLevel)

@@ -148,21 +148,27 @@ class PrometheusAPI:
 
     # -- wiring ------------------------------------------------------------
 
-    def register(self, srv: HTTPServer):
+    def register(self, srv: HTTPServer, mode: str = "all"):
+        """mode: 'all' (vmsingle), 'insert' (vminsert), 'select' (vmselect)
+        — mirrors the reference's one-codebase three-role composition."""
         self.srv = srv
+        if mode in ("all", "insert"):
+            self._register_insert(srv)
+        if mode in ("all", "select"):
+            self._register_select(srv)
+        srv.route("/metrics", self.h_metrics)
+        srv.route("/health", lambda req: Response.text("OK"))
+        srv.route("/-/healthy", lambda req: Response.text("OK"))
+        srv.route("/-/ready", lambda req: Response.text("OK"))
+
+    def _register_insert(self, srv: HTTPServer):
         r = srv.route
-        r("/api/v1/query", self.h_query)
-        r("/api/v1/query_range", self.h_query_range)
-        r("/api/v1/series", self.h_series)
-        r("/api/v1/labels", self.h_labels)
-        r("/api/v1/label/", self.h_label_values)
-        r("/api/v1/export", self.h_export)
-        r("/api/v1/import", self.h_import)
-        r("/api/v1/import/prometheus", self.h_import_prometheus)
-        r("/api/v1/import/csv", self.h_import_csv)
         r("/api/v1/write", self.h_remote_write)
         r("/api/v1/push", self.h_remote_write)
         r("/prometheus/api/v1/write", self.h_remote_write)
+        r("/api/v1/import", self.h_import)
+        r("/api/v1/import/prometheus", self.h_import_prometheus)
+        r("/api/v1/import/csv", self.h_import_csv)
         r("/write", self.h_influx_write)
         r("/influx/write", self.h_influx_write)
         r("/api/put", self.h_opentsdb_http)
@@ -172,21 +178,28 @@ class PrometheusAPI:
         r("/datadog/api/v2/series", self.h_datadog_v2)
         r("/datadog/api/v1/validate", lambda req: Response.json({"valid": True}))
         r("/newrelic/infra/v2/metrics/events/bulk", self.h_newrelic)
+
+    def _register_select(self, srv: HTTPServer):
+        r = srv.route
+        r("/api/v1/query", self.h_query)
+        r("/api/v1/query_range", self.h_query_range)
+        r("/api/v1/series", self.h_series)
+        r("/api/v1/labels", self.h_labels)
+        r("/api/v1/label/", self.h_label_values)
+        r("/api/v1/export", self.h_export)
         r("/api/v1/admin/tsdb/delete_series", self.h_delete_series)
         r("/api/v1/status/tsdb", self.h_status_tsdb)
         r("/api/v1/status/active_queries", self.h_active_queries)
         r("/api/v1/status/top_queries", self.h_top_queries)
         r("/federate", self.h_federate)
-        r("/metrics", self.h_metrics)
-        r("/health", lambda req: Response.text("OK"))
-        r("/-/healthy", lambda req: Response.text("OK"))
-        r("/-/ready", lambda req: Response.text("OK"))
-        r("/snapshot/create", self.h_snapshot_create)
-        r("/snapshot/list", self.h_snapshot_list)
-        r("/snapshot/delete", self.h_snapshot_delete)
-        r("/snapshot/delete_all", self.h_snapshot_delete_all)
-        r("/internal/force_flush", self.h_force_flush)
-        r("/internal/force_merge", self.h_force_merge)
+        if hasattr(self.storage, "create_snapshot"):
+            r("/snapshot/create", self.h_snapshot_create)
+            r("/snapshot/list", self.h_snapshot_list)
+            r("/snapshot/delete", self.h_snapshot_delete)
+            r("/snapshot/delete_all", self.h_snapshot_delete_all)
+        if hasattr(self.storage, "force_flush"):
+            r("/internal/force_flush", self.h_force_flush)
+            r("/internal/force_merge", self.h_force_merge)
 
     # -- query -------------------------------------------------------------
 
@@ -205,6 +218,8 @@ class PrometheusAPI:
         step = parse_step(req.arg("step"), 300_000)
         qid = self.active.register(q, ts, ts, step)
         t0 = time.perf_counter()
+        if hasattr(self.storage, "reset_partial"):
+            self.storage.reset_partial()
         try:
             ec = self._ec(ts, ts, step)
             rows = exec_query(ec, q)
@@ -221,6 +236,8 @@ class PrometheusAPI:
             result.append({"metric": r.metric_name.to_dict(),
                            "value": [ts / 1e3, _fmt_value(v)]})
         return Response.json({"status": "success",
+                              "isPartial": bool(getattr(self.storage,
+                                                        "last_partial", False)),
                               "data": {"resultType": "vector",
                                        "result": result}})
 
@@ -236,6 +253,8 @@ class PrometheusAPI:
             return Response.error("end < start")
         qid = self.active.register(q, start, end, step)
         t0 = time.perf_counter()
+        if hasattr(self.storage, "reset_partial"):
+            self.storage.reset_partial()
         try:
             ec = self._ec(start, end, step)
             rows = exec_query(ec, q)
@@ -254,6 +273,8 @@ class PrometheusAPI:
                 result.append({"metric": r.metric_name.to_dict(),
                                "values": vals})
         return Response.json({"status": "success",
+                              "isPartial": bool(getattr(self.storage,
+                                                        "last_partial", False)),
                               "data": {"resultType": "matrix",
                                        "result": result}})
 

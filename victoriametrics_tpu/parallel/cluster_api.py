@@ -1,0 +1,438 @@
+"""Cluster node APIs over RPC (reference lib/vminsertapi/api.go +
+lib/vmselectapi/{api,server}.go + the cluster-branch netstorage semantics
+documented in docs/victoriametrics/Cluster-VictoriaMetrics.md:851+).
+
+- make_storage_handlers(storage): RPC method table served by vmstorage
+  (both the insert-side writeRows_v1 and the select-side search_v1 family).
+- StorageNodeClient: client half for one storage node.
+- ClusterStorage: vminsert+vmselect composite backend — shards writes by
+  consistent hash of the canonical metric name with replication and
+  rerouting, fans reads out to every node and merges with partial-result
+  tracking. Duck-compatible with storage.Storage for httpapi/query use.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..storage.metric_name import MetricName
+from ..storage.tag_filters import TagFilter
+from ..utils import logger
+from .consistenthash import ConsistentHash
+from .rpc import HELLO_INSERT, HELLO_SELECT, RPCClient, RPCError, Reader, Writer
+
+SERIES_PER_FRAME = 64
+
+
+# ---------------------------------------------------------------------------
+# vmstorage-side handlers
+# ---------------------------------------------------------------------------
+
+def _read_filters(r: Reader) -> list[TagFilter]:
+    n = r.u64()
+    out = []
+    for _ in range(n):
+        key = r.bytes_()
+        value = r.bytes_()
+        flags = r.u64()
+        out.append(TagFilter(key, value, negate=bool(flags & 1),
+                             regex=bool(flags & 2)))
+    return out
+
+
+def _write_filters(w: Writer, filters: list[TagFilter]):
+    w.u64(len(filters))
+    for tf in filters:
+        w.bytes_(tf.key)
+        w.bytes_(tf.value)
+        w.u64((1 if tf.negate else 0) | (2 if tf.regex else 0))
+
+
+def make_storage_handlers(storage) -> dict:
+    """RPC dispatch table for a vmstorage node."""
+
+    def h_write_rows(r: Reader):
+        n = r.u64()
+        rows = []
+        for _ in range(n):
+            raw = r.bytes_()
+            ts = r.i64()
+            val = r.f64()
+            rows.append((MetricName.unmarshal(raw), ts, val))
+        storage.add_rows(rows)
+        return Writer().u64(len(rows))
+
+    def h_is_readonly(r: Reader):
+        return Writer().u64(1 if storage.is_readonly else 0)
+
+    def h_search(r: Reader):
+        filters = _read_filters(r)
+        min_ts, max_ts = r.i64(), r.i64()
+        series = storage.search_series(filters, min_ts, max_ts)
+
+        def frames():
+            for i in range(0, len(series), SERIES_PER_FRAME):
+                w = Writer()
+                chunk = series[i:i + SERIES_PER_FRAME]
+                w.u64(len(chunk))
+                for sd in chunk:
+                    w.bytes_(sd.metric_name.marshal())
+                    w.array(sd.timestamps)
+                    w.array(sd.values)
+                yield w
+        return frames()
+
+    def h_search_metric_names(r: Reader):
+        filters = _read_filters(r)
+        min_ts, max_ts = r.i64(), r.i64()
+        names = storage.search_metric_names(filters, min_ts, max_ts)
+        w = Writer().u64(len(names))
+        for mn in names:
+            w.bytes_(mn.marshal())
+        return w
+
+    def h_label_names(r: Reader):
+        min_ts, max_ts = r.i64(), r.i64()
+        names = storage.label_names(min_ts or None, max_ts or None)
+        w = Writer().u64(len(names))
+        for n in names:
+            w.str_(n)
+        return w
+
+    def h_label_values(r: Reader):
+        key = r.str_()
+        min_ts, max_ts = r.i64(), r.i64()
+        vals = storage.label_values(key, min_ts or None, max_ts or None)
+        w = Writer().u64(len(vals))
+        for v in vals:
+            w.str_(v)
+        return w
+
+    def h_delete_series(r: Reader):
+        filters = _read_filters(r)
+        return Writer().u64(storage.delete_series(filters))
+
+    def h_series_count(r: Reader):
+        return Writer().u64(storage.series_count())
+
+    def h_tsdb_status(r: Reader):
+        import json
+        topn = r.u64()
+        date_plus1 = r.u64()  # 0 = no date filter
+        st = storage.tsdb_status(date_plus1 - 1 if date_plus1 else None, topn)
+        return Writer().bytes_(json.dumps(st).encode())
+
+    def h_register_metric_names(r: Reader):
+        n = r.u64()
+        names = [MetricName.unmarshal(r.bytes_()) for _ in range(n)]
+        storage.register_metric_names(names)
+        return Writer().u64(n)
+
+    return {
+        "writeRows_v1": h_write_rows,
+        "isReadOnly_v1": h_is_readonly,
+        "search_v1": h_search,
+        "searchMetricNames_v1": h_search_metric_names,
+        "labelNames_v1": h_label_names,
+        "labelValues_v1": h_label_values,
+        "deleteSeries_v1": h_delete_series,
+        "seriesCount_v1": h_series_count,
+        "tsdbStatus_v1": h_tsdb_status,
+        "registerMetricNames_v1": h_register_metric_names,
+    }
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+class StorageNodeClient:
+    def __init__(self, host: str, insert_port: int, select_port: int,
+                 name: str | None = None):
+        self.name = name or f"{host}:{insert_port}"
+        self.insert = RPCClient(host, insert_port, HELLO_INSERT)
+        self.select = RPCClient(host, select_port, HELLO_SELECT)
+        self.down_until = 0.0
+
+    @property
+    def healthy(self) -> bool:
+        return time.monotonic() >= self.down_until
+
+    def mark_down(self, seconds: float = 2.0):
+        self.down_until = time.monotonic() + seconds
+        logger.warnf("storage node %s marked down for %.1fs", self.name,
+                     seconds)
+
+    def write_rows(self, rows: list[tuple[bytes, int, float]]):
+        w = Writer().u64(len(rows))
+        for raw, ts, val in rows:
+            w.bytes_(raw)
+            w.i64(int(ts))
+            w.f64(float(val))
+        self.insert.call("writeRows_v1", w)
+
+    def search_series(self, filters, min_ts, max_ts):
+        w = Writer()
+        _write_filters(w, filters)
+        w.i64(min_ts).i64(max_ts)
+        out = []
+        for r in self.select.call_stream("search_v1", w):
+            n = r.u64()
+            for _ in range(n):
+                mn = MetricName.unmarshal(r.bytes_())
+                ts = r.array()
+                vals = r.array()
+                out.append((mn, ts, vals))
+        return out
+
+    def search_metric_names(self, filters, min_ts, max_ts):
+        w = Writer()
+        _write_filters(w, filters)
+        w.i64(min_ts).i64(max_ts)
+        r = self.select.call("searchMetricNames_v1", w)
+        return [MetricName.unmarshal(r.bytes_()) for _ in range(r.u64())]
+
+    def label_names(self, min_ts, max_ts):
+        w = Writer().i64(min_ts or 0).i64(max_ts or 0)
+        r = self.select.call("labelNames_v1", w)
+        return [r.str_() for _ in range(r.u64())]
+
+    def label_values(self, key, min_ts, max_ts):
+        w = Writer().str_(key).i64(min_ts or 0).i64(max_ts or 0)
+        r = self.select.call("labelValues_v1", w)
+        return [r.str_() for _ in range(r.u64())]
+
+    def delete_series(self, filters):
+        w = Writer()
+        _write_filters(w, filters)
+        return self.select.call("deleteSeries_v1", w).u64()
+
+    def series_count(self):
+        return self.select.call("seriesCount_v1", Writer()).u64()
+
+    def tsdb_status(self, topn, date=None):
+        import json
+        w = Writer().u64(topn).u64(0 if date is None else date + 1)
+        r = self.select.call("tsdbStatus_v1", w)
+        return json.loads(r.bytes_())
+
+    def close(self):
+        self.insert.close()
+        self.select.close()
+
+
+# ---------------------------------------------------------------------------
+# ClusterStorage: the vminsert/vmselect composite backend
+# ---------------------------------------------------------------------------
+
+class PartialResultError(RuntimeError):
+    pass
+
+
+class SeriesData:
+    __slots__ = ("metric_name", "timestamps", "values")
+
+    def __init__(self, mn, ts, vals):
+        self.metric_name = mn
+        self.timestamps = ts
+        self.values = vals
+
+
+class ClusterStorage:
+    """Shard writes / fan-out reads across storage nodes."""
+
+    def __init__(self, nodes: list[StorageNodeClient],
+                 replication_factor: int = 1,
+                 deny_partial_response: bool = False):
+        self.nodes = nodes
+        self.rf = replication_factor
+        self.deny_partial = deny_partial_response
+        self.ch = ConsistentHash([n.name for n in nodes])
+        self.rows_sent = 0
+        self.reroutes = 0
+        self._lock = threading.Lock()
+        # partial-result tracking is per handler thread and STICKY across
+        # the fanouts of one query (a shared flag would race between
+        # concurrent queries and be cleared by a later clean fanout)
+        self._tls = threading.local()
+
+    def reset_partial(self):
+        self._tls.partial = False
+
+    @property
+    def last_partial(self) -> bool:
+        return bool(getattr(self._tls, "partial", False))
+
+    # -- write path (vminsert) ------------------------------------------
+
+    def add_rows(self, rows) -> int:
+        """rows: [(labels-dict-or-MetricName, ts, value)] — shard by
+        canonical metric name, replicate RF-ways, reroute on failure."""
+        per_node: dict[int, list] = {}
+        excluded = {i for i, n in enumerate(self.nodes) if not n.healthy}
+        for labels, ts, val in rows:
+            mn = labels if isinstance(labels, MetricName) else \
+                MetricName.from_dict(labels) if isinstance(labels, dict) \
+                else MetricName.from_labels(labels)
+            raw = mn.marshal()
+            targets = self.ch.nodes_for_key(raw, self.rf, excluded)
+            if not targets:
+                # all nodes down: try everything anyway
+                targets = self.ch.nodes_for_key(raw, self.rf, set())
+            for i in targets:
+                per_node.setdefault(i, []).append((raw, ts, val))
+        sent = 0
+        for i, node_rows in per_node.items():
+            node = self.nodes[i]
+            try:
+                node.write_rows(node_rows)
+                sent += len(node_rows)
+            except (OSError, RPCError, ConnectionError) as e:
+                node.mark_down()
+                with self._lock:
+                    self.reroutes += 1
+                # regroup the failed batch by alternate node: one RPC per
+                # target, not one per row
+                ex = {j for j, n in enumerate(self.nodes)
+                      if not n.healthy} | {i}
+                alt_batches: dict[int, list] = {}
+                for row in node_rows:
+                    alt = self.ch.nodes_for_key(row[0], 1, ex)
+                    if not alt:
+                        raise RPCError(
+                            f"no healthy storage nodes for reroute: {e}")
+                    alt_batches.setdefault(alt[0], []).append(row)
+                for j, batch in alt_batches.items():
+                    self.nodes[j].write_rows(batch)
+                    sent += len(batch)
+        self.rows_sent += sent
+        return len(rows)
+
+    # -- read path (vmselect) -------------------------------------------
+
+    def _fanout(self, fn):
+        """Run fn(node) on every healthy node concurrently (scatter-gather;
+        the reference fans out to all vmstorage nodes in parallel). Known-down
+        nodes are skipped but still count toward the partial flag."""
+        results: list = []
+        errors: list = []
+        lock = threading.Lock()
+
+        def run(node):
+            try:
+                r = fn(node)
+                with lock:
+                    results.append(r)
+            except (OSError, RPCError, ConnectionError) as e:
+                node.mark_down()
+                with lock:
+                    errors.append((node.name, e))
+
+        live = [n for n in self.nodes if n.healthy]
+        for n in self.nodes:
+            if not n.healthy:
+                errors.append((n.name, RPCError("node marked down")))
+        if len(live) <= 1:
+            for n in live:
+                run(n)
+        else:
+            threads = [threading.Thread(target=run, args=(n,), daemon=True)
+                       for n in live]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if errors and not results:
+            raise RPCError(f"all storage nodes failed: {errors[0][1]}")
+        if errors:
+            self._tls.partial = True
+        if errors and self.deny_partial:
+            raise PartialResultError(
+                f"partial response denied: {errors[0][0]}: {errors[0][1]}")
+        return results
+
+    def search_series(self, filters, min_ts, max_ts, dedup_interval_ms=None,
+                      max_series=None):
+        node_results = self._fanout(
+            lambda n: n.search_series(filters, min_ts, max_ts))
+        merged: dict[bytes, list] = {}
+        names: dict[bytes, MetricName] = {}
+        for res in node_results:
+            for mn, ts, vals in res:
+                raw = mn.marshal()
+                merged.setdefault(raw, []).append((ts, vals))
+                names.setdefault(raw, mn)
+        out = []
+        for raw, chunks in merged.items():
+            if len(chunks) == 1:
+                ts, vals = chunks[0]
+            else:
+                ts = np.concatenate([c[0] for c in chunks])
+                vals = np.concatenate([c[1] for c in chunks])
+                order = np.argsort(ts, kind="stable")
+                ts, vals = ts[order], vals[order]
+                # replica dedup: collapse equal timestamps (keep last)
+                if ts.size > 1:
+                    dup = np.concatenate([ts[1:] == ts[:-1], [False]])
+                    ts, vals = ts[~dup], vals[~dup]
+            out.append(SeriesData(names[raw], ts, vals))
+        if max_series is not None and len(out) > max_series:
+            raise ResourceWarning(
+                f"query matches {len(out)} series, limit {max_series}")
+        out.sort(key=lambda s: s.metric_name.marshal())
+        return out
+
+    def search_metric_names(self, filters, min_ts, max_ts, limit=2**31):
+        node_results = self._fanout(
+            lambda n: n.search_metric_names(filters, min_ts, max_ts))
+        seen = {}
+        for res in node_results:
+            for mn in res:
+                seen.setdefault(mn.marshal(), mn)
+        return [seen[k] for k in sorted(seen)][:limit]
+
+    def label_names(self, min_ts=None, max_ts=None):
+        res = self._fanout(lambda n: n.label_names(min_ts, max_ts))
+        return sorted(set().union(*map(set, res))) if res else []
+
+    def label_values(self, key, min_ts=None, max_ts=None):
+        res = self._fanout(lambda n: n.label_values(key, min_ts, max_ts))
+        return sorted(set().union(*map(set, res))) if res else []
+
+    def delete_series(self, filters):
+        return sum(self._fanout(lambda n: n.delete_series(filters)))
+
+    def series_count(self):
+        return sum(self._fanout(lambda n: n.series_count()))
+
+    def tsdb_status(self, date=None, topn=10):
+        results = self._fanout(lambda n: n.tsdb_status(topn, date))
+        total = sum(r["totalSeries"] for r in results)
+
+        def merge_top(key):
+            acc = {}
+            for r in results:
+                for e in r.get(key, []):
+                    acc[e["name"]] = acc.get(e["name"], 0) + e["count"]
+            return [{"name": k, "count": c} for k, c in
+                    sorted(acc.items(), key=lambda kv: -kv[1])[:topn]]
+
+        return {"totalSeries": total,
+                "seriesCountByMetricName": merge_top("seriesCountByMetricName"),
+                "seriesCountByLabelName": merge_top("seriesCountByLabelName"),
+                "seriesCountByLabelValuePair":
+                    merge_top("seriesCountByLabelValuePair")}
+
+    def metrics(self):
+        return {"vm_cluster_nodes": len(self.nodes),
+                "vm_cluster_rows_sent_total": self.rows_sent,
+                "vm_cluster_reroutes_total": self.reroutes,
+                "vm_cluster_healthy_nodes":
+                    sum(1 for n in self.nodes if n.healthy)}
+
+    def close(self):
+        for n in self.nodes:
+            n.close()

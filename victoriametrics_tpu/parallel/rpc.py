@@ -1,0 +1,289 @@
+"""Cluster RPC: length-prefixed binary frames over TCP with a versioned
+handshake and negotiated zstd compression (reference lib/handshake/
+handshake.go:17-160 + lib/vmselectapi/server.go framing).
+
+Frame: u32 BE length + payload. Payload (optionally zstd): method name
+(varuint len + bytes) + method-specific body. Responses: status byte
+(0=ok, 1=error+message) + body. Calls are versioned through their method
+names ("writeRows_v1", "search_v1", ...) for rolling-upgrade compat.
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from ..ops import compress as zstd
+from ..ops.varint import marshal_varuint64, unmarshal_varuint64
+from ..utils import logger
+
+HELLO_INSERT = b"vmtpu-insert.v1\n"
+HELLO_SELECT = b"vmtpu-select.v1\n"
+HELLO_OK = b"ok:zstd\n"
+
+_U32 = struct.Struct(">I")
+MAX_FRAME = 256 << 20
+
+
+class RPCError(RuntimeError):
+    pass
+
+
+def _read_exact(sock_file, n: int) -> bytes:
+    data = sock_file.read(n)
+    if data is None or len(data) != n:
+        raise ConnectionError("rpc: connection closed mid-frame")
+    return data
+
+
+def write_frame(sock_file, payload: bytes, compress: bool = True):
+    if compress:
+        payload = zstd.compress(payload)
+    sock_file.write(_U32.pack(len(payload)) + payload)
+    sock_file.flush()
+
+
+def read_frame(sock_file, compressed: bool = True) -> bytes:
+    n = _U32.unpack(_read_exact(sock_file, 4))[0]
+    if n > MAX_FRAME:
+        raise RPCError(f"rpc frame too large: {n}")
+    data = _read_exact(sock_file, n)
+    return zstd.decompress(data) if compressed else data
+
+
+# -- marshaling helpers ------------------------------------------------------
+
+class Writer:
+    def __init__(self):
+        self.buf = bytearray()
+
+    def bytes_(self, b: bytes):
+        self.buf += marshal_varuint64(len(b))
+        self.buf += b
+        return self
+
+    def str_(self, s: str):
+        return self.bytes_(s.encode())
+
+    def u64(self, x: int):
+        self.buf += marshal_varuint64(x)
+        return self
+
+    def i64(self, x: int):
+        self.buf += struct.pack(">q", x)
+        return self
+
+    def f64(self, x: float):
+        self.buf += struct.pack(">d", x)
+        return self
+
+    def array(self, a: np.ndarray):
+        raw = np.ascontiguousarray(a).tobytes()
+        self.bytes_(str(a.dtype).encode())
+        return self.bytes_(raw)
+
+    def payload(self) -> bytes:
+        return bytes(self.buf)
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.i = 0
+
+    def bytes_(self) -> bytes:
+        n, self.i = unmarshal_varuint64(self.data, self.i)
+        out = self.data[self.i:self.i + n]
+        if len(out) != n:
+            raise RPCError("rpc: truncated bytes field")
+        self.i += n
+        return out
+
+    def str_(self) -> str:
+        return self.bytes_().decode()
+
+    def u64(self) -> int:
+        v, self.i = unmarshal_varuint64(self.data, self.i)
+        return v
+
+    def i64(self) -> int:
+        v = struct.unpack_from(">q", self.data, self.i)[0]
+        self.i += 8
+        return v
+
+    def f64(self) -> float:
+        v = struct.unpack_from(">d", self.data, self.i)[0]
+        self.i += 8
+        return v
+
+    def array(self) -> np.ndarray:
+        dtype = self.bytes_().decode()
+        raw = self.bytes_()
+        return np.frombuffer(raw, dtype=dtype).copy()
+
+    @property
+    def remaining(self) -> int:
+        return len(self.data) - self.i
+
+
+# -- server ------------------------------------------------------------------
+
+class RPCServer:
+    """TCP server dispatching named methods. Handlers: fn(Reader) -> Writer
+    or an iterator of Writers for streaming responses (each streamed frame is
+    prefixed with status 2; final frame status 0)."""
+
+    def __init__(self, addr: str, port: int, hello: bytes,
+                 handlers: dict[str, object], max_conns: int = 64):
+        self.handlers = handlers
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    got = _read_exact(self.rfile, len(hello))
+                    if got != hello:
+                        self.wfile.write(b"bad hello\n")
+                        return
+                    self.wfile.write(HELLO_OK)
+                    self.wfile.flush()
+                    while True:
+                        try:
+                            req = read_frame(self.rfile)
+                        except (ConnectionError, RPCError):
+                            return
+                        outer._dispatch(req, self.wfile)
+                except (BrokenPipeError, ConnectionResetError):
+                    return
+
+        class Srv(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._srv = Srv((addr, port), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def _dispatch(self, req: bytes, wfile):
+        r = Reader(req)
+        try:
+            method = r.str_()
+            fn = self.handlers.get(method)
+            if fn is None:
+                raise RPCError(f"unknown rpc method {method!r}")
+            out = fn(r)
+            if hasattr(out, "__iter__") and not isinstance(out, Writer):
+                for w in out:
+                    write_frame(wfile, b"\x02" + w.payload())
+                write_frame(wfile, b"\x00")
+            else:
+                body = out.payload() if isinstance(out, Writer) else b""
+                write_frame(wfile, b"\x00" + body)
+        except Exception as e:  # noqa: BLE001 — rpc error boundary
+            logger.errorf("rpc handler error: %s", e)
+            try:
+                write_frame(wfile, b"\x01" + str(e).encode())
+            except OSError:
+                pass
+
+
+# -- client ------------------------------------------------------------------
+
+class RPCClient:
+    """One connection per client; callers serialize via a lock (the pool
+    layer holds several clients per node)."""
+
+    def __init__(self, host: str, port: int, hello: bytes, timeout=10.0):
+        self.addr = (host, port)
+        self.hello = hello
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock = None
+        self._f = None
+
+    def _connect(self):
+        sock = socket.create_connection(self.addr, timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        f = sock.makefile("rwb")
+        f.write(self.hello)
+        f.flush()
+        resp = f.read(len(HELLO_OK))
+        if resp != HELLO_OK:
+            raise RPCError(f"handshake failed: {resp!r}")
+        self._sock, self._f = sock, f
+
+    def close(self):
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self):
+        """Close without taking the lock — for use on paths already holding
+        self._lock (calling close() there self-deadlocks on the plain Lock)."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = self._f = None
+
+    def call(self, method: str, w: Writer | None = None) -> Reader:
+        """Unary call."""
+        frames = list(self.call_stream(method, w))
+        if frames:
+            return frames[0]
+        return Reader(b"")
+
+    def call_stream(self, method: str, w: Writer | None = None):
+        """Returns an iterator of Readers, one per streamed frame.
+
+        All frames are read under the lock BEFORE returning: a lazy
+        generator would keep the connection lock held while the caller
+        processes frames, and an abandoned generator (consumer error) would
+        leave it locked until GC — a deadlock under failure. Any transport
+        error also tears the connection down so a half-read stream can never
+        poison the next call."""
+        req = Writer().str_(method)
+        if w is not None:
+            req.buf += w.buf
+        frames: list[Reader] = []
+        with self._lock:
+            # A stale kept-alive connection (peer restarted) usually fails at
+            # the FIRST read, not the write (which lands in the send buffer),
+            # so retry once on a fresh connection as long as no frame has
+            # been received yet.
+            for attempt in (0, 1):
+                try:
+                    if self._f is None:
+                        self._connect()
+                    write_frame(self._f, req.payload())
+                    while True:
+                        resp = read_frame(self._f)
+                        status = resp[0]
+                        if status == 0:
+                            if len(resp) > 1:
+                                frames.append(Reader(resp[1:]))
+                            return iter(frames)
+                        if status == 1:
+                            # server-reported error: stream is cleanly
+                            # terminated, the connection stays usable
+                            raise RPCError(resp[1:].decode())
+                        frames.append(Reader(resp[1:]))
+                except RPCError:
+                    raise
+                except (OSError, ConnectionError, TimeoutError):
+                    self._close_locked()
+                    if attempt == 1 or frames:
+                        raise
+        return iter(frames)
