@@ -1,0 +1,177 @@
+"""Relabeling + stream aggregation tests (reference lib/promrelabel/
+relabel_test.go + lib/streamaggr/streamaggr_test.go coverage style)."""
+
+import math
+
+import pytest
+
+from victoriametrics_tpu.ingest.relabel import parse_relabel_configs
+from victoriametrics_tpu.ingest.streamaggr import (Aggregator, Deduplicator,
+                                                   StreamAggregators)
+
+T0 = 1_753_700_000_000
+
+
+def rl(yaml_text, labels):
+    return parse_relabel_configs(yaml_text).apply(labels)
+
+
+class TestRelabel:
+    def test_replace(self):
+        out = rl("""
+- source_labels: [a, b]
+  separator: "-"
+  target_label: ab
+  regex: "(.+)-(.+)"
+  replacement: "$2_$1"
+""", {"a": "x", "b": "y"})
+        assert out["ab"] == "y_x"
+
+    def test_replace_default_copies(self):
+        out = rl("- {source_labels: [a], target_label: b}", {"a": "v"})
+        assert out["b"] == "v"
+
+    def test_keep_drop(self):
+        cfg = '- {source_labels: [job], regex: "api|web", action: keep}'
+        assert rl(cfg, {"job": "api"}) is not None
+        assert rl(cfg, {"job": "db"}) is None
+        cfg = '- {source_labels: [job], regex: "db", action: drop}'
+        assert rl(cfg, {"job": "db"}) is None
+        assert rl(cfg, {"job": "api"}) is not None
+
+    def test_keep_drop_metrics(self):
+        cfg = '- {regex: "http_.*", action: keep_metrics}'
+        assert rl(cfg, {"__name__": "http_requests"}) is not None
+        assert rl(cfg, {"__name__": "mem_bytes"}) is None
+
+    def test_hashmod(self):
+        out = rl("""
+- {source_labels: [i], modulus: 4, target_label: shard, action: hashmod}
+""", {"i": "host17"})
+        assert out["shard"] in {"0", "1", "2", "3"}
+
+    def test_labelmap(self):
+        out = rl('- {regex: "__meta_(.+)", action: labelmap}',
+                 {"__meta_dc": "eu", "keep": "1"})
+        assert out["dc"] == "eu" and out["__meta_dc"] == "eu"
+
+    def test_labeldrop_labelkeep(self):
+        out = rl('- {regex: "tmp_.*", action: labeldrop}',
+                 {"tmp_x": "1", "keep": "2"})
+        assert out == {"keep": "2"}
+        out = rl('- {regex: "keep", action: labelkeep}',
+                 {"__name__": "m", "keep": "2", "other": "3"})
+        assert out == {"__name__": "m", "keep": "2"}
+
+    def test_case_actions(self):
+        out = rl('- {source_labels: [a], target_label: a, action: uppercase}',
+                 {"a": "low"})
+        assert out["a"] == "LOW"
+
+    def test_keep_if_equal(self):
+        cfg = '- {source_labels: [a, b], action: keep_if_equal}'
+        assert rl(cfg, {"a": "x", "b": "x"}) is not None
+        assert rl(cfg, {"a": "x", "b": "y"}) is None
+
+    def test_if_guard(self):
+        cfg = """
+- if: '{job="api"}'
+  source_labels: [job]
+  target_label: matched
+  replacement: "yes"
+"""
+        assert rl(cfg, {"job": "api"})["matched"] == "yes"
+        assert "matched" not in rl(cfg, {"job": "db"})
+
+    def test_graphite(self):
+        out = rl("""
+- action: graphite
+  match: "foo.*.baz"
+  labels: {job: "$1_stats", __name__: "qux"}
+""", {"__name__": "foo.bar.baz"})
+        assert out["job"] == "bar_stats" and out["__name__"] == "qux"
+
+    def test_chain_drops_empty_values(self):
+        out = rl("""
+- {source_labels: [a], target_label: b}
+- {source_labels: [gone], target_label: a}
+""", {"a": "v"})
+        assert out == {"b": "v", "a": "v"} or out == {"b": "v"}
+
+
+class TestStreamAggr:
+    def collect(self):
+        rows = []
+        return rows, lambda batch: rows.extend(batch)
+
+    def test_sum_and_count_by(self):
+        rows, push = self.collect()
+        a = Aggregator({"interval": "60s", "outputs": ["sum_samples",
+                                                       "count_samples"],
+                        "by": ["job"]}, push)
+        for i in range(10):
+            a.push({"__name__": "m", "job": "api", "pod": f"p{i}"},
+                   T0 + i, float(i))
+        a.flush(T0 + 60_000)
+        byname = {r[0]["__name__"]: r for r in rows}
+        assert byname["m:1m_sum_samples"][2] == 45.0
+        assert byname["m:1m_count_samples"][2] == 10.0
+        assert byname["m:1m_sum_samples"][0]["job"] == "api"
+        assert "pod" not in byname["m:1m_sum_samples"][0]
+
+    def test_total_handles_counter_resets(self):
+        rows, push = self.collect()
+        a = Aggregator({"interval": "1m", "outputs": ["total"]}, push)
+        for ts, v in [(0, 10), (1, 20), (2, 5), (3, 8)]:  # reset at 5
+            a.push({"__name__": "c"}, T0 + ts * 1000, float(v))
+        a.flush(T0 + 60_000)
+        # initial 10 + 10 + (reset->5) + 3
+        assert rows[0][2] == 28.0
+
+    def test_quantiles_and_unique(self):
+        rows, push = self.collect()
+        a = Aggregator({"interval": "1m",
+                        "outputs": ["quantiles(0.5)", "unique_samples"]},
+                       push)
+        for v in [1, 2, 2, 3, 100]:
+            a.push({"__name__": "m"}, T0, float(v))
+        a.flush(T0 + 60_000)
+        byname = {(r[0]["__name__"], r[0].get("quantile")): r[2]
+                  for r in rows}
+        assert byname[("m:1m_quantiles", "0.5")] == 2.0
+        assert byname[("m:1m_unique_samples", None)] == 4.0
+
+    def test_histogram_bucket(self):
+        rows, push = self.collect()
+        a = Aggregator({"interval": "1m", "outputs": ["histogram_bucket"]},
+                       push)
+        for v in [0.0005, 0.05, 0.5, 900]:
+            a.push({"__name__": "lat"}, T0, v)
+        a.flush(T0 + 60_000)
+        cum = {r[0]["le"]: r[2] for r in rows}
+        assert cum["0.001"] == 1.0 and cum["+Inf"] == 4.0
+
+    def test_match_selector(self):
+        rows, push = self.collect()
+        sa = StreamAggregators([{"interval": "1m", "outputs": ["last"],
+                                 "match": '{__name__=~"http_.*"}'}], push)
+        assert sa.push({"__name__": "http_reqs"}, T0, 1.0)
+        assert not sa.push({"__name__": "mem"}, T0, 1.0)
+        sa.stop()
+        assert rows[0][0]["__name__"] == "http_reqs:1m_last"
+
+    def test_deduplicator(self):
+        rows, push = self.collect()
+        d = Deduplicator(30_000, push)
+        d.push({"__name__": "m"}, T0, 1.0)
+        d.push({"__name__": "m"}, T0 + 1000, 2.0)
+        d.push({"__name__": "m2"}, T0, 5.0)
+        d.flush()
+        assert sorted((r[0]["__name__"], r[2]) for r in rows) == \
+            [("m", 2.0), ("m2", 5.0)]
+
+    def test_bad_config(self):
+        with pytest.raises(ValueError):
+            Aggregator({"interval": "1m", "outputs": ["bogus"]}, lambda b: 0)
+        with pytest.raises(ValueError):
+            Aggregator({"interval": "0s", "outputs": ["last"]}, lambda b: 0)

@@ -1,0 +1,155 @@
+"""vmbackup / vmrestore (reference app/vmbackup, app/vmrestore,
+lib/backup/actions/{backup,restore}.go): incremental part-level sync of an
+instant snapshot to a destination, and restore with unchanged-part skip.
+
+Destinations: fs://<path> (the reference additionally ships s3/gcs/azure
+drivers behind the same interface; RemoteFS here is that interface and
+fs:// its first driver)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import urllib.request
+
+from ..utils import logger
+
+
+class RemoteFS:
+    """Destination interface (lib/backup/common/fs.go analog)."""
+
+    def list_files(self) -> dict[str, int]:
+        raise NotImplementedError
+
+    def upload(self, rel: str, src_path: str):
+        raise NotImplementedError
+
+    def download(self, rel: str, dst_path: str):
+        raise NotImplementedError
+
+    def delete(self, rel: str):
+        raise NotImplementedError
+
+
+class FsRemote(RemoteFS):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def list_files(self) -> dict[str, int]:
+        out = {}
+        for dp, _, fns in os.walk(self.root):
+            for fn in fns:
+                full = os.path.join(dp, fn)
+                out[os.path.relpath(full, self.root)] = os.path.getsize(full)
+        return out
+
+    def upload(self, rel: str, src_path: str):
+        dst = os.path.join(self.root, rel)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copy2(src_path, dst)
+
+    def download(self, rel: str, dst_path: str):
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        shutil.copy2(os.path.join(self.root, rel), dst_path)
+
+    def delete(self, rel: str):
+        try:
+            os.unlink(os.path.join(self.root, rel))
+        except FileNotFoundError:
+            pass
+
+
+def open_remote(dst: str) -> RemoteFS:
+    if dst.startswith("fs://"):
+        return FsRemote(dst[5:])
+    raise ValueError(f"unsupported backup destination {dst!r} "
+                     "(supported: fs://)")
+
+
+def _local_files(root: str) -> dict[str, int]:
+    out = {}
+    for dp, _, fns in os.walk(root):
+        for fn in fns:
+            full = os.path.join(dp, fn)
+            out[os.path.relpath(full, root)] = os.path.getsize(full)
+    return out
+
+
+def backup(snapshot_path: str, remote: RemoteFS) -> dict:
+    """Incremental: upload only new/changed files, delete removed ones
+    (immutable parts mean same name+size => same content)."""
+    local = _local_files(snapshot_path)
+    existing = remote.list_files()
+    uploaded = skipped = deleted = 0
+    for rel, size in local.items():
+        if existing.get(rel) == size:
+            skipped += 1
+            continue
+        remote.upload(rel, os.path.join(snapshot_path, rel))
+        uploaded += 1
+    for rel in existing:
+        if rel not in local:
+            remote.delete(rel)
+            deleted += 1
+    logger.infof("backup: uploaded=%d skipped=%d deleted=%d",
+                 uploaded, skipped, deleted)
+    return {"uploaded": uploaded, "skipped": skipped, "deleted": deleted}
+
+
+def restore(remote: RemoteFS, storage_data_path: str) -> dict:
+    """Restore into an (empty or partial) storage dir, skipping files that
+    already match (hardlink-reuse analog)."""
+    local = _local_files(storage_data_path) if os.path.isdir(
+        storage_data_path) else {}
+    remote_files = remote.list_files()
+    downloaded = skipped = removed = 0
+    for rel, size in remote_files.items():
+        if local.get(rel) == size:
+            skipped += 1
+            continue
+        remote.download(rel, os.path.join(storage_data_path, rel))
+        downloaded += 1
+    for rel in local:
+        if rel not in remote_files:
+            os.unlink(os.path.join(storage_data_path, rel))
+            removed += 1
+    logger.infof("restore: downloaded=%d skipped=%d removed=%d",
+                 downloaded, skipped, removed)
+    return {"downloaded": downloaded, "skipped": skipped, "removed": removed}
+
+
+def create_snapshot_via_http(addr: str) -> str:
+    with urllib.request.urlopen(addr.rstrip("/") + "/snapshot/create",
+                                timeout=60) as r:
+        return json.loads(r.read())["snapshot"]
+
+
+def main_backup(argv=None):
+    p = argparse.ArgumentParser(prog="vmbackup")
+    p.add_argument("-storageDataPath", required=True)
+    p.add_argument("-snapshotName", default="")
+    p.add_argument("-snapshot.createURL", dest="create_url", default="")
+    p.add_argument("-dst", required=True)
+    args, _ = p.parse_known_args(argv)
+    name = args.snapshotName
+    if not name and args.create_url:
+        name = create_snapshot_via_http(args.create_url)
+    if not name:
+        raise SystemExit("need -snapshotName or -snapshot.createURL")
+    snap = os.path.join(args.storageDataPath, "snapshots", name)
+    backup(snap, open_remote(args.dst))
+
+
+def main_restore(argv=None):
+    p = argparse.ArgumentParser(prog="vmrestore")
+    p.add_argument("-src", required=True)
+    p.add_argument("-storageDataPath", required=True)
+    args, _ = p.parse_known_args(argv)
+    restore(open_remote(args.src), args.storageDataPath)
+
+
+if __name__ == "__main__":
+    main_backup()

@@ -1,0 +1,115 @@
+"""vmctl: data migration CLI (reference app/vmctl): modes
+
+  vm-native   copy series between instances via /api/v1/export + import
+  prometheus  import a Prometheus text/OpenMetrics dump file
+  influx      import an InfluxDB line-protocol file
+  opentsdb    import an OpenTSDB telnet-format file
+
+with interval chunking and selector filtering.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import urllib.parse
+import urllib.request
+
+from ..utils import logger
+
+
+def _post(url: str, data: bytes, timeout=120) -> None:
+    req = urllib.request.Request(url, data=data, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        r.read()
+
+
+def vm_native(src: str, dst: str, match: str, start: str = "", end: str = "",
+              chunk_rows: int = 10_000) -> int:
+    """Stream JSONL export from src into dst."""
+    params = {"match[]": match}
+    if start:
+        params["start"] = start
+    if end:
+        params["end"] = end
+    url = src.rstrip("/") + "/api/v1/export?" + urllib.parse.urlencode(params)
+    total = 0
+    buf: list[bytes] = []
+    with urllib.request.urlopen(url, timeout=300) as r:
+        for line in r:
+            line = line.strip()
+            if not line:
+                continue
+            buf.append(line)
+            total += 1
+            if len(buf) >= chunk_rows:
+                _post(dst.rstrip("/") + "/api/v1/import", b"\n".join(buf))
+                buf = []
+    if buf:
+        _post(dst.rstrip("/") + "/api/v1/import", b"\n".join(buf))
+    logger.infof("vmctl vm-native: migrated %d series chunks", total)
+    return total
+
+
+def import_file(path: str, dst: str, fmt: str, chunk_lines: int = 50_000) -> int:
+    endpoint = {"prometheus": "/api/v1/import/prometheus",
+                "influx": "/write",
+                "opentsdb": None}[fmt]
+    total = 0
+    if fmt == "opentsdb":
+        # convert telnet puts -> prometheus text
+        from ..ingest.parsers import parse_opentsdb_telnet
+        lines = []
+        for row in parse_opentsdb_telnet(open(path).read()):
+            labels = dict(row.labels)
+            name = labels.pop("__name__")
+            lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+            lines.append(f"{name}{{{lab}}} {row.value} {row.timestamp}")
+            total += 1
+        _post(dst.rstrip("/") + "/api/v1/import/prometheus",
+              "\n".join(lines).encode())
+        return total
+    buf: list[str] = []
+    for line in open(path):
+        if not line.strip():
+            continue
+        buf.append(line.rstrip("\n"))
+        total += 1
+        if len(buf) >= chunk_lines:
+            _post(dst.rstrip("/") + endpoint, "\n".join(buf).encode())
+            buf = []
+    if buf:
+        _post(dst.rstrip("/") + endpoint, "\n".join(buf).encode())
+    logger.infof("vmctl %s: imported %d lines", fmt, total)
+    return total
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    p = argparse.ArgumentParser(prog="vmctl")
+    sub = p.add_subparsers(dest="mode", required=True)
+
+    pn = sub.add_parser("vm-native")
+    pn.add_argument("--vm-native-src-addr", required=True)
+    pn.add_argument("--vm-native-dst-addr", required=True)
+    pn.add_argument("--vm-native-filter-match", default='{__name__=~".*"}')
+    pn.add_argument("--vm-native-filter-time-start", default="")
+    pn.add_argument("--vm-native-filter-time-end", default="")
+
+    for fmt in ("prometheus", "influx", "opentsdb"):
+        pf = sub.add_parser(fmt)
+        pf.add_argument("--file", required=True)
+        pf.add_argument("--dst-addr", required=True)
+
+    args = p.parse_args(argv)
+    if args.mode == "vm-native":
+        vm_native(args.vm_native_src_addr, args.vm_native_dst_addr,
+                  args.vm_native_filter_match,
+                  args.vm_native_filter_time_start,
+                  args.vm_native_filter_time_end)
+    else:
+        import_file(args.file, args.dst_addr, args.mode)
+
+
+if __name__ == "__main__":
+    main()

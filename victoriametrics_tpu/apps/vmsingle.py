@@ -32,6 +32,12 @@ def parse_flags(argv=None):
                    default="5m")
     p.add_argument("-search.tpuBackend", dest="tpu", action="store_true",
                    help="route supported rollups to the TPU")
+    p.add_argument("-relabelConfig", dest="relabel_config", default="",
+                   help="path to global relabeling rules YAML")
+    p.add_argument("-streamAggr.config", dest="streamaggr_config", default="",
+                   help="path to stream aggregation config YAML")
+    p.add_argument("-streamAggr.keepInput", dest="streamaggr_keep_input",
+                   action="store_true")
     p.add_argument("-loggerLevel", default="INFO")
     args, _ = p.parse_known_args(argv)
     # env overrides: VM_STORAGEDATAPATH etc (envflag analog)
@@ -68,11 +74,23 @@ def build(args):
     if args.tpu:
         from ..query.tpu_engine import TPUEngine
         tpu_engine = TPUEngine()
+    relabel = None
+    if args.relabel_config:
+        from ..ingest.relabel import parse_relabel_configs
+        relabel = parse_relabel_configs(open(args.relabel_config).read())
+    stream_aggr = None
+    if args.streamaggr_config:
+        from ..ingest.streamaggr import load_from_text
+        stream_aggr = load_from_text(open(args.streamaggr_config).read(),
+                                     lambda rows: storage.add_rows(rows))
+        stream_aggr.start()
     host, _, port = args.httpListenAddr.rpartition(":")
     srv = HTTPServer(host or "0.0.0.0", int(port))
     api = PrometheusAPI(storage, tpu_engine,
                         lookback_delta=_dur_ms(args.lookback),
-                        max_series=args.max_series)
+                        max_series=args.max_series,
+                        relabel_configs=relabel, stream_aggr=stream_aggr,
+                        stream_aggr_keep_input=args.streamaggr_keep_input)
     api.register(srv)
     return storage, srv, api
 
@@ -101,6 +119,12 @@ def main(argv=None):
     finally:
         logger.infof("vmsingle: shutting down")
         srv.stop()
+        if _api.stream_aggr is not None:
+            # final window flush BEFORE storage closes (streamaggr MustStop
+            # ordering): dropping the open window on every restart would
+            # lose data, and a late flusher tick must not write into a
+            # closed storage
+            _api.stream_aggr.stop(final_flush=True)
         storage.close()
         logger.infof("vmsingle: shutdown complete")
 

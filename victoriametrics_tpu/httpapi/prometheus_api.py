@@ -136,15 +136,20 @@ class QueryStats:
 
 class PrometheusAPI:
     def __init__(self, storage, tpu_engine=None, lookback_delta=300_000,
-                 max_series=1_000_000):
+                 max_series=1_000_000, relabel_configs=None,
+                 stream_aggr=None, stream_aggr_keep_input=False):
         self.storage = storage
         self.tpu = tpu_engine
         self.lookback_delta = lookback_delta
         self.max_series = max_series
+        self.relabel = relabel_configs   # ingest.relabel.ParsedConfigs
+        self.stream_aggr = stream_aggr   # ingest.streamaggr.StreamAggregators
+        self.stream_aggr_keep_input = stream_aggr_keep_input
         self.active = ActiveQueries()
         self.qstats = QueryStats()
         self.started_at = time.time()
         self.rows_inserted = 0
+        self.rows_relabel_dropped = 0
 
     # -- wiring ------------------------------------------------------------
 
@@ -395,7 +400,31 @@ class PrometheusAPI:
         for row in rows_iter:
             ts = row.timestamp or now
             batch.append((dict(row.labels), ts, row.value))
-        n = self.storage.add_rows(batch)
+        return self._ingest(batch)
+
+    def _ingest(self, batch: list) -> int:
+        """Shared ingest tail: global relabeling (-relabelConfig analog,
+        app/vminsert/relabel) -> stream aggregation hook -> storage."""
+        if self.relabel is not None:
+            out = []
+            for labels, ts, val in batch:
+                labels = self.relabel.apply(labels)
+                if not labels or not labels.get("__name__"):
+                    # dropped, or relabeled into a nameless/empty label set —
+                    # the reference drops those too rather than indexing an
+                    # unreachable series
+                    self.rows_relabel_dropped += 1
+                    continue
+                out.append((labels, ts, val))
+            batch = out
+        if self.stream_aggr is not None:
+            passthrough = []
+            for labels, ts, val in batch:
+                consumed = self.stream_aggr.push(labels, ts, val)
+                if not consumed or self.stream_aggr_keep_input:
+                    passthrough.append((labels, ts, val))
+            batch = passthrough
+        n = self.storage.add_rows(batch) if batch else 0
         self.rows_inserted += n
         return n
 
@@ -417,8 +446,7 @@ class PrometheusAPI:
         for labels, samples in series:
             for ts, val in samples:
                 batch.append((dict(labels), ts or now, val))
-        n = self.storage.add_rows(batch)
-        self.rows_inserted += n
+        self._ingest(batch)
         return Response(status=204, body=b"")
 
     def h_import(self, req: Request) -> Response:
@@ -540,6 +568,7 @@ class PrometheusAPI:
         m["vm_http_requests_total"] = getattr(self, "srv", None) and \
             self.srv.request_count or 0
         m["vm_rows_inserted_total"] = self.rows_inserted
+        m["vm_relabel_metrics_dropped_total"] = self.rows_relabel_dropped
         m["vm_app_uptime_seconds"] = round(time.time() - self.started_at, 3)
         for k, v in sorted(m.items()):
             lines.append(f"{k} {v}")

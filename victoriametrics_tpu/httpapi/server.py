@@ -151,14 +151,19 @@ class HTTPServer:
         return None
 
     def start(self):
+        self._started = True
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
         self._thread.start()
         logger.infof("http server listening on %s:%d", self.addr, self.port)
 
     def serve_forever(self):
+        self._started = True
         self._srv.serve_forever()
 
     def stop(self):
-        self._srv.shutdown()
+        # BaseServer.shutdown() waits on a flag only serve_forever sets;
+        # calling it on a never-started server would block forever.
+        if getattr(self, "_started", False):
+            self._srv.shutdown()
         self._srv.server_close()
