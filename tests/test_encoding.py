@@ -188,3 +188,50 @@ class TestSentinelLossyEncode:
         v = np.array([5, -(1 << 63) + 1, 5], dtype=np.int64)
         first, d = nearest_delta_encode(v, 16)
         nearest_delta_decode(first, d)
+
+
+class TestNativeCodec:
+    def test_native_available_and_equivalent(self):
+        from victoriametrics_tpu import native
+        if not native.available():
+            pytest.skip("no compiler")
+        rng = np.random.default_rng(9)
+        for size in (1, 2, 5, 1000, 8192):
+            v = rng.integers(-(1 << 55), 1 << 55, size, dtype=np.int64)
+            data = native.varint_encode(v)
+            assert data == varint.marshal_varint64s(v)  # format-identical
+            np.testing.assert_array_equal(native.varint_decode(data, size), v)
+        v = np.cumsum(rng.integers(0, 100, 5000)).astype(np.int64)
+        payload, first, fd = native.delta2_encode(v)
+        out = native.delta2_decode(payload, first, fd, v.size)
+        np.testing.assert_array_equal(out, v)
+
+    def test_native_blocks_interop_with_python_blocks(self):
+        """Blocks encoded with native kernels decode via pure python & vice
+        versa (same wire format)."""
+        from victoriametrics_tpu.ops import encoding as enc_mod
+        if not getattr(enc_mod, "_HAVE_NATIVE", False):
+            pytest.skip("no native lib")
+        rng = np.random.default_rng(10)
+        counter = np.cumsum(rng.integers(0, 100, 3000)).astype(np.int64)
+        gauge = rng.integers(-500, 500, 3000).astype(np.int64)
+        for v in (counter, gauge):
+            data, mt, first = enc_mod.marshal_int64_array(v, 64)
+            # force python decode
+            enc_mod._HAVE_NATIVE = False
+            try:
+                out_py = enc_mod.unmarshal_int64_array(data, mt, first, v.size)
+            finally:
+                enc_mod._HAVE_NATIVE = True
+            out_nat = enc_mod.unmarshal_int64_array(data, mt, first, v.size)
+            np.testing.assert_array_equal(out_py, v)
+            np.testing.assert_array_equal(out_nat, v)
+
+    def test_native_malformed_raises(self):
+        from victoriametrics_tpu import native
+        if not native.available():
+            pytest.skip("no compiler")
+        with pytest.raises(ValueError):
+            native.varint_decode(b"\x81" * 12, 1)
+        with pytest.raises(ValueError):
+            native.delta2_decode(b"\x81", 0, 1, 5)

@@ -246,3 +246,33 @@ class TestSubprocess:
         res = c2.query("persisted", T0 / 1e3 + 10)
         assert res["data"]["result"][0]["value"][1] == "7"
         app2.stop()
+
+
+class TestTracingAndCache:
+    def test_trace_embedded(self, app):
+        ingest_remote_write(app)
+        code, body = app.get("/api/v1/query_range", query="sum(rate(rw_metric[1m]))",
+                             start=T0 / 1e3, end=(T0 + 300_000) / 1e3,
+                             step=15, trace="1")
+        d = json.loads(body)
+        assert "trace" in d
+        msgs = json.dumps(d["trace"])
+        assert "fetch" in msgs and "rollup" in msgs
+        assert d["trace"]["duration_msec"] >= 0
+
+    def test_rollup_cache_hit_and_backfill_reset(self, app):
+        from victoriametrics_tpu.query.rollup_result_cache import GLOBAL
+        GLOBAL.reset()
+        ingest_remote_write(app)
+        q = dict(query="rw_metric", start=T0 / 1e3,
+                 end=(T0 + 300_000) / 1e3, step=15)
+        r1 = app.get("/api/v1/query_range", **q)[1]
+        h0 = GLOBAL.hits
+        r2 = app.get("/api/v1/query_range", **q)[1]
+        assert GLOBAL.hits > h0          # second run hits the cache
+        assert json.loads(r1)["data"] == json.loads(r2)["data"]
+        # backfill (old timestamps) resets the cache
+        line = json.dumps({"metric": {"__name__": "rw_metric", "idx": "0"},
+                           "values": [1.0], "timestamps": [T0 - 86_400_000]})
+        app.post("/api/v1/import", line.encode())
+        assert GLOBAL.stats()["entries"] == 0

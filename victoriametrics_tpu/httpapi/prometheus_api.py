@@ -225,8 +225,12 @@ class PrometheusAPI:
         t0 = time.perf_counter()
         if hasattr(self.storage, "reset_partial"):
             self.storage.reset_partial()
+        from ..utils import querytracer
+        qt = querytracer.new(req.arg("trace") == "1", "query %s time=%d",
+                             q, ts)
         try:
             ec = self._ec(ts, ts, step)
+            ec.tracer = qt
             rows = exec_query(ec, q)
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
@@ -240,11 +244,14 @@ class PrometheusAPI:
                 continue
             result.append({"metric": r.metric_name.to_dict(),
                            "value": [ts / 1e3, _fmt_value(v)]})
-        return Response.json({"status": "success",
-                              "isPartial": bool(getattr(self.storage,
-                                                        "last_partial", False)),
-                              "data": {"resultType": "vector",
-                                       "result": result}})
+        qt.donef("%d result series", len(result))
+        body = {"status": "success",
+                "isPartial": bool(getattr(self.storage, "last_partial",
+                                          False)),
+                "data": {"resultType": "vector", "result": result}}
+        if qt.enabled:
+            body["trace"] = qt.to_dict()
+        return Response.json(body)
 
     def h_query_range(self, req: Request) -> Response:
         q = req.arg("query")
@@ -256,13 +263,22 @@ class PrometheusAPI:
         step = parse_step(req.arg("step"))
         if end < start:
             return Response.error("end < start")
+        # align the grid to the step (AdjustStartEnd analog): keeps sliding
+        # dashboard windows phase-stable so the rollup cache can serve them
+        start -= start % step
+        end -= end % step
         qid = self.active.register(q, start, end, step)
         t0 = time.perf_counter()
         if hasattr(self.storage, "reset_partial"):
             self.storage.reset_partial()
+        from ..utils import querytracer
+        qt = querytracer.new(req.arg("trace") == "1",
+                             "query_range %s start=%d end=%d step=%d",
+                             q, start, end, step)
         try:
             ec = self._ec(start, end, step)
-            rows = exec_query(ec, q)
+            ec.tracer = qt
+            rows = self._exec_range_cached(ec, q, now)
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
         finally:
@@ -277,11 +293,47 @@ class PrometheusAPI:
             if vals:
                 result.append({"metric": r.metric_name.to_dict(),
                                "values": vals})
-        return Response.json({"status": "success",
-                              "isPartial": bool(getattr(self.storage,
-                                                        "last_partial", False)),
-                              "data": {"resultType": "matrix",
-                                       "result": result}})
+        qt.donef("%d result series", len(result))
+        body = {"status": "success",
+                "isPartial": bool(getattr(self.storage, "last_partial",
+                                          False)),
+                "data": {"resultType": "matrix", "result": result}}
+        if qt.enabled:
+            body["trace"] = qt.to_dict()
+        return Response.json(body)
+
+    # queries calling non-deterministic / wall-clock functions bypass the
+    # rollup-result cache; \b keeps avg_over_time( from matching time(
+    _UNCACHEABLE_RE = re.compile(
+        r"\b(?:rand|rand_normal|rand_exponential|now|time)\s*\(")
+
+    def _exec_range_cached(self, ec, q: str, now_ms: int):
+        from ..query.rollup_result_cache import GLOBAL as rcache
+        cacheable = (ec.n_points > 1
+                     and not self._UNCACHEABLE_RE.search(q))
+        if not cacheable:
+            return exec_query(ec, q)
+        cached, new_start = rcache.get(ec, q, now_ms)
+        if cached is not None and new_start > ec.end:
+            ec.tracer.printf("rollup cache: full hit")
+            return cached
+        if cached is not None:
+            ec.tracer.printf("rollup cache: partial hit, computing from %d",
+                             new_start)
+            sub = ec.child(start=new_start)
+            sub.tracer = ec.tracer
+            fresh = exec_query(sub, q)
+            rows = rcache.merge(cached, fresh, ec, new_start)
+            rows = [r for r in rows
+                    if not np.isnan(r.values).all()]
+            rows.sort(key=lambda ts: ts.metric_name.marshal())
+        else:
+            rows = exec_query(ec, q)
+        if not getattr(self.storage, "last_partial", False):
+            # never cache partial cluster results: a later hit would present
+            # incomplete data as complete with isPartial=false
+            rcache.put(ec, q, rows, now_ms)
+        return rows
 
     # -- metadata ----------------------------------------------------------
 
@@ -424,6 +476,14 @@ class PrometheusAPI:
                 if not consumed or self.stream_aggr_keep_input:
                     passthrough.append((labels, ts, val))
             batch = passthrough
+        if batch:
+            # backfill older than the cache offset invalidates cached rollup
+            # tails (ResetRollupResultCacheIfNeeded analog)
+            from ..query.rollup_result_cache import GLOBAL as rcache
+            from ..query.rollup_result_cache import OFFSET_MS
+            now = int(time.time() * 1000)
+            if min(ts for _, ts, _ in batch) < now - OFFSET_MS:
+                rcache.reset()
         n = self.storage.add_rows(batch) if batch else 0
         self.rows_inserted += n
         return n

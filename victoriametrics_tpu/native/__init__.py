@@ -1,0 +1,138 @@
+"""ctypes bindings for the native host codec kernels (codec.cpp).
+
+Auto-builds libvmcodec.so with g++ on first import if missing (and a
+compiler is available); falls back to None so callers keep their NumPy
+paths. This mirrors the reference's cgo-zstd-with-pure-Go-fallback split
+(lib/encoding/zstd/zstd_{cgo,pure}.go).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libvmcodec.so")
+
+_lib = None
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _DIR, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    i64 = ctypes.c_int64
+    p8 = ctypes.POINTER(ctypes.c_uint8)
+    pi64 = ctypes.POINTER(i64)
+    lib.vm_varint_encode.restype = i64
+    lib.vm_varint_encode.argtypes = [pi64, i64, p8]
+    lib.vm_varint_decode.restype = i64
+    lib.vm_varint_decode.argtypes = [p8, i64, pi64, i64]
+    lib.vm_delta2_encode.restype = i64
+    lib.vm_delta2_encode.argtypes = [pi64, i64, p8, pi64, pi64]
+    lib.vm_delta2_decode.restype = i64
+    lib.vm_delta2_decode.argtypes = [p8, i64, i64, i64, pi64, i64]
+    lib.vm_delta_encode.restype = i64
+    lib.vm_delta_encode.argtypes = [pi64, i64, p8, pi64]
+    lib.vm_delta_decode.restype = i64
+    lib.vm_delta_decode.argtypes = [p8, i64, i64, pi64, i64]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_i64_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _as_u8_ptr(b):
+    return ctypes.cast(ctypes.c_char_p(bytes(b) if not isinstance(b, (bytes, bytearray)) else b),
+                       ctypes.POINTER(ctypes.c_uint8))
+
+
+def varint_encode(vals: np.ndarray) -> bytes:
+    lib = _load()
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    out = ctypes.create_string_buffer(int(vals.size) * 10 or 1)
+    n = lib.vm_varint_encode(_as_i64_ptr(vals), vals.size,
+                             ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)))
+    return out.raw[:n]
+
+
+def varint_decode(data: bytes, count: int) -> np.ndarray:
+    lib = _load()
+    out = np.empty(count, dtype=np.int64)
+    n = lib.vm_varint_decode(_as_u8_ptr(data), len(data), _as_i64_ptr(out),
+                             count)
+    if n != count:
+        raise ValueError(f"native varint: expected {count} values, got {n}")
+    return out
+
+
+def delta2_encode(vals: np.ndarray) -> tuple[bytes, int, int]:
+    lib = _load()
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    out = ctypes.create_string_buffer(int(vals.size) * 10 or 1)
+    first = ctypes.c_int64()
+    fd = ctypes.c_int64()
+    n = lib.vm_delta2_encode(_as_i64_ptr(vals), vals.size,
+                             ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)),
+                             ctypes.byref(first), ctypes.byref(fd))
+    if n < 0:
+        raise ValueError("native delta2 encode failed")
+    return out.raw[:n], first.value, fd.value
+
+
+def delta2_decode(data: bytes, first: int, first_delta: int,
+                  count: int) -> np.ndarray:
+    lib = _load()
+    out = np.empty(count, dtype=np.int64)
+    n = lib.vm_delta2_decode(_as_u8_ptr(data), len(data), first, first_delta,
+                             _as_i64_ptr(out), count)
+    if n != count:
+        raise ValueError("native delta2: malformed payload")
+    return out
+
+
+def delta_encode(vals: np.ndarray) -> tuple[bytes, int]:
+    lib = _load()
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    out = ctypes.create_string_buffer(int(vals.size) * 10 or 1)
+    first = ctypes.c_int64()
+    n = lib.vm_delta_encode(_as_i64_ptr(vals), vals.size,
+                            ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)),
+                            ctypes.byref(first))
+    if n < 0:
+        raise ValueError("native delta encode failed")
+    return out.raw[:n], first.value
+
+
+def delta_decode(data: bytes, first: int, count: int) -> np.ndarray:
+    lib = _load()
+    out = np.empty(count, dtype=np.int64)
+    n = lib.vm_delta_decode(_as_u8_ptr(data), len(data), first,
+                            _as_i64_ptr(out), count)
+    if n != count:
+        raise ValueError("native delta: malformed payload")
+    return out

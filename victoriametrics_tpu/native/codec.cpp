@@ -1,0 +1,163 @@
+// Native host codecs: bulk zigzag-varint + delta2 encode/decode.
+//
+// The reference's hot host loops are hand-tuned Go (lib/encoding/int.go
+// varint bulk codecs, nearest_delta2.go) with its only native code being cgo
+// zstd (SURVEY §2.9). Here the ingest/scan hot loops get a real native
+// implementation, exposed through a C ABI consumed via ctypes
+// (victoriametrics_tpu/native/__init__.py). Build: `make -C native` or the
+// lazy auto-build in the Python wrapper.
+//
+// All functions are thread-safe (no global state) and release-the-GIL safe
+// (pure C, no Python API).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// zigzag varint
+// ---------------------------------------------------------------------------
+
+// Encode n int64s as zigzag varints into out (caller provides >= 10*n bytes).
+// Returns bytes written.
+int64_t vm_varint_encode(const int64_t* vals, int64_t n, uint8_t* out) {
+    uint8_t* p = out;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t u = ((uint64_t)vals[i] << 1) ^ (uint64_t)(vals[i] >> 63);
+        while (u >= 0x80) {
+            *p++ = (uint8_t)(u) | 0x80;
+            u >>= 7;
+        }
+        *p++ = (uint8_t)u;
+    }
+    return (int64_t)(p - out);
+}
+
+// Decode up to max_vals zigzag varints from data[0:len]. Returns number of
+// values decoded, or -1 on malformed input (truncated / overlong varint).
+int64_t vm_varint_decode(const uint8_t* data, int64_t len, int64_t* out,
+                         int64_t max_vals) {
+    const uint8_t* p = data;
+    const uint8_t* end = data + len;
+    int64_t count = 0;
+    while (p < end && count < max_vals) {
+        uint64_t u = 0;
+        int shift = 0;
+        for (;;) {
+            if (p >= end || shift > 63) return -1;
+            uint8_t b = *p++;
+            u |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        out[count++] = (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+    }
+    if (p != end && count < max_vals) return -1;
+    return count;
+}
+
+// ---------------------------------------------------------------------------
+// delta2 (double-delta) + varint, fused: the block encode/decode hot path
+// ---------------------------------------------------------------------------
+
+// vals[0..n) -> first, first_delta, varint(d2 stream) in out.
+// Returns payload bytes written; first/first_delta via out params.
+int64_t vm_delta2_encode(const int64_t* vals, int64_t n, uint8_t* out,
+                         int64_t* first, int64_t* first_delta) {
+    if (n < 2) return -1;
+    *first = vals[0];
+    int64_t prev_d = (int64_t)((uint64_t)vals[1] - (uint64_t)vals[0]);
+    *first_delta = prev_d;
+    uint8_t* p = out;
+    for (int64_t i = 2; i < n; i++) {
+        int64_t d = (int64_t)((uint64_t)vals[i] - (uint64_t)vals[i - 1]);
+        int64_t d2 = (int64_t)((uint64_t)d - (uint64_t)prev_d);
+        prev_d = d;
+        uint64_t u = ((uint64_t)d2 << 1) ^ (uint64_t)(d2 >> 63);
+        while (u >= 0x80) {
+            *p++ = (uint8_t)(u) | 0x80;
+            u >>= 7;
+        }
+        *p++ = (uint8_t)u;
+    }
+    return (int64_t)(p - out);
+}
+
+// Inverse: reconstruct n values from first, first_delta and the d2 varint
+// stream. Returns n on success, -1 on malformed input.
+int64_t vm_delta2_decode(const uint8_t* data, int64_t len, int64_t first,
+                         int64_t first_delta, int64_t* out, int64_t n) {
+    if (n < 1) return -1;
+    out[0] = first;
+    if (n == 1) return 1;
+    int64_t v = first;
+    int64_t d = first_delta;
+    const uint8_t* p = data;
+    const uint8_t* end = data + len;
+    v = (int64_t)((uint64_t)v + (uint64_t)d);
+    out[1] = v;
+    for (int64_t i = 2; i < n; i++) {
+        uint64_t u = 0;
+        int shift = 0;
+        for (;;) {
+            if (p >= end || shift > 63) return -1;
+            uint8_t b = *p++;
+            u |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        int64_t d2 = (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+        d = (int64_t)((uint64_t)d + (uint64_t)d2);
+        v = (int64_t)((uint64_t)v + (uint64_t)d);
+        out[i] = v;
+    }
+    return (p == end) ? n : -1;
+}
+
+// ---------------------------------------------------------------------------
+// delta1 (single delta) + varint
+// ---------------------------------------------------------------------------
+
+int64_t vm_delta_encode(const int64_t* vals, int64_t n, uint8_t* out,
+                        int64_t* first) {
+    if (n < 1) return -1;
+    *first = vals[0];
+    uint8_t* p = out;
+    for (int64_t i = 1; i < n; i++) {
+        int64_t d = (int64_t)((uint64_t)vals[i] - (uint64_t)vals[i - 1]);
+        uint64_t u = ((uint64_t)d << 1) ^ (uint64_t)(d >> 63);
+        while (u >= 0x80) {
+            *p++ = (uint8_t)(u) | 0x80;
+            u >>= 7;
+        }
+        *p++ = (uint8_t)u;
+    }
+    return (int64_t)(p - out);
+}
+
+int64_t vm_delta_decode(const uint8_t* data, int64_t len, int64_t first,
+                        int64_t* out, int64_t n) {
+    if (n < 1) return -1;
+    out[0] = first;
+    int64_t v = first;
+    const uint8_t* p = data;
+    const uint8_t* end = data + len;
+    for (int64_t i = 1; i < n; i++) {
+        uint64_t u = 0;
+        int shift = 0;
+        for (;;) {
+            if (p >= end || shift > 63) return -1;
+            uint8_t b = *p++;
+            u |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        int64_t d = (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+        v = (int64_t)((uint64_t)v + (uint64_t)d);
+        out[i] = v;
+    }
+    return (p == end) ? n : -1;
+}
+
+}  // extern "C"

@@ -30,6 +30,13 @@ from .nearest_delta import (nearest_delta2_decode, nearest_delta2_encode,
                             nearest_delta_decode, nearest_delta_encode)
 from .varint import marshal_varint64s, unmarshal_varint64s
 
+try:  # native C++ codec kernels (victoriametrics_tpu/native/codec.cpp)
+    from .. import native as _native
+    _HAVE_NATIVE = _native.available()
+except Exception:  # pragma: no cover - missing compiler
+    _native = None
+    _HAVE_NATIVE = False
+
 
 class MarshalType(enum.IntEnum):
     CONST = 1
@@ -99,16 +106,24 @@ def marshal_int64_array(values: np.ndarray, precision_bits: int = 64
         return marshal_varint64s(np.array([d], dtype=np.int64)), \
             MarshalType.DELTA_CONST, int(v[0])
     if is_gauge(v):
-        first, deltas = nearest_delta_encode(v, precision_bits)
-        data = marshal_varint64s(deltas)
+        if _HAVE_NATIVE and precision_bits >= 64:
+            data, first = _native.delta_encode(v)
+        else:
+            first, deltas = nearest_delta_encode(v, precision_bits)
+            data = marshal_varint64s(deltas)
         data, mt = _maybe_compress(data, MarshalType.NEAREST_DELTA,
                                    MarshalType.ZSTD_NEAREST_DELTA)
         return data, mt, first
-    first, first_delta, d2 = nearest_delta2_encode(v, precision_bits)
-    stream = np.empty(d2.size + 1, dtype=np.int64)
-    stream[0] = first_delta
-    stream[1:] = d2
-    data = marshal_varint64s(stream)
+    if _HAVE_NATIVE and precision_bits >= 64:
+        d2_payload, first, first_delta = _native.delta2_encode(v)
+        data = _native.varint_encode(
+            np.array([first_delta], dtype=np.int64)) + d2_payload
+    else:
+        first, first_delta, d2 = nearest_delta2_encode(v, precision_bits)
+        stream = np.empty(d2.size + 1, dtype=np.int64)
+        stream[0] = first_delta
+        stream[1:] = d2
+        data = marshal_varint64s(stream)
     data, mt = _maybe_compress(data, MarshalType.NEAREST_DELTA2,
                                MarshalType.ZSTD_NEAREST_DELTA2)
     return data, mt, first
@@ -130,9 +145,22 @@ def unmarshal_int64_array(data: bytes, marshal_type: MarshalType,
               if mt == MarshalType.ZSTD_NEAREST_DELTA
               else MarshalType.NEAREST_DELTA2)
     if mt == MarshalType.NEAREST_DELTA:
+        if _HAVE_NATIVE:
+            return _native.delta_decode(data, first_value, count)
         deltas = unmarshal_varint64s(data, count - 1)
         return nearest_delta_decode(first_value, deltas)
     if mt == MarshalType.NEAREST_DELTA2:
+        if _HAVE_NATIVE and count >= 2:
+            # split off the leading first_delta varint, then fused decode
+            i = 0
+            while i < len(data) and data[i] & 0x80:
+                i += 1
+                if i >= 10:
+                    raise ValueError("varint: too long encoded varint")
+            if i >= len(data):
+                raise ValueError("varint: truncated trailing value")
+            fd = int(unmarshal_varint64s(data[:i + 1], 1)[0])
+            return _native.delta2_decode(data[i + 1:], first_value, fd, count)
         stream = unmarshal_varint64s(data, count - 1)
         return nearest_delta2_decode(first_value, int(stream[0]), stream[1:])
     raise ValueError(f"unknown marshal type {marshal_type}")

@@ -245,20 +245,28 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
     end = ec.end - offset
     fetch_lo = start - lookback - ec.lookback_delta
     filters = filters_from_metric_expr(me)
+    qt = ec.tracer.new_child("fetch %s window=%dms", me, lookback)
     series = ec.storage.search_series(filters, fetch_lo, end,
                                       max_series=ec.max_series)
+    qt.donef("%d series, %d samples", len(series),
+             sum(s.timestamps.size for s in series))
     cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
 
     if ec.tpu is not None:
         from .tpu_engine import try_rollup_tpu
+        qt = ec.tracer.new_child("tpu rollup %s", func)
         got = try_rollup_tpu(ec.tpu, func, series, cfg, args)
         if got is not None:
+            qt.donef("device path, %d series", len(got))
             return _finish_rollup(series, got, keep_name)
+        qt.donef("fell back to host")
 
+    qt = ec.tracer.new_child("host rollup %s", func)
     out_rows = []
     for sd in series:
         vals = rollup_series(func, sd.timestamps, sd.values, cfg, args)
         out_rows.append(vals)
+    qt.donef("%d series", len(out_rows))
     return _finish_rollup(series, out_rows, keep_name)
 
 

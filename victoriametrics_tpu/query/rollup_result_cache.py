@@ -1,0 +1,107 @@
+"""Rollup result cache (reference app/vmselect/promql/
+rollup_result_cache.go:39-364): caches range-query results keyed by
+(query, step) so repeated/refreshing queries only compute the new tail,
+merging cached prefixes with freshly computed suffixes.
+
+Entries store per-series NumPy value arrays on the entry's own step-aligned
+grid; hits are served with slices (no per-point Python work). A hit requires
+the request grid to be phase-aligned with the cached grid — the HTTP layer
+aligns start/end to the step (AdjustStartEnd analog) so this always holds
+for dashboard refreshes. Backfill older than the cached window resets the
+cache (ResetRollupResultCacheIfNeeded analog)."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..storage.metric_name import MetricName
+from .types import EvalConfig, Timeseries
+
+# Cached series tails are clipped back by this much: the freshest points may
+# still change (late samples within the flush window) — cacheTimestampOffset.
+OFFSET_MS = 5 * 60_000
+
+
+class RollupResultCache:
+    def __init__(self, max_entries: int = 1024):
+        self._lock = threading.Lock()
+        # key -> (c_start, c_end, {metric_name_raw: values ndarray})
+        self._cache: dict[tuple, tuple[int, int, dict]] = {}
+        self.max_entries = max_entries
+        self.hits = 0
+        self.misses = 0
+
+    def _key(self, ec: EvalConfig, q: str) -> tuple:
+        return (q, ec.step)
+
+    def get(self, ec: EvalConfig, q: str, now_ms: int
+            ) -> tuple[list[Timeseries] | None, int]:
+        """Returns (cached series on [ec.start, cov_end], first timestamp
+        still to compute). (None, ec.start) on miss."""
+        with self._lock:
+            e = self._cache.get(self._key(ec, q))
+            if e is None or e[0] > ec.start or e[1] < ec.start or \
+                    (ec.start - e[0]) % ec.step != 0:
+                self.misses += 1
+                return None, ec.start
+            self.hits += 1
+            c_start, c_end, series = e
+        cov_end = min(c_end, ec.end)
+        i0 = (ec.start - c_start) // ec.step
+        n = (cov_end - ec.start) // ec.step + 1
+        out = [Timeseries(MetricName.unmarshal(raw),
+                          vals[i0:i0 + n].copy())
+               for raw, vals in series.items()]
+        return out, ec.start + n * ec.step
+
+    def put(self, ec: EvalConfig, q: str, rows: list[Timeseries],
+            now_ms: int) -> None:
+        # don't cache the volatile tail
+        cov_end_limit = now_ms - OFFSET_MS
+        cov_end = ec.start + (
+            (min(ec.end, cov_end_limit) - ec.start) // ec.step) * ec.step
+        if cov_end < ec.start:
+            return
+        n = (cov_end - ec.start) // ec.step + 1
+        series = {ts.metric_name.marshal(): ts.values[:n].copy()
+                  for ts in rows}
+        with self._lock:
+            if len(self._cache) >= self.max_entries:
+                self._cache.clear()
+            self._cache[self._key(ec, q)] = (ec.start, cov_end, series)
+
+    def merge(self, cached: list[Timeseries], fresh: list[Timeseries],
+              ec: EvalConfig, new_start: int) -> list[Timeseries]:
+        """Stitch cached prefix rows with freshly computed suffix rows."""
+        T = ec.n_points
+        n_prefix = (new_start - ec.start) // ec.step
+        by_name: dict[bytes, np.ndarray] = {}
+        for ts in cached:
+            vals = np.full(T, np.nan)
+            m = min(ts.values.size, n_prefix)
+            vals[:m] = ts.values[:m]
+            by_name[ts.metric_name.marshal()] = vals
+        for ts in fresh:
+            raw = ts.metric_name.marshal()
+            vals = by_name.get(raw)
+            if vals is None:
+                vals = np.full(T, np.nan)
+                by_name[raw] = vals
+            m = ts.values.size
+            vals[T - m:] = ts.values if m <= T else ts.values[-T:]
+        return [Timeseries(MetricName.unmarshal(raw), vals)
+                for raw, vals in by_name.items()]
+
+    def reset(self):
+        with self._lock:
+            self._cache.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._cache), "hits": self.hits,
+                    "misses": self.misses}
+
+
+GLOBAL = RollupResultCache()

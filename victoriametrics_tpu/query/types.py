@@ -36,11 +36,14 @@ class EvalConfig:
     max_points_per_series: int = 50_000_000
     max_series: int = 1_000_000
     round_digits: int = 100
-    tracer: object = None
+    tracer: object = None      # querytracer.Tracer | NOP (set in __post_init__)
     tpu: object = None         # TPUEngine when the device path is enabled
     _grid: np.ndarray | None = None
 
     def __post_init__(self):
+        if self.tracer is None:
+            from ..utils import querytracer
+            self.tracer = querytracer.NOP
         if self.step <= 0:
             raise ValueError("step must be positive")
         if self.end < self.start:

@@ -1,0 +1,90 @@
+"""Query tracing: a tree of timed spans threaded through every query layer
+(reference lib/querytracer/tracer.go:16-76), activated per-request via
+`trace=1` and embedded in the API response JSON for UI rendering.
+
+A disabled tracer is a no-op singleton so hot paths pay one branch.
+Device phases (TPU rollups) report their spans too, giving host+device
+timing in one tree.
+"""
+
+from __future__ import annotations
+
+import time
+
+_enabled_globally = True
+
+
+def set_deny_tracing(deny: bool):
+    global _enabled_globally
+    _enabled_globally = not deny
+
+
+class Tracer:
+    __slots__ = ("message", "start", "duration_s", "children", "_done")
+
+    def __init__(self, fmt: str = "", *args):
+        self.message = (fmt % args) if args else fmt
+        self.start = time.perf_counter()
+        self.duration_s = 0.0
+        self.children: list[Tracer] = []
+        self._done = False
+
+    @property
+    def enabled(self) -> bool:
+        return True
+
+    def new_child(self, fmt: str, *args) -> "Tracer":
+        child = Tracer(fmt, *args)
+        self.children.append(child)
+        return child
+
+    def printf(self, fmt: str, *args) -> None:
+        child = self.new_child(fmt, *args)
+        child.donef("")
+
+    def donef(self, fmt: str = "", *args) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.duration_s = time.perf_counter() - self.start
+        if fmt:
+            extra = (fmt % args) if args else fmt
+            self.message = f"{self.message}: {extra}" if self.message else extra
+
+    def to_dict(self) -> dict:
+        if not self._done:
+            self.donef("")
+        out = {"duration_msec": round(self.duration_s * 1e3, 3),
+               "message": self.message}
+        if self.children:
+            out["children"] = [c.to_dict() for c in self.children]
+        return out
+
+
+class _NopTracer:
+    __slots__ = ()
+
+    @property
+    def enabled(self) -> bool:
+        return False
+
+    def new_child(self, fmt, *args):
+        return self
+
+    def printf(self, fmt, *args):
+        pass
+
+    def donef(self, fmt="", *args):
+        pass
+
+    def to_dict(self):
+        return {}
+
+
+NOP = _NopTracer()
+
+
+def new(enabled: bool, fmt: str = "", *args):
+    if enabled and _enabled_globally:
+        return Tracer(fmt, *args)
+    return NOP
