@@ -48,13 +48,36 @@ def main() -> None:
     cache = TileCache(capacity_bytes=2 << 30)
     samples = n_series * n_samples
 
-    # compile once, then measure a true cold query: chunked H2D + compute
-    fn(*cache.get_or_put(("bench", 0), lambda: host_tiles)).block_until_ready()
-    cache.invalidate()
+    # cold path: compact delta planes over the link, decoded on device
+    # (ops/device_decode; ~4x fewer bytes than dense tiles)
+    import dataclasses
+
+    from victoriametrics_tpu.models.tile_cache import chunked_device_put
+    from victoriametrics_tpu.ops import device_decode as dd
+    rng = np.random.default_rng(0)
+    triples = []
+    base = np.arange(n_samples, dtype=np.int64) * 15_000 + cfg.start
+    for i in range(n_series):
+        ts = np.sort(base + rng.integers(-2000, 2001, n_samples))
+        mant = np.cumsum(rng.integers(0, 50, n_samples)).astype(np.int64)
+        triples.append((ts, mant, -2))
+    planes = dd.pack_delta_planes(triples, cfg.start, np.float32)
+    npad = int(planes.counts.max())
+
+    def cold_once():
+        dev = [chunked_device_put(getattr(planes, f.name))
+               for f in dataclasses.fields(planes)]
+        out = dd.decode_and_rollup("rate", *dev[:6], dev[6], dev[7], cfg,
+                                   npad, np.float32)
+        out.block_until_ready()
+
+    cold_once()  # compile
     t0 = time.perf_counter()
-    tiles = cache.get_or_put(("bench", 0), lambda: host_tiles)
-    fn(*tiles).block_until_ready()
+    cold_once()
     cold_s = time.perf_counter() - t0
+
+    # compile + populate the hot path
+    fn(*cache.get_or_put(("bench", 0), lambda: host_tiles)).block_until_ready()
 
     # hot: cache-resident tiles, as in steady-state serving
     iters = 20
@@ -69,7 +92,8 @@ def main() -> None:
     baseline = 1e8  # single-core reference scan rate (see module docstring)
     print(json.dumps({
         "metric": ("hot-shard sum by(rate) scan, 8192x1440 f32, HBM tile "
-                   f"cache (cold incl chunked H2D: {cold_rate/1e6:.0f}M/s)"),
+                   f"cache (cold via device-decoded delta planes: "
+                   f"{cold_rate/1e6:.0f}M/s)"),
         "value": round(rate),
         "unit": "samples/sec",
         "vs_baseline": round(rate / baseline, 2),

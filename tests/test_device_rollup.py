@@ -165,3 +165,49 @@ class TestMesh:
         mesh = meshlib.make_mesh(n_series=2, n_time=4)
         with pytest.raises(ValueError, match="whole-series"):
             meshlib.time_sharded_rollup(mesh, "lifetime", CFG, 8)
+
+
+class TestDeviceDecode:
+    def _series(self, S=24, N=200):
+        rng = np.random.default_rng(41)
+        out = []
+        for i in range(S):
+            n = int(rng.integers(3, N))
+            ts = np.arange(n, dtype=np.int64) * 15_000 + START + \
+                rng.integers(-500, 500, n)
+            ts.sort()
+            mant = np.cumsum(rng.integers(0, 50, n)).astype(np.int64)
+            out.append((ts, mant, -2))
+        return out
+
+    @pytest.mark.parametrize("func", ["rate", "sum_over_time",
+                                      "max_over_time", "last_over_time"])
+    def test_fused_decode_rollup_matches_dense(self, func):
+        from victoriametrics_tpu.ops import device_decode as dd
+        from victoriametrics_tpu.ops import decimal as dec
+        series = self._series()
+        planes = dd.pack_delta_planes(series, CFG.start, np.float64)
+        assert planes is not None
+        # plane compression actually narrows the payload
+        dense_bytes = sum(t.size * 12 for t, _, _ in series)
+        assert planes.nbytes < dense_bytes / 2
+        n = int(planes.counts.max())
+        got = np.asarray(dd.decode_and_rollup(
+            func, jnp.asarray(planes.ts_first), jnp.asarray(planes.ts_fdelta),
+            jnp.asarray(planes.ts_d2), jnp.asarray(planes.val_first),
+            jnp.asarray(planes.val_fdelta), jnp.asarray(planes.val_d2),
+            jnp.asarray(planes.scale), jnp.asarray(planes.counts),
+            CFG, n, np.float64))
+        for i, (ts, mant, exp) in enumerate(series):
+            vals = dec.decimal_to_float(
+                np.pad(mant, (0, 0)), exp) if False else mant * (10.0 ** exp)
+            want = rollup_np.rollup(func, ts, vals, CFG)
+            np.testing.assert_allclose(got[i], want, rtol=1e-9, atol=1e-9,
+                                       equal_nan=True,
+                                       err_msg=f"series {i} {func}")
+
+    def test_overflow_falls_back(self):
+        from victoriametrics_tpu.ops import device_decode as dd
+        series = [(np.array([START, START + 1000], dtype=np.int64),
+                   np.array([0, 1 << 40], dtype=np.int64), 0)]
+        assert dd.pack_delta_planes(series, CFG.start) is None

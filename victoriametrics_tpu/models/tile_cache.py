@@ -87,6 +87,25 @@ class TileCache:
             self._bytes += size
         return dev_tree
 
+    def put_device(self, key, dev_tree):
+        """Retain an already-device-resident pytree (e.g. tiles decoded on
+        device from compact planes)."""
+        size = self._tree_bytes(dev_tree)
+        if size > self.capacity:
+            self.invalidate(key)
+            return dev_tree
+        with self._lock:
+            if key in self._entries:
+                self._bytes -= self._sizes.pop(key)
+                del self._entries[key]
+            while self._bytes + size > self.capacity and self._entries:
+                old, _ = self._entries.popitem(last=False)
+                self._bytes -= self._sizes.pop(old)
+            self._entries[key] = dev_tree
+            self._sizes[key] = size
+            self._bytes += size
+        return dev_tree
+
     def get_or_put(self, key, make_host_tree):
         cached = self.get(key)
         if cached is not None:
