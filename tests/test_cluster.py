@@ -279,3 +279,28 @@ class TestRPCFailureHandling:
         assert node.storage.series_count() >= 1
         client.close()
         node.stop()
+
+
+class TestMultilevel:
+    def test_vmselect_over_vmselect(self, nodes3, tmp_path):
+        """Multilevel federation: an upper vmselect uses a lower vmselect
+        (exposing the cluster-native RPC) as its only storage node."""
+        lower = ClusterStorage([n.client() for n in nodes3])
+        lower.add_rows(seed_rows(n_series=8))
+        lower_rpc = RPCServer("127.0.0.1", 0, HELLO_SELECT,
+                              make_storage_handlers(lower))
+        lower_rpc.start()
+        upper_node = StorageNodeClient("127.0.0.1", lower_rpc.port,
+                                       lower_rpc.port)
+        upper = ClusterStorage([upper_node])
+        res = upper.search_series(filters_from_dict({"__name__": "cm"}),
+                                  T0, T0 + 10_000_000)
+        assert len(res) == 8
+        assert upper.label_values("idx") == [str(i) for i in range(8)]
+        ec = EvalConfig(start=T0, end=T0 + 120_000, step=60_000,
+                        storage=upper)
+        out = exec_query(ec, "count(cm)")
+        assert out[0].values[-1] == 8.0
+        upper.close()
+        lower_rpc.stop()
+        lower.close()

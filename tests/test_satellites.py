@@ -302,3 +302,45 @@ class TestVMCtl:
         finally:
             srv2.stop()
             storage2.close()
+
+
+class TestVMAlertTool:
+    def test_unittest_pass_and_fail(self, tmp_path):
+        import yaml
+
+        from victoriametrics_tpu.apps.vmalert_tool import (
+            parse_series_values, run_test_file)
+        assert parse_series_values("0+10x3") == [0, 10, 20, 30]
+        assert parse_series_values("5x2") == [5, 5, 5]
+        rules = tmp_path / "rules.yml"
+        rules.write_text(yaml.dump({"groups": [{"name": "g", "rules": [
+            {"alert": "High", "expr": "m > 15", "for": "0s",
+             "labels": {"sev": "crit"}}]}]}))
+        test_ok = tmp_path / "t1.yml"
+        test_ok.write_text(yaml.dump({
+            "rule_files": ["rules.yml"],
+            "tests": [{
+                "interval": "1m",
+                "input_series": [{"series": 'm{job="x"}',
+                                  "values": "0+10x10"}],
+                "alert_rule_test": [{
+                    "eval_time": "5m", "alertname": "High",
+                    "exp_alerts": [{"exp_labels": {"job": "x",
+                                                   "sev": "crit"}}]}],
+                "metricsql_expr_test": [{
+                    "expr": "m", "eval_time": "3m",
+                    "exp_samples": [{"value": 30}]}],
+            }]}))
+        assert run_test_file(str(test_ok)) == []
+        test_bad = tmp_path / "t2.yml"
+        test_bad.write_text(yaml.dump({
+            "rule_files": ["rules.yml"],
+            "tests": [{
+                "interval": "1m",
+                "input_series": [{"series": "m", "values": "0x10"}],
+                "alert_rule_test": [{
+                    "eval_time": "5m", "alertname": "High",
+                    "exp_alerts": [{"exp_labels": {"sev": "crit"}}]}],
+            }]}))
+        fails = run_test_file(str(test_bad))
+        assert fails and "High" in fails[0]

@@ -18,6 +18,9 @@ def parse_flags(argv=None):
                    help="host:insertPort:selectPort, repeatable")
     p.add_argument("-httpListenAddr", default=":8480")
     p.add_argument("-replicationFactor", type=int, default=1)
+    p.add_argument("-clusternativeListenAddr", dest="native_addr", default="",
+                   help="expose the vminsert RPC API so a higher-level "
+                        "vminsert can chain into this one (multilevel)")
     p.add_argument("-loggerLevel", default="INFO")
     args, _ = p.parse_known_args(argv)
     env = os.environ.get("VM_STORAGENODE")
@@ -48,7 +51,13 @@ def build(args):
     srv = HTTPServer(hh or "0.0.0.0", int(hp))
     api = PrometheusAPI(cluster)
     api.register(srv, mode="insert")
-    return cluster, srv, api
+    native_srv = None
+    if getattr(args, "native_addr", ""):
+        from ..parallel.cluster_api import start_native_server
+        from ..parallel.rpc import HELLO_INSERT
+        native_srv = start_native_server(args.native_addr, HELLO_INSERT,
+                                         cluster)
+    return cluster, srv, api, native_srv
 
 
 def main(argv=None):
@@ -56,7 +65,7 @@ def main(argv=None):
     faulthandler.register(signal.SIGUSR1)
     args = parse_flags(argv)
     logger.set_level(args.loggerLevel)
-    cluster, srv, _ = build(args)
+    cluster, srv, _, native_srv = build(args)
     srv.start()
     logger.infof("vminsert started: nodes=%d rf=%d http=%d",
                  len(cluster.nodes), cluster.rf, srv.port)
@@ -68,6 +77,8 @@ def main(argv=None):
             pass
     finally:
         srv.stop()
+        if native_srv is not None:
+            native_srv.stop()
         cluster.close()
         logger.infof("vminsert: shutdown complete")
 

@@ -21,6 +21,10 @@ def parse_flags(argv=None):
     p.add_argument("-search.denyPartialResponse", dest="deny_partial",
                    action="store_true")
     p.add_argument("-search.tpuBackend", dest="tpu", action="store_true")
+    p.add_argument("-clusternativeListenAddr", dest="native_addr", default="",
+                   help="expose the vmselect RPC API so a higher-level "
+                        "vmselect can use this node as a storage backend "
+                        "(multilevel federation)")
     p.add_argument("-loggerLevel", default="INFO")
     args, _ = p.parse_known_args(argv)
     env = os.environ.get("VM_STORAGENODE")
@@ -46,7 +50,13 @@ def build(args):
     srv = HTTPServer(hh or "0.0.0.0", int(hp))
     api = PrometheusAPI(cluster, tpu_engine)
     api.register(srv, mode="select")
-    return cluster, srv, api
+    native_srv = None
+    if getattr(args, "native_addr", ""):
+        from ..parallel.cluster_api import start_native_server
+        from ..parallel.rpc import HELLO_SELECT
+        native_srv = start_native_server(args.native_addr, HELLO_SELECT,
+                                         cluster)
+    return cluster, srv, api, native_srv
 
 
 def main(argv=None):
@@ -54,7 +64,7 @@ def main(argv=None):
     faulthandler.register(signal.SIGUSR1)
     args = parse_flags(argv)
     logger.set_level(args.loggerLevel)
-    cluster, srv, _ = build(args)
+    cluster, srv, _, native_srv = build(args)
     srv.start()
     logger.infof("vmselect started: nodes=%d http=%d", len(cluster.nodes),
                  srv.port)
@@ -66,6 +76,8 @@ def main(argv=None):
             pass
     finally:
         srv.stop()
+        if native_srv is not None:
+            native_srv.stop()
         cluster.close()
         logger.infof("vmselect: shutdown complete")
 

@@ -40,6 +40,14 @@ def parse_time(s: str, default_ms: int) -> int:
         return int(float(s) * 1000)
     except ValueError:
         pass
+    if s.startswith("-"):
+        # relative time: "-1h" = now minus duration (reference supports this)
+        try:
+            ms, step_based = parse_duration_ms(s[1:])
+            if not step_based and ms > 0:
+                return int(time.time() * 1000) - int(ms)
+        except Exception:
+            pass
     try:
         dt = datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
         return int(dt.timestamp() * 1000)

@@ -66,11 +66,16 @@ def make_storage_handlers(storage) -> dict:
         return Writer().u64(len(rows))
 
     def h_is_readonly(r: Reader):
-        return Writer().u64(1 if storage.is_readonly else 0)
+        return Writer().u64(1 if getattr(storage, "is_readonly", False) else 0)
+
+    # sentinel "count" marking the trailing metadata frame of search_v1
+    META_FRAME = (1 << 32) - 1
 
     def h_search(r: Reader):
         filters = _read_filters(r)
         min_ts, max_ts = r.i64(), r.i64()
+        if hasattr(storage, "reset_partial"):
+            storage.reset_partial()
         series = storage.search_series(filters, min_ts, max_ts)
 
         def frames():
@@ -83,6 +88,11 @@ def make_storage_handlers(storage) -> dict:
                     w.array(sd.timestamps)
                     w.array(sd.values)
                 yield w
+            # trailing metadata frame: propagate partial-result state up
+            # through multilevel chains
+            meta = Writer().u64(META_FRAME)
+            meta.u64(1 if getattr(storage, "last_partial", False) else 0)
+            yield meta
         return frames()
 
     def h_search_metric_names(r: Reader):
@@ -128,7 +138,8 @@ def make_storage_handlers(storage) -> dict:
     def h_register_metric_names(r: Reader):
         n = r.u64()
         names = [MetricName.unmarshal(r.bytes_()) for _ in range(n)]
-        storage.register_metric_names(names)
+        if hasattr(storage, "register_metric_names"):
+            storage.register_metric_names(names)
         return Writer().u64(n)
 
     return {
@@ -175,18 +186,23 @@ class StorageNodeClient:
         self.insert.call("writeRows_v1", w)
 
     def search_series(self, filters, min_ts, max_ts):
+        """Returns (series_list, remote_partial)."""
         w = Writer()
         _write_filters(w, filters)
         w.i64(min_ts).i64(max_ts)
         out = []
+        partial = False
         for r in self.select.call_stream("search_v1", w):
             n = r.u64()
+            if n == (1 << 32) - 1:  # trailing metadata frame
+                partial = bool(r.u64())
+                continue
             for _ in range(n):
                 mn = MetricName.unmarshal(r.bytes_())
                 ts = r.array()
                 vals = r.array()
                 out.append((mn, ts, vals))
-        return out
+        return out, partial
 
     def search_metric_names(self, filters, min_ts, max_ts):
         w = Writer()
@@ -230,6 +246,17 @@ class StorageNodeClient:
 
 class PartialResultError(RuntimeError):
     pass
+
+
+def start_native_server(addr: str, hello: bytes, storage):
+    """Start a cluster-native RPC server exposing `storage` (used by the
+    -clusternativeListenAddr multilevel flags on vminsert/vmselect)."""
+    from .rpc import RPCServer
+    host, _, port = addr.rpartition(":")
+    srv = RPCServer(host or "0.0.0.0", int(port), hello,
+                    make_storage_handlers(storage))
+    srv.start()
+    return srv
 
 
 class SeriesData:
@@ -360,7 +387,10 @@ class ClusterStorage:
             lambda n: n.search_series(filters, min_ts, max_ts))
         merged: dict[bytes, list] = {}
         names: dict[bytes, MetricName] = {}
-        for res in node_results:
+        for res, remote_partial in node_results:
+            if remote_partial:
+                # a lower level (multilevel chain) saw an incomplete fan-out
+                self._tls.partial = True
             for mn, ts, vals in res:
                 raw = mn.marshal()
                 merged.setdefault(raw, []).append((ts, vals))
