@@ -276,3 +276,83 @@ class TestTracingAndCache:
                            "values": [1.0], "timestamps": [T0 - 86_400_000]})
         app.post("/api/v1/import", line.encode())
         assert GLOBAL.stats()["entries"] == 0
+
+
+class TestIngestServersAndGate:
+    def test_tcp_udp_line_protocols(self, tmp_path):
+        import socket
+
+        from victoriametrics_tpu.apps.vmsingle import build, parse_flags
+        args = parse_flags([f"-storageDataPath={tmp_path}/d",
+                            "-httpListenAddr=127.0.0.1:0",
+                            "-graphiteListenAddr=127.0.0.1:0",
+                            "-opentsdbListenAddr=127.0.0.1:0"])
+        storage, srv, api = build(args)
+        srv.start()
+        try:
+            c = Client(srv.port)
+            gport = api.ingest_servers[0].port
+            oport = api.ingest_servers[1].port
+            # graphite over TCP
+            s = socket.create_connection(("127.0.0.1", gport), timeout=5)
+            s.sendall(f"tcp.metric;src=tcp 5.5 {T0 // 1000}\n".encode())
+            s.close()
+            # graphite over UDP
+            u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            u.sendto(f"udp.metric 6.5 {T0 // 1000}\n".encode(),
+                     ("127.0.0.1", gport))
+            u.close()
+            # opentsdb telnet over TCP
+            s = socket.create_connection(("127.0.0.1", oport), timeout=5)
+            s.sendall(f"put ot.tcp {T0 // 1000} 7.5 k=v\n".encode())
+            s.close()
+            deadline = time.time() + 10
+            got = {}
+            while time.time() < deadline and len(got) < 3:
+                for name in ("tcp.metric", "udp.metric", "ot.tcp"):
+                    res = c.query(f'{{__name__="{name}"}}', T0 / 1e3 + 10)
+                    if res["data"]["result"]:
+                        got[name] = res["data"]["result"][0]["value"][1]
+                time.sleep(0.2)
+            assert got == {"tcp.metric": "5.5", "udp.metric": "6.5",
+                           "ot.tcp": "7.5"}
+        finally:
+            srv.stop()
+            for isrv in api.ingest_servers:
+                isrv.stop()
+            storage.close()
+
+    def test_concurrency_gate_rejects_with_429(self, tmp_path):
+        """A saturated 1-slot gate must reject HTTP queries with 429 +
+        Retry-After through the real endpoint."""
+        from victoriametrics_tpu.apps.vmsingle import build, parse_flags
+        from victoriametrics_tpu.httpapi.prometheus_api import ConcurrencyGate
+        args = parse_flags([f"-storageDataPath={tmp_path}/d",
+                            "-httpListenAddr=127.0.0.1:0"])
+        storage, srv, api = build(args)
+        api.gate = ConcurrencyGate(max_concurrent=1, max_queue_duration_s=0.2)
+        srv.start()
+        try:
+            c = Client(srv.port)
+            with api.gate:  # hold the only slot
+                code, body = c.get("/api/v1/query", query="up")
+                assert code == 429, body
+                assert json.loads(body)["errorType"] == "too_many_requests"
+            code, _ = c.get("/api/v1/query", query="up")
+            assert code == 200  # slot released
+            assert api.gate.rejected == 1
+        finally:
+            srv.stop()
+            storage.close()
+
+    def test_relative_time_param(self, app):
+        import time as _t
+        now = _t.time()
+        line = f"rel_metric 9.5 {int((now - 60) * 1000)}\n"
+        app.post("/api/v1/import/prometheus", line.encode())
+        code, body = app.get("/api/v1/query", query="rel_metric")
+        assert code == 200
+        code, body = app.get("/api/v1/query_range", query="rel_metric",
+                             start="-5m", end=str(now), step="15")
+        assert code == 200
+        assert json.loads(body)["data"]["result"]

@@ -32,6 +32,9 @@ def parse_flags(argv=None):
                    default="5m")
     p.add_argument("-search.tpuBackend", dest="tpu", action="store_true",
                    help="route supported rollups to the TPU")
+    p.add_argument("-graphiteListenAddr", dest="graphite_addr", default="")
+    p.add_argument("-influxListenAddr", dest="influx_addr", default="")
+    p.add_argument("-opentsdbListenAddr", dest="opentsdb_addr", default="")
     p.add_argument("-relabelConfig", dest="relabel_config", default="",
                    help="path to global relabeling rules YAML")
     p.add_argument("-streamAggr.config", dest="streamaggr_config", default="",
@@ -92,6 +95,17 @@ def build(args):
                         relabel_configs=relabel, stream_aggr=stream_aggr,
                         stream_aggr_keep_input=args.streamaggr_keep_input)
     api.register(srv)
+    api.ingest_servers = []
+    for proto, addr in (("graphite", args.graphite_addr),
+                        ("influx", args.influx_addr),
+                        ("opentsdb", args.opentsdb_addr)):
+        if addr:
+            from ..ingest.ingestserver import IngestServer
+            h, _, p_ = addr.rpartition(":")
+            isrv = IngestServer(proto, h or "0.0.0.0", int(p_),
+                                api._add_rows)
+            isrv.start()
+            api.ingest_servers.append(isrv)
     return storage, srv, api
 
 
@@ -119,6 +133,8 @@ def main(argv=None):
     finally:
         logger.infof("vmsingle: shutting down")
         srv.stop()
+        for isrv in getattr(_api, "ingest_servers", []):
+            isrv.stop()
         if _api.stream_aggr is not None:
             # final window flush BEFORE storage closes (streamaggr MustStop
             # ordering): dropping the open window on every restart would

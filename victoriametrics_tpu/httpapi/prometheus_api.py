@@ -142,10 +142,38 @@ class QueryStats:
         return items[:n]
 
 
+class ConcurrencyGate:
+    """Query concurrency limiter with a bounded wait queue (reference
+    app/vmselect/main.go:49-92: 2xCPU capped at 16, -search.maxQueueDuration
+    timeout returning 429 + Retry-After, like the reference)."""
+
+    def __init__(self, max_concurrent: int | None = None,
+                 max_queue_duration_s: float = 10.0):
+        if max_concurrent is None:
+            from ..utils.memory import available_cpus
+            max_concurrent = min(2 * available_cpus(), 16)
+        self._sem = threading.Semaphore(max_concurrent)
+        self.max_concurrent = max_concurrent
+        self.max_queue_duration_s = max_queue_duration_s
+        self.rejected = 0
+
+    def __enter__(self):
+        if not self._sem.acquire(timeout=self.max_queue_duration_s):
+            self.rejected += 1
+            raise TimeoutError(
+                f"query queue wait exceeded {self.max_queue_duration_s}s "
+                f"({self.max_concurrent} concurrent queries)")
+        return self
+
+    def __exit__(self, *exc):
+        self._sem.release()
+
+
 class PrometheusAPI:
     def __init__(self, storage, tpu_engine=None, lookback_delta=300_000,
                  max_series=1_000_000, relabel_configs=None,
-                 stream_aggr=None, stream_aggr_keep_input=False):
+                 stream_aggr=None, stream_aggr_keep_input=False,
+                 max_concurrent_queries=None):
         self.storage = storage
         self.tpu = tpu_engine
         self.lookback_delta = lookback_delta
@@ -155,6 +183,7 @@ class PrometheusAPI:
         self.stream_aggr_keep_input = stream_aggr_keep_input
         self.active = ActiveQueries()
         self.qstats = QueryStats()
+        self.gate = ConcurrencyGate(max_concurrent_queries)
         self.started_at = time.time()
         self.rows_inserted = 0
         self.rows_relabel_dropped = 0
@@ -239,7 +268,12 @@ class PrometheusAPI:
         try:
             ec = self._ec(ts, ts, step)
             ec.tracer = qt
-            rows = exec_query(ec, q)
+            with self.gate:
+                rows = exec_query(ec, q)
+        except TimeoutError as e:
+            resp = Response.error(str(e), 429, "too_many_requests")
+            resp.headers["Retry-After"] = "10"
+            return resp
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
         finally:
@@ -271,10 +305,11 @@ class PrometheusAPI:
         step = parse_step(req.arg("step"))
         if end < start:
             return Response.error("end < start")
-        # align the grid to the step (AdjustStartEnd analog): keeps sliding
-        # dashboard windows phase-stable so the rollup cache can serve them
+        # align the grid to the step (AdjustStartEnd analog): start rounds
+        # DOWN (phase-stable for the rollup cache), end rounds UP so the
+        # freshest samples stay inside the last window
         start -= start % step
-        end -= end % step
+        end = start + -(-(end - start) // step) * step
         qid = self.active.register(q, start, end, step)
         t0 = time.perf_counter()
         if hasattr(self.storage, "reset_partial"):
@@ -286,7 +321,12 @@ class PrometheusAPI:
         try:
             ec = self._ec(start, end, step)
             ec.tracer = qt
-            rows = self._exec_range_cached(ec, q, now)
+            with self.gate:
+                rows = self._exec_range_cached(ec, q, now)
+        except TimeoutError as e:
+            resp = Response.error(str(e), 429, "too_many_requests")
+            resp.headers["Retry-After"] = "10"
+            return resp
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
         finally:
