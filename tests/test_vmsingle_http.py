@@ -356,3 +356,94 @@ class TestIngestServersAndGate:
                              start="-5m", end=str(now), step="15")
         assert code == 200
         assert json.loads(body)["data"]["result"]
+
+
+class TestOTLP:
+    def _build_payload(self):
+        """Hand-build an ExportMetricsServiceRequest with a gauge, a
+        cumulative sum and a histogram using the protowire writer."""
+        import struct
+
+        from victoriametrics_tpu.ingest.protowire import (w_bytes, w_tag,
+                                                          w_varint)
+
+        def kv(key, val):
+            b = bytearray()
+            w_bytes(b, 1, key.encode())
+            av = bytearray()
+            w_bytes(av, 1, val.encode())
+            w_bytes(b, 2, bytes(av))
+            return bytes(b)
+
+        def fixed64(buf, fnum, u):
+            w_tag(buf, fnum, 1)
+            buf += struct.pack("<Q", u)
+
+        def num_dp(ts_ns, val, attrs=()):
+            dp = bytearray()
+            fixed64(dp, 3, ts_ns)
+            w_tag(dp, 4, 1)
+            dp += struct.pack("<d", val)
+            for k, v in attrs:
+                w_bytes(dp, 7, kv(k, v))
+            return bytes(dp)
+
+        def metric_gauge(name, dp):
+            m = bytearray()
+            w_bytes(m, 1, name.encode())
+            g = bytearray()
+            w_bytes(g, 1, dp)
+            w_bytes(m, 5, bytes(g))
+            return bytes(m)
+
+        def metric_hist(name, ts_ns):
+            dp = bytearray()
+            fixed64(dp, 3, ts_ns)
+            fixed64(dp, 4, 10)               # count
+            w_tag(dp, 5, 1)
+            dp += struct.pack("<d", 55.5)    # sum
+            w_bytes(dp, 6, struct.pack("<QQQ", 6, 3, 1))   # bucket counts
+            w_bytes(dp, 7, struct.pack("<dd", 0.1, 1.0))   # bounds
+            m = bytearray()
+            w_bytes(m, 1, name.encode())
+            h = bytearray()
+            w_bytes(h, 1, bytes(dp))
+            w_bytes(m, 9, bytes(h))
+            return bytes(m)
+
+        ts_ns = T0 * 1_000_000
+        sm = bytearray()
+        w_bytes(sm, 2, metric_gauge("otlp.gauge",
+                                    num_dp(ts_ns, 3.5, [("env", "dev")])))
+        w_bytes(sm, 2, metric_hist("otlp.latency", ts_ns))
+        resource = bytearray()
+        w_bytes(resource, 1, kv("service.name", "svc1"))
+        rm = bytearray()
+        w_bytes(rm, 1, bytes(resource))
+        w_bytes(rm, 2, bytes(sm))
+        req = bytearray()
+        w_bytes(req, 1, bytes(rm))
+        return bytes(req)
+
+    def test_otlp_ingest(self, app):
+        code, body = app.post("/opentelemetry/v1/metrics",
+                              self._build_payload())
+        assert code == 200, body
+        res = app.query('{__name__="otlp.gauge"}', T0 / 1e3 + 10)
+        r = res["data"]["result"][0]
+        assert r["value"][1] == "3.5"
+        assert r["metric"]["env"] == "dev"
+        assert r["metric"]["service.name"] == "svc1"
+        # histogram expansion works with histogram_quantile
+        res = app.query('{__name__="otlp.latency_bucket", le="0.1"}', T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["value"][1] == "6"
+        res = app.query('{__name__="otlp.latency_count"}', T0 / 1e3 + 10)
+        assert res["data"]["result"][0]["value"][1] == "10"
+        res = app.query(
+            'histogram_quantile(0.5, {__name__="otlp.latency_bucket"})', T0 / 1e3 + 10)
+        v = float(res["data"]["result"][0]["value"][1])
+        assert 0 < v <= 0.1
+
+    def test_otlp_garbage(self, app):
+        code, _ = app.post("/v1/metrics", b"\x01\x02 not a protobuf")
+        assert code == 400

@@ -21,7 +21,10 @@ import time
 
 import numpy as np
 
+import struct
+
 from ..ingest import parsers, remote_write
+from ..ingest.otlp import parse_otlp
 from ..query.exec import exec_instant, exec_query
 from ..query.eval import QueryError, filters_from_metric_expr
 from ..query.metricsql import parse as mql_parse
@@ -220,6 +223,9 @@ class PrometheusAPI:
         r("/datadog/api/v2/series", self.h_datadog_v2)
         r("/datadog/api/v1/validate", lambda req: Response.json({"valid": True}))
         r("/newrelic/infra/v2/metrics/events/bulk", self.h_newrelic)
+        r("/opentelemetry/v1/metrics", self.h_otlp)
+        r("/opentelemetry/api/v1/push", self.h_otlp)
+        r("/v1/metrics", self.h_otlp)
 
     def _register_select(self, srv: HTTPServer):
         r = srv.route
@@ -608,6 +614,14 @@ class PrometheusAPI:
         except ValueError as e:
             return Response.error(f"cannot parse graphite line: {e}", 400)
         return Response(status=204, body=b"")
+
+    def h_otlp(self, req: Request) -> Response:
+        try:
+            self._add_rows(parse_otlp(req.body))
+        except (ValueError, struct.error) as e:
+            return Response.error(f"cannot parse OTLP payload: {e}", 400)
+        # empty body = valid empty ExportMetricsServiceResponse proto
+        return Response(200, b"", "application/x-protobuf")
 
     def h_datadog_v1(self, req: Request) -> Response:
         try:
