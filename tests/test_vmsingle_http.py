@@ -447,3 +447,47 @@ class TestOTLP:
     def test_otlp_garbage(self, app):
         code, _ = app.post("/v1/metrics", b"\x01\x02 not a protobuf")
         assert code == 400
+
+
+class TestSeriesLimitsAndPush:
+    def test_series_limits_drop(self, tmp_path):
+        from victoriametrics_tpu.apps.vmsingle import build, parse_flags
+        args = parse_flags([f"-storageDataPath={tmp_path}/d",
+                            "-httpListenAddr=127.0.0.1:0",
+                            "-maxLabelsPerTimeseries=3"])
+        storage, srv, api = build(args)
+        srv.start()
+        try:
+            c = Client(srv.port)
+            ok = f'fits{{a="1"}} 1 {T0}\n'
+            bad = f'toomany{{a="1",b="2",c="3",d="4"}} 1 {T0}\n'
+            code, _ = c.post("/api/v1/import/prometheus", (ok + bad).encode())
+            assert code == 204
+            assert c.query("fits", T0 / 1e3 + 5)["data"]["result"]
+            assert not c.query("toomany", T0 / 1e3 + 5)["data"]["result"]
+            code, body = c.get("/metrics")
+            assert b'vm_rows_ignored_total{reason="too_many_labels"} 1' in body
+        finally:
+            srv.stop()
+            storage.close()
+
+    def test_pushmetrics(self, tmp_path):
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+        from victoriametrics_tpu.utils.pushmetrics import MetricsPusher
+        got = []
+        sink = HTTPServer("127.0.0.1", 0)
+        sink.route("/push", lambda req: (got.append(req.body),
+                                         Response.text("OK"))[1])
+        sink.start()
+        p = MetricsPusher([f"http://127.0.0.1:{sink.port}/push"],
+                          lambda: "m1 42\nm2{x=\"y\"} 7\n",
+                          interval_s=0.2, extra_labels='job="t"')
+        p.start()
+        deadline = time.time() + 10
+        while not got and time.time() < deadline:
+            time.sleep(0.1)
+        p.stop()
+        sink.stop()
+        assert got
+        assert b'm1{job="t"} 42' in got[0]
+        assert b'm2{job="t",x="y"} 7' in got[0]

@@ -41,6 +41,14 @@ def parse_flags(argv=None):
                    help="path to stream aggregation config YAML")
     p.add_argument("-streamAggr.keepInput", dest="streamaggr_keep_input",
                    action="store_true")
+    p.add_argument("-maxLabelsPerTimeseries", type=int, default=40)
+    p.add_argument("-maxLabelValueLen", type=int, default=4096)
+    p.add_argument("-pushmetrics.url", dest="pushmetrics_urls",
+                   action="append", default=[])
+    p.add_argument("-pushmetrics.interval", dest="pushmetrics_interval",
+                   default="10s")
+    p.add_argument("-pushmetrics.extraLabel", dest="pushmetrics_extra",
+                   default="")
     p.add_argument("-loggerLevel", default="INFO")
     args, _ = p.parse_known_args(argv)
     # env overrides: VM_STORAGEDATAPATH etc (envflag analog)
@@ -48,9 +56,12 @@ def parse_flags(argv=None):
         env = os.environ.get("VM_" + name.upper().replace(".", "_"))
         if env is not None:
             cur = getattr(args, name)
-            setattr(args, name,
-                    type(cur)(env) if not isinstance(cur, bool)
-                    else env not in ("0", "false", ""))
+            if isinstance(cur, bool):
+                setattr(args, name, env not in ("0", "false", ""))
+            elif isinstance(cur, list):
+                setattr(args, name, [x for x in env.split(",") if x])
+            else:
+                setattr(args, name, type(cur)(env))
     return args
 
 
@@ -89,12 +100,24 @@ def build(args):
         stream_aggr.start()
     host, _, port = args.httpListenAddr.rpartition(":")
     srv = HTTPServer(host or "0.0.0.0", int(port))
+    from ..ingest.serieslimits import SeriesLimits
+    limits = SeriesLimits(max_labels_per_series=args.maxLabelsPerTimeseries,
+                          max_label_value_len=args.maxLabelValueLen)
     api = PrometheusAPI(storage, tpu_engine,
                         lookback_delta=_dur_ms(args.lookback),
                         max_series=args.max_series,
                         relabel_configs=relabel, stream_aggr=stream_aggr,
-                        stream_aggr_keep_input=args.streamaggr_keep_input)
+                        stream_aggr_keep_input=args.streamaggr_keep_input,
+                        series_limits=limits)
     api.register(srv)
+    if args.pushmetrics_urls:
+        from ..utils.pushmetrics import MetricsPusher
+        api.pusher = MetricsPusher(
+            args.pushmetrics_urls,
+            lambda: api.h_metrics(None).body.decode(),
+            interval_s=_dur_ms(args.pushmetrics_interval) / 1e3,
+            extra_labels=args.pushmetrics_extra)
+        api.pusher.start()
     api.ingest_servers = []
     for proto, addr in (("graphite", args.graphite_addr),
                         ("influx", args.influx_addr),
@@ -135,6 +158,8 @@ def main(argv=None):
         srv.stop()
         for isrv in getattr(_api, "ingest_servers", []):
             isrv.stop()
+        if getattr(_api, "pusher", None) is not None:
+            _api.pusher.stop()
         if _api.stream_aggr is not None:
             # final window flush BEFORE storage closes (streamaggr MustStop
             # ordering): dropping the open window on every restart would

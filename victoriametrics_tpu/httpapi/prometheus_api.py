@@ -176,7 +176,7 @@ class PrometheusAPI:
     def __init__(self, storage, tpu_engine=None, lookback_delta=300_000,
                  max_series=1_000_000, relabel_configs=None,
                  stream_aggr=None, stream_aggr_keep_input=False,
-                 max_concurrent_queries=None):
+                 max_concurrent_queries=None, series_limits=None):
         self.storage = storage
         self.tpu = tpu_engine
         self.lookback_delta = lookback_delta
@@ -184,6 +184,7 @@ class PrometheusAPI:
         self.relabel = relabel_configs   # ingest.relabel.ParsedConfigs
         self.stream_aggr = stream_aggr   # ingest.streamaggr.StreamAggregators
         self.stream_aggr_keep_input = stream_aggr_keep_input
+        self.series_limits = series_limits  # ingest.serieslimits.SeriesLimits
         self.active = ActiveQueries()
         self.qstats = QueryStats()
         self.gate = ConcurrencyGate(max_concurrent_queries)
@@ -523,6 +524,9 @@ class PrometheusAPI:
                     continue
                 out.append((labels, ts, val))
             batch = out
+        if self.series_limits is not None:
+            batch = [(labels, ts, val) for labels, ts, val in batch
+                     if self.series_limits.check(labels)]
         if self.stream_aggr is not None:
             passthrough = []
             for labels, ts, val in batch:
@@ -691,6 +695,8 @@ class PrometheusAPI:
             self.srv.request_count or 0
         m["vm_rows_inserted_total"] = self.rows_inserted
         m["vm_relabel_metrics_dropped_total"] = self.rows_relabel_dropped
+        if self.series_limits is not None:
+            m.update(self.series_limits.metrics())
         m["vm_app_uptime_seconds"] = round(time.time() - self.started_at, 3)
         for k, v in sorted(m.items()):
             lines.append(f"{k} {v}")

@@ -1,0 +1,68 @@
+"""Periodic /metrics push to remote endpoints (reference lib/pushmetrics +
+the vendored metrics.InitPush): every interval, collect the metrics text and
+POST it to each -pushmetrics.url with extra labels appended."""
+
+from __future__ import annotations
+
+import threading
+import urllib.request
+
+from . import logger
+
+
+class MetricsPusher:
+    def __init__(self, urls: list[str], collect_fn, interval_s: float = 10.0,
+                 extra_labels: str = ""):
+        """collect_fn() -> prometheus text exposition string."""
+        self.urls = urls
+        self.collect_fn = collect_fn
+        self.interval_s = interval_s
+        self.extra_labels = extra_labels
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self.pushes = 0
+        self.errors = 0
+
+    def start(self):
+        if self.urls:
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _render(self) -> bytes:
+        text = self.collect_fn()
+        if not self.extra_labels:
+            return text.encode()
+        out = []
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                out.append(line)
+                continue
+            name, _, rest = line.partition(" ")
+            if "{" in name:
+                base, _, tail = name.partition("{")
+                out.append(f"{base}{{{self.extra_labels},{tail} {rest}")
+            else:
+                out.append(f"{name}{{{self.extra_labels}}} {rest}")
+        return "\n".join(out).encode()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                body = self._render()
+                for url in self.urls:
+                    try:
+                        req = urllib.request.Request(
+                            url, data=body, method="POST",
+                            headers={"Content-Type": "text/plain"})
+                        with urllib.request.urlopen(req, timeout=10):
+                            self.pushes += 1
+                    except OSError as e:
+                        self.errors += 1
+                        logger.throttled_warnf("pushmetrics", 30,
+                                               "pushmetrics %s: %s", url, e)
+            except Exception as e:  # collect_fn error must not kill the loop
+                self.errors += 1
+                logger.throttled_warnf("pushmetrics-collect", 30,
+                                       "pushmetrics collect: %s", e)
