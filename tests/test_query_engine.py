@@ -335,3 +335,58 @@ class TestErrors:
     def test_instant(self, ec):
         rows = exec_instant(ec, "sum(mem_bytes)", T0 + 5 * STEP)
         assert len(rows) == 1 and rows[0].values.size == 1
+
+
+class TestStaleNaNHandling:
+    """reference eval.go:2081 dropStaleNaNs — staleness markers must not
+    poison non-default rollup windows."""
+
+    @pytest.fixture()
+    def stale_store(self, tmp_path):
+        from victoriametrics_tpu.ops import decimal as dec
+        s = Storage(str(tmp_path / "stale"))
+        rows = []
+        for j in range(121):
+            ts = T0 - 600_000 + j * 15_000
+            rows.append(({"__name__": "ctr"}, ts, 10.0 * j))
+        # staleness marker mid-stream (target restart)
+        rows.append(({"__name__": "ctr"}, T0 + 5 * STEP + 1000,
+                     dec.STALE_NAN))
+        s.add_rows(rows)
+        s.force_flush()
+        yield s
+        s.close()
+
+    def test_rate_ignores_marker(self, stale_store):
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=stale_store)
+        rows = exec_query(ec, "rate(ctr[5m])")
+        assert len(rows) == 1
+        assert not np.isnan(rows[0].values).any()
+        assert np.allclose(rows[0].values, 10.0 / 15.0, rtol=1e-6)
+
+    def test_sum_over_time_ignores_marker(self, stale_store):
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=stale_store)
+        for fn in ("sum_over_time", "avg_over_time"):
+            rows = exec_query(ec, f"{fn}(ctr[5m])")
+            assert not np.isnan(rows[0].values).any(), fn
+
+    def test_stale_samples_over_time_counts_marker(self, stale_store):
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=stale_store)
+        rows = exec_query(ec, "stale_samples_over_time(ctr[5m])")
+        assert rows[0].values.max() == 1.0
+
+
+class TestBinopLabelStripping:
+    def test_ignoring_strips_labels_one_to_one(self, ec):
+        # a / ignoring(instance) b must drop `instance` from the result
+        rows = exec_query(
+            ec, 'mem_bytes{instance="h1"} / ignoring(instance) '
+                'mem_bytes{instance="h1"}')
+        assert len(rows) == 1
+        for r in rows:
+            assert "instance" not in r.metric_name.to_dict()
+
+    def test_on_keeps_only_on_labels(self, ec):
+        rows = exec_query(ec, 'mem_bytes / on(instance) mem_bytes')
+        for r in rows:
+            assert set(r.metric_name.to_dict()) <= {"instance"}

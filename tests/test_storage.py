@@ -383,3 +383,46 @@ class TestReviewRegressions:
         assert len(s3.search_series(filters_from_dict({"__name__": "cpu_usage"}),
                                     T0, T0 + 10_000_000)) == 1
         s3.close()
+
+
+class TestDedupSemantics:
+    """reference lib/storage/dedup.go:30-121 — right-inclusive windows,
+    max-value tie-break preferring non-stale (issues 3333, 10196)."""
+
+    def test_exact_multiple_closes_window(self):
+        import numpy as np
+        from victoriametrics_tpu.storage.dedup import deduplicate
+        # a sample at an exact interval multiple belongs to the window
+        # ENDING there, not the next one
+        ts = np.array([60_000, 120_000, 120_001], dtype=np.int64)
+        vals = np.array([1.0, 2.0, 3.0])
+        kt, kv = deduplicate(ts, vals, 60_000)
+        assert list(kt) == [60_000, 120_000, 120_001]
+        # two samples inside (60000, 120000]
+        ts = np.array([60_001, 120_000, 180_000], dtype=np.int64)
+        vals = np.array([1.0, 2.0, 3.0])
+        kt, kv = deduplicate(ts, vals, 60_000)
+        assert list(kt) == [120_000, 180_000]
+        assert list(kv) == [2.0, 3.0]
+
+    def test_equal_ts_prefers_non_stale(self):
+        import numpy as np
+        from victoriametrics_tpu.ops import decimal as dec
+        from victoriametrics_tpu.storage.dedup import deduplicate
+        ts = np.array([100, 100, 100], dtype=np.int64)
+        vals = np.array([5.0, 7.0, dec.STALE_NAN])
+        kt, kv = deduplicate(ts, vals, 60_000)
+        assert kt.size == 1 and kv[0] == 7.0
+        # all stale -> stale marker survives
+        vals = np.array([dec.STALE_NAN, dec.STALE_NAN], dtype=np.float64)
+        kt, kv = deduplicate(ts[:2], vals, 60_000)
+        assert dec.is_stale_nan(kv[:1]).all()
+
+    def test_equal_ts_int64_mantissas(self):
+        import numpy as np
+        from victoriametrics_tpu.ops import decimal as dec
+        from victoriametrics_tpu.storage.dedup import deduplicate
+        ts = np.array([100, 100], dtype=np.int64)
+        vals = np.array([42, dec.V_STALE_NAN], dtype=np.int64)
+        kt, kv = deduplicate(ts, vals, 60_000)
+        assert kv[0] == 42

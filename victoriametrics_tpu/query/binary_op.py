@@ -148,6 +148,12 @@ def eval_binary_op(op: str, left: list[Timeseries], right: list[Timeseries],
             mn.labels = [(k, v) for k, v in mn.labels if k in keep]
             if b"__name__" not in keep:
                 mn.metric_group = b""
+        elif ignoring is not None:
+            # reference binary_op.go one-to-one branch calls
+            # MetricName.RemoveTagsIgnoring(groupTags): ignored labels are
+            # dropped from the result series
+            drop = {k.encode() for k in ignoring}
+            mn.labels = [(k, v) for k, v in mn.labels if k not in drop]
         out.append(Timeseries(mn, vals))
     return out
 

@@ -248,6 +248,7 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
     qt = ec.tracer.new_child("fetch %s window=%dms", me, lookback)
     series = ec.storage.search_series(filters, fetch_lo, end,
                                       max_series=ec.max_series)
+    series = _drop_stale_nans(func, series)
     qt.donef("%d series, %d samples", len(series),
              sum(s.timestamps.size for s in series))
     cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
@@ -268,6 +269,22 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
         out_rows.append(vals)
     qt.donef("%d series", len(out_rows))
     return _finish_rollup(series, out_rows, keep_name)
+
+
+def _drop_stale_nans(func: str, series):
+    """Strip Prometheus staleness markers before rollup computation
+    (reference eval.go:2081 dropStaleNaNs). default_rollup needs them for
+    staleness detection; stale_samples_over_time counts them."""
+    if func in ("default_rollup", "stale_samples_over_time"):
+        return series
+    from ..ops import decimal as dec_ops
+    for sd in series:
+        stale = dec_ops.is_stale_nan(sd.values)
+        if stale.any():
+            keep = ~stale
+            sd.timestamps = sd.timestamps[keep]
+            sd.values = sd.values[keep]
+    return series
 
 
 def _finish_rollup(series, rows, keep_name: bool) -> list[Timeseries]:
