@@ -211,3 +211,66 @@ class TestDeviceDecode:
         series = [(np.array([START, START + 1000], dtype=np.int64),
                    np.array([0, 1 << 40], dtype=np.int64), 0)]
         assert dd.pack_delta_planes(series, CFG.start) is None
+
+
+class TestRollupBatchVsLoop:
+    """rollup_batch must match the per-series rollup() loop exactly for
+    every SUPPORTED func on ragged, reset-y, gap-y data."""
+
+    def _mk_series(self, seed):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        series = []
+        T0 = 1_753_700_000_000
+        for s in range(37):
+            n = int(rng.integers(1, 60))
+            # jittered 15s cadence with occasional gaps
+            gaps = rng.integers(1, 5, n).cumsum()
+            ts = T0 - 900_000 + gaps * 15_000 + rng.integers(-500, 500, n)
+            ts.sort()
+            if rng.random() < 0.5:
+                vals = rng.integers(0, 50, n).cumsum().astype(float)
+                if n > 5 and rng.random() < 0.5:
+                    vals[n // 2:] -= vals[n // 2]  # counter reset
+            else:
+                vals = rng.normal(100, 10, n)
+            series.append((ts.astype(np.int64), vals.astype(np.float64)))
+        return series
+
+    def test_all_supported_funcs_match(self):
+        import numpy as np
+        from victoriametrics_tpu.ops import rollup_np
+        from victoriametrics_tpu.ops.rollup_np import RollupConfig, rollup
+        T0 = 1_753_700_000_000
+        cfg = RollupConfig(start=T0 - 600_000, end=T0, step=60_000,
+                           window=120_000)
+        cfg2 = RollupConfig(start=T0 - 600_000, end=T0, step=60_000,
+                            window=0)  # lookback = step
+        for seed in (0, 1):
+            series = self._mk_series(seed)
+            for c in (cfg, cfg2):
+                for func in rollup_np.SUPPORTED:
+                    batch = rollup_np.rollup_batch(func, series, c)
+                    assert batch is not None, func
+                    # stddev/stdvar go through prefix sums: zero-variance
+                    # windows see ~1e-7 absolute noise (documented; far
+                    # below metric precision)
+                    atol = (1e-5 if func in ("stddev_over_time",
+                                             "stdvar_over_time") else 1e-9)
+                    for s, (ts, vals) in enumerate(series):
+                        want = rollup(func, ts, vals, c)
+                        got = batch[s]
+                        np.testing.assert_allclose(
+                            got, want, rtol=1e-6, atol=atol, equal_nan=True,
+                            err_msg=f"{func} seed={seed} series={s}")
+
+    def test_nan_values_fall_back(self):
+        import numpy as np
+        from victoriametrics_tpu.ops import rollup_np
+        from victoriametrics_tpu.ops.rollup_np import RollupConfig
+        T0 = 1_753_700_000_000
+        cfg = RollupConfig(start=T0, end=T0 + 60_000, step=60_000,
+                           window=120_000)
+        series = [(np.array([T0 - 10_000, T0 - 5_000], dtype=np.int64),
+                   np.array([1.0, np.nan]))]
+        assert rollup_np.rollup_batch("sum_over_time", series, cfg) is None

@@ -426,3 +426,34 @@ class TestDedupSemantics:
         vals = np.array([42, dec.V_STALE_NAN], dtype=np.int64)
         kt, kv = deduplicate(ts, vals, 60_000)
         assert kv[0] == 42
+
+
+class TestQueryPathCaches:
+    def test_single_sample_blocks_not_collapsed_by_cache(self, tmp_path):
+        # zero-length const payloads share file offsets; the block cache
+        # must not return one series' block for another (regression)
+        s = mk_storage(tmp_path)
+        s.add_rows([({"__name__": "bm", "i": str(i)}, T0 + i * 1000, float(i))
+                    for i in range(50)])
+        s.force_flush()
+        f = filters_from_dict({"__name__": "bm"})
+        assert len(s.search_series(f, T0, T0 + 100_000)) == 50
+        # second (warm, cache-served) query must see all series too
+        assert len(s.search_series(f, T0, T0 + 100_000)) == 50
+        s.close()
+
+    def test_posting_cache_hits_and_invalidation(self, tmp_path):
+        s = mk_storage(tmp_path)
+        s.add_rows([({"__name__": "pc", "i": str(i)}, T0, float(i))
+                    for i in range(10)])
+        f = filters_from_dict({"__name__": "pc"})
+        r1 = s.idb.search_metric_ids(f, T0, T0 + 1000)
+        h0 = s.idb.filter_cache_hits
+        r2 = s.idb.search_metric_ids(f, T0, T0 + 1000)
+        assert s.idb.filter_cache_hits == h0 + 1
+        assert (r1 == r2).all()
+        # a new series invalidates the cached posting set
+        s.add_rows([({"__name__": "pc", "i": "new"}, T0, 1.0)])
+        r3 = s.idb.search_metric_ids(f, T0, T0 + 1000)
+        assert r3.size == 11
+        s.close()

@@ -329,7 +329,11 @@ class PrometheusAPI:
             ec = self._ec(start, end, step)
             ec.tracer = qt
             with self.gate:
-                rows = self._exec_range_cached(ec, q, now)
+                if req.arg("nocache") == "1":
+                    # reference -search.disableCache / nocache=1 query arg
+                    rows = exec_query(ec, q)
+                else:
+                    rows = self._exec_range_cached(ec, q, now)
         except TimeoutError as e:
             resp = Response.error(str(e), 429, "too_many_requests")
             resp.headers["Retry-After"] = "10"

@@ -192,3 +192,218 @@ SUPPORTED = (
     "increase_pure", "rate", "irate", "idelta", "deriv", "deriv_fast", "lag",
     "lifetime", "scrape_interval",
 )
+
+
+def rollup_batch(func: str, series: list, cfg: RollupConfig):
+    """Vectorized multi-series rollup: one (S, T) computation instead of a
+    per-series/per-window Python loop — the host-side analog of the device
+    tile kernels (ops/device_rollup.py). `series` is a list of (ts, values)
+    pairs, each time-sorted.
+
+    Returns an (S, T) float64 array, or None when the inputs need the exact
+    per-series path (NaN values poison the cumsum formulation).
+    Semantics are bit-compatible with rollup() above (tested side by side).
+    """
+    if func not in SUPPORTED:
+        return None
+    S = len(series)
+    out_ts = cfg.out_timestamps()
+    T = out_ts.size
+    if S == 0:
+        return np.full((0, T), np.nan)
+    N = max(int(np.asarray(ts).size) for ts, _ in series)
+    if N == 0:
+        return np.full((S, T), np.nan)
+    ts2 = np.full((S, N), np.iinfo(np.int64).max, dtype=np.int64)
+    v2 = np.zeros((S, N), dtype=np.float64)
+    counts = np.empty(S, dtype=np.int64)
+    for s, (ts, v) in enumerate(series):
+        n = int(np.asarray(ts).size)
+        counts[s] = n
+        ts2[s, :n] = ts
+        v2[s, :n] = v
+    if np.isnan(v2).any():
+        return None
+
+    lo = np.empty((S, T), dtype=np.int64)
+    hi = np.empty((S, T), dtype=np.int64)
+    w_lo = out_ts - cfg.lookback
+    for s in range(S):
+        row = ts2[s, :counts[s]]
+        lo[s] = np.searchsorted(row, w_lo, side="right")
+        hi[s] = np.searchsorted(row, out_ts, side="right")
+    have = hi > lo
+    nwin = hi - lo                       # samples per window
+    prev = lo - 1                        # last sample at/before window start
+    has_prev = prev >= 0
+    out = np.full((S, T), np.nan)
+
+    def gather(arr2d, idx, fill=0.0):
+        got = np.take_along_axis(arr2d, np.clip(idx, 0, N - 1), axis=1)
+        return got
+
+    last_i = np.clip(hi - 1, 0, N - 1)
+
+    if func == "count_over_time":
+        return np.where(nwin > 0, nwin.astype(np.float64), np.nan)
+    if func == "present_over_time":
+        return np.where(have, 1.0, np.nan)
+
+    if func in ("sum_over_time", "avg_over_time", "stddev_over_time",
+                "stdvar_over_time"):
+        c1 = np.concatenate([np.zeros((S, 1)), np.cumsum(v2, axis=1)], axis=1)
+        s1 = np.take_along_axis(c1, hi, axis=1) - \
+            np.take_along_axis(c1, lo, axis=1)
+        if func == "sum_over_time":
+            return np.where(have, s1, np.nan)
+        cnt = np.where(nwin > 0, nwin, 1).astype(np.float64)
+        if func == "avg_over_time":
+            return np.where(have, s1 / cnt, np.nan)
+        # center per series before the E[x^2]-E[x]^2 cumsums: variance is
+        # shift-invariant and this kills the catastrophic cancellation
+        shift = v2[:, :1]
+        vc = v2 - shift
+        c1c = np.concatenate([np.zeros((S, 1)), np.cumsum(vc, axis=1)],
+                             axis=1)
+        s1c = np.take_along_axis(c1c, hi, axis=1) - \
+            np.take_along_axis(c1c, lo, axis=1)
+        c2 = np.concatenate([np.zeros((S, 1)), np.cumsum(vc * vc, axis=1)],
+                            axis=1)
+        s2 = np.take_along_axis(c2, hi, axis=1) - \
+            np.take_along_axis(c2, lo, axis=1)
+        var = np.maximum(s2 / cnt - (s1c / cnt) ** 2, 0.0)
+        return np.where(have, np.sqrt(var) if func == "stddev_over_time"
+                        else var, np.nan)
+
+    if func in ("min_over_time", "max_over_time"):
+        red = np.minimum if func == "min_over_time" else np.maximum
+        fill = np.inf if func == "min_over_time" else -np.inf
+        for s in range(S):
+            # one pad element so hi == N is a valid reduceat index; [a,b)
+            # pairs land on even slots, inter-window segments are discarded
+            arr = np.concatenate([v2[s], [fill]])
+            idx = np.stack([lo[s], hi[s]], axis=1).reshape(-1)
+            r = red.reduceat(arr, idx)[::2]
+            out[s] = np.where(have[s], r, np.nan)
+        return out
+
+    if func == "first_over_time":
+        return np.where(have, gather(v2, lo), np.nan)
+    if func in ("last_over_time", "default_rollup"):
+        return np.where(have, gather(v2, last_i), np.nan)
+    if func == "tfirst_over_time":
+        return np.where(have, gather(ts2, lo) / 1e3, np.nan)
+    if func in ("tlast_over_time", "timestamp"):
+        return np.where(have, gather(ts2, last_i) / 1e3, np.nan)
+    if func == "lag":
+        return np.where(have, (out_ts[None, :] - gather(ts2, last_i)) / 1e3,
+                        np.nan)
+    if func == "lifetime":
+        first = np.where(has_prev, ts2[:, :1], gather(ts2, lo))
+        return np.where(have, (gather(ts2, last_i) - first) / 1e3, np.nan)
+    if func == "scrape_interval":
+        t_last = gather(ts2, last_i)
+        t_prev = gather(ts2, np.maximum(prev, 0))
+        t_first = gather(ts2, lo)
+        with np.errstate(all="ignore"):
+            r_prev = (t_last - t_prev) / 1e3 / nwin
+            r_self = (t_last - t_first) / 1e3 / np.maximum(nwin - 1, 1)
+        res = np.where(has_prev, r_prev,
+                       np.where(nwin >= 2, r_self, np.nan))
+        return np.where(have, res, np.nan)
+    if func == "changes":
+        ind = np.zeros((S, N))
+        ind[:, 1:] = (np.diff(v2, axis=1) != 0).astype(np.float64)
+        # mask changes into the padded region
+        col = np.arange(N)[None, :]
+        ind[col >= counts[:, None]] = 0.0
+        cz = np.concatenate([np.zeros((S, 1)), np.cumsum(ind, axis=1)],
+                            axis=1)  # cz[k] = sum ind[0..k-1], ind[0] = 0
+        # window [a,b): with prev the compared pairs are i in [a,b), without
+        # they are i in [1,b) — both reduce to cz[b] - cz[a]
+        return np.where(have,
+                        np.take_along_axis(cz, hi, axis=1) -
+                        np.take_along_axis(cz, lo, axis=1), np.nan)
+
+    # counter / derivative family
+    needs_reset = func in ("rate", "increase", "irate", "increase_pure")
+    if needs_reset:
+        d = np.diff(v2, axis=1)
+        drop = np.where(d < 0, -d, 0.0)
+        corr = np.concatenate([np.zeros((S, 1)), np.cumsum(drop, axis=1)],
+                              axis=1)
+        cw2 = v2 + corr
+    else:
+        cw2 = v2
+
+    v_last = gather(v2, last_i)
+    c_last = gather(cw2, last_i)
+    t_last = gather(ts2, last_i)
+    v_first = gather(v2, lo)
+    c_first = gather(cw2, lo)
+    t_first = gather(ts2, lo)
+    pidx = np.maximum(prev, 0)
+    v_prev = gather(v2, pidx)
+    c_prev = gather(cw2, pidx)
+    t_prev = gather(ts2, pidx)
+
+    with np.errstate(all="ignore"):
+        if func == "delta":
+            base = np.where(has_prev, v_prev, v_first)
+            return np.where(have, v_last - base, np.nan)
+        if func in ("increase", "increase_pure"):
+            base = np.where(has_prev, c_prev, c_first)
+            return np.where(have, c_last - base, np.nan)
+        if func == "rate":
+            dt = np.where(has_prev, t_last - t_prev, t_last - t_first) / 1e3
+            dv = np.where(has_prev, c_last - c_prev, c_last - c_first)
+            ok = have & (has_prev | (nwin >= 2))
+            res = np.where(dt > 0, dv / dt, np.nan)
+            return np.where(ok, res, np.nan)
+        if func == "deriv_fast":
+            dt = np.where(has_prev, t_last - t_prev, t_last - t_first) / 1e3
+            dv = np.where(has_prev, v_last - v_prev, v_last - v_first)
+            ok = have & (has_prev | (nwin >= 2))
+            res = np.where(dt > 0, dv / dt, np.nan)
+            return np.where(ok, res, np.nan)
+        if func in ("irate", "idelta"):
+            arr = cw2 if func == "irate" else v2
+            i2 = np.clip(hi - 2, 0, N - 1)
+            a_last = gather(arr, last_i)
+            a_pen = gather(arr, i2)
+            a_prev = gather(arr, pidx)
+            two = nwin >= 2
+            if func == "idelta":
+                res = np.where(two, a_last - a_pen,
+                               np.where(has_prev, a_last - a_prev, np.nan))
+                return np.where(have, res, np.nan)
+            t_pen = gather(ts2, i2)
+            dt = np.where(two, t_last - t_pen, t_last - t_prev) / 1e3
+            dv = np.where(two, a_last - a_pen, a_last - a_prev)
+            ok = have & (two | has_prev)
+            res = np.where(dt > 0, dv / dt, np.nan)
+            return np.where(ok, res, np.nan)
+        if func == "deriv":
+            # least-squares slope; shift t by cfg.start for numerics
+            t_rel = (ts2 - cfg.start) / 1e3
+            t_rel = np.where(np.arange(N)[None, :] < counts[:, None],
+                             t_rel, 0.0)
+            ct = np.concatenate([np.zeros((S, 1)), np.cumsum(t_rel, axis=1)],
+                                axis=1)
+            ctt = np.concatenate([np.zeros((S, 1)),
+                                  np.cumsum(t_rel * t_rel, axis=1)], axis=1)
+            cv = np.concatenate([np.zeros((S, 1)), np.cumsum(v2, axis=1)],
+                                axis=1)
+            ctv = np.concatenate([np.zeros((S, 1)),
+                                  np.cumsum(t_rel * v2, axis=1)], axis=1)
+
+            def wsum(c):
+                return (np.take_along_axis(c, hi, axis=1) -
+                        np.take_along_axis(c, lo, axis=1))
+            n = nwin.astype(np.float64)
+            st, sv, stt, stv = wsum(ct), wsum(cv), wsum(ctt), wsum(ctv)
+            den = n * stt - st * st
+            res = np.where(den != 0, (n * stv - st * sv) / den, np.nan)
+            return np.where(have & (nwin >= 2), res, np.nan)
+
+    return None

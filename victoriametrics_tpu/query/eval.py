@@ -263,6 +263,13 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
         qt.donef("fell back to host")
 
     qt = ec.tracer.new_child("host rollup %s", func)
+    if not args and len(series) >= 8:
+        from ..ops import rollup_np
+        rows = rollup_np.rollup_batch(
+            func, [(sd.timestamps, sd.values) for sd in series], cfg)
+        if rows is not None:
+            qt.donef("%d series (batched)", len(series))
+            return _finish_rollup(series, list(rows), keep_name)
     out_rows = []
     for sd in series:
         vals = rollup_series(func, sd.timestamps, sd.values, cfg, args)

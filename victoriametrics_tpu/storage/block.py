@@ -59,7 +59,8 @@ class BlockHeader:
 class Block:
     """Decoded (in-RAM) block."""
 
-    __slots__ = ("tsid", "timestamps", "values", "scale", "precision_bits")
+    __slots__ = ("tsid", "timestamps", "values", "scale", "precision_bits",
+                 "_floats")
 
     def __init__(self, tsid: TSID, timestamps: np.ndarray, values: np.ndarray,
                  scale: int, precision_bits: int = 64):
@@ -68,6 +69,7 @@ class Block:
         self.values = values  # int64 mantissas
         self.scale = scale
         self.precision_bits = precision_bits
+        self._floats = None
 
     @classmethod
     def from_floats(cls, tsid: TSID, timestamps: np.ndarray,
@@ -77,7 +79,12 @@ class Block:
                    precision_bits)
 
     def float_values(self) -> np.ndarray:
-        return dec.decimal_to_float(self.values, self.scale)
+        # memoized: blocks live in the part block cache across queries
+        if self._floats is None:
+            f = dec.decimal_to_float(self.values, self.scale)
+            f.setflags(write=False)
+            self._floats = f
+        return self._floats
 
     @property
     def rows(self) -> int:

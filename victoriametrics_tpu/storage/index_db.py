@@ -56,18 +56,45 @@ def _tag_key_bytes(key: bytes, value: bytes) -> bytes:
 
 
 class IndexDB:
-    """One index table + in-memory deleted-set cache."""
+    """One index table + in-memory caches.
+
+    Caches (reference lib/storage/index_db.go:306-361 analogs):
+    - metricID->MetricName / metricID->TSID dicts: entries are immutable
+      once created (append-only LSM), so they never go stale; bounded by
+      eviction of arbitrary entries at MAX_ID_CACHE.
+    - tagFilters->metricIDs posting cache: keyed by (filters, date range),
+      invalidated via a generation counter bumped on every index write —
+      steady-state ingest (no new series) leaves the generation stable.
+    """
+
+    MAX_ID_CACHE = 1 << 20
+    MAX_FILTER_CACHE = 1024
 
     def __init__(self, path: str):
         self.table = Table(path)
         self._lock = threading.Lock()
         self._deleted = self._load_deleted()
+        self._gen = 0
+        self._name_cache: dict[int, MetricName] = {}
+        self._tsid_cache: dict[int, TSID] = {}
+        self._filter_cache: "dict[tuple, tuple[int, np.ndarray]]" = {}
+        self.filter_cache_requests = 0
+        self.filter_cache_hits = 0
 
     def close(self):
         self.table.close()
 
     def flush(self):
         self.table.flush_to_disk()
+
+    def _bump_gen(self):
+        with self._lock:
+            self._gen += 1
+
+    def _cache_ids(self, cache: dict, key: int, value) -> None:
+        if len(cache) >= self.MAX_ID_CACHE:
+            cache.clear()
+        cache[key] = value
 
     # -- writes ------------------------------------------------------------
 
@@ -86,6 +113,7 @@ class IndexDB:
         for k, v in mn.labels:
             items.append(NS_TAG_TO_MID + _tag_key_bytes(k, v) + mid)
         self.table.add_items(items)
+        self._bump_gen()
 
     def create_per_day_indexes(self, mn: MetricName, tsid: TSID, date: int) -> None:
         """(date, X) indexes binding the series to one day
@@ -100,33 +128,68 @@ class IndexDB:
         for k, v in mn.labels:
             items.append(NS_DATE_TAG_TO_MID + d + _tag_key_bytes(k, v) + mid)
         self.table.add_items(items)
+        self._bump_gen()
 
     def delete_series_by_ids(self, metric_ids: np.ndarray) -> int:
         items = [NS_DELETED + _U64.pack(int(m)) for m in metric_ids]
         self.table.add_items(items)
         with self._lock:
             self._deleted = np.union1d(self._deleted, metric_ids)
+        self._bump_gen()
         return len(items)
 
     # -- point lookups -----------------------------------------------------
 
     def get_tsid_by_name(self, mn_marshaled: bytes) -> TSID | None:
         prefix = NS_NAME_TO_TSID + mn_marshaled + b"\x00"
-        for item in self.table.search_prefix(prefix):
-            return TSID.unmarshal(item[len(prefix):])
-        return None
+        item = self.table.first_with_prefix(prefix)
+        if item is None:
+            return None
+        return TSID.unmarshal(item[len(prefix):])
 
     def get_metric_name_by_id(self, metric_id: int) -> MetricName | None:
+        got = self.get_metric_name_raw_by_id(metric_id)
+        return got[0] if got is not None else None
+
+    def get_metric_name_raw_by_id(self, metric_id: int
+                                  ) -> tuple[MetricName, bytes] | None:
+        """(MetricName, marshaled bytes) — the raw form doubles as a cheap
+        sort/group key so hot paths skip re-marshaling."""
+        got = self._name_cache.get(metric_id)
+        if got is not None:
+            return got
         prefix = NS_MID_TO_NAME + _U64.pack(metric_id)
-        for item in self.table.search_prefix(prefix):
-            return MetricName.unmarshal(item[len(prefix):])
-        return None
+        item = self.table.first_with_prefix(prefix)
+        if item is None:
+            return None
+        raw = item[len(prefix):]
+        got = (MetricName.unmarshal(raw), raw)
+        self._cache_ids(self._name_cache, metric_id, got)
+        return got
 
     def get_tsid_by_id(self, metric_id: int) -> TSID | None:
+        t = self._tsid_cache.get(metric_id)
+        if t is not None:
+            return t
         prefix = NS_MID_TO_TSID + _U64.pack(metric_id)
-        for item in self.table.search_prefix(prefix):
-            return TSID.unmarshal(item[len(prefix):])
-        return None
+        item = self.table.first_with_prefix(prefix)
+        if item is None:
+            return None
+        t = TSID.unmarshal(item[len(prefix):])
+        self._cache_ids(self._tsid_cache, metric_id, t)
+        return t
+
+    def get_metric_names_by_ids(self, metric_ids
+                                ) -> dict[int, tuple[MetricName, bytes]]:
+        """Batched metricID->(MetricName, raw) resolution: one cached-block
+        bisect per missing id instead of a merge-iteration per id."""
+        out: dict[int, tuple[MetricName, bytes]] = {}
+        for mid in metric_ids:
+            mid = int(mid)
+            got = self.get_metric_name_raw_by_id(mid)
+            if got is not None:
+                out[mid] = got
+        return out
 
     def has_date_metric_id(self, date: int, metric_id: int) -> bool:
         return self.table.has_item(
@@ -206,7 +269,30 @@ class IndexDB:
                           min_ts: int | None = None,
                           max_ts: int | None = None) -> np.ndarray:
         """Resolve tag filters to a sorted metricID array
-        (searchMetricIDs, index_db.go:1685 analog)."""
+        (searchMetricIDs, index_db.go:1685 analog), memoized in the
+        tagFilters->metricIDs cache (index_db.go:336-361 analog)."""
+        ckey = (tuple((tf.key, tf.value, tf.negate, tf.regex)
+                      for tf in filters),
+                None if min_ts is None else date_of_ms(min_ts),
+                None if max_ts is None else date_of_ms(max_ts))
+        self.filter_cache_requests += 1
+        with self._lock:
+            got = self._filter_cache.get(ckey)
+            if got is not None and got[0] == self._gen:
+                self.filter_cache_hits += 1
+                return got[1]
+            gen = self._gen  # capture BEFORE the search: a concurrent index
+            # write during the scan must invalidate what we store
+        result = self._search_metric_ids_uncached(filters, min_ts, max_ts)
+        with self._lock:
+            if len(self._filter_cache) >= self.MAX_FILTER_CACHE:
+                self._filter_cache.clear()
+            self._filter_cache[ckey] = (gen, result)
+        return result
+
+    def _search_metric_ids_uncached(self, filters: list[TagFilter],
+                                    min_ts: int | None = None,
+                                    max_ts: int | None = None) -> np.ndarray:
         use_dates: list[int] | None = None
         if min_ts is not None and max_ts is not None:
             d0, d1 = date_of_ms(min_ts), date_of_ms(max_ts)
@@ -290,7 +376,7 @@ class IndexDB:
             t = self.get_tsid_by_id(int(mid))
             if t is not None:
                 out.append(t)
-        out.sort()
+        out.sort(key=TSID.sort_key)
         return out
 
     # -- label APIs --------------------------------------------------------

@@ -23,6 +23,7 @@ import json
 import os
 import struct
 import threading
+from collections import OrderedDict
 
 from ..ops import compress as zstd
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
@@ -31,6 +32,9 @@ from ..utils import logger
 MAX_BLOCK_BYTES = 64 << 10
 MAX_INMEMORY_PARTS = 15
 MAX_PENDING_ITEMS = 64 << 10
+# decoded-block cache: ~64KB of items per block; 512 blocks ~ 32MB+overhead
+# (the indexdb/data blockcache analog of reference lib/blockcache)
+MAX_CACHED_BLOCKS = 512
 
 
 def _encode_block(items: list[bytes]) -> bytes:
@@ -87,25 +91,49 @@ class _FilePart:
         self._firsts = [b[0] for b in self.blocks]
         self._f = open(os.path.join(path, "items.bin"), "rb")
         self._lock = threading.Lock()
+        self._block_cache: "OrderedDict[int, list[bytes]]" = OrderedDict()
 
     def close(self):
         self._f.close()
 
     def _read_block(self, i: int) -> list[bytes]:
-        first, off, size, cnt = self.blocks[i]
         with self._lock:
+            got = self._block_cache.get(i)
+            if got is not None:
+                self._block_cache.move_to_end(i)
+                return got
+            first, off, size, cnt = self.blocks[i]
             self._f.seek(off)
             data = self._f.read(size)
-        return _decode_block(data, cnt)
+        items = _decode_block(data, cnt)
+        with self._lock:
+            self._block_cache[i] = items
+            self._block_cache.move_to_end(i)
+            while len(self._block_cache) > MAX_CACHED_BLOCKS:
+                self._block_cache.popitem(last=False)
+        return items
 
     def iter_from(self, start: bytes):
         """Yield items >= start in order."""
         i = bisect.bisect_right(self._firsts, start) - 1
         i = max(i, 0)
         for bi in range(i, len(self.blocks)):
-            for it in self._read_block(bi):
-                if it >= start:
-                    yield it
+            items = self._read_block(bi)
+            j = bisect.bisect_left(items, start) if bi == i else 0
+            yield from items[j:]
+
+    def first_ge(self, key: bytes) -> bytes | None:
+        """First item >= key, or None (point-lookup fast path: decodes at
+        most one cached block instead of setting up a merge iteration)."""
+        i = max(bisect.bisect_right(self._firsts, key) - 1, 0)
+        for bi in (i, i + 1):
+            if bi >= len(self.blocks):
+                return None
+            items = self._read_block(bi)
+            j = bisect.bisect_left(items, key)
+            if j < len(items):
+                return items[j]
+        return None
 
     def iter_all(self):
         for bi in range(len(self.blocks)):
@@ -174,6 +202,7 @@ class Table:
         os.makedirs(path, exist_ok=True)
         self._lock = threading.RLock()
         self._pending: list[bytes] = []
+        self._pending_sorted: list[bytes] | None = []  # None = dirty
         self._mem_parts: list[list[bytes]] = []
         self._file_parts: list[_FilePart] = []
         self._part_seq = itertools.count()
@@ -212,6 +241,7 @@ class Table:
     def add_items(self, items) -> None:
         with self._lock:
             self._pending.extend(items)
+            self._pending_sorted = None
             if len(self._pending) >= MAX_PENDING_ITEMS:
                 self._flush_pending_locked()
                 if len(self._mem_parts) > MAX_INMEMORY_PARTS:
@@ -222,7 +252,13 @@ class Table:
             return
         part = sorted(set(self._pending))
         self._pending = []
+        self._pending_sorted = []
         self._mem_parts.append(part)
+
+    def _sorted_pending_locked(self) -> list[bytes]:
+        if self._pending_sorted is None:
+            self._pending_sorted = sorted(set(self._pending))
+        return self._pending_sorted
 
     def _merge_mem_to_file_locked(self):
         if not self._mem_parts:
@@ -266,7 +302,7 @@ class Table:
 
     def _sources_from(self, start: bytes):
         with self._lock:
-            pending = sorted(set(self._pending)) if self._pending else []
+            pending = self._sorted_pending_locked()
             mems = list(self._mem_parts)
             files = list(self._file_parts)
         srcs = []
@@ -292,9 +328,28 @@ class Table:
             yield it
 
     def has_item(self, item: bytes) -> bool:
-        for it in self.iter_from(item):
-            return it == item
-        return False
+        return self.first_with_prefix(item) == item
+
+    def first_with_prefix(self, prefix: bytes) -> bytes | None:
+        """Point lookup: the smallest item with the given prefix, or None.
+        Bisects each source directly (no merge-iterator setup, cached block
+        decode) — the hot path for unique-key namespaces."""
+        with self._lock:
+            pending = self._sorted_pending_locked()
+            mems = list(self._mem_parts)
+            files = list(self._file_parts)
+        best: bytes | None = None
+        for lst in ([pending] if pending else []) + mems:
+            i = bisect.bisect_left(lst, prefix)
+            if i < len(lst) and (best is None or lst[i] < best):
+                best = lst[i]
+        for fp in files:
+            it = fp.first_ge(prefix)
+            if it is not None and (best is None or it < best):
+                best = it
+        if best is not None and best.startswith(prefix):
+            return best
+        return None
 
     def item_count(self) -> int:
         with self._lock:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+from collections import OrderedDict
 
 import numpy as np
 
@@ -148,6 +149,12 @@ class Part:
         self._val_f = open(os.path.join(path, "values.bin"), "rb")
         import threading
         self._lock = threading.Lock()
+        # parts are immutable, so both caches never go stale (the reference
+        # keeps compressed blocks in lib/blockcache sized to 25% RAM; here we
+        # cache the *decoded* form so warm queries skip unmarshal entirely)
+        self._hdr_cache: dict[int, list[BlockHeader]] = {}
+        self._block_cache: "OrderedDict[tuple, Block]" = OrderedDict()
+        self._block_cache_bytes = 0
 
     def close(self):
         for f in (self._idx_f, self._ts_f, self._val_f):
@@ -158,16 +165,48 @@ class Part:
             f.seek(off)
             return f.read(size)
 
+    # byte-bounded per part: decoded ts(8B) + mantissas(8B) + the memoized
+    # float view (8B) per row; 64MB covers ~2.7M rows of hot data per part
+    MAX_BLOCK_CACHE_BYTES = 64 << 20
+
     def read_headers(self, row: MetaindexRow) -> list[BlockHeader]:
+        got = self._hdr_cache.get(row.index_offset)
+        if got is not None:
+            return got
         raw = zstd.decompress(self._read(self._idx_f, row.index_offset,
                                          row.index_size))
-        return [BlockHeader.unmarshal(raw, o)
+        hdrs = [BlockHeader.unmarshal(raw, o)
                 for o in range(0, len(raw), BlockHeader.SIZE)]
+        self._hdr_cache[row.index_offset] = hdrs
+        return hdrs
 
     def read_block(self, h: BlockHeader) -> Block:
+        # offsets alone can collide: const-encoded payloads are 0 bytes, so
+        # consecutive tiny blocks share offsets — include identity fields
+        key = (h.tsid.metric_id, h.min_ts, h.rows, h.ts_offset, h.val_offset)
+        with self._lock:
+            blk = self._block_cache.get(key)
+            if blk is not None:
+                self._block_cache.move_to_end(key)
+                return blk
         ts_data = self._read(self._ts_f, h.ts_offset, h.ts_size)
         val_data = self._read(self._val_f, h.val_offset, h.val_size)
-        return Block.unmarshal(h, ts_data, val_data)
+        blk = Block.unmarshal(h, ts_data, val_data)
+        # decoded arrays are shared across queries: freeze them so an
+        # accidental in-place mutation fails loudly instead of corrupting
+        blk.timestamps.setflags(write=False)
+        blk.values.setflags(write=False)
+        cost = 24 * h.rows
+        with self._lock:
+            if key not in self._block_cache:
+                self._block_cache_bytes += cost
+            self._block_cache[key] = blk
+            self._block_cache.move_to_end(key)
+            while self._block_cache_bytes > self.MAX_BLOCK_CACHE_BYTES and \
+                    len(self._block_cache) > 1:
+                _, old = self._block_cache.popitem(last=False)
+                self._block_cache_bytes -= 24 * old.rows
+        return blk
 
     def iter_headers(self, tsid_set: set | None = None,
                      min_ts: int | None = None, max_ts: int | None = None,

@@ -187,17 +187,28 @@ class Storage:
         if max_series is not None and len(per_mid) > max_series:
             raise ResourceWarning(
                 f"query matches {len(per_mid)} series, limit {max_series}")
+        names = self.idb.get_metric_names_by_ids(per_mid.keys())
         out = []
         for mid, blocks in per_mid.items():
-            mn = self.idb.get_metric_name_by_id(mid)
-            if mn is None:
+            got = names.get(mid)
+            if got is None:
                 continue
-            ts = np.concatenate([b.timestamps for b in blocks])
-            vals = np.concatenate([b.float_values() for b in blocks])
-            order = np.argsort(ts, kind="stable")
-            ts, vals = ts[order], vals[order]
-            keep = (ts >= min_ts) & (ts <= max_ts)
-            ts, vals = ts[keep], vals[keep]
+            mn, raw = got
+            if len(blocks) == 1:
+                # fast path: one block is already time-sorted
+                b = blocks[0]
+                ts, vals = b.timestamps, b.float_values()
+                if ts[0] < min_ts or ts[-1] > max_ts:
+                    lo = np.searchsorted(ts, min_ts, side="left")
+                    hi = np.searchsorted(ts, max_ts, side="right")
+                    ts, vals = ts[lo:hi], vals[lo:hi]
+            else:
+                ts = np.concatenate([b.timestamps for b in blocks])
+                vals = np.concatenate([b.float_values() for b in blocks])
+                order = np.argsort(ts, kind="stable")
+                ts, vals = ts[order], vals[order]
+                keep = (ts >= min_ts) & (ts <= max_ts)
+                ts, vals = ts[keep], vals[keep]
             if ts.size == 0:
                 continue
             if interval > 0:
@@ -207,9 +218,9 @@ class Storage:
                 dup = np.concatenate([ts[1:] == ts[:-1], [False]])
                 if dup.any():
                     ts, vals = ts[~dup], vals[~dup]
-            out.append(SeriesData(mn, ts, vals))
-        out.sort(key=lambda s: s.metric_name.marshal())
-        return out
+            out.append((raw, SeriesData(mn, ts, vals)))
+        out.sort(key=lambda rs: rs[0])
+        return [sd for _, sd in out]
 
     def label_names(self, min_ts=None, max_ts=None) -> list[str]:
         return self.idb.label_names(min_ts, max_ts)
