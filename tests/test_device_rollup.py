@@ -274,3 +274,52 @@ class TestRollupBatchVsLoop:
         series = [(np.array([T0 - 10_000, T0 - 5_000], dtype=np.int64),
                    np.array([1.0, np.nan]))]
         assert rollup_np.rollup_batch("sum_over_time", series, cfg) is None
+
+
+class TestFusedDeviceAggr:
+    """_try_device_fused_aggr must match the host aggregation exactly."""
+
+    @pytest.fixture(scope="class")
+    def store(self, tmp_path_factory):
+        import numpy as np
+        from victoriametrics_tpu.storage.storage import Storage
+        s = Storage(str(tmp_path_factory.mktemp("fused") / "s"))
+        rng = np.random.default_rng(7)
+        T0 = 1_753_700_000_000
+        rows = []
+        for i in range(96):
+            base = np.arange(60, dtype=np.int64) * 15_000 + T0 - 600_000
+            ts = np.sort(base + rng.integers(-2000, 2001, 60))
+            vals = np.cumsum(rng.integers(0, 30, 60)).astype(float)
+            lab = {"__name__": "fm", "instance": f"h{i % 8}",
+                   "job": f"j{i % 3}"}
+            rows.extend(zip([lab] * 60, ts.tolist(), vals.tolist()))
+        s.add_rows(rows)
+        s.force_flush()
+        yield s
+        s.close()
+
+    @pytest.mark.parametrize("q", [
+        "sum by (instance)(rate(fm[5m]))",
+        "avg by (job)(increase(fm[3m]))",
+        "count(last_over_time(fm[2m]))",
+        "max by (instance,job)(delta(fm[4m]))",
+        "min without (job,instance)(rate(fm[5m]))",
+        "stddev by (job)(avg_over_time(fm[5m]))",
+    ])
+    def test_fused_matches_host(self, store, q):
+        import numpy as np
+        from victoriametrics_tpu.query.exec import exec_query
+        from victoriametrics_tpu.query.tpu_engine import TPUEngine
+        from victoriametrics_tpu.query.types import EvalConfig
+        T0 = 1_753_700_000_000
+        kw = dict(start=T0 - 300_000, end=T0, step=60_000, storage=store)
+        host = exec_query(EvalConfig(**kw), q)
+        dev = exec_query(EvalConfig(**kw, tpu=TPUEngine(min_series=4)), q)
+        assert len(dev) == len(host) and len(host) > 0
+        hm = {r.metric_name.marshal(): r.values for r in host}
+        dm = {r.metric_name.marshal(): r.values for r in dev}
+        assert set(hm) == set(dm)
+        for k in hm:
+            np.testing.assert_allclose(dm[k], hm[k], rtol=1e-6, atol=1e-6,
+                                       equal_nan=True, err_msg=q)

@@ -457,3 +457,70 @@ class TestQueryPathCaches:
         r3 = s.idb.search_metric_ids(f, T0, T0 + 1000)
         assert r3.size == 11
         s.close()
+
+
+class TestIngestFastPath:
+    def test_day_rollover_creates_per_day_indexes(self, tmp_path):
+        s = mk_storage(tmp_path)
+        day_ms = 86_400_000
+        base = (T0 // day_ms) * day_ms
+        s.add_rows([({"__name__": "fr", "i": "1"}, base + 1000, 1.0)])
+        # same series next day through the fast path
+        s.add_rows([({"__name__": "fr", "i": "1"}, base + day_ms + 1000, 2.0)])
+        f = filters_from_dict({"__name__": "fr"})
+        # per-day index must find it on day 2 alone
+        res = s.search_series(f, base + day_ms, base + day_ms + 10_000)
+        assert len(res) == 1 and res[0].values[0] == 2.0
+        s.close()
+
+    def test_label_order_variants_resolve_same_tsid(self, tmp_path):
+        s = mk_storage(tmp_path)
+        s.add_rows([([(b"a", b"1"), (b"b", b"2"), (b"", b"lo")], T0, 1.0)])
+        s.add_rows([([(b"b", b"2"), (b"a", b"1"), (b"", b"lo")],
+                     T0 + 1000, 2.0)])
+        res = s.search_series(filters_from_dict({"__name__": "lo"}),
+                              T0, T0 + 10_000)
+        assert len(res) == 1 and res[0].timestamps.size == 2
+        s.close()
+
+    def test_delete_purges_raw_cache(self, tmp_path):
+        s = mk_storage(tmp_path)
+        s.add_rows([({"__name__": "dp", "i": "1"}, T0, 1.0)])
+        f = filters_from_dict({"__name__": "dp"})
+        assert s.delete_series(f) == 1
+        assert not s._tsid_cache_raw  # tombstoned ids must not linger
+        assert len(s.search_series(f, T0, T0 + 10_000)) == 0
+        s.close()
+
+
+class TestInfluxEscapes:
+    def test_escaped_tag_and_field_keys(self):
+        from victoriametrics_tpu.ingest.parsers import parse_influx
+        rows = list(parse_influx(
+            'weird\\ m,ta\\,g=va\\=lue fo\\=o=3,value=3.5 123000000'))
+        d = {tuple(sorted(r.labels)): (r.timestamp, r.value) for r in rows}
+        names = {dict(r.labels)["__name__"] for r in rows}
+        assert names == {"weird m_fo=o", "weird m"}
+        for r in rows:
+            assert dict(r.labels)["ta,g"] == "va=lue"
+            assert r.timestamp == 123
+
+    def test_tag_value_with_equals_same_on_both_paths(self):
+        from victoriametrics_tpu.ingest.parsers import parse_influx
+        fast = list(parse_influx('m,tag=a=b f=1 123000000'))
+        # a quote elsewhere forces the slow path for the same tag
+        slow = list(parse_influx('m,tag=a=b f=1,s="x" 123000000'))
+        assert dict(fast[0].labels)["tag"] == "a=b"
+        assert dict(slow[0].labels)["tag"] == "a=b"
+
+
+class TestRollupBatchNonFinite:
+    def test_inf_falls_back(self):
+        import numpy as np
+        from victoriametrics_tpu.ops import rollup_np
+        from victoriametrics_tpu.ops.rollup_np import RollupConfig
+        cfg = RollupConfig(start=T0, end=T0 + 120_000, step=60_000,
+                           window=120_000)
+        series = [(np.array([T0 - 10_000, T0 - 5_000], dtype=np.int64),
+                   np.array([np.inf, 2.0]))]
+        assert rollup_np.rollup_batch("sum_over_time", series, cfg) is None

@@ -139,13 +139,18 @@ def parse_influx(text: str, default_ts: int = 0, db: str = ""):
         yield from _parse_influx_line(line, now, db)
 
 
-def _split_unescaped(s: str, sep: str, escapable=",= "):
+def _split_unescaped(s: str, sep: str, escapable=",= ", keep=False):
+    """Split on unescaped `sep`. With keep=True the escape sequences are
+    preserved in the pieces (so nested splits still see them); unescape
+    with _influx_unescape after the LAST split."""
     out = []
     cur = []
     i = 0
     while i < len(s):
         c = s[i]
         if c == "\\" and i + 1 < len(s) and s[i + 1] in escapable + "\\":
+            if keep:
+                cur.append(c)
             cur.append(s[i + 1])
             i += 2
             continue
@@ -159,8 +164,51 @@ def _split_unescaped(s: str, sep: str, escapable=",= "):
     return out
 
 
+def _influx_unescape(s: str, escapable=",= "):
+    if "\\" not in s:
+        return s
+    out = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s) and s[i + 1] in escapable + "\\":
+            out.append(s[i + 1])
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
 def _parse_influx_line(line: str, now: int, db: str):
-    # split into up to 3 space-separated sections honoring escapes/quotes
+    if "\\" not in line and '"' not in line:
+        # fast path: no escapes / quoted strings — plain splits (the
+        # overwhelmingly common shape from telegraf and tsbs load)
+        sections = line.split(" ", 2)
+        if len(sections) < 2:
+            return
+        ts = now
+        if len(sections) > 2 and sections[2].strip():
+            ts = int(sections[2].strip()) // 1_000_000  # ns -> ms
+        parts = sections[0].split(",")
+        measurement = parts[0]
+        tags = [("db", db)] if db else []
+        for t in parts[1:]:
+            k, sep, v = t.partition("=")
+            if sep and v:
+                tags.append((k, v))
+        for f in sections[1].split(","):
+            fname, sep, fval = f.partition("=")
+            if not sep:
+                continue
+            v = _influx_field_value(fval)
+            if v is None:
+                continue
+            name = f"{measurement}_{fname}" if fname != "value" else measurement
+            yield Row([("__name__", name)] + tags, ts, v)
+        return
+    # slow path: split into up to 3 space-separated sections honoring
+    # escapes/quotes
     sections = []
     cur = []
     in_quotes = False
@@ -188,20 +236,23 @@ def _parse_influx_line(line: str, now: int, db: str):
     ts = now
     if len(sections) > 2 and sections[2].strip():
         ts = int(sections[2].strip()) // 1_000_000  # ns -> ms
-    parts = _split_unescaped(key, ",")
-    measurement = parts[0]
+    parts = _split_unescaped(key, ",", keep=True)
+    measurement = _influx_unescape(parts[0])
     tags = []
     if db:
         tags.append(("db", db))
     for t in parts[1:]:
-        kv = _split_unescaped(t, "=")
-        if len(kv) == 2 and kv[1]:
-            tags.append((kv[0], kv[1]))
-    for f in _split_unescaped(fields_str, ","):
-        kv = _split_unescaped(f, "=")
-        if len(kv) != 2:
+        # split on the FIRST unescaped '=' (matches the fast path's
+        # partition(): later '=' belong to the value)
+        kv = _split_unescaped(t, "=", keep=True)
+        if len(kv) >= 2 and kv[1]:
+            tags.append((_influx_unescape(kv[0]),
+                         _influx_unescape("=".join(kv[1:]))))
+    for f in _split_unescaped(fields_str, ",", keep=True):
+        kv = _split_unescaped(f, "=", keep=True)
+        if len(kv) < 2:
             continue
-        fname, fval = kv
+        fname, fval = _influx_unescape(kv[0]), "=".join(kv[1:])
         v = _influx_field_value(fval)
         if v is None:
             continue

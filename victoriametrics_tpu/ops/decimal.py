@@ -51,6 +51,101 @@ def _pow10_float(e):
     return np.power(10.0, np.asarray(e, dtype=np.float64))
 
 
+def _scalar_mantissa(x: float) -> tuple[int, int]:
+    """(mantissa, exponent) of one finite nonzero float via repr(), which is
+    the shortest decimal that round-trips — exactly the digits we want."""
+    if x == int(x) and abs(x) <= MAX_MANTISSA:
+        m, e = int(x), 0
+    else:
+        s = repr(x)
+        if "e" in s:
+            mant, _, ex = s.partition("e")
+            e = int(ex)
+        else:
+            mant, e = s, 0
+        intpart, _, frac = mant.partition(".")
+        e -= len(frac)
+        m = int(intpart + frac)
+        if abs(m) > MAX_MANTISSA:  # >17 significant digits can't happen via
+            while abs(m) > MAX_MANTISSA:  # repr, but stay safe
+                m = int(round(m / 10))
+                e += 1
+    while m != 0 and m % 10 == 0:
+        m //= 10
+        e += 1
+    return m, e
+
+
+def _float_to_decimal_small(v: np.ndarray) -> tuple[np.ndarray, int]:
+    """Scalar path for tiny arrays (the per-series streaming-flush case):
+    ~100x lower fixed overhead than the vectorized path."""
+    ms: list[int] = []
+    es: list[int] = []
+    out = np.empty(v.size, dtype=np.int64)
+    kinds: list[int] = []  # 0=normal 1=zero, negatives = specials
+    for x in v.tolist():
+        if x != x:  # NaN family: bit-test for the staleness marker
+            bits = np.float64(x).view(np.uint64)
+            kinds.append(-1 if bits == STALE_NAN_BITS else -2)
+        elif x == np.inf:
+            kinds.append(-3)
+        elif x == -np.inf:
+            kinds.append(-4)
+        elif x == 0.0:
+            kinds.append(1)
+        else:
+            m, e = _scalar_mantissa(x)
+            ms.append(m)
+            es.append(e)
+            kinds.append(0)
+    if ms:
+        exp = min(min(es), _MAX_EXP)
+        for m, e in zip(ms, es):
+            up = 0
+            am = abs(m)
+            while am * 10 ** (up + 1) <= MAX_MANTISSA:
+                up += 1
+            if e - up > exp:
+                exp = e - up
+        exp = max(min(exp, _MAX_EXP), _MIN_EXP)
+    else:
+        exp = 0
+    i = 0
+    k = 0
+    for j, kind in enumerate(kinds):
+        if kind == 0:
+            m, e = ms[i], es[i]
+            x = float(v[j])
+            i += 1
+            shift = e - exp
+            if shift > 0:
+                mm = m * 10 ** shift
+                if abs(mm) <= (1 << 53) or x == int(x):
+                    # exact: small enough for the float cast, or integer-
+                    # origin (decimal_to_float recovers those by exact
+                    # integer division)
+                    m = mm
+                else:
+                    # fractional + big mantissa: re-derive at the final
+                    # exponent like the vector path — repr() digits are the
+                    # SHORTEST form, zero-padding them would round-trip off
+                    # by an ulp through the float division
+                    if exp < 0:
+                        k1 = min(-exp, 300)
+                        m = int(round(x * 10.0 ** k1 * 10.0 ** (-exp - k1)))
+                    else:
+                        m = int(round(x / 10.0 ** exp))
+            elif shift < 0:
+                m = int(round(m / 10 ** min(-shift, 19)))
+            out[j] = min(max(m, -MAX_MANTISSA), MAX_MANTISSA)
+        elif kind == 1:
+            out[j] = 0
+        else:
+            out[j] = (V_STALE_NAN, V_NAN, V_INF_POS,
+                      V_INF_NEG)[-kind - 1]
+    return out, exp
+
+
 def float_to_decimal(values: np.ndarray) -> tuple[np.ndarray, int]:
     """Convert float64 array to (int64 mantissas, common exponent).
 
@@ -61,6 +156,8 @@ def float_to_decimal(values: np.ndarray) -> tuple[np.ndarray, int]:
     n = v.size
     if n == 0:
         return np.zeros(0, dtype=np.int64), 0
+    if n <= 8:
+        return _float_to_decimal_small(v)
 
     stale = is_stale_nan(v)
     nan = np.isnan(v) & ~stale

@@ -222,7 +222,9 @@ def rollup_batch(func: str, series: list, cfg: RollupConfig):
         counts[s] = n
         ts2[s, :n] = ts
         v2[s, :n] = v
-    if np.isnan(v2).any():
+    if not np.isfinite(v2).all():
+        # NaN *and* +/-Inf poison the cumsum formulation (inf-inf = nan
+        # for every window downstream); the per-series loop is exact
         return None
 
     lo = np.empty((S, T), dtype=np.int64)

@@ -231,12 +231,10 @@ def _eval_rollup_expr(ec: EvalConfig, func: str, re_: RollupExpr,
     return _rollup_subquery(ec, func, re_, window, offset, args, keep_name)
 
 
-def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
-                         window: int, offset: int, args: tuple,
-                         keep_name: bool) -> list[Timeseries]:
+def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
+                             window: int, offset: int):
+    """Shared fetch for the rollup paths: returns (series, cfg)."""
     me: MetricExpr = re_.expr
-    if me.is_empty():
-        return []
     if ec.storage is None:
         raise QueryError("no storage attached to the query engine")
     lookback = window if window > 0 else (
@@ -252,6 +250,16 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
     qt.donef("%d series, %d samples", len(series),
              sum(s.timestamps.size for s in series))
     cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
+    return series, cfg
+
+
+def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
+                         window: int, offset: int, args: tuple,
+                         keep_name: bool) -> list[Timeseries]:
+    me: MetricExpr = re_.expr
+    if me.is_empty():
+        return []
+    series, cfg = _fetch_series_for_rollup(ec, func, re_, window, offset)
 
     if ec.tpu is not None:
         from .tpu_engine import try_rollup_tpu
@@ -365,8 +373,76 @@ def _group_series(series: list[Timeseries], grouping: list[str],
     return groups, names
 
 
+_FUSED_AGGR_NAMES = ("sum", "count", "avg", "min", "max", "stddev",
+                     "stdvar", "group")
+
+
+def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
+                           ) -> list[Timeseries] | None:
+    """aggr by (...)(rollup(selector)) fused on device: rollup + segment
+    aggregation in one kernel so only [G, T] crosses the link (the
+    incremental-aggregation pushdown; None -> host path)."""
+    if ec.tpu is None or len(ae.args) != 1 or ae.name not in _FUSED_AGGR_NAMES:
+        return None
+    arg = ae.args[0]
+    if isinstance(arg, FuncExpr):
+        if len(arg.args) != 1 or arg.keep_metric_names:
+            return None
+        func, rarg = arg.name, arg.args[0]
+    elif isinstance(arg, (MetricExpr, RollupExpr)):
+        func, rarg = "default_rollup", arg
+    else:
+        return None
+    if isinstance(rarg, MetricExpr):
+        rarg = RollupExpr(expr=rarg)
+    if not isinstance(rarg, RollupExpr) or \
+            not isinstance(rarg.expr, MetricExpr) or rarg.expr.is_empty() or \
+            rarg.needs_subquery() or rarg.at is not None:
+        return None
+    from ..ops import rollup_np
+    from .tpu_engine import FUSED_AGGRS, try_aggr_rollup_tpu
+    if func not in rollup_np.SUPPORTED or ae.name not in FUSED_AGGRS:
+        return None
+    offset = rarg.offset.value_ms(ec.step) if rarg.offset is not None else 0
+    window = rarg.window.value_ms(ec.step) if rarg.window is not None else 0
+    series, cfg = _fetch_series_for_rollup(ec, func, rarg, window, offset)
+    if len(series) < ec.tpu.min_series:
+        return None  # host path re-fetches from warm caches
+    gb = [g.encode() for g in ae.grouping]
+    key_to_gid: dict[bytes, int] = {}
+    gids = np.empty(len(series), dtype=np.int32)
+    group_keys: list[bytes] = []
+    for i, sd in enumerate(series):
+        key = _group_key(sd.metric_name, gb, ae.without)
+        gid = key_to_gid.get(key)
+        if gid is None:
+            gid = len(group_keys)
+            key_to_gid[key] = gid
+            group_keys.append(key)
+        gids[i] = gid
+    qt = ec.tracer.new_child("tpu fused %s(%s)", ae.name, func)
+    out = try_aggr_rollup_tpu(ec.tpu, ae.name, func, series, gids,
+                              len(group_keys), cfg)
+    if out is None:
+        qt.donef("fell back to host")
+        return None
+    qt.donef("device path, %d series -> %d groups", len(series),
+             len(group_keys))
+    rows = [Timeseries(MetricName.unmarshal(k),
+                       np.asarray(out[g], dtype=np.float64))
+            for g, k in enumerate(group_keys)]
+    rows.sort(key=lambda ts: ts.metric_name.marshal())
+    if ae.limit and len(rows) > ae.limit:
+        rows = rows[:ae.limit]
+    return rows
+
+
 def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
     name = ae.name
+
+    fused = _try_device_fused_aggr(ec, ae)
+    if fused is not None:
+        return fused
 
     # arg layouts
     if name in ("topk", "bottomk", "limitk", "outliersk") or \

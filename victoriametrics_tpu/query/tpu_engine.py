@@ -40,7 +40,8 @@ def _fingerprint(series, start_ms: int) -> tuple:
     import xxhash
     h = xxhash.xxh64()
     for sd in series:
-        h.update(sd.metric_name.marshal())
+        raw = getattr(sd, "raw_name", None)
+        h.update(raw if raw is not None else sd.metric_name.marshal())
         h.update(np.int64(sd.timestamps.size).tobytes())
         if sd.timestamps.size:
             h.update(sd.timestamps[-1].tobytes())
@@ -78,6 +79,42 @@ def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
     ts_t, v_t, counts = tiles
     out = rollup_tile(func, ts_t, v_t, counts, cfg)
     return list(np.asarray(out, dtype=np.float64))
+
+
+FUSED_AGGRS = frozenset({"sum", "count", "avg", "min", "max", "stddev",
+                         "stdvar", "group"})
+
+
+def try_aggr_rollup_tpu(engine: TPUEngine, aggr: str, func: str, series,
+                        gids, num_groups: int, cfg: RollupConfig):
+    """Fused aggr(rollup(selector)) on device: per-series rollup + segment
+    aggregation run in one kernel, so only the [G, T] aggregate crosses the
+    device->host link (the incrementalAggrFuncCallbacks analog,
+    eval.go:1055; critical on tunneled links where D2H dominates).
+    Returns an [G, T] float64 array or None for host fallback."""
+    if aggr not in FUSED_AGGRS or func not in rollup_np.SUPPORTED:
+        return None
+    if len(series) < engine.min_series:
+        return None
+    span = cfg.end - cfg.start + cfg.lookback
+    if span >= 2**31 - 1:
+        return None
+    try:
+        import jax.numpy as jnp
+
+        from ..ops.device_rollup import rollup_aggregate_tile
+    except Exception:
+        return None
+    key = _fingerprint(series, cfg.start)
+    cache = engine.cache()
+    tiles = cache.get(key)
+    if tiles is None:
+        tiles = _upload_tiles(engine, series, cfg)
+        cache.put_device(key, tiles)
+    ts_t, v_t, counts = tiles
+    out = rollup_aggregate_tile(func, aggr, ts_t, v_t, counts,
+                                jnp.asarray(gids), cfg, num_groups)
+    return np.asarray(out, dtype=np.float64)
 
 
 def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):

@@ -240,8 +240,16 @@ class Table:
 
     def add_items(self, items) -> None:
         with self._lock:
+            items = list(items)
             self._pending.extend(items)
-            self._pending_sorted = None
+            # keep the sorted view incrementally: series churn interleaves
+            # point lookups with small add batches, and a full re-sort per
+            # lookup would be quadratic in churn
+            if self._pending_sorted is not None and len(items) <= 64:
+                for it in items:
+                    bisect.insort(self._pending_sorted, it)
+            else:
+                self._pending_sorted = None
             if len(self._pending) >= MAX_PENDING_ITEMS:
                 self._flush_pending_locked()
                 if len(self._mem_parts) > MAX_INMEMORY_PARTS:
@@ -302,7 +310,9 @@ class Table:
 
     def _sources_from(self, start: bytes):
         with self._lock:
-            pending = self._sorted_pending_locked()
+            # copy: the live sorted-pending list mutates under concurrent
+            # add_items insorts while these iterators are being consumed
+            pending = list(self._sorted_pending_locked())
             mems = list(self._mem_parts)
             files = list(self._file_parts)
         srcs = []
@@ -334,15 +344,16 @@ class Table:
         """Point lookup: the smallest item with the given prefix, or None.
         Bisects each source directly (no merge-iterator setup, cached block
         decode) — the hot path for unique-key namespaces."""
-        with self._lock:
-            pending = self._sorted_pending_locked()
-            mems = list(self._mem_parts)
-            files = list(self._file_parts)
         best: bytes | None = None
-        for lst in ([pending] if pending else []) + mems:
-            i = bisect.bisect_left(lst, prefix)
-            if i < len(lst) and (best is None or lst[i] < best):
-                best = lst[i]
+        with self._lock:
+            # bisect the mutable lists while still holding the lock —
+            # concurrent insorts would shift indices under our feet
+            pending = self._sorted_pending_locked()
+            for lst in ([pending] if pending else []) + self._mem_parts:
+                i = bisect.bisect_left(lst, prefix)
+                if i < len(lst) and (best is None or lst[i] < best):
+                    best = lst[i]
+            files = list(self._file_parts)
         for fp in files:
             it = fp.first_ge(prefix)
             if it is not None and (best is None or it < best):

@@ -166,8 +166,11 @@ class Part:
             return f.read(size)
 
     # byte-bounded per part: decoded ts(8B) + mantissas(8B) + the memoized
-    # float view (8B) per row; 64MB covers ~2.7M rows of hot data per part
-    MAX_BLOCK_CACHE_BYTES = 64 << 20
+    # float view (8B) per row; 768MB covers ~33M rows of hot data per part
+    # (the reference's lib/blockcache budgets 25% of RAM globally).
+    # Override with VM_BLOCK_CACHE_PART_MB for small hosts.
+    MAX_BLOCK_CACHE_BYTES = int(os.environ.get(
+        "VM_BLOCK_CACHE_PART_MB", 768)) << 20
 
     def read_headers(self, row: MetaindexRow) -> list[BlockHeader]:
         got = self._hdr_cache.get(row.index_offset)

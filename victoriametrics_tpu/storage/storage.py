@@ -33,13 +33,14 @@ DEFAULT_RETENTION_MS = 31 * 13 * 86_400_000  # ~13 months, like the reference
 class SeriesData:
     """Decoded query result for one series."""
 
-    __slots__ = ("metric_name", "timestamps", "values")
+    __slots__ = ("metric_name", "timestamps", "values", "raw_name")
 
     def __init__(self, metric_name: MetricName, timestamps: np.ndarray,
-                 values: np.ndarray):
+                 values: np.ndarray, raw_name: bytes | None = None):
         self.metric_name = metric_name
         self.timestamps = timestamps
         self.values = values
+        self.raw_name = raw_name  # marshaled name (sort/fingerprint key)
 
 
 class Storage:
@@ -57,6 +58,10 @@ class Storage:
         self.idb = IndexDB(os.path.join(path, "indexdb"))
         self.table = Table(os.path.join(path, "data"), dedup_interval_ms)
         self._tsid_cache: dict[bytes, TSID] = {}
+        # fast-path cache keyed by the UNMARSHALED label identity (the
+        # reference's MetricNameRaw-keyed tsidCache, storage.go:1874): rows
+        # with a cached label tuple skip MetricName construction entirely.
+        self._tsid_cache_raw: dict[tuple, TSID] = {}
         self._day_cache: set[tuple[int, int]] = set()  # (metric_id, date)
         self._mid_gen = MetricIDGenerator()
         self._lock = threading.RLock()
@@ -116,27 +121,53 @@ class Storage:
 
     def add_rows(self, rows) -> int:
         """rows: iterable of (MetricName | dict | list[(k,v)], ts_ms, value).
-        Returns rows added (AddRows/Storage.add analog, storage.go:1655,1874).
+        Returns rows added (AddRows/Storage.add analog, storage.go:1655).
+
+        Fast path (storage.go:1874 split): a raw-label-keyed cache hit skips
+        MetricName construction/marshaling; only new series and day
+        rollovers take the slow path through the index.
         """
         if self._readonly:
             raise RuntimeError("storage is read-only")
         out = []
+        raw_cache = self._tsid_cache_raw
+        day_cache = self._day_cache
         with self._lock:
             for labels, ts, val in rows:
-                if isinstance(labels, MetricName):
-                    mn = labels
-                elif isinstance(labels, dict):
-                    mn = MetricName.from_dict(labels)
-                else:
-                    mn = MetricName.from_labels(labels)
-                raw = mn.marshal()
-                tsid = self._resolve_tsid(mn, raw)
-                date = date_of_ms(ts)
-                key = (tsid.metric_id, date)
-                if key not in self._day_cache:
-                    self.idb.create_per_day_indexes(mn, tsid, date)
-                    self._day_cache.add(key)
-                out.append((tsid, int(ts), float(val)))
+                key = None
+                if type(labels) is dict:
+                    key = tuple(labels.items())
+                elif type(labels) is list:
+                    key = tuple(labels)
+                tsid = raw_cache.get(key) if key is not None else None
+                date = ts // 86_400_000
+                mn = None
+                if tsid is not None:
+                    dk = (tsid.metric_id, date)
+                    if dk in day_cache:
+                        out.append((tsid, ts, val))
+                        continue
+                    # day rollover: rebuild the name from the index cache
+                    mn = self.idb.get_metric_name_by_id(tsid.metric_id)
+                if mn is None:
+                    if isinstance(labels, MetricName):
+                        mn = labels
+                    elif isinstance(labels, dict):
+                        mn = MetricName.from_dict(labels)
+                    else:
+                        mn = MetricName.from_labels(labels)
+                    tsid = self._resolve_tsid(mn, mn.marshal())
+                    if key is not None:
+                        if len(raw_cache) >= 1 << 21:
+                            raw_cache.clear()
+                        raw_cache[key] = tsid
+                    dk = (tsid.metric_id, date)
+                    if dk in day_cache:
+                        out.append((tsid, ts, val))
+                        continue
+                self.idb.create_per_day_indexes(mn, tsid, date)
+                day_cache.add(dk)
+                out.append((tsid, ts, val))
         self.table.add_rows(out)
         self.rows_added += len(out)
         return len(out)
@@ -218,7 +249,7 @@ class Storage:
                 dup = np.concatenate([ts[1:] == ts[:-1], [False]])
                 if dup.any():
                     ts, vals = ts[~dup], vals[~dup]
-            out.append((raw, SeriesData(mn, ts, vals)))
+            out.append((raw, SeriesData(mn, ts, vals, raw)))
         out.sort(key=lambda rs: rs[0])
         return [sd for _, sd in out]
 
@@ -268,9 +299,14 @@ class Storage:
         if mids.size:
             self.idb.delete_series_by_ids(mids)
             with self._lock:
+                dead = set(int(m) for m in mids)
                 self._tsid_cache = {
                     raw: t for raw, t in self._tsid_cache.items()
-                    if t.metric_id not in set(int(m) for m in mids)}
+                    if t.metric_id not in dead}
+                # the raw-label cache would resurrect tombstoned metric_ids
+                self._tsid_cache_raw = {
+                    k: t for k, t in self._tsid_cache_raw.items()
+                    if t.metric_id not in dead}
         return int(mids.size)
 
     # -- maintenance -------------------------------------------------------

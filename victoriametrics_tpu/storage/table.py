@@ -32,6 +32,7 @@ class Table:
         self.dedup_interval_ms = dedup_interval_ms
         self._lock = threading.RLock()
         self._partitions: dict[str, Partition] = {}
+        self._day_to_partition: dict[int, str] = {}
         os.makedirs(path, exist_ok=True)
         for name in sorted(os.listdir(path)):
             full = os.path.join(path, name)
@@ -57,10 +58,19 @@ class Table:
 
     def add_rows(self, rows) -> None:
         """rows: [(TSID, ts_ms, float)] — routed to monthly partitions
-        (MustAddRows, table.go:300)."""
+        (MustAddRows, table.go:300). Day->name memo avoids a datetime
+        conversion per row."""
+        day_names = self._day_to_partition
         by_part: dict[str, list] = {}
         for r in rows:
-            by_part.setdefault(partition_name_for_ts(r[1]), []).append(r)
+            day = r[1] // 86_400_000
+            name = day_names.get(day)
+            if name is None:
+                name = partition_name_for_ts(r[1])
+                if len(day_names) > 4096:
+                    day_names.clear()
+                day_names[day] = name
+            by_part.setdefault(name, []).append(r)
         for name, rs in by_part.items():
             self.partition_for_ts(rs[0][1]).add_rows(rs)
 
