@@ -390,3 +390,82 @@ class TestBinopLabelStripping:
         rows = exec_query(ec, 'mem_bytes / on(instance) mem_bytes')
         for r in rows:
             assert set(r.metric_name.to_dict()) <= {"instance"}
+
+
+class TestQueryLimits:
+    """-search.max* family + memory admission (eval.go:1776-1885)."""
+
+    @pytest.fixture()
+    def lim_store(self, tmp_path):
+        s = Storage(str(tmp_path / "lim"))
+        rows = []
+        for i in range(50):
+            for j in range(30):
+                rows.append(({"__name__": "lm", "i": str(i)},
+                             T0 - 600_000 + j * 15_000, float(j)))
+        s.add_rows(rows)
+        yield s
+        s.close()
+
+    def test_max_samples_per_query(self, lim_store):
+        from victoriametrics_tpu.query.limits import QueryLimitError
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=lim_store,
+                        max_samples_per_query=100)
+        with pytest.raises(QueryLimitError, match="maxSamplesPerQuery"):
+            exec_query(ec, "rate(lm[5m])")
+
+    def test_max_series(self, lim_store):
+        from victoriametrics_tpu.query.limits import QueryLimitError
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=lim_store,
+                        max_series=10)
+        with pytest.raises(QueryLimitError, match="maxUniqueTimeseries"):
+            exec_query(ec, "rate(lm[5m])")
+
+    def test_max_memory_per_query(self, lim_store):
+        from victoriametrics_tpu.query.limits import QueryLimitError
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=lim_store,
+                        max_memory_per_query=1000)
+        with pytest.raises(QueryLimitError, match="maxMemoryPerQuery"):
+            exec_query(ec, "rate(lm[5m])")
+
+    def test_deadline(self, lim_store):
+        import time as _t
+        from victoriametrics_tpu.query.limits import QueryLimitError
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=lim_store,
+                        deadline=_t.monotonic() - 1)
+        with pytest.raises(QueryLimitError, match="maxQueryDuration"):
+            exec_query(ec, "rate(lm[5m])")
+
+    def test_memory_admission_releases(self, lim_store):
+        from victoriametrics_tpu.query.limits import rollup_memory_limiter
+        lim = rollup_memory_limiter()
+        before = lim.usage
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=lim_store)
+        exec_query(ec, "rate(lm[5m])")
+        assert lim.usage == before
+
+    def test_samples_accumulate_across_selectors(self, lim_store):
+        from victoriametrics_tpu.query.limits import QueryLimitError
+        # each selector scans ~1500; the cap of 2000 only trips summed
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=lim_store,
+                        max_samples_per_query=2000)
+        with pytest.raises(QueryLimitError):
+            exec_query(ec, "rate(lm[5m]) + avg_over_time(lm[5m])")
+
+    def test_fused_fallback_does_not_double_count(self, lim_store):
+        # fused path fetches then declines (min_series) -> host re-fetch
+        # must not double-count toward maxSamplesPerQuery
+        from victoriametrics_tpu.query.tpu_engine import TPUEngine
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=lim_store,
+                        max_samples_per_query=2000,
+                        tpu=TPUEngine(min_series=1000))
+        rows = exec_query(ec, "sum(rate(lm[5m]))")  # ~1500 samples scanned
+        assert len(rows) == 1
+
+    def test_subquery_shares_accumulator(self, lim_store):
+        from victoriametrics_tpu.query.limits import QueryLimitError
+        ec = EvalConfig(start=T0, end=END, step=STEP, storage=lim_store,
+                        max_samples_per_query=2500)
+        # inner subquery selector + outer selector together exceed the cap
+        with pytest.raises(QueryLimitError):
+            exec_query(ec, "max_over_time(rate(lm[1m])[5m:30s]) + rate(lm[5m])")

@@ -21,6 +21,14 @@ def parse_flags(argv=None):
     p.add_argument("-search.denyPartialResponse", dest="deny_partial",
                    action="store_true")
     p.add_argument("-search.tpuBackend", dest="tpu", action="store_true")
+    p.add_argument("-search.maxUniqueTimeseries", dest="max_series",
+                   type=int, default=300_000)
+    p.add_argument("-search.maxSamplesPerQuery", dest="max_samples_per_query",
+                   type=int, default=1_000_000_000)
+    p.add_argument("-search.maxMemoryPerQuery", dest="max_memory_per_query",
+                   type=int, default=0)
+    p.add_argument("-search.maxQueryDuration", dest="max_query_duration",
+                   default="30s")
     p.add_argument("-clusternativeListenAddr", dest="native_addr", default="",
                    help="expose the vmselect RPC API so a higher-level "
                         "vmselect can use this node as a storage backend "
@@ -48,7 +56,12 @@ def build(args):
         tpu_engine = TPUEngine()
     hh, _, hp = args.httpListenAddr.rpartition(":")
     srv = HTTPServer(hh or "0.0.0.0", int(hp))
-    api = PrometheusAPI(cluster, tpu_engine)
+    from .vmsingle import _dur_ms
+    api = PrometheusAPI(
+        cluster, tpu_engine, max_series=args.max_series,
+        max_samples_per_query=args.max_samples_per_query,
+        max_memory_per_query=args.max_memory_per_query,
+        max_query_duration_ms=_dur_ms(args.max_query_duration))
     api.register(srv, mode="select")
     native_srv = None
     if getattr(args, "native_addr", ""):

@@ -28,6 +28,12 @@ def parse_flags(argv=None):
                    default="0s")
     p.add_argument("-search.maxUniqueTimeseries", dest="max_series",
                    type=int, default=300_000)
+    p.add_argument("-search.maxSamplesPerQuery", dest="max_samples_per_query",
+                   type=int, default=1_000_000_000)
+    p.add_argument("-search.maxMemoryPerQuery", dest="max_memory_per_query",
+                   type=int, default=0)
+    p.add_argument("-search.maxQueryDuration", dest="max_query_duration",
+                   default="30s")
     p.add_argument("-search.maxStalenessInterval", dest="lookback",
                    default="5m")
     p.add_argument("-search.tpuBackend", dest="tpu", action="store_true",
@@ -108,7 +114,11 @@ def build(args):
                         max_series=args.max_series,
                         relabel_configs=relabel, stream_aggr=stream_aggr,
                         stream_aggr_keep_input=args.streamaggr_keep_input,
-                        series_limits=limits)
+                        series_limits=limits,
+                        max_samples_per_query=args.max_samples_per_query,
+                        max_memory_per_query=args.max_memory_per_query,
+                        max_query_duration_ms=_dur_ms(
+                            args.max_query_duration))
     api.register(srv)
     if args.pushmetrics_urls:
         from ..utils.pushmetrics import MetricsPusher

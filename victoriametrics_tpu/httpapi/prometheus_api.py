@@ -176,11 +176,16 @@ class PrometheusAPI:
     def __init__(self, storage, tpu_engine=None, lookback_delta=300_000,
                  max_series=1_000_000, relabel_configs=None,
                  stream_aggr=None, stream_aggr_keep_input=False,
-                 max_concurrent_queries=None, series_limits=None):
+                 max_concurrent_queries=None, series_limits=None,
+                 max_samples_per_query=1_000_000_000,
+                 max_memory_per_query=0, max_query_duration_ms=30_000):
         self.storage = storage
         self.tpu = tpu_engine
         self.lookback_delta = lookback_delta
         self.max_series = max_series
+        self.max_samples_per_query = max_samples_per_query
+        self.max_memory_per_query = max_memory_per_query
+        self.max_query_duration_ms = max_query_duration_ms
         self.relabel = relabel_configs   # ingest.relabel.ParsedConfigs
         self.stream_aggr = stream_aggr   # ingest.streamaggr.StreamAggregators
         self.stream_aggr_keep_input = stream_aggr_keep_input
@@ -253,10 +258,16 @@ class PrometheusAPI:
     # -- query -------------------------------------------------------------
 
     def _ec(self, start, end, step) -> EvalConfig:
+        import time as _t
+        deadline = (_t.monotonic() + self.max_query_duration_ms / 1e3
+                    if self.max_query_duration_ms > 0 else 0.0)
         return EvalConfig(start=start, end=end, step=step,
                           storage=self.storage,
                           lookback_delta=self.lookback_delta,
-                          max_series=self.max_series, tpu=self.tpu)
+                          max_series=self.max_series, tpu=self.tpu,
+                          max_samples_per_query=self.max_samples_per_query,
+                          max_memory_per_query=self.max_memory_per_query,
+                          deadline=deadline)
 
     def h_query(self, req: Request) -> Response:
         q = req.arg("query")

@@ -233,10 +233,17 @@ def _eval_rollup_expr(ec: EvalConfig, func: str, re_: RollupExpr,
 
 def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
                              window: int, offset: int):
-    """Shared fetch for the rollup paths: returns (series, cfg)."""
+    """Shared fetch for the rollup paths: returns (series, cfg, admission).
+
+    Enforces the per-query limit family (eval.go:1776-1885): deadline,
+    -search.maxSamplesPerQuery across all selectors, and rollup memory
+    admission; the caller holds `admission` while computing the rollup.
+    """
+    from .limits import admit_rollup
     me: MetricExpr = re_.expr
     if ec.storage is None:
         raise QueryError("no storage attached to the query engine")
+    ec.check_deadline()
     lookback = window if window > 0 else (
         ec.lookback_delta if func == "default_rollup" else ec.step)
     start = ec.start - offset
@@ -244,13 +251,22 @@ def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
     fetch_lo = start - lookback - ec.lookback_delta
     filters = filters_from_metric_expr(me)
     qt = ec.tracer.new_child("fetch %s window=%dms", me, lookback)
-    series = ec.storage.search_series(filters, fetch_lo, end,
-                                      max_series=ec.max_series)
+    try:
+        series = ec.storage.search_series(filters, fetch_lo, end,
+                                          max_series=ec.max_series)
+    except ResourceWarning as e:
+        from .limits import QueryLimitError
+        raise QueryLimitError(
+            f"{e}; either narrow the selector or raise "
+            f"-search.maxUniqueTimeseries") from None
     series = _drop_stale_nans(func, series)
-    qt.donef("%d series, %d samples", len(series),
-             sum(s.timestamps.size for s in series))
+    n_samples = sum(s.timestamps.size for s in series)
+    ec.count_samples(n_samples)
+    qt.donef("%d series, %d samples", len(series), n_samples)
     cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
-    return series, cfg
+    admission = admit_rollup(str(me), len(series), ec.n_points,
+                             ec.max_memory_per_query)
+    return series, cfg, admission
 
 
 def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
@@ -259,31 +275,34 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
     me: MetricExpr = re_.expr
     if me.is_empty():
         return []
-    series, cfg = _fetch_series_for_rollup(ec, func, re_, window, offset)
+    series, cfg, admission = _fetch_series_for_rollup(ec, func, re_, window,
+                                                      offset)
+    with admission:
+        if ec.tpu is not None:
+            from .tpu_engine import try_rollup_tpu
+            qt = ec.tracer.new_child("tpu rollup %s", func)
+            got = try_rollup_tpu(ec.tpu, func, series, cfg, args)
+            if got is not None:
+                qt.donef("device path, %d series", len(got))
+                return _finish_rollup(series, got, keep_name)
+            qt.donef("fell back to host")
 
-    if ec.tpu is not None:
-        from .tpu_engine import try_rollup_tpu
-        qt = ec.tracer.new_child("tpu rollup %s", func)
-        got = try_rollup_tpu(ec.tpu, func, series, cfg, args)
-        if got is not None:
-            qt.donef("device path, %d series", len(got))
-            return _finish_rollup(series, got, keep_name)
-        qt.donef("fell back to host")
-
-    qt = ec.tracer.new_child("host rollup %s", func)
-    if not args and len(series) >= 8:
-        from ..ops import rollup_np
-        rows = rollup_np.rollup_batch(
-            func, [(sd.timestamps, sd.values) for sd in series], cfg)
-        if rows is not None:
-            qt.donef("%d series (batched)", len(series))
-            return _finish_rollup(series, list(rows), keep_name)
-    out_rows = []
-    for sd in series:
-        vals = rollup_series(func, sd.timestamps, sd.values, cfg, args)
-        out_rows.append(vals)
-    qt.donef("%d series", len(out_rows))
-    return _finish_rollup(series, out_rows, keep_name)
+        qt = ec.tracer.new_child("host rollup %s", func)
+        if not args and len(series) >= 8:
+            from ..ops import rollup_np
+            rows = rollup_np.rollup_batch(
+                func, [(sd.timestamps, sd.values) for sd in series], cfg)
+            if rows is not None:
+                qt.donef("%d series (batched)", len(series))
+                return _finish_rollup(series, list(rows), keep_name)
+        out_rows = []
+        for i, sd in enumerate(series):
+            if i % 256 == 0:
+                ec.check_deadline()
+            vals = rollup_series(func, sd.timestamps, sd.values, cfg, args)
+            out_rows.append(vals)
+        qt.donef("%d series", len(out_rows))
+        return _finish_rollup(series, out_rows, keep_name)
 
 
 def _drop_stale_nans(func: str, series):
@@ -405,29 +424,38 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
         return None
     offset = rarg.offset.value_ms(ec.step) if rarg.offset is not None else 0
     window = rarg.window.value_ms(ec.step) if rarg.window is not None else 0
-    series, cfg = _fetch_series_for_rollup(ec, func, rarg, window, offset)
-    if len(series) < ec.tpu.min_series:
-        return None  # host path re-fetches from warm caches
-    gb = [g.encode() for g in ae.grouping]
-    key_to_gid: dict[bytes, int] = {}
-    gids = np.empty(len(series), dtype=np.int32)
-    group_keys: list[bytes] = []
-    for i, sd in enumerate(series):
-        key = _group_key(sd.metric_name, gb, ae.without)
-        gid = key_to_gid.get(key)
-        if gid is None:
-            gid = len(group_keys)
-            key_to_gid[key] = gid
-            group_keys.append(key)
-        gids[i] = gid
-    qt = ec.tracer.new_child("tpu fused %s(%s)", ae.name, func)
-    out = try_aggr_rollup_tpu(ec.tpu, ae.name, func, series, gids,
-                              len(group_keys), cfg)
-    if out is None:
-        qt.donef("fell back to host")
+    series, cfg, admission = _fetch_series_for_rollup(ec, func, rarg, window,
+                                                      offset)
+    n_fetched = sum(s.timestamps.size for s in series)
+
+    def _decline():
+        # the host path will re-fetch and re-count the same samples
+        ec.count_samples(-n_fetched)
         return None
-    qt.donef("device path, %d series -> %d groups", len(series),
-             len(group_keys))
+
+    with admission:
+        if len(series) < ec.tpu.min_series:
+            return _decline()  # host path re-fetches from warm caches
+        gb = [g.encode() for g in ae.grouping]
+        key_to_gid: dict[bytes, int] = {}
+        gids = np.empty(len(series), dtype=np.int32)
+        group_keys: list[bytes] = []
+        for i, sd in enumerate(series):
+            key = _group_key(sd.metric_name, gb, ae.without)
+            gid = key_to_gid.get(key)
+            if gid is None:
+                gid = len(group_keys)
+                key_to_gid[key] = gid
+                group_keys.append(key)
+            gids[i] = gid
+        qt = ec.tracer.new_child("tpu fused %s(%s)", ae.name, func)
+        out = try_aggr_rollup_tpu(ec.tpu, ae.name, func, series, gids,
+                                  len(group_keys), cfg)
+        if out is None:
+            qt.donef("fell back to host")
+            return _decline()
+        qt.donef("device path, %d series -> %d groups", len(series),
+                 len(group_keys))
     rows = [Timeseries(MetricName.unmarshal(k),
                        np.asarray(out[g], dtype=np.float64))
             for g, k in enumerate(group_keys)]

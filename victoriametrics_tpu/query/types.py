@@ -35,15 +35,23 @@ class EvalConfig:
     lookback_delta: int = 300_000   # instant-vector staleness window
     max_points_per_series: int = 50_000_000
     max_series: int = 1_000_000
+    max_samples_per_query: int = 1_000_000_000  # -search.maxSamplesPerQuery
+    max_memory_per_query: int = 0               # -search.maxMemoryPerQuery
+    deadline: float = 0.0      # time.monotonic() cutoff; 0 = none
     round_digits: int = 100
     tracer: object = None      # querytracer.Tracer | NOP (set in __post_init__)
     tpu: object = None         # TPUEngine when the device path is enabled
     _grid: np.ndarray | None = None
+    _samples_scanned: list | None = None  # shared per-query accumulator
 
     def __post_init__(self):
         if self.tracer is None:
             from ..utils import querytracer
             self.tracer = querytracer.NOP
+        if self._samples_scanned is None:
+            # created HERE (not lazily) so child() configs made before the
+            # first fetch still share one per-query accumulator
+            self._samples_scanned = [0]
         if self.step <= 0:
             raise ValueError("step must be positive")
         if self.end < self.start:
@@ -67,9 +75,38 @@ class EvalConfig:
                  storage=self.storage, lookback_delta=self.lookback_delta,
                  max_points_per_series=self.max_points_per_series,
                  max_series=self.max_series, round_digits=self.round_digits,
-                 tracer=self.tracer, tpu=self.tpu)
+                 max_samples_per_query=self.max_samples_per_query,
+                 max_memory_per_query=self.max_memory_per_query,
+                 deadline=self.deadline,
+                 tracer=self.tracer, tpu=self.tpu,
+                 _samples_scanned=self._samples_scanned)
         d.update(kw)
         return EvalConfig(**d)
+
+    def check_deadline(self):
+        if self.deadline:
+            import time as _t
+            if _t.monotonic() > self.deadline:
+                from .limits import QueryLimitError
+                raise QueryLimitError(
+                    "query exceeds -search.maxQueryDuration; increase the "
+                    "flag or reduce the query scope")
+
+    def count_samples(self, n: int):
+        """Accumulate scanned samples across all selectors of one query
+        (the -search.maxSamplesPerQuery scope, eval.go seriesFetched).
+        Negative n rolls back a fetch whose work was abandoned (e.g. the
+        fused device path declining after its fetch)."""
+        acc = self._samples_scanned
+        acc[0] += n
+        if acc[0] > self.max_samples_per_query:
+            from .limits import QueryLimitError
+            raise QueryLimitError(
+                f"cannot select more than -search.maxSamplesPerQuery="
+                f"{self.max_samples_per_query} samples; the query scans "
+                f"{acc[0]} samples so far; possible solutions: to increase "
+                f"the -search.maxSamplesPerQuery, to reduce the time range "
+                f"or the number of matching series")
 
 
 def new_series(values: np.ndarray, group: bytes = b"",
