@@ -304,3 +304,28 @@ class TestMultilevel:
         upper.close()
         lower_rpc.stop()
         lower.close()
+
+
+class TestClusterMultitenancy:
+    def test_tenant_isolation_across_nodes(self, nodes3):
+        cs = ClusterStorage([n.client() for n in nodes3],
+                            replication_factor=1)
+        t1, t2 = (5, 0), (5, 1)
+        cs.add_rows([({"__name__": "mt", "i": str(i)}, T0, float(i))
+                     for i in range(20)], tenant=t1)
+        cs.add_rows([({"__name__": "mt", "i": str(i)}, T0, float(i + 100))
+                     for i in range(10)], tenant=t2)
+        f = filters_from_dict({"__name__": "mt"})
+        r1 = cs.search_series(f, T0 - 1000, T0 + 1000, tenant=t1)
+        r2 = cs.search_series(f, T0 - 1000, T0 + 1000, tenant=t2)
+        assert len(r1) == 20 and len(r2) == 10
+        assert {float(s.values[0]) for s in r2} == {float(i + 100)
+                                                    for i in range(10)}
+        assert cs.search_series(f, T0 - 1000, T0 + 1000) == []
+        assert set(cs.tenants()) >= {t1, t2}
+        assert cs.series_count(tenant=t1) == 20
+        # tenant-scoped delete
+        assert cs.delete_series(f, tenant=t2) == 10
+        assert cs.search_series(f, T0 - 1000, T0 + 1000, tenant=t2) == []
+        assert len(cs.search_series(f, T0 - 1000, T0 + 1000, tenant=t1)) == 20
+        cs.close()

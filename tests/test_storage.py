@@ -205,6 +205,7 @@ class TestStorage:
         os.makedirs(dst)
         os.rename(os.path.join(snap, "data"), dst / "data")
         os.rename(os.path.join(snap, "indexdb"), dst / "indexdb")
+        os.rename(os.path.join(snap, "format.json"), dst / "format.json")
         s2 = Storage(str(dst))
         res = s2.search_series(filters_from_dict({"__name__": "cpu_usage"}),
                                T0, T0 + 10_000_000)
@@ -524,3 +525,61 @@ class TestRollupBatchNonFinite:
         series = [(np.array([T0 - 10_000, T0 - 5_000], dtype=np.int64),
                    np.array([np.inf, 2.0]))]
         assert rollup_np.rollup_batch("sum_over_time", series, cfg) is None
+
+
+class TestMultitenancy:
+    """accountID:projectID isolation (lib/auth.Token, search.go:376)."""
+
+    def test_identical_names_fully_isolated(self, tmp_path):
+        s = mk_storage(tmp_path)
+        t1, t2 = (1, 0), (1, 7)
+        s.add_rows([({"__name__": "m", "i": "x"}, T0, 1.0)], tenant=t1)
+        s.add_rows([({"__name__": "m", "i": "x"}, T0, 2.0)], tenant=t2)
+        s.add_rows([({"__name__": "only1", "i": "y"}, T0, 3.0)], tenant=t1)
+        f = filters_from_dict({"__name__": "m"})
+        r1 = s.search_series(f, T0 - 1000, T0 + 1000, tenant=t1)
+        r2 = s.search_series(f, T0 - 1000, T0 + 1000, tenant=t2)
+        r0 = s.search_series(f, T0 - 1000, T0 + 1000)  # default tenant
+        assert len(r1) == 1 and r1[0].values[0] == 1.0
+        assert len(r2) == 1 and r2[0].values[0] == 2.0
+        assert r0 == []
+        # label APIs are tenant-scoped
+        assert s.label_values("__name__", tenant=t1) == ["m", "only1"]
+        assert s.label_values("__name__", tenant=t2) == ["m"]
+        assert s.series_count(tenant=t1) == 2
+        assert s.series_count(tenant=t2) == 1
+        assert s.tenants() == [(1, 0), (1, 7)]
+        # delete in one tenant leaves the other intact
+        assert s.delete_series(f, tenant=t1) == 1
+        assert s.search_series(f, T0 - 1000, T0 + 1000, tenant=t1) == []
+        assert len(s.search_series(f, T0 - 1000, T0 + 1000, tenant=t2)) == 1
+        s.close()
+
+    def test_tenant_survives_restart(self, tmp_path):
+        s = mk_storage(tmp_path)
+        s.add_rows([({"__name__": "rt"}, T0, 5.0)], tenant=(9, 9))
+        s.close()
+        s2 = mk_storage(tmp_path)
+        f = filters_from_dict({"__name__": "rt"})
+        assert len(s2.search_series(f, T0 - 1000, T0 + 1000,
+                                    tenant=(9, 9))) == 1
+        assert s2.search_series(f, T0 - 1000, T0 + 1000) == []
+        assert (9, 9) in s2.tenants()
+        s2.close()
+
+
+class TestFormatVersionGate:
+    def test_old_layout_rejected_clearly(self, tmp_path):
+        import json as _json
+        root = tmp_path / "s"
+        os.makedirs(root / "data")
+        with pytest.raises(RuntimeError, match="on-disk format"):
+            Storage(str(root))
+        # wrong version in the marker also rejected
+        import shutil as _sh
+        _sh.rmtree(root)
+        os.makedirs(root / "data")
+        with open(root / "format.json", "w") as f:
+            _json.dump({"format_version": 1}, f)
+        with pytest.raises(RuntimeError, match="v1"):
+            Storage(str(root))

@@ -491,3 +491,63 @@ class TestSeriesLimitsAndPush:
         assert got
         assert b'm1{job="t"} 42' in got[0]
         assert b'm2{job="t",x="y"} 7' in got[0]
+
+
+class TestMultitenantHTTP:
+    """Cluster-style /insert|/select/<accountID[:projectID]>/ routing."""
+
+    def test_insert_select_tenant_paths(self, app):
+        line = f"mt_metric{{t=\"a\"}} 41 {T0}\n"
+        code, _ = app.post("/insert/7:3/prometheus/api/v1/import/prometheus",
+                           line.encode())
+        assert code == 204
+        code, _ = app.post("/insert/8/prometheus/api/v1/import/prometheus",
+                           f"mt_metric{{t=\"a\"}} 42 {T0}\n".encode())
+        assert code == 204
+        # tenant 7:3 sees only its own value
+        code, body = app.get("/select/7:3/prometheus/api/v1/query",
+                             query="mt_metric", time=str(T0 // 1000))
+        assert code == 200, body
+        res = json.loads(body)["data"]["result"]
+        assert len(res) == 1 and res[0]["value"][1] == "41"
+        # tenant 8 (project 0) sees its own
+        code, body = app.get("/select/8/prometheus/api/v1/query",
+                             query="mt_metric", time=str(T0 // 1000))
+        assert json.loads(body)["data"]["result"][0]["value"][1] == "42"
+        # default tenant sees nothing
+        code, body = app.get("/api/v1/query",
+                             query="mt_metric", time=str(T0 // 1000))
+        assert json.loads(body)["data"]["result"] == []
+        # tenants listing
+        code, body = app.get("/admin/tenants")
+        assert code == 200 and set(json.loads(body)["data"]) >= {"7:3", "8:0"}
+
+    def test_bad_tenant_rejected(self, app):
+        code, _ = app.post("/insert/xx/prometheus/api/v1/import/prometheus",
+                           b"m 1\n")
+        assert code == 400
+        code, _ = app.get("/select/1:2")
+        assert code == 400
+
+    def test_rollup_cache_is_tenant_scoped(self, app):
+        # regression: query_range results must never be served across
+        # tenants from the rollup result cache
+        for tenant, v in (("7", "111"), ("8", "222")):
+            code, _ = app.post(
+                f"/insert/{tenant}/prometheus/api/v1/import/prometheus",
+                f"leak{{x=\"y\"}} {v} {T0}\n".encode())
+            assert code == 204
+        out = {}
+        for tenant in ("7", "8"):
+            code, body = app.get(
+                f"/select/{tenant}/prometheus/api/v1/query_range",
+                query="leak", start=str(T0 // 1000),
+                end=str(T0 // 1000 + 60), step="30")
+            res = json.loads(body)["data"]["result"]
+            out[tenant] = res[0]["values"][0][1]
+        assert out == {"7": "111", "8": "222"}
+        # default tenant: nothing, even after both cached
+        code, body = app.get("/api/v1/query_range", query="leak",
+                             start=str(T0 // 1000),
+                             end=str(T0 // 1000 + 60), step="30")
+        assert json.loads(body)["data"]["result"] == []

@@ -186,6 +186,7 @@ class PrometheusAPI:
         self.max_samples_per_query = max_samples_per_query
         self.max_memory_per_query = max_memory_per_query
         self.max_query_duration_ms = max_query_duration_ms
+        self.default_tenant = (0, 0)
         self.relabel = relabel_configs   # ingest.relabel.ParsedConfigs
         self.stream_aggr = stream_aggr   # ingest.streamaggr.StreamAggregators
         self.stream_aggr_keep_input = stream_aggr_keep_input
@@ -205,8 +206,11 @@ class PrometheusAPI:
         self.srv = srv
         if mode in ("all", "insert"):
             self._register_insert(srv)
+            srv.route("/insert/", self._mt_dispatch)
         if mode in ("all", "select"):
             self._register_select(srv)
+            srv.route("/select/", self._mt_dispatch)
+            srv.route("/admin/tenants", self.h_tenants)
         srv.route("/metrics", self.h_metrics)
         srv.route("/health", lambda req: Response.text("OK"))
         srv.route("/-/healthy", lambda req: Response.text("OK"))
@@ -257,7 +261,60 @@ class PrometheusAPI:
 
     # -- query -------------------------------------------------------------
 
-    def _ec(self, start, end, step) -> EvalConfig:
+    def _tenant(self, req) -> tuple:
+        """Per-request tenant: set by the multitenant path router
+        (/insert|/select/<accountID[:projectID]>/..., lib/auth.Token)."""
+        return getattr(req, "tenant", None) or self.default_tenant
+
+    def _mt_dispatch(self, req: Request) -> Response:
+        """Cluster-style multitenant routing (lib/auth.NewToken +
+        app/vmselect/main.go:262 /select/<tenant>/prometheus/...,
+        app/vminsert/main.go /insert/<tenant>/<proto>)."""
+        parts = req.path.split("/", 3)
+        if len(parts) < 4 or not parts[3]:
+            return Response.error(f"missing tenant path suffix in "
+                                  f"{req.path!r}", 400)
+        tstr, rest = parts[2], "/" + parts[3]
+        try:
+            if ":" in tstr:
+                a, p = tstr.split(":", 1)
+                tenant = (int(a), int(p))
+            else:
+                tenant = (int(tstr), 0)
+        except ValueError:
+            return Response.error(f"cannot parse tenant {tstr!r} "
+                                  f"(want accountID[:projectID])", 400)
+        if not (0 <= tenant[0] < 2**32 and 0 <= tenant[1] < 2**32):
+            return Response.error(f"tenant ids out of uint32 range: {tstr}",
+                                  400)
+        # cluster URLs nest the protocol: /select/0/prometheus/api/v1/query,
+        # /insert/0/prometheus/api/v1/write, /insert/0/influx/write
+        if rest.startswith("/prometheus/"):
+            rest = rest[len("/prometheus"):]
+        elif rest.startswith("/influx/"):
+            rest = rest[len("/influx"):]
+        elif rest.startswith("/opentsdb/"):
+            rest = rest[len("/opentsdb"):]
+        elif rest.startswith("/graphite/"):
+            rest = rest[len("/graphite"):]
+        req.tenant = tenant
+        req.path = rest
+        fn = self.srv._route_for(rest)
+        if fn is None or getattr(fn, "__func__", None) is \
+                PrometheusAPI._mt_dispatch:
+            return Response.error(f"unsupported path {rest}", 404,
+                                  "not_found")
+        return fn(req)
+
+    def h_tenants(self, req: Request) -> Response:
+        """List tenants with stored data (the vmselect /admin/tenants API,
+        app/vmselect/main.go:229 + vmselectapi tenants_v1)."""
+        tenants = self.storage.tenants() if hasattr(self.storage, "tenants") \
+            else [(0, 0)]
+        return Response.json({"status": "success",
+                              "data": [f"{a}:{p}" for a, p in tenants]})
+
+    def _ec(self, start, end, step, tenant=(0, 0)) -> EvalConfig:
         import time as _t
         deadline = (_t.monotonic() + self.max_query_duration_ms / 1e3
                     if self.max_query_duration_ms > 0 else 0.0)
@@ -267,7 +324,7 @@ class PrometheusAPI:
                           max_series=self.max_series, tpu=self.tpu,
                           max_samples_per_query=self.max_samples_per_query,
                           max_memory_per_query=self.max_memory_per_query,
-                          deadline=deadline)
+                          deadline=deadline, tenant=tenant)
 
     def h_query(self, req: Request) -> Response:
         q = req.arg("query")
@@ -284,7 +341,7 @@ class PrometheusAPI:
         qt = querytracer.new(req.arg("trace") == "1", "query %s time=%d",
                              q, ts)
         try:
-            ec = self._ec(ts, ts, step)
+            ec = self._ec(ts, ts, step, self._tenant(req))
             ec.tracer = qt
             with self.gate:
                 rows = exec_query(ec, q)
@@ -337,7 +394,7 @@ class PrometheusAPI:
                              "query_range %s start=%d end=%d step=%d",
                              q, start, end, step)
         try:
-            ec = self._ec(start, end, step)
+            ec = self._ec(start, end, step, self._tenant(req))
             ec.tracer = qt
             with self.gate:
                 if req.arg("nocache") == "1":
@@ -437,7 +494,8 @@ class PrometheusAPI:
             for filters in fl:
                 if len(out) >= limit:
                     break
-                for mn in self.storage.search_metric_names(filters, start, end):
+                for mn in self.storage.search_metric_names(
+                        filters, start, end, tenant=self._tenant(req)):
                     raw = mn.marshal()
                     if raw not in seen:
                         seen.add(raw)
@@ -454,7 +512,8 @@ class PrometheusAPI:
         except QueryError as e:
             return Response.error(str(e))
         return Response.json({"status": "success",
-                              "data": self.storage.label_names(start, end)})
+                              "data": self.storage.label_names(
+                                  start, end, tenant=self._tenant(req))})
 
     def h_label_values(self, req: Request) -> Response:
         m = re.fullmatch(r"/api/v1/label/([^/]+)/values", req.path)
@@ -464,7 +523,8 @@ class PrometheusAPI:
             start, end = self._time_range(req)
         except QueryError as e:
             return Response.error(str(e))
-        vals = self.storage.label_values(m.group(1), start, end)
+        vals = self.storage.label_values(m.group(1), start, end,
+                                         tenant=self._tenant(req))
         return Response.json({"status": "success", "data": vals})
 
     # -- export / federate ---------------------------------------------------
@@ -477,7 +537,8 @@ class PrometheusAPI:
             start, end = self._time_range(req, full_default=True)
             lines = []
             for filters in fl:
-                for sd in self.storage.search_series(filters, start, end):
+                for sd in self.storage.search_series(
+                        filters, start, end, tenant=self._tenant(req)):
                     mask = ~np.isnan(sd.values)
                     lines.append(parsers.series_to_jsonl(
                         sd.metric_name.to_dict(),
@@ -496,7 +557,8 @@ class PrometheusAPI:
             start = now - self.lookback_delta
             lines = []
             for filters in fl:
-                for sd in self.storage.search_series(filters, start, now):
+                for sd in self.storage.search_series(
+                        filters, start, now, tenant=self._tenant(req)):
                     mask = ~np.isnan(sd.values)
                     if not mask.any():
                         continue
@@ -516,15 +578,15 @@ class PrometheusAPI:
 
     # -- ingestion -----------------------------------------------------------
 
-    def _add_rows(self, rows_iter) -> int:
+    def _add_rows(self, rows_iter, tenant=(0, 0)) -> int:
         now = int(time.time() * 1000)
         batch = []
         for row in rows_iter:
             ts = row.timestamp or now
             batch.append((dict(row.labels), ts, row.value))
-        return self._ingest(batch)
+        return self._ingest(batch, tenant)
 
-    def _ingest(self, batch: list) -> int:
+    def _ingest(self, batch: list, tenant=(0, 0)) -> int:
         """Shared ingest tail: global relabeling (-relabelConfig analog,
         app/vminsert/relabel) -> stream aggregation hook -> storage."""
         if self.relabel is not None:
@@ -557,7 +619,7 @@ class PrometheusAPI:
             now = int(time.time() * 1000)
             if min(ts for _, ts, _ in batch) < now - OFFSET_MS:
                 rcache.reset()
-        n = self.storage.add_rows(batch) if batch else 0
+        n = self.storage.add_rows(batch, tenant=tenant) if batch else 0
         self.rows_inserted += n
         return n
 
@@ -579,13 +641,13 @@ class PrometheusAPI:
         for labels, samples in series:
             for ts, val in samples:
                 batch.append((dict(labels), ts or now, val))
-        self._ingest(batch)
+        self._ingest(batch, self._tenant(req))
         return Response(status=204, body=b"")
 
     def h_import(self, req: Request) -> Response:
         try:
             n = self._add_rows(parsers.parse_jsonl(
-                req.body.decode("utf-8", "replace")))
+                req.body.decode("utf-8", "replace")), self._tenant(req))
         except (ValueError, KeyError) as e:
             return Response.error(f"cannot parse import data: {e}", 400)
         return Response(status=204, body=b"")
@@ -594,7 +656,7 @@ class PrometheusAPI:
         try:
             ts = parse_time(req.arg("timestamp"), 0)
             self._add_rows(parsers.parse_prometheus(
-                req.body.decode("utf-8", "replace"), ts))
+                req.body.decode("utf-8", "replace"), ts), self._tenant(req))
         except (ValueError, QueryError) as e:
             return Response.error(f"cannot parse prometheus text: {e}", 400)
         return Response(status=204, body=b"")
@@ -605,7 +667,7 @@ class PrometheusAPI:
             return Response.error("missing 'format' arg")
         try:
             self._add_rows(parsers.parse_csv(
-                req.body.decode("utf-8", "replace"), fmt))
+                req.body.decode("utf-8", "replace"), fmt), self._tenant(req))
         except (ValueError, IndexError) as e:
             return Response.error(f"cannot parse csv: {e}", 400)
         return Response(status=204, body=b"")
@@ -614,14 +676,15 @@ class PrometheusAPI:
         db = req.arg("db")
         try:
             self._add_rows(parsers.parse_influx(
-                req.body.decode("utf-8", "replace"), db=db))
+                req.body.decode("utf-8", "replace"), db=db),
+                self._tenant(req))
         except ValueError as e:
             return Response.error(f"cannot parse influx line: {e}", 400)
         return Response(status=204, body=b"")
 
     def h_opentsdb_http(self, req: Request) -> Response:
         try:
-            self._add_rows(parsers.parse_opentsdb_http(req.body))
+            self._add_rows(parsers.parse_opentsdb_http(req.body), self._tenant(req))
         except (ValueError, KeyError) as e:
             return Response.error(f"cannot parse opentsdb json: {e}", 400)
         return Response(status=204, body=b"")
@@ -629,14 +692,14 @@ class PrometheusAPI:
     def h_graphite_write(self, req: Request) -> Response:
         try:
             self._add_rows(parsers.parse_graphite(
-                req.body.decode("utf-8", "replace")))
+                req.body.decode("utf-8", "replace")), self._tenant(req))
         except ValueError as e:
             return Response.error(f"cannot parse graphite line: {e}", 400)
         return Response(status=204, body=b"")
 
     def h_otlp(self, req: Request) -> Response:
         try:
-            self._add_rows(parse_otlp(req.body))
+            self._add_rows(parse_otlp(req.body), self._tenant(req))
         except (ValueError, struct.error) as e:
             return Response.error(f"cannot parse OTLP payload: {e}", 400)
         # empty body = valid empty ExportMetricsServiceResponse proto
@@ -644,21 +707,23 @@ class PrometheusAPI:
 
     def h_datadog_v1(self, req: Request) -> Response:
         try:
-            self._add_rows(parsers.parse_datadog_v1(req.body))
+            self._add_rows(parsers.parse_datadog_v1(req.body),
+                           self._tenant(req))
         except (ValueError, KeyError) as e:
             return Response.error(f"cannot parse datadog: {e}", 400)
         return Response.json({"status": "ok"}, status=202)
 
     def h_datadog_v2(self, req: Request) -> Response:
         try:
-            self._add_rows(parsers.parse_datadog_v2(req.body))
+            self._add_rows(parsers.parse_datadog_v2(req.body),
+                           self._tenant(req))
         except (ValueError, KeyError) as e:
             return Response.error(f"cannot parse datadog: {e}", 400)
         return Response.json({"errors": []}, status=202)
 
     def h_newrelic(self, req: Request) -> Response:
         try:
-            self._add_rows(parsers.parse_newrelic(req.body))
+            self._add_rows(parsers.parse_newrelic(req.body), self._tenant(req))
         except (ValueError, KeyError) as e:
             return Response.error(f"cannot parse newrelic: {e}", 400)
         return Response.json({"status": "ok"}, status=202)
@@ -672,7 +737,8 @@ class PrometheusAPI:
                 return Response.error("missing match[] arg")
             n = 0
             for filters in fl:
-                n += self.storage.delete_series(filters)
+                n += self.storage.delete_series(filters,
+                                                tenant=self._tenant(req))
             return Response(status=204, body=b"")
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
@@ -687,7 +753,7 @@ class PrometheusAPI:
                         // 86400)
         except ValueError as e:
             return Response.error(f"bad arg: {e}", 400)
-        st = self.storage.tsdb_status(d, topn)
+        st = self.storage.tsdb_status(d, topn, tenant=self._tenant(req))
         return Response.json({"status": "success", "data": st})
 
     def h_active_queries(self, req: Request) -> Response:

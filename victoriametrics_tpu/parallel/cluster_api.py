@@ -51,10 +51,19 @@ def _write_filters(w: Writer, filters: list[TagFilter]):
         w.u64((1 if tf.negate else 0) | (2 if tf.regex else 0))
 
 
+def _read_tenant(r: Reader) -> tuple:
+    return (r.u64(), r.u64())
+
+
+def _write_tenant(w: Writer, tenant) -> Writer:
+    return w.u64(tenant[0]).u64(tenant[1])
+
+
 def make_storage_handlers(storage) -> dict:
     """RPC dispatch table for a vmstorage node."""
 
     def h_write_rows(r: Reader):
+        tenant = _read_tenant(r)
         n = r.u64()
         rows = []
         for _ in range(n):
@@ -62,7 +71,7 @@ def make_storage_handlers(storage) -> dict:
             ts = r.i64()
             val = r.f64()
             rows.append((MetricName.unmarshal(raw), ts, val))
-        storage.add_rows(rows)
+        storage.add_rows(rows, tenant=tenant)
         return Writer().u64(len(rows))
 
     def h_is_readonly(r: Reader):
@@ -72,11 +81,13 @@ def make_storage_handlers(storage) -> dict:
     META_FRAME = (1 << 32) - 1
 
     def h_search(r: Reader):
+        tenant = _read_tenant(r)
         filters = _read_filters(r)
         min_ts, max_ts = r.i64(), r.i64()
         if hasattr(storage, "reset_partial"):
             storage.reset_partial()
-        series = storage.search_series(filters, min_ts, max_ts)
+        series = storage.search_series(filters, min_ts, max_ts,
+                                       tenant=tenant)
 
         def frames():
             for i in range(0, len(series), SERIES_PER_FRAME):
@@ -96,51 +107,70 @@ def make_storage_handlers(storage) -> dict:
         return frames()
 
     def h_search_metric_names(r: Reader):
+        tenant = _read_tenant(r)
         filters = _read_filters(r)
         min_ts, max_ts = r.i64(), r.i64()
-        names = storage.search_metric_names(filters, min_ts, max_ts)
+        names = storage.search_metric_names(filters, min_ts, max_ts,
+                                            tenant=tenant)
         w = Writer().u64(len(names))
         for mn in names:
             w.bytes_(mn.marshal())
         return w
 
     def h_label_names(r: Reader):
+        tenant = _read_tenant(r)
         min_ts, max_ts = r.i64(), r.i64()
-        names = storage.label_names(min_ts or None, max_ts or None)
+        names = storage.label_names(min_ts or None, max_ts or None,
+                                    tenant=tenant)
         w = Writer().u64(len(names))
         for n in names:
             w.str_(n)
         return w
 
     def h_label_values(r: Reader):
+        tenant = _read_tenant(r)
         key = r.str_()
         min_ts, max_ts = r.i64(), r.i64()
-        vals = storage.label_values(key, min_ts or None, max_ts or None)
+        vals = storage.label_values(key, min_ts or None, max_ts or None,
+                                    tenant=tenant)
         w = Writer().u64(len(vals))
         for v in vals:
             w.str_(v)
         return w
 
     def h_delete_series(r: Reader):
+        tenant = _read_tenant(r)
         filters = _read_filters(r)
-        return Writer().u64(storage.delete_series(filters))
+        return Writer().u64(storage.delete_series(filters, tenant=tenant))
 
     def h_series_count(r: Reader):
-        return Writer().u64(storage.series_count())
+        tenant = _read_tenant(r)
+        return Writer().u64(storage.series_count(tenant=tenant))
 
     def h_tsdb_status(r: Reader):
         import json
+        tenant = _read_tenant(r)
         topn = r.u64()
         date_plus1 = r.u64()  # 0 = no date filter
-        st = storage.tsdb_status(date_plus1 - 1 if date_plus1 else None, topn)
+        st = storage.tsdb_status(date_plus1 - 1 if date_plus1 else None, topn,
+                                 tenant=tenant)
         return Writer().bytes_(json.dumps(st).encode())
 
     def h_register_metric_names(r: Reader):
+        tenant = _read_tenant(r)
         n = r.u64()
         names = [MetricName.unmarshal(r.bytes_()) for _ in range(n)]
         if hasattr(storage, "register_metric_names"):
-            storage.register_metric_names(names)
+            storage.register_metric_names(names, tenant=tenant)
         return Writer().u64(n)
+
+    def h_tenants(r: Reader):
+        tenants = storage.tenants() if hasattr(storage, "tenants") \
+            else [(0, 0)]
+        w = Writer().u64(len(tenants))
+        for a, p in tenants:
+            w.u64(a).u64(p)
+        return w
 
     return {
         "writeRows_v1": h_write_rows,
@@ -153,6 +183,7 @@ def make_storage_handlers(storage) -> dict:
         "seriesCount_v1": h_series_count,
         "tsdbStatus_v1": h_tsdb_status,
         "registerMetricNames_v1": h_register_metric_names,
+        "tenants_v1": h_tenants,
     }
 
 
@@ -177,17 +208,18 @@ class StorageNodeClient:
         logger.warnf("storage node %s marked down for %.1fs", self.name,
                      seconds)
 
-    def write_rows(self, rows: list[tuple[bytes, int, float]]):
-        w = Writer().u64(len(rows))
+    def write_rows(self, rows: list[tuple[bytes, int, float]],
+                   tenant=(0, 0)):
+        w = _write_tenant(Writer(), tenant).u64(len(rows))
         for raw, ts, val in rows:
             w.bytes_(raw)
             w.i64(int(ts))
             w.f64(float(val))
         self.insert.call("writeRows_v1", w)
 
-    def search_series(self, filters, min_ts, max_ts):
+    def search_series(self, filters, min_ts, max_ts, tenant=(0, 0)):
         """Returns (series_list, remote_partial)."""
-        w = Writer()
+        w = _write_tenant(Writer(), tenant)
         _write_filters(w, filters)
         w.i64(min_ts).i64(max_ts)
         out = []
@@ -204,36 +236,43 @@ class StorageNodeClient:
                 out.append((mn, ts, vals))
         return out, partial
 
-    def search_metric_names(self, filters, min_ts, max_ts):
-        w = Writer()
+    def search_metric_names(self, filters, min_ts, max_ts, tenant=(0, 0)):
+        w = _write_tenant(Writer(), tenant)
         _write_filters(w, filters)
         w.i64(min_ts).i64(max_ts)
         r = self.select.call("searchMetricNames_v1", w)
         return [MetricName.unmarshal(r.bytes_()) for _ in range(r.u64())]
 
-    def label_names(self, min_ts, max_ts):
-        w = Writer().i64(min_ts or 0).i64(max_ts or 0)
+    def label_names(self, min_ts, max_ts, tenant=(0, 0)):
+        w = _write_tenant(Writer(), tenant).i64(min_ts or 0).i64(max_ts or 0)
         r = self.select.call("labelNames_v1", w)
         return [r.str_() for _ in range(r.u64())]
 
-    def label_values(self, key, min_ts, max_ts):
-        w = Writer().str_(key).i64(min_ts or 0).i64(max_ts or 0)
+    def label_values(self, key, min_ts, max_ts, tenant=(0, 0)):
+        w = _write_tenant(Writer(), tenant).str_(key)
+        w.i64(min_ts or 0).i64(max_ts or 0)
         r = self.select.call("labelValues_v1", w)
         return [r.str_() for _ in range(r.u64())]
 
-    def delete_series(self, filters):
-        w = Writer()
+    def delete_series(self, filters, tenant=(0, 0)):
+        w = _write_tenant(Writer(), tenant)
         _write_filters(w, filters)
         return self.select.call("deleteSeries_v1", w).u64()
 
-    def series_count(self):
-        return self.select.call("seriesCount_v1", Writer()).u64()
+    def series_count(self, tenant=(0, 0)):
+        return self.select.call("seriesCount_v1",
+                                _write_tenant(Writer(), tenant)).u64()
 
-    def tsdb_status(self, topn, date=None):
+    def tsdb_status(self, topn, date=None, tenant=(0, 0)):
         import json
-        w = Writer().u64(topn).u64(0 if date is None else date + 1)
+        w = _write_tenant(Writer(), tenant).u64(topn)
+        w.u64(0 if date is None else date + 1)
         r = self.select.call("tsdbStatus_v1", w)
         return json.loads(r.bytes_())
+
+    def tenants(self):
+        r = self.select.call("tenants_v1", Writer())
+        return [(r.u64(), r.u64()) for _ in range(r.u64())]
 
     def close(self):
         self.insert.close()
@@ -295,9 +334,12 @@ class ClusterStorage:
 
     # -- write path (vminsert) ------------------------------------------
 
-    def add_rows(self, rows) -> int:
+    def add_rows(self, rows, tenant=(0, 0)) -> int:
         """rows: [(labels-dict-or-MetricName, ts, value)] — shard by
-        canonical metric name, replicate RF-ways, reroute on failure."""
+        (tenant, canonical metric name), replicate RF-ways, reroute on
+        failure."""
+        import struct as _struct
+        tkey = _struct.pack(">II", tenant[0], tenant[1])
         per_node: dict[int, list] = {}
         excluded = {i for i, n in enumerate(self.nodes) if not n.healthy}
         for labels, ts, val in rows:
@@ -305,17 +347,17 @@ class ClusterStorage:
                 MetricName.from_dict(labels) if isinstance(labels, dict) \
                 else MetricName.from_labels(labels)
             raw = mn.marshal()
-            targets = self.ch.nodes_for_key(raw, self.rf, excluded)
+            targets = self.ch.nodes_for_key(tkey + raw, self.rf, excluded)
             if not targets:
                 # all nodes down: try everything anyway
-                targets = self.ch.nodes_for_key(raw, self.rf, set())
+                targets = self.ch.nodes_for_key(tkey + raw, self.rf, set())
             for i in targets:
                 per_node.setdefault(i, []).append((raw, ts, val))
         sent = 0
         for i, node_rows in per_node.items():
             node = self.nodes[i]
             try:
-                node.write_rows(node_rows)
+                node.write_rows(node_rows, tenant)
                 sent += len(node_rows)
             except (OSError, RPCError, ConnectionError) as e:
                 node.mark_down()
@@ -327,13 +369,13 @@ class ClusterStorage:
                       if not n.healthy} | {i}
                 alt_batches: dict[int, list] = {}
                 for row in node_rows:
-                    alt = self.ch.nodes_for_key(row[0], 1, ex)
+                    alt = self.ch.nodes_for_key(tkey + row[0], 1, ex)
                     if not alt:
                         raise RPCError(
                             f"no healthy storage nodes for reroute: {e}")
                     alt_batches.setdefault(alt[0], []).append(row)
                 for j, batch in alt_batches.items():
-                    self.nodes[j].write_rows(batch)
+                    self.nodes[j].write_rows(batch, tenant)
                     sent += len(batch)
         self.rows_sent += sent
         return len(rows)
@@ -382,9 +424,9 @@ class ClusterStorage:
         return results
 
     def search_series(self, filters, min_ts, max_ts, dedup_interval_ms=None,
-                      max_series=None):
+                      max_series=None, tenant=(0, 0)):
         node_results = self._fanout(
-            lambda n: n.search_series(filters, min_ts, max_ts))
+            lambda n: n.search_series(filters, min_ts, max_ts, tenant))
         merged: dict[bytes, list] = {}
         names: dict[bytes, MetricName] = {}
         for res, remote_partial in node_results:
@@ -415,31 +457,37 @@ class ClusterStorage:
         out.sort(key=lambda s: s.metric_name.marshal())
         return out
 
-    def search_metric_names(self, filters, min_ts, max_ts, limit=2**31):
+    def search_metric_names(self, filters, min_ts, max_ts, limit=2**31,
+                            tenant=(0, 0)):
         node_results = self._fanout(
-            lambda n: n.search_metric_names(filters, min_ts, max_ts))
+            lambda n: n.search_metric_names(filters, min_ts, max_ts, tenant))
         seen = {}
         for res in node_results:
             for mn in res:
                 seen.setdefault(mn.marshal(), mn)
         return [seen[k] for k in sorted(seen)][:limit]
 
-    def label_names(self, min_ts=None, max_ts=None):
-        res = self._fanout(lambda n: n.label_names(min_ts, max_ts))
+    def label_names(self, min_ts=None, max_ts=None, tenant=(0, 0)):
+        res = self._fanout(lambda n: n.label_names(min_ts, max_ts, tenant))
         return sorted(set().union(*map(set, res))) if res else []
 
-    def label_values(self, key, min_ts=None, max_ts=None):
-        res = self._fanout(lambda n: n.label_values(key, min_ts, max_ts))
+    def label_values(self, key, min_ts=None, max_ts=None, tenant=(0, 0)):
+        res = self._fanout(
+            lambda n: n.label_values(key, min_ts, max_ts, tenant))
         return sorted(set().union(*map(set, res))) if res else []
 
-    def delete_series(self, filters):
-        return sum(self._fanout(lambda n: n.delete_series(filters)))
+    def delete_series(self, filters, tenant=(0, 0)):
+        return sum(self._fanout(lambda n: n.delete_series(filters, tenant)))
 
-    def series_count(self):
-        return sum(self._fanout(lambda n: n.series_count()))
+    def series_count(self, tenant=(0, 0)):
+        return sum(self._fanout(lambda n: n.series_count(tenant)))
 
-    def tsdb_status(self, date=None, topn=10):
-        results = self._fanout(lambda n: n.tsdb_status(topn, date))
+    def tenants(self):
+        res = self._fanout(lambda n: n.tenants())
+        return sorted(set().union(*map(set, res))) if res else []
+
+    def tsdb_status(self, date=None, topn=10, tenant=(0, 0)):
+        results = self._fanout(lambda n: n.tsdb_status(topn, date, tenant))
         total = sum(r["totalSeries"] for r in results)
 
         def merge_top(key):

@@ -22,8 +22,8 @@ from ..ops import compress as zstd
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
 from ..utils import logger
 
-HELLO_INSERT = b"vmtpu-insert.v1\n"
-HELLO_SELECT = b"vmtpu-select.v1\n"
+HELLO_INSERT = b"vmtpu-insert.v2\n"
+HELLO_SELECT = b"vmtpu-select.v2\n"
 HELLO_OK = b"ok:zstd\n"
 
 _U32 = struct.Struct(">I")

@@ -253,7 +253,8 @@ def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
     qt = ec.tracer.new_child("fetch %s window=%dms", me, lookback)
     try:
         series = ec.storage.search_series(filters, fetch_lo, end,
-                                          max_series=ec.max_series)
+                                          max_series=ec.max_series,
+                                          tenant=ec.tenant)
     except ResourceWarning as e:
         from .limits import QueryLimitError
         raise QueryLimitError(

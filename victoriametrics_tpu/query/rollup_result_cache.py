@@ -34,7 +34,9 @@ class RollupResultCache:
         self.misses = 0
 
     def _key(self, ec: EvalConfig, q: str) -> tuple:
-        return (q, ec.step)
+        # tenant MUST be part of the key: a shared entry would leak one
+        # tenant's results to another
+        return (ec.tenant, q, ec.step)
 
     def get(self, ec: EvalConfig, q: str, now_ms: int
             ) -> tuple[list[Timeseries] | None, int]:

@@ -39,6 +39,7 @@ class EvalConfig:
     max_memory_per_query: int = 0               # -search.maxMemoryPerQuery
     deadline: float = 0.0      # time.monotonic() cutoff; 0 = none
     round_digits: int = 100
+    tenant: tuple = (0, 0)     # (accountID, projectID), lib/auth.Token analog
     tracer: object = None      # querytracer.Tracer | NOP (set in __post_init__)
     tpu: object = None         # TPUEngine when the device path is enabled
     _grid: np.ndarray | None = None
@@ -77,7 +78,7 @@ class EvalConfig:
                  max_series=self.max_series, round_digits=self.round_digits,
                  max_samples_per_query=self.max_samples_per_query,
                  max_memory_per_query=self.max_memory_per_query,
-                 deadline=self.deadline,
+                 deadline=self.deadline, tenant=self.tenant,
                  tracer=self.tracer, tpu=self.tpu,
                  _samples_scanned=self._samples_scanned)
         d.update(kw)

@@ -20,9 +20,9 @@ from .tsid import TSID
 
 MAX_ROWS_PER_BLOCK = 8192
 
-# tsid(24) min_ts max_ts rows scale prec ts_mt val_mt ts_first val_first
+# tsid(32) min_ts max_ts rows scale prec ts_mt val_mt ts_first val_first
 # ts_off ts_size val_off val_size
-_HDR = struct.Struct(">24sqqIhBBBqqQIQI")
+_HDR = struct.Struct(">32sqqIhBBBqqQIQI")
 
 
 class BlockHeader:

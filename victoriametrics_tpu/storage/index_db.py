@@ -1,16 +1,19 @@
 """Inverted index over the mergeset (reference lib/storage/index_db.go).
 
-Eight key namespaces (index_db.go:35-71 analog), all items in one mergeset
-table, 1-byte namespace prefix:
+Nine key namespaces (index_db.go:35-71 analog), all items in one mergeset
+table, 1-byte namespace prefix. T = tenant prefix accountID(4B BE)
+projectID(4B BE) (marshalCommonPrefix analog) — metricID-keyed namespaces
+are global because metricIDs are unique across tenants:
 
-  0  metricName(marshaled)        -> TSID          global series registry
-  1  tag(k 0x01 v) 0x00 metricID  -> (exists)      posting lists
-  2  metricID(8B BE)              -> TSID
-  3  metricID(8B BE)              -> metricName
-  4  metricID(8B BE)              -> (deleted)     tombstones
-  5  date(4B BE) metricID         -> (exists)      per-day series
-  6  date(4B BE) tag 0x00 metricID-> (exists)      per-day postings
-  7  date(4B BE) metricName       -> TSID          per-day registry
+  0  T metricName(marshaled)        -> TSID          per-tenant registry
+  1  T tag(k 0x01 v) 0x00 metricID  -> (exists)      posting lists
+  2  metricID(8B BE)                -> TSID
+  3  metricID(8B BE)                -> metricName
+  4  metricID(8B BE)                -> (deleted)     tombstones
+  5  T date(4B BE) metricID         -> (exists)      per-day series
+  6  T date(4B BE) tag 0x00 metricID-> (exists)      per-day postings
+  7  T date(4B BE) metricName       -> TSID          per-day registry
+  8  T                              -> (exists)      tenant listing
 
 The metric group is indexed as tag key b"" (like the reference). Values use
 the escaped metric-name encoding so 0x00/0x01 separators are unambiguous and
@@ -40,9 +43,15 @@ NS_DELETED = b"\x04"
 NS_DATE_TO_MID = b"\x05"
 NS_DATE_TAG_TO_MID = b"\x06"
 NS_DATE_NAME_TO_TSID = b"\x07"
+NS_TENANTS = b"\x08"
 
 _U64 = struct.Struct(">Q")
 _U32 = struct.Struct(">I")
+_TEN = struct.Struct(">II")  # accountID, projectID
+
+
+def tenant_prefix(tenant) -> bytes:
+    return _TEN.pack(tenant[0], tenant[1])
 
 MS_PER_DAY = 86_400_000
 
@@ -100,25 +109,29 @@ class IndexDB:
 
     def create_indexes_for_metric(self, mn: MetricName, tsid: TSID) -> None:
         """Global (date-independent) indexes for a new series
-        (createGlobalIndexes, index_db.go:428 analog)."""
+        (createGlobalIndexes, index_db.go:428 analog). The tenant rides in
+        the TSID (account_id/project_id)."""
+        ten = _TEN.pack(tsid.account_id, tsid.project_id)
         name_raw = mn.marshal()
         tsid_b = tsid.marshal()
         mid = _U64.pack(tsid.metric_id)
         items = [
-            NS_NAME_TO_TSID + name_raw + b"\x00" + tsid_b,
+            NS_NAME_TO_TSID + ten + name_raw + b"\x00" + tsid_b,
             NS_MID_TO_TSID + mid + tsid_b,
             NS_MID_TO_NAME + mid + name_raw,
-            NS_TAG_TO_MID + _tag_key_bytes(b"", mn.metric_group) + mid,
+            NS_TAG_TO_MID + ten + _tag_key_bytes(b"", mn.metric_group) + mid,
+            NS_TENANTS + ten,
         ]
         for k, v in mn.labels:
-            items.append(NS_TAG_TO_MID + _tag_key_bytes(k, v) + mid)
+            items.append(NS_TAG_TO_MID + ten + _tag_key_bytes(k, v) + mid)
         self.table.add_items(items)
         self._bump_gen()
 
     def create_per_day_indexes(self, mn: MetricName, tsid: TSID, date: int) -> None:
         """(date, X) indexes binding the series to one day
         (updatePerDateData analog, storage.go:2261)."""
-        d = _U32.pack(date)
+        ten = _TEN.pack(tsid.account_id, tsid.project_id)
+        d = ten + _U32.pack(date)
         mid = _U64.pack(tsid.metric_id)
         items = [
             NS_DATE_TO_MID + d + mid,
@@ -140,8 +153,10 @@ class IndexDB:
 
     # -- point lookups -----------------------------------------------------
 
-    def get_tsid_by_name(self, mn_marshaled: bytes) -> TSID | None:
-        prefix = NS_NAME_TO_TSID + mn_marshaled + b"\x00"
+    def get_tsid_by_name(self, mn_marshaled: bytes,
+                         tenant=(0, 0)) -> TSID | None:
+        prefix = NS_NAME_TO_TSID + tenant_prefix(tenant) + \
+            mn_marshaled + b"\x00"
         item = self.table.first_with_prefix(prefix)
         if item is None:
             return None
@@ -210,21 +225,27 @@ class IndexDB:
     # -- posting scans -----------------------------------------------------
 
     def _postings_for_tag(self, key: bytes, value: bytes,
-                          date: int | None = None) -> np.ndarray:
+                          date: int | None = None,
+                          tenant=(0, 0)) -> np.ndarray:
+        ten = tenant_prefix(tenant)
         if date is None:
-            prefix = NS_TAG_TO_MID + _tag_key_bytes(key, value)
+            prefix = NS_TAG_TO_MID + ten + _tag_key_bytes(key, value)
         else:
-            prefix = NS_DATE_TAG_TO_MID + _U32.pack(date) + _tag_key_bytes(key, value)
+            prefix = NS_DATE_TAG_TO_MID + ten + _U32.pack(date) + \
+                _tag_key_bytes(key, value)
         ids = [_U64.unpack(item[-8:])[0]
                for item in self.table.search_prefix(prefix)]
         return np.array(sorted(ids), dtype=np.uint64)
 
-    def _iter_tag_values(self, key: bytes, date: int | None = None):
+    def _iter_tag_values(self, key: bytes, date: int | None = None,
+                         tenant=(0, 0)):
         """Yield (value, metric_id) pairs for one tag key."""
+        ten = tenant_prefix(tenant)
         if date is None:
-            prefix = NS_TAG_TO_MID + escape(key) + b"\x01"
+            prefix = NS_TAG_TO_MID + ten + escape(key) + b"\x01"
         else:
-            prefix = NS_DATE_TAG_TO_MID + _U32.pack(date) + escape(key) + b"\x01"
+            prefix = NS_DATE_TAG_TO_MID + ten + _U32.pack(date) + \
+                escape(key) + b"\x01"
         plen = len(prefix)
         for item in self.table.search_prefix(prefix):
             body = item[plen:]
@@ -235,28 +256,43 @@ class IndexDB:
                 raise ValueError("corrupted tag->metricID index item")
             yield unescape(body[:sep]), _U64.unpack(body[sep + 1:])[0]
 
-    def _metric_ids_for_date(self, date: int) -> np.ndarray:
-        prefix = NS_DATE_TO_MID + _U32.pack(date)
+    def _metric_ids_for_date(self, date: int, tenant=(0, 0)) -> np.ndarray:
+        prefix = NS_DATE_TO_MID + tenant_prefix(tenant) + _U32.pack(date)
         ids = [_U64.unpack(item[-8:])[0]
                for item in self.table.search_prefix(prefix)]
         return np.array(sorted(ids), dtype=np.uint64)
 
-    def _all_metric_ids(self) -> np.ndarray:
-        ids = [_U64.unpack(item[1:9])[0]
-               for item in self.table.search_prefix(NS_MID_TO_TSID)]
+    def _all_metric_ids(self, tenant=(0, 0)) -> np.ndarray:
+        # every series has exactly one metric-group posting (tag key b"");
+        # scanning it under the tenant prefix enumerates the tenant
+        ids = [_U64.unpack(item[-8:])[0] for item in self.table.search_prefix(
+            NS_TAG_TO_MID + tenant_prefix(tenant) + b"\x01")]
         return np.array(sorted(ids), dtype=np.uint64)
 
-    def _metric_ids_for_filter(self, tf: TagFilter, date: int | None) -> np.ndarray:
+    def all_series_count(self) -> int:
+        """Global series count across every tenant (vm_timeseries_total)."""
+        return sum(1 for _ in self.table.search_prefix(NS_MID_TO_TSID))
+
+    def tenants(self) -> list[tuple[int, int]]:
+        """Distinct (accountID, projectID) pairs (tenants_v1 analog)."""
+        out = []
+        for item in self.table.search_prefix(NS_TENANTS):
+            a, p = _TEN.unpack(item[1:9])
+            out.append((a, p))
+        return sorted(set(out))
+
+    def _metric_ids_for_filter(self, tf: TagFilter, date: int | None,
+                               tenant=(0, 0)) -> np.ndarray:
         """Posting set for the *positive form* of the filter, i.e. ids whose
         label value matches value/regex ignoring negation (negation is set
         subtraction in the caller)."""
         if tf.or_values is not None:
-            sets = [self._postings_for_tag(tf.key, v, date)
+            sets = [self._postings_for_tag(tf.key, v, date, tenant)
                     for v in tf.or_values if v != b""]
             sets = [s for s in sets if s.size]
             return (np.unique(np.concatenate(sets))
                     if sets else np.array([], dtype=np.uint64))
-        ids = [mid for v, mid in self._iter_tag_values(tf.key, date)
+        ids = [mid for v, mid in self._iter_tag_values(tf.key, date, tenant)
                if bool(tf._re.match(v.decode("utf-8", "replace")))]
         return np.unique(np.array(ids, dtype=np.uint64)) if ids else \
             np.array([], dtype=np.uint64)
@@ -267,11 +303,13 @@ class IndexDB:
 
     def search_metric_ids(self, filters: list[TagFilter],
                           min_ts: int | None = None,
-                          max_ts: int | None = None) -> np.ndarray:
+                          max_ts: int | None = None,
+                          tenant=(0, 0)) -> np.ndarray:
         """Resolve tag filters to a sorted metricID array
         (searchMetricIDs, index_db.go:1685 analog), memoized in the
         tagFilters->metricIDs cache (index_db.go:336-361 analog)."""
-        ckey = (tuple((tf.key, tf.value, tf.negate, tf.regex)
+        ckey = (tenant,
+                tuple((tf.key, tf.value, tf.negate, tf.regex)
                       for tf in filters),
                 None if min_ts is None else date_of_ms(min_ts),
                 None if max_ts is None else date_of_ms(max_ts))
@@ -283,7 +321,8 @@ class IndexDB:
                 return got[1]
             gen = self._gen  # capture BEFORE the search: a concurrent index
             # write during the scan must invalidate what we store
-        result = self._search_metric_ids_uncached(filters, min_ts, max_ts)
+        result = self._search_metric_ids_uncached(filters, min_ts, max_ts,
+                                                  tenant)
         with self._lock:
             if len(self._filter_cache) >= self.MAX_FILTER_CACHE:
                 self._filter_cache.clear()
@@ -292,7 +331,8 @@ class IndexDB:
 
     def _search_metric_ids_uncached(self, filters: list[TagFilter],
                                     min_ts: int | None = None,
-                                    max_ts: int | None = None) -> np.ndarray:
+                                    max_ts: int | None = None,
+                                    tenant=(0, 0)) -> np.ndarray:
         use_dates: list[int] | None = None
         if min_ts is not None and max_ts is not None:
             d0, d1 = date_of_ms(min_ts), date_of_ms(max_ts)
@@ -301,11 +341,12 @@ class IndexDB:
 
         def filter_set(tf: TagFilter) -> np.ndarray:
             if use_dates is not None:
-                sets = [self._metric_ids_for_filter(tf, d) for d in use_dates]
+                sets = [self._metric_ids_for_filter(tf, d, tenant)
+                        for d in use_dates]
                 sets = [s for s in sets if s.size]
                 return (np.unique(np.concatenate(sets)) if sets
                         else np.array([], dtype=np.uint64))
-            return self._metric_ids_for_filter(tf, None)
+            return self._metric_ids_for_filter(tf, None, tenant)
 
         # Strong positives (don't match a missing label) seed the result via
         # posting intersections; everything else refines it. A missing label
@@ -325,12 +366,13 @@ class IndexDB:
         else:
             # no strong positive: start from the day universe (or everything)
             if use_dates is not None:
-                sets = [self._metric_ids_for_date(d) for d in use_dates]
+                sets = [self._metric_ids_for_date(d, tenant)
+                        for d in use_dates]
                 sets = [s for s in sets if s.size]
                 result = (np.unique(np.concatenate(sets)) if sets
                           else np.array([], dtype=np.uint64))
             else:
-                result = self._all_metric_ids()
+                result = self._all_metric_ids(tenant)
 
         for tf in rest:
             if result.size == 0:
@@ -342,14 +384,14 @@ class IndexDB:
                 if not tf.is_empty_match:
                     # e.g. x!="" / x!~"a?": a missing label would match the
                     # positive form, so only ids that HAVE the key survive
-                    have_key = self._ids_with_key(tf.key, use_dates)
+                    have_key = self._ids_with_key(tf.key, use_dates, tenant)
                     survivors = np.intersect1d(survivors, have_key,
                                                assume_unique=True)
                 result = survivors
             else:
                 # positive filter matching empty (x="" or x=~"a?"): keep ids
                 # that either match the positive form or lack the label
-                have_key = self._ids_with_key(tf.key, use_dates)
+                have_key = self._ids_with_key(tf.key, use_dates, tenant)
                 lacking = np.setdiff1d(result, have_key, assume_unique=True)
                 matching = np.intersect1d(result, matched, assume_unique=True)
                 result = np.union1d(lacking, matching)
@@ -359,18 +401,18 @@ class IndexDB:
             result = np.setdiff1d(result, self._deleted, assume_unique=True)
         return result
 
-    def _ids_with_key(self, key: bytes, use_dates) -> np.ndarray:
+    def _ids_with_key(self, key: bytes, use_dates, tenant=(0, 0)) -> np.ndarray:
         ids = set()
         dates = use_dates if use_dates is not None else [None]
         for d in dates:
-            for _, mid in self._iter_tag_values(key, d):
+            for _, mid in self._iter_tag_values(key, d, tenant):
                 ids.add(mid)
         return np.array(sorted(ids), dtype=np.uint64)
 
     def search_tsids(self, filters: list[TagFilter],
                      min_ts: int | None = None,
-                     max_ts: int | None = None) -> list[TSID]:
-        mids = self.search_metric_ids(filters, min_ts, max_ts)
+                     max_ts: int | None = None, tenant=(0, 0)) -> list[TSID]:
+        mids = self.search_metric_ids(filters, min_ts, max_ts, tenant)
         out = []
         for mid in mids:
             t = self.get_tsid_by_id(int(mid))
@@ -390,18 +432,21 @@ class IndexDB:
             return None
         return list(range(d0, d1 + 1))
 
-    def label_names(self, min_ts=None, max_ts=None) -> list[str]:
+    def label_names(self, min_ts=None, max_ts=None,
+                    tenant=(0, 0)) -> list[str]:
         """Distinct label keys, time-scoped via the per-day index when the
         range is narrow (SearchLabelNames analog, index_db.go:507)."""
+        ten = tenant_prefix(tenant)
         dates = self._date_range(min_ts, max_ts)
         seen_keys = set()
         if dates is None:
-            for item in self.table.search_prefix(NS_TAG_TO_MID):
-                body = item[1:]
+            prefix = NS_TAG_TO_MID + ten
+            for item in self.table.search_prefix(prefix):
+                body = item[len(prefix):]
                 seen_keys.add(body[:body.index(b"\x01")])
         else:
             for d in dates:
-                prefix = NS_DATE_TAG_TO_MID + _U32.pack(d)
+                prefix = NS_DATE_TAG_TO_MID + ten + _U32.pack(d)
                 for item in self.table.search_prefix(prefix):
                     body = item[len(prefix):]
                     seen_keys.add(body[:body.index(b"\x01")])
@@ -410,10 +455,11 @@ class IndexDB:
         names.add("__name__")
         return sorted(names)
 
-    def label_values(self, key: str, min_ts=None, max_ts=None) -> list[str]:
+    def label_values(self, key: str, min_ts=None, max_ts=None,
+                     tenant=(0, 0)) -> list[str]:
         kb = b"" if key == "__name__" else key.encode()
         dates = self._date_range(min_ts, max_ts)
         vals = set()
         for d in (dates if dates is not None else [None]):
-            vals |= {v for v, _ in self._iter_tag_values(kb, d)}
+            vals |= {v for v, _ in self._iter_tag_values(kb, d, tenant)}
         return sorted(v.decode("utf-8", "replace") for v in vals)

@@ -27,7 +27,7 @@ from .block import Block, BlockHeader
 from .tsid import TSID
 
 HEADERS_PER_INDEX_BLOCK = 256
-_META_ROW = struct.Struct(">24sIQIqq")
+_META_ROW = struct.Struct(">32sIQIqq")
 
 
 class MetaindexRow:

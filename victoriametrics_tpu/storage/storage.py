@@ -55,6 +55,7 @@ class Storage:
             fcntl.flock(self._flock_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
         except BlockingIOError:
             raise RuntimeError(f"storage at {path} is locked by another process")
+        self._check_format()
         self.idb = IndexDB(os.path.join(path, "indexdb"))
         self.table = Table(os.path.join(path, "data"), dedup_interval_ms)
         self._tsid_cache: dict[bytes, TSID] = {}
@@ -72,6 +73,32 @@ class Storage:
         self.new_series_created = 0
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
         self._flusher.start()
+
+    FORMAT_VERSION = 2  # v2: 32-byte TSID with (accountID, projectID)
+
+    def _check_format(self):
+        """Refuse to open data directories written with an incompatible
+        on-disk format instead of misparsing them (format.json marker)."""
+        import json as _json
+        marker = os.path.join(self.path, "format.json")
+        has_data = any(os.path.isdir(os.path.join(self.path, d))
+                       for d in ("data", "indexdb"))
+        if os.path.exists(marker):
+            with open(marker) as f:
+                v = _json.load(f).get("format_version")
+            if v != self.FORMAT_VERSION:
+                raise RuntimeError(
+                    f"storage at {self.path} uses on-disk format v{v}; this "
+                    f"build reads v{self.FORMAT_VERSION} — restore from a "
+                    f"snapshot or re-ingest")
+        elif has_data:
+            raise RuntimeError(
+                f"storage at {self.path} predates the versioned on-disk "
+                f"format (v{self.FORMAT_VERSION}) — restore from a snapshot "
+                f"or re-ingest")
+        else:
+            with open(marker, "w") as f:
+                _json.dump({"format_version": self.FORMAT_VERSION}, f)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -106,20 +133,22 @@ class Storage:
 
     # -- writes ------------------------------------------------------------
 
-    def _resolve_tsid(self, mn: MetricName, raw: bytes) -> TSID:
-        tsid = self._tsid_cache.get(raw)
+    def _resolve_tsid(self, mn: MetricName, raw: bytes,
+                      tenant=(0, 0)) -> TSID:
+        ck = (tenant, raw)
+        tsid = self._tsid_cache.get(ck)
         if tsid is not None:
             return tsid
         self.slow_row_inserts += 1
-        tsid = self.idb.get_tsid_by_name(raw)
+        tsid = self.idb.get_tsid_by_name(raw, tenant)
         if tsid is None:
-            tsid = generate_tsid(mn, self._mid_gen.next_id())
+            tsid = generate_tsid(mn, self._mid_gen.next_id(), tenant)
             self.idb.create_indexes_for_metric(mn, tsid)
             self.new_series_created += 1
-        self._tsid_cache[raw] = tsid
+        self._tsid_cache[ck] = tsid
         return tsid
 
-    def add_rows(self, rows) -> int:
+    def add_rows(self, rows, tenant=(0, 0)) -> int:
         """rows: iterable of (MetricName | dict | list[(k,v)], ts_ms, value).
         Returns rows added (AddRows/Storage.add analog, storage.go:1655).
 
@@ -136,9 +165,9 @@ class Storage:
             for labels, ts, val in rows:
                 key = None
                 if type(labels) is dict:
-                    key = tuple(labels.items())
+                    key = (tenant, *labels.items())
                 elif type(labels) is list:
-                    key = tuple(labels)
+                    key = (tenant, *labels)
                 tsid = raw_cache.get(key) if key is not None else None
                 date = ts // 86_400_000
                 mn = None
@@ -156,7 +185,7 @@ class Storage:
                         mn = MetricName.from_dict(labels)
                     else:
                         mn = MetricName.from_labels(labels)
-                    tsid = self._resolve_tsid(mn, mn.marshal())
+                    tsid = self._resolve_tsid(mn, mn.marshal(), tenant)
                     if key is not None:
                         if len(raw_cache) >= 1 << 21:
                             raw_cache.clear()
@@ -172,20 +201,21 @@ class Storage:
         self.rows_added += len(out)
         return len(out)
 
-    def register_metric_names(self, metric_names) -> None:
+    def register_metric_names(self, metric_names, tenant=(0, 0)) -> None:
         """Create index entries without samples (RegisterMetricNames,
         storage.go:1524)."""
         with self._lock:
             for labels in metric_names:
                 mn = labels if isinstance(labels, MetricName) else \
                     MetricName.from_dict(labels)
-                self._resolve_tsid(mn, mn.marshal())
+                self._resolve_tsid(mn, mn.marshal(), tenant)
 
     # -- reads -------------------------------------------------------------
 
     def search_metric_names(self, filters: list[TagFilter], min_ts: int,
-                            max_ts: int, limit: int = 2**31) -> list[MetricName]:
-        mids = self.idb.search_metric_ids(filters, min_ts, max_ts)
+                            max_ts: int, limit: int = 2**31,
+                            tenant=(0, 0)) -> list[MetricName]:
+        mids = self.idb.search_metric_ids(filters, min_ts, max_ts, tenant)
         out = []
         for mid in mids[:limit]:
             mn = self.idb.get_metric_name_by_id(int(mid))
@@ -194,10 +224,10 @@ class Storage:
         return out
 
     def iter_series_blocks(self, filters: list[TagFilter], min_ts: int,
-                           max_ts: int):
+                           max_ts: int, tenant=(0, 0)):
         """Raw matching blocks in (tsid, min_ts) order — the input to the
         TPU tile packer (Search.NextMetricBlock analog, search.go:275)."""
-        tsids = self.idb.search_tsids(filters, min_ts, max_ts)
+        tsids = self.idb.search_tsids(filters, min_ts, max_ts, tenant)
         tsid_set = {t.metric_id for t in tsids}
         if not tsid_set:
             return
@@ -207,13 +237,14 @@ class Storage:
 
     def search_series(self, filters: list[TagFilter], min_ts: int,
                       max_ts: int, dedup_interval_ms: int | None = None,
-                      max_series: int | None = None) -> list[SeriesData]:
+                      max_series: int | None = None,
+                      tenant=(0, 0)) -> list[SeriesData]:
         """Decoded per-series rows, cross-part merged, deduped, clipped."""
         from ..ops import decimal as dec_ops
         interval = (self.dedup_interval_ms if dedup_interval_ms is None
                     else dedup_interval_ms)
         per_mid: dict[int, list] = {}
-        for blk in self.iter_series_blocks(filters, min_ts, max_ts):
+        for blk in self.iter_series_blocks(filters, min_ts, max_ts, tenant):
             per_mid.setdefault(blk.tsid.metric_id, []).append(blk)
         if max_series is not None and len(per_mid) > max_series:
             raise ResourceWarning(
@@ -253,22 +284,29 @@ class Storage:
         out.sort(key=lambda rs: rs[0])
         return [sd for _, sd in out]
 
-    def label_names(self, min_ts=None, max_ts=None) -> list[str]:
-        return self.idb.label_names(min_ts, max_ts)
+    def label_names(self, min_ts=None, max_ts=None,
+                    tenant=(0, 0)) -> list[str]:
+        return self.idb.label_names(min_ts, max_ts, tenant)
 
-    def label_values(self, key: str, min_ts=None, max_ts=None) -> list[str]:
-        return self.idb.label_values(key, min_ts, max_ts)
+    def label_values(self, key: str, min_ts=None, max_ts=None,
+                     tenant=(0, 0)) -> list[str]:
+        return self.idb.label_values(key, min_ts, max_ts, tenant)
 
-    def series_count(self) -> int:
-        return int(self.idb._all_metric_ids().size)
+    def series_count(self, tenant=(0, 0)) -> int:
+        return int(self.idb._all_metric_ids(tenant).size)
 
-    def tsdb_status(self, date: int | None = None, topn: int = 10) -> dict:
+    def tenants(self) -> list[tuple[int, int]]:
+        return self.idb.tenants()
+
+    def tsdb_status(self, date: int | None = None, topn: int = 10,
+                    tenant=(0, 0)) -> dict:
         """Cardinality explorer data (GetTSDBStatus, index_db.go:1284)."""
         by_metric: dict[bytes, int] = {}
         by_label: dict[bytes, int] = {}
         by_pair: dict[bytes, int] = {}
-        mids = (self.idb._metric_ids_for_date(date) if date is not None
-                else self.idb._all_metric_ids())
+        mids = (self.idb._metric_ids_for_date(date, tenant)
+                if date is not None
+                else self.idb._all_metric_ids(tenant))
         for mid in mids:
             mn = self.idb.get_metric_name_by_id(int(mid))
             if mn is None:
@@ -292,16 +330,16 @@ class Storage:
 
     # -- deletes -----------------------------------------------------------
 
-    def delete_series(self, filters: list[TagFilter]) -> int:
+    def delete_series(self, filters: list[TagFilter], tenant=(0, 0)) -> int:
         """Tombstone matching series (DeleteSeries, storage.go:1345). Data
         blocks are dropped at the next merge."""
-        mids = self.idb.search_metric_ids(filters)
+        mids = self.idb.search_metric_ids(filters, tenant=tenant)
         if mids.size:
             self.idb.delete_series_by_ids(mids)
             with self._lock:
                 dead = set(int(m) for m in mids)
                 self._tsid_cache = {
-                    raw: t for raw, t in self._tsid_cache.items()
+                    k: t for k, t in self._tsid_cache.items()
                     if t.metric_id not in dead}
                 # the raw-label cache would resurrect tombstoned metric_ids
                 self._tsid_cache_raw = {
@@ -338,6 +376,8 @@ class Storage:
         dst = os.path.join(self.snapshots_dir(), name)
         self.table.snapshot_to(os.path.join(dst, "data"))
         self.idb.table.create_snapshot_at(os.path.join(dst, "indexdb"))
+        shutil.copy(os.path.join(self.path, "format.json"),
+                    os.path.join(dst, "format.json"))
         logger.infof("storage: created snapshot %s", name)
         return name
 
@@ -362,6 +402,6 @@ class Storage:
             "vm_rows": self.table.rows,
             "vm_new_timeseries_created_total": self.new_series_created,
             "vm_slow_row_inserts_total": self.slow_row_inserts,
-            "vm_timeseries_total": self.series_count(),
+            "vm_timeseries_total": self.idb.all_series_count(),
             "vm_partitions": len(self.table.partition_names),
         }

@@ -14,32 +14,40 @@ import time
 
 import xxhash
 
-_FMT = struct.Struct(">QIIQ")  # group, job, instance, metric
+_FMT = struct.Struct(">IIQIIQ")  # account, project, group, job, instance, metric
 
 
 class TSID:
-    __slots__ = ("metric_group_id", "job_id", "instance_id", "metric_id")
+    """Sort order starts with (account_id, project_id) so one tenant's
+    blocks cluster together on disk (reference tsid.go:17 Less())."""
+
+    __slots__ = ("account_id", "project_id", "metric_group_id", "job_id",
+                 "instance_id", "metric_id")
 
     SIZE = _FMT.size
 
-    def __init__(self, metric_group_id=0, job_id=0, instance_id=0, metric_id=0):
+    def __init__(self, metric_group_id=0, job_id=0, instance_id=0,
+                 metric_id=0, account_id=0, project_id=0):
+        self.account_id = account_id
+        self.project_id = project_id
         self.metric_group_id = metric_group_id
         self.job_id = job_id
         self.instance_id = instance_id
         self.metric_id = metric_id
 
     def marshal(self) -> bytes:
-        return _FMT.pack(self.metric_group_id, self.job_id, self.instance_id,
+        return _FMT.pack(self.account_id, self.project_id,
+                         self.metric_group_id, self.job_id, self.instance_id,
                          self.metric_id)
 
     @classmethod
     def unmarshal(cls, data: bytes, offset: int = 0) -> "TSID":
-        g, j, i, m = _FMT.unpack_from(data, offset)
-        return cls(g, j, i, m)
+        a, p, g, j, i, m = _FMT.unpack_from(data, offset)
+        return cls(g, j, i, m, a, p)
 
     def sort_key(self) -> tuple:
-        return (self.metric_group_id, self.job_id, self.instance_id,
-                self.metric_id)
+        return (self.account_id, self.project_id, self.metric_group_id,
+                self.job_id, self.instance_id, self.metric_id)
 
     def __lt__(self, other):
         return self.sort_key() < other.sort_key()
@@ -70,9 +78,9 @@ class MetricIDGenerator:
             return self._next
 
 
-def generate_tsid(mn, metric_id: int) -> TSID:
+def generate_tsid(mn, metric_id: int, tenant=(0, 0)) -> TSID:
     """Derive the clustering hash fields from the metric name."""
-    t = TSID(metric_id=metric_id)
+    t = TSID(metric_id=metric_id, account_id=tenant[0], project_id=tenant[1])
     t.metric_group_id = xxhash.xxh64_intdigest(mn.metric_group)
     job = mn.get_label(b"job")
     if job:
