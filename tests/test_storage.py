@@ -583,3 +583,77 @@ class TestFormatVersionGate:
             _json.dump({"format_version": 1}, f)
         with pytest.raises(RuntimeError, match="v1"):
             Storage(str(root))
+
+
+class TestCardinalityLimiters:
+    """lib/bloomfilter/limiter.go semantics (storage.go:2136)."""
+
+    def test_hourly_limit_drops_over_budget(self, tmp_path):
+        s = Storage(str(tmp_path / "cl"), max_hourly_series=10)
+        rows = [({"__name__": "cl", "i": str(i)}, T0, float(i))
+                for i in range(25)]
+        s.add_rows(rows)
+        m = s.metrics()
+        assert m["vm_hourly_series_limit_max_series"] == 10
+        assert m["vm_hourly_series_limit_current_series"] == 10
+        assert m["vm_hourly_series_limit_rows_dropped_total"] == 15
+        # over-budget series created NO index entries (storage.go:2136
+        # ordering: limiter gates index creation, not just data rows)
+        assert s.series_count() == 10
+        assert s.new_series_created == 10
+        # tracked series keep flowing through the fast path
+        n = s.add_rows([({"__name__": "cl", "i": "1"}, T0 + 15_000, 9.0)])
+        assert n == 1
+        assert s.metrics()["vm_hourly_series_limit_rows_dropped_total"] == 15
+        s.close()
+
+    def test_limiter_rotates(self):
+        import time as _t
+        from victoriametrics_tpu.storage.cardinality import BloomLimiter
+        lim = BloomLimiter(2, rotation_s=3600)
+        assert lim.add(1) and lim.add(2) and not lim.add(3)
+        lim._bucket -= 1  # simulate the hour rolling over
+        assert lim.add(3)  # budget reset
+        assert lim.current_series == 1
+
+
+class TestCachePersistence:
+    def test_no_reresolve_storm_after_restart(self, tmp_path):
+        s = Storage(str(tmp_path / "cp"))
+        rows = [({"__name__": "cp", "i": str(i)}, T0, float(i))
+                for i in range(200)]
+        s.add_rows(rows)
+        s.close()
+        s2 = Storage(str(tmp_path / "cp"))
+        before = s2.slow_row_inserts
+        s2.add_rows([({"__name__": "cp", "i": str(i)}, T0 + 15_000, 1.0)
+                     for i in range(200)])
+        # every tsid came from the persisted cache: one cache-dict hit per
+        # series, zero index lookups for day-known series
+        assert s2.slow_row_inserts - before == 0
+        assert s2.new_series_created == 0
+        f = filters_from_dict({"__name__": "cp"})
+        assert len(s2.search_series(f, T0, T0 + 100_000)) == 200
+        s2.close()
+
+
+class TestPerMonthIndex:
+    def test_retention_drops_month_index_with_partition(self, tmp_path):
+        now_ms = int(__import__("time").time() * 1000)
+        old_ms = now_ms - 200 * 86_400_000
+        s = Storage(str(tmp_path / "pm"), retention_ms=100 * 86_400_000)
+        s.add_rows([({"__name__": "old", "i": "1"}, old_ms, 1.0)])
+        s.add_rows([({"__name__": "new", "i": "1"}, now_ms, 2.0)])
+        s.force_flush()
+        months = os.path.join(str(tmp_path / "pm"), "indexdb", "months")
+        assert len(os.listdir(months)) == 2
+        dropped = s.enforce_retention()
+        assert dropped >= 2  # data partition + month index
+        live = os.listdir(months)
+        assert len(live) == 1
+        # new data still searchable through its per-day index
+        f = filters_from_dict({"__name__": "new"})
+        assert len(s.search_series(f, now_ms - 1000, now_ms + 1000)) == 1
+        f = filters_from_dict({"__name__": "old"})
+        assert s.search_series(f, old_ms - 1000, old_ms + 1000) == []
+        s.close()

@@ -26,6 +26,10 @@ def parse_flags(argv=None):
                    help="duration: 30d, 13m(onths) etc")
     p.add_argument("-dedup.minScrapeInterval", dest="dedup_interval",
                    default="0s")
+    p.add_argument("-storage.maxHourlySeries", dest="max_hourly_series",
+                   type=int, default=0)
+    p.add_argument("-storage.maxDailySeries", dest="max_daily_series",
+                   type=int, default=0)
     p.add_argument("-search.maxUniqueTimeseries", dest="max_series",
                    type=int, default=300_000)
     p.add_argument("-search.maxSamplesPerQuery", dest="max_samples_per_query",
@@ -89,7 +93,9 @@ def build(args):
     retention = _dur_ms(args.retentionPeriod, months_ok=True)
     dedup = _dur_ms(args.dedup_interval) if args.dedup_interval != "0s" else 0
     storage = Storage(args.storageDataPath, retention_ms=retention,
-                      dedup_interval_ms=dedup)
+                      dedup_interval_ms=dedup,
+                      max_hourly_series=args.max_hourly_series,
+                      max_daily_series=args.max_daily_series)
     tpu_engine = None
     if args.tpu:
         from ..query.tpu_engine import TPUEngine

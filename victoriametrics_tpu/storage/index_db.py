@@ -25,6 +25,7 @@ uint64set analog; intersections/unions/subtractions are vectorized.
 
 from __future__ import annotations
 
+import os
 import struct
 import threading
 
@@ -80,7 +81,19 @@ class IndexDB:
     MAX_FILTER_CACHE = 1024
 
     def __init__(self, path: str):
+        self.path = path
         self.table = Table(path)
+        # per-month tables hold the per-day namespaces (5/6/7) so retention
+        # can drop a month's index with its data partition (the reference's
+        # per-partition indexDB, storage.go:1094); the global table keeps
+        # the registry namespaces (0/2/3/4/8) and undated postings (1).
+        self._month_tables: dict[str, Table] = {}
+        months_dir = os.path.join(path, "months")
+        if os.path.isdir(months_dir):
+            for name in sorted(os.listdir(months_dir)):
+                if len(name) == 7 and name[4] == "_":
+                    self._month_tables[name] = Table(
+                        os.path.join(months_dir, name))
         self._lock = threading.Lock()
         self._deleted = self._load_deleted()
         self._gen = 0
@@ -92,9 +105,57 @@ class IndexDB:
 
     def close(self):
         self.table.close()
+        for t in self._month_tables.values():
+            t.close()
 
     def flush(self):
         self.table.flush_to_disk()
+        for t in self._month_tables.values():
+            t.flush_to_disk()
+
+    @staticmethod
+    def _month_of_date(date: int) -> str:
+        import datetime as _dt
+        d = _dt.datetime.fromtimestamp(date * 86_400,
+                                       tz=_dt.timezone.utc)
+        return f"{d.year:04d}_{d.month:02d}"
+
+    def _day_table(self, date: int) -> Table:
+        """Month table for writes (created on demand)."""
+        name = self._month_of_date(date)
+        t = self._month_tables.get(name)
+        if t is None:
+            with self._lock:
+                t = self._month_tables.get(name)
+                if t is None:
+                    t = Table(os.path.join(self.path, "months", name))
+                    self._month_tables[name] = t
+        return t
+
+    def _day_table_ro(self, date: int) -> Table | None:
+        """Month table for reads: None when the month has no index (never
+        written or dropped by retention) — reads must not create dirs."""
+        return self._month_tables.get(self._month_of_date(date))
+
+    def snapshot_month_tables(self) -> list:
+        with self._lock:
+            return list(self._month_tables.items())
+
+    def drop_months_before(self, min_valid_ts: int) -> int:
+        """Drop whole month index tables older than retention (the
+        per-partition indexDB rotation; returns count)."""
+        import shutil
+        min_month = self._month_of_date(min_valid_ts // MS_PER_DAY)
+        dropped = 0
+        with self._lock:
+            for name in list(self._month_tables):
+                if name < min_month:
+                    t = self._month_tables.pop(name)
+                    t.close()
+                    shutil.rmtree(t.path, ignore_errors=True)
+                    dropped += 1
+                    self._gen += 1
+        return dropped
 
     def _bump_gen(self):
         with self._lock:
@@ -140,7 +201,7 @@ class IndexDB:
         ]
         for k, v in mn.labels:
             items.append(NS_DATE_TAG_TO_MID + d + _tag_key_bytes(k, v) + mid)
-        self.table.add_items(items)
+        self._day_table(date).add_items(items)
         self._bump_gen()
 
     def delete_series_by_ids(self, metric_ids: np.ndarray) -> int:
@@ -206,10 +267,6 @@ class IndexDB:
                 out[mid] = got
         return out
 
-    def has_date_metric_id(self, date: int, metric_id: int) -> bool:
-        return self.table.has_item(
-            NS_DATE_TO_MID + _U32.pack(date) + _U64.pack(metric_id))
-
     # -- deleted set -------------------------------------------------------
 
     def _load_deleted(self) -> np.ndarray:
@@ -229,12 +286,16 @@ class IndexDB:
                           tenant=(0, 0)) -> np.ndarray:
         ten = tenant_prefix(tenant)
         if date is None:
+            table = self.table
             prefix = NS_TAG_TO_MID + ten + _tag_key_bytes(key, value)
         else:
+            table = self._day_table_ro(date)
+            if table is None:
+                return np.array([], dtype=np.uint64)
             prefix = NS_DATE_TAG_TO_MID + ten + _U32.pack(date) + \
                 _tag_key_bytes(key, value)
         ids = [_U64.unpack(item[-8:])[0]
-               for item in self.table.search_prefix(prefix)]
+               for item in table.search_prefix(prefix)]
         return np.array(sorted(ids), dtype=np.uint64)
 
     def _iter_tag_values(self, key: bytes, date: int | None = None,
@@ -242,12 +303,16 @@ class IndexDB:
         """Yield (value, metric_id) pairs for one tag key."""
         ten = tenant_prefix(tenant)
         if date is None:
+            table = self.table
             prefix = NS_TAG_TO_MID + ten + escape(key) + b"\x01"
         else:
+            table = self._day_table_ro(date)
+            if table is None:
+                return
             prefix = NS_DATE_TAG_TO_MID + ten + _U32.pack(date) + \
                 escape(key) + b"\x01"
         plen = len(prefix)
-        for item in self.table.search_prefix(prefix):
+        for item in table.search_prefix(prefix):
             body = item[plen:]
             # fixed-width tail: 0x00 separator + 8-byte BE metric_id (which
             # itself may contain 0x00 bytes, so never search for the NUL)
@@ -257,9 +322,12 @@ class IndexDB:
             yield unescape(body[:sep]), _U64.unpack(body[sep + 1:])[0]
 
     def _metric_ids_for_date(self, date: int, tenant=(0, 0)) -> np.ndarray:
+        table = self._day_table_ro(date)
+        if table is None:
+            return np.array([], dtype=np.uint64)
         prefix = NS_DATE_TO_MID + tenant_prefix(tenant) + _U32.pack(date)
         ids = [_U64.unpack(item[-8:])[0]
-               for item in self.table.search_prefix(prefix)]
+               for item in table.search_prefix(prefix)]
         return np.array(sorted(ids), dtype=np.uint64)
 
     def _all_metric_ids(self, tenant=(0, 0)) -> np.ndarray:
@@ -446,8 +514,11 @@ class IndexDB:
                 seen_keys.add(body[:body.index(b"\x01")])
         else:
             for d in dates:
+                table = self._day_table_ro(d)
+                if table is None:
+                    continue
                 prefix = NS_DATE_TAG_TO_MID + ten + _U32.pack(d)
-                for item in self.table.search_prefix(prefix):
+                for item in table.search_prefix(prefix):
                     body = item[len(prefix):]
                     seen_keys.add(body[:body.index(b"\x01")])
         names = {unescape(k).decode("utf-8", "replace")

@@ -45,10 +45,16 @@ class SeriesData:
 
 class Storage:
     def __init__(self, path: str, retention_ms: int = DEFAULT_RETENTION_MS,
-                 dedup_interval_ms: int = 0):
+                 dedup_interval_ms: int = 0, max_hourly_series: int = 0,
+                 max_daily_series: int = 0):
         self.path = path
         self.retention_ms = retention_ms
         self.dedup_interval_ms = dedup_interval_ms
+        from .cardinality import BloomLimiter
+        self.hourly_limiter = (BloomLimiter(max_hourly_series, 3600, "hourly")
+                               if max_hourly_series > 0 else None)
+        self.daily_limiter = (BloomLimiter(max_daily_series, 86400, "daily")
+                              if max_daily_series > 0 else None)
         os.makedirs(path, exist_ok=True)
         self._flock_f = open(os.path.join(path, "flock.lock"), "w")
         try:
@@ -71,6 +77,7 @@ class Storage:
         self.rows_added = 0
         self.slow_row_inserts = 0
         self.new_series_created = 0
+        self._load_caches()
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
         self._flusher.start()
 
@@ -105,6 +112,7 @@ class Storage:
     def close(self):
         self._stop.set()
         self._flusher.join(timeout=10)
+        self._save_caches()
         self.table.flush_to_disk()
         self.idb.flush()
         self.table.close()
@@ -124,6 +132,65 @@ class Storage:
             except Exception as e:  # pragma: no cover
                 logger.errorf("storage flusher: %s", e)
 
+    # -- cache persistence (storage.go:1026-1041 mustSaveCache analogs) ----
+
+    _CACHE_MAGIC = b"vmtpu-cache-v2\n"
+
+    def _save_caches(self):
+        """Persist the tsid and per-day caches so a restart does not
+        re-resolve every live series through the index."""
+        import struct as _st
+        d = os.path.join(self.path, "cache")
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, "tsid_cache.bin.tmp")
+        with self._lock:
+            tsid_items = list(self._tsid_cache.items())
+            day_items = list(self._day_cache)
+        with open(tmp, "wb") as f:
+            f.write(self._CACHE_MAGIC)
+            f.write(_st.pack("<Q", len(tsid_items)))
+            for (tenant, raw), t in tsid_items:
+                f.write(_st.pack("<III", tenant[0], tenant[1], len(raw)))
+                f.write(raw)
+                f.write(t.marshal())
+            f.write(_st.pack("<Q", len(day_items)))
+            for mid, date in day_items:
+                f.write(_st.pack("<QI", mid, date))
+        os.rename(tmp, os.path.join(d, "tsid_cache.bin"))
+
+    def _load_caches(self):
+        import struct as _st
+        fp = os.path.join(self.path, "cache", "tsid_cache.bin")
+        try:
+            with open(fp, "rb") as f:
+                data = f.read()
+        except OSError:
+            return
+        if not data.startswith(self._CACHE_MAGIC):
+            return
+        try:
+            off = len(self._CACHE_MAGIC)
+            (n,) = _st.unpack_from("<Q", data, off)
+            off += 8
+            for _ in range(n):
+                a, p, ln = _st.unpack_from("<III", data, off)
+                off += 12
+                raw = data[off:off + ln]
+                off += ln
+                t = TSID.unmarshal(data[off:off + TSID.SIZE])
+                off += TSID.SIZE
+                self._tsid_cache[((a, p), raw)] = t
+            (n,) = _st.unpack_from("<Q", data, off)
+            off += 8
+            for _ in range(n):
+                mid, date = _st.unpack_from("<QI", data, off)
+                off += 12
+                self._day_cache.add((mid, date))
+        except (_st.error, IndexError):
+            # torn write: caches are an optimization, start cold
+            self._tsid_cache.clear()
+            self._day_cache.clear()
+
     @property
     def is_readonly(self) -> bool:
         return self._readonly
@@ -134,17 +201,27 @@ class Storage:
     # -- writes ------------------------------------------------------------
 
     def _resolve_tsid(self, mn: MetricName, raw: bytes,
-                      tenant=(0, 0)) -> TSID:
+                      tenant=(0, 0), limited=False) -> TSID | None:
+        """Resolve or create the TSID. With limited=True the cardinality
+        limiter is consulted BEFORE any index writes, so an over-budget
+        NEW series creates no index entries at all (storage.go:2136
+        ordering); returns None when the limiter rejects."""
         ck = (tenant, raw)
         tsid = self._tsid_cache.get(ck)
         if tsid is not None:
+            if limited and not self._cardinality_ok(tsid.metric_id):
+                return None
             return tsid
         self.slow_row_inserts += 1
         tsid = self.idb.get_tsid_by_name(raw, tenant)
         if tsid is None:
             tsid = generate_tsid(mn, self._mid_gen.next_id(), tenant)
+            if limited and not self._cardinality_ok(tsid.metric_id):
+                return None
             self.idb.create_indexes_for_metric(mn, tsid)
             self.new_series_created += 1
+        elif limited and not self._cardinality_ok(tsid.metric_id):
+            return None
         self._tsid_cache[ck] = tsid
         return tsid
 
@@ -172,6 +249,8 @@ class Storage:
                 date = ts // 86_400_000
                 mn = None
                 if tsid is not None:
+                    if not self._cardinality_ok(tsid.metric_id):
+                        continue
                     dk = (tsid.metric_id, date)
                     if dk in day_cache:
                         out.append((tsid, ts, val))
@@ -185,7 +264,10 @@ class Storage:
                         mn = MetricName.from_dict(labels)
                     else:
                         mn = MetricName.from_labels(labels)
-                    tsid = self._resolve_tsid(mn, mn.marshal(), tenant)
+                    tsid = self._resolve_tsid(mn, mn.marshal(), tenant,
+                                              limited=True)
+                    if tsid is None:
+                        continue  # over the cardinality budget
                     if key is not None:
                         if len(raw_cache) >= 1 << 21:
                             raw_cache.clear()
@@ -200,6 +282,17 @@ class Storage:
         self.table.add_rows(out)
         self.rows_added += len(out)
         return len(out)
+
+    def _cardinality_ok(self, metric_id: int) -> bool:
+        """registerSeriesCardinality (storage.go:2136): hourly/daily bloom
+        limiters drop rows for ids beyond the distinct-series budget."""
+        if self.hourly_limiter is not None and \
+                not self.hourly_limiter.add(metric_id):
+            return False
+        if self.daily_limiter is not None and \
+                not self.daily_limiter.add(metric_id):
+            return False
+        return True
 
     def register_metric_names(self, metric_names, tenant=(0, 0)) -> None:
         """Create index entries without samples (RegisterMetricNames,
@@ -362,7 +455,17 @@ class Storage:
         return int(time.time() * 1e3) - self.retention_ms
 
     def enforce_retention(self) -> int:
-        return self.table.enforce_retention(self.min_valid_ts)
+        n = self.table.enforce_retention(self.min_valid_ts)
+        dropped_months = self.idb.drop_months_before(self.min_valid_ts)
+        n += dropped_months
+        if dropped_months:
+            # a later backfill into a dropped date must recreate its
+            # per-day index entries
+            min_date = self.min_valid_ts // 86_400_000
+            with self._lock:
+                self._day_cache = {dk for dk in self._day_cache
+                                   if dk[1] >= min_date}
+        return n
 
     # -- snapshots ---------------------------------------------------------
 
@@ -376,6 +479,9 @@ class Storage:
         dst = os.path.join(self.snapshots_dir(), name)
         self.table.snapshot_to(os.path.join(dst, "data"))
         self.idb.table.create_snapshot_at(os.path.join(dst, "indexdb"))
+        for mname, t in self.idb.snapshot_month_tables():
+            t.create_snapshot_at(os.path.join(dst, "indexdb", "months",
+                                              mname))
         shutil.copy(os.path.join(self.path, "format.json"),
                     os.path.join(dst, "format.json"))
         logger.infof("storage: created snapshot %s", name)
@@ -397,7 +503,7 @@ class Storage:
     # -- metrics -----------------------------------------------------------
 
     def metrics(self) -> dict[str, float]:
-        return {
+        out = {
             "vm_rows_added_to_storage_total": self.rows_added,
             "vm_rows": self.table.rows,
             "vm_new_timeseries_created_total": self.new_series_created,
@@ -405,3 +511,7 @@ class Storage:
             "vm_timeseries_total": self.idb.all_series_count(),
             "vm_partitions": len(self.table.partition_names),
         }
+        for lim in (self.hourly_limiter, self.daily_limiter):
+            if lim is not None:
+                out.update(lim.metrics())
+        return out
