@@ -74,15 +74,7 @@ def parse_step(s: str, default_ms: int = 60_000) -> int:
     raise QueryError(f"cannot parse step {s!r}")
 
 
-def _fmt_value(v: float) -> str:
-    v = float(v)  # numpy scalars repr as np.float64(...) otherwise
-    if math.isnan(v):
-        return "NaN"
-    if math.isinf(v):
-        return "+Inf" if v > 0 else "-Inf"
-    if v == int(v) and abs(v) < 1e15:
-        return str(int(v))
-    return repr(v)
+from ..query.format_value import fmt_value as _fmt_value  # noqa: E402
 
 
 class ActiveQueries:

@@ -132,14 +132,24 @@ def a_quantile(m, phi: float):
     return out
 
 
-@_guard
+def _guard_matrix(fn):
+    # matrix-shaped results: all-NaN COLUMNS go NaN (per-axis, not per-row)
+    def wrapped(m, *args):
+        with np.errstate(all="ignore"):
+            out = np.asarray(fn(m, *args), dtype=np.float64)
+        out[:, _nan_all(m)] = nan
+        return out
+    return wrapped
+
+
+@_guard_matrix
 def a_zscore(m):
     mean = np.nanmean(m, axis=0)
     sd = np.nanstd(m, axis=0)
     return (m - mean) / np.where(sd > 0, sd, nan)   # returns matrix!
 
 
-@_guard
+@_guard_matrix
 def a_share(m):
     s = np.nansum(m, axis=0)
     return m / np.where(s != 0, s, nan)             # returns matrix!

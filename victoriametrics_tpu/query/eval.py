@@ -40,19 +40,6 @@ def filters_from_metric_expr(me: MetricExpr) -> list[TagFilter]:
     return out
 
 
-def is_scalar_expr(e: Expr) -> bool:
-    if isinstance(e, (NumberExpr, DurationExpr)):
-        return True
-    if isinstance(e, FuncExpr) and e.name in ("time", "now", "step", "start",
-                                              "end", "pi", "e", "scalar",
-                                              "rand", "rand_normal",
-                                              "rand_exponential"):
-        return True
-    if isinstance(e, BinaryOpExpr) and e.op in ARITH_OPS:
-        return is_scalar_expr(e.left) and is_scalar_expr(e.right)
-    return False
-
-
 def eval_expr(ec: EvalConfig, e: Expr) -> list[Timeseries]:
     if isinstance(e, NumberExpr):
         return [const_series(ec, e.value)]
@@ -89,9 +76,9 @@ def _eval_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
     for a in fe.args:
         if isinstance(a, StringExpr):
             args.append(a.value)
-        elif is_scalar_expr(a):
-            args.append(float(eval_expr(ec, a)[0].values[0]))
         else:
+            # everything else is a series list; scalar params unwrap via
+            # _scalar_arg (const scalars become 1-series constants)
             args.append(eval_expr(ec, a))
     out = tf(ec, args)
     if fe.keep_metric_names:
@@ -110,9 +97,8 @@ def _find_rollup_arg_idx(fe: FuncExpr) -> int:
     spec = GENERIC_FUNCS.get(fe.name)
     if spec is not None and spec[0] is not None:
         return spec[2]
-    if fe.name in ("quantiles_over_time",):
-        return len(fe.args) - 1
-    if fe.name in ("aggr_over_time",):
+    if fe.name in ("quantiles_over_time", "aggr_over_time",
+                   "count_values_over_time"):
         return len(fe.args) - 1
     return 0
 
@@ -136,6 +122,10 @@ def _eval_rollup_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
             continue
         if isinstance(a, StringExpr):
             extra.append(a.value)
+        elif isinstance(a, FuncExpr) and a.name == "union" and \
+                all(isinstance(x, StringExpr) for x in a.args):
+            # ("fn1", "fn2", ...) function-name lists (aggr_over_time)
+            extra.extend(x.value for x in a.args)
         else:
             extra.append(float(eval_expr(ec, a)[0].values[0]))
 
@@ -163,6 +153,14 @@ def _eval_rollup_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
                 ts.metric_name.sort_labels()
             out.extend(sub)
         return out
+
+    if fe.name == "absent_over_time":
+        rows = _eval_rollup_expr(ec, "absent_over_time", rarg, ())
+        return _aggregate_absent_over_time(ec, rarg.expr, rows)
+
+    if fe.name in ("count_values_over_time", "histogram_over_time"):
+        return _eval_multi_value_rollup(ec, fe.name, rarg, extra,
+                                        fe.keep_metric_names)
 
     if fe.name in MULTI_FUNCS:
         base = {"rollup": "default_rollup", "rollup_rate": "rate",
@@ -304,6 +302,82 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
             out_rows.append(vals)
         qt.donef("%d series", len(out_rows))
         return _finish_rollup(series, out_rows, keep_name)
+
+
+def _aggregate_absent_over_time(ec: EvalConfig, expr,
+                                rows: list[Timeseries]) -> list[Timeseries]:
+    """Collapse per-series absent windows into one series: 1 only where NO
+    matching series has a sample (eval.go:990 aggregateAbsentOverTime);
+    labels come from the selector's literal equality filters."""
+    labels = []
+    if isinstance(expr, MetricExpr):
+        for f in expr.label_filters:
+            if not f.is_negative and not f.is_regexp and \
+                    f.label != "__name__":
+                labels.append((f.label.encode(), f.value.encode()))
+    out = Timeseries(MetricName(b"", sorted(labels)),
+                     np.ones(ec.n_points, dtype=np.float64))
+    for ts in rows:
+        # a NaN in the per-series absent rollup means the series HAS a
+        # sample there — so the collapsed result must be NaN too
+        out.values[np.isnan(ts.values)] = nan
+    return [out]
+
+
+def _eval_multi_value_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
+                             extra: list,
+                             keep_name: bool = False) -> list[Timeseries]:
+    """count_values_over_time("label", m[d]) and histogram_over_time(m[d]):
+    one output series per distinct value / vmrange bucket per input series
+    (rollup.go:1490 newRollupCountValues, :1526 rollupHistogram)."""
+    if not isinstance(re_.expr, MetricExpr) or re_.needs_subquery():
+        raise QueryError(f"{func} requires a plain series selector")
+    if func == "count_values_over_time":
+        if not extra or not isinstance(extra[0], str):
+            raise QueryError("count_values_over_time needs a label name")
+        dst_label = extra[0].encode()
+    offset = re_.offset.value_ms(ec.step) if re_.offset is not None else 0
+    window = re_.window.value_ms(ec.step) if re_.window is not None else 0
+    from .format_value import fmt_value as _fmt_value
+    from .vmhistogram import histogram_counts
+    series, cfg, admission = _fetch_series_for_rollup(ec, func, re_, window,
+                                                      offset)
+    out_ts = cfg.out_timestamps()
+    T = out_ts.size
+    out: list[Timeseries] = []
+    with admission:
+        for sd in series:
+            lo = np.searchsorted(sd.timestamps, out_ts - cfg.lookback,
+                                 side="right")
+            hi = np.searchsorted(sd.timestamps, out_ts, side="right")
+            per_key: dict[bytes, np.ndarray] = {}
+            for j in range(T):
+                w = sd.values[lo[j]:hi[j]]
+                if w.size == 0:
+                    continue
+                if func == "count_values_over_time":
+                    vals, counts = np.unique(w, return_counts=True)
+                    items = [(_fmt_value(v).encode(), float(c))
+                             for v, c in zip(vals, counts)]
+                else:
+                    items = [(k.encode(), float(c))
+                             for k, c in histogram_counts(w).items()]
+                for key, c in items:
+                    row = per_key.get(key)
+                    if row is None:
+                        row = per_key[key] = np.full(T, nan)
+                    row[j] = c
+            label = (dst_label if func == "count_values_over_time"
+                     else b"vmrange")
+            group = sd.metric_name.metric_group if keep_name else b""
+            for key, row in sorted(per_key.items()):
+                mn = MetricName(group,
+                                [(k, v)
+                                 for k, v in sd.metric_name.labels
+                                 if k != label] + [(label, key)])
+                mn.sort_labels()
+                out.append(Timeseries(mn, row))
+    return out
 
 
 def _drop_stale_nans(func: str, series):
@@ -480,6 +554,10 @@ def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
             raise QueryError(f"{name} needs (k, q)")
         k = float(eval_expr(ec, ae.args[0])[0].values[0])
         series = eval_expr(ec, ae.args[1])
+        if np.isnan(k):
+            k = 0.0
+        elif np.isinf(k):
+            k = float(len(series))
         return _eval_topk_family(ec, ae, name, k, series)
     if name == "quantile":
         phi = float(eval_expr(ec, ae.args[0])[0].values[0])
@@ -529,11 +607,45 @@ def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
         series = eval_expr(ec, ae.args[0])
         return _eval_outliers_iqr(ec, ae, series)
 
+    if name == "histogram":
+        series = [ts for a in ae.args for ts in eval_expr(ec, a)]
+        return _eval_histogram_aggr(ec, ae, series)
+
     series = [ts for a in ae.args for ts in eval_expr(ec, a)]
     fn = SIMPLE.get(name)
     if fn is None:
         raise QueryError(f"unknown aggregate {name!r}")
     return _simple_aggr(ec, ae, series, fn)
+
+
+def _eval_histogram_aggr(ec, ae, series) -> list[Timeseries]:
+    """histogram(q): per-step VM histogram over each group's values, one
+    output series per non-zero vmrange bucket (aggr.go aggrFuncHistogram)."""
+    from .vmhistogram import vmrange_for
+    groups, names = _group_series(series, ae.grouping, ae.without)
+    out = []
+    for key, rows in groups.items():
+        m = np.vstack([ts.values for ts in rows])
+        per_range: dict[str, np.ndarray] = {}
+        T = m.shape[1]
+        for j in range(T):
+            col = m[:, j]
+            for v in col[~np.isnan(col)]:
+                r = vmrange_for(float(v))
+                if r is None:
+                    continue
+                row = per_range.get(r)
+                if row is None:
+                    row = per_range[r] = np.full(T, nan)
+                row[j] = (row[j] + 1.0) if not np.isnan(row[j]) else 1.0
+        base = names[key]
+        for r, vals in sorted(per_range.items()):
+            mn = MetricName(base.metric_group,
+                            list(base.labels) + [(b"vmrange", r.encode())])
+            mn.sort_labels()
+            out.append(Timeseries(mn, vals))
+    out.sort(key=lambda ts: ts.metric_name.marshal())
+    return out
 
 
 def _simple_aggr(ec, ae, series, fn) -> list[Timeseries]:
@@ -650,9 +762,21 @@ def _eval_outliers_iqr(ec, ae, series) -> list[Timeseries]:
 # Binary ops
 # ---------------------------------------------------------------------------
 
+def _is_const_scalar(e: Expr) -> bool:
+    """True scalars per PromQL: literals and scalar() — NOT time()/rand(),
+    which are instant vectors (so comparisons keep THEIR values)."""
+    if isinstance(e, (NumberExpr, DurationExpr)):
+        return True
+    if isinstance(e, FuncExpr) and e.name == "scalar":
+        return True
+    if isinstance(e, BinaryOpExpr) and e.op in ARITH_OPS:
+        return _is_const_scalar(e.left) and _is_const_scalar(e.right)
+    return False
+
+
 def _eval_binary(ec: EvalConfig, be: BinaryOpExpr) -> list[Timeseries]:
-    l_scalar = is_scalar_expr(be.left)
-    r_scalar = is_scalar_expr(be.right)
+    l_scalar = _is_const_scalar(be.left)
+    r_scalar = _is_const_scalar(be.right)
     left = eval_expr(ec, be.left)
     right = eval_expr(ec, be.right)
 

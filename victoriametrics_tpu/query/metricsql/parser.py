@@ -27,11 +27,14 @@ class ParseError(ValueError):
 
 # no leading ":" — it would swallow the subquery separator in "[1h:1m]"
 _IDENT_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_:.]*")
-_DURATION_RE = re.compile(r"(?:\d+(?:\.\d+)?(?:ms|s|m|h|d|w|y|i))+")
+_DURATION_RE = re.compile(
+    r"(?:\d+(?:\.\d+)?(?:[mM][sS]|[smhdwyiSMHDWYI]))+")
 # Numeric size suffixes are uppercase only (K/M/G/T, Ki/Mi/...): lowercase
 # m/s/h/d/w/y are duration units and must stay distinct ("5m" = 5 minutes).
 _NUMBER_RE = re.compile(
-    r"0[xX][0-9a-fA-F]+|(?:\d+(?:\.\d*)?|\.\d+)(?:[eE][+-]?\d+)?(?:[KMGT]i?)?")
+    r"0[xX][0-9a-fA-F]+|0[bB][01]+|0[oO][0-7]+"
+    r"|(?:\d[\d_]*(?:\.[\d_]*)?|\.\d[\d_]*)(?:[eE][+-]?\d+)?"
+    r"(?:[KMGT]i?B?)?")
 _OPS = ["==", "!=", ">=", "<=", "=~", "!~", "+", "-", "*", "/", "%", "^",
         ">", "<", "=", "(", ")", "{", "}", "[", "]", ",", "@", ":"]
 
@@ -112,8 +115,16 @@ def tokenize(q: str) -> list[Token]:
 
 
 def parse_number(text: str) -> float:
-    if text.lower().startswith("0x"):
+    text = text.replace("_", "")
+    low = text.lower()
+    if low.startswith("0x"):
         return float(int(text, 16))
+    if low.startswith("0b"):
+        return float(int(text, 2))
+    if low.startswith("0o"):
+        return float(int(text, 8))
+    if text.endswith("B"):
+        text = text[:-1]
     for suf in ("Ki", "Mi", "Gi", "Ti"):
         if text.endswith(suf):
             return float(text[:-2]) * _SUFFIX[suf]
@@ -123,12 +134,14 @@ def parse_number(text: str) -> float:
 
 
 def parse_duration_ms(text: str) -> tuple[float, bool]:
-    """Returns (ms, step_based)."""
-    if text.endswith("i") and not text.endswith("mi"):
+    """Returns (ms, step_based). Units are case-insensitive except the
+    number/size ambiguity handled by the lexer."""
+    if text.endswith(("i", "I")) and not text.lower().endswith("mi"):
         # step-based like 5i (possibly fractional)
         return float(text[:-1]), True
     total = 0.0
-    for num, unit in re.findall(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w|y)", text):
+    for num, unit in re.findall(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w|y)",
+                                text.lower()):
         total += float(num) * _DUR_UNIT_MS[unit]
     return total, False
 
@@ -163,7 +176,8 @@ class Parser:
     def __init__(self, q: str):
         self.toks = tokenize(q)
         self.i = 0
-        self.with_scopes: list[dict[str, tuple[list[str], Expr]]] = []
+        self.with_scopes: list[dict[str, tuple[list[str], Expr]]] = [
+            _default_with_scope()]
 
     # -- token helpers -------------------------------------------------
 
@@ -231,7 +245,8 @@ class Parser:
     def parse_unary(self) -> Expr:
         if self.at_op("-"):
             self.next()
-            arg = self.parse_unary()
+            # unary minus binds looser than ^: -4^0.5 == -(4^0.5)
+            arg = self.parse_expr(len(_BINOPS) - 1)
             if isinstance(arg, NumberExpr):
                 return NumberExpr(-arg.value)
             e = BinaryOpExpr(op="*", left=NumberExpr(-1.0), right=arg)
@@ -326,6 +341,16 @@ class Parser:
         if t.kind == "op" and t.text == "(":
             self.next()
             e = self.parse_expr(0)
+            if self.at_op(","):
+                # (e1, e2, ...) is union(e1, e2, ...) in MetricsQL
+                exprs = [e]
+                while self.at_op(","):
+                    self.next()
+                    if self.at_op(")"):
+                        break
+                    exprs.append(self.parse_expr(0))
+                self.expect_op(")")
+                return FuncExpr(name="union", args=exprs)
             self.expect_op(")")
             return e
         if t.kind == "op" and t.text == "{":
@@ -499,6 +524,30 @@ class Parser:
 def _clone(e: Expr) -> Expr:
     import copy
     return copy.deepcopy(e)
+
+
+_DEFAULT_WITH_SOURCES = {
+    # builtin WITH templates (metricsql parser.go:56-71)
+    "ru": (["freev", "maxv"],
+           "clamp_min(maxv - clamp_min(freev, 0), 0) / "
+           "clamp_min(maxv, 0) * 100"),
+    "ttf": (["freev"],
+            "smooth_exponential(clamp_max(clamp_max(-freev, 0) / "
+            "clamp_max(deriv_fast(freev), 0), 365*24*3600), "
+            "clamp_max(step()/300, 1))"),
+    "range_median": (["q"], "range_quantile(0.5, q)"),
+    "alias": (["q", "name"], 'label_set(q, "__name__", name)'),
+}
+_default_with: dict | None = None
+
+
+def _default_with_scope() -> dict:
+    global _default_with
+    if _default_with is None:
+        _default_with = {}  # set first: template bodies may reference others
+        for name, (params, src) in _DEFAULT_WITH_SOURCES.items():
+            _default_with[name] = (params, Parser(src).parse_expr(0))
+    return _default_with
 
 
 def _substitute(e: Expr, bindings: dict[str, Expr]) -> Expr:

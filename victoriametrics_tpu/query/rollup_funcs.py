@@ -295,6 +295,31 @@ def _hoeffding(w, phi):
     return float(w.mean()), float(bound)
 
 
+def _w_tlast_change(w, t, pv, pt, te, args):
+    # timestamp of the last value change (rollup.go:1669 rollupTlastChange)
+    if w.size == 0:
+        return nan
+    last = w[-1]
+    for i in range(w.size - 2, -1, -1):
+        if w[i] != last:
+            return float(t[i + 1]) / 1e3
+    if pv is None or pv != last:
+        return float(t[0]) / 1e3
+    return nan
+
+
+def _w_outlier_iqr(w, t, pv, pt, te, args):
+    # last value when outside [q25-1.5iqr, q75+1.5iqr] (rollup.go:1427)
+    if w.size < 2:
+        return nan
+    q25, q75 = np.quantile(w, [0.25, 0.75])
+    iqr = 1.5 * (q75 - q25)
+    v = float(w[-1])
+    if v > q75 + iqr or v < q25 - iqr:
+        return v
+    return nan
+
+
 # name -> (window_fn, n_extra_args, rollup_arg_index)
 GENERIC_FUNCS = {
     "quantile_over_time": (_w_quantile, 1, 1),
@@ -338,6 +363,8 @@ GENERIC_FUNCS = {
     "hoeffding_bound_lower": (_w_hoeffding_lower, 1, 1),
     "hoeffding_bound_upper": (_w_hoeffding_upper, 1, 1),
     "timestamp_with_name": (None, 0, 0),   # alias of timestamp, keeps name
+    "tlast_change_over_time": (_w_tlast_change, 0, 0),
+    "outlier_iqr_over_time": (_w_outlier_iqr, 0, 0),
 }
 
 # multi-output rollups: name -> list of (rollup_tag, oracle-or-generic name)
@@ -365,7 +392,9 @@ rollup_candlestick timestamp_with_name double_exponential_smoothing
 """.split())
 
 ROLLUP_FUNC_NAMES = (ORACLE_FUNCS | set(GENERIC_FUNCS) | set(MULTI_FUNCS)
-                     | {"aggr_over_time", "quantiles_over_time"})
+                     | {"aggr_over_time", "quantiles_over_time",
+                        "absent_over_time", "rate_prometheus",
+                        "count_values_over_time", "histogram_over_time"})
 
 
 def generic_rollup(fn, ts: np.ndarray, vals: np.ndarray, cfg: RollupConfig,
@@ -392,6 +421,16 @@ def rollup_series(func: str, ts: np.ndarray, vals: np.ndarray,
     """Single-series rollup dispatch: oracle fast path else generic."""
     if func == "timestamp_with_name":
         func = "timestamp"
+    if func == "absent_over_time":
+        # 1 for empty windows, NaN otherwise (rollup.go:1755 rollupAbsent;
+        # the cross-series collapse happens in eval)
+        cnt = rollup_np.rollup("count_over_time", ts, vals, cfg)
+        return np.where(np.isnan(cnt), 1.0, np.nan)
+    if func == "rate_prometheus":
+        # delta_prometheus / window_seconds (rollup.go:1946)
+        c = rollup_np.remove_counter_resets(vals)
+        d = generic_rollup(_w_delta_prometheus, ts, c, cfg, args)
+        return d / (cfg.lookback / 1e3)
     if func in ORACLE_FUNCS:
         return rollup_np.rollup(func, ts, vals, cfg)
     spec = GENERIC_FUNCS.get(func)

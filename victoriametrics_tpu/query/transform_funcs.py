@@ -551,7 +551,7 @@ def _group_buckets(series: list[Timeseries]):
 
 def tf_histogram_quantile(ec, args):
     phi_arg = args[0]
-    series = args[1]
+    series = _vmrange_to_le(list(args[1]))
     phis = None
     if isinstance(phi_arg, list):
         if len(phi_arg) == 1:
@@ -626,8 +626,9 @@ def tf_histogram_avg(ec, args):
 
 
 def tf_prometheus_buckets(ec, args):
-    # VM-native histograms are not produced by this engine; pass through.
-    return list(args[0])
+    """vmrange buckets (histogram_over_time / histogram()) -> cumulative
+    Prometheus le= buckets (transform.go:490)."""
+    return _vmrange_to_le(list(args[0]))
 
 
 def tf_buckets_limit(ec, args):
@@ -781,3 +782,338 @@ TRANSFORM_FUNCS.update({
 
 # args that must NOT be auto-evaluated to series (string positions are
 # detected at eval time via StringExpr)
+
+
+# -- vmrange histograms + round-2 parity tail ---------------------------------
+
+def _vmrange_to_le(series: list[Timeseries]) -> list[Timeseries]:
+    """Convert VM-native vmrange buckets into cumulative Prometheus le=
+    buckets (transform.go:494 vmrangeBucketsToLE); le-labeled series pass
+    through unchanged."""
+    out = []
+    groups: dict[bytes, tuple[MetricName, list]] = {}
+    for ts in series:
+        vr = ts.metric_name.get_label(b"vmrange")
+        if not vr:
+            if ts.metric_name.get_label(b"le"):
+                out.append(ts)
+            continue
+        sep = vr.find(b"...")
+        if sep < 0:
+            continue
+        try:
+            start = float(vr[:sep])
+            end = float(vr[sep + 3:])
+        except ValueError:
+            continue
+        mn = MetricName(ts.metric_name.metric_group,
+                        [(k, v) for k, v in ts.metric_name.labels
+                         if k not in (b"le", b"vmrange")])
+        key = mn.marshal()
+        if key not in groups:
+            groups[key] = (mn, [])
+        groups[key][1].append((start, end, vr[:sep], vr[sep + 3:], ts))
+    for key, (mn, xss) in groups.items():
+        xss.sort(key=lambda x: x[1])
+        T = xss[0][4].values.size
+
+        def bucket(le_bytes, vals):
+            b = MetricName(mn.metric_group,
+                           list(mn.labels) + [(b"le", le_bytes)])
+            b.sort_labels()
+            return Timeseries(b, vals)
+
+        new: list[tuple[float, bytes, np.ndarray]] = []
+        seen_le: dict[bytes, np.ndarray] = {}
+        prev_end = 0.0  # reference xsPrev zero-value: start==0 fills nothing
+        prev_end_s = None
+        nonzero = [x for x in xss
+                   if np.nansum(np.nan_to_num(x[4].values)) > 0]
+        for start, end, start_s, end_s, ts in nonzero:
+            if start != prev_end and start_s not in seen_le:
+                z = np.zeros(T)
+                seen_le[start_s] = z
+                new.append((start, start_s, z))
+            vals = np.nan_to_num(ts.values).copy()
+            prev = seen_le.get(end_s)
+            if prev is not None:
+                prev += vals
+            else:
+                seen_le[end_s] = vals
+                new.append((end, end_s, vals))
+            prev_end, prev_end_s = end, end_s
+        if new and prev_end_s is not None and np.isfinite(prev_end):
+            new.append((np.inf, b"+Inf", np.zeros(T)))
+        if not new:
+            continue
+        # cumulative counts across ascending le
+        acc = np.zeros(T)
+        for le, le_s, vals in new:
+            acc = acc + vals
+            out.append(bucket(le_s, acc.copy()))
+    return out
+
+
+def _le_share(le_req: float, les: np.ndarray, counts: np.ndarray,
+              j: int) -> tuple[float, float, float]:
+    """(q, lower, upper) share of counts at or below le_req
+    (transform.go:661)."""
+    if np.isnan(le_req) or les.size == 0:
+        return nan, nan, nan
+    if le_req < 0:
+        return 0.0, 0.0, 0.0
+    if np.isinf(le_req):
+        return 1.0, 1.0, 1.0
+    v_prev = 0.0
+    le_prev = 0.0
+    v_last = counts[-1, j]
+    if v_last == 0 or np.isnan(v_last):
+        return nan, nan, nan
+    for b in range(les.size):
+        v = counts[b, j]
+        le = les[b]
+        if le_req >= le:
+            v_prev, le_prev = v, le
+            continue
+        lower = v_prev / v_last
+        if np.isinf(le):
+            return lower, lower, 1.0
+        if le_prev == le_req:
+            return lower, lower, lower
+        upper = v / v_last
+        q = lower + (v - v_prev) / v_last * (le_req - le_prev) / (le - le_prev)
+        return q, lower, upper
+    return 1.0, 1.0, 1.0
+
+
+def _grouped_le_matrix(series):
+    """[(MetricName-without-le, les asc, counts [B, T] monotone)]"""
+    out = []
+    for key, (mn, buckets) in _group_buckets(_vmrange_to_le(series)).items():
+        buckets.sort(key=lambda b: b[0])
+        les = np.array([b[0] for b in buckets])
+        m = np.nan_to_num(np.vstack([b[1] for b in buckets]))
+        m = np.maximum.accumulate(m, axis=0)  # fix broken buckets
+        out.append((mn, les, m))
+    return out
+
+
+def tf_histogram_share(ec, args):
+    le_req = _scalar_arg(args, 0)
+    bounds_label = args[2].encode() if len(args) > 2 and \
+        isinstance(args[2], str) else None
+    out = []
+    for mn, les, m in _grouped_le_matrix(args[1]):
+        T = m.shape[1]
+        q = np.full(T, nan)
+        lo = np.full(T, nan)
+        hi = np.full(T, nan)
+        for j in range(T):
+            q[j], lo[j], hi[j] = _le_share(le_req, les, m, j)
+        out.append(Timeseries(mn, q))
+        if bounds_label:
+            for tag, vals in ((b"lower", lo), (b"upper", hi)):
+                b = MetricName(mn.metric_group,
+                               [(k, v) for k, v in mn.labels
+                                if k != bounds_label] +
+                               [(bounds_label, tag)])
+                b.sort_labels()
+                out.append(Timeseries(b, vals))
+    return out
+
+
+def tf_histogram_fraction(ec, args):
+    lower, upper = _scalar_arg(args, 0), _scalar_arg(args, 1)
+    if lower >= upper:
+        raise ValueError("histogram_fraction: lower le must be < upper le")
+    out = []
+    for mn, les, m in _grouped_le_matrix(args[2]):
+        T = m.shape[1]
+        vals = np.full(T, nan)
+        for j in range(T):
+            up, _, _ = _le_share(upper, les, m, j)
+            dn, _, _ = _le_share(lower, les, m, j)
+            vals[j] = up - dn
+        out.append(Timeseries(mn, vals))
+    return out
+
+
+def _hist_stdvar_cols(les: np.ndarray, m: np.ndarray) -> np.ndarray:
+    """stdvar over le-bucket midpoints (transform.go:900)."""
+    T = m.shape[1]
+    out = np.full(T, nan)
+    for j in range(T):
+        le_prev = v_prev = 0.0
+        s = s2 = wtot = 0.0
+        for b in range(les.size):
+            if np.isinf(les[b]):
+                continue
+            n = (les[b] + le_prev) / 2
+            w = m[b, j] - v_prev
+            s += n * w
+            s2 += n * n * w
+            wtot += w
+            le_prev, v_prev = les[b], m[b, j]
+        if wtot == 0:
+            continue
+        avg = s / wtot
+        out[j] = max(s2 / wtot - avg * avg, 0.0)
+    return out
+
+
+def tf_histogram_stdvar(ec, args):
+    return [Timeseries(mn, _hist_stdvar_cols(les, m))
+            for mn, les, m in _grouped_le_matrix(args[0])]
+
+
+def tf_histogram_stddev(ec, args):
+    return [Timeseries(mn, np.sqrt(_hist_stdvar_cols(les, m)))
+            for mn, les, m in _grouped_le_matrix(args[0])]
+
+
+def tf_histogram_quantiles(ec, args):
+    dst_label = _string_arg(args, 0).encode()
+    phis = [_scalar_arg(args, i) for i in range(1, len(args) - 1)]
+    series = args[-1]
+    out = []
+    for phi in phis:
+        rows = tf_histogram_quantile(ec, [phi, list(series)])
+        for ts in rows:
+            mn = MetricName(ts.metric_name.metric_group,
+                            [(k, v) for k, v in ts.metric_name.labels
+                             if k != dst_label] +
+                            [(dst_label, repr(phi).encode())])
+            mn.sort_labels()
+            out.append(Timeseries(mn, ts.values))
+    return out
+
+
+def tf_drop_empty_series(ec, args):
+    return [ts for ts in args[0] if not np.isnan(ts.values).all()]
+
+
+def tf_label_graphite_group(ec, args):
+    group_ids = [int(_scalar_arg(args, i)) for i in range(1, len(args))]
+    out = []
+    for ts in args[0]:
+        groups = ts.metric_name.metric_group.split(b".")
+        parts = [groups[g] if 0 <= g < len(groups) else b""
+                 for g in group_ids]
+        mn = MetricName(b".".join(parts), list(ts.metric_name.labels))
+        out.append(Timeseries(mn, ts.values))
+    return out
+
+
+def tf_range_zscore(ec, args):
+    out = []
+    with np.errstate(all="ignore"):
+        for ts in args[0]:
+            sd = np.nanstd(ts.values)
+            out.append(Timeseries(ts.metric_name,
+                                  (ts.values - np.nanmean(ts.values)) / sd))
+    return out
+
+
+def tf_range_trim_zscore(ec, args):
+    z = abs(_scalar_arg(args, 0))
+    out = []
+    with np.errstate(all="ignore"):
+        for ts in args[1]:
+            sd = np.nanstd(ts.values)
+            avg = np.nanmean(ts.values)
+            vals = np.where(np.abs(ts.values - avg) / sd > z, nan, ts.values)
+            out.append(Timeseries(ts.metric_name, vals))
+    return out
+
+
+def tf_range_trim_outliers(ec, args):
+    k = _scalar_arg(args, 0)
+    out = []
+    with np.errstate(all="ignore"):
+        for ts in args[1]:
+            med = np.nanmedian(ts.values)
+            mad = np.nanmedian(np.abs(ts.values - med))
+            vals = np.where(np.abs(ts.values - med) > k * mad, nan,
+                            ts.values)
+            out.append(Timeseries(ts.metric_name, vals))
+    return out
+
+
+def tf_range_trim_spikes(ec, args):
+    phi = _scalar_arg(args, 0) / 2.0
+    out = []
+    with np.errstate(all="ignore"):
+        for ts in args[1]:
+            ok = ts.values[~np.isnan(ts.values)]
+            if ok.size == 0:
+                out.append(ts)
+                continue
+            v_min, v_max = np.quantile(ok, [phi, 1 - phi])
+            vals = np.where((ts.values > v_max) | (ts.values < v_min), nan,
+                            ts.values)
+            out.append(Timeseries(ts.metric_name, vals))
+    return out
+
+
+def tf_range_mad(ec, args):
+    out = []
+    with np.errstate(all="ignore"):
+        for ts in args[0]:
+            med = np.nanmedian(ts.values)
+            mad = np.nanmedian(np.abs(ts.values - med))
+            out.append(Timeseries(ts.metric_name,
+                                  np.full(ts.values.size, mad)))
+    return out
+
+
+def tf_range_linear_regression(ec, args):
+    grid = None
+    out = []
+    for ts in args[0]:
+        if grid is None:
+            grid = ec.timestamps()
+        t_s = (grid - grid[0]) / 1e3
+        ok = ~np.isnan(ts.values)
+        if ok.sum() < 1:
+            out.append(ts)
+            continue
+        if ok.sum() == 1:
+            out.append(Timeseries(ts.metric_name,
+                                  np.full(grid.size, ts.values[ok][0])))
+            continue
+        k, v0 = np.polyfit(t_s[ok], ts.values[ok], 1)
+        out.append(Timeseries(ts.metric_name, v0 + k * t_s))
+    return out
+
+
+def tf_timezone_offset(ec, args):
+    import zoneinfo
+    import datetime as _dt
+    tz_name = _string_arg(args, 0)
+    try:
+        tz = zoneinfo.ZoneInfo(tz_name)
+    except (zoneinfo.ZoneInfoNotFoundError, ValueError) as e:
+        raise ValueError(f"cannot load timezone {tz_name!r}: {e}")
+    grid = ec.timestamps()
+    vals = np.array([
+        _dt.datetime.fromtimestamp(t / 1e3, tz).utcoffset().total_seconds()
+        for t in grid])
+    return [Timeseries(MetricName(b""), vals)]
+
+
+TRANSFORM_FUNCS.update({
+    "drop_empty_series": tf_drop_empty_series,
+    "histogram_share": tf_histogram_share,
+    "histogram_fraction": tf_histogram_fraction,
+    "histogram_stddev": tf_histogram_stddev,
+    "histogram_stdvar": tf_histogram_stdvar,
+    "histogram_quantiles": tf_histogram_quantiles,
+    "label_graphite_group": tf_label_graphite_group,
+    "range_zscore": tf_range_zscore,
+    "range_trim_zscore": tf_range_trim_zscore,
+    "range_trim_outliers": tf_range_trim_outliers,
+    "range_trim_spikes": tf_range_trim_spikes,
+    "range_mad": tf_range_mad,
+    "range_linear_regression": tf_range_linear_regression,
+    "timezone_offset": tf_timezone_offset,
+})

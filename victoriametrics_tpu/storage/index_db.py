@@ -82,7 +82,9 @@ class IndexDB:
 
     def __init__(self, path: str):
         self.path = path
-        self.table = Table(path)
+        # global table in its own subdir: the month tables live under
+        # months/ and must not be scanned as parts of the global table
+        self.table = Table(os.path.join(path, "global"))
         # per-month tables hold the per-day namespaces (5/6/7) so retention
         # can drop a month's index with its data partition (the reference's
         # per-partition indexDB, storage.go:1094); the global table keeps

@@ -81,7 +81,7 @@ class Storage:
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
         self._flusher.start()
 
-    FORMAT_VERSION = 2  # v2: 32-byte TSID with (accountID, projectID)
+    FORMAT_VERSION = 3  # v2: 32-byte tenant TSID; v3: indexdb/global layout
 
     def _check_format(self):
         """Refuse to open data directories written with an incompatible
@@ -478,7 +478,8 @@ class Storage:
         name = time.strftime("%Y%m%d%H%M%S") + f"-{int(time.time_ns()) % 10000:04d}"
         dst = os.path.join(self.snapshots_dir(), name)
         self.table.snapshot_to(os.path.join(dst, "data"))
-        self.idb.table.create_snapshot_at(os.path.join(dst, "indexdb"))
+        self.idb.table.create_snapshot_at(
+            os.path.join(dst, "indexdb", "global"))
         for mname, t in self.idb.snapshot_month_tables():
             t.create_snapshot_at(os.path.join(dst, "indexdb", "months",
                                               mname))
