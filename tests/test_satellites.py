@@ -344,3 +344,158 @@ class TestVMAlertTool:
             }]}))
         fails = run_test_file(str(test_bad))
         assert fails and "High" in fails[0]
+
+
+class TestVMAgentDepth:
+    """Round-2 scrape depth: staleness markers (scrapework.go:441),
+    stream-parse, SD providers, dynamic target sync."""
+
+    def _mk_exporter(self, lines_fn):
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+        srv = HTTPServer("127.0.0.1", 0)
+        srv.route("/metrics", lambda req: Response.text(lines_fn()))
+        srv.start()
+        return srv
+
+    def test_staleness_on_series_disappearance(self, tmp_path):
+        from victoriametrics_tpu.apps.vmagent import ScrapeTarget
+        from victoriametrics_tpu.ops import decimal as dec
+        state = {"n": 2}
+        srv = self._mk_exporter(
+            lambda: "".join(f'g{{i="{i}"}} 1\n' for i in range(state["n"])))
+        got = []
+        t = ScrapeTarget(f"http://127.0.0.1:{srv.port}/metrics",
+                         {"job": "j"}, 1000, 5, None, got.extend)
+        t._scrape_once()
+        assert sum(1 for r in got if r[0].get("__name__") == "g") == 2
+        got.clear()
+        state["n"] = 1  # one series vanishes
+        t._scrape_once()
+        stale = [r for r in got if r[0].get("__name__") == "g"
+                 and dec.is_stale_nan(np.array([r[2]])).any()]
+        assert len(stale) == 1 and stale[0][0]["i"] == "1"
+        # scrape failure: everything goes stale
+        got.clear()
+        srv.stop()
+        t._scrape_once()
+        stale = [r for r in got if dec.is_stale_nan(np.array([r[2]])).any()]
+        assert len(stale) == 1  # the remaining g series
+        up = [r for r in got if r[0].get("__name__") == "up"]
+        assert up and up[0][2] == 0.0
+        # stop() marks auto metrics too
+        got.clear()
+        t.stop(send_stale=True)
+        assert not got  # prev was cleared by the failed scrape
+
+    def test_stream_parse_large_body(self):
+        from victoriametrics_tpu.apps.vmagent import ScrapeTarget
+        body = "".join(f'big{{i="{i}"}} {i}\n' for i in range(60_000))
+        assert len(body) > ScrapeTarget.STREAM_PARSE_BYTES
+        srv = self._mk_exporter(lambda: body)
+        batches = []
+        t = ScrapeTarget(f"http://127.0.0.1:{srv.port}/metrics",
+                         {"job": "big"}, 1000, 30, None, batches.append)
+        t._scrape_once()
+        srv.stop()
+        n = sum(1 for b in batches for r in b
+                if r[0].get("__name__") == "big")
+        assert n == 60_000
+        assert len(batches) > 2  # streamed in chunks, not one blob
+
+    def test_kubernetes_and_consul_sd(self):
+        import json as _json
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+        from victoriametrics_tpu.ingest import discovery
+        srv = HTTPServer("127.0.0.1", 0)
+        pods = {"items": [{
+            "metadata": {"name": "p1", "namespace": "ns1",
+                         "labels": {"app": "web"}},
+            "spec": {"nodeName": "n1",
+                     "containers": [{"ports": [{"containerPort": 9100,
+                                                "name": "metrics"}]}]},
+            "status": {"podIP": "10.0.0.5", "phase": "Running"}}]}
+        srv.route("/api/v1/pods", lambda r: Response.json(pods))
+        srv.route("/v1/catalog/services",
+                  lambda r: Response.json({"web": ["prod"]}))
+        srv.route("/v1/health/service/web", lambda r: Response.json([
+            {"Node": {"Node": "c1", "Address": "10.1.1.1",
+                      "Datacenter": "dc1"},
+             "Service": {"Service": "web", "Address": "10.1.1.2",
+                         "Port": 8080, "Tags": ["prod"]}}]))
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        k8s = discovery.kubernetes_sd({"api_server": base, "role": "pod"})
+        assert k8s == [("10.0.0.5:9100", {
+            "__meta_kubernetes_namespace": "ns1",
+            "__meta_kubernetes_pod_name": "p1",
+            "__meta_kubernetes_pod_ip": "10.0.0.5",
+            "__meta_kubernetes_pod_node_name": "n1",
+            "__meta_kubernetes_pod_phase": "Running",
+            "__meta_kubernetes_pod_label_app": "web",
+            "__meta_kubernetes_pod_container_port_number": "9100",
+            "__meta_kubernetes_pod_container_port_name": "metrics"})]
+        consul = discovery.consul_sd({"server": f"127.0.0.1:{srv.port}"})
+        assert consul == [("10.1.1.2:8080", {
+            "__meta_consul_service": "web",
+            "__meta_consul_node": "c1",
+            "__meta_consul_address": "10.1.1.1",
+            "__meta_consul_service_address": "10.1.1.2",
+            "__meta_consul_service_port": "8080",
+            "__meta_consul_tags": ",prod,",
+            "__meta_consul_dc": "dc1"})]
+        srv.stop()
+
+    def test_ec2_sd_with_sigv4(self):
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+        from victoriametrics_tpu.ingest import discovery
+        seen = {}
+        xml = """<?xml version="1.0"?>
+<DescribeInstancesResponse xmlns="http://ec2.amazonaws.com/doc/2013-10-15/">
+ <reservationSet><item><instancesSet><item>
+  <instanceId>i-123</instanceId><instanceType>t3.micro</instanceType>
+  <privateIpAddress>172.1.2.3</privateIpAddress>
+  <instanceState><name>running</name></instanceState>
+  <placement><availabilityZone>us-east-1a</availabilityZone></placement>
+  <tagSet><item><key>Name</key><value>api</value></item></tagSet>
+ </item></instancesSet></item></reservationSet>
+</DescribeInstancesResponse>"""
+
+        def h(req):
+            seen["auth"] = req.headers.get("Authorization", "")
+            return Response(200, xml.encode(), "text/xml")
+        srv = HTTPServer("127.0.0.1", 0)
+        srv.route("/", h)
+        srv.start()
+        out = discovery.ec2_sd({
+            "endpoint": f"http://127.0.0.1:{srv.port}/",
+            "region": "us-east-1", "port": 9100,
+            "access_key": "AKID", "secret_key": "SECRET"})
+        srv.stop()
+        assert out == [("172.1.2.3:9100", {
+            "__meta_ec2_instance_id": "i-123",
+            "__meta_ec2_private_ip": "172.1.2.3",
+            "__meta_ec2_instance_type": "t3.micro",
+            "__meta_ec2_availability_zone": "us-east-1a",
+            "__meta_ec2_instance_state": "running",
+            "__meta_ec2_tag_Name": "api"})]
+        assert seen["auth"].startswith("AWS4-HMAC-SHA256 Credential=AKID/")
+
+    def test_sd_target_sync_relabel_and_removal(self, tmp_path):
+        from victoriametrics_tpu.apps.vmagent import VMAgent
+        cfg = {"scrape_configs": [{
+            "job_name": "k",
+            "static_configs": [{"targets": ["1.2.3.4:9100"]}],
+            "relabel_configs": [
+                {"source_labels": ["__address__"],
+                 "target_label": "box"}],
+        }]}
+        a = VMAgent(cfg, [], str(tmp_path))
+        assert len(a.targets) == 1
+        t = list(a.targets.values())[0]
+        assert t.labels == {"job": "k", "box": "1.2.3.4:9100",
+                            "instance": "1.2.3.4:9100"}
+        assert t.url == "http://1.2.3.4:9100/metrics"
+        # config reload removes the target
+        a.reload({"scrape_configs": []})
+        assert a.targets == {}
+        a.stop()

@@ -115,6 +115,9 @@ class RemoteWriteCtx:
 
 
 class ScrapeTarget:
+    STREAM_PARSE_BYTES = 1 << 20   # bodies above this parse incrementally
+    PUSH_BATCH = 5000
+
     def __init__(self, url: str, labels: dict, interval_s: float,
                  timeout_s: float, metric_relabel, push_fn):
         self.url = url
@@ -128,12 +131,34 @@ class ScrapeTarget:
         self.health = "unknown"
         self.last_error = ""
         self.last_scrape = 0.0
+        # series seen in the last successful scrape: key -> labels, used to
+        # emit Prometheus staleness markers when they disappear
+        # (scrapework.go:441 sendStaleSeries)
+        self._prev: dict[int, dict] = {}
 
     def start(self):
         self._thread.start()
 
-    def stop(self):
+    def stop(self, send_stale: bool = True):
         self._stop.set()
+        # let an in-flight scrape finish first: samples pushed AFTER the
+        # stale markers would resurrect the series forever
+        if self._thread.is_alive() and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=self.timeout_s + 2)
+        if send_stale and self._prev:
+            # target removed (SD change / shutdown): mark every tracked
+            # series stale so queries stop extending it
+            now_ms = int(time.time() * 1000)
+            from ..ops.decimal import STALE_NAN
+            rows = [(labels, now_ms, STALE_NAN)
+                    for labels in self._prev.values()]
+            for name in ("up", "scrape_duration_seconds",
+                         "scrape_samples_scraped"):
+                rows.append(({"__name__": name, **self.labels}, now_ms,
+                             STALE_NAN))
+            self._prev = {}
+            self.push_fn(rows)
 
     def _loop(self):
         # jitter the start so targets spread over the interval
@@ -146,33 +171,72 @@ class ScrapeTarget:
             if self._stop.wait(max(self.interval_s - elapsed, 0.1)):
                 return
 
+    @staticmethod
+    def _series_key(labels: dict) -> int:
+        return hash(tuple(sorted(labels.items())))
+
     def _scrape_once(self):
+        from ..ops.decimal import STALE_NAN
         now_ms = int(time.time() * 1000)
         rows = []
+        cur: dict[int, dict] = {}
         up = 1.0
+        samples = 0
         t0 = time.perf_counter()
-        try:
-            with urllib.request.urlopen(self.url, timeout=self.timeout_s) as r:
-                text = r.read().decode("utf-8", "replace")
-            samples = 0
+
+        def handle_line_block(text):
+            nonlocal samples, rows
             for row in parse_prometheus(text, now_ms):
                 labels = dict(row.labels)
                 labels.update(self.labels)
                 if self.metric_relabel is not None:
                     labels = self.metric_relabel.apply(labels)
-                    if labels is None:
+                    if not labels:
                         continue
+                cur[self._series_key(labels)] = labels
                 rows.append((labels, row.timestamp or now_ms, row.value))
                 samples += 1
+                if len(rows) >= self.PUSH_BATCH:
+                    self.push_fn(rows)
+                    rows = []
+
+        try:
+            with urllib.request.urlopen(self.url,
+                                        timeout=self.timeout_s) as r:
+                # stream-parse unconditionally: bounded memory regardless of
+                # Content-Length (chunked responses included;
+                # scrapework.go streamParse)
+                tail = b""
+                while True:
+                    chunk = r.read(256 << 10)
+                    if not chunk:
+                        break
+                    buf = tail + chunk
+                    cut = buf.rfind(b"\n")
+                    if cut < 0:
+                        tail = buf
+                        continue
+                    handle_line_block(
+                        buf[:cut + 1].decode("utf-8", "replace"))
+                    tail = buf[cut + 1:]
+                if tail:
+                    handle_line_block(tail.decode("utf-8", "replace"))
             self.health = "up"
             self.last_error = ""
         except OSError as e:
             up = 0.0
             samples = 0
+            rows = []  # drop the un-pushed partial batch
             self.health = "down"
             self.last_error = str(e)
+            cur = {}  # scrape failed: every previous series goes stale
         dur = time.perf_counter() - t0
         self.last_scrape = time.time()
+        # staleness markers for series that vanished since the last scrape
+        for key, labels in self._prev.items():
+            if key not in cur:
+                rows.append((labels, now_ms, STALE_NAN))
+        self._prev = cur
         auto = [("up", up), ("scrape_duration_seconds", dur),
                 ("scrape_samples_scraped", float(samples))]
         for name, v in auto:
@@ -181,18 +245,33 @@ class ScrapeTarget:
 
 
 class VMAgent:
+    SD_REFRESH_S = 30.0  # -promscrape.*SDCheckInterval analog
+
     def __init__(self, scrape_config: dict, remote_urls: list[str],
-                 tmp_dir: str, global_relabel=None):
+                 tmp_dir: str, global_relabel=None, sd_refresh_s=None):
         self.rw_ctxs = [
             RemoteWriteCtx(url, os.path.join(tmp_dir, f"q{i}"))
             for i, url in enumerate(remote_urls)]
         self.global_relabel = global_relabel
-        self.targets: list[ScrapeTarget] = []
-        self._load_targets(scrape_config or {})
+        self.cfg = scrape_config or {}
+        self.sd_refresh_s = sd_refresh_s or self.SD_REFRESH_S
+        self.targets: dict[tuple, ScrapeTarget] = {}
+        self._started = False
+        self._stop = threading.Event()
+        self._sync_lock = threading.Lock()
+        self._sd_thread = threading.Thread(target=self._sd_loop, daemon=True)
+        self._sync_targets()
 
-    def _load_targets(self, cfg: dict):
+    def _resolve_specs(self) -> dict[tuple, tuple]:
+        """Evaluate every SD provider: spec_key -> (url, labels, interval,
+        timeout, metric_relabel). Meta labels flow through relabel_configs,
+        then __-prefixed labels are dropped (promscrape/config.go
+        mergeLabels semantics)."""
+        from ..ingest.discovery import discover_targets
+        cfg = self.cfg
         g = cfg.get("global", {})
         default_interval = _dur_s(g.get("scrape_interval", "1m"))
+        specs: dict[tuple, tuple] = {}
         for sc in cfg.get("scrape_configs", []):
             job = sc.get("job_name", "")
             interval = _dur_s(sc.get("scrape_interval")) or default_interval
@@ -201,6 +280,8 @@ class VMAgent:
             scheme = sc.get("scheme", "http")
             mrc = sc.get("metric_relabel_configs")
             metric_relabel = parse_relabel_configs(mrc) if mrc else None
+            rc = sc.get("relabel_configs")
+            relabel = parse_relabel_configs(rc) if rc else None
             target_specs = []
             for stc in sc.get("static_configs", []):
                 for t in stc.get("targets", []):
@@ -215,17 +296,53 @@ class VMAgent:
                                     (t, entry.get("labels", {})))
                     except (OSError, ValueError) as e:
                         logger.errorf("file_sd %s: %s", fn, e)
+            target_specs.extend(discover_targets(sc))
             for addr, extra in target_specs:
-                labels = {"job": job, "instance": addr, **extra}
-                rc = sc.get("relabel_configs")
-                if rc:
-                    labels = parse_relabel_configs(rc).apply(labels)
-                    if labels is None:
+                labels = {"job": job, "__address__": addr,
+                          "__metrics_path__": path, "__scheme__": scheme,
+                          **extra}
+                if relabel is not None:
+                    labels = relabel.apply(labels)
+                    if not labels:
                         continue
-                url = f"{scheme}://{addr}{path}"
-                self.targets.append(ScrapeTarget(
-                    url, labels, interval, timeout, metric_relabel,
-                    self.push))
+                addr = labels.get("__address__", addr)
+                path_f = labels.get("__metrics_path__", path)
+                scheme_f = labels.get("__scheme__", scheme)
+                labels.setdefault("instance", addr)
+                final = {k: v for k, v in labels.items()
+                         if not k.startswith("__")}
+                url = f"{scheme_f}://{addr}{path_f}"
+                # scrape settings are part of the identity: a reload that
+                # changes interval/timeout/relabel must replace the target
+                key = (url, tuple(sorted(final.items())), interval, timeout,
+                       json.dumps(mrc, sort_keys=True))
+                specs[key] = (url, final, interval, timeout, metric_relabel)
+        return specs
+
+    def _sync_targets(self):
+        """Diff discovered specs against running scrapers; removed targets
+        stop WITH staleness markers. Serialized: SIGHUP, /-/reload, and the
+        SD refresh thread may all call this concurrently."""
+        with self._sync_lock:
+            specs = self._resolve_specs()
+            for key in list(self.targets):
+                if key not in specs:
+                    self.targets.pop(key).stop(send_stale=True)
+            for key, (url, labels, interval, timeout, mrc) in specs.items():
+                if key in self.targets:
+                    continue
+                t = ScrapeTarget(url, labels, interval, timeout, mrc,
+                                 self.push)
+                self.targets[key] = t
+                if self._started:
+                    t.start()
+
+    def _sd_loop(self):
+        while not self._stop.wait(self.sd_refresh_s):
+            try:
+                self._sync_targets()
+            except Exception as e:  # pragma: no cover
+                logger.errorf("vmagent sd refresh: %s", e)
 
     def push(self, rows: list):
         if self.global_relabel is not None:
@@ -239,21 +356,29 @@ class VMAgent:
             ctx.push(rows)
 
     def start(self):
+        self._started = True
         for ctx in self.rw_ctxs:
             ctx.start()
-        for t in self.targets:
+        for t in self.targets.values():
             t.start()
+        self._sd_thread.start()
 
     def stop(self):
-        for t in self.targets:
-            t.stop()
+        self._stop.set()
+        for t in self.targets.values():
+            t.stop(send_stale=True)
         for ctx in self.rw_ctxs:
             ctx.stop()
+
+    def reload(self, scrape_config: dict):
+        """Swap the scrape config in place (SIGHUP hot-reload)."""
+        self.cfg = scrape_config or {}
+        self._sync_targets()
 
     def target_status(self) -> list[dict]:
         return [{"url": t.url, "labels": t.labels, "health": t.health,
                  "lastError": t.last_error, "lastScrape": t.last_scrape}
-                for t in self.targets]
+                for t in self.targets.values()]
 
 
 def _dur_s(s) -> float:
@@ -339,6 +464,28 @@ def main(argv=None):
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    def _reload(*_):
+        # SIGHUP hot-reload of -promscrape.config (the reference re-reads
+        # scrape configs on SIGHUP and on /-/reload)
+        if not args.scrape_config:
+            return
+        try:
+            import yaml
+            cfg = yaml.safe_load(open(args.scrape_config).read()) or {}
+            agent.reload(cfg)
+            logger.infof("vmagent: reloaded %s (%d targets)",
+                         args.scrape_config, len(agent.targets))
+        except Exception as e:
+            logger.errorf("vmagent: reload failed, keeping old config: %s",
+                          e)
+    signal.signal(signal.SIGHUP, _reload)
+    from ..httpapi.server import Response as _Resp
+
+    def h_reload(req):
+        _reload()
+        return _Resp.text("OK")
+    srv.route("/-/reload", h_reload)
     try:
         while not stop.wait(1.0):
             pass

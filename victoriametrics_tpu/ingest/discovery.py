@@ -1,0 +1,293 @@
+"""Service discovery providers (reference lib/promscrape/discovery/):
+kubernetes (pod/node/service/endpoints roles), consul, ec2, plus the
+static/file providers handled inline by vmagent.
+
+Each provider resolves a scrape config section to [(address, labels)]
+where labels carry the provider's __meta_* set (the subset most relabel
+configs use; reference emits a wider set). Providers are plain HTTP
+clients so tests can point them at fake API servers (the reference tests
+do the same via custom endpoints).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from ..utils import logger
+
+
+def _get_json(url: str, headers: dict | None = None, timeout: float = 10.0):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+# -- kubernetes (discovery/kubernetes/) --------------------------------------
+
+def kubernetes_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Supported roles: pod, node, service, endpoints."""
+    api = cfg.get("api_server", "http://127.0.0.1:8001").rstrip("/")
+    role = cfg.get("role", "pod")
+    headers = {}
+    token = cfg.get("bearer_token", "")
+    token_file = cfg.get("bearer_token_file", "")
+    if token_file:
+        try:
+            token = open(token_file).read().strip()
+        except OSError as e:
+            logger.errorf("kubernetes_sd: cannot read token: %s", e)
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    ns = cfg.get("namespaces", {}).get("names", [])
+    out: list[tuple[str, dict]] = []
+
+    def paths(kind):
+        if ns:
+            return [f"{api}/api/v1/namespaces/{n}/{kind}" for n in ns]
+        return [f"{api}/api/v1/{kind}"]
+
+    try:
+        if role == "pod":
+            for url in paths("pods"):
+                for item in _get_json(url, headers).get("items", []):
+                    meta = item.get("metadata", {})
+                    status = item.get("status", {})
+                    ip = status.get("podIP")
+                    if not ip:
+                        continue
+                    base = {
+                        "__meta_kubernetes_namespace":
+                            meta.get("namespace", ""),
+                        "__meta_kubernetes_pod_name": meta.get("name", ""),
+                        "__meta_kubernetes_pod_ip": ip,
+                        "__meta_kubernetes_pod_node_name":
+                            item.get("spec", {}).get("nodeName", ""),
+                        "__meta_kubernetes_pod_phase":
+                            status.get("phase", ""),
+                    }
+                    for k, v in (meta.get("labels") or {}).items():
+                        base["__meta_kubernetes_pod_label_" +
+                             _sanitize(k)] = v
+                    ports = [p for c in item.get("spec", {}).get(
+                        "containers", []) for p in c.get("ports", [])]
+                    if not ports:
+                        out.append((ip, dict(base)))
+                    for p in ports:
+                        labels = dict(base)
+                        labels["__meta_kubernetes_pod_container_port_number"] \
+                            = str(p.get("containerPort", ""))
+                        if p.get("name"):
+                            labels["__meta_kubernetes_pod_container_port_name"] \
+                                = p["name"]
+                        out.append((f"{ip}:{p.get('containerPort')}", labels))
+        elif role == "node":
+            for item in _get_json(f"{api}/api/v1/nodes",
+                                  headers).get("items", []):
+                meta = item.get("metadata", {})
+                addrs = {a.get("type"): a.get("address") for a in
+                         item.get("status", {}).get("addresses", [])}
+                ip = addrs.get("InternalIP") or addrs.get("Hostname")
+                if not ip:
+                    continue
+                labels = {"__meta_kubernetes_node_name":
+                          meta.get("name", "")}
+                for k, v in (meta.get("labels") or {}).items():
+                    labels["__meta_kubernetes_node_label_" +
+                           _sanitize(k)] = v
+                out.append((f"{ip}:10250", labels))
+        elif role in ("service", "endpoints"):
+            kind = "services" if role == "service" else "endpoints"
+            for url in paths(kind):
+                for item in _get_json(url, headers).get("items", []):
+                    meta = item.get("metadata", {})
+                    base = {
+                        "__meta_kubernetes_namespace":
+                            meta.get("namespace", ""),
+                        f"__meta_kubernetes_{role}_name":
+                            meta.get("name", ""),
+                    }
+                    if role == "service":
+                        ip = item.get("spec", {}).get("clusterIP")
+                        for p in item.get("spec", {}).get("ports", []):
+                            labels = dict(base)
+                            labels["__meta_kubernetes_service_port_number"] \
+                                = str(p.get("port", ""))
+                            out.append((f"{ip}:{p.get('port')}", labels))
+                    else:
+                        for ss in item.get("subsets", []):
+                            for a in ss.get("addresses", []):
+                                for p in ss.get("ports", []):
+                                    out.append((
+                                        f"{a.get('ip')}:{p.get('port')}",
+                                        dict(base)))
+        else:
+            logger.errorf("kubernetes_sd: unsupported role %r", role)
+    except (OSError, ValueError) as e:
+        logger.errorf("kubernetes_sd %s role=%s: %s", api, role, e)
+    return out
+
+
+def _sanitize(k: str) -> str:
+    return "".join(c if c.isalnum() else "_" for c in k)
+
+
+# -- consul (discovery/consul/) ----------------------------------------------
+
+def consul_sd(cfg: dict) -> list[tuple[str, dict]]:
+    server = cfg.get("server", "127.0.0.1:8500")
+    scheme = cfg.get("scheme", "http")
+    base = f"{scheme}://{server}/v1"
+    headers = {}
+    if cfg.get("token"):
+        headers["X-Consul-Token"] = cfg["token"]
+    out: list[tuple[str, dict]] = []
+    try:
+        services = cfg.get("services") or list(
+            _get_json(f"{base}/catalog/services", headers))
+        for svc in services:
+            for e in _get_json(f"{base}/health/service/{svc}", headers):
+                node = e.get("Node", {})
+                s = e.get("Service", {})
+                addr = s.get("Address") or node.get("Address", "")
+                port = s.get("Port", 0)
+                labels = {
+                    "__meta_consul_service": s.get("Service", svc),
+                    "__meta_consul_node": node.get("Node", ""),
+                    "__meta_consul_address": node.get("Address", ""),
+                    "__meta_consul_service_address": addr,
+                    "__meta_consul_service_port": str(port),
+                    "__meta_consul_tags":
+                        "," + ",".join(s.get("Tags") or []) + ",",
+                    "__meta_consul_dc": node.get("Datacenter", ""),
+                }
+                out.append((f"{addr}:{port}", labels))
+    except (OSError, ValueError) as e:
+        logger.errorf("consul_sd %s: %s", server, e)
+    return out
+
+
+# -- ec2 (discovery/ec2/) -----------------------------------------------------
+
+def ec2_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """DescribeInstances with SigV4 signing; `endpoint` override makes it
+    testable against a fake server (the reference supports the same)."""
+    region = cfg.get("region", "us-east-1")
+    endpoint = cfg.get("endpoint",
+                       f"https://ec2.{region}.amazonaws.com")
+    port = int(cfg.get("port", 80))
+    access_key = cfg.get("access_key", "")
+    secret_key = cfg.get("secret_key", "")
+    body = "Action=DescribeInstances&Version=2013-10-15"
+    headers = {"Content-Type":
+               "application/x-www-form-urlencoded; charset=utf-8"}
+    if access_key and secret_key:
+        headers.update(_sigv4_headers(
+            "POST", endpoint, body, region, "ec2", access_key, secret_key))
+    out: list[tuple[str, dict]] = []
+    try:
+        req = urllib.request.Request(endpoint, data=body.encode(),
+                                     headers=headers, method="POST")
+        with urllib.request.urlopen(req, timeout=15) as r:
+            xml = r.read().decode("utf-8", "replace")
+        for inst in _parse_ec2_instances(xml):
+            ip = inst.get("privateIpAddress")
+            if not ip:
+                continue
+            labels = {
+                "__meta_ec2_instance_id": inst.get("instanceId", ""),
+                "__meta_ec2_private_ip": ip,
+                "__meta_ec2_instance_type": inst.get("instanceType", ""),
+                "__meta_ec2_availability_zone":
+                    inst.get("availabilityZone", ""),
+                "__meta_ec2_instance_state": inst.get("state", ""),
+            }
+            if inst.get("publicIpAddress"):
+                labels["__meta_ec2_public_ip"] = inst["publicIpAddress"]
+            for k, v in inst.get("tags", {}).items():
+                labels["__meta_ec2_tag_" + _sanitize(k)] = v
+            out.append((f"{ip}:{port}", labels))
+    except (OSError, ValueError) as e:
+        logger.errorf("ec2_sd %s: %s", endpoint, e)
+    return out
+
+
+def _parse_ec2_instances(xml: str) -> list[dict]:
+    import xml.etree.ElementTree as ET
+    root = ET.fromstring(xml)
+    ns = ""
+    if root.tag.startswith("{"):
+        ns = root.tag[:root.tag.index("}") + 1]
+    out = []
+    for item in root.iter(f"{ns}instancesSet"):
+        for inst in item.findall(f"{ns}item"):
+            d = {}
+            for field in ("instanceId", "instanceType",
+                          "privateIpAddress", "publicIpAddress"):
+                el = inst.find(f"{ns}{field}")
+                if el is not None and el.text:
+                    d[field] = el.text
+            st = inst.find(f"{ns}instanceState/{ns}name")
+            if st is not None:
+                d["state"] = st.text
+            az = inst.find(f"{ns}placement/{ns}availabilityZone")
+            if az is not None:
+                d["availabilityZone"] = az.text
+            tags = {}
+            for t in inst.findall(f"{ns}tagSet/{ns}item"):
+                k = t.find(f"{ns}key")
+                v = t.find(f"{ns}value")
+                if k is not None and v is not None:
+                    tags[k.text] = v.text or ""
+            d["tags"] = tags
+            out.append(d)
+    return out
+
+
+def _sigv4_headers(method: str, url: str, body: str, region: str,
+                   service: str, access_key: str, secret_key: str) -> dict:
+    """Minimal AWS Signature Version 4 (lib/awsapi/sign.go analog)."""
+    import datetime
+    import hashlib
+    import hmac
+    from urllib.parse import urlparse
+    u = urlparse(url)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(body.encode()).hexdigest()
+    canonical_headers = f"host:{u.netloc}\nx-amz-date:{amz_date}\n"
+    signed_headers = "host;x-amz-date"
+    canonical = "\n".join([method, u.path or "/", u.query,
+                           canonical_headers, signed_headers, payload_hash])
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    to_sign = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def _hmac(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _hmac(("AWS4" + secret_key).encode(), datestamp)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    auth = (f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={sig}")
+    return {"Authorization": auth, "X-Amz-Date": amz_date}
+
+
+PROVIDERS = {
+    "kubernetes_sd_configs": kubernetes_sd,
+    "consul_sd_configs": consul_sd,
+    "ec2_sd_configs": ec2_sd,
+}
+
+
+def discover_targets(sc: dict) -> list[tuple[str, dict]]:
+    """All dynamic-provider targets for one scrape config section."""
+    out: list[tuple[str, dict]] = []
+    for key, fn in PROVIDERS.items():
+        for cfg in sc.get(key, []) or []:
+            out.extend(fn(cfg))
+    return out
