@@ -1,0 +1,142 @@
+"""Graphite query API tests (reference app/vmselect/graphite/*_test.go
+behaviors: find globbing, tags API, render with function pipeline)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tests.apptest_helpers import Client
+
+T0 = 1_753_700_000_000
+
+
+@pytest.fixture()
+def app(tmp_path):
+    from victoriametrics_tpu.apps.vmsingle import build, parse_flags
+    args = parse_flags([f"-storageDataPath={tmp_path}/data",
+                        "-httpListenAddr=127.0.0.1:0"])
+    storage, srv, api = build(args)
+    srv.start()
+    # graphite-style series: dotted names + one tagged series
+    rows = []
+    for host in ("web1", "web2"):
+        for j in range(30):
+            rows.append(({"__name__": f"servers.{host}.cpu.load"},
+                         T0 + j * 60_000, float(j)))
+    for j in range(30):
+        rows.append(({"__name__": "servers.web1.mem.used",
+                      "dc": "east"}, T0 + j * 60_000, 100.0 + j))
+    storage.add_rows(rows)
+    yield Client(srv.port)
+    srv.stop()
+    storage.close()
+
+
+class TestMetricsFind:
+    def test_top_level(self, app):
+        code, body = app.get("/metrics/find", query="*")
+        assert code == 200
+        nodes = json.loads(body)
+        assert nodes == [{"text": "servers", "id": "servers", "leaf": 0,
+                          "expandable": 1, "allowChildren": 1,
+                          "context": {}}]
+
+    def test_glob_level(self, app):
+        code, body = app.get("/metrics/find", query="servers.*")
+        names = [n["text"] for n in json.loads(body)]
+        assert names == ["web1", "web2"]
+
+    def test_leaf(self, app):
+        code, body = app.get("/metrics/find", query="servers.web1.cpu.*")
+        nodes = json.loads(body)
+        assert nodes[0]["leaf"] == 1 and nodes[0]["id"] == \
+            "servers.web1.cpu.load"
+
+    def test_braces(self, app):
+        code, body = app.get("/metrics/find", query="servers.{web1}.*")
+        names = [n["text"] for n in json.loads(body)]
+        assert names == ["cpu", "mem"]
+
+    def test_expand(self, app):
+        code, body = app.get("/metrics/expand", query="servers.*.cpu")
+        assert json.loads(body)["results"] == [
+            "servers.web1.cpu", "servers.web2.cpu"]
+
+
+class TestTagsAPI:
+    def test_tags_list(self, app):
+        code, body = app.get("/tags")
+        tags = [t["tag"] for t in json.loads(body)]
+        assert "name" in tags and "dc" in tags
+
+    def test_tag_values(self, app):
+        code, body = app.get("/tags/dc")
+        d = json.loads(body)
+        assert d["tag"] == "dc"
+        assert [v["value"] for v in d["values"]] == ["east"]
+
+    def test_autocomplete(self, app):
+        code, body = app.get("/tags/autoComplete/tags", tagPrefix="d")
+        assert json.loads(body) == ["dc"]
+        code, body = app.get("/tags/autoComplete/values", tag="dc",
+                             valuePrefix="e")
+        assert json.loads(body) == ["east"]
+
+    def test_find_series(self, app):
+        code, body = app.get("/tags/findSeries", expr="dc=east")
+        assert json.loads(body) == ["servers.web1.mem.used;dc=east"]
+
+
+class TestRender:
+    def _render(self, app, target, **kw):
+        params = {"target": target, "from": str((T0 - 60_000) // 1000),
+                  "until": str((T0 + 29 * 60_000) // 1000),
+                  "format": "json", **kw}
+        code, body = app.get("/render", **params)
+        assert code == 200, body
+        return json.loads(body)
+
+    def test_plain_path_glob(self, app):
+        out = self._render(app, "servers.*.cpu.load")
+        assert {s["target"] for s in out} == {
+            "servers.web1.cpu.load", "servers.web2.cpu.load"}
+        s0 = out[0]
+        vals = [p[0] for p in s0["datapoints"] if p[0] is not None]
+        assert vals[:3] == [0.0, 1.0, 2.0]
+        # datapoint timestamps are epoch seconds
+        assert s0["datapoints"][0][1] * 1000 >= T0 - 120_000
+
+    def test_sum_and_alias(self, app):
+        out = self._render(app, 'alias(sumSeries(servers.*.cpu.load), "tot")')
+        assert len(out) == 1 and out[0]["target"] == "tot"
+        vals = [p[0] for p in out[0]["datapoints"] if p[0] is not None]
+        assert vals[:3] == [0.0, 2.0, 4.0]  # two series summed
+
+    def test_scale_and_nnderivative(self, app):
+        out = self._render(
+            app, "scale(nonNegativeDerivative(servers.web1.cpu.load), 2)")
+        vals = [p[0] for p in out[0]["datapoints"] if p[0] is not None]
+        assert all(v == 2.0 for v in vals)  # slope 1/min * 2
+
+    def test_alias_by_node_and_group(self, app):
+        out = self._render(app, "aliasByNode(servers.*.cpu.load, 1)")
+        assert {s["target"] for s in out} == {"web1", "web2"}
+        out = self._render(
+            app, 'groupByNode(servers.*.cpu.load, 1, "sum")')
+        assert {s["target"] for s in out} == {"web1", "web2"}
+
+    def test_series_by_tag(self, app):
+        out = self._render(app, "seriesByTag('dc=east')")
+        assert len(out) == 1
+        assert out[0]["target"] == "servers.web1.mem.used"
+        assert out[0]["tags"]["dc"] == "east"
+
+    def test_max_data_points(self, app):
+        out = self._render(app, "servers.web1.cpu.load", maxDataPoints="5")
+        assert len(out[0]["datapoints"]) <= 7  # ceil-rounded grid ends
+
+    def test_bad_target(self, app):
+        code, body = app.get("/render", target="nosuchfunc(", **{
+            "from": "-1h"})
+        assert code == 400

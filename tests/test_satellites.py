@@ -382,15 +382,18 @@ class TestVMAgentDepth:
         assert len(stale) == 1  # the remaining g series
         up = [r for r in got if r[0].get("__name__") == "up"]
         assert up and up[0][2] == 0.0
-        # stop() marks auto metrics too
+        # stop() stales the auto metrics even after a failed last scrape
         got.clear()
         t.stop(send_stale=True)
-        assert not got  # prev was cleared by the failed scrape
+        names = {r[0]["__name__"] for r in got}
+        assert names == {"up", "scrape_duration_seconds",
+                         "scrape_samples_scraped"}
+        assert all(dec.is_stale_nan(np.array([r[2]])).any() for r in got)
 
     def test_stream_parse_large_body(self):
         from victoriametrics_tpu.apps.vmagent import ScrapeTarget
         body = "".join(f'big{{i="{i}"}} {i}\n' for i in range(60_000))
-        assert len(body) > ScrapeTarget.STREAM_PARSE_BYTES
+        assert len(body) > (1 << 20)  # comfortably beyond one read chunk
         srv = self._mk_exporter(lambda: body)
         batches = []
         t = ScrapeTarget(f"http://127.0.0.1:{srv.port}/metrics",
@@ -499,3 +502,23 @@ class TestVMAgentDepth:
         a.reload({"scrape_configs": []})
         assert a.targets == {}
         a.stop()
+
+    def test_sd_error_keeps_last_good_targets(self):
+        from victoriametrics_tpu.ingest import discovery
+        calls = {"n": 0}
+
+        def flaky(cfg):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise discovery.DiscoveryError("api down")
+            return [("1.1.1.1:80", {"__meta_x": "y"})]
+        old = discovery.PROVIDERS.get("consul_sd_configs")
+        discovery.PROVIDERS["consul_sd_configs"] = flaky
+        try:
+            lg = {}
+            sc = {"consul_sd_configs": [{"server": "x"}]}
+            t1 = discovery.discover_targets(sc, lg)
+            t2 = discovery.discover_targets(sc, lg)  # provider errors
+            assert t1 == t2 == [("1.1.1.1:80", {"__meta_x": "y"})]
+        finally:
+            discovery.PROVIDERS["consul_sd_configs"] = old

@@ -115,7 +115,6 @@ class RemoteWriteCtx:
 
 
 class ScrapeTarget:
-    STREAM_PARSE_BYTES = 1 << 20   # bodies above this parse incrementally
     PUSH_BATCH = 5000
 
     def __init__(self, url: str, labels: dict, interval_s: float,
@@ -135,6 +134,7 @@ class ScrapeTarget:
         # emit Prometheus staleness markers when they disappear
         # (scrapework.go:441 sendStaleSeries)
         self._prev: dict[int, dict] = {}
+        self._scraped_once = False
 
     def start(self):
         self._thread.start()
@@ -146,9 +146,11 @@ class ScrapeTarget:
         if self._thread.is_alive() and \
                 self._thread is not threading.current_thread():
             self._thread.join(timeout=self.timeout_s + 2)
-        if send_stale and self._prev:
+        if send_stale and self._scraped_once:
             # target removed (SD change / shutdown): mark every tracked
-            # series stale so queries stop extending it
+            # series AND the auto metrics stale so queries stop extending
+            # them (the last scrape may have failed, so _prev can be empty
+            # while up=0 etc are still live)
             now_ms = int(time.time() * 1000)
             from ..ops.decimal import STALE_NAN
             rows = [(labels, now_ms, STALE_NAN)
@@ -229,9 +231,13 @@ class ScrapeTarget:
             rows = []  # drop the un-pushed partial batch
             self.health = "down"
             self.last_error = str(e)
-            cur = {}  # scrape failed: every previous series goes stale
+            # scrape failed: everything from the previous scrape AND any
+            # partially-pushed series from this one goes stale
+            self._prev = {**self._prev, **cur}
+            cur = {}
         dur = time.perf_counter() - t0
         self.last_scrape = time.time()
+        self._scraped_once = True
         # staleness markers for series that vanished since the last scrape
         for key, labels in self._prev.items():
             if key not in cur:
@@ -268,6 +274,8 @@ class VMAgent:
         then __-prefixed labels are dropped (promscrape/config.go
         mergeLabels semantics)."""
         from ..ingest.discovery import discover_targets
+        if not hasattr(self, "_sd_last_good"):
+            self._sd_last_good = {}
         cfg = self.cfg
         g = cfg.get("global", {})
         default_interval = _dur_s(g.get("scrape_interval", "1m"))
@@ -296,7 +304,7 @@ class VMAgent:
                                     (t, entry.get("labels", {})))
                     except (OSError, ValueError) as e:
                         logger.errorf("file_sd %s: %s", fn, e)
-            target_specs.extend(discover_targets(sc))
+            target_specs.extend(discover_targets(sc, self._sd_last_good))
             for addr, extra in target_specs:
                 labels = {"job": job, "__address__": addr,
                           "__metrics_path__": path, "__scheme__": scheme,
@@ -365,7 +373,14 @@ class VMAgent:
 
     def stop(self):
         self._stop.set()
-        for t in self.targets.values():
+        with self._sync_lock:
+            targets = list(self.targets.values())
+            self.targets = {}
+        # signal everything first so hung scrapes time out concurrently,
+        # then join + emit stale markers
+        for t in targets:
+            t._stop.set()
+        for t in targets:
             t.stop(send_stale=True)
         for ctx in self.rw_ctxs:
             ctx.stop()
@@ -376,9 +391,11 @@ class VMAgent:
         self._sync_targets()
 
     def target_status(self) -> list[dict]:
+        with self._sync_lock:
+            targets = list(self.targets.values())
         return [{"url": t.url, "labels": t.labels, "health": t.health,
                  "lastError": t.last_error, "lastScrape": t.last_scrape}
-                for t in self.targets.values()]
+                for t in targets]
 
 
 def _dur_s(s) -> float:

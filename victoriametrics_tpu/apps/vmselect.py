@@ -63,6 +63,8 @@ def build(args):
         max_memory_per_query=args.max_memory_per_query,
         max_query_duration_ms=_dur_ms(args.max_query_duration))
     api.register(srv, mode="select")
+    from ..httpapi.graphite_api import GraphiteAPI
+    GraphiteAPI(cluster).register(srv)
     native_srv = None
     if getattr(args, "native_addr", ""):
         from ..parallel.cluster_api import start_native_server

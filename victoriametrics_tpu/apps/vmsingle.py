@@ -126,6 +126,8 @@ def build(args):
                         max_query_duration_ms=_dur_ms(
                             args.max_query_duration))
     api.register(srv)
+    from ..httpapi.graphite_api import GraphiteAPI
+    GraphiteAPI(storage).register(srv)
     if args.pushmetrics_urls:
         from ..utils.pushmetrics import MetricsPusher
         api.pusher = MetricsPusher(

@@ -17,6 +17,11 @@ import urllib.request
 from ..utils import logger
 
 
+class DiscoveryError(RuntimeError):
+    """Provider API failure — callers keep their last-known-good targets
+    instead of treating this as an empty target list."""
+
+
 def _get_json(url: str, headers: dict | None = None, timeout: float = 10.0):
     req = urllib.request.Request(url, headers=headers or {})
     with urllib.request.urlopen(req, timeout=timeout) as r:
@@ -109,6 +114,8 @@ def kubernetes_sd(cfg: dict) -> list[tuple[str, dict]]:
                     }
                     if role == "service":
                         ip = item.get("spec", {}).get("clusterIP")
+                        if not ip or ip == "None":  # headless services
+                            continue
                         for p in item.get("spec", {}).get("ports", []):
                             labels = dict(base)
                             labels["__meta_kubernetes_service_port_number"] \
@@ -124,7 +131,7 @@ def kubernetes_sd(cfg: dict) -> list[tuple[str, dict]]:
         else:
             logger.errorf("kubernetes_sd: unsupported role %r", role)
     except (OSError, ValueError) as e:
-        logger.errorf("kubernetes_sd %s role=%s: %s", api, role, e)
+        raise DiscoveryError(f"kubernetes_sd {api} role={role}: {e}") from e
     return out
 
 
@@ -163,7 +170,7 @@ def consul_sd(cfg: dict) -> list[tuple[str, dict]]:
                 }
                 out.append((f"{addr}:{port}", labels))
     except (OSError, ValueError) as e:
-        logger.errorf("consul_sd %s: %s", server, e)
+        raise DiscoveryError(f"consul_sd {server}: {e}") from e
     return out
 
 
@@ -208,7 +215,7 @@ def ec2_sd(cfg: dict) -> list[tuple[str, dict]]:
                 labels["__meta_ec2_tag_" + _sanitize(k)] = v
             out.append((f"{ip}:{port}", labels))
     except (OSError, ValueError) as e:
-        logger.errorf("ec2_sd %s: %s", endpoint, e)
+        raise DiscoveryError(f"ec2_sd {endpoint}: {e}") from e
     return out
 
 
@@ -284,10 +291,24 @@ PROVIDERS = {
 }
 
 
-def discover_targets(sc: dict) -> list[tuple[str, dict]]:
-    """All dynamic-provider targets for one scrape config section."""
+def discover_targets(sc: dict, last_good: dict | None = None
+                     ) -> list[tuple[str, dict]]:
+    """All dynamic-provider targets for one scrape config section. On a
+    provider error the provider's previous successful result is reused
+    (Prometheus keeps last-known-good targets across SD hiccups); pass a
+    persistent `last_good` dict to enable that."""
+    import json as _json
     out: list[tuple[str, dict]] = []
     for key, fn in PROVIDERS.items():
         for cfg in sc.get(key, []) or []:
-            out.extend(fn(cfg))
+            ck = (key, _json.dumps(cfg, sort_keys=True))
+            try:
+                got = fn(cfg)
+            except DiscoveryError as e:
+                logger.errorf("%s; keeping last-known-good targets", e)
+                got = (last_good or {}).get(ck, [])
+            else:
+                if last_good is not None:
+                    last_good[ck] = got
+            out.extend(got)
     return out
