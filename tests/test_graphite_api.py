@@ -140,3 +140,34 @@ class TestRender:
         code, body = app.get("/render", target="nosuchfunc(", **{
             "from": "-1h"})
         assert code == 400
+
+
+class TestReviewRegressions:
+    def test_leaf_and_branch_node(self, tmp_path):
+        from victoriametrics_tpu.apps.vmsingle import build, parse_flags
+        args = parse_flags([f"-storageDataPath={tmp_path}/d",
+                            "-httpListenAddr=127.0.0.1:0"])
+        storage, srv, api = build(args)
+        srv.start()
+        storage.add_rows([({"__name__": "a.b"}, T0, 1.0),
+                          ({"__name__": "a.b.c"}, T0, 2.0)])
+        c = Client(srv.port)
+        code, body = c.get("/metrics/find", query="a.*")
+        n = json.loads(body)[0]
+        assert n["leaf"] == 1 and n["expandable"] == 1  # both roles
+        # '?' wildcard
+        code, body = c.get("/metrics/find", query="?.b")
+        assert [x["id"] for x in json.loads(body)] == ["a.b"]
+        # bad from -> 400 not 500
+        code, _ = c.get("/render", target="a.b", **{"from": "tomorrow"})
+        assert code == 400
+        srv.stop()
+        storage.close()
+
+    def test_alias_by_tags(self, app):
+        code, body = app.get(
+            "/render", target="aliasByTags(seriesByTag('dc=east'), 'dc')",
+            **{"from": str((T0 - 60_000) // 1000),
+               "until": str((T0 + 29 * 60_000) // 1000)})
+        out = json.loads(body)
+        assert out and out[0]["target"] == "east"

@@ -551,3 +551,15 @@ class TestMultitenantHTTP:
                              start=str(T0 // 1000),
                              end=str(T0 // 1000 + 60), step="30")
         assert json.loads(body)["data"]["result"] == []
+
+
+class TestVMUI:
+    def test_vmui_served(self, app):
+        code, body = app.get("/vmui")
+        assert code == 200
+        text = body.decode()
+        assert "<title>vmui" in text
+        # the explorer drives these APIs; they must exist
+        for ep in ("/api/v1/status/tsdb", "/api/v1/status/top_queries"):
+            code, body = app.get(ep)
+            assert code == 200, ep

@@ -332,6 +332,8 @@ class VMAgent:
         stop WITH staleness markers. Serialized: SIGHUP, /-/reload, and the
         SD refresh thread may all call this concurrently."""
         with self._sync_lock:
+            if self._stop.is_set():
+                return  # a queued SD refresh must not resurrect targets
             specs = self._resolve_specs()
             for key in list(self.targets):
                 if key not in specs:

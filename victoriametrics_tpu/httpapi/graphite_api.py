@@ -120,6 +120,8 @@ def _glob_to_regex(glob: str) -> str:
         c = glob[i]
         if c == "*":
             out.append(r"[^.]*")
+        elif c == "?":
+            out.append(r"[^.]")
         elif c == "{":
             j = glob.index("}", i)
             alts = glob[i + 1:j].split(",")
@@ -173,7 +175,9 @@ class GraphiteAPI:
         """(text, full_path, is_leaf) nodes one level below the glob."""
         depth = query.count(".") + 1
         rx = re.compile("^" + _glob_to_regex(query))
-        nodes: dict[str, bool] = {}
+        # path -> [is_leaf, has_children]: a path can be both a metric and
+        # a branch; Grafana needs expandable=1 whenever children exist
+        nodes: dict[str, list] = {}
         for name in self._names(tenant):
             segs = name.split(".")
             if len(segs) < depth:
@@ -181,12 +185,13 @@ class GraphiteAPI:
             prefix = ".".join(segs[:depth])
             if not rx.fullmatch(prefix):
                 continue
-            leaf = len(segs) == depth
-            # a prefix can be both a leaf and a branch; branch wins for
-            # expandable, leaf tracked separately
-            nodes[prefix] = nodes.get(prefix, False) or leaf
-        return [(p.rsplit(".", 1)[-1], p, leaf)
-                for p, leaf in sorted(nodes.items())]
+            e = nodes.setdefault(prefix, [False, False])
+            if len(segs) == depth:
+                e[0] = True
+            else:
+                e[1] = True
+        return [(p.rsplit(".", 1)[-1], p, leaf, kids)
+                for p, (leaf, kids) in sorted(nodes.items())]
 
     def h_find(self, req: Request) -> Response:
         query = req.arg("query", "*")
@@ -194,19 +199,20 @@ class GraphiteAPI:
         nodes = self._find_nodes(query, _tenant(req))
         if fmt == "completer":
             return Response.json({"metrics": [
-                {"name": text, "path": p + ("" if leaf else "."),
+                {"name": text, "path": p + ("." if kids and not leaf
+                                            else ""),
                  "is_leaf": "1" if leaf else "0"}
-                for text, p, leaf in nodes]})
+                for text, p, leaf, kids in nodes]})
         return Response.json([
             {"text": text, "id": p, "leaf": 1 if leaf else 0,
-             "expandable": 0 if leaf else 1, "allowChildren": 0 if leaf
-             else 1, "context": {}}
-            for text, p, leaf in nodes])
+             "expandable": 1 if kids else 0,
+             "allowChildren": 1 if kids else 0, "context": {}}
+            for text, p, leaf, kids in nodes])
 
     def h_expand(self, req: Request) -> Response:
         out = set()
         for q in req.args("query"):
-            for _, p, _leaf in self._find_nodes(q, _tenant(req)):
+            for _, p, _leaf, _kids in self._find_nodes(q, _tenant(req)):
                 out.add(p)
         return Response.json({"results": sorted(out)})
 
@@ -259,9 +265,12 @@ class GraphiteAPI:
 
     def h_render(self, req: Request) -> Response:
         now = int(time.time() * 1000)
-        frm = parse_graphite_time(req.arg("from"), now - 3600_000)
-        until = parse_graphite_time(req.arg("until"), now)
-        mdp = int(req.arg("maxDataPoints", "0") or 0)
+        try:
+            frm = parse_graphite_time(req.arg("from"), now - 3600_000)
+            until = parse_graphite_time(req.arg("until"), now)
+            mdp = int(req.arg("maxDataPoints", "0") or 0)
+        except ValueError as e:
+            return Response.error(f"cannot render: {e}", 400)
         step = self.step_ms
         if mdp > 0:
             step = max(step, ((until - frm) // mdp + step - 1)
@@ -288,29 +297,11 @@ class GraphiteAPI:
         return Response.json(body)
 
     def _fetch(self, path_glob: str, grid, step, tenant):
-        """Series matching a dotted glob, aligned to the grid with
-        last-value-in-bucket consolidation."""
+        """Series matching a dotted glob, aligned to the grid."""
         rx = "^" + _glob_to_regex(path_glob) + "$"
         filters = [TagFilter(b"", rx.encode(), regex=True)]
-        frm, until = int(grid[0]), int(grid[-1])
-        series = self.storage.search_series(
-            filters, frm - step, until, tenant=tenant)
-        out = []
-        for sd in series:
-            vals = np.full(grid.size, np.nan)
-            idx = np.searchsorted(sd.timestamps, grid, side="right") - 1
-            ok = idx >= 0
-            if ok.any():
-                got = sd.values[np.clip(idx, 0, None)]
-                age = grid - sd.timestamps[np.clip(idx, 0, None)]
-                ok &= age < step  # only samples within the bucket
-                vals[ok] = got[ok]
-            name = sd.metric_name.metric_group.decode("utf-8", "replace")
-            tags = {k.decode(): v.decode() for k, v in
-                    sd.metric_name.labels}
-            tags["name"] = name
-            out.append(GraphiteSeries(name, tags, grid, vals, path_glob))
-        return out
+        return _fetch_aligned(self.storage, filters, grid, step, tenant,
+                              path_glob)
 
     def _eval(self, node: _GNode, grid, step, tenant
               ) -> list[GraphiteSeries]:
@@ -511,9 +502,15 @@ _G_FUNCS = {
 
 def _f_series_by_tag(api, args, grid, step, tenant):
     filters = [_tag_expr_filter(sv) for sv in _strings(args)]
+    return _fetch_aligned(api.storage, filters, grid, step, tenant)
+
+
+def _fetch_aligned(storage, filters, grid, step, tenant, path_expr=""):
+    """Fetch + last-value-in-bucket consolidation onto the render grid
+    (shared by path-glob fetch and seriesByTag)."""
     frm, until = int(grid[0]), int(grid[-1])
-    series = api.storage.search_series(filters, frm - step, until,
-                                       tenant=tenant)
+    series = storage.search_series(filters, frm - step, until,
+                                   tenant=tenant)
     out = []
     for sd in series:
         vals = np.full(grid.size, np.nan)
@@ -522,13 +519,25 @@ def _f_series_by_tag(api, args, grid, step, tenant):
         if ok.any():
             got = sd.values[np.clip(idx, 0, None)]
             age = grid - sd.timestamps[np.clip(idx, 0, None)]
-            ok &= age < step
+            ok &= age < step  # only samples within the bucket
             vals[ok] = got[ok]
         name = sd.metric_name.metric_group.decode("utf-8", "replace")
         tags = {k.decode(): v.decode() for k, v in sd.metric_name.labels}
         tags["name"] = name
-        out.append(GraphiteSeries(name, tags, grid, vals))
+        out.append(GraphiteSeries(name, tags, grid, vals, path_expr))
+    return out
+
+
+def _f_alias_by_tags(api, args, grid, step, tenant):
+    series = _series_args(api, args, grid, step, tenant)
+    tag_names = _strings(args)
+    out = []
+    for s in series:
+        name = ".".join(s.tags.get(t, "") for t in tag_names) or s.name
+        out.append(GraphiteSeries(name, s.tags, grid, s.values,
+                                  s.path_expr))
     return out
 
 
 _G_FUNCS["seriesByTag"] = _f_series_by_tag
+_G_FUNCS["aliasByTags"] = _f_alias_by_tags

@@ -203,6 +203,9 @@ class PrometheusAPI:
             self._register_select(srv)
             srv.route("/select/", self._mt_dispatch)
             srv.route("/admin/tenants", self.h_tenants)
+        if mode in ("all", "select"):
+            srv.route("/vmui", self.h_vmui)
+            srv.route("/vmui/", self.h_vmui)
         srv.route("/metrics", self.h_metrics)
         srv.route("/health", lambda req: Response.text("OK"))
         srv.route("/-/healthy", lambda req: Response.text("OK"))
@@ -297,6 +300,15 @@ class PrometheusAPI:
             return Response.error(f"unsupported path {rest}", 404,
                                   "not_found")
         return fn(req)
+
+    def h_vmui(self, req: Request) -> Response:
+        """Static explorer (the reference serves the React vmui bundle at
+        app/vmselect/main.go:438; this is a dependency-free equivalent
+        with query/graph/table/JSON tabs + cardinality + top queries)."""
+        import os as _os
+        path = _os.path.join(_os.path.dirname(__file__), "vmui.html")
+        with open(path, "rb") as f:
+            return Response(200, f.read(), "text/html; charset=utf-8")
 
     def h_tenants(self, req: Request) -> Response:
         """List tenants with stored data (the vmselect /admin/tenants API,
