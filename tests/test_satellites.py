@@ -522,3 +522,197 @@ class TestVMAgentDepth:
             assert t1 == t2 == [("1.1.1.1:80", {"__meta_x": "y"})]
         finally:
             discovery.PROVIDERS["consul_sd_configs"] = old
+
+
+class TestVMAlertReplayRestore:
+    def test_replay_writes_historic_recordings(self, tmp_path, vmsingle):
+        client, storage = vmsingle
+        # seed a counter over a 30-min historic window
+        rows = [({"__name__": "rc", "i": "1"}, T0 + j * 15_000, 150.0 * j)
+                for j in range(121)]
+        storage.add_rows(rows)
+        from victoriametrics_tpu.apps.vmalert import (Datasource, Group,
+                                                      RemoteWriter, replay)
+        base = f"http://127.0.0.1:{storage_port(client)}"
+        ds = Datasource(base)
+        rw = RemoteWriter(base)
+        g = Group({"name": "g", "interval": "5m", "rules": [
+            {"record": "rc:rate5m", "expr": "rate(rc[5m])"}]}, ds, [], rw)
+        n = replay([g], T0 + 600_000, T0 + 1_500_000)
+        assert n == 4  # 15min span at 5m interval inclusive
+        r = client.query_range("rc:rate5m", (T0 + 600_000) / 1e3,
+                               (T0 + 1_500_000) / 1e3, 300)
+        res = r["data"]["result"]
+        assert len(res) == 1
+        vals = {v for _, v in res[0]["values"]}
+        assert "10" in vals  # 150/15s = 10/s
+
+    def test_state_restore(self, tmp_path, vmsingle):
+        client, storage = vmsingle
+        import time as _t
+        now = _t.time()
+        active_at = now - 120  # alert has been pending for 2 minutes
+        storage.add_rows([
+            ({"__name__": "ALERTS_FOR_STATE", "alertname": "HighLoad",
+              "sev": "warn"}, int((now - 30) * 1000), active_at),
+            ({"__name__": "trigger_metric"}, int(now * 1000), 1.0),
+        ])
+        from victoriametrics_tpu.apps.vmalert import (AlertingRule,
+                                                      Datasource, Group)
+        base = f"http://127.0.0.1:{storage_port(client)}"
+        ds = Datasource(base)
+        g = Group({"name": "g", "rules": [
+            {"alert": "HighLoad", "expr": "trigger_metric > 0",
+             "for": "3m", "labels": {"sev": "warn"}}]}, ds, [], None)
+        g.restore(ds)
+        rule = g.rules[0]
+        assert len(rule._active) == 1
+        st = list(rule._active.values())[0]
+        assert abs(st["activeAt"] - active_at) < 1.0
+        # next eval: still pending (3m not yet reached), keeps old activeAt
+        g.eval_once(now)
+        st = list(rule._active.values())[0]
+        assert st["state"] == "pending"
+        assert abs(st["activeAt"] - active_at) < 1.0
+        # a minute later the restored clock crosses `for` -> firing
+        g.eval_once(now + 70)
+        st = list(rule._active.values())[0]
+        assert st["state"] == "firing"
+
+
+def storage_port(client) -> int:
+    return int(client.base.rsplit(":", 1)[1])
+
+
+class TestS3Backup:
+    def test_backup_restore_via_fake_s3(self, tmp_path, vmsingle):
+        """A minimal in-process S3 server: PUT/GET/DELETE objects +
+        ListObjectsV2, like the reference's custom-endpoint tests."""
+        import urllib.parse
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+        objects: dict[str, bytes] = {}
+
+        def handler(req):
+            path = urllib.parse.unquote(req.path.lstrip("/"))
+            if req.method == "PUT":
+                objects[path] = req.body
+                return Response(200, b"")
+            if req.method == "DELETE":
+                objects.pop(path, None)
+                return Response(204, b"")
+            if req.method == "GET" and req.arg("list-type") == "2":
+                bucket = path.split("?")[0]
+                prefix = req.arg("prefix", "")
+                # real S3 keys exclude the bucket name
+                items = "".join(
+                    f"<Contents><Key>{k[len(bucket) + 1:]}</Key>"
+                    f"<Size>{len(v)}</Size></Contents>"
+                    for k, v in objects.items()
+                    if k.startswith(bucket + "/" + prefix))
+                xml = (f"<ListBucketResult>{items}"
+                       f"<IsTruncated>false</IsTruncated></ListBucketResult>")
+                return Response(200, xml.encode(), "application/xml")
+            if req.method == "GET":
+                if path in objects:
+                    return Response(200, objects[path],
+                                    "application/octet-stream")
+                return Response(404, b"not found")
+            return Response(400, b"")
+        srv = HTTPServer("127.0.0.1", 0)
+        srv.route("/", handler)
+        srv.prefix_routes.append(("/", handler))
+        srv.start()
+
+        client, storage = vmsingle
+        storage.add_rows([({"__name__": "s3m", "i": str(i)}, T0, float(i))
+                          for i in range(30)])
+        storage.force_flush()
+        snap = storage.create_snapshot()
+        snap_dir = os.path.join(storage.snapshots_dir(), snap)
+        from victoriametrics_tpu.apps.vmbackup import (S3Remote, backup,
+                                                       restore)
+        remote = S3Remote("bkt", "backups/daily",
+                          endpoint=f"http://127.0.0.1:{srv.port}",
+                          access_key="AK", secret_key="SK")
+        st = backup(snap_dir, remote)
+        assert st["uploaded"] > 0
+        st2 = backup(snap_dir, remote)  # incremental: nothing re-uploaded
+        assert st2["uploaded"] == 0 and st2["skipped"] == st["uploaded"]
+        dst = str(tmp_path / "restored-s3")
+        restore(remote, dst)
+        from victoriametrics_tpu.storage.storage import Storage
+        from victoriametrics_tpu.storage.tag_filters import filters_from_dict
+        s2 = Storage(dst)
+        res = s2.search_series(filters_from_dict({"__name__": "s3m"}),
+                               T0 - 1000, T0 + 1000)
+        assert len(res) == 30
+        s2.close()
+        srv.stop()
+
+
+class TestJWT:
+    def _hs_token(self, secret, claims):
+        import base64, hashlib, hmac, json as _json
+        enc = lambda b: base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+        h = enc(_json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        p = enc(_json.dumps(claims).encode())
+        sig = hmac.new(secret.encode(), f"{h}.{p}".encode(),
+                       hashlib.sha256).digest()
+        return f"{h}.{p}.{enc(sig)}"
+
+    def test_hs256_verify(self):
+        import time as _t
+        from victoriametrics_tpu.utils.jwt import JWTError, verify
+        tok = self._hs_token("s3cret", {"sub": "u1",
+                                        "exp": _t.time() + 60})
+        assert verify(tok, secrets=["wrong", "s3cret"])["sub"] == "u1"
+        import pytest as _pt
+        with _pt.raises(JWTError, match="signature"):
+            verify(tok, secrets=["nope"])
+        expired = self._hs_token("s3cret", {"exp": _t.time() - 10})
+        with _pt.raises(JWTError, match="expired"):
+            verify(expired, secrets=["s3cret"])
+
+    def test_vmauth_jwt_user(self):
+        from victoriametrics_tpu.apps.vmauth import AuthConfig
+        cfg = {"users": [{
+            "name": "jwty", "url_prefix": "http://b1",
+            "jwt_secrets": ["topsecret"],
+            "jwt_required_claims": {"team": "dev"}}]}
+        auth = AuthConfig(cfg)
+        good = self._hs_token("topsecret", {"team": "dev"})
+        bad_claim = self._hs_token("topsecret", {"team": "ops"})
+        bad_sig = self._hs_token("other", {"team": "dev"})
+        assert auth.find_user(
+            {"Authorization": f"Bearer {good}"}).name == "jwty"
+        assert auth.find_user(
+            {"Authorization": f"Bearer {bad_claim}"}) is None
+        assert auth.find_user(
+            {"Authorization": f"Bearer {bad_sig}"}) is None
+
+
+class TestRemoteRead:
+    def test_vmctl_remote_read_migration(self, tmp_path, vmsingle):
+        client, storage = vmsingle
+        storage.add_rows([({"__name__": "rrm", "i": str(i)},
+                           T0 + j * 15_000, float(i * 10 + j))
+                          for i in range(5) for j in range(20)])
+        # destination vmsingle
+        from victoriametrics_tpu.apps.vmsingle import build, parse_flags
+        args = parse_flags([f"-storageDataPath={tmp_path}/rrdst",
+                            "-httpListenAddr=127.0.0.1:0"])
+        storage2, srv2, _ = build(args)
+        srv2.start()
+        try:
+            from victoriametrics_tpu.apps.vmctl import remote_read
+            src = client.base
+            dst = f"http://127.0.0.1:{srv2.port}"
+            n = remote_read(src, dst, '{__name__="rrm"}',
+                            T0, T0 + 20 * 15_000)
+            assert n == 100
+            c2 = Client(srv2.port)
+            r = c2.query("count(rrm)", (T0 + 19 * 15_000) / 1e3)
+            assert r["data"]["result"][0]["value"][1] == "5"
+        finally:
+            srv2.stop()
+            storage2.close()

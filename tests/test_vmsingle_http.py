@@ -563,3 +563,72 @@ class TestVMUI:
         for ep in ("/api/v1/status/tsdb", "/api/v1/status/top_queries"):
             code, body = app.get(ep)
             assert code == 200, ep
+
+
+class TestNativeExport:
+    def test_roundtrip(self, app, tmp_path):
+        ingest_remote_write(app, n_series=3, n_samples=10)
+        code, body = app.get("/api/v1/export/native",
+                             **{"match[]": "rw_metric"})
+        assert code == 200 and body.startswith(b"vmtpu-native-v1\n")
+        # import into a second instance
+        from victoriametrics_tpu.apps.vmsingle import build, parse_flags
+        args = parse_flags([f"-storageDataPath={tmp_path}/native2",
+                            "-httpListenAddr=127.0.0.1:0"])
+        storage2, srv2, _ = build(args)
+        srv2.start()
+        try:
+            c2 = Client(srv2.port)
+            code, _ = c2.post("/api/v1/import/native", body)
+            assert code == 204
+            r = c2.query_range("rw_metric", T0 / 1e3,
+                               (T0 + 300_000) / 1e3, 15)
+            assert len(r["data"]["result"]) == 3
+            vals = r["data"]["result"][0]["values"]
+            # 10 raw samples land on the grid with lookback fill
+            assert {v for _, v in vals} == {str(i) for i in range(10)}
+        finally:
+            srv2.stop()
+            storage2.close()
+
+    def test_bad_header(self, app):
+        code, _ = app.post("/api/v1/import/native", b"garbage")
+        assert code == 400
+
+
+class TestMetadataAndZabbix:
+    def test_zabbix_connector_history(self, app):
+        line = json.dumps({
+            "host": {"host": "zhost", "name": "Zabbix Host"},
+            "name": "system.cpu.load", "value": 1.25,
+            "clock": T0 // 1000, "ns": 500000,
+            "item_tags": [{"tag": "component", "value": "cpu"}]})
+        code, _ = app.post("/zabbixconnector/api/v1/history", line.encode())
+        assert code == 204
+        r = app.query('{host="zhost"}', T0 / 1e3)
+        res = r["data"]["result"][0]
+        assert res["metric"]["__name__"] == "system.cpu.load"
+        assert res["metric"]["tag_component"] == "cpu"
+        assert res["value"][1] == "1.25"
+
+    def test_type_help_metadata(self, app):
+        body = (b"# HELP my_counter Counts the things.\n"
+                b"# TYPE my_counter counter\n"
+                b"my_counter 5\n")
+        code, _ = app.post("/api/v1/import/prometheus", body)
+        assert code == 204
+        code, body = app.get("/api/v1/metadata")
+        d = json.loads(body)["data"]
+        assert d["my_counter"] == [{"type": "counter",
+                                    "help": "Counts the things.",
+                                    "unit": ""}]
+        code, body = app.get("/api/v1/metadata", metric="my_counter")
+        assert list(json.loads(body)["data"]) == ["my_counter"]
+
+    def test_metric_names_stats(self, app):
+        ingest_remote_write(app, n_series=2, n_samples=3)
+        app.query("rw_metric", T0 / 1e3)
+        code, body = app.get("/api/v1/status/metric_names_stats")
+        recs = json.loads(body)["records"]
+        assert any(r["metricName"] == "rw_metric" and r["requestsCount"] >= 2
+                   for r in recs)

@@ -158,6 +158,34 @@ class AlertingRule:
     def datasource_results(self, ds: Datasource, now: float):
         return ds.query(self.expr, now)
 
+    def restore(self, ds: Datasource, now: float, lookback_s: float):
+        """Restore pending/firing state after a restart from the
+        ALERTS_FOR_STATE series written by the previous instance
+        (app/vmalert/rule/alerting.go Restore). Only useful for rules
+        with a `for` duration."""
+        if self.for_s <= 0 or self._active:
+            return
+        sel = "{alertname=%r" % self.name
+        for k, v in sorted(self.labels.items()):
+            sel += ",%s=%r" % (k, v)
+        sel += "}"
+        expr = f"last_over_time(ALERTS_FOR_STATE{sel}[{int(lookback_s)}s])"
+        try:
+            results = ds.query(expr, now)
+        except (OSError, RuntimeError, ValueError) as e:
+            self.last_error = f"restore: {e}"
+            return
+        for r in results:
+            labels = dict(r["metric"])
+            labels.pop("__name__", None)
+            key = tuple(sorted(labels.items()))
+            self._active[key] = {
+                "labels": labels, "state": STATE_PENDING,
+                "activeAt": float(r["value"]), "value": float("nan"),
+                "annotations": {}}
+            logger.infof("restored alert state %s activeAt=%s",
+                         self.name, r["value"])
+
     def state_rows(self, now_ms: int) -> list:
         rows = []
         for st in self._active.values():
@@ -230,7 +258,13 @@ class Group:
             if self._stop.wait(max(self.interval - (time.time() - t0), 0.1)):
                 return
 
-    def eval_once(self, now: float) -> None:
+    def restore(self, ds: Datasource, lookback_s: float = 86_400.0):
+        now = time.time()
+        for rule in self.rules:
+            if isinstance(rule, AlertingRule):
+                rule.restore(ds, now, lookback_s)
+
+    def eval_once(self, now: float, notify: bool = True) -> None:
         self.last_eval = now
         now_ms = int(now * 1000)
         state_rows = []
@@ -251,7 +285,7 @@ class Group:
                         })
             else:
                 state_rows.extend(rule.eval(self.ds, now))
-        if firing:
+        if firing and notify:
             for n in self.notifiers:
                 n.send(firing)
         if state_rows and self.rw is not None:
@@ -283,6 +317,24 @@ class Group:
         return {"name": self.name, "interval": self.interval, "rules": rules}
 
 
+def replay(groups: list, time_from_ms: int, time_to_ms: int) -> int:
+    """Replay mode (app/vmalert/replay.go): walk each group's rules over
+    the historical range at the group interval, remote-writing recording
+    results and alert state; notifications are suppressed. Returns the
+    number of evaluations performed."""
+    evals = 0
+    for g in groups:
+        step_ms = int(g.interval * 1000)
+        t = time_from_ms
+        while t <= time_to_ms:
+            g.eval_once(t / 1000.0, notify=False)
+            evals += 1
+            t += step_ms
+        logger.infof("replay: group %s evaluated %d steps", g.name,
+                     (time_to_ms - time_from_ms) // step_ms + 1)
+    return evals
+
+
 def parse_flags(argv=None):
     p = argparse.ArgumentParser(prog="vmalert")
     p.add_argument("-rule", action="append", default=[],
@@ -293,6 +345,11 @@ def parse_flags(argv=None):
                    default=[])
     p.add_argument("-remoteWrite.url", dest="remote_write_url", default="")
     p.add_argument("-evaluationInterval", dest="eval_interval", default="1m")
+    p.add_argument("-remoteRead.url", dest="remote_read_url", default="",
+                   help="restore alert state from this datasource on start")
+    p.add_argument("-replay.timeFrom", dest="replay_from", default="",
+                   help="replay mode: evaluate rules from this time")
+    p.add_argument("-replay.timeTo", dest="replay_to", default="")
     p.add_argument("-httpListenAddr", default=":8880")
     p.add_argument("-loggerLevel", default="INFO")
     args, _ = p.parse_known_args(argv)
@@ -345,6 +402,17 @@ def main(argv=None):
     args = parse_flags(argv)
     logger.set_level(args.loggerLevel)
     groups, srv = build(args)
+    if args.replay_from and args.replay_to:
+        from ..httpapi.prometheus_api import parse_time
+        frm = parse_time(args.replay_from, 0)
+        to = parse_time(args.replay_to, 0)
+        n = replay(groups, frm, to)
+        logger.infof("vmalert replay finished: %d evaluations", n)
+        return
+    if args.remote_read_url:
+        rr = Datasource(args.remote_read_url)
+        for g in groups:
+            g.restore(rr)
     for g in groups:
         g.start()
     srv.start()

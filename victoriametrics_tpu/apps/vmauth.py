@@ -52,6 +52,11 @@ class User:
         self.username = cfg.get("username", "")
         self.password = cfg.get("password", "")
         self.bearer_token = cfg.get("bearer_token", "")
+        # JWT auth (lib/jwt analog): HS* shared secrets and/or RS256 PEM
+        # public keys; optional required claims, e.g. {"vm_access": ...}
+        self.jwt_secrets = list(cfg.get("jwt_secrets", []) or [])
+        self.jwt_public_keys = list(cfg.get("jwt_public_keys", []) or [])
+        self.jwt_claims = dict(cfg.get("jwt_required_claims", {}) or {})
         self.name = cfg.get("name", self.username or "bearer")
         self.url_map = [URLMapEntry(m) for m in cfg.get("url_map", [])]
         self.default_backend = (Backend(cfg["url_prefix"])
@@ -83,6 +88,19 @@ class AuthConfig:
             for u in self.users:
                 if u.bearer_token and u.bearer_token == token:
                     return u
+            if token.count(".") == 2:
+                from ..utils.jwt import JWTError, verify
+                for u in self.users:
+                    if not (u.jwt_secrets or u.jwt_public_keys):
+                        continue
+                    try:
+                        claims = verify(token, u.jwt_secrets,
+                                        u.jwt_public_keys)
+                    except JWTError:
+                        continue
+                    if all(claims.get(k) == v
+                           for k, v in u.jwt_claims.items()):
+                        return u
         if auth.startswith("Basic "):
             try:
                 dec = base64.b64decode(auth[6:]).decode()

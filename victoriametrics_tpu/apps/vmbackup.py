@@ -12,6 +12,7 @@ import argparse
 import json
 import os
 import shutil
+import urllib.error
 import urllib.request
 
 from ..utils import logger
@@ -62,11 +63,93 @@ class FsRemote(RemoteFS):
             pass
 
 
-def open_remote(dst: str) -> RemoteFS:
+class S3Remote(RemoteFS):
+    """s3://bucket/prefix destination (lib/backup/s3remote/s3.go analog):
+    plain S3 REST calls signed with SigV4. `endpoint` override (the
+    -customS3Endpoint flag) points it at MinIO / fake servers."""
+
+    def __init__(self, bucket: str, prefix: str, region: str = "us-east-1",
+                 endpoint: str = "", access_key: str = "",
+                 secret_key: str = ""):
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.region = region
+        self.endpoint = (endpoint.rstrip("/") if endpoint else
+                         f"https://s3.{region}.amazonaws.com")
+        self.access_key = access_key or os.environ.get(
+            "AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get(
+            "AWS_SECRET_ACCESS_KEY", "")
+
+    def _url(self, rel: str = "", query: str = "") -> str:
+        key = "/".join(x for x in (self.bucket, self.prefix, rel) if x)
+        u = f"{self.endpoint}/{key}"
+        return u + ("?" + query if query else "")
+
+    def _call(self, method: str, url: str, body: bytes = b"") -> bytes:
+        from ..ingest.discovery import _sigv4_headers
+        headers = {}
+        if self.access_key and self.secret_key:
+            headers = _sigv4_headers(method, url, body, self.region,
+                                     "s3", self.access_key,
+                                     self.secret_key)
+        req = urllib.request.Request(url, data=body or None,
+                                     headers=headers, method=method)
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.read()
+
+    def list_files(self) -> dict[str, int]:
+        import urllib.parse
+        import xml.etree.ElementTree as ET
+        out: dict[str, int] = {}
+        prefix = "/".join(x for x in (self.prefix,) if x)
+        token = ""
+        while True:
+            q = "list-type=2&prefix=" + urllib.parse.quote(
+                prefix + "/" if prefix else "")
+            if token:
+                q += "&continuation-token=" + urllib.parse.quote(token)
+            data = self._call("GET", f"{self.endpoint}/{self.bucket}?{q}")
+            root = ET.fromstring(data)
+            ns = root.tag[:root.tag.index("}") + 1] if                 root.tag.startswith("{") else ""
+            for c in root.iter(f"{ns}Contents"):
+                key = c.find(f"{ns}Key").text
+                size = int(c.find(f"{ns}Size").text)
+                rel = key[len(prefix) + 1:] if prefix else key
+                out[rel] = size
+            trunc = root.find(f"{ns}IsTruncated")
+            if trunc is None or trunc.text != "true":
+                break
+            token = root.find(f"{ns}NextContinuationToken").text
+        return out
+
+    def upload(self, rel: str, src_path: str):
+        with open(src_path, "rb") as f:
+            self._call("PUT", self._url(rel), f.read())
+
+    def download(self, rel: str, dst_path: str):
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        data = self._call("GET", self._url(rel))
+        with open(dst_path, "wb") as f:
+            f.write(data)
+
+    def delete(self, rel: str):
+        try:
+            self._call("DELETE", self._url(rel))
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+def open_remote(dst: str, **kw) -> RemoteFS:
     if dst.startswith("fs://"):
         return FsRemote(dst[5:])
+    if dst.startswith("s3://"):
+        rest = dst[5:]
+        bucket, _, prefix = rest.partition("/")
+        return S3Remote(bucket, prefix, **kw)
     raise ValueError(f"unsupported backup destination {dst!r} "
-                     "(supported: fs://)")
+                     "(supported: fs://, s3://; gcs/azure not implemented)")
 
 
 def _local_files(root: str) -> dict[str, int]:

@@ -51,6 +51,58 @@ def vm_native(src: str, dst: str, match: str, start: str = "", end: str = "",
     return total
 
 
+def remote_read(src: str, dst: str, match: str, start_ms: int,
+                end_ms: int, chunk_rows: int = 50_000) -> int:
+    """Migrate from any Prometheus remote_read endpoint (prometheus, mimir,
+    thanos — the reference vmctl's remote-read mode) into dst."""
+    import json as _json
+    import re as _re
+
+    from ..ingest import remote_write as rw
+    from ..ingest.parsers import series_to_jsonl
+    matchers = []
+    m = _re.match(r"\{(.*)\}$", match.strip()) if match.strip().startswith("{") else None
+    body_expr = m.group(1) if m else ""
+    if body_expr or m:
+        for mm in _re.finditer(
+                r'([A-Za-z_][\w]*)\s*(=~|!~|!=|=)\s*"((?:[^"\\]|\\.)*)"',
+                body_expr):
+            matchers.append((mm.group(2), mm.group(1),
+                             mm.group(3).replace('\\"', '"')))
+        if not matchers:
+            raise ValueError(f"cannot parse matchers in {match!r}")
+    else:
+        matchers.append(("=", "__name__", match.strip()))
+    body = rw.build_read_request(start_ms, end_ms, matchers)
+    req = urllib.request.Request(
+        src.rstrip("/") + "/api/v1/read", data=body, method="POST",
+        headers={"Content-Encoding": "snappy",
+                 "Content-Type": "application/x-protobuf",
+                 "X-Prometheus-Remote-Read-Version": "0.1.0"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        resp = r.read()
+    total = 0
+    _flushed = {"n": 0}
+    buf: list[bytes] = []
+    for labels, samples in rw.parse_read_response(resp):
+        if not samples:
+            continue
+        d = {k.decode() if isinstance(k, bytes) else k:
+             v.decode() if isinstance(v, bytes) else v
+             for k, v in labels}
+        buf.append(series_to_jsonl(d, [t for t, _ in samples],
+                                   [v for _, v in samples]).encode())
+        total += len(samples)
+        if total - _flushed["n"] >= max(chunk_rows, 1):
+            _post(dst.rstrip("/") + "/api/v1/import", b"\n".join(buf))
+            _flushed["n"] = total
+            buf = []
+    if buf:
+        _post(dst.rstrip("/") + "/api/v1/import", b"\n".join(buf))
+    logger.infof("vmctl remote-read: migrated %d samples", total)
+    return total
+
+
 def import_file(path: str, dst: str, fmt: str, chunk_lines: int = 50_000) -> int:
     endpoint = {"prometheus": "/api/v1/import/prometheus",
                 "influx": "/write",

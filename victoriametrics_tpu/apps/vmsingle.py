@@ -167,6 +167,29 @@ def main(argv=None):
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    def _reload(*_):
+        # SIGHUP hot-reload of -relabelConfig and -streamAggr.config
+        try:
+            if args.relabel_config:
+                from ..ingest.relabel import parse_relabel_configs
+                _api.relabel = parse_relabel_configs(
+                    open(args.relabel_config).read())
+            if args.streamaggr_config:
+                from ..ingest.streamaggr import load_from_text
+                new = load_from_text(
+                    open(args.streamaggr_config).read(),
+                    lambda rows: storage.add_rows(rows))
+                old = _api.stream_aggr
+                new.start()
+                _api.stream_aggr = new
+                if old is not None:
+                    old.stop()
+            logger.infof("vmsingle: config reloaded")
+        except Exception as e:
+            logger.errorf("vmsingle: reload failed, keeping old config: %s",
+                          e)
+    signal.signal(signal.SIGHUP, _reload)
     srv.start()
     try:
         while not stop.wait(1.0):

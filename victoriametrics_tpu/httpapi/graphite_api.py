@@ -199,8 +199,7 @@ class GraphiteAPI:
         nodes = self._find_nodes(query, _tenant(req))
         if fmt == "completer":
             return Response.json({"metrics": [
-                {"name": text, "path": p + ("." if kids and not leaf
-                                            else ""),
+                {"name": text, "path": p + ("." if kids else ""),
                  "is_leaf": "1" if leaf else "0"}
                 for text, p, leaf, kids in nodes]})
         return Response.json([

@@ -189,6 +189,10 @@ class PrometheusAPI:
         self.started_at = time.time()
         self.rows_inserted = 0
         self.rows_relabel_dropped = 0
+        # TYPE/HELP metadata (lib/storage/metricsmetadata analog) and
+        # per-metric-name query usage stats (lib/storage/metricnamestats)
+        self.metadata: dict[str, dict] = {}
+        self.name_usage: dict[str, list] = {}  # name -> [count, last_ts]
 
     # -- wiring ------------------------------------------------------------
 
@@ -217,11 +221,13 @@ class PrometheusAPI:
         r("/api/v1/push", self.h_remote_write)
         r("/prometheus/api/v1/write", self.h_remote_write)
         r("/api/v1/import", self.h_import)
+        r("/api/v1/import/native", self.h_import_native)
         r("/api/v1/import/prometheus", self.h_import_prometheus)
         r("/api/v1/import/csv", self.h_import_csv)
         r("/write", self.h_influx_write)
         r("/influx/write", self.h_influx_write)
         r("/api/put", self.h_opentsdb_http)
+        r("/zabbixconnector/api/v1/history", self.h_zabbix)
         r("/opentsdb/api/put", self.h_opentsdb_http)
         r("/graphite", self.h_graphite_write)
         r("/datadog/api/v1/series", self.h_datadog_v1)
@@ -240,10 +246,14 @@ class PrometheusAPI:
         r("/api/v1/labels", self.h_labels)
         r("/api/v1/label/", self.h_label_values)
         r("/api/v1/export", self.h_export)
+        r("/api/v1/read", self.h_remote_read)
+        r("/api/v1/export/native", self.h_export_native)
         r("/api/v1/admin/tsdb/delete_series", self.h_delete_series)
         r("/api/v1/status/tsdb", self.h_status_tsdb)
         r("/api/v1/status/active_queries", self.h_active_queries)
         r("/api/v1/status/top_queries", self.h_top_queries)
+        r("/api/v1/metadata", self.h_metadata)
+        r("/api/v1/status/metric_names_stats", self.h_name_stats)
         r("/federate", self.h_federate)
         if hasattr(self.storage, "create_snapshot"):
             r("/snapshot/create", self.h_snapshot_create)
@@ -349,6 +359,7 @@ class PrometheusAPI:
             ec.tracer = qt
             with self.gate:
                 rows = exec_query(ec, q)
+            self._track_usage(rows)
         except TimeoutError as e:
             resp = Response.error(str(e), 429, "too_many_requests")
             resp.headers["Retry-After"] = "10"
@@ -406,6 +417,7 @@ class PrometheusAPI:
                     rows = exec_query(ec, q)
                 else:
                     rows = self._exec_range_cached(ec, q, now)
+            self._track_usage(rows)
         except TimeoutError as e:
             resp = Response.error(str(e), 429, "too_many_requests")
             resp.headers["Retry-After"] = "10"
@@ -552,6 +564,95 @@ class PrometheusAPI:
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
 
+    def h_export_native(self, req: Request) -> Response:
+        """Binary export (reference /api/v1/export/native,
+        app/vmselect/prometheus/export.go): zstd-framed series blocks —
+        marshaled MetricName + raw int64 timestamp/float64 value arrays.
+        Round-trips losslessly through /api/v1/import/native."""
+        from ..ops import compress as zstd_c
+        from ..parallel.rpc import Writer
+        try:
+            fl = self._matches_to_filters(req)
+            if not fl:
+                return Response.error("missing match[] arg")
+            start, end = self._time_range(req, full_default=True)
+            out = bytearray(b"vmtpu-native-v1\n")
+            for filters in fl:
+                for sd in self.storage.search_series(
+                        filters, start, end, tenant=self._tenant(req)):
+                    w = Writer()
+                    w.bytes_(sd.metric_name.marshal())
+                    w.array(np.asarray(sd.timestamps, dtype=np.int64))
+                    w.array(np.asarray(sd.values, dtype=np.float64))
+                    frame = zstd_c.compress(bytes(w.buf))
+                    out += struct.pack("<I", len(frame))
+                    out += frame
+            return Response(200, bytes(out),
+                            content_type="application/octet-stream")
+        except (QueryError, ParseError, ValueError) as e:
+            return Response.error(str(e))
+
+    def h_import_native(self, req: Request) -> Response:
+        from ..ops import compress as zstd_c
+        from ..parallel.rpc import Reader
+        body = req.body
+        magic = b"vmtpu-native-v1\n"
+        if not body.startswith(magic):
+            return Response.error("bad native export header", 400)
+        off = len(magic)
+        batch = []
+        try:
+            while off < len(body):
+                (flen,) = struct.unpack_from("<I", body, off)
+                off += 4
+                r = Reader(zstd_c.decompress(body[off:off + flen]))
+                off += flen
+                mn = MetricName.unmarshal(r.bytes_())
+                ts = r.array()
+                vals = r.array()
+                labels = mn.to_dict()
+                for t, v in zip(ts.tolist(), vals.tolist()):
+                    batch.append((labels, t, v))
+        except Exception as e:  # noqa: BLE001 — any parse failure is a 400
+            return Response.error(f"cannot parse native import: {e}", 400)
+        self._ingest(batch, self._tenant(req))
+        return Response(status=204, body=b"")
+
+    def h_remote_read(self, req: Request) -> Response:
+        """Prometheus remote_read server (the reference serves this at
+        app/vmselect; lets Prometheus/Thanos/vmctl pull data out)."""
+        from ..storage.tag_filters import TagFilter
+        try:
+            # server.py already decompressed bodies carrying a
+            # Content-Encoding header; clients omitting it still send
+            # snappy (protocol default)
+            try:
+                queries = list(remote_write.parse_read_request(req.body,
+                                                               "none"))
+            except Exception:
+                queries = list(remote_write.parse_read_request(req.body,
+                                                               "snappy"))
+            results = []
+            for start, end, matchers in queries:
+                filters = []
+                for op, name, value in matchers:
+                    key = b"" if name == "__name__" else name.encode()
+                    filters.append(TagFilter(
+                        key, value.encode(), negate=op.startswith("!"),
+                        regex=op.endswith("~")))
+                series = []
+                for sd in self.storage.search_series(
+                        filters, start, end, max_series=self.max_series,
+                        tenant=self._tenant(req)):
+                    mask = ~np.isnan(sd.values)
+                    series.append((sd.metric_name.to_dict(),
+                                   sd.timestamps[mask], sd.values[mask]))
+                results.append(series)
+            body = remote_write.build_read_response(results)
+            return Response(200, body, "application/x-protobuf")
+        except (ValueError, ResourceWarning) as e:
+            return Response.error(f"cannot serve remote read: {e}", 400)
+
     def h_federate(self, req: Request) -> Response:
         try:
             fl = self._matches_to_filters(req)
@@ -659,6 +760,11 @@ class PrometheusAPI:
     def h_import_prometheus(self, req: Request) -> Response:
         try:
             ts = parse_time(req.arg("timestamp"), 0)
+            text_md = req.body.decode("utf-8", "replace")
+            if "# TYPE" in text_md or "# HELP" in text_md:
+                md = parsers.parse_prometheus_metadata(text_md)
+                if len(self.metadata) < 100_000:
+                    self.metadata.update(md)
             self._add_rows(parsers.parse_prometheus(
                 req.body.decode("utf-8", "replace"), ts), self._tenant(req))
         except (ValueError, QueryError) as e:
@@ -708,6 +814,14 @@ class PrometheusAPI:
             return Response.error(f"cannot parse OTLP payload: {e}", 400)
         # empty body = valid empty ExportMetricsServiceResponse proto
         return Response(200, b"", "application/x-protobuf")
+
+    def h_zabbix(self, req: Request) -> Response:
+        try:
+            self._add_rows(parsers.parse_zabbixconnector(
+                req.body.decode("utf-8", "replace")), self._tenant(req))
+        except (ValueError, KeyError) as e:
+            return Response.error(f"cannot parse zabbix history: {e}", 400)
+        return Response(status=204, body=b"")
 
     def h_datadog_v1(self, req: Request) -> Response:
         try:
@@ -772,6 +886,50 @@ class PrometheusAPI:
             "topBySumDuration": self.qstats.top(n, "sumDuration"),
             "topByAvgDuration": self.qstats.top(n, "avgDuration"),
         })
+
+    def _track_usage(self, rows):
+        now = int(time.time())
+        for r in rows:
+            g = r.metric_name.metric_group
+            if not g:
+                continue
+            name = g.decode("utf-8", "replace")
+            e = self.name_usage.get(name)
+            if e is None:
+                if len(self.name_usage) >= 100_000:
+                    continue
+                e = self.name_usage[name] = [0, 0]
+            e[0] += 1
+            e[1] = now
+
+    def h_metadata(self, req: Request) -> Response:
+        """Prometheus /api/v1/metadata shape."""
+        limit = int(req.arg("limit", "0") or 0)
+        metric = req.arg("metric", "")
+        data = {}
+        for name, md in self.metadata.items():
+            if metric and name != metric:
+                continue
+            data[name] = [{"type": md["type"] or "unknown",
+                           "help": md["help"], "unit": ""}]
+            if limit and len(data) >= limit:
+                break
+        return Response.json({"status": "success", "data": data})
+
+    def h_name_stats(self, req: Request) -> Response:
+        """Per-metric-name query usage (the reference's
+        /api/v1/status/metric_names_stats, lib/storage/metricnamestats)."""
+        limit = int(req.arg("limit", "1000") or 1000)
+        le = req.arg("le", "")
+        items = [{"metricName": n, "requestsCount": c,
+                  "lastRequestTimestamp": t}
+                 for n, (c, t) in self.name_usage.items()]
+        if le:
+            items = [x for x in items if x["requestsCount"] <= int(le)]
+        items.sort(key=lambda x: x["requestsCount"])
+        return Response.json({"status": "success",
+                              "statsCollectedSince": int(self.started_at),
+                              "records": items[:limit]})
 
     def h_metrics(self, req: Request) -> Response:
         lines = []

@@ -251,22 +251,32 @@ def _parse_ec2_instances(xml: str) -> list[dict]:
     return out
 
 
-def _sigv4_headers(method: str, url: str, body: str, region: str,
+def _sigv4_headers(method: str, url: str, body, region: str,
                    service: str, access_key: str, secret_key: str) -> dict:
-    """Minimal AWS Signature Version 4 (lib/awsapi/sign.go analog)."""
+    """AWS Signature Version 4 (lib/awsapi/sign.go analog): hashes the RAW
+    byte payload, sends x-amz-content-sha256 (required by S3), and
+    canonicalizes the query string in sorted order."""
     import datetime
     import hashlib
     import hmac
-    from urllib.parse import urlparse
+    from urllib.parse import parse_qsl, quote, urlparse
+    if isinstance(body, str):
+        body = body.encode()
     u = urlparse(url)
     now = datetime.datetime.now(datetime.timezone.utc)
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     datestamp = now.strftime("%Y%m%d")
-    payload_hash = hashlib.sha256(body.encode()).hexdigest()
-    canonical_headers = f"host:{u.netloc}\nx-amz-date:{amz_date}\n"
-    signed_headers = "host;x-amz-date"
-    canonical = "\n".join([method, u.path or "/", u.query,
-                           canonical_headers, signed_headers, payload_hash])
+    payload_hash = hashlib.sha256(body).hexdigest()
+    q = sorted(parse_qsl(u.query, keep_blank_values=True))
+    canonical_query = "&".join(
+        f"{quote(k, safe='-_.~')}={quote(v, safe='-_.~')}" for k, v in q)
+    canonical_headers = (f"host:{u.netloc}\n"
+                         f"x-amz-content-sha256:{payload_hash}\n"
+                         f"x-amz-date:{amz_date}\n")
+    signed_headers = "host;x-amz-content-sha256;x-amz-date"
+    canonical = "\n".join([method, quote(u.path or "/", safe="/-_.~"),
+                           canonical_query, canonical_headers,
+                           signed_headers, payload_hash])
     scope = f"{datestamp}/{region}/{service}/aws4_request"
     to_sign = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
                          hashlib.sha256(canonical.encode()).hexdigest()])
@@ -281,7 +291,8 @@ def _sigv4_headers(method: str, url: str, body: str, region: str,
     sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
     auth = (f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
             f"SignedHeaders={signed_headers}, Signature={sig}")
-    return {"Authorization": auth, "X-Amz-Date": amz_date}
+    return {"Authorization": auth, "X-Amz-Date": amz_date,
+            "X-Amz-Content-Sha256": payload_hash}
 
 
 PROVIDERS = {

@@ -496,3 +496,63 @@ def _snake(s: str) -> str:
             out.append("_")
         out.append(c.lower())
     return "".join(out).replace(".", "_").replace("-", "_")
+
+
+# -- Zabbix Connector (lib/protoparser/zabbixconnector/parser.go) -------------
+
+def parse_zabbixconnector(text: str):
+    """JSON lines from Zabbix real-time export (item values):
+    {"host":{"host":"h","name":"visible"},"name":"item","value":1.5,
+     "clock":..., "ns":..., "item_tags":[{"tag":"t","value":"v"},...]}
+    Labels: __name__=name, host, hostname, tag_<k>=<v>."""
+    import json as _json
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            o = _json.loads(line)
+        except ValueError:
+            continue
+        host = o.get("host") or {}
+        name = o.get("name")
+        if not host.get("host") or not host.get("name") or not name:
+            continue
+        if "value" not in o or "clock" not in o:
+            continue
+        try:
+            value = float(o["value"])
+            ts = int(o["clock"]) * 1000 + int(o.get("ns", 0)) // 1_000_000
+        except (TypeError, ValueError):
+            continue
+        labels = [("__name__", str(name)), ("host", str(host["host"])),
+                  ("hostname", str(host["name"]))]
+        for t in o.get("item_tags") or []:
+            k = t.get("tag")
+            v = t.get("value", "")
+            if k and v:
+                labels.append((f"tag_{k}", str(v)))
+        yield Row(labels, ts, value)
+
+
+def parse_prometheus_metadata(text: str) -> dict:
+    """# HELP / # TYPE comments -> {metric: {"type": t, "help": h}}
+    (lib/storage/metricsmetadata source data)."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("#"):
+            continue
+        parts = line.split(None, 3)
+        # strictly "# TYPE <name> <type>" / "# HELP <name> <text>" — any
+        # other comment is ignored
+        if len(parts) < 4 or parts[0] != "#" or \
+                parts[1] not in ("HELP", "TYPE"):
+            continue
+        kind, name, rest = parts[1], parts[2], parts[3]
+        e = out.setdefault(name, {"type": "", "help": ""})
+        if kind == "TYPE":
+            e["type"] = rest.strip()
+        else:
+            e["help"] = rest
+    return out
