@@ -109,17 +109,9 @@ def a_mode(m):
     return out
 
 
-@_guard
-def a_any(m):
-    # first non-NaN per column, by series order
-    out = np.full(m.shape[1], nan)
-    for i in range(m.shape[0] - 1, -1, -1):
-        row = m[i]
-        out = np.where(np.isnan(row), out, row)
-    return out
-
-
 def a_quantile(m, phi: float):
+    if np.isnan(phi):
+        return np.full(m.shape[1], nan)
     with np.errstate(all="ignore"):
         out = np.full(m.shape[1], nan)
         ok = ~_nan_all(m)
@@ -159,7 +151,6 @@ SIMPLE = {
     "count": a_count, "stddev": a_stddev, "stdvar": a_stdvar,
     "group": a_group, "median": a_median, "sum2": a_sum2,
     "geomean": a_geomean, "distinct": a_distinct, "mode": a_mode,
-    "any": a_any,
 }
 
 # matrix-preserving aggregates: output one series per input series

@@ -65,6 +65,45 @@ def signature(mn: MetricName, on: list[str] | None, ignoring: list[str] | None
     return tuple((k, v) for k, v in mn.labels if k not in ig)
 
 
+def _merge_non_overlapping(dst: Timeseries, src: Timeseries) -> bool:
+    """Merge src into dst when they overlap in <=2 points and have enough
+    points (binary_op.go:367 mergeNonOverlappingTimeseries): duplicate
+    signatures from complementary filters like (m<10, m>=10) combine."""
+    sv, dv = src.values, dst.values
+    overlaps = int((~np.isnan(sv) & ~np.isnan(dv)).sum())
+    if overlaps > 2:
+        return False
+    if sv.size <= 2 and dv.size <= 2:
+        return False
+    ok = ~np.isnan(sv)
+    dv[ok] = sv[ok]  # src wins at the (<=2) overlap points, like the ref
+    return True
+
+
+def _group_by_sig(series, on, ignoring):
+    m: dict[tuple, list] = {}
+    order = []
+    for ts in series:
+        sig = signature(ts.metric_name, on, ignoring)
+        if sig not in m:
+            order.append(sig)
+        m.setdefault(sig, []).append(ts)
+    return m, order
+
+
+def _merge_group(tss, side: str, op: str) -> Timeseries:
+    """Collapse one signature group by non-overlapping merge; raise only
+    when the group genuinely overlaps (ensureSingleTimeseries semantics —
+    unmatched groups never reach this)."""
+    cur = Timeseries(tss[0].metric_name, tss[0].values.copy())
+    for ts in tss[1:]:
+        if not _merge_non_overlapping(cur, ts):
+            raise ValueError(
+                f"duplicate time series on the {side} side of {op}: "
+                f"{ts.metric_name}")
+    return cur
+
+
 def _result_labels(left_mn: MetricName, keep_name: bool) -> MetricName:
     return MetricName(left_mn.metric_group if keep_name else b"",
                       list(left_mn.labels))
@@ -95,19 +134,18 @@ def eval_binary_op(op: str, left: list[Timeseries], right: list[Timeseries],
 
     out: list[Timeseries] = []
     if many is not None:
-        one_by_sig: dict[tuple, Timeseries] = {}
-        for ts in one:
-            sig = signature(ts.metric_name, on, ignoring)
-            if sig in one_by_sig:
-                raise ValueError(
-                    f"duplicate series on the 'one' side of {op} "
-                    f"{join_mod.op} for {ts.metric_name}")
-            one_by_sig[sig] = ts
+        one_groups, _ = _group_by_sig(one, on, ignoring)
+        one_by_sig = {}
         extra = [l.encode() for l in join_mod.args]
         for m_ts in many:
-            o_ts = one_by_sig.get(signature(m_ts.metric_name, on, ignoring))
+            sig = signature(m_ts.metric_name, on, ignoring)
+            o_ts = one_by_sig.get(sig)
             if o_ts is None:
-                continue
+                grp = one_groups.get(sig)
+                if grp is None:
+                    continue
+                o_ts = one_by_sig[sig] = _merge_group(
+                    grp, f"'one' ({join_mod.op})", op)
             lv, rv = (m_ts.values, o_ts.values)
             a, b = (lv, rv) if join_mod.op == "group_left" else (rv, lv)
             vals = _apply(fn, a, b, is_cmp, bool_modifier,
@@ -123,22 +161,14 @@ def eval_binary_op(op: str, left: list[Timeseries], right: list[Timeseries],
             out.append(Timeseries(mn, vals))
         return out
 
-    right_by_sig: dict[tuple, Timeseries] = {}
-    for ts in right:
-        sig = signature(ts.metric_name, on, ignoring)
-        if sig in right_by_sig:
-            raise ValueError(f"duplicate series on right side of {op}: "
-                             f"{ts.metric_name}")
-        right_by_sig[sig] = ts
-    seen = set()
-    for l_ts in left:
-        sig = signature(l_ts.metric_name, on, ignoring)
-        r_ts = right_by_sig.get(sig)
-        if r_ts is None:
-            continue
-        if sig in seen:
-            raise ValueError(f"duplicate series on left side of {op}")
-        seen.add(sig)
+    right_groups, _ = _group_by_sig(right, on, ignoring)
+    left_groups, left_order = _group_by_sig(left, on, ignoring)
+    for sig in left_order:
+        r_grp = right_groups.get(sig)
+        if r_grp is None:
+            continue  # unmatched groups are dropped, duplicates and all
+        l_ts = _merge_group(left_groups[sig], "left", op)
+        r_ts = _merge_group(r_grp, "right", op)
         vals = _apply(fn, l_ts.values, r_ts.values, is_cmp, bool_modifier,
                       keep_left=l_ts.values)
         mn = _result_labels(l_ts.metric_name,
@@ -171,57 +201,125 @@ def _apply(fn, a, b, is_cmp, bool_modifier, keep_left):
     return np.where(m, keep_left, nan)
 
 
+def _group_map(series, on, ignoring):
+    m: dict[tuple, list] = {}
+    for ts in series:
+        m.setdefault(signature(ts.metric_name, on, ignoring), []).append(ts)
+    return m
+
+
+def _any_right_value(rights):
+    """[T] bool: does ANY series in the group have a value at each step."""
+    return ~np.all(np.vstack([np.isnan(r.values) for r in rights]), axis=0)
+
+
+def _is_scalar_group(tss) -> bool:
+    return (len(tss) == 1 and not tss[0].metric_name.metric_group
+            and not tss[0].metric_name.labels)
+
+
+def _series_by_key(m: dict, sig):
+    """mr lookup with the reference's seriesByKey fallback: a lone
+    scalar-signature right group matches every left signature."""
+    got = m.get(sig)
+    if got is not None:
+        return got
+    if len(m) == 1:
+        (only,) = m.values()
+        if _is_scalar_group(only):
+            return only
+    return None
+
+
 def _eval_set_op(op, left, right, on, ignoring):
-    right_sigs = {}
-    for ts in right:
-        right_sigs.setdefault(signature(ts.metric_name, on, ignoring), ts)
-    out = []
-    if op == "and":
-        for ts in left:
-            r = right_sigs.get(signature(ts.metric_name, on, ignoring))
-            if r is not None:
-                vals = np.where(np.isnan(r.values), nan, ts.values)
-                out.append(Timeseries(ts.metric_name, vals))
+    """Group-based per-point set ops (binary_op.go:416-623): groups are the
+    on()/ignoring() signature; and/if mask left to right-present points,
+    unless/ifnot to right-absent, default fills left gaps from the group,
+    or merges per point (with whole-labelset merge for identical series)."""
+    ml = _group_map(left, on, ignoring)
+    mr = _group_map(right, on, ignoring)
+    out: list[Timeseries] = []
+
+    if op in ("and", "if"):
+        for sig, lefts in ml.items():
+            rights = mr.get(sig) if op == "and" else _series_by_key(mr, sig)
+            if not rights:
+                continue
+            has = _any_right_value(rights)
+            for ts in lefts:
+                out.append(Timeseries(ts.metric_name,
+                                      np.where(has, ts.values, nan)))
         return out
-    if op == "unless":
-        for ts in left:
-            r = right_sigs.get(signature(ts.metric_name, on, ignoring))
-            if r is None:
-                out.append(ts)
-            else:
-                vals = np.where(np.isnan(r.values), ts.values, nan)
-                out.append(Timeseries(ts.metric_name, vals))
+
+    if op in ("unless", "ifnot"):
+        for sig, lefts in ml.items():
+            rights = (mr.get(sig) if op == "unless"
+                      else _series_by_key(mr, sig))
+            if not rights:
+                out.extend(lefts)
+                continue
+            has = _any_right_value(rights)
+            for ts in lefts:
+                out.append(Timeseries(ts.metric_name,
+                                      np.where(has, nan, ts.values)))
         return out
-    if op == "or":
-        left_sigs = {signature(ts.metric_name, on, ignoring) for ts in left}
-        out = list(left)
-        for ts in right:
-            if signature(ts.metric_name, on, ignoring) not in left_sigs:
-                out.append(ts)
-        return out
+
     if op == "default":
-        for ts in left:
-            r = right_sigs.get(signature(ts.metric_name, on, ignoring))
-            if r is None:
-                out.append(ts)
-            else:
-                vals = np.where(np.isnan(ts.values), r.values, ts.values)
+        if not ml:
+            for rights in mr.values():
+                out.extend(rights)
+            return out
+        for sig, lefts in ml.items():
+            rights = _series_by_key(mr, sig)
+            if not rights:
+                out.extend(lefts)
+                continue
+            for ts in lefts:
+                vals = ts.values.copy()
+                for r in rights:
+                    gap = np.isnan(vals)
+                    if not gap.any():
+                        break
+                    vals[gap] = r.values[gap]
                 out.append(Timeseries(ts.metric_name, vals))
         return out
-    if op == "if":
-        for ts in left:
-            r = right_sigs.get(signature(ts.metric_name, on, ignoring))
-            if r is not None:
-                vals = np.where(np.isnan(r.values), nan, ts.values)
-                out.append(Timeseries(ts.metric_name, vals))
+
+    if op == "or":
+        # left side first (non-empty series), then per-group right handling
+        # (binary_op.go:483 binaryOpOr)
+        kept_left: dict[tuple, list] = {}
+        for sig, lefts in ml.items():
+            # copies: the merge below fills left gaps in place
+            keep = [Timeseries(ts.metric_name, ts.values.copy())
+                    for ts in lefts if not np.isnan(ts.values).all()]
+            kept_left[sig] = keep
+            out.extend(keep)
+        out.sort(key=lambda ts: ts.metric_name.marshal())
+        n_left = len(out)
+        for sig, rights in mr.items():
+            lefts = kept_left.get(sig)
+            if not lefts:
+                out.extend(rights)
+                continue
+            rights = [Timeseries(r.metric_name, r.values.copy())
+                      for r in rights]
+            scalar_right = _is_scalar_group(rights)
+            for ts in lefts:
+                merged_scalar = scalar_right and _is_scalar_group([ts])
+                lname = ts.metric_name.marshal()
+                for r in rights:
+                    mergeable = merged_scalar or                         r.metric_name.marshal() == lname
+                    left_nan = np.isnan(ts.values)
+                    if mergeable:
+                        ts.values[left_nan] = r.values[left_nan]
+                        r.values[:] = nan
+                    else:
+                        r.values[~left_nan] = nan
+            extra = [r for r in rights if not np.isnan(r.values).all()]
+            extra.sort(key=lambda ts: ts.metric_name.marshal())
+            out.extend(extra)
+        out[n_left:] = sorted(out[n_left:],
+                              key=lambda ts: ts.metric_name.marshal())
         return out
-    if op == "ifnot":
-        for ts in left:
-            r = right_sigs.get(signature(ts.metric_name, on, ignoring))
-            if r is None:
-                out.append(ts)
-            else:
-                vals = np.where(np.isnan(r.values), ts.values, nan)
-                out.append(Timeseries(ts.metric_name, vals))
-        return out
+
     raise ValueError(f"unknown set op {op}")

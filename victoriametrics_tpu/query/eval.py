@@ -46,7 +46,7 @@ def eval_expr(ec: EvalConfig, e: Expr) -> list[Timeseries]:
     if isinstance(e, DurationExpr):
         return [const_series(ec, e.value_ms(ec.step) / 1e3)]
     if isinstance(e, StringExpr):
-        raise QueryError("string literal is not a valid expression here")
+        return []  # bare string literals evaluate to no series (exec_test)
     if isinstance(e, MetricExpr):
         re_ = RollupExpr(expr=e)
         return _eval_rollup_expr(ec, "default_rollup", re_, ())
@@ -445,13 +445,15 @@ def _group_key(mn: MetricName, grouping: list[bytes], without: bool) -> bytes:
         kept = [(k, v) for k, v in mn.labels if k not in grouping]
         return MetricName(b"", kept).marshal()
     kept = []
+    group = b""
     for g in grouping:
         if g == b"__name__":
+            group = mn.metric_group  # sum by (__name__) keeps the name
             continue
         v = mn.get_label(g)
         if v is not None:
             kept.append((g, v))
-    return MetricName(b"", sorted(kept)).marshal()
+    return MetricName(group, sorted(kept)).marshal()
 
 
 def _group_series(series: list[Timeseries], grouping: list[str],
@@ -550,15 +552,19 @@ def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
     # arg layouts
     if name in ("topk", "bottomk", "limitk", "outliersk") or \
             name.startswith(("topk_", "bottomk_")):
-        if len(ae.args) != 2:
+        remaining = None
+        if len(ae.args) == 3 and isinstance(ae.args[2], StringExpr) and \
+                name.startswith(("topk_", "bottomk_")):
+            remaining = ae.args[2].value  # remaining-sum series tag
+        elif len(ae.args) != 2:
             raise QueryError(f"{name} needs (k, q)")
         k = float(eval_expr(ec, ae.args[0])[0].values[0])
         series = eval_expr(ec, ae.args[1])
-        if np.isnan(k):
-            k = 0.0
+        if np.isnan(k) or k < 0:
+            k = 0.0  # getIntK clamps (aggr.go:793)
         elif np.isinf(k):
             k = float(len(series))
-        return _eval_topk_family(ec, ae, name, k, series)
+        return _eval_topk_family(ec, ae, name, k, series, remaining)
     if name == "quantile":
         phi = float(eval_expr(ec, ae.args[0])[0].values[0])
         series = eval_expr(ec, ae.args[1])
@@ -610,6 +616,14 @@ def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
     if name == "histogram":
         series = [ts for a in ae.args for ts in eval_expr(ec, a)]
         return _eval_histogram_aggr(ec, ae, series)
+
+    if name == "any":
+        # first series per group, ORIGINAL identity kept (aggr.go:156)
+        series = [ts for a in ae.args for ts in eval_expr(ec, a)]
+        groups, _ = _group_series(series, ae.grouping, ae.without)
+        out = [rows[0] for rows in groups.values()]
+        out.sort(key=lambda ts: ts.metric_name.marshal())
+        return out
 
     series = [ts for a in ae.args for ts in eval_expr(ec, a)]
     fn = SIMPLE.get(name)
@@ -673,7 +687,34 @@ def _eval_per_series(ec, ae, fn, series) -> list[Timeseries]:
     return out
 
 
-def _eval_topk_family(ec, ae, name, k, series) -> list[Timeseries]:
+def _remaining_sum_series(ec, ae, rows, selected_idx, tag_spec: str
+                          ) -> Timeseries:
+    """Sum of the NON-selected series, tagged tag[=value]
+    (aggr.go:751 getRemainingSumTimeseries)."""
+    if "=" in tag_spec:
+        tag, _, value = tag_spec.partition("=")
+    else:
+        tag = value = tag_spec
+    base = rows[0].metric_name
+    gb = {g.encode() for g in ae.grouping}
+    if ae.without:
+        labels = [(kk, vv) for kk, vv in base.labels if kk not in gb]
+    else:
+        labels = [(kk, vv) for kk, vv in base.labels if kk in gb]
+    labels = [(kk, vv) for kk, vv in labels if kk != tag.encode()]
+    labels.append((tag.encode(), value.encode()))
+    mn = MetricName(b"", sorted(labels))
+    rest = [r for i, r in enumerate(rows) if i not in selected_idx]
+    if not rest:
+        return Timeseries(mn, np.full(ec.n_points, nan))
+    m = np.vstack([r.values for r in rest])
+    with np.errstate(all="ignore"):
+        vals = np.where(np.isnan(m).all(axis=0), nan, np.nansum(m, axis=0))
+    return Timeseries(mn, vals)
+
+
+def _eval_topk_family(ec, ae, name, k, series,
+                      remaining: str | None = None) -> list[Timeseries]:
     groups, _ = _group_series(series, ae.grouping, ae.without)
     out = []
     bottom = name.startswith("bottomk")
@@ -687,6 +728,8 @@ def _eval_topk_family(ec, ae, name, k, series) -> list[Timeseries]:
                     out.append(Timeseries(ts.metric_name, vals))
         elif name == "limitk":
             import xxhash
+            if k <= 0:
+                continue
             ranked = sorted(rows, key=lambda ts: xxhash.xxh64_intdigest(
                 ts.metric_name.marshal()))
             out.extend(ranked[:int(k)])
@@ -705,6 +748,9 @@ def _eval_topk_family(ec, ae, name, k, series) -> list[Timeseries]:
             sel = order[:int(k)] if bottom else order[::-1][:int(k)]
             for i in sel:
                 out.append(rows[i])
+            if remaining is not None:
+                out.append(_remaining_sum_series(ec, ae, rows, set(
+                    int(i) for i in sel), remaining))
     return out
 
 
@@ -774,7 +820,29 @@ def _is_const_scalar(e: Expr) -> bool:
     return False
 
 
+def _is_union_expr(e: Expr) -> bool:
+    return isinstance(e, FuncExpr) and e.name in ("union", "")
+
+
 def _eval_binary(ec: EvalConfig, be: BinaryOpExpr) -> list[Timeseries]:
+    if be.op in ("==", "!=") and \
+            (_is_union_expr(be.left) or _is_union_expr(be.right)):
+        # `q == (v1,...,vN)` value-list filtering (binary_op.go:58)
+        left = eval_expr(ec, be.left)
+        right = eval_expr(ec, be.right)
+        if _is_union_expr(be.left):
+            left, right = right, left
+        if not left or not right:
+            return [] if be.op == "==" else left
+        vals_r = np.vstack([r.values for r in right])
+        out = []
+        for ts in left:
+            contained = np.any(vals_r == ts.values[None, :], axis=0)
+            keep = contained if be.op == "==" else ~contained
+            out.append(Timeseries(ts.metric_name,
+                                  np.where(keep, ts.values, nan)))
+        return out
+
     l_scalar = _is_const_scalar(be.left)
     r_scalar = _is_const_scalar(be.right)
     left = eval_expr(ec, be.left)

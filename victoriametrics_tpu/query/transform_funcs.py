@@ -65,27 +65,39 @@ MATH = {
 }
 
 
+def _arg_values(args, i, default=None):
+    """Per-point parameter: a 1-series arg yields its value ARRAY (so
+    clamp_min(q, time()) etc vary per step); plain floats broadcast."""
+    a = args[i] if i < len(args) else default
+    if isinstance(a, list):
+        if len(a) != 1:
+            raise ValueError("expected scalar arg")
+        return a[0].values
+    return float(a)
+
+
 def tf_round(ec, args):
-    nearest = _scalar_arg(args, 1, 1.0)
+    nearest = _arg_values(args, 1, 1.0)
     def fn(v):
-        if nearest == 1.0:
+        if np.isscalar(nearest) and nearest == 1.0:
             return np.round(v)
-        return np.round(v / nearest) * nearest
+        with np.errstate(all="ignore"):
+            return np.round(v / nearest) * nearest
     return _map_values(args[0], fn, keep_name=True)
 
 
 def tf_clamp(ec, args):
-    lo, hi = _scalar_arg(args, 1), _scalar_arg(args, 2)
+    lo, hi = _arg_values(args, 1), _arg_values(args, 2)
     return _map_values(args[0], lambda v: np.clip(v, lo, hi), keep_name=True)
 
 
 def tf_clamp_min(ec, args):
-    lo = _scalar_arg(args, 1)
+    lo = _arg_values(args, 1)
     return _map_values(args[0], lambda v: np.maximum(v, lo), keep_name=True)
 
 
 def tf_clamp_max(ec, args):
-    hi = _scalar_arg(args, 1)
+    hi = _arg_values(args, 1)
     return _map_values(args[0], lambda v: np.minimum(v, hi), keep_name=True)
 
 
@@ -145,6 +157,13 @@ DT_FUNCS = {
 # -- series shaping ------------------------------------------------------------
 
 def tf_scalar(ec, args):
+    if args and isinstance(args[0], str):
+        # scalar("-12.34"): numeric strings become scalars (reference
+        # transformScalar string fast path)
+        try:
+            return [const_series(ec, float(args[0]))]
+        except ValueError:
+            return [const_series(ec, nan)]
     series = args[0]
     if len(series) != 1:
         return [const_series(ec, nan)]
@@ -157,10 +176,20 @@ def tf_vector(ec, args):
     return list(args[0])
 
 
+def _is_scalar_series(series) -> bool:
+    return (len(series) == 1 and not series[0].metric_name.metric_group
+            and not series[0].metric_name.labels)
+
+
 def tf_union(ec, args):
+    series_args = [a for a in args if isinstance(a, list)]
+    if series_args and all(_is_scalar_series(a) for a in series_args):
+        # (v1, ..., vN) of scalars keeps every element — needed for
+        # `q == (v1,...,vN)` lists (transform.go:1731)
+        return [a[0] for a in series_args]
     seen = set()
     out = []
-    for series in args:
+    for series in series_args:
         for ts in series:
             key = ts.metric_name.marshal()
             if key not in seen:
@@ -170,13 +199,27 @@ def tf_union(ec, args):
 
 
 def tf_sort(ec, args, desc=False, by_last=False):
+    import functools
     series = list(args[0])
 
-    def key(ts):
-        with np.errstate(all="ignore"):
-            v = np.nanmean(ts.values) if not by_last else ts.values[-1]
-        return -v if desc else v
-    series.sort(key=lambda ts: (math.inf if np.isnan(key(ts)) else key(ts)))
+    def cmp(x, y):
+        a, b = x.values, y.values
+        n = a.size - 1
+        while n >= 0:
+            if not math.isnan(a[n]):
+                if math.isnan(b[n]):
+                    return 1   # a after b ("not less")
+                if a[n] != b[n]:
+                    break
+            elif not math.isnan(b[n]):
+                return -1
+            n -= 1
+        if n < 0:
+            return 0
+        if desc:
+            return -1 if b[n] < a[n] else 1
+        return -1 if a[n] < b[n] else 1
+    series.sort(key=functools.cmp_to_key(cmp))
     return series
 
 
@@ -315,7 +358,12 @@ def tf_interpolate(ec, args):
         ok = ~np.isnan(v)
         if ok.any() and not ok.all():
             idx = np.arange(v.size)
-            v = np.interp(idx, idx[ok], v[ok])
+            filled = np.interp(idx, idx[ok], v[ok])
+            # only interior gaps: leading/trailing NaNs stay NaN
+            # (transform.go:1268 skips leading/trailing)
+            first, last = idx[ok][0], idx[ok][-1]
+            inside = (idx >= first) & (idx <= last)
+            v = np.where(inside, filled, nan)
         out.append(Timeseries(ts.metric_name, v))
     return out
 
@@ -361,6 +409,12 @@ def tf_remove_resets(ec, args):
 
 
 # -- label manipulation ---------------------------------------------------------
+
+def _get_label(mn: MetricName, key: bytes):
+    if key == b"__name__":
+        return mn.metric_group or None
+    return mn.get_label(key)
+
 
 def _set_label(mn: MetricName, key: bytes, value: bytes):
     if key == b"__name__":
@@ -409,10 +463,10 @@ def tf_label_copy(ec, args, move=False):
         src = _string_arg(pairs, i).encode()
         dst = _string_arg(pairs, i + 1).encode()
         for ts in series:
-            v = ts.metric_name.get_label(src)
+            v = _get_label(ts.metric_name, src)
             if v:
                 _set_label(ts.metric_name, dst, v)
-                if move:
+                if move and src != dst:
                     _set_label(ts.metric_name, src, b"")
     return series
 
@@ -426,11 +480,19 @@ def tf_label_replace(ec, args):
     except re.error as e:
         raise ValueError(f"label_replace: bad regex: {e}")
     for ts in series:
-        v = (ts.metric_name.get_label(src.encode()) or b"").decode(
+        v = (_get_label(ts.metric_name, src.encode()) or b"").decode(
             "utf-8", "replace")
         m = rx.match(v)
         if m:
-            new = m.expand(repl.replace("$", "\\"))
+            # $1 / ${1} expand to the group, or "" when the group does not
+            # exist (Go regexp.Expand semantics — no error)
+            def _grp(gm):
+                gi = gm.group(1) or gm.group(2)
+                try:
+                    return m.group(int(gi)) or ""
+                except (IndexError, ValueError):
+                    return ""
+            new = re.sub(r"\$(?:\{(\w+)\}|(\d+))", _grp, repl)
             _set_label(ts.metric_name, dst.encode(), new.encode())
     return series
 
@@ -441,7 +503,7 @@ def tf_label_join(ec, args):
     sep = _string_arg(args, 2).encode()
     srcs = [a.encode() for a in args[3:] if isinstance(a, str)]
     for ts in series:
-        parts = [(ts.metric_name.get_label(s) or b"") for s in srcs]
+        parts = [(_get_label(ts.metric_name, s) or b"") for s in srcs]
         _set_label(ts.metric_name, dst, sep.join(parts))
     return series
 
@@ -451,7 +513,7 @@ def tf_label_value(ec, args):
     key = _string_arg(args, 1).encode()
     out = []
     for ts in series:
-        v = ts.metric_name.get_label(key)
+        v = _get_label(ts.metric_name, key)
         try:
             x = float(v) if v is not None else nan
         except ValueError:
@@ -550,16 +612,10 @@ def _group_buckets(series: list[Timeseries]):
 
 
 def tf_histogram_quantile(ec, args):
-    phi_arg = args[0]
+    phis = _arg_values(args, 0)
     series = _vmrange_to_le(list(args[1]))
-    phis = None
-    if isinstance(phi_arg, list):
-        if len(phi_arg) == 1:
-            phis = float(phi_arg[0].values[0])
-        else:
-            raise ValueError("histogram_quantile: phi must be scalar")
-    else:
-        phis = float(phi_arg)
+    bounds_label = args[2].encode() if len(args) > 2 and \
+        isinstance(args[2], str) else None
     out = []
     for key, (mn, buckets) in _group_buckets(series).items():
         buckets.sort(key=lambda b: b[0])
@@ -567,16 +623,35 @@ def tf_histogram_quantile(ec, args):
         m = np.vstack([b[1] for b in buckets])  # [B, T] cumulative counts
         with np.errstate(all="ignore"):
             vals = _hist_quantile_cols(phis, les, m)
+        if bounds_label:
+            # lower/upper bucket-edge bound series (prometheus issue 5706)
+            lo = np.full(vals.shape, nan)
+            hi = np.full(vals.shape, nan)
+            fin = np.isfinite(vals)
+            if fin.any():
+                for j in np.flatnonzero(fin):
+                    i = int(np.searchsorted(les, vals[j], side="left"))
+                    lo[j] = les[i - 1] if i > 0 else 0.0
+                    hi[j] = les[min(i, les.size - 1)]
+            for tag, bvals in ((b"lower", lo), (b"upper", hi)):
+                b = MetricName(mn.metric_group,
+                               [(k, v) for k, v in mn.labels
+                                if k != bounds_label] +
+                               [(bounds_label, tag)])
+                b.sort_labels()
+                out.append(Timeseries(b, bvals))
         out.append(Timeseries(mn, vals))
     return out
 
 
-def _hist_quantile_cols(phi: float, les: np.ndarray, m: np.ndarray) -> np.ndarray:
+def _hist_quantile_cols(phi, les: np.ndarray, m: np.ndarray) -> np.ndarray:
     T = m.shape[1]
+    phi_arr = np.broadcast_to(np.asarray(phi, dtype=np.float64), (T,))
     out = np.full(T, nan)
     if not np.isfinite(les[-1]) and les.size < 2:
         return out
     for j in range(T):
+        phi = float(phi_arr[j])
         counts = m[:, j]
         if np.isnan(counts).all():
             continue
@@ -632,24 +707,48 @@ def tf_prometheus_buckets(ec, args):
 
 
 def tf_buckets_limit(ec, args):
+    """Reduce per-group bucket count by merging the buckets with the
+    fewest hits, always keeping the first and last (transform.go:386)."""
     limit = int(_scalar_arg(args, 0))
-    groups = _group_buckets(args[1])
+    if limit <= 0:
+        return []
+    if limit < 3:
+        limit = 3  # preserve first/last for min/max accuracy
+    tss = _vmrange_to_le(list(args[1]))
+    groups: dict[bytes, list] = {}
+    for ts in tss:
+        le_b = ts.metric_name.get_label(b"le")
+        if not le_b:
+            continue
+        try:
+            le = float(le_b)
+        except ValueError:
+            continue
+        mn = MetricName(ts.metric_name.metric_group,
+                        [(k, v) for k, v in ts.metric_name.labels
+                         if k != b"le"])
+        groups.setdefault(mn.marshal(), []).append([le, 0.0, ts])
     out = []
-    for key, (mn, buckets) in groups.items():
-        buckets.sort(key=lambda b: b[0])
-        keep = buckets
-        if len(buckets) > limit and limit >= 2:
-            # always keep the first and +Inf buckets; thin the middle
-            step = (len(buckets) - 1) / (limit - 1)
-            idxs = sorted({0, len(buckets) - 1} |
-                          {int(round(i * step)) for i in range(limit)})
-            keep = [buckets[i] for i in idxs[:limit]]
-        for le, vals in keep:
-            mn2 = MetricName(mn.metric_group, list(mn.labels))
-            le_s = b"+Inf" if np.isinf(le) else repr(le).rstrip("0").rstrip(".").encode()
-            mn2.labels.append((b"le", le_s))
-            mn2.sort_labels()
-            out.append(Timeseries(mn2, vals))
+    for grp in groups.values():
+        if len(grp) <= limit:
+            out.extend(x[2] for x in grp)
+            continue
+        grp.sort(key=lambda x: x[0])
+        prev = np.zeros(grp[0][2].values.size)
+        for x in grp:
+            vals = np.nan_to_num(x[2].values)
+            x[1] = float((vals - prev).sum())
+            prev = vals
+        while len(grp) > limit:
+            best = 1
+            best_hits = grp[1][1] + grp[2][1]
+            for i in range(1, len(grp) - 2):
+                h = grp[i][1] + grp[i + 1][1]
+                if h < best_hits:
+                    best, best_hits = i, h
+            grp[best + 1][1] += grp[best][1]
+            del grp[best]
+        out.extend(x[2] for x in grp)
     return out
 
 
@@ -899,17 +998,19 @@ def _grouped_le_matrix(series):
 
 
 def tf_histogram_share(ec, args):
-    le_req = _scalar_arg(args, 0)
+    le_req = _arg_values(args, 0)
     bounds_label = args[2].encode() if len(args) > 2 and \
         isinstance(args[2], str) else None
     out = []
     for mn, les, m in _grouped_le_matrix(args[1]):
         T = m.shape[1]
+        le_arr = np.broadcast_to(np.asarray(le_req, dtype=np.float64),
+                                 (T,))
         q = np.full(T, nan)
         lo = np.full(T, nan)
         hi = np.full(T, nan)
         for j in range(T):
-            q[j], lo[j], hi[j] = _le_share(le_req, les, m, j)
+            q[j], lo[j], hi[j] = _le_share(float(le_arr[j]), les, m, j)
         out.append(Timeseries(mn, q))
         if bounds_label:
             for tag, vals in ((b"lower", lo), (b"upper", hi)):
@@ -923,16 +1024,18 @@ def tf_histogram_share(ec, args):
 
 
 def tf_histogram_fraction(ec, args):
-    lower, upper = _scalar_arg(args, 0), _scalar_arg(args, 1)
-    if lower >= upper:
+    lower, upper = _arg_values(args, 0), _arg_values(args, 1)
+    if np.isscalar(lower) and np.isscalar(upper) and lower >= upper:
         raise ValueError("histogram_fraction: lower le must be < upper le")
     out = []
     for mn, les, m in _grouped_le_matrix(args[2]):
         T = m.shape[1]
+        lo_arr = np.broadcast_to(np.asarray(lower, dtype=np.float64), (T,))
+        up_arr = np.broadcast_to(np.asarray(upper, dtype=np.float64), (T,))
         vals = np.full(T, nan)
         for j in range(T):
-            up, _, _ = _le_share(upper, les, m, j)
-            dn, _, _ = _le_share(lower, les, m, j)
+            up, _, _ = _le_share(float(up_arr[j]), les, m, j)
+            dn, _, _ = _le_share(float(lo_arr[j]), les, m, j)
             vals[j] = up - dn
         out.append(Timeseries(mn, vals))
     return out
