@@ -83,8 +83,10 @@ def main() -> None:
         for backend, engine in (("device", tpu), ("host-batch", None)):
             if backend == "device" and engine is None:
                 continue
+            # disable_cache: the bench measures the real fetch+compute
+            # path, not result-cache hits
             ec_kw = dict(start=t_start + 300_000, end=end, step=60_000,
-                         storage=s, tpu=engine)
+                         storage=s, tpu=engine, disable_cache=True)
             t0 = time.perf_counter()
             rows = exec_query(EvalConfig(**ec_kw), q)
             cold_dt = time.perf_counter() - t0

@@ -1,0 +1,98 @@
+"""Deterministic-time tests (the reference covers flush/rotation/retention
+with synctest bubbles, lib/storage/storage_synctest_test.go; here a fake
+clock via monkeypatch drives the same policies without sleeps)."""
+
+import pytest
+
+from victoriametrics_tpu.storage.storage import Storage
+from victoriametrics_tpu.storage.tag_filters import filters_from_dict
+
+DAY = 86_400_000
+
+
+class FakeClock:
+    def __init__(self, ms: int):
+        self.ms = ms
+
+    def time(self) -> float:
+        return self.ms / 1000.0
+
+    def advance(self, ms: int):
+        self.ms += ms
+
+
+@pytest.fixture()
+def clock(monkeypatch):
+    c = FakeClock(1_753_700_000_000)
+    import victoriametrics_tpu.storage.storage as st
+    monkeypatch.setattr(st.time, "time", c.time)
+    from victoriametrics_tpu.query.rollup_result_cache import GLOBAL
+    GLOBAL.reset()  # fake-clock tests must not see real-clock entries
+    return c
+
+
+class TestRetentionClock:
+    def test_partitions_drop_exactly_at_boundary(self, tmp_path, clock):
+        s = Storage(str(tmp_path / "rt"), retention_ms=40 * DAY)
+        t0 = clock.ms
+        old = t0 - 35 * DAY   # inside retention today
+        s.add_rows([({"__name__": "rm"}, old, 1.0),
+                    ({"__name__": "rm"}, t0, 2.0)])
+        s.force_flush()
+        assert s.enforce_retention() == 0  # still inside the window
+        f = filters_from_dict({"__name__": "rm"})
+        assert len(s.search_series(f, old - 1000, t0 + 1000)) == 1
+        # advance the clock: the old partition crosses the boundary
+        clock.advance(40 * DAY)
+        dropped = s.enforce_retention()
+        assert dropped >= 1
+        res = s.search_series(f, old - 1000, old + 1000)
+        assert res == [] or all(
+            (sd.timestamps > s.min_valid_ts).all() for sd in res)
+        s.close()
+
+    def test_min_valid_ts_tracks_clock(self, tmp_path, clock):
+        s = Storage(str(tmp_path / "mv"), retention_ms=10 * DAY)
+        before = s.min_valid_ts
+        clock.advance(3 * DAY)
+        assert s.min_valid_ts - before == 3 * DAY
+        s.close()
+
+
+class TestFlushDiscipline:
+    def test_rows_visible_at_every_flush_stage(self, tmp_path, clock):
+        """pending -> in-memory part -> file part: reads see the rows at
+        each stage with no sleeps (partition.go 2s/5s discipline driven
+        explicitly)."""
+        s = Storage(str(tmp_path / "fd"))
+        t0 = clock.ms
+        f = filters_from_dict({"__name__": "fm"})
+        s.add_rows([({"__name__": "fm"}, t0, 1.0)])
+        # stage 1: raw pending rows
+        assert len(s.search_series(f, t0 - 1000, t0 + 1000)) == 1
+        p = s.table.partition_for_ts(t0)
+        assert len(p._pending) == 1 and not p._mem_parts
+        # stage 2: in-memory part (the 2s flush tick)
+        s.table.flush_pending()
+        assert not p._pending and len(p._mem_parts) == 1
+        assert len(s.search_series(f, t0 - 1000, t0 + 1000)) == 1
+        # stage 3: durable file part (the 5s disk tick)
+        s.table.flush_to_disk()
+        assert not p._mem_parts and len(p._file_parts) == 1
+        assert len(s.search_series(f, t0 - 1000, t0 + 1000)) == 1
+        s.close()
+
+
+class TestLimiterClock:
+    def test_hourly_rotation_boundary(self, monkeypatch):
+        import victoriametrics_tpu.storage.cardinality as card
+        base = (1_753_700_000_000 // 3_600_000) * 3_600_000  # hour-aligned
+        c = FakeClock(base + 1000)
+        monkeypatch.setattr(card.time, "time", c.time)
+        lim = card.BloomLimiter(1, rotation_s=3600)
+        assert lim.add(1) and not lim.add(2)
+        c.advance(3_597_000)       # :59:58 — same hour bucket
+        assert not lim.add(2)
+        c.advance(2_000)           # crosses the hour boundary
+        assert lim.add(2)
+        assert lim.current_series == 1

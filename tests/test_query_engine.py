@@ -469,3 +469,38 @@ class TestQueryLimits:
         # inner subquery selector + outer selector together exceed the cap
         with pytest.raises(QueryLimitError):
             exec_query(ec, "max_over_time(rate(lm[1m])[5m:30s]) + rate(lm[5m])")
+
+
+class TestEvalRollupCache:
+    def test_repeated_eval_hits_cache(self, tmp_path):
+        import time as _t
+        from victoriametrics_tpu.query.rollup_result_cache import GLOBAL
+        s = Storage(str(tmp_path / "erc"))
+        now = int(_t.time() * 1000)
+        rows = [({"__name__": "erc", "i": str(i)},
+                 now - 3600_000 + j * 60_000, float(j))
+                for i in range(20) for j in range(50)]
+        s.add_rows(rows)
+        start = now - 3000_000
+        start -= start % 60_000
+        ec_kw = dict(start=start, end=start + 1800_000, step=60_000,
+                     storage=s)
+        h0 = GLOBAL.hits
+        r1 = exec_query(EvalConfig(**ec_kw), "avg_over_time(erc[5m])")
+        r2 = exec_query(EvalConfig(**ec_kw), "avg_over_time(erc[5m])")
+        assert GLOBAL.hits > h0
+        m1 = {ts.metric_name.marshal(): ts.values for ts in r1}
+        m2 = {ts.metric_name.marshal(): ts.values for ts in r2}
+        assert set(m1) == set(m2) and len(m1) == 20
+        for k in m1:
+            np.testing.assert_allclose(m1[k], m2[k], equal_nan=True)
+        # sub-expression reuse across DIFFERENT enclosing queries
+        r3 = exec_query(EvalConfig(**ec_kw),
+                        "sum(avg_over_time(erc[5m]))")
+        assert len(r3) == 1
+        # storages don't share cache entries
+        s2 = Storage(str(tmp_path / "erc2"))
+        assert exec_query(EvalConfig(**{**ec_kw, "storage": s2}),
+                          "avg_over_time(erc[5m])") == []
+        s2.close()
+        s.close()

@@ -414,6 +414,7 @@ class PrometheusAPI:
             with self.gate:
                 if req.arg("nocache") == "1":
                     # reference -search.disableCache / nocache=1 query arg
+                    ec.disable_cache = True
                     rows = exec_query(ec, q)
                 else:
                     rows = self._exec_range_cached(ec, q, now)

@@ -317,6 +317,8 @@ class ClusterStorage:
         self.rf = replication_factor
         self.deny_partial = deny_partial_response
         self.ch = ConsistentHash([n.name for n in nodes])
+        from ..query.rollup_result_cache import next_storage_token
+        self.cache_token = next_storage_token()
         self.rows_sent = 0
         self.reroutes = 0
         self._lock = threading.Lock()

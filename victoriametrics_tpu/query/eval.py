@@ -259,6 +259,10 @@ def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
             f"{e}; either narrow the selector or raise "
             f"-search.maxUniqueTimeseries") from None
     series = _drop_stale_nans(func, series)
+    if getattr(ec.storage, "last_partial", False):
+        # capture partiality PER QUERY right after the fetch: the shared
+        # storage flag is reset by every new incoming request
+        ec._partial[0] = True
     n_samples = sum(s.timestamps.size for s in series)
     ec.count_samples(n_samples)
     qt.donef("%d series, %d samples", len(series), n_samples)
@@ -274,6 +278,35 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
     me: MetricExpr = re_.expr
     if me.is_empty():
         return []
+
+    # eval-level per-expression rollup cache (rollup_result_cache.go:283):
+    # repeated and rolling evaluations of the same rollup recompute only
+    # the uncovered tail, independent of the enclosing query
+    use_cache = (ec.n_points > 1 and func != "default_rollup"
+                 and offset >= 0 and not ec.disable_cache)
+    ckey = None
+    if use_cache:
+        import time as _t
+
+        from .rollup_result_cache import GLOBAL as rcache
+        now_ms = int(_t.time() * 1000)
+        ckey = (f"rollup|{func}|{me}|{window}|{offset}|{args!r}|"
+                f"{keep_name}")
+        cached, new_start = rcache.get(ec, ckey, now_ms)
+        if cached is not None and new_start > ec.end:
+            ec.tracer.printf("eval rollup cache: full hit %s", ckey)
+            return cached
+        if cached is not None:
+            ec.tracer.printf("eval rollup cache: tail from %d", new_start)
+            sub = ec.child(start=new_start)
+            sub.disable_cache = True  # the suffix must not clobber ckey
+            fresh = _rollup_from_storage(sub, func, re_, window, offset,
+                                         args, keep_name)
+            rows = rcache.merge(cached, fresh, ec, new_start)
+            if not ec._partial[0]:
+                rcache.put(ec, ckey, rows, now_ms)
+            return rows
+
     series, cfg, admission = _fetch_series_for_rollup(ec, func, re_, window,
                                                       offset)
     with admission:
@@ -283,7 +316,8 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
             got = try_rollup_tpu(ec.tpu, func, series, cfg, args)
             if got is not None:
                 qt.donef("device path, %d series", len(got))
-                return _finish_rollup(series, got, keep_name)
+                return _cache_rollup(ec, ckey,
+                                     _finish_rollup(series, got, keep_name))
             qt.donef("fell back to host")
 
         qt = ec.tracer.new_child("host rollup %s", func)
@@ -293,7 +327,8 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
                 func, [(sd.timestamps, sd.values) for sd in series], cfg)
             if rows is not None:
                 qt.donef("%d series (batched)", len(series))
-                return _finish_rollup(series, list(rows), keep_name)
+                return _cache_rollup(
+                    ec, ckey, _finish_rollup(series, list(rows), keep_name))
         out_rows = []
         for i, sd in enumerate(series):
             if i % 256 == 0:
@@ -301,7 +336,8 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
             vals = rollup_series(func, sd.timestamps, sd.values, cfg, args)
             out_rows.append(vals)
         qt.donef("%d series", len(out_rows))
-        return _finish_rollup(series, out_rows, keep_name)
+        return _cache_rollup(ec, ckey,
+                             _finish_rollup(series, out_rows, keep_name))
 
 
 def _aggregate_absent_over_time(ec: EvalConfig, expr,
@@ -378,6 +414,15 @@ def _eval_multi_value_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
                 mn.sort_labels()
                 out.append(Timeseries(mn, row))
     return out
+
+
+def _cache_rollup(ec, ckey, rows):
+    if ckey is not None and not ec._partial[0]:
+        import time as _t
+
+        from .rollup_result_cache import GLOBAL as rcache
+        rcache.put(ec, ckey, rows, int(_t.time() * 1000))
+    return rows
 
 
 def _drop_stale_nans(func: str, series):

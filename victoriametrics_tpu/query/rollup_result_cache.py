@@ -12,6 +12,7 @@ cache (ResetRollupResultCacheIfNeeded analog)."""
 
 from __future__ import annotations
 
+import itertools
 import threading
 
 import numpy as np
@@ -24,30 +25,46 @@ from .types import EvalConfig, Timeseries
 OFFSET_MS = 5 * 60_000
 
 
+_storage_tokens = itertools.count(1)
+
+
+def next_storage_token() -> int:
+    """Unique per-storage-instance token for cache keys: id() could be
+    reused after GC, silently serving another storage's entries."""
+    return next(_storage_tokens)
+
+
 class RollupResultCache:
-    def __init__(self, max_entries: int = 1024):
+    def __init__(self, max_entries: int = 4096):
+        from collections import OrderedDict
         self._lock = threading.Lock()
         # key -> (c_start, c_end, {metric_name_raw: values ndarray})
-        self._cache: dict[tuple, tuple[int, int, dict]] = {}
+        self._cache: "OrderedDict[tuple, tuple[int, int, dict]]" = \
+            OrderedDict()
         self.max_entries = max_entries
         self.hits = 0
         self.misses = 0
 
     def _key(self, ec: EvalConfig, q: str) -> tuple:
-        # tenant MUST be part of the key: a shared entry would leak one
-        # tenant's results to another
-        return (ec.tenant, q, ec.step)
+        # tenant MUST be part of the key (a shared entry would leak across
+        # tenants), and so must the storage instance (one process can host
+        # several storages: tests, embedded setups)
+        token = getattr(ec.storage, "cache_token", None)
+        return (token if token is not None else id(ec.storage),
+                ec.tenant, q, ec.step)
 
     def get(self, ec: EvalConfig, q: str, now_ms: int
             ) -> tuple[list[Timeseries] | None, int]:
         """Returns (cached series on [ec.start, cov_end], first timestamp
         still to compute). (None, ec.start) on miss."""
         with self._lock:
-            e = self._cache.get(self._key(ec, q))
+            key = self._key(ec, q)
+            e = self._cache.get(key)
             if e is None or e[0] > ec.start or e[1] < ec.start or \
                     (ec.start - e[0]) % ec.step != 0:
                 self.misses += 1
                 return None, ec.start
+            self._cache.move_to_end(key)
             self.hits += 1
             c_start, c_end, series = e
         cov_end = min(c_end, ec.end)
@@ -70,9 +87,11 @@ class RollupResultCache:
         series = {ts.metric_name.marshal(): ts.values[:n].copy()
                   for ts in rows}
         with self._lock:
-            if len(self._cache) >= self.max_entries:
-                self._cache.clear()
-            self._cache[self._key(ec, q)] = (ec.start, cov_end, series)
+            key = self._key(ec, q)
+            self._cache[key] = (ec.start, cov_end, series)
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.max_entries:
+                self._cache.popitem(last=False)  # LRU, not clear-all
 
     def merge(self, cached: list[Timeseries], fresh: list[Timeseries],
               ec: EvalConfig, new_start: int) -> list[Timeseries]:

@@ -40,10 +40,12 @@ class EvalConfig:
     deadline: float = 0.0      # time.monotonic() cutoff; 0 = none
     round_digits: int = 100
     tenant: tuple = (0, 0)     # (accountID, projectID), lib/auth.Token analog
+    disable_cache: bool = False  # nocache=1 / -search.disableCache
     tracer: object = None      # querytracer.Tracer | NOP (set in __post_init__)
     tpu: object = None         # TPUEngine when the device path is enabled
     _grid: np.ndarray | None = None
     _samples_scanned: list | None = None  # shared per-query accumulator
+    _partial: list | None = None          # per-query partial-result flag
 
     def __post_init__(self):
         if self.tracer is None:
@@ -53,6 +55,8 @@ class EvalConfig:
             # created HERE (not lazily) so child() configs made before the
             # first fetch still share one per-query accumulator
             self._samples_scanned = [0]
+        if self._partial is None:
+            self._partial = [False]
         if self.step <= 0:
             raise ValueError("step must be positive")
         if self.end < self.start:
@@ -79,8 +83,10 @@ class EvalConfig:
                  max_samples_per_query=self.max_samples_per_query,
                  max_memory_per_query=self.max_memory_per_query,
                  deadline=self.deadline, tenant=self.tenant,
+                 disable_cache=self.disable_cache,
                  tracer=self.tracer, tpu=self.tpu,
-                 _samples_scanned=self._samples_scanned)
+                 _samples_scanned=self._samples_scanned,
+                 _partial=self._partial)
         d.update(kw)
         return EvalConfig(**d)
 

@@ -77,6 +77,8 @@ class Storage:
         self.rows_added = 0
         self.slow_row_inserts = 0
         self.new_series_created = 0
+        from ..query.rollup_result_cache import next_storage_token
+        self.cache_token = next_storage_token()
         self._load_caches()
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
         self._flusher.start()
@@ -279,6 +281,14 @@ class Storage:
                 self.idb.create_per_day_indexes(mn, tsid, date)
                 day_cache.add(dk)
                 out.append((tsid, ts, val))
+        if out:
+            # backfill older than the result-cache offset invalidates
+            # cached rollup tails (ResetRollupResultCacheIfNeeded) — at
+            # STORAGE level so library/embedded writers are covered too
+            from ..query.rollup_result_cache import GLOBAL, OFFSET_MS
+            oldest = min(r[1] for r in out)
+            if oldest < int(time.time() * 1000) - OFFSET_MS:
+                GLOBAL.reset()
         self.table.add_rows(out)
         self.rows_added += len(out)
         return len(out)
