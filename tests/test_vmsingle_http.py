@@ -632,3 +632,46 @@ class TestMetadataAndZabbix:
         recs = json.loads(body)["records"]
         assert any(r["metricName"] == "rw_metric" and r["requestsCount"] >= 2
                    for r in recs)
+
+
+class TestOpsEndpoints:
+    def test_flags_page(self, app):
+        code, body = app.get("/flags")
+        assert code == 200 and b"storageDataPath=" in body
+
+    def test_pprof_threads(self, app):
+        code, body = app.get("/debug/pprof/goroutine")
+        assert code == 200 and b"Thread" in body
+
+    def test_tenant_metrics(self, app):
+        app.post("/insert/3:4/prometheus/api/v1/import/prometheus",
+                 f"tm_m 1 {T0}\n".encode())
+        code, body = app.get("/metrics")
+        assert b'vm_tenant_inserted_rows_total{accountID="3",projectID="4"} 1' \
+            in body
+
+    def test_tls_server(self, tmp_path):
+        import ssl, subprocess, urllib.request
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout",
+             str(key), "-out", str(cert), "-days", "1", "-nodes", "-subj",
+             "/CN=localhost"], check=True, capture_output=True)
+        from victoriametrics_tpu.apps.vmsingle import build, parse_flags
+        args = parse_flags([f"-storageDataPath={tmp_path}/tls", "-tls",
+                            f"-tlsCertFile={cert}", f"-tlsKeyFile={key}",
+                            "-httpListenAddr=127.0.0.1:0"])
+        storage, srv, api = build(args)
+        srv.start()
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                    f"https://127.0.0.1:{srv.port}/health",
+                    context=ctx, timeout=10) as r:
+                assert r.read() == b"OK"
+        finally:
+            srv.stop()
+            storage.close()

@@ -60,6 +60,9 @@ def parse_flags(argv=None):
     p.add_argument("-pushmetrics.extraLabel", dest="pushmetrics_extra",
                    default="")
     p.add_argument("-loggerLevel", default="INFO")
+    p.add_argument("-tls", action="store_true")
+    p.add_argument("-tlsCertFile", default="")
+    p.add_argument("-tlsKeyFile", default="")
     args, _ = p.parse_known_args(argv)
     # env overrides: VM_STORAGEDATAPATH etc (envflag analog)
     for name in vars(args):
@@ -111,7 +114,9 @@ def build(args):
                                      lambda rows: storage.add_rows(rows))
         stream_aggr.start()
     host, _, port = args.httpListenAddr.rpartition(":")
-    srv = HTTPServer(host or "0.0.0.0", int(port))
+    srv = HTTPServer(host or "0.0.0.0", int(port),
+                     tls_cert_file=args.tlsCertFile if args.tls else "",
+                     tls_key_file=args.tlsKeyFile if args.tls else "")
     from ..ingest.serieslimits import SeriesLimits
     limits = SeriesLimits(max_labels_per_series=args.maxLabelsPerTimeseries,
                           max_label_value_len=args.maxLabelValueLen)
@@ -125,6 +130,7 @@ def build(args):
                         max_memory_per_query=args.max_memory_per_query,
                         max_query_duration_ms=_dur_ms(
                             args.max_query_duration))
+    api.flags_map = {k: v for k, v in vars(args).items()}
     api.register(srv)
     from ..httpapi.graphite_api import GraphiteAPI
     GraphiteAPI(storage).register(srv)

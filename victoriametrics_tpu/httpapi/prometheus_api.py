@@ -192,6 +192,7 @@ class PrometheusAPI:
         # TYPE/HELP metadata (lib/storage/metricsmetadata analog) and
         # per-metric-name query usage stats (lib/storage/metricnamestats)
         self.metadata: dict[str, dict] = {}
+        self.tenant_rows: dict[str, int] = {}
         self.name_usage: dict[str, list] = {}  # name -> [count, last_ts]
 
     # -- wiring ------------------------------------------------------------
@@ -211,6 +212,8 @@ class PrometheusAPI:
             srv.route("/vmui", self.h_vmui)
             srv.route("/vmui/", self.h_vmui)
         srv.route("/metrics", self.h_metrics)
+        srv.route("/flags", self.h_flags)
+        srv.route("/debug/pprof/", self.h_pprof)
         srv.route("/health", lambda req: Response.text("OK"))
         srv.route("/-/healthy", lambda req: Response.text("OK"))
         srv.route("/-/ready", lambda req: Response.text("OK"))
@@ -727,6 +730,10 @@ class PrometheusAPI:
                 rcache.reset()
         n = self.storage.add_rows(batch, tenant=tenant) if batch else 0
         self.rows_inserted += n
+        if n and tenant != (0, 0):
+            # tenantmetrics (lib/tenantmetrics CounterMap analog)
+            key = f'{{accountID="{tenant[0]}",projectID="{tenant[1]}"}}'
+            self.tenant_rows[key] = self.tenant_rows.get(key, 0) + n
         return n
 
     def h_remote_write(self, req: Request) -> Response:
@@ -932,6 +939,43 @@ class PrometheusAPI:
                               "statsCollectedSince": int(self.started_at),
                               "records": items[:limit]})
 
+    flags_map: dict | None = None  # set by apps for the /flags page
+
+    def h_flags(self, req: Request) -> Response:
+        """Flag values page (lib/httpserver/httpserver.go:400 /flags)."""
+        flags = self.flags_map or {}
+        body = "".join(f"{k}={v}\n" for k, v in sorted(flags.items()))
+        return Response.text(body or "# no flags registered\n")
+
+    def h_pprof(self, req: Request) -> Response:
+        """Pythonic /debug/pprof/: goroutine analog = thread stacks;
+        profile = cProfile over `seconds` of live traffic."""
+        kind = req.path.rsplit("/", 1)[-1]
+        if kind in ("goroutine", "threads", ""):
+            import sys
+            import traceback
+            names = {t.ident: t.name for t in threading.enumerate()}
+            parts = []
+            for tid, frame in sys._current_frames().items():
+                parts.append(f"Thread {names.get(tid, '?')} ({tid}):\n" +
+                             "".join(traceback.format_stack(frame)))
+            return Response.text("\n".join(parts))
+        if kind == "profile":
+            import cProfile
+            import io as _io
+            import pstats
+            seconds = min(float(req.arg("seconds", "5")), 60.0)
+            pr = cProfile.Profile()
+            pr.enable()
+            time.sleep(seconds)
+            pr.disable()
+            buf = _io.StringIO()
+            pstats.Stats(pr, stream=buf).sort_stats("cumulative")\
+                .print_stats(60)
+            return Response.text(buf.getvalue())
+        return Response.error(f"unsupported pprof kind {kind!r}", 404,
+                              "not_found")
+
     def h_metrics(self, req: Request) -> Response:
         lines = []
         m = dict(self.storage.metrics())
@@ -946,6 +990,8 @@ class PrometheusAPI:
             lines.append(f"{k} {v}")
         for lvl, cnt in logger.message_counters().items():
             lines.append(f'vm_log_messages_total{{level="{lvl}"}} {cnt}')
+        for tkey, cnt in sorted(self.tenant_rows.items()):
+            lines.append(f"vm_tenant_inserted_rows_total{tkey} {cnt}")
         return Response.text("\n".join(lines) + "\n")
 
     def h_snapshot_create(self, req: Request) -> Response:

@@ -62,7 +62,8 @@ class HTTPServer:
     """Route-dispatching server. Routes: exact path or prefix (trailing /)."""
 
     def __init__(self, addr: str = "127.0.0.1", port: int = 8428,
-                 auth_key: str = "", basic_auth: tuple | None = None):
+                 auth_key: str = "", basic_auth: tuple | None = None,
+                 tls_cert_file: str = "", tls_key_file: str = ""):
         self.routes: dict[str, object] = {}
         self.prefix_routes: list[tuple[str, object]] = []
         self.auth_key = auth_key
@@ -131,6 +132,16 @@ class HTTPServer:
         self._handler_cls = Handler
         self._srv = ThreadingHTTPServer((addr, port), Handler)
         self._srv.daemon_threads = True
+        if tls_cert_file and tls_key_file:
+            # -tls / -tlsCertFile / -tlsKeyFile (lib/httpserver TLS)
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert_file, tls_key_file)
+            self._srv.socket = ctx.wrap_socket(self._srv.socket,
+                                               server_side=True)
+            self.tls = True
+        else:
+            self.tls = False
         self.port = self._srv.server_address[1]
         self.addr = addr
         self._thread: threading.Thread | None = None
