@@ -20,7 +20,8 @@ from .metricsql.ast import (AggrFuncExpr, BinaryOpExpr, DurationExpr, Expr,
                             FuncExpr, LabelFilter, MetricExpr, NumberExpr,
                             RollupExpr, StringExpr)
 from .rollup_funcs import (GENERIC_FUNCS, KEEP_METRIC_NAMES, MULTI_FUNCS,
-                           ORACLE_FUNCS, ROLLUP_FUNC_NAMES, rollup_series)
+                           ORACLE_FUNCS, ROLLUP_FUNC_NAMES,
+                           adjusted_windows, rollup_series)
 from .transform_funcs import TRANSFORM_FUNCS
 from .types import EvalConfig, Timeseries, const_series, new_series
 
@@ -309,7 +310,32 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
 
     series, cfg, admission = _fetch_series_for_rollup(ec, func, re_, window,
                                                       offset)
+    per_series_cfg = None
+    adj = adjusted_windows(func, window, ec.step,
+                           [sd.timestamps for sd in series])
+    if adj:
+        if all(a == adj[0] for a in adj):
+            cfg = RollupConfig(start=cfg.start, end=cfg.end, step=cfg.step,
+                               window=adj[0])
+        else:
+            per_series_cfg = [RollupConfig(start=cfg.start, end=cfg.end,
+                                           step=cfg.step, window=a)
+                              for a in adj]
     with admission:
+        if per_series_cfg is not None:
+            # windows differ per series: per-series host loop
+            qt = ec.tracer.new_child("host rollup %s (per-series window)",
+                                     func)
+            out_rows = []
+            for i, (sd, c) in enumerate(zip(series, per_series_cfg)):
+                if i % 256 == 0:
+                    ec.check_deadline()
+                out_rows.append(rollup_series(func, sd.timestamps,
+                                              sd.values, c, args))
+            qt.donef("%d series", len(out_rows))
+            return _cache_rollup(ec, ckey,
+                                 _finish_rollup(series, out_rows,
+                                                keep_name))
         if ec.tpu is not None:
             from .tpu_engine import try_rollup_tpu
             qt = ec.tracer.new_child("tpu rollup %s", func)
@@ -474,7 +500,12 @@ def _rollup_subquery(ec: EvalConfig, func: str, re_: RollupExpr, window: int,
         s_vals = ts.values[ok]
         if s_ts.size == 0:
             continue
-        vals = rollup_series(func, s_ts, s_vals, cfg, args)
+        c = cfg
+        adj1 = adjusted_windows(func, window, ec.step, [s_ts])
+        if adj1:
+            c = RollupConfig(start=start, end=end, step=ec.step,
+                             window=adj1[0])
+        vals = rollup_series(func, s_ts, s_vals, c, args)
         mn = MetricName(ts.metric_name.metric_group if keep_name else b"",
                         list(ts.metric_name.labels))
         out.append(Timeseries(mn, vals))
@@ -548,6 +579,17 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
     window = rarg.window.value_ms(ec.step) if rarg.window is not None else 0
     series, cfg, admission = _fetch_series_for_rollup(ec, func, rarg, window,
                                                       offset)
+    adj = adjusted_windows(func, window, ec.step,
+                           [sd.timestamps for sd in series])
+    if adj:
+        if all(a == adj[0] for a in adj):
+            cfg = RollupConfig(start=cfg.start, end=cfg.end, step=cfg.step,
+                               window=adj[0])
+        else:
+            with admission:
+                pass
+            ec.count_samples(-sum(s.timestamps.size for s in series))
+            return None  # host path handles per-series windows
     n_fetched = sum(s.timestamps.size for s in series)
 
     def _decline():
@@ -678,8 +720,10 @@ def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
 
 
 def _eval_histogram_aggr(ec, ae, series) -> list[Timeseries]:
-    """histogram(q): per-step VM histogram over each group's values, one
-    output series per non-zero vmrange bucket (aggr.go aggrFuncHistogram)."""
+    """histogram(q): per-step VM histogram over each group's values,
+    emitted as CUMULATIVE le= buckets with zero-filled gaps — the
+    reference converts through vmrangeBucketsToLE (aggr.go:256-285)."""
+    from .transform_funcs import _vmrange_to_le
     from .vmhistogram import vmrange_for
     groups, names = _group_series(series, ae.grouping, ae.without)
     out = []
@@ -695,14 +739,16 @@ def _eval_histogram_aggr(ec, ae, series) -> list[Timeseries]:
                     continue
                 row = per_range.get(r)
                 if row is None:
-                    row = per_range[r] = np.full(T, nan)
-                row[j] = (row[j] + 1.0) if not np.isnan(row[j]) else 1.0
+                    row = per_range[r] = np.zeros(T)
+                row[j] += 1.0
         base = names[key]
+        raw = []
         for r, vals in sorted(per_range.items()):
             mn = MetricName(base.metric_group,
                             list(base.labels) + [(b"vmrange", r.encode())])
             mn.sort_labels()
-            out.append(Timeseries(mn, vals))
+            raw.append(Timeseries(mn, vals))
+        out.extend(_vmrange_to_le(raw))
     out.sort(key=lambda ts: ts.metric_name.marshal())
     return out
 
@@ -780,17 +826,26 @@ def _eval_topk_family(ec, ae, name, k, series,
             out.extend(ranked[:int(k)])
         elif name == "outliersk":
             med = np.nanmedian(m, axis=0)
-            dev = np.nansum(np.abs(m - med), axis=1)
-            order = np.argsort(-dev)
-            for i in order[:int(k)]:
+            with np.errstate(all="ignore"):
+                dev = np.nansum((m - med) ** 2, axis=1)
+            # stable ascending sort, keep the LAST k: ties favor later
+            # series (getRangeTopKTimeseries ordering)
+            order = np.argsort(dev, kind="stable")
+            kn = max(int(k), 0)
+            for i in (order[-kn:] if kn else []):
                 out.append(rows[i])
         else:
             kind = name.split("_", 1)[1]
             rank = series_rank_metric(kind, m)
             rank = np.where(np.isnan(rank), -np.inf if not bottom else np.inf,
                             rank)
-            order = np.argsort(rank)
-            sel = order[:int(k)] if bottom else order[::-1][:int(k)]
+            kn = max(int(k), 0)
+            if bottom:
+                # stable desc sort, keep last k: ties favor later series
+                order = np.argsort(-rank, kind="stable")
+            else:
+                order = np.argsort(rank, kind="stable")
+            sel = order[-kn:] if kn else []
             for i in sel:
                 out.append(rows[i])
             if remaining is not None:

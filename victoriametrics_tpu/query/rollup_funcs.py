@@ -382,6 +382,65 @@ MULTI_FUNCS = {
     "rollup_scrape_interval": [("min", None), ("max", None), ("avg", None)],
 }
 
+# funcs whose implicit window expands to cover >=2 samples
+# (rollup.go:204 rollupFuncsCanAdjustWindow; default_rollup excluded here
+# because our default_rollup already uses the full lookback_delta window)
+ADJUSTABLE_WINDOW_FUNCS = frozenset("""
+deriv deriv_fast ideriv irate rate rate_over_sum rollup
+rollup_candlestick rollup_deriv rollup_rate rollup_scrape_interval
+scrape_interval timestamp
+""".split())
+
+
+def scrape_interval_estimate(ts: np.ndarray, default_ms: int) -> int:
+    """0.6 quantile of the last 20 sample intervals (rollup.go:871)."""
+    if ts.size < 2:
+        return default_ms
+    tail = ts[-21:]
+    intervals = np.diff(tail).astype(np.float64)
+    if intervals.size == 0:
+        return default_ms
+    si = int(np.quantile(intervals, 0.6))
+    return si if si > 0 else default_ms
+
+
+def max_prev_interval(scrape_interval: int) -> int:
+    """Jitter headroom over the scrape interval (rollup.go:899)."""
+    si = scrape_interval
+    if si <= 2_000:
+        return si + 4 * si
+    if si <= 4_000:
+        return si + 2 * si
+    if si <= 8_000:
+        return si + si
+    if si <= 16_000:
+        return si + si // 2
+    if si <= 32_000:
+        return si + si // 4
+    return si + si // 8
+
+
+def adjusted_window_ms(func: str, ts: np.ndarray, step: int) -> int:
+    """The implicit lookbehind for rate/deriv-style funcs: at least the
+    series' (inflated) scrape interval so windows hold >=2 samples
+    (rollup.go:747-751)."""
+    w = step
+    if func in ADJUSTABLE_WINDOW_FUNCS:
+        mpi = max_prev_interval(scrape_interval_estimate(ts, step))
+        if w < mpi:
+            w = mpi
+    return w
+
+
+def adjusted_windows(func: str, window: int, step: int, ts_list
+                     ) -> list[int] | None:
+    """Per-series adjusted windows for an implicit lookbehind, or None
+    when no adjustment applies (explicit window / non-adjustable func)."""
+    if window != 0 or func not in ADJUSTABLE_WINDOW_FUNCS or not ts_list:
+        return None
+    return [adjusted_window_ms(func, ts, step) for ts in ts_list]
+
+
 # funcs that keep the metric name in results (rollup.go keepMetricName set)
 KEEP_METRIC_NAMES = frozenset("""
 avg_over_time default_rollup first_over_time geomean_over_time
