@@ -105,8 +105,45 @@ def _remove_counter_resets(v: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     prev = jnp.concatenate([vm[:, :1], vm[:, :-1]], axis=1)
     pair_valid = valid & jnp.concatenate(
         [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
-    drop = jnp.where(pair_valid & (vm < prev), prev - vm, 0.0)
+    drop = jnp.where(pair_valid & (vm < prev),
+                     jnp.where((prev - vm) * 8 < prev, prev - vm, prev), 0.0)
     return v + jnp.cumsum(drop, axis=1)
+
+
+def _max_prev_interval_tile(ts: jnp.ndarray, counts: jnp.ndarray,
+                            cfg: RollupConfig) -> jnp.ndarray:
+    """Per-series maxPrevInterval [S], bit-compatible with
+    rollup_np._max_prev_interval_for: 0.6 linear-interpolated quantile of the
+    last <=20 sample intervals, inflated by the rollup.go:899 jitter table.
+    Instant grids (start == end) use the step directly."""
+    S, N = ts.shape
+    step = jnp.asarray(cfg.step, jnp.int32)
+    if cfg.start >= cfg.end:
+        return jnp.full((S,), step, dtype=jnp.int32)
+    c = counts.astype(jnp.int32)
+    base = jnp.clip(c - 21, 0, None)
+    idx = base[:, None] + jnp.arange(21, dtype=jnp.int32)[None, :]
+    tv = jnp.take_along_axis(ts, jnp.clip(idx, 0, N - 1), axis=1)
+    valid = idx < c[:, None]
+    d = (tv[:, 1:] - tv[:, :-1]).astype(jnp.float64)
+    dvalid = valid[:, 1:] & valid[:, :-1]
+    n = dvalid.sum(axis=1)
+    dsort = jnp.sort(jnp.where(dvalid, d, jnp.inf), axis=1)
+    rank = 0.6 * jnp.maximum(n - 1, 0).astype(jnp.float64)
+    lo_i = jnp.floor(rank).astype(jnp.int32)
+    hi_i = jnp.ceil(rank).astype(jnp.int32)
+    v_lo = jnp.take_along_axis(dsort, lo_i[:, None], axis=1)[:, 0]
+    v_hi = jnp.take_along_axis(dsort, hi_i[:, None], axis=1)[:, 0]
+    q = v_lo + (rank - lo_i) * (v_hi - v_lo)
+    # zero out the no-interval case BEFORE the int cast: inf -> int32
+    # saturates to INT_MAX, which would sneak past the positivity guard
+    si = jnp.where(n >= 1, q, 0.0).astype(jnp.int32)
+    si = jnp.where(si > 0, si, step)
+    mpi = jnp.select(
+        [si <= 2_000, si <= 4_000, si <= 8_000, si <= 16_000, si <= 32_000],
+        [si + 4 * si, si + 2 * si, si + si, si + si // 2, si + si // 4],
+        si + si // 8)
+    return mpi
 
 
 @functools.partial(jax.jit, static_argnames=("func", "cfg"))
@@ -121,6 +158,13 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
     n_win = (hi - lo).astype(dtype)
     have = hi > lo
     has_prev = lo >= 1
+    # deriv-family prevValue gate (rollup.go:781): the sample before the
+    # window seeds prevValue only within maxPrevInterval of the window start;
+    # delta/increase/changes keep the ungated sample (realPrevValue analog)
+    mpi = _max_prev_interval_tile(ts, counts, cfg)
+    t_prev_i = jnp.take_along_axis(ts, jnp.clip(lo - 1, 0, N - 1), axis=1)
+    has_gprev = has_prev & (
+        t_prev_i > (grid - cfg.lookback)[None, :] - mpi[:, None])
 
     vm = jnp.where(valid, values, 0.0)
     tsf = jnp.where(valid, ts, 0).astype(dtype)
@@ -194,7 +238,7 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
         v_last = _gather(values, hi - 1)
         prev = jnp.where(two, _gather(values, hi - 2),
                          _gather(values, lo - 1))
-        return masked(v_last - prev, have & (two | has_prev))
+        return masked(v_last - prev, have & (two | has_gprev))
 
     if func in ("increase", "increase_pure", "rate", "irate"):
         cv = _remove_counter_resets(values, valid)
@@ -209,13 +253,15 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
         t_prev = _gather(tsf, lo - 1)
         if func == "rate":
             two = hi - lo >= 2
-            ok = have & (has_prev | two)
-            dt = jnp.where(has_prev, t_last - t_prev, t_last - t_first) / 1e3
-            dv = c_last - base
+            ok = have & (has_gprev | two)
+            rate_base = jnp.where(has_gprev, c_prev, c_first)
+            dt = jnp.where(has_gprev, t_last - t_prev,
+                           t_last - t_first) / 1e3
+            dv = c_last - rate_base
             return masked(jnp.where(dt > 0, dv / dt, nan), ok)
         # irate: last two samples
         two = hi - lo >= 2
-        ok = have & (two | has_prev)
+        ok = have & (two | has_gprev)
         c_l2 = jnp.where(two, _gather(cv, hi - 2), c_prev)
         t_l2 = jnp.where(two, _gather(tsf, hi - 2), t_prev)
         dt = (t_last - t_l2) / 1e3
@@ -225,9 +271,11 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
         v_last = _gather(values, hi - 1)
         t_last = _gather(tsf, hi - 1)
         two = hi - lo >= 2
-        base_v = jnp.where(has_prev, _gather(values, lo - 1), _gather(values, lo))
-        base_t = jnp.where(has_prev, _gather(tsf, lo - 1), _gather(tsf, lo))
-        ok = have & (has_prev | two)
+        base_v = jnp.where(has_gprev, _gather(values, lo - 1),
+                           _gather(values, lo))
+        base_t = jnp.where(has_gprev, _gather(tsf, lo - 1),
+                           _gather(tsf, lo))
+        ok = have & (has_gprev | two)
         dt = (t_last - base_t) / 1e3
         return masked(jnp.where(dt > 0, (v_last - base_v) / dt, nan), ok)
 

@@ -39,19 +39,64 @@ class RollupConfig:
         return np.arange(self.start, self.end + 1, self.step, dtype=np.int64)
 
 
+def scrape_interval_estimate(ts: np.ndarray, default_ms: int) -> int:
+    """0.6 quantile of the last 20 sample intervals (rollup.go:871
+    getScrapeInterval)."""
+    if ts.size < 2:
+        return default_ms
+    tail = ts[-21:]
+    intervals = np.diff(tail).astype(np.float64)
+    if intervals.size == 0:
+        return default_ms
+    si = int(np.quantile(intervals, 0.6))
+    return si if si > 0 else default_ms
+
+
+def max_prev_interval(scrape_interval: int) -> int:
+    """Jitter headroom over the scrape interval (rollup.go:899
+    getMaxPrevInterval)."""
+    si = scrape_interval
+    if si <= 2_000:
+        return si + 4 * si
+    if si <= 4_000:
+        return si + 2 * si
+    if si <= 8_000:
+        return si + si
+    if si <= 16_000:
+        return si + si // 2
+    if si <= 32_000:
+        return si + si // 4
+    return si + si // 8
+
+
+def _max_prev_interval_for(ts: np.ndarray, cfg: "RollupConfig") -> int:
+    """rollup.go:720-728: instant queries use step; range queries estimate
+    the scrape interval and inflate it for jitter tolerance. The sample just
+    before the window seeds prevValue only when it is within this interval
+    of the window start."""
+    if cfg.start >= cfg.end:
+        return cfg.step
+    return max_prev_interval(scrape_interval_estimate(ts, cfg.step))
+
+
+
 def remove_counter_resets(values: np.ndarray) -> np.ndarray:
     """Monotonize a counter series: whenever v[i] < v[i-1] (reset), add the
     lost base back so deltas across resets count from the reset value
-    (rollup.go:921 removeCounterResets analog). Every negative delta is
-    treated as a full reset."""
+    (rollup.go:921 removeCounterResets analog). A drop where the new value
+    is still >1/8 of the previous one is a "partial reset" (only the lost
+    amount is added back); otherwise it's a full reset (the whole previous
+    value is added back, so the counter continues from where it was)."""
     v = np.asarray(values, dtype=np.float64)
-    if v.size == 0:
+    if v.size == 0 or v.shape[-1] == 0:
         return v.copy()
-    d = np.diff(v)
-    drop = np.where(d < 0, -d, 0.0)
+    d = np.diff(v, axis=-1)
+    prev = v[..., :-1]
+    drop = np.where(d < 0, np.where(-d * 8 < prev, -d, prev), 0.0)
     # reset correction: cumulative sum of drops, shifted to apply from the
     # resetting sample onward
-    corr = np.concatenate([[0.0], np.cumsum(drop)])
+    zeros = np.zeros(v.shape[:-1] + (1,), dtype=np.float64)
+    corr = np.concatenate([zeros, np.cumsum(drop, axis=-1)], axis=-1)
     return v + corr
 
 
@@ -81,9 +126,18 @@ def rollup(func: str, ts: np.ndarray, values: np.ndarray, cfg: RollupConfig
     corrected = remove_counter_resets(v) if func in (
         "rate", "increase", "irate", "increase_pure") else v
 
+    # prevValue gating (rollup.go:781): the sample before the window seeds
+    # prevValue only when within maxPrevInterval of the window start. The
+    # delta/increase/changes family keeps the ungated sample — it doubles as
+    # realPrevValue, which rollup.go uses ungated when LookbackDelta is 0.
+    mpi = _max_prev_interval_for(ts, cfg)
+
     for j in range(T):
         a, b = lo[j], hi[j]
-        prev_idx = a - 1  # last sample at or before window start
+        prev_idx = a - 1  # last sample at or before window start (realPrev)
+        gated_prev = prev_idx if (
+            prev_idx >= 0 and ts[prev_idx] > out_ts[j] - cfg.lookback - mpi
+        ) else -1
         if func == "count_over_time":
             out[j] = (b - a) if b > a else np.nan
             continue
@@ -128,9 +182,9 @@ def rollup(func: str, ts: np.ndarray, values: np.ndarray, cfg: RollupConfig
             base = corrected[prev_idx] if prev_idx >= 0 else cw[0]
             out[j] = cw[-1] - base
         elif func == "rate":
-            if prev_idx >= 0:
-                dt = (tw[-1] - ts[prev_idx]) / 1e3
-                dv = cw[-1] - corrected[prev_idx]
+            if gated_prev >= 0:
+                dt = (tw[-1] - ts[gated_prev]) / 1e3
+                dv = cw[-1] - corrected[gated_prev]
             elif b - a >= 2:
                 dt = (tw[-1] - tw[0]) / 1e3
                 dv = cw[-1] - cw[0]
@@ -141,21 +195,21 @@ def rollup(func: str, ts: np.ndarray, values: np.ndarray, cfg: RollupConfig
             if b - a >= 2:
                 dt = (tw[-1] - tw[-2]) / 1e3
                 dv = cw[-1] - cw[-2]
-            elif prev_idx >= 0:
-                dt = (tw[-1] - ts[prev_idx]) / 1e3
-                dv = cw[-1] - corrected[prev_idx]
+            elif gated_prev >= 0:
+                dt = (tw[-1] - ts[gated_prev]) / 1e3
+                dv = cw[-1] - corrected[gated_prev]
             else:
                 continue
             out[j] = dv / dt if dt > 0 else np.nan
         elif func == "idelta":
             if b - a >= 2:
                 out[j] = w[-1] - w[-2]
-            elif prev_idx >= 0:
-                out[j] = w[-1] - v[prev_idx]
+            elif gated_prev >= 0:
+                out[j] = w[-1] - v[gated_prev]
         elif func == "deriv_fast":
-            if prev_idx >= 0:
-                dt = (tw[-1] - ts[prev_idx]) / 1e3
-                out[j] = (w[-1] - v[prev_idx]) / dt if dt > 0 else np.nan
+            if gated_prev >= 0:
+                dt = (tw[-1] - ts[gated_prev]) / 1e3
+                out[j] = (w[-1] - v[gated_prev]) / dt if dt > 0 else np.nan
             elif b - a >= 2:
                 dt = (tw[-1] - tw[0]) / 1e3
                 out[j] = (w[-1] - w[0]) / dt if dt > 0 else np.nan
@@ -238,6 +292,13 @@ def rollup_batch(func: str, series: list, cfg: RollupConfig):
     nwin = hi - lo                       # samples per window
     prev = lo - 1                        # last sample at/before window start
     has_prev = prev >= 0
+    # per-series maxPrevInterval prevValue gate for the deriv family — must
+    # stay bit-compatible with rollup() above (same gating rule)
+    mpi = np.array([_max_prev_interval_for(ts2[s, :counts[s]], cfg)
+                    for s in range(S)], dtype=np.int64)
+    t_prev_raw = np.take_along_axis(ts2, np.clip(prev, 0, N - 1), axis=1)
+    has_gated_prev = has_prev & (
+        t_prev_raw > (out_ts - cfg.lookback)[None, :] - mpi[:, None])
     out = np.full((S, T), np.nan)
 
     def gather(arr2d, idx, fill=0.0):
@@ -330,11 +391,7 @@ def rollup_batch(func: str, series: list, cfg: RollupConfig):
     # counter / derivative family
     needs_reset = func in ("rate", "increase", "irate", "increase_pure")
     if needs_reset:
-        d = np.diff(v2, axis=1)
-        drop = np.where(d < 0, -d, 0.0)
-        corr = np.concatenate([np.zeros((S, 1)), np.cumsum(drop, axis=1)],
-                              axis=1)
-        cw2 = v2 + corr
+        cw2 = remove_counter_resets(v2)
     else:
         cw2 = v2
 
@@ -357,15 +414,17 @@ def rollup_batch(func: str, series: list, cfg: RollupConfig):
             base = np.where(has_prev, c_prev, c_first)
             return np.where(have, c_last - base, np.nan)
         if func == "rate":
-            dt = np.where(has_prev, t_last - t_prev, t_last - t_first) / 1e3
-            dv = np.where(has_prev, c_last - c_prev, c_last - c_first)
-            ok = have & (has_prev | (nwin >= 2))
+            dt = np.where(has_gated_prev, t_last - t_prev,
+                          t_last - t_first) / 1e3
+            dv = np.where(has_gated_prev, c_last - c_prev, c_last - c_first)
+            ok = have & (has_gated_prev | (nwin >= 2))
             res = np.where(dt > 0, dv / dt, np.nan)
             return np.where(ok, res, np.nan)
         if func == "deriv_fast":
-            dt = np.where(has_prev, t_last - t_prev, t_last - t_first) / 1e3
-            dv = np.where(has_prev, v_last - v_prev, v_last - v_first)
-            ok = have & (has_prev | (nwin >= 2))
+            dt = np.where(has_gated_prev, t_last - t_prev,
+                          t_last - t_first) / 1e3
+            dv = np.where(has_gated_prev, v_last - v_prev, v_last - v_first)
+            ok = have & (has_gated_prev | (nwin >= 2))
             res = np.where(dt > 0, dv / dt, np.nan)
             return np.where(ok, res, np.nan)
         if func in ("irate", "idelta"):
@@ -377,12 +436,13 @@ def rollup_batch(func: str, series: list, cfg: RollupConfig):
             two = nwin >= 2
             if func == "idelta":
                 res = np.where(two, a_last - a_pen,
-                               np.where(has_prev, a_last - a_prev, np.nan))
+                               np.where(has_gated_prev, a_last - a_prev,
+                                        np.nan))
                 return np.where(have, res, np.nan)
             t_pen = gather(ts2, i2)
             dt = np.where(two, t_last - t_pen, t_last - t_prev) / 1e3
             dv = np.where(two, a_last - a_pen, a_last - a_prev)
-            ok = have & (two | has_prev)
+            ok = have & (two | has_gated_prev)
             res = np.where(dt > 0, dv / dt, np.nan)
             return np.where(ok, res, np.nan)
         if func == "deriv":

@@ -164,31 +164,38 @@ def _eval_rollup_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
                                         fe.keep_metric_names)
 
     if fe.name in MULTI_FUNCS:
-        base = {"rollup": "default_rollup", "rollup_rate": "rate",
-                "rollup_increase": "increase", "rollup_delta": "delta",
-                "rollup_deriv": "deriv_fast",
-                "rollup_scrape_interval": "scrape_interval"}
+        # rollup.go:413 appendRollupConfigs: an explicit 2nd arg ("min" /
+        # "max" / "avg", or candlestick's leg name) selects ONE output and —
+        # except for rollup_candlestick — suppresses the `rollup` tag.
         out = []
-        if fe.name in ("rollup", "rollup_candlestick"):
-            tags = MULTI_FUNCS[fe.name]
+        tags = MULTI_FUNCS[fe.name]
+        explicit = extra[0] if extra and isinstance(extra[0], str) else None
+        keep = fe.keep_metric_names or fe.name in KEEP_METRIC_NAMES
+        if fe.name == "rollup_candlestick":
+            if explicit is not None:
+                legs = dict(tags)
+                if explicit not in legs:
+                    raise QueryError(
+                        f"unexpected second arg for {fe.name}: {explicit!r}")
+                tags = [(explicit, legs[explicit])]
             for tag, func in tags:
-                sub = _eval_rollup_expr(ec, func, rarg, (),
-                                        keep_name=fe.name in KEEP_METRIC_NAMES)
+                sub = _eval_rollup_expr(ec, func, rarg, (), keep_name=keep)
                 for ts in sub:
                     ts.metric_name.labels.append((b"rollup", tag.encode()))
                     ts.metric_name.sort_labels()
                 out.extend(sub)
             return out
-        # min/max/avg over the base func computed at each point: approximate
-        # by computing the base func and tagging avg=min=max (single sample
-        # per window on the host path). Full per-window spreads arrive with
-        # the device path.
-        func = base[fe.name]
-        for tag in ("min", "max", "avg"):
-            sub = _eval_rollup_expr(ec, func, rarg, ())
-            for ts in sub:
-                ts.metric_name.labels.append((b"rollup", tag.encode()))
-                ts.metric_name.sort_labels()
+        if explicit is not None and explicit not in ("min", "max", "avg"):
+            raise QueryError(
+                f"unexpected second arg for {fe.name}: {explicit!r}; "
+                "want `min`, `max` or `avg`")
+        sel = [t for t, _ in tags] if explicit is None else [explicit]
+        for tag in sel:
+            sub = _eval_rollup_expr(ec, fe.name, rarg, (tag,), keep_name=keep)
+            if explicit is None:
+                for ts in sub:
+                    ts.metric_name.labels.append((b"rollup", tag.encode()))
+                    ts.metric_name.sort_labels()
             out.extend(sub)
         return out
 
@@ -486,10 +493,16 @@ def _rollup_subquery(ec: EvalConfig, func: str, re_: RollupExpr, window: int,
     lookback = window if window > 0 else ec.step
     start = ec.start - offset
     end = ec.end - offset
-    sub_start = start - lookback
-    # align the inner grid to sub_step like Prometheus subqueries
+    # eval.go:1023: extend the inner range by window + step + the max
+    # silence interval (5m) so prevValue / adjusted windows see the samples
+    # just before the outer range, then step-align both ends as Prometheus
+    # subqueries do (eval.go alignStartEnd).
+    sub_start = start - lookback - sub_step - 300_000
+    sub_end = end + sub_step
     sub_start -= sub_start % sub_step
-    inner_ec = ec.child(start=sub_start, end=end, step=sub_step)
+    if sub_end % sub_step:
+        sub_end += sub_step - sub_end % sub_step
+    inner_ec = ec.child(start=sub_start, end=sub_end, step=sub_step)
     inner = eval_expr(inner_ec, re_.expr)
     grid = inner_ec.timestamps()
     cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
@@ -623,9 +636,9 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
     rows = [Timeseries(MetricName.unmarshal(k),
                        np.asarray(out[g], dtype=np.float64))
             for g, k in enumerate(group_keys)]
-    rows.sort(key=lambda ts: ts.metric_name.marshal())
     if ae.limit and len(rows) > ae.limit:
-        rows = rows[:ae.limit]
+        rows = rows[:ae.limit]  # first-seen group order (aggrPrepareSeries)
+    rows.sort(key=lambda ts: ts.metric_name.marshal())
     return rows
 
 
@@ -682,7 +695,8 @@ def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
         series = eval_expr(ec, ae.args[0])
         return _eval_per_series(ec, ae, PER_SERIES[name], series)
     if name in ("mad", "iqr"):
-        series = eval_expr(ec, ae.args[0])
+        # plain aggregates union ALL their args (aggr.go getAggrTimeseries)
+        series = [ts for a in ae.args for ts in eval_expr(ec, a)]
         def mad_fn(m):
             med = np.nanmedian(m, axis=0)
             return np.nanmedian(np.abs(m - med), axis=0)
@@ -755,14 +769,17 @@ def _eval_histogram_aggr(ec, ae, series) -> list[Timeseries]:
 
 def _simple_aggr(ec, ae, series, fn) -> list[Timeseries]:
     groups, names = _group_series(series, ae.grouping, ae.without)
+    # `limit N` keeps the first N groups in INPUT order — groups past the
+    # limit are skipped at grouping time (aggr.go:139 aggrPrepareSeries),
+    # not after sorting.
+    if ae.limit and len(groups) > ae.limit:
+        groups = {k: groups[k] for k in list(groups)[:ae.limit]}
     out = []
     for key, rows in groups.items():
         m = np.vstack([ts.values for ts in rows])
         vals = fn(m)
         out.append(Timeseries(names[key], np.asarray(vals, dtype=np.float64)))
     out.sort(key=lambda ts: ts.metric_name.marshal())
-    if ae.limit and len(out) > ae.limit:
-        out = out[:ae.limit]
     return out
 
 
@@ -804,6 +821,18 @@ def _remaining_sum_series(ec, ae, rows, selected_idx, tag_spec: str
     return Timeseries(mn, vals)
 
 
+def _vm_name_hash(mn: MetricName) -> int:
+    """aggr.go getHash: xxhash64 over MetricGroup then raw key+value bytes of
+    the sorted tags — NOT the length-prefixed marshal. Drives limitk()'s
+    stable uniform series selection."""
+    import xxhash
+    parts = [mn.metric_group]
+    for lk, lv in sorted(mn.labels):
+        parts.append(lk)
+        parts.append(lv)
+    return xxhash.xxh64_intdigest(b"".join(parts))
+
+
 def _eval_topk_family(ec, ae, name, k, series,
                       remaining: str | None = None) -> list[Timeseries]:
     groups, _ = _group_series(series, ae.grouping, ae.without)
@@ -818,11 +847,9 @@ def _eval_topk_family(ec, ae, name, k, series,
                 if not np.isnan(vals).all():
                     out.append(Timeseries(ts.metric_name, vals))
         elif name == "limitk":
-            import xxhash
             if k <= 0:
                 continue
-            ranked = sorted(rows, key=lambda ts: xxhash.xxh64_intdigest(
-                ts.metric_name.marshal()))
+            ranked = sorted(rows, key=lambda ts: _vm_name_hash(ts.metric_name))
             out.extend(ranked[:int(k)])
         elif name == "outliersk":
             med = np.nanmedian(m, axis=0)

@@ -239,6 +239,12 @@ class Parser:
                 be.right = self.parse_expr(level)  # right-assoc
             else:
                 be.right = self.parse_expr(level + 1)
+            # keep_metric_names after the right operand attaches to the
+            # BINOP (Go metricsql parser.go:410); a real function call
+            # consumes its own flag before we get here (parser.go:1210)
+            if self.at_keyword("keep_metric_names"):
+                self.next()
+                be.keep_metric_names = True
             left = be
         # unreachable
 
@@ -259,53 +265,77 @@ class Parser:
     # -- postfix: [window[:step]], offset, @, keep_metric_names ----------
 
     def parse_postfix(self, e: Expr) -> Expr:
-        while True:
-            if self.at_op("["):
+        if self.at_keyword("keep_metric_names"):
+            # a real function call owns its flag (Go parser.go:1210); a
+            # parenthesized binop too (parser.go:602); anything else
+            # leaves the token for the enclosing binop (parser.go:410)
+            parens = getattr(e, "_parens", False)
+            if isinstance(e, FuncExpr) and not parens:
                 self.next()
-                window = step = None
-                inherit = False
-                if not self.at_op(":"):
-                    window = self.parse_duration_token()
-                if self.at_op(":"):
-                    self.next()
-                    if self.at_op("]"):
-                        inherit = True
-                    else:
-                        step = self.parse_duration_token()
-                self.expect_op("]")
-                e = self._as_rollup(e)
-                e.window, e.step, e.inherit_step = window, step, inherit
-            elif self.at_keyword("offset"):
+                e.keep_metric_names = True
+            elif isinstance(e, BinaryOpExpr) and parens:
                 self.next()
-                neg = False
-                if self.at_op("-"):
-                    self.next()
-                    neg = True
-                d = self.parse_duration_token()
-                if neg:
-                    d = DurationExpr(-d.ms, d.step_based, "-" + d.text)
-                e = self._as_rollup(e)
-                e.offset = d
-            elif self.at_op("@"):
-                self.next()
-                at = self.parse_unary()
-                e = self._as_rollup(e)
-                e.at = at
-            elif self.at_keyword("keep_metric_names"):
-                self.next()
-                if isinstance(e, (FuncExpr, BinaryOpExpr)):
-                    e.keep_metric_names = True
-                else:
-                    raise ParseError("keep_metric_names must follow a "
-                                     "function or binary op")
+                e.keep_metric_names = True
             else:
                 return e
+        if self.at_op("[", "@") or self.at_keyword("offset"):
+            return self._parse_rollup_suffix(e)
+        return e
 
-    def _as_rollup(self, e: Expr) -> RollupExpr:
-        if isinstance(e, RollupExpr) and e.at is None:
-            return e
-        r = RollupExpr(expr=e)
-        return r
+    def _parse_rollup_suffix(self, e: Expr) -> RollupExpr:
+        """Go parser.go:1783 parseRollupExpr: a fixed SEQUENCE (not a loop) —
+        optional [window[:step]], then optional `@`, then optional offset,
+        then optionally a second `@` spot (duplicate `@` is an error). A
+        suffix in any other order is left unconsumed and errors upstream."""
+        re_ = RollupExpr(expr=e)
+        if self.at_op("["):
+            self.next()
+            window = step = None
+            inherit = False
+            if not self.at_op(":"):
+                window = self.parse_duration_token()
+            if self.at_op(":"):
+                self.next()
+                if self.at_op("]"):
+                    inherit = True
+                else:
+                    step = self.parse_duration_token()
+            self.expect_op("]")
+            re_.window, re_.step, re_.inherit_step = window, step, inherit
+            if not (self.at_op("@") or self.at_keyword("offset")):
+                return re_
+        if self.at_op("@"):
+            self.next()
+            re_.at = self._parse_at_expr()
+        if self.at_keyword("offset"):
+            self.next()
+            neg = False
+            if self.at_op("-"):
+                self.next()
+                neg = True
+            d = self.parse_duration_token()
+            if neg:
+                d = DurationExpr(-d.ms, d.step_based, "-" + d.text)
+            re_.offset = d
+        if self.at_op("@"):
+            if re_.at is not None:
+                raise ParseError("duplicate `@` token")
+            self.next()
+            re_.at = self._parse_at_expr()
+        return re_
+
+    def _parse_at_expr(self) -> Expr:
+        # the at-expression takes no rollup suffixes: a trailing
+        # `offset`/`[...]` binds to the OUTER rollup, so
+        # `time() @ end() offset 10m` is (time() @ end()) offset 10m
+        # (metricsql parser.go parseSingleExprWithoutRollupSuffix)
+        if self.at_op("-"):
+            self.next()
+            prim = self.parse_primary()
+            return (NumberExpr(-prim.value)
+                    if isinstance(prim, NumberExpr) else
+                    BinaryOpExpr(op="*", left=NumberExpr(-1.0), right=prim))
+        return self.parse_primary()
 
     def parse_duration_token(self) -> DurationExpr:
         t = self.next()
@@ -340,6 +370,10 @@ class Parser:
             return StringExpr(t.text)
         if t.kind == "op" and t.text == "(":
             self.next()
+            if self.at_op(")"):
+                # `()` is an empty union (exec_test.go `()` case)
+                self.next()
+                return FuncExpr(name="union", args=[])
             e = self.parse_expr(0)
             if self.at_op(","):
                 # (e1, e2, ...) is union(e1, e2, ...) in MetricsQL
@@ -350,8 +384,11 @@ class Parser:
                         break
                     exprs.append(self.parse_expr(0))
                 self.expect_op(")")
-                return FuncExpr(name="union", args=exprs)
+                u = FuncExpr(name="union", args=exprs)
+                u._parens = True
+                return u
             self.expect_op(")")
+            e._parens = True
             return e
         if t.kind == "op" and t.text == "{":
             return MetricExpr(label_filters=self.parse_label_filters())

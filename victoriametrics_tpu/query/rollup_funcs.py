@@ -65,10 +65,25 @@ def _w_iqr(w, t, pv, pt, te, args):
 
 
 def _w_zscore(w, t, pv, pt, te, args):
+    """rollup.go:2361 rollupZScoreOverTime: gated on lag <= scrape interval,
+    and (last - avg) == 0 short-circuits to 0 before dividing by stddev."""
     if w.size == 0:
         return nan
+    if pv is not None:
+        prev_ts, n = pt, t.size
+    else:
+        if t.size < 2:
+            return nan
+        prev_ts, n = t[0], t.size - 1
+    scrape_interval = (t[-1] - prev_ts) / 1e3 / n
+    lag = (te - t[-1]) / 1e3
+    if lag > scrape_interval:
+        return nan
+    d = w[-1] - w.mean()
+    if d == 0:
+        return 0.0
     sd = w.std()
-    return float((w[-1] - w.mean()) / sd) if sd > 0 else nan
+    return float(d / sd) if sd > 0 else nan
 
 
 def _w_range(w, t, pv, pt, te, args):
@@ -369,8 +384,7 @@ GENERIC_FUNCS = {
 
 # multi-output rollups: name -> list of (rollup_tag, oracle-or-generic name)
 MULTI_FUNCS = {
-    "rollup": [("min", "min_over_time"), ("max", "max_over_time"),
-               ("avg", "avg_over_time")],
+    "rollup": [("min", None), ("max", None), ("avg", None)],
     "rollup_rate": [("min", None), ("max", None), ("avg", None)],
     "rollup_increase": [("min", None), ("max", None), ("avg", None)],
     "rollup_delta": [("min", None), ("max", None), ("avg", None)],
@@ -382,6 +396,83 @@ MULTI_FUNCS = {
     "rollup_scrape_interval": [("min", None), ("max", None), ("avg", None)],
 }
 
+
+def _deriv_values(vals: np.ndarray, ts: np.ndarray) -> np.ndarray:
+    """rollup.go:976 derivValues: replace each value with the derivative of
+    the pair (i, i+1), assigned to the LEFT index; the last value repeats the
+    last derivative; duplicate timestamps reuse the previous derivative."""
+    v = np.asarray(vals, dtype=np.float64).copy()
+    if v.size <= 1:
+        if v.size == 1:
+            v[0] = 0.0
+        return v
+    dts = np.diff(ts)
+    if np.all(dts > 0):
+        d = np.diff(v) / (dts / 1e3)
+        v[:-1] = d
+        v[-1] = d[-1]
+        return v
+    prev_deriv, prev_val, prev_ts = 0.0, v[0], ts[0]
+    out = v.copy()
+    for i in range(1, v.size):
+        if ts[i] == prev_ts:
+            out[i - 1] = prev_deriv
+            continue
+        prev_deriv = (v[i] - prev_val) / ((ts[i] - prev_ts) / 1e3)
+        out[i - 1] = prev_deriv
+        prev_val, prev_ts = v[i], ts[i]
+    out[-1] = prev_deriv
+    return out
+
+
+def _delta_values(vals: np.ndarray) -> np.ndarray:
+    """rollup.go:960 deltaValues: pairwise delta assigned to the LEFT index,
+    last value repeats the last delta."""
+    v = np.asarray(vals, dtype=np.float64).copy()
+    if v.size <= 1:
+        if v.size == 1:
+            v[0] = 0.0
+        return v
+    d = np.diff(v)
+    v[:-1] = d
+    v[-1] = d[-1]
+    return v
+
+
+def _interval_values(ts: np.ndarray) -> np.ndarray:
+    """rollup_scrape_interval preprocessing (rollup.go:478): seconds between
+    adjacent samples; the leading NaN is overwritten with the 2nd interval."""
+    v = np.empty(ts.shape, dtype=np.float64)
+    if v.size == 0:
+        return v
+    v[0] = np.nan
+    if v.size > 1:
+        v[1:] = np.diff(ts) / 1e3
+        v[0] = v[1]
+    return v
+
+
+# pre-transform applied to the whole series before min/max/avg windowing
+# (rollup.go:413-495 appendRollupConfigs + preFunc chain)
+PRE_ROLLUP_FUNCS = frozenset((
+    "rollup", "rollup_rate", "rollup_deriv", "rollup_increase",
+    "rollup_delta", "rollup_scrape_interval"))
+
+
+def _pre_rollup(func: str, ts: np.ndarray, vals: np.ndarray,
+                cfg: RollupConfig, args: tuple) -> np.ndarray:
+    agg = args[0] if args and isinstance(args[0], str) else "avg"
+    v = np.asarray(vals, dtype=np.float64)
+    if func in ("rollup_rate", "rollup_increase"):
+        v = rollup_np.remove_counter_resets(v)
+    if func in ("rollup_rate", "rollup_deriv"):
+        v = _deriv_values(v, ts)
+    elif func in ("rollup_increase", "rollup_delta"):
+        v = _delta_values(v)
+    elif func == "rollup_scrape_interval":
+        v = _interval_values(ts)
+    return rollup_np.rollup(f"{agg}_over_time", ts, v, cfg)
+
 # funcs whose implicit window expands to cover >=2 samples
 # (rollup.go:204 rollupFuncsCanAdjustWindow; default_rollup excluded here
 # because our default_rollup already uses the full lookback_delta window)
@@ -392,32 +483,11 @@ scrape_interval timestamp
 """.split())
 
 
-def scrape_interval_estimate(ts: np.ndarray, default_ms: int) -> int:
-    """0.6 quantile of the last 20 sample intervals (rollup.go:871)."""
-    if ts.size < 2:
-        return default_ms
-    tail = ts[-21:]
-    intervals = np.diff(tail).astype(np.float64)
-    if intervals.size == 0:
-        return default_ms
-    si = int(np.quantile(intervals, 0.6))
-    return si if si > 0 else default_ms
-
-
-def max_prev_interval(scrape_interval: int) -> int:
-    """Jitter headroom over the scrape interval (rollup.go:899)."""
-    si = scrape_interval
-    if si <= 2_000:
-        return si + 4 * si
-    if si <= 4_000:
-        return si + 2 * si
-    if si <= 8_000:
-        return si + si
-    if si <= 16_000:
-        return si + si // 2
-    if si <= 32_000:
-        return si + si // 4
-    return si + si // 8
+# canonical implementations live in ops/rollup_np.py (the window walkers
+# there share them for prevValue gating); re-exported here for the
+# adjusted-window machinery and tests
+scrape_interval_estimate = rollup_np.scrape_interval_estimate
+max_prev_interval = rollup_np.max_prev_interval
 
 
 def adjusted_window_ms(func: str, ts: np.ndarray, step: int) -> int:
@@ -463,12 +533,17 @@ def generic_rollup(fn, ts: np.ndarray, vals: np.ndarray, cfg: RollupConfig,
     lo = np.searchsorted(ts, out_ts - cfg.lookback, side="right")
     hi = np.searchsorted(ts, out_ts, side="right")
     out = np.full(out_ts.size, np.nan)
+    # prevValue is seeded only when the sample before the window lies within
+    # maxPrevInterval of the window start (rollup.go:781 doInternal)
+    mpi = rollup_np._max_prev_interval_for(np.asarray(ts), cfg)
     for j in range(out_ts.size):
         a, b = lo[j], hi[j]
         if b <= a and a == 0:
             continue
-        pv = float(vals[a - 1]) if a >= 1 else None
-        pt = int(ts[a - 1]) if a >= 1 else None
+        pv = pt = None
+        if a >= 1 and ts[a - 1] > out_ts[j] - cfg.lookback - mpi:
+            pv = float(vals[a - 1])
+            pt = int(ts[a - 1])
         if b <= a:
             continue
         out[j] = fn(vals[a:b], ts[a:b], pv, pt, int(out_ts[j]), args)
@@ -485,6 +560,8 @@ def rollup_series(func: str, ts: np.ndarray, vals: np.ndarray,
         # the cross-series collapse happens in eval)
         cnt = rollup_np.rollup("count_over_time", ts, vals, cfg)
         return np.where(np.isnan(cnt), 1.0, np.nan)
+    if func in PRE_ROLLUP_FUNCS:
+        return _pre_rollup(func, ts, vals, cfg, args)
     if func == "rate_prometheus":
         # delta_prometheus / window_seconds (rollup.go:1946)
         c = rollup_np.remove_counter_resets(vals)

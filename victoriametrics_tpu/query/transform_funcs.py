@@ -223,6 +223,22 @@ def tf_sort(ec, args, desc=False, by_last=False):
     return series
 
 
+_NAT_CHUNK = re.compile(r"[0-9]+|[^0-9]+")
+
+
+def _natural_key(v: bytes):
+    """Natural-order sort key matching lib/stringsutil LessNatural: decimal
+    digit runs compare numerically and sort before non-digit chunks."""
+    out = []
+    for m in _NAT_CHUNK.finditer(v.decode("utf-8", "surrogateescape")):
+        c = m.group(0)
+        if c[0] in "0123456789":
+            out.append((0, int(c), ""))
+        else:
+            out.append((1, 0, c))
+    return out
+
+
 def tf_sort_by_label(ec, args, desc=False, numeric=False):
     series = list(args[0])
     labels = [a for a in args[1:] if isinstance(a, str)]
@@ -231,13 +247,7 @@ def tf_sort_by_label(ec, args, desc=False, numeric=False):
         out = []
         for lab in labels:
             v = ts.metric_name.get_label(lab.encode()) or b""
-            if numeric:
-                try:
-                    out.append(float(v))
-                except ValueError:
-                    out.append(math.inf)
-            else:
-                out.append(v)
+            out.append(_natural_key(v) if numeric else v)
         return out
     series.sort(key=key, reverse=desc)
     return series
@@ -246,7 +256,10 @@ def tf_sort_by_label(ec, args, desc=False, numeric=False):
 def tf_limit_offset(ec, args):
     limit = int(_scalar_arg(args, 0))
     offset = int(_scalar_arg(args, 1))
-    return list(args[2])[offset:offset + limit]
+    # transform.go:2290: empty (all-NaN) series are dropped BEFORE the
+    # offset is applied
+    rows = [ts for ts in args[2] if not np.isnan(ts.values).all()]
+    return rows[offset:offset + limit]
 
 
 def tf_absent(ec, args):
@@ -338,14 +351,20 @@ def tf_range_quantile(ec, args):
 
 
 def tf_range_normalize(ec, args):
+    """transform.go:1347 transformRangeNormalize: (v-min)/(max-min) per
+    series; all-NaN series (infinite spread) dropped; KEEPS metric names
+    (it's in transformFuncsKeepMetricName); a zero spread yields 0/0=NaN."""
     out = []
     for series in args:
         for ts in series:
             with np.errstate(all="ignore"):
-                lo, hi = np.nanmin(ts.values), np.nanmax(ts.values)
-                v = (ts.values - lo) / (hi - lo) if hi > lo else \
-                    np.zeros_like(ts.values)
-            out.append(Timeseries(MetricName(b"", list(ts.metric_name.labels)), v))
+                ok = ~np.isnan(ts.values)
+                if not ok.any():
+                    continue
+                lo, hi = np.min(ts.values[ok]), np.max(ts.values[ok])
+                v = (ts.values - lo) / (hi - lo)
+            out.append(Timeseries(MetricName(ts.metric_name.metric_group,
+                                             list(ts.metric_name.labels)), v))
     return out
 
 
