@@ -143,8 +143,11 @@ def a_zscore(m):
 
 @_guard_matrix
 def a_share(m):
-    s = np.nansum(m, axis=0)
-    return m / np.where(s != 0, s, nan)             # returns matrix!
+    # aggr.go:462 aggrFuncShare: negative points are EXCLUDED from the sum
+    # and their own share is NaN
+    ok = ~np.isnan(m) & (m >= 0)
+    s = np.where(ok, m, 0.0).sum(axis=0)
+    return np.where(ok, m / s, nan)                 # returns matrix!
 
 SIMPLE = {
     "sum": a_sum, "min": a_min, "max": a_max, "avg": a_avg,

@@ -109,6 +109,34 @@ def _result_labels(left_mn: MetricName, keep_name: bool) -> MetricName:
                       list(left_mn.labels))
 
 
+def _set_join_tags(mn, add: list[bytes], prefix: bytes, skip: set[bytes],
+                   src) -> None:
+    """metric_name.go:317 SetTags: copy the join tags from the one side onto
+    the result. `*` copies ALL non-skip tags; a named tag missing on the one
+    side is REMOVED from the result; `prefix` prepends to copied tag names."""
+    if add == [b"*"]:
+        for k, v in src.labels:
+            if k in skip:
+                continue
+            nk = prefix + k
+            mn.labels = [(a, b) for a, b in mn.labels if a != nk]
+            mn.labels.append((nk, v))
+        mn.sort_labels()
+        return
+    for tag in add:
+        if tag in skip:
+            continue
+        if tag == b"__name__":
+            mn.metric_group = src.metric_group
+            continue
+        v = src.get_label(tag)
+        mn.labels = [(a, b) for a, b in mn.labels
+                     if a != tag and a != prefix + tag]
+        if v is not None:
+            mn.labels.append((prefix + tag, v))
+    mn.sort_labels()
+
+
 def eval_binary_op(op: str, left: list[Timeseries], right: list[Timeseries],
                    bool_modifier: bool, group_mod, join_mod,
                    keep_metric_names: bool, is_cmp_with_scalar_right=None
@@ -134,30 +162,42 @@ def eval_binary_op(op: str, left: list[Timeseries], right: list[Timeseries],
 
     out: list[Timeseries] = []
     if many is not None:
+        # binary_op.go:304 groupJoin: each many-side series pairs with EVERY
+        # matching one-side series; the join tags copied from the one side
+        # (with optional `prefix`) must make the results unique, else the
+        # one-side values are merged when non-overlapping (duplicate error
+        # otherwise).
         one_groups, _ = _group_by_sig(one, on, ignoring)
-        one_by_sig = {}
         extra = [l.encode() for l in join_mod.args]
+        prefix = getattr(join_mod, "prefix", "").encode()
+        skip = {k.encode() for k in on} if on is not None else set()
+        keep = keep_metric_names or (is_cmp and not bool_modifier)
+        pairs: list[tuple] = []           # (joined MetricName, many, one)
+        pair_idx: dict[bytes, int] = {}
         for m_ts in many:
-            sig = signature(m_ts.metric_name, on, ignoring)
-            o_ts = one_by_sig.get(sig)
-            if o_ts is None:
-                grp = one_groups.get(sig)
-                if grp is None:
+            grp = one_groups.get(signature(m_ts.metric_name, on, ignoring))
+            if grp is None:
+                continue
+            for o_ts in grp:
+                mn = _result_labels(m_ts.metric_name, keep)
+                _set_join_tags(mn, extra, prefix, skip, o_ts.metric_name)
+                if len(grp) == 1:
+                    pairs.append((mn, m_ts, o_ts))
                     continue
-                o_ts = one_by_sig[sig] = _merge_group(
-                    grp, f"'one' ({join_mod.op})", op)
+                key = mn.marshal()
+                hit = pair_idx.get(key)
+                if hit is None:
+                    pair_idx[key] = len(pairs)
+                    pairs.append((mn, m_ts, o_ts.copy_shallow_labels()))
+                elif not _merge_non_overlapping(pairs[hit][2], o_ts):
+                    raise ValueError(
+                        f"duplicate time series on the 'one' side of "
+                        f"{op} {join_mod.op}: {mn}")
+        for mn, m_ts, o_ts in pairs:
             lv, rv = (m_ts.values, o_ts.values)
             a, b = (lv, rv) if join_mod.op == "group_left" else (rv, lv)
             vals = _apply(fn, a, b, is_cmp, bool_modifier,
                           keep_left=m_ts.values)
-            mn = _result_labels(m_ts.metric_name,
-                                keep_metric_names or (is_cmp and not bool_modifier))
-            for lab in extra:
-                v = o_ts.metric_name.get_label(lab)
-                mn.labels = [(k, x) for k, x in mn.labels if k != lab]
-                if v:
-                    mn.labels.append((lab, v))
-            mn.sort_labels()
             out.append(Timeseries(mn, vals))
         return out
 
@@ -171,12 +211,12 @@ def eval_binary_op(op: str, left: list[Timeseries], right: list[Timeseries],
         r_ts = _merge_group(r_grp, "right", op)
         vals = _apply(fn, l_ts.values, r_ts.values, is_cmp, bool_modifier,
                       keep_left=l_ts.values)
-        mn = _result_labels(l_ts.metric_name,
-                            keep_metric_names or (is_cmp and not bool_modifier))
+        keep_name = keep_metric_names or (is_cmp and not bool_modifier)
+        mn = _result_labels(l_ts.metric_name, keep_name)
         if on is not None:
             keep = {k.encode() for k in on}
             mn.labels = [(k, v) for k, v in mn.labels if k in keep]
-            if b"__name__" not in keep:
+            if b"__name__" not in keep and not keep_name:
                 mn.metric_group = b""
         elif ignoring is not None:
             # reference binary_op.go one-to-one branch calls

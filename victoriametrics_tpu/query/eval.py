@@ -882,7 +882,15 @@ def _eval_topk_family(ec, ae, name, k, series,
 
 
 def _eval_count_values(ec, ae, dst_label, series) -> list[Timeseries]:
-    groups, names = _group_series(series, ae.grouping, ae.without)
+    # aggr.go:576: the dst label leaves `by` grouping / joins `without`
+    # grouping, so the per-value output label always wins
+    grouping = list(ae.grouping)
+    if ae.without:
+        if dst_label not in grouping:
+            grouping.append(dst_label)
+    else:
+        grouping = [g for g in grouping if g != dst_label]
+    groups, names = _group_series(series, grouping, ae.without)
     out = []
     for key, rows in groups.items():
         m = np.vstack([ts.values for ts in rows])

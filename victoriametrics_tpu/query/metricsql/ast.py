@@ -161,8 +161,9 @@ class AggrFuncExpr(Expr):
 
 @dataclasses.dataclass
 class ModifierExpr:
-    op: str = ""                      # on | ignoring
+    op: str = ""                      # on | ignoring | group_left | group_right
     args: list[str] = dataclasses.field(default_factory=list)
+    prefix: str = ""                  # group_left(...) prefix "p" join prefix
 
 
 @dataclasses.dataclass

@@ -235,6 +235,15 @@ class Parser:
                 if self.at_op("("):
                     args = self.parse_ident_list()
                 be.join_modifier = ModifierExpr(kw, args)
+                if self.at_keyword("prefix"):
+                    # group_left(...) prefix "p": copied join tags get the
+                    # prefix (Go parser.go:393 JoinModifierPrefix)
+                    self.next()
+                    t = self.next()
+                    if t.kind != "string":
+                        raise ParseError(
+                            f"prefix needs a string at {t.pos}")
+                    be.join_modifier.prefix = t.text
             if op in _RIGHT_ASSOC:
                 be.right = self.parse_expr(level)  # right-assoc
             else:
@@ -479,7 +488,10 @@ class Parser:
         out = []
         while not self.at_op(")"):
             t = self.next()
-            if t.kind not in ("ident", "string"):
+            if t.kind not in ("ident", "string") and \
+                    not (t.kind == "op" and t.text == "*"):
+                # `*` is valid in group_left(*): copy ALL tags from the
+                # one side (metric_name.go:318 SetTags)
                 raise ParseError(f"expected label name at {t.pos}")
             out.append(t.text)
             if self.at_op(","):

@@ -630,6 +630,18 @@ def _group_buckets(series: list[Timeseries]):
     return groups
 
 
+def _merge_same_le(buckets):
+    """transform.go:1151 mergeSameLE: buckets with identical numeric le are
+    SUMMED (le="5" and le="5.0" are the same bucket from different scrapes)."""
+    out = []
+    for le, v in buckets:
+        if out and out[-1][0] == le:
+            out[-1] = (le, out[-1][1] + v)
+        else:
+            out.append((le, v))
+    return out
+
+
 def tf_histogram_quantile(ec, args):
     phis = _arg_values(args, 0)
     series = _vmrange_to_le(list(args[1]))
@@ -638,6 +650,7 @@ def tf_histogram_quantile(ec, args):
     out = []
     for key, (mn, buckets) in _group_buckets(series).items():
         buckets.sort(key=lambda b: b[0])
+        buckets = _merge_same_le(buckets)
         les = np.array([b[0] for b in buckets])
         m = np.vstack([b[1] for b in buckets])  # [B, T] cumulative counts
         with np.errstate(all="ignore"):
@@ -952,10 +965,16 @@ def _vmrange_to_le(series: list[Timeseries]) -> list[Timeseries]:
                 z = np.zeros(T)
                 seen_le[start_s] = z
                 new.append((start, start_s, z))
-            vals = np.nan_to_num(ts.values).copy()
+            vals = ts.values.copy()
             prev = seen_le.get(end_s)
             if prev is not None:
-                prev += vals
+                # duplicate end: merge when non-overlapping, else DROP the
+                # later bucket (transform.go:598 discards the merge result;
+                # an overlapping duplicate like 0...0.25 over 0...0.2 +
+                # 0.2...0.25 must not be double-counted)
+                src_ok = ~np.isnan(vals)
+                if int((src_ok & ~np.isnan(prev)).sum()) <= 2 and                         vals.size > 2:
+                    prev[src_ok] = vals[src_ok]
             else:
                 seen_le[end_s] = vals
                 new.append((end, end_s, vals))
@@ -964,10 +983,11 @@ def _vmrange_to_le(series: list[Timeseries]) -> list[Timeseries]:
             new.append((np.inf, b"+Inf", np.zeros(T)))
         if not new:
             continue
-        # cumulative counts across ascending le
+        # cumulative counts across ascending le: NaN and non-positive points
+        # contribute nothing (transform.go:616)
         acc = np.zeros(T)
         for le, le_s, vals in new:
-            acc = acc + vals
+            acc = acc + np.where(np.isnan(vals) | (vals <= 0), 0.0, vals)
             out.append(bucket(le_s, acc.copy()))
     return out
 
