@@ -65,19 +65,25 @@ def signature(mn: MetricName, on: list[str] | None, ignoring: list[str] | None
     return tuple((k, v) for k, v in mn.labels if k not in ig)
 
 
-def _merge_non_overlapping(dst: Timeseries, src: Timeseries) -> bool:
-    """Merge src into dst when they overlap in <=2 points and have enough
-    points (binary_op.go:367 mergeNonOverlappingTimeseries): duplicate
-    signatures from complementary filters like (m<10, m>=10) combine."""
-    sv, dv = src.values, dst.values
+def merge_values_non_overlapping(dv: np.ndarray, sv: np.ndarray) -> bool:
+    """Array-level mergeNonOverlappingTimeseries (binary_op.go:367): merge
+    src values into dst in place when they overlap in <=2 points and have
+    enough points; src wins at the (<=2) overlap points."""
     overlaps = int((~np.isnan(sv) & ~np.isnan(dv)).sum())
     if overlaps > 2:
         return False
     if sv.size <= 2 and dv.size <= 2:
         return False
     ok = ~np.isnan(sv)
-    dv[ok] = sv[ok]  # src wins at the (<=2) overlap points, like the ref
+    dv[ok] = sv[ok]
     return True
+
+
+def _merge_non_overlapping(dst: Timeseries, src: Timeseries) -> bool:
+    """Merge src into dst when they overlap in <=2 points and have enough
+    points (binary_op.go:367 mergeNonOverlappingTimeseries): duplicate
+    signatures from complementary filters like (m<10, m>=10) combine."""
+    return merge_values_non_overlapping(dst.values, src.values)
 
 
 def _group_by_sig(series, on, ignoring):
@@ -130,10 +136,15 @@ def _set_join_tags(mn, add: list[bytes], prefix: bytes, skip: set[bytes],
             mn.metric_group = src.metric_group
             continue
         v = src.get_label(tag)
-        mn.labels = [(a, b) for a, b in mn.labels
-                     if a != tag and a != prefix + tag]
         if v is not None:
+            # SetTagBytes only overwrites prefix+tag; with a prefix the
+            # many side's own unprefixed tag survives (metric_name.go:344)
+            mn.labels = [(a, b) for a, b in mn.labels if a != prefix + tag]
             mn.labels.append((prefix + tag, v))
+        else:
+            # missing on the one side: the UNPREFIXED tag is removed
+            # (metric_name.go:341 RemoveTag(tagName))
+            mn.labels = [(a, b) for a, b in mn.labels if a != tag]
     mn.sort_labels()
 
 
@@ -173,11 +184,14 @@ def eval_binary_op(op: str, left: list[Timeseries], right: list[Timeseries],
         skip = {k.encode() for k in on} if on is not None else set()
         keep = keep_metric_names or (is_cmp and not bool_modifier)
         pairs: list[tuple] = []           # (joined MetricName, many, one)
-        pair_idx: dict[bytes, int] = {}
         for m_ts in many:
             grp = one_groups.get(signature(m_ts.metric_name, on, ignoring))
             if grp is None:
                 continue
+            # the duplicate-name map resets per many-side series
+            # (binary_op.go:331); identical joined names from DIFFERENT
+            # many series are legal duplicate outputs
+            pair_idx: dict[bytes, int] = {}
             for o_ts in grp:
                 mn = _result_labels(m_ts.metric_name, keep)
                 _set_join_tags(mn, extra, prefix, skip, o_ts.metric_name)
@@ -214,9 +228,13 @@ def eval_binary_op(op: str, left: list[Timeseries], right: list[Timeseries],
         keep_name = keep_metric_names or (is_cmp and not bool_modifier)
         mn = _result_labels(l_ts.metric_name, keep_name)
         if on is not None:
+            # RemoveTagsOn (metric_name.go:247) resets the metric group
+            # unless __name__ is in the on-list; only an explicit
+            # keep_metric_names adds it there (binary_op.go:238) — a non-bool
+            # comparison does NOT survive the on() reduction
             keep = {k.encode() for k in on}
             mn.labels = [(k, v) for k, v in mn.labels if k in keep]
-            if b"__name__" not in keep and not keep_name:
+            if b"__name__" not in keep and not keep_metric_names:
                 mn.metric_group = b""
         elif ignoring is not None:
             # reference binary_op.go one-to-one branch calls

@@ -178,8 +178,12 @@ def _eval_rollup_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
                     raise QueryError(
                         f"unexpected second arg for {fe.name}: {explicit!r}")
                 tags = [(explicit, legs[explicit])]
-            for tag, func in tags:
-                sub = _eval_rollup_expr(ec, func, rarg, (), keep_name=keep)
+            # eval.go:943: auto `offset -step` — evaluate one step forward
+            # (shifting the inner subquery grid too), relabel back
+            ec2 = ec.child(start=ec.start + ec.step, end=ec.end + ec.step)
+            for tag, _ in tags:
+                sub = _eval_rollup_expr(ec2, "rollup_candlestick", rarg,
+                                        (tag,), keep_name=keep)
                 for ts in sub:
                     ts.metric_name.labels.append((b"rollup", tag.encode()))
                     ts.metric_name.sort_labels()
@@ -399,53 +403,61 @@ def _eval_multi_value_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
     """count_values_over_time("label", m[d]) and histogram_over_time(m[d]):
     one output series per distinct value / vmrange bucket per input series
     (rollup.go:1490 newRollupCountValues, :1526 rollupHistogram)."""
-    if not isinstance(re_.expr, MetricExpr) or re_.needs_subquery():
-        raise QueryError(f"{func} requires a plain series selector")
+    dst_label = b""
     if func == "count_values_over_time":
         if not extra or not isinstance(extra[0], str):
             raise QueryError("count_values_over_time needs a label name")
         dst_label = extra[0].encode()
     offset = re_.offset.value_ms(ec.step) if re_.offset is not None else 0
     window = re_.window.value_ms(ec.step) if re_.window is not None else 0
-    from .format_value import fmt_value as _fmt_value
-    from .vmhistogram import histogram_counts
-    series, cfg, admission = _fetch_series_for_rollup(ec, func, re_, window,
-                                                      offset)
-    out_ts = cfg.out_timestamps()
-    T = out_ts.size
+
+    def _series_rows(func, s_ts, s_vals, src_mn, cfg):
+        from .format_value import fmt_value as _fmt_value
+        from .vmhistogram import histogram_counts
+        out_ts = cfg.out_timestamps()
+        T = out_ts.size
+        lo = np.searchsorted(s_ts, out_ts - cfg.lookback, side="right")
+        hi = np.searchsorted(s_ts, out_ts, side="right")
+        per_key: dict[bytes, np.ndarray] = {}
+        for j in range(T):
+            w = s_vals[lo[j]:hi[j]]
+            if w.size == 0:
+                continue
+            if func == "count_values_over_time":
+                vals, counts = np.unique(w, return_counts=True)
+                items = [(_fmt_value(v).encode(), float(c))
+                         for v, c in zip(vals, counts)]
+            else:
+                items = [(k.encode(), float(c))
+                         for k, c in histogram_counts(w).items()]
+            for key, c in items:
+                row = per_key.get(key)
+                if row is None:
+                    row = per_key[key] = np.full(T, nan)
+                row[j] = c
+        label = dst_label if func == "count_values_over_time" else b"vmrange"
+        group = src_mn.metric_group if keep_name else b""
+        rows = []
+        for key, row in sorted(per_key.items()):
+            mn = MetricName(group,
+                            [(k, v) for k, v in src_mn.labels
+                             if k != label] + [(label, key)])
+            mn.sort_labels()
+            rows.append(Timeseries(mn, row))
+        return rows
+
     out: list[Timeseries] = []
-    with admission:
-        for sd in series:
-            lo = np.searchsorted(sd.timestamps, out_ts - cfg.lookback,
-                                 side="right")
-            hi = np.searchsorted(sd.timestamps, out_ts, side="right")
-            per_key: dict[bytes, np.ndarray] = {}
-            for j in range(T):
-                w = sd.values[lo[j]:hi[j]]
-                if w.size == 0:
-                    continue
-                if func == "count_values_over_time":
-                    vals, counts = np.unique(w, return_counts=True)
-                    items = [(_fmt_value(v).encode(), float(c))
-                             for v, c in zip(vals, counts)]
-                else:
-                    items = [(k.encode(), float(c))
-                             for k, c in histogram_counts(w).items()]
-                for key, c in items:
-                    row = per_key.get(key)
-                    if row is None:
-                        row = per_key[key] = np.full(T, nan)
-                    row[j] = c
-            label = (dst_label if func == "count_values_over_time"
-                     else b"vmrange")
-            group = sd.metric_name.metric_group if keep_name else b""
-            for key, row in sorted(per_key.items()):
-                mn = MetricName(group,
-                                [(k, v)
-                                 for k, v in sd.metric_name.labels
-                                 if k != label] + [(label, key)])
-                mn.sort_labels()
-                out.append(Timeseries(mn, row))
+    if isinstance(re_.expr, MetricExpr) and not re_.needs_subquery():
+        series, cfg, admission = _fetch_series_for_rollup(ec, func, re_,
+                                                          window, offset)
+        with admission:
+            for sd in series:
+                out.extend(_series_rows(func, sd.timestamps, sd.values,
+                                        sd.metric_name, cfg))
+    else:
+        rows, cfg = _subquery_series(ec, re_, window, offset)
+        for s_ts, s_vals, src_mn in rows:
+            out.extend(_series_rows(func, s_ts, s_vals, src_mn, cfg))
     return out
 
 
@@ -483,9 +495,11 @@ def _finish_rollup(series, rows, keep_name: bool) -> list[Timeseries]:
     return out
 
 
-def _rollup_subquery(ec: EvalConfig, func: str, re_: RollupExpr, window: int,
-                     offset: int, args: tuple, keep_name: bool
-                     ) -> list[Timeseries]:
+def _subquery_series(ec: EvalConfig, re_: RollupExpr, window: int,
+                     offset: int):
+    """Evaluate the inner expression of a subquery and return the NaN-
+    stripped per-series samples plus the outer rollup config
+    (eval.go:1006 evalRollupFuncWithSubquery)."""
     sub_step = (re_.step.value_ms(ec.step) if re_.step is not None
                 else ec.step)
     if sub_step <= 0:
@@ -496,8 +510,11 @@ def _rollup_subquery(ec: EvalConfig, func: str, re_: RollupExpr, window: int,
     # eval.go:1023: extend the inner range by window + step + the max
     # silence interval (5m) so prevValue / adjusted windows see the samples
     # just before the outer range, then step-align both ends as Prometheus
-    # subqueries do (eval.go alignStartEnd).
-    sub_start = start - lookback - sub_step - 300_000
+    # subqueries do (eval.go alignStartEnd). NOTE: the RAW window is used
+    # here (0 when unspecified), not the effective lookback — using the
+    # lookback shifts the inner grid by a full outer step, which visibly
+    # shifts seeded rand() streams.
+    sub_start = start - window - sub_step - 300_000
     sub_end = end + sub_step
     sub_start -= sub_start % sub_step
     if sub_end % sub_step:
@@ -506,21 +523,31 @@ def _rollup_subquery(ec: EvalConfig, func: str, re_: RollupExpr, window: int,
     inner = eval_expr(inner_ec, re_.expr)
     grid = inner_ec.timestamps()
     cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
-    out = []
+    rows = []
     for ts in inner:
         ok = ~np.isnan(ts.values)
         s_ts = grid[ok]
         s_vals = ts.values[ok]
         if s_ts.size == 0:
             continue
+        rows.append((s_ts, s_vals, ts.metric_name))
+    return rows, cfg
+
+
+def _rollup_subquery(ec: EvalConfig, func: str, re_: RollupExpr, window: int,
+                     offset: int, args: tuple, keep_name: bool
+                     ) -> list[Timeseries]:
+    rows, cfg = _subquery_series(ec, re_, window, offset)
+    out = []
+    for s_ts, s_vals, src_mn in rows:
         c = cfg
         adj1 = adjusted_windows(func, window, ec.step, [s_ts])
         if adj1:
-            c = RollupConfig(start=start, end=end, step=ec.step,
+            c = RollupConfig(start=cfg.start, end=cfg.end, step=ec.step,
                              window=adj1[0])
         vals = rollup_series(func, s_ts, s_vals, c, args)
-        mn = MetricName(ts.metric_name.metric_group if keep_name else b"",
-                        list(ts.metric_name.labels))
+        mn = MetricName(src_mn.metric_group if keep_name else b"",
+                        list(src_mn.labels))
         out.append(Timeseries(mn, vals))
     return out
 
@@ -1027,7 +1054,9 @@ def _scalar_side(be: BinaryOpExpr, vec: list[Timeseries], s: np.ndarray,
                 vals[np.isnan(ts.values)] = nan
             else:
                 vals = np.where(m, ts.values, nan)
-            keep = True  # comparisons keep names on scalar compare
+            # non-bool comparisons keep names on scalar compare; `bool`
+            # resets the metric group (eval.go resetMetricGroupIfRequired)
+            keep = not be.bool_modifier
         else:
             vals = ARITH_OPS[be.op](a, b)
             keep = be.keep_metric_names

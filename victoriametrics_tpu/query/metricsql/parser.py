@@ -233,7 +233,7 @@ class Parser:
                 kw = self.next().text.lower()
                 args = []
                 if self.at_op("("):
-                    args = self.parse_ident_list()
+                    args = self.parse_ident_list(allow_star=True)
                 be.join_modifier = ModifierExpr(kw, args)
                 if self.at_keyword("prefix"):
                     # group_left(...) prefix "p": copied join tags get the
@@ -483,15 +483,19 @@ class Parser:
             else:
                 return
 
-    def parse_ident_list(self) -> list[str]:
+    def parse_ident_list(self, allow_star: bool = False) -> list[str]:
         self.expect_op("(")
+        if allow_star and self.at_op("*"):
+            # `*` is valid only in group_left(*)/group_right(*) and only as
+            # the SOLE element: copy ALL tags from the one side
+            # (Go parser.go parseIdentList allowStar, metric_name.go:318)
+            self.next()
+            self.expect_op(")")
+            return ["*"]
         out = []
         while not self.at_op(")"):
             t = self.next()
-            if t.kind not in ("ident", "string") and \
-                    not (t.kind == "op" and t.text == "*"):
-                # `*` is valid in group_left(*): copy ALL tags from the
-                # one side (metric_name.go:318 SetTags)
+            if t.kind not in ("ident", "string"):
                 raise ParseError(f"expected label name at {t.pos}")
             out.append(t.text)
             if self.at_op(","):

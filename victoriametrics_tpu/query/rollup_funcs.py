@@ -459,6 +459,43 @@ PRE_ROLLUP_FUNCS = frozenset((
     "rollup_delta", "rollup_scrape_interval"))
 
 
+def _candlestick(kind: str, ts: np.ndarray, vals: np.ndarray,
+                 cfg: RollupConfig) -> np.ndarray:
+    """rollup_candlestick OHLC (rollup.go:2209-2283 + eval.go:943): windows
+    are shifted one step FORWARD (`offset -step` auto-applied), samples at
+    the window end are excluded, and `open` is the last sample at/before the
+    window start when it lies within the window length. The one-step
+    forward grid shift (`offset -step`, eval.go:943) is applied by the
+    EVALUATOR via a shifted EvalConfig so the inner subquery grid shifts
+    with it."""
+    out_ts = cfg.out_timestamps()
+    window = cfg.lookback
+    lo = np.searchsorted(ts, out_ts - window, side="right")
+    hi = np.searchsorted(ts, out_ts, side="left")  # drop ts >= currTimestamp
+    out = np.full(out_ts.size, np.nan)
+    for j in range(out_ts.size):
+        a, b = lo[j], hi[j]
+        w = vals[a:b]
+        first = nan
+        if a >= 1 and ts[a - 1] + window >= out_ts[j]:
+            first = float(vals[a - 1])
+        if kind == "open":
+            out[j] = first if first == first else (w[0] if w.size else nan)
+        elif kind == "close":
+            out[j] = w[-1] if w.size else first
+        elif kind == "high":
+            if first == first:
+                out[j] = max(first, w.max()) if w.size else first
+            else:
+                out[j] = w.max() if w.size else nan
+        elif kind == "low":
+            if first == first:
+                out[j] = min(first, w.min()) if w.size else first
+            else:
+                out[j] = w.min() if w.size else nan
+    return out
+
+
 def _pre_rollup(func: str, ts: np.ndarray, vals: np.ndarray,
                 cfg: RollupConfig, args: tuple) -> np.ndarray:
     agg = args[0] if args and isinstance(args[0], str) else "avg"
@@ -562,6 +599,8 @@ def rollup_series(func: str, ts: np.ndarray, vals: np.ndarray,
         return np.where(np.isnan(cnt), 1.0, np.nan)
     if func in PRE_ROLLUP_FUNCS:
         return _pre_rollup(func, ts, vals, cfg, args)
+    if func == "rollup_candlestick":
+        return _candlestick(args[0] if args else "close", ts, vals, cfg)
     if func == "rate_prometheus":
         # delta_prometheus / window_seconds (rollup.go:1946)
         c = rollup_np.remove_counter_resets(vals)

@@ -76,14 +76,36 @@ def _arg_values(args, i, default=None):
     return float(a)
 
 
+def _vm_round(v: np.ndarray, nearest) -> np.ndarray:
+    """transform.go:2337 transformRound, replicated float-for-float: add a
+    signed half, subtract fmod, then TRUNCATE at the nearest's decimal
+    precision. The truncation step is observable (e.g. round(0.28948, 0.01)
+    = 0.28 because 0.29*100 = 28.999... truncates to 28), so np.round is not
+    equivalent."""
+    n = np.asarray(nearest, dtype=np.float64)
+    # decimal.FromFloat(n) exponent -> p10 (per distinct nearest value)
+    def p10_of(x):
+        from ..ops.decimal import float_to_decimal
+        if not np.isfinite(x) or x == 0:
+            return 1.0
+        _, e = float_to_decimal(np.array([x]))
+        return 10.0 ** (-e)
+    if n.ndim == 0:
+        p10 = p10_of(float(n))
+    else:
+        p10 = np.array([p10_of(float(x)) for x in n])
+    with np.errstate(all="ignore"):
+        w = v + 0.5 * np.copysign(n, v)
+        w = w - np.fmod(w, n)
+        w = np.trunc(w * p10)
+        out = w / p10
+    return np.where(np.isnan(v), nan, out)
+
+
 def tf_round(ec, args):
     nearest = _arg_values(args, 1, 1.0)
-    def fn(v):
-        if np.isscalar(nearest) and nearest == 1.0:
-            return np.round(v)
-        with np.errstate(all="ignore"):
-            return np.round(v / nearest) * nearest
-    return _map_values(args[0], fn, keep_name=True)
+    return _map_values(args[0], lambda v: _vm_round(v, nearest),
+                       keep_name=True)
 
 
 def tf_clamp(ec, args):
@@ -717,17 +739,28 @@ def _hist_quantile_cols(phi, les: np.ndarray, m: np.ndarray) -> np.ndarray:
 
 
 def tf_histogram_avg(ec, args):
+    """transform.go:812 transformHistogramAvg + :876 avgForLeTimeseries:
+    vmrange buckets are converted to le= first; the +Inf bucket is SKIPPED
+    entirely (it does not advance lePrev/vPrev); weights are adjacent
+    cumulative diffs and a zero total weight yields NaN."""
     out = []
-    for key, (mn, buckets) in _group_buckets(args[0]).items():
+    series = _vmrange_to_le(list(args[0]))
+    for key, (mn, buckets) in _group_buckets(series).items():
         buckets.sort(key=lambda b: b[0])
-        les = np.array([b[0] for b in buckets])
-        m = np.nan_to_num(np.vstack([b[1] for b in buckets]))
+        buckets = _merge_same_le(buckets)
+        fin = [(le, v) for le, v in buckets if np.isfinite(le)]
+        if not fin:
+            out.append(Timeseries(mn, np.full(
+                buckets[0][1].size if buckets else 0, nan)))
+            continue
+        les = np.array([b[0] for b in fin])
+        m = np.nan_to_num(np.vstack([b[1] for b in fin]))
+        mids = (les + np.concatenate([[0.0], les[:-1]])) / 2
         d = np.diff(np.vstack([np.zeros(m.shape[1]), m]), axis=0)
-        mids = np.where(np.isfinite(les), les, les[les.size - 2] if les.size > 1 else 0)
-        lowers = np.concatenate([[0], mids[:-1]])
-        centers = (lowers + mids) / 2
         with np.errstate(all="ignore"):
-            avg = (d * centers[:, None]).sum(axis=0) / d.sum(axis=0)
+            tot = d.sum(axis=0)
+            avg = np.where(tot != 0, (d * mids[:, None]).sum(axis=0) / tot,
+                           nan)
         out.append(Timeseries(mn, avg))
     return out
 
@@ -794,22 +827,33 @@ def tf_e(ec, args):
     return [const_series(ec, math.e)]
 
 
+def _go_rand_series(ec, args, draw_attr):
+    """Seeded rand draws replicate Go's math/rand stream bit-for-bit
+    (transform.go:2653 newTransformRand + gorand.py); unseeded calls are
+    time-seeded like the reference and just use numpy."""
+    if args:
+        from .gorand import GoRand
+        r = GoRand(int(_scalar_arg(args, 0, 0)))
+        draw = getattr(r, draw_attr)
+        return [new_series(np.array([draw() for _ in range(ec.n_points)]))]
+    rng = np.random.default_rng()
+    fallback = {"float64": rng.random,
+                "norm_float64": rng.standard_normal,
+                "exp_float64": lambda n: rng.exponential(size=n)}
+    return [new_series(np.asarray(fallback[draw_attr](ec.n_points),
+                                  dtype=np.float64))]
+
+
 def tf_rand(ec, args):
-    seed = int(_scalar_arg(args, 0, 0)) if args else None
-    rng = np.random.default_rng(seed)
-    return [new_series(rng.random(ec.n_points))]
+    return _go_rand_series(ec, args, "float64")
 
 
 def tf_rand_normal(ec, args):
-    seed = int(_scalar_arg(args, 0, 0)) if args else None
-    rng = np.random.default_rng(seed)
-    return [new_series(rng.standard_normal(ec.n_points))]
+    return _go_rand_series(ec, args, "norm_float64")
 
 
 def tf_rand_exponential(ec, args):
-    seed = int(_scalar_arg(args, 0, 0)) if args else None
-    rng = np.random.default_rng(seed)
-    return [new_series(rng.exponential(size=ec.n_points))]
+    return _go_rand_series(ec, args, "exp_float64")
 
 
 def tf_smooth_exponential(ec, args):
@@ -972,9 +1016,8 @@ def _vmrange_to_le(series: list[Timeseries]) -> list[Timeseries]:
                 # later bucket (transform.go:598 discards the merge result;
                 # an overlapping duplicate like 0...0.25 over 0...0.2 +
                 # 0.2...0.25 must not be double-counted)
-                src_ok = ~np.isnan(vals)
-                if int((src_ok & ~np.isnan(prev)).sum()) <= 2 and                         vals.size > 2:
-                    prev[src_ok] = vals[src_ok]
+                from .binary_op import merge_values_non_overlapping
+                merge_values_non_overlapping(prev, vals)
             else:
                 seen_le[end_s] = vals
                 new.append((end, end_s, vals))
