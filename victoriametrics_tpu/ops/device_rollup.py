@@ -125,11 +125,13 @@ def _max_prev_interval_tile(ts: jnp.ndarray, counts: jnp.ndarray,
     idx = base[:, None] + jnp.arange(21, dtype=jnp.int32)[None, :]
     tv = jnp.take_along_axis(ts, jnp.clip(idx, 0, N - 1), axis=1)
     valid = idx < c[:, None]
-    d = (tv[:, 1:] - tv[:, :-1]).astype(jnp.float64)
+    # float32 is exact for interval magnitudes up to 2^24 ms (~4.6h) and
+    # avoids the x64-truncation warning when jax_enable_x64 is off
+    d = (tv[:, 1:] - tv[:, :-1]).astype(jnp.float32)
     dvalid = valid[:, 1:] & valid[:, :-1]
     n = dvalid.sum(axis=1)
     dsort = jnp.sort(jnp.where(dvalid, d, jnp.inf), axis=1)
-    rank = 0.6 * jnp.maximum(n - 1, 0).astype(jnp.float64)
+    rank = (0.6 * jnp.maximum(n - 1, 0)).astype(jnp.float32)
     lo_i = jnp.floor(rank).astype(jnp.int32)
     hi_i = jnp.ceil(rank).astype(jnp.int32)
     v_lo = jnp.take_along_axis(dsort, lo_i[:, None], axis=1)[:, 0]
@@ -158,13 +160,17 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
     n_win = (hi - lo).astype(dtype)
     have = hi > lo
     has_prev = lo >= 1
-    # deriv-family prevValue gate (rollup.go:781): the sample before the
-    # window seeds prevValue only within maxPrevInterval of the window start;
-    # delta/increase/changes keep the ungated sample (realPrevValue analog)
-    mpi = _max_prev_interval_tile(ts, counts, cfg)
-    t_prev_i = jnp.take_along_axis(ts, jnp.clip(lo - 1, 0, N - 1), axis=1)
-    has_gprev = has_prev & (
-        t_prev_i > (grid - cfg.lookback)[None, :] - mpi[:, None])
+    if func in ("rate", "irate", "idelta", "deriv_fast"):
+        # deriv-family prevValue gate (rollup.go:781): the sample before the
+        # window seeds prevValue only within maxPrevInterval of the window
+        # start; delta/increase/changes keep the ungated sample
+        # (realPrevValue analog). Computed only for these funcs — the
+        # quantile estimate costs a sort per tile.
+        mpi = _max_prev_interval_tile(ts, counts, cfg)
+        t_prev_i = jnp.take_along_axis(ts, jnp.clip(lo - 1, 0, N - 1),
+                                       axis=1)
+        has_gprev = has_prev & (
+            t_prev_i > (grid - cfg.lookback)[None, :] - mpi[:, None])
 
     vm = jnp.where(valid, values, 0.0)
     tsf = jnp.where(valid, ts, 0).astype(dtype)
