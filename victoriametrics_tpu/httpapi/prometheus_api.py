@@ -768,13 +768,21 @@ class PrometheusAPI:
     def h_import_prometheus(self, req: Request) -> Response:
         try:
             ts = parse_time(req.arg("timestamp"), 0)
-            text_md = req.body.decode("utf-8", "replace")
-            if "# TYPE" in text_md or "# HELP" in text_md:
-                md = parsers.parse_prometheus_metadata(text_md)
+            if b"# TYPE" in req.body or b"# HELP" in req.body:
+                md = parsers.parse_prometheus_metadata(
+                    req.body.decode("utf-8", "replace"))
                 if len(self.metadata) < 100_000:
                     self.metadata.update(md)
-            self._add_rows(parsers.parse_prometheus(
-                req.body.decode("utf-8", "replace"), ts), self._tenant(req))
+            tenant = self._tenant(req)
+            if self.relabel is None and self.series_limits is None and \
+                    self.stream_aggr is None:
+                # fast path: native parse -> raw series-key rows; cache
+                # hits in Storage.add_rows never materialize labels
+                rows = parsers.parse_prometheus_fast(req.body, ts)
+                self._ingest(rows, tenant)
+            else:
+                self._add_rows(parsers.parse_prometheus(
+                    req.body.decode("utf-8", "replace"), ts), tenant)
         except (ValueError, QueryError) as e:
             return Response.error(f"cannot parse prometheus text: {e}", 400)
         return Response(status=204, body=b"")

@@ -50,11 +50,39 @@ def parse_prometheus(text: str, default_ts: int = 0):
             yield row.with_default_ts(default_ts or _now_ms())
 
 
+def _find_closing_brace(s: str, start: int) -> int:
+    """Quote-aware scan for the '}' ending a label set ('}' may appear
+    inside quoted label values). Returns -1 when unterminated."""
+    in_q = False
+    i = start
+    n = len(s)
+    while i < n:
+        c = s[i]
+        if in_q:
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                in_q = False
+        elif c == '"':
+            in_q = True
+        elif c == "}":
+            return i
+        i += 1
+    return -1
+
+
 def _parse_prom_line(line: str) -> Row | None:
     labels = []
-    if "{" in line:
-        name, rest = line.split("{", 1)
-        lab_str, rest = rest.split("}", 1)
+    brace = line.find("{")
+    sp = line.find(" ")
+    if brace >= 0 and (sp < 0 or brace < sp):
+        name = line[:brace]
+        close = _find_closing_brace(line, brace + 1)
+        if close < 0:
+            return None
+        lab_str = line[brace + 1:close]
+        rest = line[close + 1:]
         labels.append(("__name__", name.strip()))
         labels += _parse_prom_labels(lab_str)
     else:
@@ -555,4 +583,35 @@ def parse_prometheus_metadata(text: str) -> dict:
             e["type"] = rest.strip()
         else:
             e["help"] = rest
+    return out
+
+
+def labels_from_series_key(key: bytes) -> list:
+    """Decompose a raw `name{labels}` series key (as produced by the native
+    parser, native/parse.cpp) into [(name, value), ...] — the slow path
+    taken only on TSID-cache misses. Duplicate label names collapse
+    last-wins, matching the dict(labels) Python ingest path. Raises
+    ValueError on malformed keys (callers skip the row)."""
+    text = key.decode("utf-8", "replace")
+    try:
+        row = _parse_prom_line(text + " 0")
+    except ValueError as e:
+        raise ValueError(f"invalid series key {text!r}: {e}") from None
+    if row is None:
+        raise ValueError(f"invalid series key {text!r}")
+    return list(dict(row.labels).items())
+
+
+def parse_prometheus_fast(data: bytes, default_ts: int = 0):
+    """Native-accelerated prometheus parse returning raw-key rows
+    [(series_key_bytes, ts_ms, value)] suitable for Storage.add_rows.
+    Falls back to the Python parser (materialized labels) when the native
+    library is unavailable."""
+    from .. import native
+    rows = native.parse_prom_raw(data, default_ts or _now_ms())
+    if rows is not None:
+        return rows
+    out = []
+    for row in parse_prometheus(data.decode("utf-8", "replace"), default_ts):
+        out.append((row.labels, row.timestamp, row.value))
     return out

@@ -54,6 +54,11 @@ def _load():
     lib.vm_delta_encode.argtypes = [pi64, i64, p8, pi64]
     lib.vm_delta_decode.restype = i64
     lib.vm_delta_decode.argtypes = [p8, i64, i64, pi64, i64]
+    pi32 = ctypes.POINTER(ctypes.c_int32)
+    pf64 = ctypes.POINTER(ctypes.c_double)
+    lib.vm_parse_prom.restype = i64
+    lib.vm_parse_prom.argtypes = [ctypes.c_char_p, i64, pi32, pi32,
+                                  pf64, pi64, i64]
     _lib = lib
     return lib
 
@@ -135,4 +140,40 @@ def delta_decode(data: bytes, first: int, count: int) -> np.ndarray:
                             _as_i64_ptr(out), count)
     if n != count:
         raise ValueError("native delta: malformed payload")
+    return out
+
+
+_TS_ABSENT = -(2 ** 63)  # INT64_MIN sentinel from vm_parse_prom
+
+
+def parse_prom_raw(data: bytes, default_ts: int):
+    """Native prometheus text parse -> list of (series_key_bytes, ts_ms,
+    value). Returns None when the native library is unavailable (callers
+    fall back to the Python parser). The series key is the raw
+    `name{labels}` prefix — the storage TSID cache is keyed on it directly,
+    so repeat scrapes never materialize labels."""
+    lib = _load()
+    if lib is None:
+        return None
+    n_max = data.count(b"\n") + 2
+    key_off = np.empty(n_max, dtype=np.int32)
+    key_len = np.empty(n_max, dtype=np.int32)
+    values = np.empty(n_max, dtype=np.float64)
+    tss = np.empty(n_max, dtype=np.int64)
+    n = lib.vm_parse_prom(
+        data, len(data),
+        key_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        key_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _as_i64_ptr(tss), n_max)
+    out = []
+    mv = memoryview(data)
+    for i in range(n):
+        o = key_off[i]
+        ts = tss[i]
+        # explicit 0 is "no timestamp" too, matching the Python ingest path
+        # (Row.with_default_ts treats 0 as absent)
+        out.append((bytes(mv[o:o + key_len[i]]),
+                    default_ts if ts == _TS_ABSENT or ts == 0 else int(ts),
+                    values[i]))
     return out

@@ -247,6 +247,10 @@ class Storage:
                     key = (tenant, *labels.items())
                 elif type(labels) is list:
                     key = (tenant, *labels)
+                elif type(labels) is bytes:
+                    # raw `name{labels}` series key from the native parser:
+                    # cache hits never materialize labels at all
+                    key = (tenant, labels)
                 tsid = raw_cache.get(key) if key is not None else None
                 date = ts // 86_400_000
                 mn = None
@@ -264,6 +268,13 @@ class Storage:
                         mn = labels
                     elif isinstance(labels, dict):
                         mn = MetricName.from_dict(labels)
+                    elif isinstance(labels, bytes):
+                        from ..ingest.parsers import labels_from_series_key
+                        try:
+                            mn = MetricName.from_labels(
+                                labels_from_series_key(labels))
+                        except ValueError:
+                            continue  # malformed key: skip row, keep batch
                     else:
                         mn = MetricName.from_labels(labels)
                     tsid = self._resolve_tsid(mn, mn.marshal(), tenant,
