@@ -106,3 +106,57 @@ class Client:
     def force_flush(self):
         code, _ = self.get("/internal/force_flush")
         assert code == 200
+
+
+class AppProc:
+    """Any apps/* module in a subprocess (cluster apptest processes)."""
+
+    def __init__(self, module: str, flags: list, health_port: int,
+                 name: str = ""):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.name = name or module
+        self.port = health_port
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", f"victoriametrics_tpu.apps.{module}",
+             *flags],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        self._wait_ready()
+
+    def _wait_ready(self, timeout=30):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{self.port}/health", timeout=1):
+                    return
+            except OSError:
+                if self.proc.poll() is not None:
+                    out = self.proc.stdout.read().decode()
+                    raise RuntimeError(f"{self.name} died:\n{out}")
+                time.sleep(0.1)
+        raise TimeoutError(f"{self.name} did not become ready")
+
+    def stop(self, kill=False):
+        if kill:
+            self.proc.kill()
+        else:
+            self.proc.terminate()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+def free_ports(n: int) -> list:
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports

@@ -775,7 +775,8 @@ class PrometheusAPI:
                     self.metadata.update(md)
             tenant = self._tenant(req)
             if self.relabel is None and self.series_limits is None and \
-                    self.stream_aggr is None:
+                    self.stream_aggr is None and \
+                    getattr(self.storage, "supports_raw_keys", False):
                 # fast path: native parse -> raw series-key rows; cache
                 # hits in Storage.add_rows never materialize labels
                 rows = parsers.parse_prometheus_fast(req.body, ts)

@@ -227,6 +227,11 @@ class Storage:
         self._tsid_cache[ck] = tsid
         return tsid
 
+    #: add_rows accepts raw `name{labels}` BYTES keys (native parser fast
+    #: path); ClusterStorage does NOT — it must decompose labels to shard
+    #: and marshal the RPC payload, so the HTTP layer gates on this.
+    supports_raw_keys = True
+
     def add_rows(self, rows, tenant=(0, 0)) -> int:
         """rows: iterable of (MetricName | dict | list[(k,v)], ts_ms, value).
         Returns rows added (AddRows/Storage.add analog, storage.go:1655).
