@@ -16,46 +16,22 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 class VmSingleProc:
-    """vmsingle in a subprocess (apptest/app.go analog)."""
+    """vmsingle in a subprocess (apptest/app.go analog) — thin wrapper over
+    AppProc that self-allocates the HTTP port."""
 
     def __init__(self, data_path: str, port: int = 0, extra_flags=()):
-        import socket
         if port == 0:
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-            s.close()
+            port = free_ports(1)[0]
         self.port = port
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        self.proc = subprocess.Popen(
-            [sys.executable, "-m", "victoriametrics_tpu.apps.vmsingle",
-             f"-storageDataPath={data_path}",
+        self._app = AppProc(
+            "vmsingle",
+            [f"-storageDataPath={data_path}",
              f"-httpListenAddr=127.0.0.1:{port}", *extra_flags],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        self._wait_ready()
-
-    def _wait_ready(self, timeout=30):
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            try:
-                with urllib.request.urlopen(
-                        f"http://127.0.0.1:{self.port}/health", timeout=1):
-                    return
-            except OSError:
-                if self.proc.poll() is not None:
-                    out = self.proc.stdout.read().decode()
-                    raise RuntimeError(f"vmsingle died:\n{out}")
-                time.sleep(0.1)
-        raise TimeoutError("vmsingle did not become ready")
+            port, "vmsingle")
+        self.proc = self._app.proc
 
     def stop(self):
-        self.proc.terminate()
-        try:
-            self.proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            self.proc.kill()
+        self._app.stop()
 
 
 class Client:

@@ -28,6 +28,7 @@ class TPUEngine:
     value_dtype: object = np.float64
     min_series: int = 64        # below this the host path wins
     _cache: object = None
+    _aux: object = None
 
     def cache(self):
         if self._cache is None:
@@ -49,7 +50,7 @@ def _fingerprint(series, start_ms: int) -> tuple:
 
 
 def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
-                   args: tuple):
+                   args: tuple, cache_key=None):
     """Returns list of per-series value rows, or None for host fallback."""
     if func not in rollup_np.SUPPORTED:
         return None
@@ -68,7 +69,7 @@ def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
     except Exception:
         return None
 
-    key = _fingerprint(series, cfg.start)
+    key = cache_key or _fingerprint(series, cfg.start)
     cache = engine.cache()
     tiles = cache.get(key)
     if tiles is None:
@@ -86,7 +87,8 @@ FUSED_AGGRS = frozenset({"sum", "count", "avg", "min", "max", "stddev",
 
 
 def try_aggr_rollup_tpu(engine: TPUEngine, aggr: str, func: str, series,
-                        gids, num_groups: int, cfg: RollupConfig):
+                        gids, num_groups: int, cfg: RollupConfig,
+                        cache_key=None):
     """Fused aggr(rollup(selector)) on device: per-series rollup + segment
     aggregation run in one kernel, so only the [G, T] aggregate crosses the
     device->host link (the incrementalAggrFuncCallbacks analog,
@@ -105,7 +107,7 @@ def try_aggr_rollup_tpu(engine: TPUEngine, aggr: str, func: str, series,
         from ..ops.device_rollup import rollup_aggregate_tile
     except Exception:
         return None
-    key = _fingerprint(series, cfg.start)
+    key = cache_key or _fingerprint(series, cfg.start)
     cache = engine.cache()
     tiles = cache.get(key)
     if tiles is None:
@@ -148,3 +150,40 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
         dtype=engine.value_dtype)
     return (chunked_device_put(ts), chunked_device_put(vals),
             jnp.asarray(counts))
+
+
+def aux_cache(engine: TPUEngine):
+    """Host-side LRU mapping a query-shape key to (tile_key, adjusted cfg,
+    device gids, group keys, sample count): lets a warm fused query skip the
+    host fetch entirely and go straight to the resident tile."""
+    if engine._aux is None:
+        from collections import OrderedDict
+        engine._aux = OrderedDict()
+    return engine._aux
+
+
+def aux_get(engine: TPUEngine, key):
+    aux = aux_cache(engine)
+    hit = aux.get(key)
+    if hit is not None:
+        aux.move_to_end(key)  # true LRU: hits refresh recency
+    return hit
+
+
+def aux_put(engine: TPUEngine, key, value, cap: int = 1024):
+    aux = aux_cache(engine)
+    aux[key] = value
+    aux.move_to_end(key)
+    while len(aux) > cap:
+        aux.popitem(last=False)
+
+
+def run_fused_on_tiles(engine: TPUEngine, aggr: str, func: str, tiles,
+                       gids_dev, num_groups: int, cfg: RollupConfig):
+    """Fused kernel over an HBM-resident tile (warm-path shortcut: no host
+    fetch, no upload)."""
+    from ..ops.device_rollup import rollup_aggregate_tile
+    ts_t, v_t, counts = tiles
+    out = rollup_aggregate_tile(func, aggr, ts_t, v_t, counts, gids_dev,
+                                cfg, num_groups)
+    return np.asarray(out, dtype=np.float64)

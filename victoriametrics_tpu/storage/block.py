@@ -60,7 +60,7 @@ class Block:
     """Decoded (in-RAM) block."""
 
     __slots__ = ("tsid", "timestamps", "values", "scale", "precision_bits",
-                 "_floats")
+                 "_floats", "_has_stale")
 
     def __init__(self, tsid: TSID, timestamps: np.ndarray, values: np.ndarray,
                  scale: int, precision_bits: int = 64):
@@ -70,6 +70,7 @@ class Block:
         self.scale = scale
         self.precision_bits = precision_bits
         self._floats = None
+        self._has_stale = None
 
     @classmethod
     def from_floats(cls, tsid: TSID, timestamps: np.ndarray,
@@ -85,6 +86,14 @@ class Block:
             f.setflags(write=False)
             self._floats = f
         return self._floats
+
+    def has_stale(self) -> bool:
+        """Whether any value is a staleness-marker NaN — memoized alongside
+        the float decode so warm queries skip the per-query stale scan."""
+        if self._has_stale is None:
+            self._has_stale = bool(
+                dec.is_stale_nan(self.float_values()).any())
+        return self._has_stale
 
     @property
     def rows(self) -> int:

@@ -33,14 +33,31 @@ DEFAULT_RETENTION_MS = 31 * 13 * 86_400_000  # ~13 months, like the reference
 class SeriesData:
     """Decoded query result for one series."""
 
-    __slots__ = ("metric_name", "timestamps", "values", "raw_name")
+    __slots__ = ("metric_name", "timestamps", "values", "raw_name",
+                 "_stale_blocks", "_maybe_stale")
 
     def __init__(self, metric_name: MetricName, timestamps: np.ndarray,
-                 values: np.ndarray, raw_name: bytes | None = None):
+                 values: np.ndarray, raw_name: bytes | None = None,
+                 stale_blocks=None):
         self.metric_name = metric_name
         self.timestamps = timestamps
         self.values = values
         self.raw_name = raw_name  # marshaled name (sort/fingerprint key)
+        # lazily computed from the contributing blocks' memoized stale
+        # scans: default_rollup (the common case) never consults it, so it
+        # costs nothing there; sealed-part blocks amortize across queries
+        self._stale_blocks = stale_blocks
+        self._maybe_stale = None if stale_blocks is not None else True
+
+    @property
+    def maybe_stale(self) -> bool:
+        """False when every contributing block is known stale-marker-free
+        (block-level memo): lets the eval skip the per-query stale scan."""
+        if self._maybe_stale is None:
+            self._maybe_stale = any(b.has_stale()
+                                    for b in self._stale_blocks)
+            self._stale_blocks = None
+        return self._maybe_stale
 
 
 class Storage:
@@ -75,6 +92,9 @@ class Storage:
         self._stop = threading.Event()
         self._readonly = False
         self.rows_added = 0
+        # bumped on every data mutation (ingest/delete/retention): cheap
+        # content token for device tile-cache fingerprints
+        self.data_version = 0
         self.slow_row_inserts = 0
         self.new_series_created = 0
         from ..query.rollup_result_cache import next_storage_token
@@ -307,6 +327,8 @@ class Storage:
                 GLOBAL.reset()
         self.table.add_rows(out)
         self.rows_added += len(out)
+        if out:
+            self.data_version += 1
         return len(out)
 
     def _cardinality_ok(self, metric_id: int) -> bool:
@@ -399,7 +421,8 @@ class Storage:
                 dup = np.concatenate([ts[1:] == ts[:-1], [False]])
                 if dup.any():
                     ts, vals = ts[~dup], vals[~dup]
-            out.append((raw, SeriesData(mn, ts, vals, raw)))
+            out.append((raw, SeriesData(mn, ts, vals, raw,
+                                        stale_blocks=blocks)))
         out.sort(key=lambda rs: rs[0])
         return [sd for _, sd in out]
 
@@ -464,6 +487,9 @@ class Storage:
                 self._tsid_cache_raw = {
                     k: t for k, t in self._tsid_cache_raw.items()
                     if t.metric_id not in dead}
+            # AFTER the tombstones land: a racing query that fetched the
+            # old data keys its tile under the pre-delete version
+            self.data_version += 1
         return int(mids.size)
 
     # -- maintenance -------------------------------------------------------
@@ -491,6 +517,8 @@ class Storage:
             with self._lock:
                 self._day_cache = {dk for dk in self._day_cache
                                    if dk[1] >= min_date}
+        if n:
+            self.data_version += 1  # after the drop; no-op sweeps keep tiles
         return n
 
     # -- snapshots ---------------------------------------------------------
