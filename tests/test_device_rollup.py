@@ -306,6 +306,11 @@ class TestFusedDeviceAggr:
         "max by (instance,job)(delta(fm[4m]))",
         "min without (job,instance)(rate(fm[5m]))",
         "stddev by (job)(avg_over_time(fm[5m]))",
+        "quantile(0.9, rate(fm[5m])) by (instance)",
+        "quantile(0.25, last_over_time(fm[2m])) by (job)",
+        "quantile(1.5, rate(fm[5m])) by (job)",
+        "median(increase(fm[3m])) by (instance)",
+        "quantile(0.5, rate(fm[5m]))",
     ])
     def test_fused_matches_host(self, store, q):
         import numpy as np
@@ -323,3 +328,27 @@ class TestFusedDeviceAggr:
         for k in hm:
             np.testing.assert_allclose(dm[k], hm[k], rtol=1e-6, atol=1e-6,
                                        equal_nan=True, err_msg=q)
+
+
+    def test_fused_warm_path_matches(self, store):
+        """Second run hits the aux/resident-tile shortcut and must agree."""
+        import numpy as np
+        from victoriametrics_tpu.query.exec import exec_query
+        from victoriametrics_tpu.query.tpu_engine import TPUEngine
+        from victoriametrics_tpu.query.types import EvalConfig
+        T0 = 1_753_700_000_000
+        engine = TPUEngine(min_series=4)
+        for q in ("sum by (instance)(rate(fm[5m]))",
+                  "quantile(0.9, rate(fm[5m])) by (instance)"):
+            kw = dict(start=T0 - 300_000, end=T0, step=60_000, storage=store)
+            host = exec_query(EvalConfig(**kw), q)
+            cold = exec_query(EvalConfig(**kw, tpu=engine), q)
+            warm = exec_query(EvalConfig(**kw, tpu=engine), q)
+            hm = {r.metric_name.marshal(): r.values for r in host}
+            for res in (cold, warm):
+                rm = {r.metric_name.marshal(): r.values for r in res}
+                assert set(rm) == set(hm), q
+                for k in hm:
+                    np.testing.assert_allclose(rm[k], hm[k], rtol=1e-6,
+                                               atol=1e-6, equal_nan=True,
+                                               err_msg=q)

@@ -430,3 +430,44 @@ def pack_series(series: list[tuple[np.ndarray, np.ndarray]], start_ms: int,
         ts[i, :c] = rel.astype(np.int32)
         vals[i, :c] = v
     return ts, vals, counts
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rollup_func", "cfg", "num_groups",
+                                    "max_group"))
+def rollup_quantile_tile(rollup_func: str, phi, ts: jnp.ndarray,
+                         values: jnp.ndarray, counts: jnp.ndarray,
+                         group_ids: jnp.ndarray, slots: jnp.ndarray,
+                         cfg: RollupConfig, num_groups: int,
+                         max_group: int) -> jnp.ndarray:
+    """Fused quantile(phi, rollup(m[d])) by (...) -> [G, T].
+
+    The per-series rollup [S, T] is scattered into a dense [G, M, T] tensor
+    (M = largest group, host-precomputed per-series slot within its group),
+    sorted along M (NaN gaps sort last), and linearly interpolated at
+    phi*(n-1) per (group, step) — matching the host a_quantile /
+    np.nanquantile semantics. The caller bounds G*M*T so skewed groupings
+    fall back to the host path rather than exploding HBM."""
+    rolled = rollup_tile(rollup_func, ts, values, counts, cfg)  # [S, T]
+    S, T = rolled.shape
+    dtype = rolled.dtype
+    nan = jnp.asarray(jnp.nan, dtype)
+    dense = jnp.full((num_groups, max_group, T), nan, dtype)
+    dense = dense.at[group_ids, slots].set(rolled)
+    dsort = jnp.sort(dense, axis=1)  # NaNs last per (g, t)
+    valid = ~jnp.isnan(rolled)
+    n = jnp.zeros((num_groups, T), jnp.int32).at[group_ids].add(
+        valid.astype(jnp.int32))  # live series per (g, t)
+    phi_arr = jnp.asarray(phi, dtype)
+    rank = jnp.clip(phi_arr, 0.0, 1.0) * jnp.maximum(n - 1, 0)
+    lo = jnp.floor(rank).astype(jnp.int32)
+    hi = jnp.ceil(rank).astype(jnp.int32)
+    g_idx = jnp.arange(num_groups, dtype=jnp.int32)[:, None]
+    t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+    v_lo = dsort[g_idx, lo, t_idx]
+    v_hi = dsort[g_idx, hi, t_idx]
+    q = v_lo + (rank - lo) * (v_hi - v_lo)
+    # reference a_quantile: phi<0 -> -Inf, phi>1 -> +Inf on live steps
+    q = jnp.where(phi_arr < 0, -jnp.inf, q)
+    q = jnp.where(phi_arr > 1, jnp.inf, q)
+    return jnp.where(n > 0, q, nan)

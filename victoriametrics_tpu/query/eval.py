@@ -620,9 +620,25 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
     """aggr by (...)(rollup(selector)) fused on device: rollup + segment
     aggregation in one kernel so only [G, T] crosses the link (the
     incremental-aggregation pushdown; None -> host path)."""
-    if ec.tpu is None or len(ae.args) != 1 or ae.name not in _FUSED_AGGR_NAMES:
+    if ec.tpu is None:
         return None
-    arg = ae.args[0]
+    phi = None
+    if ae.name in ("quantile", "median"):
+        # quantile(phi, q) fuses when phi is a literal; median = 0.5
+        if ae.name == "quantile":
+            if len(ae.args) != 2 or not isinstance(ae.args[0], NumberExpr):
+                return None
+            phi = float(ae.args[0].value)
+            arg = ae.args[1]
+        else:
+            if len(ae.args) != 1:
+                return None
+            phi = 0.5
+            arg = ae.args[0]
+    elif len(ae.args) != 1 or ae.name not in _FUSED_AGGR_NAMES:
+        return None
+    else:
+        arg = ae.args[0]
     if isinstance(arg, FuncExpr):
         if len(arg.args) != 1 or arg.keep_metric_names:
             return None
@@ -638,9 +654,11 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
             rarg.needs_subquery() or rarg.at is not None:
         return None
     from ..ops import rollup_np
-    from .tpu_engine import (FUSED_AGGRS, aux_get, aux_put,
-                             run_fused_on_tiles, try_aggr_rollup_tpu)
-    if func not in rollup_np.SUPPORTED or ae.name not in FUSED_AGGRS:
+    from .tpu_engine import (FUSED_AGGRS, aux_get, aux_put, group_slots,
+                             run_fused_on_tiles, run_quantile_on_tiles,
+                             try_aggr_rollup_tpu, try_quantile_rollup_tpu)
+    if func not in rollup_np.SUPPORTED or \
+            (phi is None and ae.name not in FUSED_AGGRS):
         return None
     offset = rarg.offset.value_ms(ec.step) if rarg.offset is not None else 0
     window = rarg.window.value_ms(ec.step) if rarg.window is not None else 0
@@ -662,21 +680,28 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
     ver = getattr(ec.storage, "data_version", None)
     if ver is not None:
         aux_key = ("fused-aux", str(rarg.expr), ec.tenant, ec.start, ec.end,
-                   ec.step, window, offset, func, ae.name,
+                   ec.step, window, offset, func, ae.name, phi,
                    tuple(ae.grouping), ae.without,
                    getattr(ec.storage, "dedup_interval_ms", 0),
                    ec.lookback_delta, ec.max_series, ver)
         aux = aux_get(ec.tpu, aux_key)
         if aux is not None:
-            tile_key, cfg2, gids_dev, group_keys, n_samples = aux
+            tile_key, cfg2, gids_dev, group_keys, n_samples, qx = aux
             tiles = ec.tpu.cache().get(tile_key)
             if tiles is not None:
                 ec.check_deadline()
                 ec.count_samples(n_samples)
                 qt = ec.tracer.new_child("tpu fused %s(%s) warm", ae.name,
                                          func)
-                out = run_fused_on_tiles(ec.tpu, ae.name, func, tiles,
-                                         gids_dev, len(group_keys), cfg2)
+                if qx is not None:
+                    slots_dev, max_group = qx
+                    out = run_quantile_on_tiles(
+                        ec.tpu, phi, func, tiles, gids_dev, slots_dev,
+                        len(group_keys), max_group, cfg2)
+                else:
+                    out = run_fused_on_tiles(ec.tpu, ae.name, func, tiles,
+                                             gids_dev, len(group_keys),
+                                             cfg2)
                 qt.donef("resident tile, %d groups", len(group_keys))
                 return _emit(out, group_keys)
 
@@ -717,9 +742,17 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
             gids[i] = gid
         qt = ec.tracer.new_child("tpu fused %s(%s)", ae.name, func)
         tile_key = _tile_cache_key(ec, rarg.expr, cfg, fetch_info)
-        out = try_aggr_rollup_tpu(ec.tpu, ae.name, func, series, gids,
-                                  len(group_keys), cfg,
-                                  cache_key=tile_key)
+        qx = None
+        slots = max_group = None
+        if phi is not None:
+            slots, max_group = group_slots(gids, len(group_keys))
+            out = try_quantile_rollup_tpu(ec.tpu, phi, func, series, gids,
+                                          len(group_keys), cfg, slots,
+                                          max_group, cache_key=tile_key)
+        else:
+            out = try_aggr_rollup_tpu(ec.tpu, ae.name, func, series, gids,
+                                      len(group_keys), cfg,
+                                      cache_key=tile_key)
         if out is None:
             qt.donef("fell back to host")
             return _decline()
@@ -728,9 +761,11 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
         if aux_key is not None and tile_key is not None and \
                 not ec._partial[0]:
             import jax.numpy as jnp
+            if phi is not None:
+                qx = (jnp.asarray(slots), max_group)
             aux_put(ec.tpu, aux_key,
                     (tile_key, cfg, jnp.asarray(gids), list(group_keys),
-                     n_fetched))
+                     n_fetched, qx))
     return _emit(out, group_keys)
 
 

@@ -187,3 +187,77 @@ def run_fused_on_tiles(engine: TPUEngine, aggr: str, func: str, tiles,
     out = rollup_aggregate_tile(func, aggr, ts_t, v_t, counts, gids_dev,
                                 cfg, num_groups)
     return np.asarray(out, dtype=np.float64)
+
+
+# HBM budget for the dense [G, M, T] quantile tensor. The kernel holds the
+# scatter target AND its sorted copy simultaneously, so the element cap is
+# budget / (itemsize * 2).
+_QUANTILE_DENSE_BYTES = 512 << 20
+
+
+def group_slots(gids, num_groups: int):
+    """Per-series slot within its group + the largest group size — the ONE
+    place this ordering is defined (warm-path reuse depends on it matching
+    the cold-path scatter exactly)."""
+    counts_per_group = np.bincount(gids, minlength=num_groups)
+    max_group = int(counts_per_group.max()) if num_groups else 0
+    next_slot = np.zeros(num_groups, dtype=np.int32)
+    slots = np.empty(len(gids), dtype=np.int32)
+    for i, g in enumerate(gids):
+        slots[i] = next_slot[g]
+        next_slot[g] += 1
+    return slots, max_group
+
+
+def quantile_dense_fits(engine: TPUEngine, num_groups: int, max_group: int,
+                        cfg: RollupConfig) -> bool:
+    T = (cfg.end - cfg.start) // cfg.step + 1
+    itemsize = np.dtype(engine.value_dtype).itemsize
+    return num_groups * max_group * T <= \
+        _QUANTILE_DENSE_BYTES // (itemsize * 2)
+
+
+def try_quantile_rollup_tpu(engine: TPUEngine, phi: float, func: str,
+                            series, gids, num_groups: int,
+                            cfg: RollupConfig, slots, max_group: int,
+                            cache_key=None):
+    """Fused quantile/median(phi, rollup(selector)) by (...) on device.
+    `slots`/`max_group` come from group_slots(). Returns [G, T] float64 or
+    None for host fallback."""
+    if func not in rollup_np.SUPPORTED:
+        return None
+    if len(series) < engine.min_series:
+        return None
+    span = cfg.end - cfg.start + cfg.lookback
+    if span >= 2**31 - 1:
+        return None
+    if not quantile_dense_fits(engine, num_groups, max_group, cfg):
+        return None  # skewed grouping: dense tensor too big, host wins
+    try:
+        import jax.numpy as jnp
+
+        from ..ops.device_rollup import rollup_quantile_tile
+    except Exception:
+        return None
+    key = cache_key or _fingerprint(series, cfg.start)
+    cache = engine.cache()
+    tiles = cache.get(key)
+    if tiles is None:
+        tiles = _upload_tiles(engine, series, cfg)
+        cache.put_device(key, tiles)
+    ts_t, v_t, counts = tiles
+    out = rollup_quantile_tile(func, phi, ts_t, v_t, counts,
+                               jnp.asarray(gids), jnp.asarray(slots), cfg,
+                               num_groups, max_group)
+    return np.asarray(out, dtype=np.float64)
+
+
+def run_quantile_on_tiles(engine: TPUEngine, phi: float, func: str, tiles,
+                          gids_dev, slots_dev, num_groups: int,
+                          max_group: int, cfg: RollupConfig):
+    """Warm-path fused quantile over an HBM-resident tile."""
+    from ..ops.device_rollup import rollup_quantile_tile
+    ts_t, v_t, counts = tiles
+    out = rollup_quantile_tile(func, phi, ts_t, v_t, counts, gids_dev,
+                               slots_dev, cfg, num_groups, max_group)
+    return np.asarray(out, dtype=np.float64)
