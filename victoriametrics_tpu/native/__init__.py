@@ -59,6 +59,9 @@ def _load():
     lib.vm_parse_prom.restype = i64
     lib.vm_parse_prom.argtypes = [ctypes.c_char_p, i64, pi32, pi32,
                                   pf64, pi64, i64]
+    lib.vm_marshal_i64_many.restype = i64
+    lib.vm_marshal_i64_many.argtypes = [pi64, pi64, i64, p8, i64,
+                                        pi32, pi64, pi64]
     _lib = lib
     return lib
 
@@ -177,3 +180,29 @@ def parse_prom_raw(data: bytes, default_ts: int):
                     default_ts if ts == _TS_ABSENT or ts == 0 else int(ts),
                     values[i]))
     return out
+
+
+def marshal_i64_many(vals: np.ndarray, offsets: np.ndarray):
+    """Batched block marshal: type choice + encode for K blocks in one
+    native call. vals = int64 concatenation, offsets = K+1 boundaries.
+    Returns (payload bytes, types int32[K], firsts int64[K], lens int64[K])
+    or None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    k = offsets.size - 1
+    cap = int(vals.size + k) * 10 + 16
+    out = ctypes.create_string_buffer(cap)
+    types = np.empty(k, dtype=np.int32)
+    firsts = np.empty(k, dtype=np.int64)
+    lens = np.empty(k, dtype=np.int64)
+    n = lib.vm_marshal_i64_many(
+        _as_i64_ptr(vals), _as_i64_ptr(offsets), k,
+        ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)), cap,
+        types.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _as_i64_ptr(firsts), _as_i64_ptr(lens))
+    if n < 0:
+        raise ValueError("native batched marshal failed")
+    return out.raw[:n], types, firsts, lens

@@ -160,4 +160,92 @@ int64_t vm_delta_decode(const uint8_t* data, int64_t len, int64_t first,
     return (p == end) ? n : -1;
 }
 
+
+// ---------------------------------------------------------------------------
+// batched block marshal: type choice + encode for K blocks in one call
+// ---------------------------------------------------------------------------
+
+// Marshal types (mirror ops/encoding.py MarshalType)
+#define VM_MT_CONST 1
+#define VM_MT_DELTA_CONST 2
+#define VM_MT_NEAREST_DELTA 3
+#define VM_MT_NEAREST_DELTA2 4
+
+// For each block i with values vals[offsets[i]..offsets[i+1]):
+// choose CONST / DELTA_CONST / NEAREST_DELTA (gauge: >1/8 negative deltas)
+// / NEAREST_DELTA2 exactly like ops/encoding.py marshal_int64_array, encode
+// the payload contiguously into out, and record (type, first_value,
+// payload_len). Returns total bytes written, or -1 when out_cap would be
+// exceeded. offsets has n_blocks+1 entries.
+int64_t vm_marshal_i64_many(const int64_t* vals, const int64_t* offsets,
+                            int64_t n_blocks, uint8_t* out, int64_t out_cap,
+                            int32_t* types, int64_t* firsts, int64_t* lens) {
+    int64_t pos = 0;
+    for (int64_t i = 0; i < n_blocks; i++) {
+        const int64_t* v = vals + offsets[i];
+        int64_t n = offsets[i + 1] - offsets[i];
+        if (n <= 0) return -1;
+        // worst case: 10 bytes per varint
+        if (pos + (n + 1) * 10 > out_cap) return -1;
+        bool is_const = true;
+        for (int64_t j = 1; j < n; j++) {
+            if (v[j] != v[0]) { is_const = false; break; }
+        }
+        if (is_const) {
+            types[i] = VM_MT_CONST;
+            firsts[i] = v[0];
+            lens[i] = 0;
+            continue;
+        }
+        // delta-const (wrapping two's-complement deltas, like np.int64)
+        if (n >= 2) {
+            uint64_t d0 = (uint64_t)v[1] - (uint64_t)v[0];
+            bool dconst = true;
+            for (int64_t j = 2; j < n; j++) {
+                if ((uint64_t)v[j] - (uint64_t)v[j - 1] != d0) {
+                    dconst = false;
+                    break;
+                }
+            }
+            if (dconst) {
+                int64_t d = (int64_t)d0;
+                int64_t len = vm_varint_encode(&d, 1, out + pos);
+                types[i] = VM_MT_DELTA_CONST;
+                firsts[i] = v[0];
+                lens[i] = len;
+                pos += len;
+                continue;
+            }
+        }
+        int64_t neg = 0;
+        for (int64_t j = 1; j < n; j++) {
+            if (v[j] < v[j - 1]) neg++;
+        }
+        if (neg * 8 > n) {
+            // gauge: first-order deltas
+            int64_t first;
+            int64_t len = vm_delta_encode(v, n, out + pos, &first);
+            types[i] = VM_MT_NEAREST_DELTA;
+            firsts[i] = first;
+            lens[i] = len;
+            pos += len;
+        } else {
+            // counter: varint(first_delta) + delta2 stream
+            int64_t first, first_delta;
+            uint8_t tmp[10];
+            int64_t d2len = vm_delta2_encode(v, n, out + pos, &first,
+                                             &first_delta);
+            int64_t fdlen = vm_varint_encode(&first_delta, 1, tmp);
+            // shift payload right to prepend the first_delta varint
+            memmove(out + pos + fdlen, out + pos, d2len);
+            memcpy(out + pos, tmp, fdlen);
+            types[i] = VM_MT_NEAREST_DELTA2;
+            firsts[i] = first;
+            lens[i] = fdlen + d2len;
+            pos += fdlen + d2len;
+        }
+    }
+    return pos;
+}
+
 }  // extern "C"

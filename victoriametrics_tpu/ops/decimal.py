@@ -158,7 +158,33 @@ def float_to_decimal(values: np.ndarray) -> tuple[np.ndarray, int]:
         return np.zeros(0, dtype=np.int64), 0
     if n <= 8:
         return _float_to_decimal_small(v)
+    m, e, normal, specials = _f2d_element_phase(v)
+    if normal.any():
+        e_norm = e[normal]
+        m_norm = m[normal]
+        # Common exponent: as small as possible without overflowing mantissas.
+        # Scaling m from exponent E down to `exp` multiplies it by 10^(E-exp);
+        # the largest allowed up-shift for m is floor(log10(MAX_MANTISSA/|m|)).
+        absm = np.abs(m_norm).astype(np.float64)
+        absm = np.maximum(absm, 1.0)
+        allowed_up = np.floor(np.log10(MAX_MANTISSA / absm)).astype(np.int64)
+        exp = int(min(e_norm.min(), _MAX_EXP))
+        exp_floor = int((e_norm - allowed_up).max())
+        if exp_floor > exp:
+            exp = exp_floor
+        exp = max(min(exp, _MAX_EXP), _MIN_EXP)
+        m = _f2d_rescale(m, e, normal, np.int64(exp))
+    else:
+        exp = 0
+    m = _f2d_apply_specials(m, specials)
+    return m, int(exp)
 
+
+def _f2d_element_phase(v: np.ndarray):
+    """Element-wise mantissa/exponent extraction (shared by the per-block
+    and grouped entry points): returns (m, e, normal, specials) BEFORE
+    common-exponent unification."""
+    n = v.size
     stale = is_stale_nan(v)
     nan = np.isnan(v) & ~stale
     posinf = np.isposinf(v)
@@ -224,45 +250,86 @@ def float_to_decimal(values: np.ndarray) -> tuple[np.ndarray, int]:
             ei = np.where(can, ei + 1, ei)
         m = np.where(normal, mi, m)
         e = np.where(normal, ei, e)
+    return m, e, normal, (stale, nan, posinf, neginf)
 
-    if normal.any():
-        e_norm = e[normal]
-        m_norm = m[normal]
-        # Common exponent: as small as possible without overflowing mantissas.
-        # Scaling m from exponent E down to `exp` multiplies it by 10^(E-exp);
-        # the largest allowed up-shift for m is floor(log10(MAX_MANTISSA/|m|)).
-        absm = np.abs(m_norm).astype(np.float64)
-        absm = np.maximum(absm, 1.0)
-        allowed_up = np.floor(np.log10(MAX_MANTISSA / absm)).astype(np.int64)
-        exp = int(min(e_norm.min(), _MAX_EXP))
-        exp_floor = int((e_norm - allowed_up).max())
-        if exp_floor > exp:
-            exp = exp_floor
-        exp = max(min(exp, _MAX_EXP), _MIN_EXP)
-        # Rescale all normal mantissas to the common exponent.
-        shift = e - exp
-        up = normal & (shift > 0)
-        down = normal & (shift < 0)
-        if up.any():
-            # Exact int64 multiply: the shifted product is bounded by
-            # MAX_MANTISSA (1e17 < 2^63) by construction of allowed_up, and a
-            # float64 multiply here would corrupt mantissas above 2^53.
-            factor = np.power(np.int64(10), np.where(up, shift, 0).astype(np.int64))
-            m = np.where(up, m * factor, m)
-        if down.any():
-            # Lossy: value has more precision than the common scale can hold.
-            # Shifts beyond 18 decimal places collapse the mantissa to zero.
-            dshift = np.minimum(np.where(down, -shift, 1), 19).astype(np.float64)
-            factor = np.power(10.0, dshift)
-            m = np.where(down, np.round(m.astype(np.float64) / factor).astype(np.int64), m)
-    else:
-        exp = 0
 
+def _f2d_rescale(m, e, normal, exp):
+    """Rescale normal mantissas from their own exponents to `exp` (scalar
+    int64 or per-element int64 array)."""
+    shift = e - exp
+    up = normal & (shift > 0)
+    down = normal & (shift < 0)
+    if up.any():
+        # Exact int64 multiply: the shifted product is bounded by
+        # MAX_MANTISSA (1e17 < 2^63) by construction of allowed_up, and a
+        # float64 multiply here would corrupt mantissas above 2^53.
+        factor = np.power(np.int64(10), np.where(up, shift, 0).astype(np.int64))
+        m = np.where(up, m * factor, m)
+    if down.any():
+        # Lossy: value has more precision than the common scale can hold.
+        # Shifts beyond 18 decimal places collapse the mantissa to zero.
+        dshift = np.minimum(np.where(down, -shift, 1), 19).astype(np.float64)
+        factor = np.power(10.0, dshift)
+        m = np.where(down, np.round(m.astype(np.float64) / factor).astype(np.int64), m)
+    return m
+
+
+def _f2d_apply_specials(m, specials):
+    stale, nan, posinf, neginf = specials
     m = np.where(stale, V_STALE_NAN, m)
     m = np.where(nan, V_NAN, m)
     m = np.where(posinf, V_INF_POS, m)
     m = np.where(neginf, V_INF_NEG, m)
-    return m, int(exp)
+    return m
+
+
+def float_to_decimal_grouped(values: np.ndarray, starts: np.ndarray
+                             ) -> tuple[np.ndarray, np.ndarray]:
+    """Per-group float_to_decimal over a concatenation — bit-identical to
+    calling float_to_decimal on each segment, but the element-wise phase
+    runs ONCE over the whole array and the per-group common-exponent
+    unification is reduceat-vectorized. The flush path batches thousands of
+    small per-series blocks through this (the per-call overhead of the
+    vectorized pipeline dominates at ~24-sample scrape blocks).
+
+    starts: sorted int group start offsets; ends are implied. Returns
+    (mantissas, exps[int64, one per group]). Groups of <=8 values take the
+    exact repr-based small path, like the per-block entry point."""
+    v = np.asarray(values, dtype=np.float64)
+    starts = np.asarray(starts, dtype=np.int64)
+    n_groups = starts.size
+    exps = np.zeros(n_groups, dtype=np.int64)
+    if v.size == 0 or n_groups == 0:
+        return np.zeros(v.size, dtype=np.int64), exps
+    ends = np.append(starts[1:], v.size)
+    sizes = ends - starts
+    m_out = np.empty(v.size, dtype=np.int64)
+    small = sizes <= 8
+    big_idx = np.flatnonzero(~small)
+    if big_idx.size:
+        m, e, normal, specials = _f2d_element_phase(v)
+        BIG = np.int64(1 << 40)
+        absm = np.maximum(np.abs(m).astype(np.float64), 1.0)
+        allowed_up = np.floor(np.log10(MAX_MANTISSA / absm)).astype(np.int64)
+        emin_g = np.minimum.reduceat(np.where(normal, e, BIG), starts)
+        floor_g = np.maximum.reduceat(
+            np.where(normal, e - allowed_up, -BIG), starts)
+        has_norm_g = np.logical_or.reduceat(normal, starts)
+        exp_g = np.minimum(emin_g, _MAX_EXP)
+        exp_g = np.where(floor_g > exp_g, floor_g, exp_g)
+        exp_g = np.clip(exp_g, _MIN_EXP, _MAX_EXP)
+        exp_g = np.where(has_norm_g, exp_g, 0)
+        exp_elem = np.repeat(exp_g, sizes)
+        m_all = _f2d_rescale(m, e, normal, exp_elem)
+        m_all = _f2d_apply_specials(m_all, specials)
+        m_out[:] = m_all
+        exps[:] = exp_g
+    for gi in np.flatnonzero(small):
+        a, b = starts[gi], ends[gi]
+        mg, eg = float_to_decimal(v[a:b])
+        m_out[a:b] = mg
+        exps[gi] = eg
+    return m_out, exps
 
 
 def decimal_to_float(ints: np.ndarray, exponent: int) -> np.ndarray:

@@ -55,20 +55,35 @@ class InmemoryPart:
 
 def _rows_to_inmemory_part(rows: list, precision_bits: int = 64) -> InmemoryPart:
     """rows: list of (TSID, ts_ms, float_value). Sorts by (tsid, ts) and
-    builds <=8k-row blocks (createInmemoryPart, partition.go:877 analog)."""
+    builds <=8k-row blocks (createInmemoryPart, partition.go:877 analog).
+
+    The float->decimal conversion is BATCHED across all blocks
+    (float_to_decimal_grouped): per-series scrape flushes produce thousands
+    of ~tens-of-rows blocks, where per-block conversion overhead dominates
+    the flush."""
+    from ..ops.decimal import float_to_decimal_grouped
+    from .block import MAX_ROWS_PER_BLOCK, Block
     rows.sort(key=lambda r: (r[0].sort_key(), r[1]))
-    blocks = []
-    i = 0
     n = len(rows)
+    all_ts = np.fromiter((r[1] for r in rows), dtype=np.int64, count=n)
+    all_vals = np.fromiter((r[2] for r in rows), dtype=np.float64, count=n)
+    segs = []          # (tsid, start, end) per block
+    i = 0
     while i < n:
         j = i
         tsid = rows[i][0]
         while j < n and rows[j][0].metric_id == tsid.metric_id:
             j += 1
-        ts = np.array([r[1] for r in rows[i:j]], dtype=np.int64)
-        vals = np.array([r[2] for r in rows[i:j]], dtype=np.float64)
-        blocks.extend(rows_to_blocks(tsid, ts, vals, precision_bits))
+        for a in range(i, j, MAX_ROWS_PER_BLOCK):
+            segs.append((tsid, a, min(a + MAX_ROWS_PER_BLOCK, j)))
         i = j
+    if not segs:
+        return InmemoryPart([])
+    starts = np.array([a for _, a, _ in segs], dtype=np.int64)
+    m_all, exps = float_to_decimal_grouped(all_vals, starts)
+    blocks = [Block(tsid, all_ts[a:b], m_all[a:b], int(exps[k]),
+                    precision_bits)
+              for k, (tsid, a, b) in enumerate(segs)]
     return InmemoryPart(blocks)
 
 
