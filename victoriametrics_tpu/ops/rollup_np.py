@@ -79,6 +79,46 @@ def _max_prev_interval_for(ts: np.ndarray, cfg: "RollupConfig") -> int:
     return max_prev_interval(scrape_interval_estimate(ts, cfg.step))
 
 
+def scrape_interval_estimate_batch(ts2: np.ndarray, counts: np.ndarray,
+                                   default_ms: int) -> np.ndarray:
+    """Vectorized scrape_interval_estimate over padded (S, N) rows —
+    bit-compatible with the scalar version (same 0.6-quantile with numpy's
+    linear interpolation, same int() truncation)."""
+    S, N = ts2.shape
+    k = np.minimum(counts, 21)                    # tail length per row
+    start = counts - k
+    idx = np.clip(start[:, None] + np.arange(21)[None, :], 0, max(N - 1, 0))
+    tail = np.take_along_axis(ts2, idx, axis=1)
+    iv = np.diff(tail, axis=1).astype(np.float64)  # (S, 20)
+    n_iv = k - 1                                   # valid intervals per row
+    valid = np.arange(20)[None, :] < n_iv[:, None]
+    iv = np.where(valid, iv, np.inf)
+    iv.sort(axis=1)
+    m = np.maximum(n_iv, 1).astype(np.float64)
+    pos = 0.6 * (m - 1)
+    flo = np.floor(pos).astype(np.int64)
+    frac = pos - flo
+    a = np.take_along_axis(iv, np.clip(flo, 0, 19)[:, None], axis=1)[:, 0]
+    b = np.take_along_axis(iv, np.clip(flo + 1, 0, 19)[:, None],
+                           axis=1)[:, 0]
+    # replicate numpy's _lerp branch (t >= 0.5 computes from b) bit-exactly
+    with np.errstate(invalid="ignore"):
+        b = np.where(frac > 0, b, a)
+        d = b - a
+        q = np.where(frac >= 0.5, b - d * (1.0 - frac), a + d * frac)
+    si = np.where(np.isfinite(q), q, 0.0).astype(np.int64)
+    return np.where((counts < 2) | (n_iv < 1) | (si <= 0), default_ms, si)
+
+
+def max_prev_interval_batch(si: np.ndarray) -> np.ndarray:
+    """Vectorized max_prev_interval (rollup.go:899)."""
+    si = np.asarray(si, dtype=np.int64)
+    extra = np.select(
+        [si <= 2_000, si <= 4_000, si <= 8_000, si <= 16_000, si <= 32_000],
+        [4 * si, 2 * si, si, si // 2, si // 4], si // 8)
+    return si + extra
+
+
 
 def remove_counter_resets(values: np.ndarray) -> np.ndarray:
     """Monotonize a counter series: whenever v[i] < v[i-1] (reset), add the
@@ -265,40 +305,81 @@ def rollup_batch(func: str, series: list, cfg: RollupConfig):
     T = out_ts.size
     if S == 0:
         return np.full((0, T), np.nan)
-    N = max(int(np.asarray(ts).size) for ts, _ in series)
+    arrs_ts = [np.asarray(ts) for ts, _ in series]
+    counts = np.fromiter((a.size for a in arrs_ts), dtype=np.int64, count=S)
+    N = int(counts.max())
     if N == 0:
         return np.full((S, T), np.nan)
-    ts2 = np.full((S, N), np.iinfo(np.int64).max, dtype=np.int64)
-    v2 = np.zeros((S, N), dtype=np.float64)
-    counts = np.empty(S, dtype=np.int64)
-    for s, (ts, v) in enumerate(series):
-        n = int(np.asarray(ts).size)
-        counts[s] = n
-        ts2[s, :n] = ts
-        v2[s, :n] = v
-    if not np.isfinite(v2).all():
+    pad = np.iinfo(np.int64).max
+    if bool((counts == N).all()):
+        # uniform lengths (the common scrape-grid case): one concatenate +
+        # reshape instead of S row assignments
+        ts2 = np.ascontiguousarray(
+            np.concatenate(arrs_ts).reshape(S, N).astype(np.int64,
+                                                         copy=False))
+        v2 = np.concatenate([np.asarray(v, dtype=np.float64)
+                             for _, v in series]).reshape(S, N)
+    else:
+        mask = np.arange(N)[None, :] < counts[:, None]
+        ts2 = np.full((S, N), pad, dtype=np.int64)
+        ts2[mask] = np.concatenate(arrs_ts)
+        v2 = np.zeros((S, N), dtype=np.float64)
+        v2[mask] = np.concatenate([np.asarray(v, dtype=np.float64)
+                                   for _, v in series])
+    return rollup_batch_packed(func, ts2, v2, counts, cfg)
+
+
+def rollup_batch_packed(func: str, ts2: np.ndarray, v2: np.ndarray,
+                        counts: np.ndarray, cfg: RollupConfig):
+    """rollup_batch over pre-packed padded columns: ts2 (S, N) int64 padded
+    with INT64_MAX, v2 (S, N) float64 (padding ignored), counts (S,).
+    Entry point for callers that already hold packed columns (the columnar
+    fetch path), skipping the per-series repack."""
+    S, N = ts2.shape
+    out_ts = cfg.out_timestamps()
+    T = out_ts.size
+    if S == 0 or N == 0:
+        return np.full((S, T), np.nan)
+    valid_mask = np.arange(N)[None, :] < counts[:, None]
+    if not np.isfinite(np.where(valid_mask, v2, 0.0)).all():
         # NaN *and* +/-Inf poison the cumsum formulation (inf-inf = nan
         # for every window downstream); the per-series loop is exact
         return None
 
-    lo = np.empty((S, T), dtype=np.int64)
-    hi = np.empty((S, T), dtype=np.int64)
     w_lo = out_ts - cfg.lookback
-    for s in range(S):
-        row = ts2[s, :counts[s]]
-        lo[s] = np.searchsorted(row, w_lo, side="right")
-        hi[s] = np.searchsorted(row, out_ts, side="right")
+    first_row = ts2[0]
+    if bool((counts == counts[0]).all()) and \
+            bool((ts2 == first_row[None, :]).all()):
+        # every series shares one timestamp grid (common scrape schedule):
+        # two searchsorteds total instead of 2*S
+        row = first_row[:counts[0]]
+        lo = np.broadcast_to(np.searchsorted(row, w_lo, side="right"),
+                             (S, T))
+        hi = np.broadcast_to(np.searchsorted(row, out_ts, side="right"),
+                             (S, T))
+    else:
+        lo = np.empty((S, T), dtype=np.int64)
+        hi = np.empty((S, T), dtype=np.int64)
+        for s in range(S):
+            row = ts2[s, :counts[s]]
+            lo[s] = np.searchsorted(row, w_lo, side="right")
+            hi[s] = np.searchsorted(row, out_ts, side="right")
     have = hi > lo
     nwin = hi - lo                       # samples per window
     prev = lo - 1                        # last sample at/before window start
     has_prev = prev >= 0
-    # per-series maxPrevInterval prevValue gate for the deriv family — must
-    # stay bit-compatible with rollup() above (same gating rule)
-    mpi = np.array([_max_prev_interval_for(ts2[s, :counts[s]], cfg)
-                    for s in range(S)], dtype=np.int64)
-    t_prev_raw = np.take_along_axis(ts2, np.clip(prev, 0, N - 1), axis=1)
-    has_gated_prev = has_prev & (
-        t_prev_raw > (out_ts - cfg.lookback)[None, :] - mpi[:, None])
+
+    def gated_prev_mask():
+        # per-series maxPrevInterval prevValue gate for the deriv family —
+        # must stay bit-compatible with rollup() above (same gating rule)
+        if cfg.start >= cfg.end:
+            mpi = np.full(S, cfg.step, dtype=np.int64)
+        else:
+            mpi = max_prev_interval_batch(
+                scrape_interval_estimate_batch(ts2, counts, cfg.step))
+        t_prev_raw = np.take_along_axis(ts2, np.clip(prev, 0, N - 1), axis=1)
+        return has_prev & (t_prev_raw > w_lo[None, :] - mpi[:, None])
+
     out = np.full((S, T), np.nan)
 
     def gather(arr2d, idx, fill=0.0):
@@ -388,47 +469,38 @@ def rollup_batch(func: str, series: list, cfg: RollupConfig):
                         np.take_along_axis(cz, hi, axis=1) -
                         np.take_along_axis(cz, lo, axis=1), np.nan)
 
-    # counter / derivative family
+    # counter / derivative family: each branch gathers only what it needs
+    # (a gather is a full (S, T) pass — 9 unconditional ones dominated this
+    # function's profile before)
     needs_reset = func in ("rate", "increase", "irate", "increase_pure")
     if needs_reset:
         cw2 = remove_counter_resets(v2)
     else:
         cw2 = v2
-
-    v_last = gather(v2, last_i)
-    c_last = gather(cw2, last_i)
-    t_last = gather(ts2, last_i)
-    v_first = gather(v2, lo)
-    c_first = gather(cw2, lo)
-    t_first = gather(ts2, lo)
     pidx = np.maximum(prev, 0)
-    v_prev = gather(v2, pidx)
-    c_prev = gather(cw2, pidx)
-    t_prev = gather(ts2, pidx)
 
     with np.errstate(all="ignore"):
         if func == "delta":
-            base = np.where(has_prev, v_prev, v_first)
-            return np.where(have, v_last - base, np.nan)
+            base = np.where(has_prev, gather(v2, pidx), gather(v2, lo))
+            return np.where(have, gather(v2, last_i) - base, np.nan)
         if func in ("increase", "increase_pure"):
-            base = np.where(has_prev, c_prev, c_first)
-            return np.where(have, c_last - base, np.nan)
-        if func == "rate":
-            dt = np.where(has_gated_prev, t_last - t_prev,
-                          t_last - t_first) / 1e3
-            dv = np.where(has_gated_prev, c_last - c_prev, c_last - c_first)
-            ok = have & (has_gated_prev | (nwin >= 2))
-            res = np.where(dt > 0, dv / dt, np.nan)
-            return np.where(ok, res, np.nan)
-        if func == "deriv_fast":
-            dt = np.where(has_gated_prev, t_last - t_prev,
-                          t_last - t_first) / 1e3
-            dv = np.where(has_gated_prev, v_last - v_prev, v_last - v_first)
+            base = np.where(has_prev, gather(cw2, pidx), gather(cw2, lo))
+            return np.where(have, gather(cw2, last_i) - base, np.nan)
+        if func in ("rate", "deriv_fast"):
+            arr = cw2 if func == "rate" else v2
+            has_gated_prev = gated_prev_mask()
+            t_last = gather(ts2, last_i)
+            a_last = gather(arr, last_i)
+            dt = np.where(has_gated_prev, t_last - gather(ts2, pidx),
+                          t_last - gather(ts2, lo)) / 1e3
+            dv = np.where(has_gated_prev, a_last - gather(arr, pidx),
+                          a_last - gather(arr, lo))
             ok = have & (has_gated_prev | (nwin >= 2))
             res = np.where(dt > 0, dv / dt, np.nan)
             return np.where(ok, res, np.nan)
         if func in ("irate", "idelta"):
             arr = cw2 if func == "irate" else v2
+            has_gated_prev = gated_prev_mask()
             i2 = np.clip(hi - 2, 0, N - 1)
             a_last = gather(arr, last_i)
             a_pen = gather(arr, i2)
@@ -439,7 +511,9 @@ def rollup_batch(func: str, series: list, cfg: RollupConfig):
                                np.where(has_gated_prev, a_last - a_prev,
                                         np.nan))
                 return np.where(have, res, np.nan)
+            t_last = gather(ts2, last_i)
             t_pen = gather(ts2, i2)
+            t_prev = gather(ts2, pidx)
             dt = np.where(two, t_last - t_pen, t_last - t_prev) / 1e3
             dv = np.where(two, a_last - a_pen, a_last - a_prev)
             ok = have & (two | has_gated_prev)

@@ -545,6 +545,18 @@ def adjusted_windows(func: str, window: int, step: int, ts_list
     when no adjustment applies (explicit window / non-adjustable func)."""
     if window != 0 or func not in ADJUSTABLE_WINDOW_FUNCS or not ts_list:
         return None
+    S = len(ts_list)
+    if S >= 64:
+        # batched: only the last <=21 samples of each series matter, so pack
+        # the tails and run the vectorized estimator once (bit-compatible
+        # with the per-series path)
+        tails = [np.asarray(ts)[-21:] for ts in ts_list]
+        counts = np.fromiter((t.size for t in tails), np.int64, count=S)
+        t2 = np.full((S, 21), np.iinfo(np.int64).max, dtype=np.int64)
+        t2[np.arange(21)[None, :] < counts[:, None]] = np.concatenate(tails)
+        mpi = rollup_np.max_prev_interval_batch(
+            rollup_np.scrape_interval_estimate_batch(t2, counts, step))
+        return np.maximum(mpi, step).tolist()
     return [adjusted_window_ms(func, ts, step) for ts in ts_list]
 
 
