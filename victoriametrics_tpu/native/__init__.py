@@ -36,9 +36,27 @@ def _load():
     if not os.path.exists(_SO) and not _build():
         return None
     try:
-        lib = ctypes.CDLL(_SO)
+        lib = _configure(ctypes.CDLL(_SO))
     except OSError:
         return None
+    except AttributeError:
+        # stale .so from older sources (the binary is untracked): rebuild
+        # once, then give up and let callers keep their numpy paths
+        try:
+            os.remove(_SO)
+        except OSError:
+            return None
+        if not _build():
+            return None
+        try:
+            lib = _configure(ctypes.CDLL(_SO))
+        except (OSError, AttributeError):
+            return None
+    _lib = lib
+    return lib
+
+
+def _configure(lib):
     i64 = ctypes.c_int64
     p8 = ctypes.POINTER(ctypes.c_uint8)
     pi64 = ctypes.POINTER(i64)
@@ -62,7 +80,17 @@ def _load():
     lib.vm_marshal_i64_many.restype = i64
     lib.vm_marshal_i64_many.argtypes = [pi64, pi64, i64, p8, i64,
                                         pi32, pi64, pi64]
-    _lib = lib
+    lib.vm_decode_blocks.restype = i64
+    lib.vm_decode_blocks.argtypes = [p8, pi64, pi64, pi32, pi64, pi64,
+                                     i64, pi64, ctypes.c_int32]
+    lib.vm_decimal_to_float_blocks.restype = None
+    lib.vm_decimal_to_float_blocks.argtypes = [pi64, pi64, pi64, i64, pf64]
+    lib.vm_counter_resets_2d.restype = None
+    lib.vm_counter_resets_2d.argtypes = [pf64, i64, i64, pf64]
+    lib.vm_rollup_counter_2d.restype = None
+    lib.vm_rollup_counter_2d.argtypes = [pi64, pf64, pi64, i64, i64, i64,
+                                         i64, i64, i64, pi64,
+                                         ctypes.c_int32, pf64, pf64]
     return lib
 
 
@@ -179,6 +207,84 @@ def parse_prom_raw(data: bytes, default_ts: int):
         out.append((bytes(mv[o:o + key_len[i]]),
                     default_ts if ts == _TS_ABSENT or ts == 0 else int(ts),
                     values[i]))
+    return out
+
+
+def decode_blocks(buf, off: np.ndarray, sz: np.ndarray, mt: np.ndarray,
+                  first: np.ndarray, cnt: np.ndarray, out: np.ndarray,
+                  validate_ts: bool) -> None:
+    """Batched block decode: K payloads at buf[off[i]:off[i]+sz[i]] (zstd
+    inline for MarshalType 5/6) -> int64s written contiguously into `out`
+    (pre-sized to cnt.sum()). buf may be any buffer (bytes/mmap/ndarray).
+    Raises ValueError naming the malformed block."""
+    lib = _load()
+    if isinstance(buf, np.ndarray):
+        base = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    else:  # bytes: zero-copy via c_char_p
+        base = ctypes.cast(ctypes.c_char_p(buf),
+                           ctypes.POINTER(ctypes.c_uint8))
+    k = int(off.size)
+    r = lib.vm_decode_blocks(
+        base, _as_i64_ptr(off), _as_i64_ptr(sz),
+        mt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _as_i64_ptr(first), _as_i64_ptr(cnt), k, _as_i64_ptr(out),
+        1 if validate_ts else 0)
+    if r != int(cnt.sum()):
+        raise ValueError(f"native decode_blocks: malformed block {-r - 1}")
+
+
+def decimal_to_float_blocks(m: np.ndarray, group_offsets: np.ndarray,
+                            exps: np.ndarray, out: np.ndarray) -> None:
+    """Batched mantissa->float64: group i = m[group_offsets[i]:
+    group_offsets[i+1]] with decimal exponent exps[i], written into out
+    (same layout). Replicates ops/decimal.decimal_to_float bit-exactly."""
+    lib = _load()
+    k = int(group_offsets.size) - 1
+    lib.vm_decimal_to_float_blocks(
+        _as_i64_ptr(m), _as_i64_ptr(group_offsets), _as_i64_ptr(exps), k,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+
+
+def counter_resets_2d(v: np.ndarray) -> np.ndarray:
+    """Row-batched counter-reset removal; v is (S, N) or (N,) float64."""
+    lib = _load()
+    a = np.ascontiguousarray(v, dtype=np.float64)
+    shape = a.shape
+    if a.ndim == 1:
+        a = a.reshape(1, -1)
+    out = np.empty_like(a)
+    lib.vm_counter_resets_2d(
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        a.shape[0], a.shape[1],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return out.reshape(shape)
+
+
+ROLLUP_COUNTER_FUNCS = {"rate": 1, "increase": 2, "increase_pure": 2,
+                        "delta": 3, "deriv_fast": 4, "irate": 5, "idelta": 6}
+
+
+def rollup_counter_2d(func: str, ts2: np.ndarray, v2: np.ndarray,
+                      counts: np.ndarray, start: int, end: int, step: int,
+                      lookback: int, mpi: np.ndarray) -> np.ndarray:
+    """Fused native window-walk for the counter/derivative rollup family;
+    returns (S, T) float64. Semantics match rollup_batch_packed bit-exactly
+    (shared differential tests)."""
+    lib = _load()
+    S, N = ts2.shape
+    T = (end - start) // step + 1
+    ts2 = np.ascontiguousarray(ts2, dtype=np.int64)
+    v2 = np.ascontiguousarray(v2, dtype=np.float64)
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    mpi = np.ascontiguousarray(mpi, dtype=np.int64)
+    out = np.empty((S, T), np.float64)
+    scratch = np.empty(max(N, 1), np.float64)
+    lib.vm_rollup_counter_2d(
+        _as_i64_ptr(ts2), v2.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _as_i64_ptr(counts), S, N, start, end, step, lookback,
+        _as_i64_ptr(mpi), ROLLUP_COUNTER_FUNCS[func],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), scratch.ctypes.
+        data_as(ctypes.POINTER(ctypes.c_double)))
     return out
 
 

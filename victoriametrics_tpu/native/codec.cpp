@@ -10,8 +10,12 @@
 // All functions are thread-safe (no global state) and release-the-GIL safe
 // (pure C, no Python API).
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <vector>
+
+#include <zstd.h>
 
 extern "C" {
 
@@ -246,6 +250,298 @@ int64_t vm_marshal_i64_many(const int64_t* vals, const int64_t* offsets,
         }
     }
     return pos;
+}
+
+// ---------------------------------------------------------------------------
+// batched block decode: the cold-query scan hot path
+// ---------------------------------------------------------------------------
+
+#define VM_MT_ZSTD_NEAREST_DELTA 5
+#define VM_MT_ZSTD_NEAREST_DELTA2 6
+
+// Decode one plain (non-zstd) payload into out[0..n). Returns n or -1.
+static int64_t vm_decode_plain(const uint8_t* p, int64_t sz, int32_t mt,
+                               int64_t first, int64_t n, int64_t* out) {
+    switch (mt) {
+    case VM_MT_CONST:
+        for (int64_t i = 0; i < n; i++) out[i] = first;
+        return n;
+    case VM_MT_DELTA_CONST: {
+        int64_t d;
+        if (vm_varint_decode(p, sz, &d, 1) != 1) return -1;
+        int64_t v = first;
+        for (int64_t i = 0; i < n; i++) {
+            out[i] = v;
+            v = (int64_t)((uint64_t)v + (uint64_t)d);
+        }
+        return n;
+    }
+    case VM_MT_NEAREST_DELTA:
+        return vm_delta_decode(p, sz, first, out, n);
+    case VM_MT_NEAREST_DELTA2: {
+        if (n == 1) { out[0] = first; return 1; }
+        // leading varint = first_delta, remainder = d2 stream
+        const uint8_t* q = p;
+        const uint8_t* end = p + sz;
+        uint64_t u = 0;
+        int shift = 0;
+        for (;;) {
+            if (q >= end || shift > 63) return -1;
+            uint8_t b = *q++;
+            u |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        int64_t fd = (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+        return vm_delta2_decode(q, (int64_t)(end - q), first, fd, out, n);
+    }
+    default:
+        return -1;
+    }
+}
+
+// Decode K blocks in one call. Block i's payload lives at base[off[i]..
+// off[i]+sz[i]) (zstd-compressed for types 5/6), decodes to cnt[i] int64s
+// written contiguously into out (caller lays out offsets as cumsum(cnt)).
+// validate_ts != 0 additionally clamps decoded sequences of the lossy
+// UNcompressed types (3/4) to be non-decreasing, mirroring
+// ops/encoding.py unmarshal_timestamps needs_validation.
+// Returns total values decoded, or -(i+1) when block i is malformed.
+int64_t vm_decode_blocks(const uint8_t* base, const int64_t* off,
+                         const int64_t* sz, const int32_t* mt,
+                         const int64_t* first, const int64_t* cnt,
+                         int64_t k, int64_t* out, int32_t validate_ts) {
+    int64_t pos = 0;
+    std::vector<uint8_t> scratch;
+    for (int64_t i = 0; i < k; i++) {
+        int32_t t = mt[i];
+        const uint8_t* p = base + off[i];
+        int64_t n = cnt[i];
+        int64_t s = sz[i];
+        if (n <= 0) return -(i + 1);
+        int64_t r;
+        if (t == VM_MT_ZSTD_NEAREST_DELTA || t == VM_MT_ZSTD_NEAREST_DELTA2) {
+            // decompressed payload is <= 10 bytes per varint (+lead varint)
+            size_t cap = (size_t)(n + 1) * 10 + 16;
+            if (scratch.size() < cap) scratch.resize(cap);
+            size_t got = ZSTD_decompress(scratch.data(), cap, p, (size_t)s);
+            if (ZSTD_isError(got)) return -(i + 1);
+            r = vm_decode_plain(scratch.data(), (int64_t)got, t - 2, first[i],
+                                n, out + pos);
+        } else {
+            r = vm_decode_plain(p, s, t, first[i], n, out + pos);
+        }
+        if (r != n) return -(i + 1);
+        if (validate_ts &&
+            (t == VM_MT_NEAREST_DELTA || t == VM_MT_NEAREST_DELTA2)) {
+            int64_t* o = out + pos;
+            for (int64_t j = 1; j < n; j++) {
+                if (o[j] < o[j - 1]) o[j] = o[j - 1];
+            }
+        }
+        pos += n;
+    }
+    return pos;
+}
+
+// ---------------------------------------------------------------------------
+// decimal mantissas -> float64, batched over blocks with per-block exponents
+// ---------------------------------------------------------------------------
+
+#define VM_V_NAN       INT64_MIN
+#define VM_V_STALE_NAN (INT64_MIN + 1)
+#define VM_V_INF_NEG   (INT64_MIN + 2)
+#define VM_V_INF_POS   INT64_MAX
+
+// Convert n mantissas sharing decimal exponent `e` into float64, replicating
+// ops/decimal.py decimal_to_float: exact integer division for e in [-18, -1]
+// when it divides evenly (bit-exact round-trips for typical decimal values).
+static void vm_d2f_one(const int64_t* m, int64_t n, int64_t e, double* out) {
+    double stale;
+    {
+        uint64_t bits = 0x7FF0000000000002ULL;
+        memcpy(&stale, &bits, 8);
+    }
+    double pos_scale = 1.0, neg_scale = 1.0;
+    int64_t ipow = 1;
+    bool have_ipow = false;
+    if (e > 0) {
+        // single pow call, matching np.power(10.0, e) bit-for-bit (same
+        // libm; overflows to +inf above e=308 exactly like numpy)
+        pos_scale = pow(10.0, (double)e);
+    } else if (e < 0) {
+        neg_scale = pow(10.0, (double)(-e));
+        if (e >= -18) {
+            ipow = 1;
+            for (int64_t i = 0; i < -e; i++) ipow *= 10;
+            have_ipow = true;
+        }
+    }
+    for (int64_t i = 0; i < n; i++) {
+        int64_t v = m[i];
+        if (v == VM_V_STALE_NAN) { out[i] = stale; continue; }
+        if (v == VM_V_NAN) { out[i] = NAN; continue; }
+        if (v == VM_V_INF_POS) { out[i] = INFINITY; continue; }
+        if (v == VM_V_INF_NEG) { out[i] = -INFINITY; continue; }
+        if (e == 0) { out[i] = (double)v; continue; }
+        if (e < 0) {
+            if (e >= -22) {
+                double r = (double)v / neg_scale;
+                if (have_ipow) {
+                    int64_t q = v / ipow;
+                    // python floor-div semantics only differ for negatives
+                    // with remainder, which also fail the exactness test
+                    if (q * ipow == v) r = (double)q;
+                }
+                out[i] = r;
+            } else {
+                out[i] = (double)v * pow(10.0, (double)e);
+            }
+        } else {
+            out[i] = (double)v * pos_scale;
+        }
+    }
+}
+
+// Batched: K groups; group i covers mantissas [go[i], go[i+1]) with exponent
+// exps[i]. go has k+1 entries.
+void vm_decimal_to_float_blocks(const int64_t* m, const int64_t* go,
+                                const int64_t* exps, int64_t k, double* out) {
+    for (int64_t i = 0; i < k; i++) {
+        int64_t a = go[i];
+        vm_d2f_one(m + a, go[i + 1] - a, exps[i], out + a);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// counter-reset removal (rollup.go:921 removeCounterResets), row-batched
+// ---------------------------------------------------------------------------
+
+// For each of S rows of length N: out = v + shifted-cumsum(drop) where
+// drop_j = (d<0) ? ((-d*8 < prev) ? -d : prev) : 0, d = v[j]-v[j-1].
+// Bit-exact with the numpy diff/where/cumsum formulation in
+// ops/rollup_np.py remove_counter_resets (sequential adds, NaN d -> 0).
+void vm_counter_resets_2d(const double* v, int64_t S, int64_t N,
+                          double* out) {
+    for (int64_t s = 0; s < S; s++) {
+        const double* r = v + s * N;
+        double* o = out + s * N;
+        if (N == 0) continue;
+        double corr = 0.0;
+        o[0] = r[0];
+        for (int64_t j = 1; j < N; j++) {
+            double d = r[j] - r[j - 1];
+            if (d < 0.0) {  // false for NaN, matching np.where
+                double md = -d;
+                corr += (md * 8.0 < r[j - 1]) ? md : r[j - 1];
+            }
+            o[j] = r[j] + corr;
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// fused window-walk for the counter/derivative rollup family
+// ---------------------------------------------------------------------------
+
+#define VM_RF_RATE 1
+#define VM_RF_INCREASE 2
+#define VM_RF_DELTA 3
+#define VM_RF_DERIV_FAST 4
+#define VM_RF_IRATE 5
+#define VM_RF_IDELTA 6
+
+// One pass per row: counter-reset correction into scratch, then a
+// two-pointer window walk over the T output steps. Semantics and float-op
+// order mirror ops/rollup_np.py rollup_batch_packed's counter family
+// (verified bit-exact by the batch-vs-oracle differential tests).
+// ts: (S, N) int64 padded with INT64_MAX; v: (S, N) float64; counts (S,);
+// mpi: (S,) maxPrevInterval for the gated-prev rule; out: (S, T).
+// scratch: N doubles.
+void vm_rollup_counter_2d(const int64_t* ts, const double* v,
+                          const int64_t* counts, int64_t S, int64_t N,
+                          int64_t start, int64_t end, int64_t step,
+                          int64_t lookback, const int64_t* mpi, int32_t func,
+                          double* out, double* scratch) {
+    int64_t T = (end - start) / step + 1;
+    bool needs_reset = (func == VM_RF_RATE || func == VM_RF_INCREASE ||
+                        func == VM_RF_IRATE);
+    for (int64_t s = 0; s < S; s++) {
+        const int64_t* t = ts + s * N;
+        const double* r = v + s * N;
+        double* o = out + s * T;
+        int64_t n = counts[s];
+        const double* c = r;
+        if (needs_reset && n > 0) {
+            double corr = 0.0;
+            scratch[0] = r[0];
+            for (int64_t j = 1; j < n; j++) {
+                double d = r[j] - r[j - 1];
+                if (d < 0.0) {
+                    double md = -d;
+                    corr += (md * 8.0 < r[j - 1]) ? md : r[j - 1];
+                }
+                scratch[j] = r[j] + corr;
+            }
+            c = scratch;
+        }
+        int64_t a = 0, b = 0;
+        for (int64_t j = 0; j < T; j++) {
+            int64_t tj = start + j * step;
+            int64_t w_lo = tj - lookback;
+            while (a < n && t[a] <= w_lo) a++;
+            if (b < a) b = a;
+            while (b < n && t[b] <= tj) b++;
+            double res = NAN;
+            int64_t nwin = b - a;
+            bool have = nwin > 0;
+            int64_t prev = a - 1;
+            bool has_prev = prev >= 0;
+            bool gated = has_prev && t[prev] > w_lo - mpi[s];
+            switch (func) {
+            case VM_RF_DELTA:
+                if (have) {
+                    double base = has_prev ? r[prev] : r[a];
+                    res = r[b - 1] - base;
+                }
+                break;
+            case VM_RF_INCREASE:
+                if (have) {
+                    double base = has_prev ? c[prev] : c[a];
+                    res = c[b - 1] - base;
+                }
+                break;
+            case VM_RF_RATE:
+            case VM_RF_DERIV_FAST: {
+                const double* arr = (func == VM_RF_RATE) ? c : r;
+                if (have && (gated || nwin >= 2)) {
+                    int64_t pi = gated ? prev : a;
+                    double dt = (double)(t[b - 1] - t[pi]) / 1e3;
+                    double dv = arr[b - 1] - arr[pi];
+                    res = (dt > 0.0) ? dv / dt : NAN;
+                }
+                break;
+            }
+            case VM_RF_IRATE: {
+                bool two = nwin >= 2;
+                if (have && (two || gated)) {
+                    int64_t hi2 = two ? b - 2 : prev;
+                    double dt = (double)(t[b - 1] - t[hi2]) / 1e3;
+                    double dv = c[b - 1] - c[hi2];
+                    res = (dt > 0.0) ? dv / dt : NAN;
+                }
+                break;
+            }
+            case VM_RF_IDELTA:
+                if (have) {
+                    if (nwin >= 2) res = r[b - 1] - r[b - 2];
+                    else if (gated) res = r[b - 1] - r[prev];
+                }
+                break;
+            }
+            o[j] = res;
+        }
+    }
 }
 
 }  // extern "C"

@@ -130,6 +130,13 @@ def remove_counter_resets(values: np.ndarray) -> np.ndarray:
     v = np.asarray(values, dtype=np.float64)
     if v.size == 0 or v.shape[-1] == 0:
         return v.copy()
+    if v.ndim <= 2:
+        try:  # single-pass native kernel (bit-exact with the path below)
+            from .. import native as _native
+            if _native.available():
+                return _native.counter_resets_2d(v)
+        except Exception:
+            pass
     d = np.diff(v, axis=-1)
     prev = v[..., :-1]
     drop = np.where(d < 0, np.where(-d * 8 < prev, -d, prev), 0.0)
@@ -340,10 +347,10 @@ def rollup_batch_packed(func: str, ts2: np.ndarray, v2: np.ndarray,
     T = out_ts.size
     if S == 0 or N == 0:
         return np.full((S, T), np.nan)
-    valid_mask = np.arange(N)[None, :] < counts[:, None]
-    if not np.isfinite(np.where(valid_mask, v2, 0.0)).all():
-        # NaN *and* +/-Inf poison the cumsum formulation (inf-inf = nan
-        # for every window downstream); the per-series loop is exact
+    # padding is 0.0 by layout contract, so one flat pass suffices.
+    # NaN *and* +/-Inf poison the cumsum formulation (inf-inf = nan for
+    # every window downstream); the per-series loop is exact
+    if not np.isfinite(v2).all():
         return None
 
     w_lo = out_ts - cfg.lookback
@@ -369,22 +376,43 @@ def rollup_batch_packed(func: str, ts2: np.ndarray, v2: np.ndarray,
     prev = lo - 1                        # last sample at/before window start
     has_prev = prev >= 0
 
-    def gated_prev_mask():
+    def mpi_batch():
         # per-series maxPrevInterval prevValue gate for the deriv family —
         # must stay bit-compatible with rollup() above (same gating rule)
         if cfg.start >= cfg.end:
-            mpi = np.full(S, cfg.step, dtype=np.int64)
-        else:
-            mpi = max_prev_interval_batch(
-                scrape_interval_estimate_batch(ts2, counts, cfg.step))
+            return np.full(S, cfg.step, dtype=np.int64)
+        return max_prev_interval_batch(
+            scrape_interval_estimate_batch(ts2, counts, cfg.step))
+
+    def gated_prev_mask():
         t_prev_raw = np.take_along_axis(ts2, np.clip(prev, 0, N - 1), axis=1)
-        return has_prev & (t_prev_raw > w_lo[None, :] - mpi[:, None])
+        return has_prev & (t_prev_raw > w_lo[None, :] - mpi_batch()[:, None])
 
     out = np.full((S, T), np.nan)
 
+    # flat-index gathers: np.take on precomputed flat indices is ~4x faster
+    # than take_along_axis; index arrays repeat across gathers, so the flat
+    # form is memoized per identity
+    _row_base = (np.arange(S, dtype=np.int64) * N)[:, None]
+    _flat_idx: dict = {}
+    _flat_arr: dict = {}
+
+    def _fidx(idx):
+        fi = _flat_idx.get(id(idx))
+        if fi is None:
+            fi = np.clip(idx, 0, N - 1) + _row_base
+            _flat_idx[id(idx)] = fi
+        return fi
+
+    def _farr(a):  # flat view; copies once iff the input is a sliced view
+        f = _flat_arr.get(id(a))
+        if f is None:
+            f = np.ascontiguousarray(a).reshape(-1)
+            _flat_arr[id(a)] = f
+        return f
+
     def gather(arr2d, idx, fill=0.0):
-        got = np.take_along_axis(arr2d, np.clip(idx, 0, N - 1), axis=1)
-        return got
+        return np.take(_farr(arr2d), _fidx(idx))
 
     last_i = np.clip(hi - 1, 0, N - 1)
 
@@ -469,7 +497,24 @@ def rollup_batch_packed(func: str, ts2: np.ndarray, v2: np.ndarray,
                         np.take_along_axis(cz, hi, axis=1) -
                         np.take_along_axis(cz, lo, axis=1), np.nan)
 
-    # counter / derivative family: each branch gathers only what it needs
+    # counter / derivative family — fused native window-walk when available
+    # (reset-correction + two-pointer windows in one C pass per row)
+    if func in ("rate", "increase", "increase_pure", "delta", "deriv_fast",
+                "irate", "idelta"):
+        try:
+            from .. import native as _native
+            has_native = _native.available()
+        except Exception:
+            has_native = False
+        if has_native:
+            mpi = (mpi_batch() if func in ("rate", "deriv_fast", "irate",
+                                           "idelta")
+                   else np.zeros(S, dtype=np.int64))  # ungated funcs
+            return _native.rollup_counter_2d(
+                func, ts2, v2, counts, cfg.start, cfg.end, cfg.step,
+                cfg.lookback, mpi)
+
+    # numpy fallback: each branch gathers only what it needs
     # (a gather is a full (S, T) pass — 9 unconditional ones dominated this
     # function's profile before)
     needs_reset = func in ("rate", "increase", "irate", "increase_pure")

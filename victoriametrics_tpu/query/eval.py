@@ -290,6 +290,98 @@ def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
     return series, cfg, admission, fetch_info
 
 
+def _fetch_columns_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
+                              window: int, offset: int):
+    """Columnar twin of _fetch_series_for_rollup: one batched decode pass
+    into padded (S, N) columns (storage.search_columns), same limit/
+    deadline/partial bookkeeping."""
+    from .limits import admit_rollup
+    me: MetricExpr = re_.expr
+    ec.check_deadline()
+    lookback = window if window > 0 else (
+        ec.lookback_delta if func == "default_rollup" else ec.step)
+    start = ec.start - offset
+    end = ec.end - offset
+    fetch_lo = start - lookback - ec.lookback_delta
+    fetch_info = (fetch_lo, end,
+                  getattr(ec.storage, "data_version", None))
+    filters = filters_from_metric_expr(me)
+    qt = ec.tracer.new_child("fetch cols %s window=%dms", me, lookback)
+    try:
+        cols = ec.storage.search_columns(filters, fetch_lo, end,
+                                         max_series=ec.max_series,
+                                         tenant=ec.tenant)
+    except ResourceWarning as e:
+        from .limits import QueryLimitError
+        raise QueryLimitError(
+            f"{e}; either narrow the selector or raise "
+            f"-search.maxUniqueTimeseries") from None
+    if func not in ("default_rollup", "stale_samples_over_time"):
+        cols.drop_stale_nans()  # dropStaleNaNs (eval.go:2081), batched
+    if getattr(ec.storage, "last_partial", False):
+        ec._partial[0] = True
+    n_samples = cols.n_samples
+    ec.count_samples(n_samples)
+    qt.donef("%d series, %d samples", cols.n_series, n_samples)
+    cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
+    admission = admit_rollup(str(me), cols.n_series, ec.n_points,
+                             ec.max_memory_per_query)
+    return cols, cfg, admission, fetch_info
+
+
+def _finish_rollup_cols(cols, rows, keep_name: bool) -> list[Timeseries]:
+    out = []
+    for mn_src, vals in zip(cols.metric_names, rows):
+        mn = MetricName(mn_src.metric_group if keep_name else b"",
+                        list(mn_src.labels))
+        out.append(Timeseries(mn, np.asarray(vals, dtype=np.float64)))
+    return out
+
+
+def _rollup_from_storage_cols(ec: EvalConfig, func: str, re_: RollupExpr,
+                              window: int, offset: int, args: tuple,
+                              keep_name: bool, ckey) -> list[Timeseries]:
+    """Columnar host rollup: fetch -> (S, N) columns -> batched rollup,
+    zero per-series Python on the hot path."""
+    from ..ops import rollup_np
+    cols, cfg, admission, _ = _fetch_columns_for_rollup(
+        ec, func, re_, window, offset)
+    per_series_cfg = None
+    adj = adjusted_windows(func, window, ec.step, cols.ts_list())
+    if adj:
+        if all(a == adj[0] for a in adj):
+            cfg = RollupConfig(start=cfg.start, end=cfg.end, step=cfg.step,
+                               window=adj[0])
+        else:
+            per_series_cfg = [RollupConfig(start=cfg.start, end=cfg.end,
+                                           step=cfg.step, window=a)
+                              for a in adj]
+    with admission:
+        if per_series_cfg is None:
+            qt = ec.tracer.new_child("host rollup %s (columns)", func)
+            rows = rollup_np.rollup_batch_packed(func, cols.ts, cols.vals,
+                                                 cols.counts, cfg)
+            if rows is not None:
+                qt.donef("%d series (packed)", cols.n_series)
+                return _cache_rollup(ec, ckey,
+                                     _finish_rollup_cols(cols, rows,
+                                                         keep_name))
+            qt.donef("fell back to per-series (non-finite values)")
+        qt = ec.tracer.new_child("host rollup %s (per-series)", func)
+        out_rows = []
+        counts = cols.counts
+        for i in range(cols.n_series):
+            if i % 256 == 0:
+                ec.check_deadline()
+            n = int(counts[i])
+            c = per_series_cfg[i] if per_series_cfg is not None else cfg
+            out_rows.append(rollup_series(func, cols.ts[i, :n],
+                                          cols.vals[i, :n], c, args))
+        qt.donef("%d series", cols.n_series)
+        return _cache_rollup(ec, ckey,
+                             _finish_rollup_cols(cols, out_rows, keep_name))
+
+
 def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
                          window: int, offset: int, args: tuple,
                          keep_name: bool) -> list[Timeseries]:
@@ -324,6 +416,16 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
             if not ec._partial[0]:
                 rcache.put(ec, ckey, rows, now_ms)
             return rows
+
+    from ..ops import rollup_np as _rnp
+    if (ec.tpu is None and not args and ec.storage is not None
+            and func in _rnp.SUPPORTED
+            and getattr(ec.storage, "search_columns", None) is not None):
+        # columnar host path: batched decode -> packed rollup, no
+        # per-series materialization (device tiles go through the series
+        # path below so tile caching keys stay unified)
+        return _rollup_from_storage_cols(ec, func, re_, window, offset,
+                                         args, keep_name, ckey)
 
     series, cfg, admission, fetch_info = _fetch_series_for_rollup(
         ec, func, re_, window, offset)
@@ -584,6 +686,13 @@ def _group_key(mn: MetricName, grouping: list[bytes], without: bool) -> bytes:
 
 def _group_series(series: list[Timeseries], grouping: list[str],
                   without: bool):
+    if not grouping and not without:
+        # aggr over everything: the group key is the same empty name for
+        # every series — skip the per-series marshal entirely
+        if not series:
+            return {}, {}  # match the loop below: no series, no groups
+        key = MetricName(b"", []).marshal()
+        return {key: list(series)}, {key: MetricName.unmarshal(key)}
     gb = [g.encode() for g in grouping]
     groups: dict[bytes, list[Timeseries]] = {}
     names: dict[bytes, MetricName] = {}

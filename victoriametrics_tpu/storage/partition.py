@@ -327,6 +327,48 @@ class Partition:
             yield from p.iter_blocks(tsid_set, min_ts, max_ts,
                                      tsid_lo, tsid_hi)
 
+    def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
+                        tsid_lo=None, tsid_hi=None):
+        """Batched block collection: returns (mids, cnts, scales, ts_concat,
+        mant_concat) numpy arrays over every matching block in this
+        partition. File parts decode ALL their matched blocks in one native
+        call (part.read_blocks_columns); in-memory blocks are already
+        decoded."""
+        with self._lock:
+            pending = list(self._pending)
+            mems = list(self._mem_parts)
+            files = list(self._file_parts)
+        if pending:
+            mems = mems + [_rows_to_inmemory_part(pending)]
+        mids_l, cnts_l, scales_l = [], [], []
+        ts_l, m_l = [], []
+        for src in mems:
+            for b in src.iter_blocks(tsid_set, min_ts, max_ts):
+                mids_l.append(b.tsid.metric_id)
+                cnts_l.append(b.rows)
+                scales_l.append(b.scale)
+                ts_l.append(b.timestamps)
+                m_l.append(b.values)
+        pieces = []
+        if mids_l:
+            pieces.append((np.array(mids_l, np.int64),
+                           np.array(cnts_l, np.int64),
+                           np.array(scales_l, np.int64),
+                           np.concatenate(ts_l), np.concatenate(m_l)))
+        for p in files:
+            hdrs = list(p.iter_headers(tsid_set, min_ts, max_ts,
+                                       tsid_lo, tsid_hi))
+            if not hdrs:
+                continue
+            K = len(hdrs)
+            ts_c, m_c = p.read_blocks_columns(hdrs)
+            pieces.append((
+                np.fromiter((h.tsid.metric_id for h in hdrs), np.int64, K),
+                np.fromiter((h.rows for h in hdrs), np.int64, K),
+                np.fromiter((h.scale for h in hdrs), np.int64, K),
+                ts_c, m_c))
+        return pieces
+
     @property
     def rows(self) -> int:
         with self._lock:

@@ -91,6 +91,19 @@ class Table:
             yield from p.iter_blocks(tsid_set, min_ts, max_ts,
                                      tsid_lo, tsid_hi)
 
+    def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
+                        tsid_lo=None, tsid_hi=None):
+        """Batched per-partition block collection (see
+        Partition.collect_columns); returns a flat list of pieces."""
+        parts = self.partitions_for_range(
+            min_ts if min_ts is not None else -(1 << 62),
+            max_ts if max_ts is not None else 1 << 62)
+        out = []
+        for p in parts:
+            out.extend(p.collect_columns(tsid_set, min_ts, max_ts,
+                                         tsid_lo, tsid_hi))
+        return out
+
     def enforce_retention(self, min_valid_ts: int) -> int:
         """Drop partitions entirely older than retention; returns count
         (retentionWatcher analog)."""
