@@ -1,0 +1,130 @@
+"""exec_query over a multi-device mesh must agree with the host path and
+with the single-device engine (VERDICT r2 #2: the reference's read scaling
+is scatter-gather + merged partial aggregates, aggr_incremental.go:98-168 +
+vmselectapi/server.go:1010; the TPU equivalent shards the series axis of a
+real fetched workload over the mesh and psums partial group moments).
+
+conftest.py forces a virtual 8-device CPU platform, so the mesh here is a
+real 8-way series-axis mesh.
+"""
+
+import numpy as np
+import pytest
+
+
+T0 = 1_753_700_000_000
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    from victoriametrics_tpu.storage.storage import Storage
+    s = Storage(str(tmp_path_factory.mktemp("meshq") / "s"))
+    rng = np.random.default_rng(11)
+    rows = []
+    # 97 series: NOT a multiple of 8, so the mesh pad path is exercised.
+    for i in range(97):
+        base = np.arange(60, dtype=np.int64) * 15_000 + T0 - 600_000
+        ts = np.sort(base + rng.integers(-2000, 2001, 60))
+        # integer-valued counters: group sums are exact in float64, so the
+        # per-shard psum order cannot change the result bits
+        vals = np.cumsum(rng.integers(0, 30, 60)).astype(float)
+        lab = {"__name__": "mq", "instance": f"h{i % 8}", "job": f"j{i % 3}"}
+        rows.extend(zip([lab] * 60, ts.tolist(), vals.tolist()))
+    s.add_rows(rows)
+    s.force_flush()
+    yield s
+    s.close()
+
+
+def _mesh8():
+    import jax
+
+    from victoriametrics_tpu.parallel.mesh import make_mesh
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh(n_series=8, n_time=1, devices=devs[:8])
+
+
+def _run(store, q, engine):
+    from victoriametrics_tpu.query.exec import exec_query
+    from victoriametrics_tpu.query.types import EvalConfig
+    kw = dict(start=T0 - 300_000, end=T0, step=60_000, storage=store)
+    if engine is not None:
+        kw["tpu"] = engine
+    return exec_query(EvalConfig(**kw), q)
+
+
+def _as_map(rows):
+    return {r.metric_name.marshal(): np.asarray(r.values) for r in rows}
+
+
+EXACT_QUERIES = [
+    # integer-exact aggregations: bit-equality across 1 vs 8 devices
+    "sum by (instance)(last_over_time(mq[2m]))",
+    "count(last_over_time(mq[2m]))",
+    "max by (job)(last_over_time(mq[2m]))",
+    "min by (instance,job)(last_over_time(mq[2m]))",
+    "sum by (job)(delta(mq[4m]))",
+]
+
+CLOSE_QUERIES = [
+    "sum by (instance)(rate(mq[5m]))",
+    "avg by (job)(increase(mq[3m]))",
+    "stddev by (job)(avg_over_time(mq[5m]))",
+    "quantile(0.9, rate(mq[5m])) by (instance)",
+    "median(increase(mq[3m])) by (instance)",
+]
+
+
+class TestExecQueryMesh:
+
+    @pytest.mark.parametrize("q", EXACT_QUERIES)
+    def test_bit_equal_1_vs_8_devices(self, store, q):
+        from victoriametrics_tpu.query.tpu_engine import TPUEngine
+        mesh = _mesh8()
+        one = _run(store, q, TPUEngine(min_series=4))
+        eight = _run(store, q, TPUEngine(min_series=4, mesh=mesh))
+        m1, m8 = _as_map(one), _as_map(eight)
+        assert set(m1) == set(m8) and len(m1) > 0
+        for k in m1:
+            np.testing.assert_array_equal(m8[k], m1[k], err_msg=q)
+
+    @pytest.mark.parametrize("q", EXACT_QUERIES + CLOSE_QUERIES)
+    def test_mesh_matches_host(self, store, q):
+        from victoriametrics_tpu.query.tpu_engine import TPUEngine
+        mesh = _mesh8()
+        host = _run(store, q, None)
+        eight = _run(store, q, TPUEngine(min_series=4, mesh=mesh))
+        hm, m8 = _as_map(host), _as_map(eight)
+        assert set(hm) == set(m8) and len(hm) > 0
+        for k in hm:
+            np.testing.assert_allclose(m8[k], hm[k], rtol=1e-9, atol=1e-9,
+                                       equal_nan=True, err_msg=q)
+
+    def test_mesh_warm_path(self, store):
+        """Second run takes the resident-tile shortcut on the SHARDED tile."""
+        from victoriametrics_tpu.query.tpu_engine import TPUEngine
+        mesh = _mesh8()
+        engine = TPUEngine(min_series=4, mesh=mesh)
+        q = "sum by (instance)(rate(mq[5m]))"
+        host = _as_map(_run(store, q, None))
+        cold = _as_map(_run(store, q, engine))
+        warm = _as_map(_run(store, q, engine))
+        for m in (cold, warm):
+            assert set(m) == set(host)
+            for k in host:
+                np.testing.assert_allclose(m[k], host[k], rtol=1e-9,
+                                           atol=1e-9, equal_nan=True)
+
+    def test_tile_is_actually_sharded(self, store):
+        """The cached tile must be laid out over the mesh, not replicated."""
+        from victoriametrics_tpu.query.tpu_engine import TPUEngine
+        mesh = _mesh8()
+        engine = TPUEngine(min_series=4, mesh=mesh)
+        _run(store, "sum by (instance)(rate(mq[5m]))", engine)
+        tiles = list(engine.cache()._entries.values())
+        assert tiles, "tile cache empty after device query"
+        ts_t = tiles[0][0]
+        assert ts_t.shape[0] % 8 == 0  # padded to the series axis
+        assert len(ts_t.sharding.device_set) == 8

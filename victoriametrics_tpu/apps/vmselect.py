@@ -52,8 +52,8 @@ def build(args):
                              deny_partial_response=args.deny_partial)
     tpu_engine = None
     if args.tpu:
-        from ..query.tpu_engine import TPUEngine
-        tpu_engine = TPUEngine()
+        from ..query.tpu_engine import TPUEngine, auto_mesh
+        tpu_engine = TPUEngine(mesh=auto_mesh())
     hh, _, hp = args.httpListenAddr.rpartition(":")
     srv = HTTPServer(hh or "0.0.0.0", int(hp))
     from .vmsingle import _dur_ms

@@ -101,8 +101,8 @@ def build(args):
                       max_daily_series=args.max_daily_series)
     tpu_engine = None
     if args.tpu:
-        from ..query.tpu_engine import TPUEngine
-        tpu_engine = TPUEngine()
+        from ..query.tpu_engine import TPUEngine, auto_mesh
+        tpu_engine = TPUEngine(mesh=auto_mesh())
     relabel = None
     if args.relabel_config:
         from ..ingest.relabel import parse_relabel_configs

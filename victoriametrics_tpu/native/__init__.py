@@ -80,6 +80,8 @@ def _configure(lib):
     lib.vm_marshal_i64_many.restype = i64
     lib.vm_marshal_i64_many.argtypes = [pi64, pi64, i64, p8, i64,
                                         pi32, pi64, pi64]
+    lib.vm_has_zstd.restype = ctypes.c_int32
+    lib.vm_has_zstd.argtypes = []
     lib.vm_decode_blocks.restype = i64
     lib.vm_decode_blocks.argtypes = [p8, pi64, pi64, pi32, pi64, pi64,
                                      i64, pi64, ctypes.c_int32]
@@ -96,6 +98,13 @@ def _configure(lib):
 
 def available() -> bool:
     return _load() is not None
+
+
+def has_zstd() -> bool:
+    """True when the native library was built against libzstd; callers
+    with zstd-marshaled blocks must otherwise take their Python path."""
+    lib = _load()
+    return bool(lib is not None and lib.vm_has_zstd())
 
 
 def _as_i64_ptr(a: np.ndarray):

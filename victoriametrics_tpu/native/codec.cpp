@@ -15,9 +15,22 @@
 #include <cstring>
 #include <vector>
 
+#ifdef VM_HAVE_ZSTD
 #include <zstd.h>
+#endif
 
 extern "C" {
+
+// 1 when built against libzstd; 0 means zstd-marshaled blocks (MarshalType
+// 5/6) must take the Python per-block path while everything else stays
+// native.
+int32_t vm_has_zstd(void) {
+#ifdef VM_HAVE_ZSTD
+    return 1;
+#else
+    return 0;
+#endif
+}
 
 // ---------------------------------------------------------------------------
 // zigzag varint
@@ -321,6 +334,9 @@ int64_t vm_decode_blocks(const uint8_t* base, const int64_t* off,
         if (n <= 0) return -(i + 1);
         int64_t r;
         if (t == VM_MT_ZSTD_NEAREST_DELTA || t == VM_MT_ZSTD_NEAREST_DELTA2) {
+#ifndef VM_HAVE_ZSTD
+            return -(i + 1);
+#else
             // decompressed payload is <= 10 bytes per varint (+lead varint)
             size_t cap = (size_t)(n + 1) * 10 + 16;
             if (scratch.size() < cap) scratch.resize(cap);
@@ -328,6 +344,7 @@ int64_t vm_decode_blocks(const uint8_t* base, const int64_t* off,
             if (ZSTD_isError(got)) return -(i + 1);
             r = vm_decode_plain(scratch.data(), (int64_t)got, t - 2, first[i],
                                 n, out + pos);
+#endif
         } else {
             r = vm_decode_plain(p, s, t, first[i], n, out + pos);
         }

@@ -37,6 +37,24 @@ from .rollup_np import RollupConfig
 
 TS_PAD = np.int32(2**31 - 1)
 
+# Funcs whose output embeds absolute time: they read cfg.start and cannot
+# run on a start-rebased grid.
+TIME_VALUED_FUNCS = frozenset({"tfirst_over_time", "tlast_over_time",
+                               "timestamp"})
+
+
+def normalized_cfg(func: str, cfg: RollupConfig) -> RollupConfig:
+    """Rebase the window grid to start=0 for kernel compilation: tile
+    timestamps are already relative to cfg.start and the grid is relative,
+    so two queries with the same span/step/window share one compiled
+    executable. Without this every rolling dashboard refresh (start/end
+    advance each time) would recompile — and would miss the mesh layer's
+    memoized shard_map closures. Time-valued funcs keep the absolute cfg."""
+    if func in TIME_VALUED_FUNCS or cfg.start == 0:
+        return cfg
+    return RollupConfig(start=0, end=cfg.end - cfg.start, step=cfg.step,
+                        window=cfg.window)
+
 
 def _valid_mask(counts: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.arange(n, dtype=jnp.int32)[None, :] < counts[:, None]

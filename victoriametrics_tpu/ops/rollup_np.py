@@ -397,19 +397,22 @@ def rollup_batch_packed(func: str, ts2: np.ndarray, v2: np.ndarray,
     _flat_idx: dict = {}
     _flat_arr: dict = {}
 
+    # the memo entries keep a reference to the KEY array: an id() of a freed
+    # temporary could be recycled by a later allocation and serve stale
+    # indices
     def _fidx(idx):
-        fi = _flat_idx.get(id(idx))
-        if fi is None:
-            fi = np.clip(idx, 0, N - 1) + _row_base
-            _flat_idx[id(idx)] = fi
-        return fi
+        hit = _flat_idx.get(id(idx))
+        if hit is None:
+            hit = (idx, np.clip(idx, 0, N - 1) + _row_base)
+            _flat_idx[id(idx)] = hit
+        return hit[1]
 
     def _farr(a):  # flat view; copies once iff the input is a sliced view
-        f = _flat_arr.get(id(a))
-        if f is None:
-            f = np.ascontiguousarray(a).reshape(-1)
-            _flat_arr[id(a)] = f
-        return f
+        hit = _flat_arr.get(id(a))
+        if hit is None:
+            hit = (a, np.ascontiguousarray(a).reshape(-1))
+            _flat_arr[id(a)] = hit
+        return hit[1]
 
     def gather(arr2d, idx, fill=0.0):
         return np.take(_farr(arr2d), _fidx(idx))

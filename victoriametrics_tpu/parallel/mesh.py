@@ -49,6 +49,15 @@ def make_mesh(n_series: int | None = None, n_time: int = 1,
     return Mesh(arr, (AXIS_SERIES, AXIS_TIME))
 
 
+@functools.lru_cache(maxsize=256)
+def cached_sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
+                                    cfg: RollupConfig, num_groups: int):
+    """Memoized sharded_rollup_aggregate: the serving engine calls this per
+    query; without memoization every call would build a fresh closure and
+    miss jax's jit cache."""
+    return sharded_rollup_aggregate(mesh, rollup_func, aggr, cfg, num_groups)
+
+
 def sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
                              cfg: RollupConfig, num_groups: int):
     """Build a jitted aggr(rollup(...)) running series-sharded on the mesh.

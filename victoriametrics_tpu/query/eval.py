@@ -241,14 +241,15 @@ def _eval_rollup_expr(ec: EvalConfig, func: str, re_: RollupExpr,
     return _rollup_subquery(ec, func, re_, window, offset, args, keep_name)
 
 
-def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
-                             window: int, offset: int):
-    """Shared fetch for the rollup paths: returns (series, cfg, admission).
+def _fetch_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
+                      window: int, offset: int, fetcher, trace_label: str):
+    """Shared fetch bookkeeping for both rollup fetch shapes (per-series
+    and columnar): deadline, -search.maxSamplesPerQuery, rollup memory
+    admission (eval.go:1776-1885), partial-result capture, tracing.
 
-    Enforces the per-query limit family (eval.go:1776-1885): deadline,
-    -search.maxSamplesPerQuery across all selectors, and rollup memory
-    admission; the caller holds `admission` while computing the rollup.
-    """
+    `fetcher(filters, lo, hi)` performs the storage search plus any
+    stale-sample handling and returns (payload, n_series, n_samples); the
+    caller holds the returned `admission` while computing the rollup."""
     from .limits import admit_rollup
     me: MetricExpr = re_.expr
     if ec.storage is None:
@@ -266,76 +267,57 @@ def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
     fetch_info = (fetch_lo, end,
                   getattr(ec.storage, "data_version", None))
     filters = filters_from_metric_expr(me)
-    qt = ec.tracer.new_child("fetch %s window=%dms", me, lookback)
+    qt = ec.tracer.new_child(trace_label + " %s window=%dms", me, lookback)
     try:
-        series = ec.storage.search_series(filters, fetch_lo, end,
-                                          max_series=ec.max_series,
-                                          tenant=ec.tenant)
+        payload, n_series, n_samples = fetcher(filters, fetch_lo, end)
     except ResourceWarning as e:
         from .limits import QueryLimitError
         raise QueryLimitError(
             f"{e}; either narrow the selector or raise "
             f"-search.maxUniqueTimeseries") from None
-    series = _drop_stale_nans(func, series)
     if getattr(ec.storage, "last_partial", False):
         # capture partiality PER QUERY right after the fetch: the shared
         # storage flag is reset by every new incoming request
         ec._partial[0] = True
-    n_samples = sum(s.timestamps.size for s in series)
     ec.count_samples(n_samples)
-    qt.donef("%d series, %d samples", len(series), n_samples)
+    qt.donef("%d series, %d samples", n_series, n_samples)
     cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
-    admission = admit_rollup(str(me), len(series), ec.n_points,
+    admission = admit_rollup(str(me), n_series, ec.n_points,
                              ec.max_memory_per_query)
-    return series, cfg, admission, fetch_info
+    return payload, cfg, admission, fetch_info
+
+
+def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
+                             window: int, offset: int):
+    def fetcher(filters, lo, hi):
+        series = ec.storage.search_series(filters, lo, hi,
+                                          max_series=ec.max_series,
+                                          tenant=ec.tenant)
+        series = _drop_stale_nans(func, series)
+        return series, len(series), sum(s.timestamps.size for s in series)
+
+    return _fetch_for_rollup(ec, func, re_, window, offset, fetcher,
+                             "fetch")
 
 
 def _fetch_columns_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
                               window: int, offset: int):
     """Columnar twin of _fetch_series_for_rollup: one batched decode pass
-    into padded (S, N) columns (storage.search_columns), same limit/
-    deadline/partial bookkeeping."""
-    from .limits import admit_rollup
-    me: MetricExpr = re_.expr
-    ec.check_deadline()
-    lookback = window if window > 0 else (
-        ec.lookback_delta if func == "default_rollup" else ec.step)
-    start = ec.start - offset
-    end = ec.end - offset
-    fetch_lo = start - lookback - ec.lookback_delta
-    fetch_info = (fetch_lo, end,
-                  getattr(ec.storage, "data_version", None))
-    filters = filters_from_metric_expr(me)
-    qt = ec.tracer.new_child("fetch cols %s window=%dms", me, lookback)
-    try:
-        cols = ec.storage.search_columns(filters, fetch_lo, end,
+    into padded (S, N) columns (storage.search_columns)."""
+    def fetcher(filters, lo, hi):
+        cols = ec.storage.search_columns(filters, lo, hi,
                                          max_series=ec.max_series,
                                          tenant=ec.tenant)
-    except ResourceWarning as e:
-        from .limits import QueryLimitError
-        raise QueryLimitError(
-            f"{e}; either narrow the selector or raise "
-            f"-search.maxUniqueTimeseries") from None
-    if func not in ("default_rollup", "stale_samples_over_time"):
-        cols.drop_stale_nans()  # dropStaleNaNs (eval.go:2081), batched
-    if getattr(ec.storage, "last_partial", False):
-        ec._partial[0] = True
-    n_samples = cols.n_samples
-    ec.count_samples(n_samples)
-    qt.donef("%d series, %d samples", cols.n_series, n_samples)
-    cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
-    admission = admit_rollup(str(me), cols.n_series, ec.n_points,
-                             ec.max_memory_per_query)
-    return cols, cfg, admission, fetch_info
+        if func not in ("default_rollup", "stale_samples_over_time"):
+            cols.drop_stale_nans()  # dropStaleNaNs (eval.go:2081), batched
+        return cols, cols.n_series, cols.n_samples
+
+    return _fetch_for_rollup(ec, func, re_, window, offset, fetcher,
+                             "fetch cols")
 
 
 def _finish_rollup_cols(cols, rows, keep_name: bool) -> list[Timeseries]:
-    out = []
-    for mn_src, vals in zip(cols.metric_names, rows):
-        mn = MetricName(mn_src.metric_group if keep_name else b"",
-                        list(mn_src.labels))
-        out.append(Timeseries(mn, np.asarray(vals, dtype=np.float64)))
-    return out
+    return _finish_rollup_names(cols.metric_names, rows, keep_name)
 
 
 def _rollup_from_storage_cols(ec: EvalConfig, func: str, re_: RollupExpr,
@@ -598,13 +580,19 @@ def _drop_stale_nans(func: str, series):
     return series
 
 
-def _finish_rollup(series, rows, keep_name: bool) -> list[Timeseries]:
+def _finish_rollup_names(metric_names, rows, keep_name: bool
+                         ) -> list[Timeseries]:
     out = []
-    for sd, vals in zip(series, rows):
-        mn = MetricName(sd.metric_name.metric_group if keep_name else b"",
-                        list(sd.metric_name.labels))
+    for mn_src, vals in zip(metric_names, rows):
+        mn = MetricName(mn_src.metric_group if keep_name else b"",
+                        list(mn_src.labels))
         out.append(Timeseries(mn, np.asarray(vals, dtype=np.float64)))
     return out
+
+
+def _finish_rollup(series, rows, keep_name: bool) -> list[Timeseries]:
+    return _finish_rollup_names((sd.metric_name for sd in series), rows,
+                                keep_name)
 
 
 def _subquery_series(ec: EvalConfig, re_: RollupExpr, window: int,

@@ -27,6 +27,7 @@ class TPUEngine:
     cache_bytes: int = 2 << 30
     value_dtype: object = np.float64
     min_series: int = 64        # below this the host path wins
+    mesh: object = None         # jax.sharding.Mesh; series axis sharding
     _cache: object = None
     _aux: object = None
 
@@ -35,6 +36,30 @@ class TPUEngine:
             from ..models.tile_cache import TileCache
             self._cache = TileCache(self.cache_bytes)
         return self._cache
+
+    def series_shards(self) -> int:
+        """Size of the mesh's series axis (1 = single-device engine)."""
+        if self.mesh is None:
+            return 1
+        from ..parallel.mesh import AXIS_SERIES
+        return self.mesh.shape[AXIS_SERIES]
+
+
+def auto_mesh():
+    """Series-axis mesh over every visible device, or None single-chip.
+    The serving apps call this at startup: the same engine then answers
+    identically on 1 chip and on a pod slice (the reference's
+    vmselect-over-N-vmstorage scatter-gather, netstorage.go:374, becomes a
+    mesh psum)."""
+    try:
+        import jax
+        devs = jax.devices()
+    except Exception:
+        return None
+    if len(devs) < 2:
+        return None
+    from ..parallel.mesh import make_mesh
+    return make_mesh(n_series=len(devs), n_time=1, devices=devs)
 
 
 def _fingerprint(series, start_ms: int) -> tuple:
@@ -77,9 +102,11 @@ def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
         # retain the DECODED device tiles (not the planes): hot queries then
         # run straight on HBM-resident data
         cache.put_device(key, tiles)
+    from ..ops.device_rollup import normalized_cfg
     ts_t, v_t, counts = tiles
-    out = rollup_tile(func, ts_t, v_t, counts, cfg)
-    return list(np.asarray(out, dtype=np.float64))
+    out = rollup_tile(func, ts_t, v_t, counts, normalized_cfg(func, cfg))
+    # mesh tiles are row-padded; only the live rows come back
+    return list(np.asarray(out, dtype=np.float64)[:len(series)])
 
 
 FUSED_AGGRS = frozenset({"sum", "count", "avg", "min", "max", "stddev",
@@ -113,24 +140,79 @@ def try_aggr_rollup_tpu(engine: TPUEngine, aggr: str, func: str, series,
     if tiles is None:
         tiles = _upload_tiles(engine, series, cfg)
         cache.put_device(key, tiles)
+    return _dispatch_fused(engine, aggr, func, tiles, jnp.asarray(gids),
+                           num_groups, cfg)
+
+
+def _pad_rows(arr, n_rows: int, fill):
+    """Pad a [S]-vector to the tile's padded row count (mesh tiles round S
+    up to a multiple of the series axis)."""
+    import jax.numpy as jnp
+    arr = jnp.asarray(arr)
+    if arr.shape[0] >= n_rows:
+        return arr
+    pad = jnp.full((n_rows - arr.shape[0],), fill, dtype=arr.dtype)
+    return jnp.concatenate([arr, pad])
+
+
+def _dispatch_fused(engine: TPUEngine, aggr: str, func: str, tiles,
+                    gids_dev, num_groups: int, cfg: RollupConfig):
+    """Route a fused aggr(rollup()) to the single-device kernel or the
+    mesh-sharded psum path (parallel/mesh.py). Padded rows carry count=0 so
+    their rollup is NaN and contributes nothing to any group moment."""
+    from ..ops.device_rollup import normalized_cfg, rollup_aggregate_tile
     ts_t, v_t, counts = tiles
-    out = rollup_aggregate_tile(func, aggr, ts_t, v_t, counts,
-                                jnp.asarray(gids), cfg, num_groups)
+    gids_dev = _pad_rows(gids_dev, ts_t.shape[0], 0)
+    cfg = normalized_cfg(func, cfg)
+    if engine.series_shards() > 1:
+        from ..parallel.mesh import cached_sharded_rollup_aggregate
+        fn = cached_sharded_rollup_aggregate(engine.mesh, func, aggr, cfg,
+                                             num_groups)
+        out = fn(ts_t, v_t, counts, gids_dev)
+    else:
+        out = rollup_aggregate_tile(func, aggr, ts_t, v_t, counts, gids_dev,
+                                    cfg, num_groups)
     return np.asarray(out, dtype=np.float64)
 
 
 def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
     """Cold upload: prefer compact delta planes decoded on device (~2-5
     B/sample over the link, SURVEY §7 'compressed columns cross the
-    boundary'); fall back to dense tiles when the data needs >int32."""
+    boundary'); fall back to dense tiles when the data needs >int32.
+
+    With a multi-device mesh the rows (series axis) are padded to a multiple
+    of the mesh's series axis and placed with a NamedSharding over it — the
+    delta-plane decode is per-row, so under GSPMD each device decodes only
+    its shard and the decoded tile never leaves its device (the scatter half
+    of the reference's scatter-gather)."""
     import dataclasses
 
     import jax.numpy as jnp
 
     from ..ops import decimal as dec
     from ..ops import device_decode as dd
-    from ..ops.device_rollup import pack_series
+    from ..ops.device_rollup import TS_PAD, pack_series
     from ..models.tile_cache import chunked_device_put
+
+    n_sh = engine.series_shards()
+    row_sh = vec_sh = None
+    if n_sh > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import AXIS_SERIES
+        row_sh = NamedSharding(engine.mesh, P(AXIS_SERIES, None))
+        vec_sh = NamedSharding(engine.mesh, P(AXIS_SERIES))
+
+    def _put(a: np.ndarray, pad_value=0):
+        if n_sh > 1:
+            import jax
+            S = a.shape[0]
+            S_pad = -(-S // n_sh) * n_sh
+            if S_pad != S:
+                widths = ((0, S_pad - S),) + ((0, 0),) * (a.ndim - 1)
+                a = np.pad(a, widths, constant_values=pad_value)
+            return jax.device_put(a, row_sh if a.ndim > 1 else vec_sh)
+        return chunked_device_put(a)
 
     triples = []
     for sd in series:
@@ -139,7 +221,9 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
     planes = dd.pack_delta_planes(triples, cfg.start,
                                   value_dtype=engine.value_dtype)
     if planes is not None:
-        dev = [chunked_device_put(getattr(planes, f.name))
+        # padded rows get count=0 and scale=1: decode masks them to TS_PAD
+        pad_vals = {"scale": 1}
+        dev = [_put(getattr(planes, f.name), pad_vals.get(f.name, 0))
                for f in dataclasses.fields(planes)]
         n = int(planes.counts.max())
         ts_t, v_t = dd.decode_tiles(*dev[:6], dev[6], dev[7], n,
@@ -148,8 +232,7 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
     ts, vals, counts = pack_series(
         [(sd.timestamps, sd.values) for sd in series], cfg.start,
         dtype=engine.value_dtype)
-    return (chunked_device_put(ts), chunked_device_put(vals),
-            jnp.asarray(counts))
+    return (_put(ts, TS_PAD), _put(vals), _put(counts))
 
 
 def aux_cache(engine: TPUEngine):
@@ -182,11 +265,8 @@ def run_fused_on_tiles(engine: TPUEngine, aggr: str, func: str, tiles,
                        gids_dev, num_groups: int, cfg: RollupConfig):
     """Fused kernel over an HBM-resident tile (warm-path shortcut: no host
     fetch, no upload)."""
-    from ..ops.device_rollup import rollup_aggregate_tile
-    ts_t, v_t, counts = tiles
-    out = rollup_aggregate_tile(func, aggr, ts_t, v_t, counts, gids_dev,
-                                cfg, num_groups)
-    return np.asarray(out, dtype=np.float64)
+    return _dispatch_fused(engine, aggr, func, tiles, gids_dev, num_groups,
+                           cfg)
 
 
 # HBM budget for the dense [G, M, T] quantile tensor. The kernel holds the
@@ -245,19 +325,23 @@ def try_quantile_rollup_tpu(engine: TPUEngine, phi: float, func: str,
     if tiles is None:
         tiles = _upload_tiles(engine, series, cfg)
         cache.put_device(key, tiles)
-    ts_t, v_t, counts = tiles
-    out = rollup_quantile_tile(func, phi, ts_t, v_t, counts,
-                               jnp.asarray(gids), jnp.asarray(slots), cfg,
-                               num_groups, max_group)
-    return np.asarray(out, dtype=np.float64)
+    return run_quantile_on_tiles(engine, phi, func, tiles,
+                                 jnp.asarray(gids), jnp.asarray(slots),
+                                 num_groups, max_group, cfg)
 
 
 def run_quantile_on_tiles(engine: TPUEngine, phi: float, func: str, tiles,
                           gids_dev, slots_dev, num_groups: int,
                           max_group: int, cfg: RollupConfig):
-    """Warm-path fused quantile over an HBM-resident tile."""
-    from ..ops.device_rollup import rollup_quantile_tile
+    """Warm-path fused quantile over an HBM-resident tile. On a mesh the
+    jitted kernel runs under GSPMD on the sharded tile; padded rows get
+    out-of-bounds (group, slot) indices so their NaN rollup rows are DROPPED
+    by the scatter instead of clobbering a live slot."""
+    from ..ops.device_rollup import normalized_cfg, rollup_quantile_tile
     ts_t, v_t, counts = tiles
+    gids_dev = _pad_rows(gids_dev, ts_t.shape[0], num_groups)
+    slots_dev = _pad_rows(slots_dev, ts_t.shape[0], max_group)
     out = rollup_quantile_tile(func, phi, ts_t, v_t, counts, gids_dev,
-                               slots_dev, cfg, num_groups, max_group)
+                               slots_dev, normalized_cfg(func, cfg),
+                               num_groups, max_group)
     return np.asarray(out, dtype=np.float64)

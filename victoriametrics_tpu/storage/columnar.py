@@ -70,16 +70,6 @@ class ColumnarSeries:
             out.append(sd)
         return out
 
-    def select_rows(self, rows: np.ndarray) -> "ColumnarSeries":
-        """Row-subset (used by the staleness filter / reordering)."""
-        return ColumnarSeries(
-            self.metric_ids[rows], self.ts[rows], self.vals[rows],
-            self.counts[rows],
-            [self.raw_names[i] for i in rows] if self.raw_names else None,
-            [self.metric_names[i] for i in rows] if self.metric_names
-            else None,
-            self.stale_rows[rows] if self.stale_rows is not None else None)
-
     def drop_stale_nans(self):
         """Remove Prometheus staleness-marker samples in place (the
         eval-side dropStaleNaNs analog, but batched)."""
@@ -203,14 +193,22 @@ def assemble(rows: np.ndarray, S: int, cnts: np.ndarray, ts_all: np.ndarray,
 
     # exact-duplicate timestamps (replica merges): keep the LAST sample of
     # each run, matching search_series semantics
+    W = ts2.shape[1]
     dup_rows = ((ts2[:, 1:] == ts2[:, :-1]) &
-                (ts2[:, 1:] != PAD_TS)).any(axis=1) if N > 1 else \
+                (ts2[:, 1:] != PAD_TS)).any(axis=1) if W > 1 else \
         np.zeros(S, bool)
+    if dedup_interval_ms > 0 and W > 1:
+        # batched needs_dedup: a row pays the per-row pass only when two
+        # consecutive samples share a dedup bucket (ordinary well-spaced
+        # scrapes stay fully vectorized)
+        valid_next = np.arange(1, W)[None, :] < counts[:, None]
+        b = (np.where(ts2 == PAD_TS, 0, ts2) + (dedup_interval_ms - 1)) \
+            // dedup_interval_ms
+        dup_rows |= ((b[:, 1:] == b[:, :-1]) & valid_next).any(axis=1)
     need_dedup = dedup_interval_ms > 0
-    if dup_rows.any() or need_dedup:
+    if dup_rows.any():
         from .dedup import deduplicate
-        rows_iter = (np.flatnonzero(dup_rows) if not need_dedup
-                     else np.arange(S))
+        rows_iter = np.flatnonzero(dup_rows)
         for s in rows_iter:
             n = int(counts[s])
             t = ts2[s, :n]

@@ -271,7 +271,10 @@ class Part:
         K = len(hdrs)
         cnt = np.fromiter((h.rows for h in hdrs), np.int64, K)
         total = int(cnt.sum())
-        if self._ts_buf is None or not _native.available():
+        zstd_blocks = any(int(h.ts_marshal_type) >= 5 or
+                          int(h.val_marshal_type) >= 5 for h in hdrs)
+        if self._ts_buf is None or not _native.available() or \
+                (zstd_blocks and not _native.has_zstd()):
             blocks = [self.read_block(h) for h in hdrs]
             ts_all = (np.concatenate([b.timestamps for b in blocks])
                       if blocks else np.zeros(0, np.int64))
