@@ -127,8 +127,10 @@ class TestMesh:
         series, ts, vals, counts, gids = self._data()
         mesh = meshlib.make_mesh(n_series=8, n_time=1)
         fn = meshlib.sharded_rollup_aggregate(mesh, "rate", aggr, CFG, 5)
+        from victoriametrics_tpu.ops.device_rollup import MIN_TS_NONE
         got = np.asarray(fn(jnp.asarray(ts), jnp.asarray(vals),
-                            jnp.asarray(counts), jnp.asarray(gids)))
+                            jnp.asarray(counts), jnp.asarray(gids),
+                            np.int32(0), MIN_TS_NONE))
         rolled = rollup_tile("rate", jnp.asarray(ts), jnp.asarray(vals),
                              jnp.asarray(counts), CFG)
         want = np.asarray(aggregate_groups(aggr, rolled, jnp.asarray(gids), 5))

@@ -49,8 +49,15 @@ class QueryPipeline:
         return fn
 
     def sharded(self, mesh):
-        return meshlib.sharded_rollup_aggregate(
+        fn = meshlib.sharded_rollup_aggregate(
             mesh, self.rollup_func, self.aggr, self.cfg, self.num_groups)
+
+        from ..ops.device_rollup import MIN_TS_NONE
+
+        def run(ts, values, counts, group_ids):
+            return fn(ts, values, counts, group_ids, np.int32(0),
+                      MIN_TS_NONE)
+        return run
 
 
 def synth_workload(n_series: int, n_samples: int, cfg: RollupConfig,

@@ -129,11 +129,12 @@ def _remove_counter_resets(v: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
 
 
 def _max_prev_interval_tile(ts: jnp.ndarray, counts: jnp.ndarray,
-                            cfg: RollupConfig) -> jnp.ndarray:
+                            cfg: RollupConfig, min_ts=None) -> jnp.ndarray:
     """Per-series maxPrevInterval [S], bit-compatible with
     rollup_np._max_prev_interval_for: 0.6 linear-interpolated quantile of the
     last <=20 sample intervals, inflated by the rollup.go:899 jitter table.
-    Instant grids (start == end) use the step directly."""
+    Instant grids (start == end) use the step directly. Samples older than
+    `min_ts` are excluded like the host's truncated fetch would."""
     S, N = ts.shape
     step = jnp.asarray(cfg.step, jnp.int32)
     if cfg.start >= cfg.end:
@@ -143,6 +144,8 @@ def _max_prev_interval_tile(ts: jnp.ndarray, counts: jnp.ndarray,
     idx = base[:, None] + jnp.arange(21, dtype=jnp.int32)[None, :]
     tv = jnp.take_along_axis(ts, jnp.clip(idx, 0, N - 1), axis=1)
     valid = idx < c[:, None]
+    if min_ts is not None:
+        valid = valid & (tv >= jnp.int32(min_ts))
     # float32 is exact for interval magnitudes up to 2^24 ms (~4.6h) and
     # avoids the x64-truncation warning when jax_enable_x64 is off
     d = (tv[:, 1:] - tv[:, :-1]).astype(jnp.float32)
@@ -166,10 +169,21 @@ def _max_prev_interval_tile(ts: jnp.ndarray, counts: jnp.ndarray,
     return mpi
 
 
+MIN_TS_NONE = np.int32(-2**31 + 1)
+
+
 @functools.partial(jax.jit, static_argnames=("func", "cfg"))
 def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
-                counts: jnp.ndarray, cfg: RollupConfig) -> jnp.ndarray:
-    """Windowed rollup over a padded tile -> [S, T] float array (NaN = gap)."""
+                counts: jnp.ndarray, cfg: RollupConfig,
+                min_ts=MIN_TS_NONE) -> jnp.ndarray:
+    """Windowed rollup over a padded tile -> [S, T] float array (NaN = gap).
+
+    `min_ts` (traced) reproduces the evaluator's fetch truncation on tiles
+    that hold MORE history than the query would fetch (rolling tiles):
+    samples older than min_ts never seed prevValue / boundary transitions,
+    exactly as if the fetch had started there. Window samples themselves
+    are always newer than any fetch bound, so only prev-sample accesses are
+    gated."""
     S, N = ts.shape
     dtype = values.dtype
     nan = jnp.asarray(jnp.nan, dtype)
@@ -177,16 +191,15 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
     lo, hi, grid = _window_bounds(ts, cfg)
     n_win = (hi - lo).astype(dtype)
     have = hi > lo
-    has_prev = lo >= 1
+    t_prev_i = jnp.take_along_axis(ts, jnp.clip(lo - 1, 0, N - 1), axis=1)
+    has_prev = (lo >= 1) & (t_prev_i >= jnp.int32(min_ts))
     if func in ("rate", "irate", "idelta", "deriv_fast"):
         # deriv-family prevValue gate (rollup.go:781): the sample before the
         # window seeds prevValue only within maxPrevInterval of the window
         # start; delta/increase/changes keep the ungated sample
         # (realPrevValue analog). Computed only for these funcs — the
         # quantile estimate costs a sort per tile.
-        mpi = _max_prev_interval_tile(ts, counts, cfg)
-        t_prev_i = jnp.take_along_axis(ts, jnp.clip(lo - 1, 0, N - 1),
-                                       axis=1)
+        mpi = _max_prev_interval_tile(ts, counts, cfg, min_ts)
         has_gprev = has_prev & (
             t_prev_i > (grid - cfg.lookback)[None, :] - mpi[:, None])
 
@@ -249,8 +262,9 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
         c = _cum0(chg)
         # chg[i] is the transition (i-1, i); window changes = chg[lo..hi-1],
         # which already includes the boundary transition from the real prev
-        # value when lo >= 1. With no prev (lo == 0) start from chg[1].
-        inner_lo = jnp.maximum(lo, 1)
+        # value when one exists. With no (eligible) prev sample the first
+        # window sample is the baseline: start from the next transition.
+        inner_lo = jnp.where(has_prev, jnp.maximum(lo, 1), lo + 1)
         return masked(_gather(c, hi) - _gather(c, inner_lo))
 
     if func == "delta":
@@ -420,10 +434,43 @@ def aggregate_groups(aggr: str, rolled: jnp.ndarray, group_ids: jnp.ndarray,
 def rollup_aggregate_tile(rollup_func: str, aggr: str, ts: jnp.ndarray,
                           values: jnp.ndarray, counts: jnp.ndarray,
                           group_ids: jnp.ndarray, cfg: RollupConfig,
-                          num_groups: int) -> jnp.ndarray:
-    """Fused aggr(rollup(m[d])) over one tile -> [G, T]."""
-    rolled = rollup_tile(rollup_func, ts, values, counts, cfg)
+                          num_groups: int, shift=0,
+                          min_ts=MIN_TS_NONE) -> jnp.ndarray:
+    """Fused aggr(rollup(m[d])) over one tile -> [G, T].
+
+    `shift` (traced int32, ms) rebases tile timestamps onto the cfg grid:
+    rolling tiles keep timestamps relative to their original base while the
+    query grid advances, so shift = query_start - tile_base. Time-valued
+    funcs are not supported with shift != 0 (dispatch excludes them).
+    `min_ts` is the query's fetch lower bound in the SHIFTED frame (see
+    rollup_tile)."""
+    rolled = rollup_tile(rollup_func, ts - jnp.int32(shift), values, counts,
+                         cfg, min_ts)
     return aggregate_groups(aggr, rolled, group_ids, num_groups)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def append_tile(ts: jnp.ndarray, values: jnp.ndarray, counts: jnp.ndarray,
+                new_ts: jnp.ndarray, new_values: jnp.ndarray,
+                new_counts: jnp.ndarray):
+    """Rolling-tile advance: scatter newer samples onto each row's tail.
+
+    The buffers are donated — the caller's old tile references become
+    invalid and must be replaced with the returned arrays (this is what
+    keeps the HBM-resident tile single-copy while ingest appends). New
+    samples must be strictly newer than each row's existing samples (the
+    eval layer guarantees this via the storage append watermark); per-row
+    positions beyond new_counts[row] scatter out of bounds and are dropped."""
+    S, N = ts.shape
+    K = new_ts.shape[1]
+    rows = jnp.arange(S, dtype=jnp.int32)[:, None]
+    k = jnp.arange(K, dtype=jnp.int32)[None, :]
+    live = k < new_counts[:, None]
+    pos = jnp.where(live, counts.astype(jnp.int32)[:, None] + k, N)
+    ts2 = ts.at[rows, pos].set(new_ts, mode="drop")
+    v2 = values.at[rows, pos].set(new_values.astype(values.dtype),
+                                  mode="drop")
+    return ts2, v2, counts + new_counts.astype(counts.dtype)
 
 
 def pack_series(series: list[tuple[np.ndarray, np.ndarray]], start_ms: int,
@@ -457,7 +504,8 @@ def rollup_quantile_tile(rollup_func: str, phi, ts: jnp.ndarray,
                          values: jnp.ndarray, counts: jnp.ndarray,
                          group_ids: jnp.ndarray, slots: jnp.ndarray,
                          cfg: RollupConfig, num_groups: int,
-                         max_group: int) -> jnp.ndarray:
+                         max_group: int, shift=0,
+                         min_ts=MIN_TS_NONE) -> jnp.ndarray:
     """Fused quantile(phi, rollup(m[d])) by (...) -> [G, T].
 
     The per-series rollup [S, T] is scattered into a dense [G, M, T] tensor
@@ -466,7 +514,8 @@ def rollup_quantile_tile(rollup_func: str, phi, ts: jnp.ndarray,
     phi*(n-1) per (group, step) — matching the host a_quantile /
     np.nanquantile semantics. The caller bounds G*M*T so skewed groupings
     fall back to the host path rather than exploding HBM."""
-    rolled = rollup_tile(rollup_func, ts, values, counts, cfg)  # [S, T]
+    rolled = rollup_tile(rollup_func, ts - jnp.int32(shift), values, counts,
+                         cfg, min_ts)  # [S, T]
     S, T = rolled.shape
     dtype = rolled.dtype
     nan = jnp.asarray(jnp.nan, dtype)

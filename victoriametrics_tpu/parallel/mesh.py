@@ -63,7 +63,8 @@ def sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
     """Build a jitted aggr(rollup(...)) running series-sharded on the mesh.
 
     Inputs: ts [S, N] int32, values [S, N], counts [S] int32,
-    group_ids [S] int32; S must be divisible by the series-axis size.
+    group_ids [S] int32, shift int32 scalar (rolling-tile grid rebase, 0
+    for freshly built tiles); S must be divisible by the series-axis size.
     Output: [G, T] fully replicated.
     """
 
@@ -73,10 +74,11 @@ def sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(AXIS_SERIES, None), P(AXIS_SERIES, None),
-                  P(AXIS_SERIES), P(AXIS_SERIES)),
+                  P(AXIS_SERIES), P(AXIS_SERIES), P(), P()),
         out_specs=P())
-    def step_moments(ts, values, counts, group_ids):
-        rolled = rollup_tile(rollup_func, ts, values, counts, cfg)
+    def step_moments(ts, values, counts, group_ids, shift, min_ts):
+        rolled = rollup_tile(rollup_func, ts - jnp.int32(shift), values,
+                             counts, cfg, min_ts)
         # psum/pmin/pmax the raw moments across shards, then finalize —
         # the moment split lives in ops.device_rollup so the single-device
         # and sharded paths share one aggregation definition.

@@ -375,7 +375,8 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
     # repeated and rolling evaluations of the same rollup recompute only
     # the uncovered tail, independent of the enclosing query
     use_cache = (ec.n_points > 1 and func != "default_rollup"
-                 and offset >= 0 and not ec.disable_cache)
+                 and offset >= 0 and not ec.disable_cache
+                 and not ec.no_eval_cache)
     ckey = None
     if use_cache:
         import time as _t
@@ -391,7 +392,7 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
         if cached is not None:
             ec.tracer.printf("eval rollup cache: tail from %d", new_start)
             sub = ec.child(start=new_start)
-            sub.disable_cache = True  # the suffix must not clobber ckey
+            sub.no_eval_cache = True  # the suffix must not clobber ckey
             fresh = _rollup_from_storage(sub, func, re_, window, offset,
                                          args, keep_name)
             rows = rcache.merge(cached, fresh, ec, new_start)
@@ -751,7 +752,8 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
             rarg.needs_subquery() or rarg.at is not None:
         return None
     from ..ops import rollup_np
-    from .tpu_engine import (FUSED_AGGRS, aux_get, aux_put, group_slots,
+    from .tpu_engine import (FUSED_AGGRS, RollingTile, advance_rolling,
+                             aux_get, aux_put, group_slots,
                              run_fused_on_tiles, run_quantile_on_tiles,
                              try_aggr_rollup_tpu, try_quantile_rollup_tpu)
     if func not in rollup_np.SUPPORTED or \
@@ -775,6 +777,8 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
     # aggregate crosses the link)
     aux_key = None
     ver = getattr(ec.storage, "data_version", None)
+    if ec.disable_cache:  # nocache=1 / -search.disableCache bypasses every
+        ver = None        # resident-tile reuse path (aux, rolling) too
     if ver is not None:
         aux_key = ("fused-aux", str(rarg.expr), ec.tenant, ec.start, ec.end,
                    ec.step, window, offset, func, ae.name, phi,
@@ -801,6 +805,66 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                                              cfg2)
                 qt.donef("resident tile, %d groups", len(group_keys))
                 return _emit(out, group_keys)
+
+    # rolling shortcut: the same query SHAPE with advanced bounds and/or
+    # append-only ingest. The resident tile absorbs only the new samples
+    # (device scatter into reserved headroom, storage append-watermark
+    # guarded) and answers with a traced grid shift — no host fetch, no
+    # re-upload, no recompile. The tail-reuse role of the reference's
+    # rollupResultCache (rollup_result_cache.go:283) done at tile level.
+    roll_state_key = roll_tile_key = None
+    if ver is not None and \
+            getattr(ec.storage, "structural_version", None) is not None:
+        from ..ops.device_rollup import TIME_VALUED_FUNCS
+        from .rollup_funcs import ADJUSTABLE_WINDOW_FUNCS
+        lookback = window if window > 0 else (
+            ec.lookback_delta if func == "default_rollup" else ec.step)
+        if func not in TIME_VALUED_FUNCS and func != "lifetime" and \
+                (window > 0 or (func not in ADJUSTABLE_WINDOW_FUNCS
+                                and func != "default_rollup")):
+            roll_state_key = ("roll-aggr", str(rarg.expr), ec.tenant, func,
+                              ae.name, phi, tuple(ae.grouping), ae.without,
+                              ec.max_series)
+            roll_tile_key = ("roll-tile", str(rarg.expr), ec.tenant,
+                             ec.max_series)
+    if roll_state_key is not None:
+        stv = aux_get(ec.tpu, roll_state_key)
+        if stv is not None:
+            rt, gids_dev, group_keys, qx = stv
+            start = ec.start - offset
+            end = ec.end - offset
+            fetch_lo = start - lookback - ec.lookback_delta
+            filters = filters_from_metric_expr(rarg.expr)
+            drop_stale = func not in ("default_rollup",
+                                      "stale_samples_over_time")
+            qt = ec.tracer.new_child("tpu fused %s(%s) rolling", ae.name,
+                                     func)
+            if advance_rolling(ec.tpu, rt, ec.storage, filters, start,
+                               fetch_lo, end, ec.max_series, ec.tenant,
+                               drop_stale):
+                ec.check_deadline()
+                ec.count_samples(rt.samples_in_range(fetch_lo))
+                cfg2 = RollupConfig(start=start, end=end, step=ec.step,
+                                    window=lookback)
+                shift = start - rt.base_ms
+                # fetch truncation in the shifted frame: prev samples older
+                # than this behave as if never fetched
+                min_ts = fetch_lo - start
+                if qx is not None:
+                    slots_dev, max_group = qx
+                    out = run_quantile_on_tiles(
+                        ec.tpu, phi, func, rt.tiles, gids_dev, slots_dev,
+                        len(group_keys), max_group, cfg2, shift, min_ts)
+                else:
+                    out = run_fused_on_tiles(ec.tpu, ae.name, func,
+                                             rt.tiles, gids_dev,
+                                             len(group_keys), cfg2, shift,
+                                             min_ts)
+                qt.donef("advanced tile (%d appends), %d groups",
+                         rt.appends, len(group_keys))
+                return _emit(out, group_keys)
+            qt.donef("not advanceable (%s); rebuilding",
+                     ec.tpu.last_roll_decline)
 
     series, cfg, admission, fetch_info = _fetch_series_for_rollup(
         ec, func, rarg, window, offset)
@@ -855,14 +919,38 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
             return _decline()
         qt.donef("device path, %d series -> %d groups", len(series),
                  len(group_keys))
+        import jax.numpy as jnp
+        if phi is not None:
+            qx = (jnp.asarray(slots), max_group)
         if aux_key is not None and tile_key is not None and \
                 not ec._partial[0]:
-            import jax.numpy as jnp
-            if phi is not None:
-                qx = (jnp.asarray(slots), max_group)
             aux_put(ec.tpu, aux_key,
                     (tile_key, cfg, jnp.asarray(gids), list(group_keys),
                      n_fetched, qx))
+        if roll_state_key is not None and adj is None and \
+                tile_key is not None and not ec._partial[0] and \
+                not getattr(ec.storage, "dedup_interval_ms", 0) and \
+                all(sd.raw_name is not None for sd in series):
+            tiles_now = ec.tpu.cache().get(tile_key)
+            if tiles_now is not None:
+                rt = aux_get(ec.tpu, roll_tile_key)
+                if not isinstance(rt, RollingTile) or \
+                        rt.adopted_key != tile_key:
+                    rt = RollingTile(
+                        tiles=tiles_now, base_ms=cfg.start,
+                        n_cap=int(tiles_now[0].shape[1]),
+                        lo_ms=fetch_info[0], hi_ms=fetch_info[1],
+                        version=fetch_info[2],
+                        structural=ec.storage.structural_version,
+                        counts_host=np.fromiter(
+                            (sd.timestamps.size for sd in series),
+                            np.int64, len(series)),
+                        row_of_raw={sd.raw_name: i
+                                    for i, sd in enumerate(series)},
+                        n_samples=n_fetched, adopted_key=tile_key)
+                    aux_put(ec.tpu, roll_tile_key, rt)
+                aux_put(ec.tpu, roll_state_key,
+                        (rt, jnp.asarray(gids), list(group_keys), qx))
     return _emit(out, group_keys)
 
 

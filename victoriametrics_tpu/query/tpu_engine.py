@@ -28,6 +28,7 @@ class TPUEngine:
     value_dtype: object = np.float64
     min_series: int = 64        # below this the host path wins
     mesh: object = None         # jax.sharding.Mesh; series axis sharding
+    last_roll_decline: str = ""  # why the last rolling advance fell back
     _cache: object = None
     _aux: object = None
 
@@ -156,11 +157,18 @@ def _pad_rows(arr, n_rows: int, fill):
 
 
 def _dispatch_fused(engine: TPUEngine, aggr: str, func: str, tiles,
-                    gids_dev, num_groups: int, cfg: RollupConfig):
+                    gids_dev, num_groups: int, cfg: RollupConfig,
+                    shift: int = 0, min_ts=None):
     """Route a fused aggr(rollup()) to the single-device kernel or the
     mesh-sharded psum path (parallel/mesh.py). Padded rows carry count=0 so
-    their rollup is NaN and contributes nothing to any group moment."""
-    from ..ops.device_rollup import normalized_cfg, rollup_aggregate_tile
+    their rollup is NaN and contributes nothing to any group moment.
+    `shift` rebases rolling-tile timestamps onto the query grid and
+    `min_ts` reproduces fetch truncation on over-covering tiles (both
+    traced, so rolling windows never recompile)."""
+    from ..ops.device_rollup import (MIN_TS_NONE, normalized_cfg,
+                                     rollup_aggregate_tile)
+    if min_ts is None:
+        min_ts = MIN_TS_NONE
     ts_t, v_t, counts = tiles
     gids_dev = _pad_rows(gids_dev, ts_t.shape[0], 0)
     cfg = normalized_cfg(func, cfg)
@@ -168,10 +176,12 @@ def _dispatch_fused(engine: TPUEngine, aggr: str, func: str, tiles,
         from ..parallel.mesh import cached_sharded_rollup_aggregate
         fn = cached_sharded_rollup_aggregate(engine.mesh, func, aggr, cfg,
                                              num_groups)
-        out = fn(ts_t, v_t, counts, gids_dev)
+        out = fn(ts_t, v_t, counts, gids_dev, np.int32(shift),
+                 np.int32(min_ts))
     else:
         out = rollup_aggregate_tile(func, aggr, ts_t, v_t, counts, gids_dev,
-                                    cfg, num_groups)
+                                    cfg, num_groups, np.int32(shift),
+                                    np.int32(min_ts))
     return np.asarray(out, dtype=np.float64)
 
 
@@ -221,18 +231,185 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
     planes = dd.pack_delta_planes(triples, cfg.start,
                                   value_dtype=engine.value_dtype)
     if planes is not None:
+        n = int(planes.counts.max())
+        n_cap = tile_capacity(n)
+        if n_cap > n:
+            # headroom columns for rolling appends: zero d2 planes decode
+            # into garbage tails that every kernel masks out via counts
+            pad = max(n_cap - 2 - planes.ts_d2.shape[1], 0)
+            planes = dataclasses.replace(
+                planes,
+                ts_d2=np.pad(planes.ts_d2, ((0, 0), (0, pad))),
+                val_d2=np.pad(planes.val_d2, ((0, 0), (0, pad))))
         # padded rows get count=0 and scale=1: decode masks them to TS_PAD
         pad_vals = {"scale": 1}
         dev = [_put(getattr(planes, f.name), pad_vals.get(f.name, 0))
                for f in dataclasses.fields(planes)]
-        n = int(planes.counts.max())
-        ts_t, v_t = dd.decode_tiles(*dev[:6], dev[6], dev[7], n,
+        ts_t, v_t = dd.decode_tiles(*dev[:6], dev[6], dev[7], n_cap,
                                     engine.value_dtype)
         return ts_t, v_t, dev[7]
     ts, vals, counts = pack_series(
         [(sd.timestamps, sd.values) for sd in series], cfg.start,
+        n_pad=tile_capacity(
+            max((sd.timestamps.size for sd in series), default=1)),
         dtype=engine.value_dtype)
     return (_put(ts, TS_PAD), _put(vals), _put(counts))
+
+
+def tile_capacity(n: int) -> int:
+    """Column capacity for a freshly built tile: ~25% headroom (min 32
+    columns) rounded to a multiple of 64, so rolling appends have room and
+    rebuilt tiles land on few distinct compiled shapes."""
+    return (max(n + 32, n * 5 // 4) + 63) // 64 * 64
+
+
+class RollingTile:
+    """An HBM-resident tile that advances with append-only ingest instead of
+    rebuilding (the VERDICT-r2 'incremental tile maintenance': the
+    reference's rollupResultCache reuses cached tails,
+    rollup_result_cache.go:283 — here the TILE is the cache and new blocks
+    append into reserved column headroom).
+
+    Shared per selector across every fused query shape over it (sum/avg/...
+    states reference the same RollingTile, so one append serves them all).
+    The append DONATES the old device buffers; anything else holding them
+    (the exact-key TileCache entry it was adopted from) must be invalidated
+    first — advance_rolling() does that via `adopted_key`."""
+
+    __slots__ = ("tiles", "base_ms", "n_cap", "lo_ms", "hi_ms", "version",
+                 "structural", "counts_host", "row_of_raw", "n_samples",
+                 "adopted_key", "appends", "segments")
+
+    def __init__(self, tiles, base_ms, n_cap, lo_ms, hi_ms, version,
+                 structural, counts_host, row_of_raw, n_samples,
+                 adopted_key):
+        self.tiles = tiles
+        self.base_ms = base_ms
+        self.n_cap = n_cap
+        self.lo_ms = lo_ms
+        self.hi_ms = hi_ms
+        self.version = version
+        self.structural = structural
+        self.counts_host = counts_host
+        self.row_of_raw = row_of_raw
+        self.n_samples = n_samples
+        self.adopted_key = adopted_key
+        self.appends = 0
+        # (seg_lo, seg_hi, n) per build/append: lets sample accounting for
+        # -search.maxSamplesPerQuery charge only segments a query's fetch
+        # range would actually touch, not the tile's whole history
+        self.segments = [(lo_ms, hi_ms, n_samples)]
+
+    def samples_in_range(self, fetch_lo: int) -> int:
+        return sum(n for _, seg_hi, n in self.segments if seg_hi >= fetch_lo)
+
+
+def advance_rolling(engine: TPUEngine, rt: RollingTile, storage, filters,
+                    start: int, fetch_lo: int, end: int, max_series, tenant,
+                    drop_stale: bool) -> bool:
+    """Bring `rt` up to date with storage for a query fetching
+    [fetch_lo, end]: fetch only the slice newer than the tile's covered
+    range and append it on device. Returns False when the tile cannot be
+    advanced (late/backfilled data, deletes, new series, capacity/int32
+    exhausted) — the caller rebuilds via the cold path."""
+    def no(reason: str) -> bool:
+        engine.last_roll_decline = reason
+        return False
+
+    ver = getattr(storage, "data_version", None)
+    if ver is None or \
+            getattr(storage, "structural_version", None) != rt.structural:
+        return no("deletes/retention changed visible data")
+    if getattr(storage, "dedup_interval_ms", 0):
+        return no("dedup interval set")  # buckets could straddle the append
+    if rt.lo_ms > fetch_lo:
+        return no("tile history does not reach this query's lookback")
+    if start < rt.base_ms:
+        # a negative shift would wrap the TS_PAD sentinel in int32 and
+        # break row sortedness
+        return no("query starts before the tile's rebase origin")
+    if end - rt.base_ms >= 2**31 - 1:
+        return no("int32 rebase exhausted")
+    if ver != rt.version:
+        try:
+            lo_new = storage.min_appended_since(rt.version)
+        except LookupError:
+            return no("append log trimmed past tile version")
+        if lo_new is not None and lo_new <= rt.hi_ms:
+            return no("late data landed inside the covered range")
+    if end > rt.hi_ms:
+        # extend coverage: anything in (hi, end] — new ingest OR data that
+        # simply lay beyond the previous query's fetch bound — appends in
+        # one slice fetch
+        try:
+            cols = storage.search_columns(filters, rt.hi_ms + 1, end,
+                                          max_series=max_series,
+                                          tenant=tenant)
+        except ResourceWarning as e:
+            from .limits import QueryLimitError
+            raise QueryLimitError(
+                f"{e}; either narrow the selector or raise "
+                f"-search.maxUniqueTimeseries") from None
+        if getattr(storage, "last_partial", False):
+            return no("partial slice fetch")
+        if drop_stale:
+            cols.drop_stale_nans()
+        if cols.n_series:
+            if not _append_cols(engine, rt, cols):
+                return no(engine.last_roll_decline)
+            rt.segments.append((rt.hi_ms + 1, end, cols.n_samples))
+        rt.hi_ms = end
+    rt.version = ver
+    return True
+
+
+def _append_cols(engine: TPUEngine, rt: RollingTile, cols) -> bool:
+    """Scatter a fetched slice (ColumnarSeries) onto the tile tails."""
+    from ..ops.device_rollup import append_tile
+    rows_idx = np.empty(cols.n_series, dtype=np.int64)
+    for i, rn in enumerate(cols.raw_names):
+        r = rt.row_of_raw.get(rn)
+        if r is None:
+            engine.last_roll_decline = "new series appeared"
+            return False
+        rows_idx[i] = r
+    new_n = rt.counts_host[rows_idx] + cols.counts
+    if int(new_n.max()) > rt.n_cap:
+        engine.last_roll_decline = "column headroom exhausted"
+        return False
+    S_tile = int(rt.tiles[0].shape[0])
+    K = int(cols.ts.shape[1])
+    K_pad = (K + 7) // 8 * 8  # few distinct compiled append shapes
+    new_ts = np.zeros((S_tile, K_pad), dtype=np.int32)
+    new_vals = np.zeros((S_tile, K_pad), dtype=np.float64)
+    new_counts = np.zeros(S_tile, dtype=np.int32)
+    new_ts[rows_idx, :K] = (cols.ts - rt.base_ms).astype(np.int32)
+    new_vals[rows_idx, :K] = cols.vals
+    new_counts[rows_idx] = cols.counts
+    # the old buffers are donated: drop the TileCache reference first so no
+    # reachable entry keeps deleted arrays
+    if rt.adopted_key is not None:
+        engine.cache().invalidate(rt.adopted_key)
+        rt.adopted_key = None
+    ts_t, v_t, counts_t = rt.tiles
+    if engine.series_shards() > 1:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import AXIS_SERIES
+        row_sh = NamedSharding(engine.mesh, P(AXIS_SERIES, None))
+        vec_sh = NamedSharding(engine.mesh, P(AXIS_SERIES))
+        new_ts_d = jax.device_put(new_ts, row_sh)
+        new_vals_d = jax.device_put(new_vals, row_sh)
+        new_counts_d = jax.device_put(new_counts, vec_sh)
+    else:
+        new_ts_d, new_vals_d, new_counts_d = new_ts, new_vals, new_counts
+    rt.tiles = append_tile(ts_t, v_t, counts_t, new_ts_d, new_vals_d,
+                           new_counts_d)
+    rt.counts_host[rows_idx] = new_n
+    rt.n_samples += cols.n_samples
+    rt.appends += 1
+    return True
 
 
 def aux_cache(engine: TPUEngine):
@@ -262,11 +439,12 @@ def aux_put(engine: TPUEngine, key, value, cap: int = 1024):
 
 
 def run_fused_on_tiles(engine: TPUEngine, aggr: str, func: str, tiles,
-                       gids_dev, num_groups: int, cfg: RollupConfig):
+                       gids_dev, num_groups: int, cfg: RollupConfig,
+                       shift: int = 0, min_ts=None):
     """Fused kernel over an HBM-resident tile (warm-path shortcut: no host
     fetch, no upload)."""
     return _dispatch_fused(engine, aggr, func, tiles, gids_dev, num_groups,
-                           cfg)
+                           cfg, shift, min_ts)
 
 
 # HBM budget for the dense [G, M, T] quantile tensor. The kernel holds the
@@ -332,16 +510,21 @@ def try_quantile_rollup_tpu(engine: TPUEngine, phi: float, func: str,
 
 def run_quantile_on_tiles(engine: TPUEngine, phi: float, func: str, tiles,
                           gids_dev, slots_dev, num_groups: int,
-                          max_group: int, cfg: RollupConfig):
+                          max_group: int, cfg: RollupConfig,
+                          shift: int = 0, min_ts=None):
     """Warm-path fused quantile over an HBM-resident tile. On a mesh the
     jitted kernel runs under GSPMD on the sharded tile; padded rows get
     out-of-bounds (group, slot) indices so their NaN rollup rows are DROPPED
     by the scatter instead of clobbering a live slot."""
-    from ..ops.device_rollup import normalized_cfg, rollup_quantile_tile
+    from ..ops.device_rollup import (MIN_TS_NONE, normalized_cfg,
+                                     rollup_quantile_tile)
+    if min_ts is None:
+        min_ts = MIN_TS_NONE
     ts_t, v_t, counts = tiles
     gids_dev = _pad_rows(gids_dev, ts_t.shape[0], num_groups)
     slots_dev = _pad_rows(slots_dev, ts_t.shape[0], max_group)
     out = rollup_quantile_tile(func, phi, ts_t, v_t, counts, gids_dev,
                                slots_dev, normalized_cfg(func, cfg),
-                               num_groups, max_group)
+                               num_groups, max_group, np.int32(shift),
+                               np.int32(min_ts))
     return np.asarray(out, dtype=np.float64)

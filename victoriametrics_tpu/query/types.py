@@ -41,6 +41,10 @@ class EvalConfig:
     round_digits: int = 100
     tenant: tuple = (0, 0)     # (accountID, projectID), lib/auth.Token analog
     disable_cache: bool = False  # nocache=1 / -search.disableCache
+    # internal: the tail child of an eval-cache partial hit must not read or
+    # write the eval rollup cache under its parent's key, but MAY still use
+    # the device tile reuse paths (unlike user-facing disable_cache)
+    no_eval_cache: bool = False
     tracer: object = None      # querytracer.Tracer | NOP (set in __post_init__)
     tpu: object = None         # TPUEngine when the device path is enabled
     _grid: np.ndarray | None = None
@@ -84,6 +88,7 @@ class EvalConfig:
                  max_memory_per_query=self.max_memory_per_query,
                  deadline=self.deadline, tenant=self.tenant,
                  disable_cache=self.disable_cache,
+                 no_eval_cache=self.no_eval_cache,
                  tracer=self.tracer, tpu=self.tpu,
                  _samples_scanned=self._samples_scanned,
                  _partial=self._partial)

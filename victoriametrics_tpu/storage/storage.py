@@ -98,6 +98,17 @@ class Storage:
         # bumped on every data mutation (ingest/delete/retention): cheap
         # content token for device tile-cache fingerprints
         self.data_version = 0
+        # bumped only on mutations that REMOVE visible data (delete,
+        # retention): append-only ingest keeps it stable so rolling device
+        # tiles can advance incrementally instead of rebuilding
+        self.structural_version = 0
+        # (data_version, min inserted ts) per append batch, bounded: lets a
+        # rolling tile ask "was anything since version v older than my
+        # covered range?" (late/backfill data forces a rebuild)
+        from collections import deque
+        self._append_log: deque = deque(maxlen=4096)
+        self._append_log_floor = 0  # appends at versions <= floor may be
+        #                             missing from the bounded log
         self.slow_row_inserts = 0
         self.new_series_created = 0
         from ..query.rollup_result_cache import next_storage_token
@@ -332,7 +343,24 @@ class Storage:
         self.rows_added += len(out)
         if out:
             self.data_version += 1
+            log = self._append_log
+            if log.maxlen is not None and len(log) == log.maxlen:
+                self._append_log_floor = log[0][0]
+            log.append((self.data_version, min(r[1] for r in out)))
         return len(out)
+
+    def min_appended_since(self, version: int):
+        """Minimum timestamp inserted after data_version `version`, or None
+        when nothing was appended since. Raises LookupError when `version`
+        predates the bounded append log (caller must rebuild)."""
+        if version < self._append_log_floor:
+            raise LookupError("append log does not cover version")
+        lo = None
+        for v, mn in reversed(self._append_log):
+            if v <= version:
+                break
+            lo = mn if lo is None else min(lo, mn)
+        return lo
 
     def _cardinality_ok(self, metric_id: int) -> bool:
         """registerSeriesCardinality (storage.go:2136): hourly/daily bloom
@@ -602,6 +630,7 @@ class Storage:
             # AFTER the tombstones land: a racing query that fetched the
             # old data keys its tile under the pre-delete version
             self.data_version += 1
+            self.structural_version += 1
         return int(mids.size)
 
     # -- maintenance -------------------------------------------------------
@@ -631,6 +660,7 @@ class Storage:
                                    if dk[1] >= min_date}
         if n:
             self.data_version += 1  # after the drop; no-op sweeps keep tiles
+            self.structural_version += 1
         return n
 
     # -- snapshots ---------------------------------------------------------
