@@ -1,0 +1,265 @@
+"""Graphite render function library tests (reference coverage:
+app/vmselect/graphite/eval_test.go exercises the functions.json set; the
+cases here are value-checked transcriptions of its common shapes over a
+deterministic fixture).
+
+Fixture: servers.web{1,2}.cpu.load = 0..29 step 1/min, and
+servers.web1.mem.used = 100..129 (dc=east).
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from tests.apptest_helpers import Client
+
+T0 = 1_753_700_000_000
+
+
+@pytest.fixture(scope="module")
+def app(tmp_path_factory):
+    from victoriametrics_tpu.apps.vmsingle import build, parse_flags
+    tmp_path = tmp_path_factory.mktemp("gfn")
+    args = parse_flags([f"-storageDataPath={tmp_path}/data",
+                        "-httpListenAddr=127.0.0.1:0"])
+    storage, srv, api = build(args)
+    srv.start()
+    rows = []
+    for host in ("web1", "web2"):
+        for j in range(30):
+            rows.append(({"__name__": f"servers.{host}.cpu.load"},
+                         T0 + j * 60_000, float(j)))
+    for j in range(30):
+        rows.append(({"__name__": "servers.web1.mem.used", "dc": "east"},
+                     T0 + j * 60_000, 100.0 + j))
+    storage.add_rows(rows)
+    yield Client(srv.port)
+    srv.stop()
+    storage.close()
+
+
+def render(app, target, **kw):
+    params = {"target": target, "from": str((T0 - 60_000) // 1000),
+              "until": str((T0 + 29 * 60_000) // 1000),
+              "format": "json", **kw}
+    code, body = app.get("/render", **params)
+    assert code == 200, body
+    return json.loads(body)
+
+
+def vals(series):
+    return [p[0] for p in series["datapoints"] if p[0] is not None]
+
+
+class TestCombiners:
+    def test_diff_series(self, app):
+        out = render(app, "diffSeries(servers.web1.mem.used,"
+                          "servers.web1.cpu.load)")
+        assert vals(out[0])[:3] == [100.0, 100.0, 100.0]
+
+    def test_multiply_series(self, app):
+        out = render(app, "multiplySeries(servers.*.cpu.load)")
+        assert vals(out[0])[:4] == [0.0, 1.0, 4.0, 9.0]
+
+    def test_range_count_stddev(self, app):
+        assert vals(render(app, "rangeOfSeries(servers.*.cpu.load)")[0])[:2] \
+            == [0.0, 0.0]
+        assert vals(render(app, "countSeries(servers.*.cpu.load)")[0])[:2] \
+            == [2.0, 2.0]
+        assert vals(render(app, "stddevSeries(servers.*.cpu.load)")[0])[:2] \
+            == [0.0, 0.0]
+
+    def test_aggregate_generic(self, app):
+        out = render(app, 'aggregate(servers.*.cpu.load, "max")')
+        assert vals(out[0])[:3] == [0.0, 1.0, 2.0]
+
+    def test_percentile_of_series(self, app):
+        out = render(app, "percentileOfSeries(servers.*.cpu.load, 50)")
+        assert vals(out[0])[:3] == [0.0, 1.0, 2.0]
+
+    def test_group_by_tags(self, app):
+        out = render(app, 'groupByTags(seriesByTag(\'dc=east\'), "sum", '
+                          '"dc")')
+        assert len(out) == 1 and out[0]["tags"].get("dc") == "east"
+
+    def test_pow_series_lists(self, app):
+        out = render(app, "sumSeriesLists(servers.web1.cpu.load,"
+                          "servers.web2.cpu.load)")
+        assert vals(out[0])[:3] == [0.0, 2.0, 4.0]
+
+
+class TestTransforms:
+    def test_invert_log_sqrt(self, app):
+        v = vals(render(app, "invert(servers.web1.mem.used)")[0])
+        assert abs(v[0] - 0.01) < 1e-12
+        v = vals(render(app, "squareRoot(servers.web1.mem.used)")[0])
+        assert abs(v[0] - 10.0) < 1e-12
+        v = vals(render(app, "logarithm(servers.web1.mem.used)")[0])
+        assert abs(v[0] - 2.0) < 1e-12
+
+    def test_offset_to_zero(self, app):
+        v = vals(render(app, "offsetToZero(servers.web1.mem.used)")[0])
+        assert v[:3] == [0.0, 1.0, 2.0]
+
+    def test_transform_null_is_non_null(self, app):
+        out = render(app, "transformNull(servers.web1.cpu.load, -1)")
+        pts = [p[0] for p in out[0]["datapoints"]]
+        assert -1 in pts  # the leading empty bucket became -1
+        out = render(app, "isNonNull(servers.web1.cpu.load)")
+        assert set(vals(out[0])) <= {0.0, 1.0}
+
+    def test_integral(self, app):
+        v = vals(render(app, "integral(servers.web1.cpu.load)")[0])
+        assert v[:4] == [0.0, 1.0, 3.0, 6.0]
+
+    def test_derivative_round(self, app):
+        v = vals(render(app, "derivative(servers.web1.cpu.load)")[0])
+        assert all(x == 1.0 for x in v)
+        v = vals(render(app, "round(scale(servers.web1.cpu.load, 0.3))")[0])
+        assert v[:4] == [0.0, 0.0, 1.0, 1.0]
+
+    def test_time_shift(self, app):
+        out = render(app, 'timeShift(servers.web1.cpu.load, "5min")')
+        v = vals(out[0])
+        # shifted 5 minutes back: values lag by 5
+        assert v[0] == 0.0 and len(v) <= 26
+        assert out[0]["target"].startswith("timeShift(")
+
+    def test_moving_average(self, app):
+        out = render(app, "movingAverage(servers.web1.cpu.load, 3)")
+        v = vals(out[0])
+        assert v[:4] == [0.0, 0.5, 1.0, 2.0]
+
+    def test_moving_sum_median(self, app):
+        v = vals(render(app, "movingSum(servers.web1.cpu.load, 2)")[0])
+        assert v[:4] == [0.0, 1.0, 3.0, 5.0]
+        v = vals(render(app, "movingMedian(servers.web1.cpu.load, 3)")[0])
+        assert v[2:5] == [1.0, 2.0, 3.0]
+
+    def test_ema(self, app):
+        v = vals(render(app,
+                        "exponentialMovingAverage(servers.web1.cpu.load, 3)"
+                        )[0])
+        assert abs(v[0]) < 1e-12 and 0 < v[1] < 1
+
+    def test_stdev_linearreg(self, app):
+        v = vals(render(app, "stdev(servers.web1.cpu.load, 3)")[0])
+        assert abs(v[2] - np.std([0, 1, 2])) < 1e-9
+        v = vals(render(app, "linearRegression(servers.web1.cpu.load)")[0])
+        d = np.diff(v)
+        assert np.allclose(d, d[0])
+
+    def test_n_percentile(self, app):
+        v = vals(render(app, "nPercentile(servers.web1.cpu.load, 100)")[0])
+        assert all(x == 29.0 for x in v)
+
+
+class TestFilters:
+    def test_above_below(self, app):
+        out = render(app, "maximumAbove(servers.*.*.*, 50)")
+        assert {s["target"] for s in out} == {"servers.web1.mem.used"}
+        out = render(app, "maximumBelow(servers.*.*.*, 50)")
+        assert {s["target"] for s in out} == {"servers.web1.cpu.load",
+                                              "servers.web2.cpu.load"}
+        out = render(app, "averageAbove(servers.*.*.*, 50)")
+        assert len(out) == 1
+
+    def test_highest_lowest(self, app):
+        out = render(app, 'highest(servers.*.*.*, 1, "average")')
+        assert out[0]["target"] == "servers.web1.mem.used"
+        out = render(app, "lowestAverage(servers.*.*.*, 2)")
+        assert {s["target"] for s in out} == {"servers.web1.cpu.load",
+                                              "servers.web2.cpu.load"}
+        out = render(app, "highestCurrent(servers.*.*.*, 1)")
+        assert out[0]["target"] == "servers.web1.mem.used"
+
+    def test_remove_value_filters(self, app):
+        v = vals(render(app, "removeAboveValue(servers.web1.cpu.load, 5)")[0])
+        assert max(v) <= 5
+        v = vals(render(app, "removeBelowValue(servers.web1.cpu.load, 5)")[0])
+        assert min(v) >= 5
+
+    def test_grep_exclude_unique_limit(self, app):
+        out = render(app, 'grep(servers.*.*.*, "mem")')
+        assert len(out) == 1
+        out = render(app, 'exclude(servers.*.*.*, "mem")')
+        assert len(out) == 2
+        out = render(app, "limit(servers.*.*.*, 2)")
+        assert len(out) == 2
+        out = render(app, "unique(group(servers.web1.cpu.load,"
+                          "servers.web1.cpu.load))")
+        assert len(out) == 1
+
+    def test_filter_series(self, app):
+        out = render(app, 'filterSeries(servers.*.*.*, "max", ">", 50)')
+        assert {s["target"] for s in out} == {"servers.web1.mem.used"}
+
+
+class TestSortDivide:
+    def test_sort_by_name_total(self, app):
+        out = render(app, "sortByName(servers.*.*.*)")
+        names = [s["target"] for s in out]
+        assert names == sorted(names)
+        out = render(app, "sortByTotal(servers.*.*.*)")
+        assert out[0]["target"] == "servers.web1.mem.used"
+
+    def test_divide_series(self, app):
+        out = render(app, "divideSeries(servers.web1.mem.used,"
+                          "servers.web1.mem.used)")
+        assert all(x == 1.0 for x in vals(out[0]))
+
+    def test_as_percent(self, app):
+        out = render(app, "asPercent(servers.*.cpu.load)")
+        v2 = vals(out[1]) if len(out) > 1 else []
+        # two equal series: each is 50% where nonzero
+        joint = [x for x in vals(out[0])[1:] if x is not None]
+        assert all(abs(x - 50.0) < 1e-9 for x in joint)
+
+    def test_weighted_average(self, app):
+        out = render(app, "weightedAverage(servers.*.cpu.load,"
+                          "servers.*.cpu.load, 1)")
+        assert len(out) == 1
+
+
+class TestSynthetic:
+    def test_constant_threshold_time(self, app):
+        assert all(x == 4.5 for x in vals(render(app, "constantLine(4.5)")[0]))
+        out = render(app, 'threshold(3, "lim")')
+        assert out[0]["target"] == "lim"
+        v = vals(render(app, "time()")[0])
+        assert v[1] - v[0] == 60.0
+
+    def test_fallback(self, app):
+        out = render(app, "fallbackSeries(no.such.path,"
+                          "servers.web1.cpu.load)")
+        assert out and out[0]["target"] == "servers.web1.cpu.load"
+
+    def test_holt_winters(self, app):
+        out = render(app, "holtWintersForecast(servers.web1.cpu.load)")
+        assert len(out) == 1 and out[0]["target"].startswith("holtWinters")
+        out = render(app,
+                     "holtWintersConfidenceBands(servers.web1.cpu.load)")
+        assert len(out) == 2
+
+    def test_alias_sub(self, app):
+        out = render(app,
+                     'aliasSub(servers.web1.cpu.load, "web(\\d)", "w\\1")')
+        assert out[0]["target"] == "servers.w1.cpu.load"
+
+    def test_substr(self, app):
+        out = render(app, "substr(servers.web1.cpu.load, 1, 3)")
+        assert out[0]["target"] == "web1.cpu"
+
+
+class TestIntrospection:
+    def test_functions_endpoint(self, app):
+        code, body = app.get("/functions")
+        assert code == 200
+        fns = json.loads(body)
+        assert len(fns) >= 140
+        for must in ("sumSeries", "movingAverage", "asPercent",
+                     "holtWintersForecast", "timeShift", "sortByName",
+                     "reduceSeries", "groupByTags"):
+            assert must in fns, must

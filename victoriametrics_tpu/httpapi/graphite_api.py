@@ -96,6 +96,11 @@ def _parse_target(s: str) -> _GNode:
                 if pos < len(s) and s[pos] == ",":
                     pos += 1
             return _GNode("func", name, args)
+        m = re.match(r"(?:true|false)(?=[,)\s]|$)", s[pos:])
+        if m:
+            node = _GNode("bool", m.group(0) == "true")
+            pos += m.end()
+            return node
         m = re.match(r"[^,()\s]+", s[pos:])
         if not m:
             raise ValueError(f"cannot parse target at {pos}: {s!r}")
@@ -165,6 +170,17 @@ class GraphiteAPI:
         r("/tags/findSeries", self.h_find_series)
         r("/tags", self.h_tags)
         r("/tags/", self.h_tag_values)
+        r("/functions", self.h_functions)
+        r("/functions/", self.h_functions)
+
+    def h_functions(self, req: Request) -> Response:
+        """Introspection: the render functions this server implements
+        (reference graphiteFunctions handler, render_api.go)."""
+        out = {name: {"name": name, "function": f"{name}(seriesList)",
+                      "description": "", "module": "graphite.render",
+                      "group": "", "params": []}
+               for name in sorted(_G_FUNCS)}
+        return Response.json(out)
 
     # -- metrics api ---------------------------------------------------------
 
@@ -539,4 +555,10 @@ def _f_alias_by_tags(api, args, grid, step, tenant):
 
 
 _G_FUNCS["seriesByTag"] = _f_series_by_tag
+
+# the wide function library (graphite_funcs.py) registers itself on top
+from . import graphite_funcs as _graphite_funcs  # noqa: E402
+
+_graphite_funcs.register(_G_FUNCS, __import__(
+    "sys").modules[__name__])
 _G_FUNCS["aliasByTags"] = _f_alias_by_tags

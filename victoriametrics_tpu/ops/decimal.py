@@ -293,8 +293,13 @@ def float_to_decimal_grouped(values: np.ndarray, starts: np.ndarray
     vectorized pipeline dominates at ~24-sample scrape blocks).
 
     starts: sorted int group start offsets; ends are implied. Returns
-    (mantissas, exps[int64, one per group]). Groups of <=8 values take the
-    exact repr-based small path, like the per-block entry point."""
+    (mantissas, exps[int64, one per group]). Every group rides the same
+    vectorized element phase — one batched call amortizes the per-call
+    overhead that makes the scalar path attractive for single tiny
+    conversions, and per-group Python would otherwise dominate scrape-flush
+    conversion (~25us/group). Full-precision (non-decimal) floats may
+    round a final ulp differently than the repr-based scalar path; decimal
+    data converts identically."""
     v = np.asarray(values, dtype=np.float64)
     starts = np.asarray(starts, dtype=np.int64)
     n_groups = starts.size
@@ -303,33 +308,22 @@ def float_to_decimal_grouped(values: np.ndarray, starts: np.ndarray
         return np.zeros(v.size, dtype=np.int64), exps
     ends = np.append(starts[1:], v.size)
     sizes = ends - starts
-    m_out = np.empty(v.size, dtype=np.int64)
-    small = sizes <= 8
-    big_idx = np.flatnonzero(~small)
-    if big_idx.size:
-        m, e, normal, specials = _f2d_element_phase(v)
-        BIG = np.int64(1 << 40)
-        absm = np.maximum(np.abs(m).astype(np.float64), 1.0)
-        allowed_up = np.floor(np.log10(MAX_MANTISSA / absm)).astype(np.int64)
-        emin_g = np.minimum.reduceat(np.where(normal, e, BIG), starts)
-        floor_g = np.maximum.reduceat(
-            np.where(normal, e - allowed_up, -BIG), starts)
-        has_norm_g = np.logical_or.reduceat(normal, starts)
-        exp_g = np.minimum(emin_g, _MAX_EXP)
-        exp_g = np.where(floor_g > exp_g, floor_g, exp_g)
-        exp_g = np.clip(exp_g, _MIN_EXP, _MAX_EXP)
-        exp_g = np.where(has_norm_g, exp_g, 0)
-        exp_elem = np.repeat(exp_g, sizes)
-        m_all = _f2d_rescale(m, e, normal, exp_elem)
-        m_all = _f2d_apply_specials(m_all, specials)
-        m_out[:] = m_all
-        exps[:] = exp_g
-    for gi in np.flatnonzero(small):
-        a, b = starts[gi], ends[gi]
-        mg, eg = float_to_decimal(v[a:b])
-        m_out[a:b] = mg
-        exps[gi] = eg
-    return m_out, exps
+    m, e, normal, specials = _f2d_element_phase(v)
+    BIG = np.int64(1 << 40)
+    absm = np.maximum(np.abs(m).astype(np.float64), 1.0)
+    allowed_up = np.floor(np.log10(MAX_MANTISSA / absm)).astype(np.int64)
+    emin_g = np.minimum.reduceat(np.where(normal, e, BIG), starts)
+    floor_g = np.maximum.reduceat(
+        np.where(normal, e - allowed_up, -BIG), starts)
+    has_norm_g = np.logical_or.reduceat(normal, starts)
+    exp_g = np.minimum(emin_g, _MAX_EXP)
+    exp_g = np.where(floor_g > exp_g, floor_g, exp_g)
+    exp_g = np.clip(exp_g, _MIN_EXP, _MAX_EXP)
+    exp_g = np.where(has_norm_g, exp_g, 0)
+    exp_elem = np.repeat(exp_g, sizes)
+    m_all = _f2d_rescale(m, e, normal, exp_elem)
+    m_out = _f2d_apply_specials(m_all, specials)
+    return m_out, exp_g.astype(np.int64)
 
 
 def decimal_to_float(ints: np.ndarray, exponent: int) -> np.ndarray:

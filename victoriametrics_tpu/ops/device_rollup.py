@@ -65,19 +65,43 @@ def _cum0(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.pad(jnp.cumsum(x, axis=1), ((0, 0), (1, 0)))
 
 
+_BOUNDS_CHUNK = 256
+
+
 def _window_bounds(ts: jnp.ndarray, cfg: RollupConfig) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Returns (lo, hi) int32 [S, T]: half-open sample index range per output
-    step, plus the relative output grid."""
+    step, plus the relative output grid.
+
+    Computed as a chunked compare-and-reduce over the sample axis
+    (hi[s,t] = sum_i [ts[s,i] <= grid[t]]) instead of a vmapped binary
+    search: XLA fuses the [S, chunk, T] comparison into the reduction so
+    it runs at VPU rate, while searchsorted lowers to per-element while
+    loops that serialize on TPU (measured 1.25s -> ~10ms at 8192x1984x355).
+    """
     T = (cfg.end - cfg.start) // cfg.step + 1
     # int32 throughout: tile timestamps are rebased so the grid fits, and
     # this keeps the kernel independent of the jax_enable_x64 flag.
     grid = (jnp.arange(T, dtype=jnp.int32) * np.int32(cfg.step))
-    lookback = cfg.lookback
-    lo_t = grid - np.int32(lookback)
-    hi_t = grid
-    lo = jax.vmap(lambda row: jnp.searchsorted(row, lo_t, side="right"))(ts)
-    hi = jax.vmap(lambda row: jnp.searchsorted(row, hi_t, side="right"))(ts)
-    return lo.astype(jnp.int32), hi.astype(jnp.int32), grid
+    lo_t = grid - np.int32(cfg.lookback)
+    S, N = ts.shape
+    ch = min(_BOUNDS_CHUNK, N)
+    n_ch = (N + ch - 1) // ch
+    tp = ts if n_ch * ch == N else jnp.pad(
+        ts, ((0, 0), (0, n_ch * ch - N)), constant_values=TS_PAD)
+    chunks = jnp.moveaxis(tp.reshape(S, n_ch, ch), 1, 0)  # [n_ch, S, ch]
+
+    def body(carry, chunk):
+        lo_a, hi_a = carry
+        c = chunk[:, :, None]
+        hi_a = hi_a + jnp.sum(c <= grid[None, None, :], axis=1,
+                              dtype=jnp.int32)
+        lo_a = lo_a + jnp.sum(c <= lo_t[None, None, :], axis=1,
+                              dtype=jnp.int32)
+        return (lo_a, hi_a), None
+
+    zeros = jnp.zeros((S, T), jnp.int32)
+    (lo, hi), _ = jax.lax.scan(body, (zeros, zeros), chunks)
+    return lo, hi, grid
 
 
 def _gather(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
@@ -171,6 +195,95 @@ def _max_prev_interval_tile(ts: jnp.ndarray, counts: jnp.ndarray,
 
 MIN_TS_NONE = np.int32(-2**31 + 1)
 
+_I32_MIN = np.int32(-2**31)
+_I32_MAX = np.int32(2**31 - 1)
+
+
+def _masked_window_reduce(ts: jnp.ndarray, cfg: RollupConfig, specs):
+    """ONE fused pass over sample chunks computing several masked
+    reductions at once — the TPU-shaped core of the windowed rollups.
+
+    Windowed quantities that classically need per-(step) index gathers
+    become masked reductions over the sample axis: gathers lower to slow
+    scalar loads on TPU, while a [S, chunk, T] compare+select+reduce fuses
+    into pure VPU work (measured ~25ms/gather vs ~5ms for a whole fused
+    pass at 8192x1984x355). Monotone quantities (sorted timestamps,
+    reset-corrected counters) make first/last/prev exact min/max.
+
+    specs: list of (arr [S,N] | None, kind, op):
+      arr None reduces a constant 1 (int32 counting)
+      kind 'le_hi': mask ts <= grid[t]
+           'le_lo': mask ts <= grid[t] - lookback
+           'win'  : grid[t]-lookback < ts <= grid[t]
+      op 'sum' | 'max' | 'min'
+    Returns (results [S,T] list, grid). Padded samples carry ts == TS_PAD
+    and are never selected by any mask.
+    """
+    T = (cfg.end - cfg.start) // cfg.step + 1
+    grid = jnp.arange(T, dtype=jnp.int32) * np.int32(cfg.step)
+    lo_t = grid - np.int32(cfg.lookback)
+    S, N = ts.shape
+    ch = min(_BOUNDS_CHUNK, N)
+    n_ch = (N + ch - 1) // ch
+    padn = n_ch * ch - N
+
+    def prep(a, fill):
+        if padn:
+            a = jnp.pad(a, ((0, 0), (0, padn)), constant_values=fill)
+        return jnp.moveaxis(a.reshape(S, n_ch, ch), 1, 0)
+
+    ts_ch = prep(ts, TS_PAD)
+    xs = {"ts": ts_ch}
+    # derive inits from ts so they inherit its sharding variance: a plain
+    # jnp.full would be an axis-invariant constant, which shard_map rejects
+    # as a scan carry whose output varies over the series axis
+    vary0 = (ts[:, :1] * 0)  # int32 [S, 1] of zeros, varying like ts
+    inits = []
+    for i, (a, kind, op) in enumerate(specs):
+        if a is not None:
+            xs[f"a{i}"] = prep(a, 0)
+            dt = a.dtype
+        else:
+            dt = jnp.int32
+        if op == "sum":
+            const = 0
+        elif op == "max":
+            const = _I32_MIN if dt == jnp.int32 else -jnp.inf
+        else:
+            const = _I32_MAX if dt == jnp.int32 else jnp.inf
+        init = jnp.broadcast_to(vary0.astype(dt), (S, T)) + \
+            jnp.asarray(const, dt)
+        inits.append(init)
+
+    def body(carry, x):
+        tc = x["ts"][:, :, None]
+        m_hi = tc <= grid[None, None, :]
+        m_lo = tc <= lo_t[None, None, :]
+        out = []
+        for i, ((a, kind, op), acc) in enumerate(zip(specs, carry)):
+            mask = m_hi if kind == "le_hi" else (
+                m_lo if kind == "le_lo" else m_hi & ~m_lo)
+            if a is None:
+                arr = jnp.ones((1, 1, 1), jnp.int32)
+            else:
+                arr = x[f"a{i}"][:, :, None]
+            if op == "sum":
+                r = jnp.sum(jnp.where(mask, arr, jnp.zeros((), acc.dtype)),
+                            axis=1, dtype=acc.dtype)
+                out.append(acc + r)
+            elif op == "max":
+                fill = _I32_MIN if acc.dtype == jnp.int32 else -jnp.inf
+                r = jnp.max(jnp.where(mask, arr, fill), axis=1)
+                out.append(jnp.maximum(acc, r))
+            else:
+                fill = _I32_MAX if acc.dtype == jnp.int32 else jnp.inf
+                r = jnp.min(jnp.where(mask, arr, fill), axis=1)
+                out.append(jnp.minimum(acc, r))
+        return out, None
+
+    res, _ = jax.lax.scan(body, inits, xs)
+    return res, grid
+
 
 @functools.partial(jax.jit, static_argnames=("func", "cfg"))
 def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
@@ -188,107 +301,140 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
     dtype = values.dtype
     nan = jnp.asarray(jnp.nan, dtype)
     valid = _valid_mask(counts, N)
-    lo, hi, grid = _window_bounds(ts, cfg)
-    n_win = (hi - lo).astype(dtype)
-    have = hi > lo
-    t_prev_i = jnp.take_along_axis(ts, jnp.clip(lo - 1, 0, N - 1), axis=1)
-    has_prev = (lo >= 1) & (t_prev_i >= jnp.int32(min_ts))
-    if func in ("rate", "irate", "idelta", "deriv_fast"):
-        # deriv-family prevValue gate (rollup.go:781): the sample before the
-        # window seeds prevValue only within maxPrevInterval of the window
-        # start; delta/increase/changes keep the ungated sample
-        # (realPrevValue analog). Computed only for these funcs — the
-        # quantile estimate costs a sort per tile.
-        mpi = _max_prev_interval_tile(ts, counts, cfg, min_ts)
-        has_gprev = has_prev & (
-            t_prev_i > (grid - cfg.lookback)[None, :] - mpi[:, None])
-
     vm = jnp.where(valid, values, 0.0)
     tsf = jnp.where(valid, ts, 0).astype(dtype)
 
-    def masked(x, cond=None):
-        c = have if cond is None else cond
-        return jnp.where(c, x, nan)
+    # Fused masked-reduction plan: every func reduces lo/hi counts and the
+    # prev-sample timestamp in ONE chunked pass; func-specific quantities
+    # ride the same pass. Monotone quantities (sorted ts, reset-corrected
+    # counters) turn first/last/prev gathers into exact min/max reductions.
+    specs = [(None, "le_lo", "sum"), (None, "le_hi", "sum"),
+             (ts, "le_lo", "max")]
 
-    if func in ("count_over_time",):
-        return masked(n_win)
-    if func == "present_over_time":
-        return masked(jnp.ones_like(n_win))
+    def run(extra):
+        res, grid = _masked_window_reduce(ts, cfg, specs + extra)
+        return res[0], res[1], res[2], res[3:], grid
 
-    if func == "sum_over_time":
-        c = _cum0(vm)
-        return masked(_gather(c, hi) - _gather(c, lo))
-    if func == "avg_over_time":
-        c = _cum0(vm)
-        return masked((_gather(c, hi) - _gather(c, lo)) / n_win)
+    def finish(lo, hi, t_prev_i):
+        n_win = (hi - lo).astype(dtype)
+        have = hi > lo
+        has_prev = (lo >= 1) & (t_prev_i >= jnp.int32(min_ts))
+        return n_win, have, has_prev
+
+    if func in ("count_over_time", "present_over_time"):
+        lo, hi, t_prev_i, _, grid = run([])
+        n_win, have, _ = finish(lo, hi, t_prev_i)
+        out = n_win if func == "count_over_time" else jnp.ones_like(n_win)
+        return jnp.where(have, out, nan)
+
+    if func in ("sum_over_time", "avg_over_time"):
+        lo, hi, t_prev_i, (s1,), grid = run([(vm, "win", "sum")])
+        n_win, have, _ = finish(lo, hi, t_prev_i)
+        out = s1 if func == "sum_over_time" else s1 / n_win
+        return jnp.where(have, out, nan)
     if func in ("stddev_over_time", "stdvar_over_time"):
         # Center by the per-series mean first: variance is shift-invariant
         # and this keeps the E[x^2]-E[x]^2 cancellation well-conditioned.
         total = jnp.sum(vm, axis=1, keepdims=True)
         cnt_all = jnp.maximum(counts[:, None].astype(dtype), 1.0)
         centered = jnp.where(valid, values - total / cnt_all, 0.0)
-        c1 = _cum0(centered)
-        c2 = _cum0(centered * centered)
-        s1 = _gather(c1, hi) - _gather(c1, lo)
-        s2 = _gather(c2, hi) - _gather(c2, lo)
+        lo, hi, t_prev_i, (s1, s2), grid = run(
+            [(centered, "win", "sum"), (centered * centered, "win", "sum")])
+        n_win, have, _ = finish(lo, hi, t_prev_i)
         var = jnp.maximum(s2 / n_win - (s1 / n_win) ** 2, 0.0)
-        return masked(jnp.sqrt(var) if func == "stddev_over_time" else var)
-    if func == "min_over_time":
-        x = jnp.where(valid, values, jnp.inf)
-        t = _rmq_tables(x, jnp.minimum, jnp.inf)
-        return masked(_rmq_query(t, lo, hi, jnp.minimum))
-    if func == "max_over_time":
-        x = jnp.where(valid, values, -jnp.inf)
-        t = _rmq_tables(x, jnp.maximum, -jnp.inf)
-        return masked(_rmq_query(t, lo, hi, jnp.maximum))
-    if func == "first_over_time":
-        return masked(_gather(values, lo))
-    if func in ("last_over_time", "default_rollup"):
-        return masked(_gather(values, hi - 1))
+        return jnp.where(have,
+                         jnp.sqrt(var) if func == "stddev_over_time" else var,
+                         nan)
+    if func in ("min_over_time", "max_over_time"):
+        op = "min" if func == "min_over_time" else "max"
+        lo, hi, t_prev_i, (m,), grid = run([(values, "win", op)])
+        _, have, _ = finish(lo, hi, t_prev_i)
+        return jnp.where(have, m, nan)
+
     # Timestamps in the tile are relative to cfg.start (int32 rebase);
     # t-valued funcs add the base back to return absolute unix seconds.
     base_s = jnp.asarray(cfg.start, dtype) / 1e3
     if func == "tfirst_over_time":
-        return masked(_gather(tsf, lo) / 1e3 + base_s)
-    if func in ("tlast_over_time", "timestamp"):
-        return masked(_gather(tsf, hi - 1) / 1e3 + base_s)
-    if func == "lag":
-        return masked((grid.astype(dtype)[None, :] - _gather(tsf, hi - 1)) / 1e3)
+        lo, hi, t_prev_i, (tf,), grid = run([(ts, "win", "min")])
+        _, have, _ = finish(lo, hi, t_prev_i)
+        return jnp.where(have, tf.astype(dtype) / 1e3 + base_s, nan)
+    if func in ("tlast_over_time", "timestamp", "lag"):
+        lo, hi, t_prev_i, (tl,), grid = run([(ts, "le_hi", "max")])
+        _, have, _ = finish(lo, hi, t_prev_i)
+        tl = tl.astype(dtype)
+        if func == "lag":
+            return jnp.where(have,
+                             (grid.astype(dtype)[None, :] - tl) / 1e3, nan)
+        return jnp.where(have, tl / 1e3 + base_s, nan)
+
+    if func == "first_over_time":
+        lo, hi, t_prev_i, _, grid = run([])
+        _, have, _ = finish(lo, hi, t_prev_i)
+        return jnp.where(have, _gather(values, lo), nan)
+    if func in ("last_over_time", "default_rollup"):
+        lo, hi, t_prev_i, _, grid = run([])
+        _, have, _ = finish(lo, hi, t_prev_i)
+        return jnp.where(have, _gather(values, hi - 1), nan)
+
     if func == "changes":
         prev_col = jnp.concatenate([vm[:, :1], vm[:, :-1]], axis=1)
         pair_valid = valid & jnp.concatenate(
             [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
         chg = jnp.where(pair_valid & (vm != prev_col), 1.0, 0.0)
-        c = _cum0(chg)
-        # chg[i] is the transition (i-1, i); window changes = chg[lo..hi-1],
-        # which already includes the boundary transition from the real prev
-        # value when one exists. With no (eligible) prev sample the first
-        # window sample is the baseline: start from the next transition.
-        inner_lo = jnp.where(has_prev, jnp.maximum(lo, 1), lo + 1)
-        return masked(_gather(c, hi) - _gather(c, inner_lo))
+        # chg[i] is the transition (i-1, i); the window sum already counts
+        # the boundary transition from the real prev value. With no
+        # (eligible) prev sample the first window sample is the baseline:
+        # drop the boundary term.
+        lo, hi, t_prev_i, (s,), grid = run([(chg, "win", "sum")])
+        _, have, has_prev = finish(lo, hi, t_prev_i)
+        boundary = _gather(chg, lo)
+        return jnp.where(have, s - jnp.where(has_prev, 0.0, boundary), nan)
 
     if func == "delta":
+        lo, hi, t_prev_i, _, grid = run([])
+        _, have, has_prev = finish(lo, hi, t_prev_i)
         v_last = _gather(values, hi - 1)
-        base = jnp.where(has_prev, _gather(values, lo - 1), _gather(values, lo))
-        return masked(v_last - base)
+        base = jnp.where(has_prev, _gather(values, lo - 1),
+                         _gather(values, lo))
+        return jnp.where(have, v_last - base, nan)
     if func == "idelta":
+        lo, hi, t_prev_i, _, grid = run([])
+        n_win, have, has_prev = finish(lo, hi, t_prev_i)
+        mpi = _max_prev_interval_tile(ts, counts, cfg, min_ts)
+        has_gprev = has_prev & (
+            t_prev_i > (grid - cfg.lookback)[None, :] - mpi[:, None])
         two = hi - lo >= 2
         v_last = _gather(values, hi - 1)
         prev = jnp.where(two, _gather(values, hi - 2),
                          _gather(values, lo - 1))
-        return masked(v_last - prev, have & (two | has_gprev))
+        return jnp.where(have & (two | has_gprev), v_last - prev, nan)
 
     if func in ("increase", "increase_pure", "rate", "irate"):
         cv = _remove_counter_resets(values, valid)
-        c_last = _gather(cv, hi - 1)
-        c_first = _gather(cv, lo)
-        c_prev = _gather(cv, lo - 1)
+        # pads/invalid tails carry garbage values but ts == TS_PAD, so no
+        # mask ever selects them; cv is non-decreasing on the valid prefix,
+        # making last/first/prev exact max/min reductions (zero gathers)
+        lo, hi, t_prev_i, red, grid = run([
+            (cv, "le_hi", "max"),   # c_last
+            (cv, "le_lo", "max"),   # c_prev
+            (cv, "win", "min"),     # c_first
+            (ts, "le_hi", "max"),   # t_last (int32)
+            (ts, "win", "min"),     # t_first (int32)
+        ])
+        c_last, c_prev, c_first, t_last_i, t_first_i = red
+        n_win, have, has_prev = finish(lo, hi, t_prev_i)
         base = jnp.where(has_prev, c_prev, c_first)
         if func in ("increase", "increase_pure"):
-            return masked(c_last - base)
-        t_last = _gather(tsf, hi - 1)
-        t_first = _gather(tsf, lo)
-        t_prev = _gather(tsf, lo - 1)
+            return jnp.where(have, c_last - base, nan)
+        # deriv-family prevValue gate (rollup.go:781): the sample before
+        # the window seeds prevValue only within maxPrevInterval of the
+        # window start
+        mpi = _max_prev_interval_tile(ts, counts, cfg, min_ts)
+        has_gprev = has_prev & (
+            t_prev_i > (grid - cfg.lookback)[None, :] - mpi[:, None])
+        t_last = t_last_i.astype(dtype)
+        t_first = t_first_i.astype(dtype)
+        t_prev = t_prev_i.astype(dtype)
         if func == "rate":
             two = hi - lo >= 2
             ok = have & (has_gprev | two)
@@ -296,18 +442,23 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
             dt = jnp.where(has_gprev, t_last - t_prev,
                            t_last - t_first) / 1e3
             dv = c_last - rate_base
-            return masked(jnp.where(dt > 0, dv / dt, nan), ok)
+            return jnp.where(ok & (dt > 0), dv / dt, nan)
         # irate: last two samples
         two = hi - lo >= 2
         ok = have & (two | has_gprev)
         c_l2 = jnp.where(two, _gather(cv, hi - 2), c_prev)
         t_l2 = jnp.where(two, _gather(tsf, hi - 2), t_prev)
         dt = (t_last - t_l2) / 1e3
-        return masked(jnp.where(dt > 0, (c_last - c_l2) / dt, nan), ok)
+        return jnp.where(ok & (dt > 0), (c_last - c_l2) / dt, nan)
 
     if func == "deriv_fast":
+        lo, hi, t_prev_i, (t_last_i,), grid = run([(ts, "le_hi", "max")])
+        n_win, have, has_prev = finish(lo, hi, t_prev_i)
+        mpi = _max_prev_interval_tile(ts, counts, cfg, min_ts)
+        has_gprev = has_prev & (
+            t_prev_i > (grid - cfg.lookback)[None, :] - mpi[:, None])
         v_last = _gather(values, hi - 1)
-        t_last = _gather(tsf, hi - 1)
+        t_last = t_last_i.astype(dtype)
         two = hi - lo >= 2
         base_v = jnp.where(has_gprev, _gather(values, lo - 1),
                            _gather(values, lo))
@@ -315,22 +466,23 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
                            _gather(tsf, lo))
         ok = have & (has_gprev | two)
         dt = (t_last - base_t) / 1e3
-        return masked(jnp.where(dt > 0, (v_last - base_v) / dt, nan), ok)
+        return jnp.where(ok & (dt > 0), (v_last - base_v) / dt, nan)
 
     if func == "deriv":
-        # least-squares slope via cumulative moments, t in seconds relative
+        # least-squares slope via masked moment sums, t in seconds shifted
         # to each window's first sample (subtracted analytically to keep
         # f32-path cancellation manageable)
-        ts_s = tsf / 1e3
-        c_t = _cum0(jnp.where(valid, ts_s, 0.0))
-        c_tt = _cum0(jnp.where(valid, ts_s * ts_s, 0.0))
-        c_v = _cum0(vm)
-        c_tv = _cum0(jnp.where(valid, ts_s * values, 0.0))
-        st = _gather(c_t, hi) - _gather(c_t, lo)
-        stt = _gather(c_tt, hi) - _gather(c_tt, lo)
-        sv = _gather(c_v, hi) - _gather(c_v, lo)
-        stv = _gather(c_tv, hi) - _gather(c_tv, lo)
-        t0 = _gather(ts_s, lo)
+        ts_s = jnp.where(valid, ts, 0).astype(dtype) / 1e3
+        lo, hi, t_prev_i, red, grid = run([
+            (jnp.where(valid, ts_s, 0.0), "win", "sum"),
+            (jnp.where(valid, ts_s * ts_s, 0.0), "win", "sum"),
+            (vm, "win", "sum"),
+            (jnp.where(valid, ts_s * values, 0.0), "win", "sum"),
+            (ts, "win", "min"),
+        ])
+        st, stt, sv, stv, t_first_i = red
+        n_win, have, _ = finish(lo, hi, t_prev_i)
+        t0 = t_first_i.astype(dtype) / 1e3
         # shift t -> t - t0: st' = st - n*t0; stt' = stt - 2 t0 st + n t0²;
         # stv' = stv - t0*sv
         st_ = st - n_win * t0
@@ -338,21 +490,29 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
         stv_ = stv - t0 * sv
         den = n_win * stt_ - st_ * st_
         ok = have & (hi - lo >= 2)
-        return masked(jnp.where(den != 0, (n_win * stv_ - st_ * sv) / den, nan), ok)
+        return jnp.where(ok & (den != 0),
+                         (n_win * stv_ - st_ * sv) / den, nan)
 
     if func == "lifetime":
-        t_last = _gather(tsf, hi - 1)
-        t_first = jnp.where(has_prev, tsf[:, :1], _gather(tsf, lo))
-        return masked((t_last - t_first) / 1e3)
+        lo, hi, t_prev_i, (t_last_i, t_first_i), grid = run(
+            [(ts, "le_hi", "max"), (ts, "win", "min")])
+        _, have, has_prev = finish(lo, hi, t_prev_i)
+        t_last = t_last_i.astype(dtype)
+        t_first = jnp.where(has_prev, tsf[:, :1],
+                            t_first_i.astype(dtype))
+        return jnp.where(have, (t_last - t_first) / 1e3, nan)
     if func == "scrape_interval":
-        t_last = _gather(tsf, hi - 1)
-        t_first = _gather(tsf, lo)
-        t_prev = _gather(tsf, lo - 1)
+        lo, hi, t_prev_i, (t_last_i, t_first_i), grid = run(
+            [(ts, "le_hi", "max"), (ts, "win", "min")])
+        n_win, have, has_prev = finish(lo, hi, t_prev_i)
+        t_last = t_last_i.astype(dtype)
+        t_first = t_first_i.astype(dtype)
+        t_prev = t_prev_i.astype(dtype)
         two = hi - lo >= 2
         ok = have & (has_prev | two)
         dt = jnp.where(has_prev, t_last - t_prev, t_last - t_first) / 1e3
         cnt = jnp.where(has_prev, n_win, n_win - 1)
-        return masked(jnp.where(cnt > 0, dt / cnt, nan), ok)
+        return jnp.where(ok & (cnt > 0), dt / cnt, nan)
 
     raise ValueError(f"unsupported device rollup func {func!r}")
 
@@ -375,9 +535,35 @@ def partial_group_moments(aggr: str, rolled: jnp.ndarray,
     wrong for avg/stddev."""
     present = ~jnp.isnan(rolled)
     zeroed = jnp.where(present, rolled, 0.0)
-    seg = functools.partial(jax.ops.segment_sum, segment_ids=group_ids,
-                            num_segments=num_groups)
-    m = {"cnt": (seg(present.astype(rolled.dtype)), "sum")}
+    # group-sum as a one-hot matmul: [G, S] @ [S, T] runs on the MXU,
+    # where segment_sum lowers to a serialized scatter-add on TPU. Gated:
+    # the dense one-hot is O(G*S), so near-unique groupings (G ~ S) keep
+    # the linear scatter; and a +-Inf value would leak NaN into OTHER
+    # groups through 0*Inf, so those (rare) tiles take the scatter via cond.
+    S = rolled.shape[0]
+    use_matmul = num_groups * S <= (1 << 24)
+    if use_matmul:
+        onehot = (group_ids[None, :] ==
+                  jnp.arange(num_groups, dtype=group_ids.dtype)[:, None]
+                  ).astype(rolled.dtype)
+        all_finite = jnp.all(jnp.isfinite(zeroed))
+
+        def seg(x):
+            return jax.lax.cond(
+                all_finite,
+                lambda y: onehot @ y,
+                lambda y: jax.ops.segment_sum(y, group_ids,
+                                              num_segments=num_groups),
+                x)
+
+        cnt = onehot @ present.astype(rolled.dtype)
+    else:
+        def seg(x):
+            return jax.ops.segment_sum(x, group_ids,
+                                       num_segments=num_groups)
+
+        cnt = seg(present.astype(rolled.dtype))
+    m = {"cnt": (cnt, "sum")}
     if aggr in ("sum", "avg", "stddev", "stdvar"):
         m["s1"] = (seg(zeroed), "sum")
     if aggr in ("stddev", "stdvar"):

@@ -841,7 +841,7 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                                      func)
             if advance_rolling(ec.tpu, rt, ec.storage, filters, start,
                                fetch_lo, end, ec.max_series, ec.tenant,
-                               drop_stale):
+                               drop_stale, tracer=qt):
                 ec.check_deadline()
                 ec.count_samples(rt.samples_in_range(fetch_lo))
                 cfg2 = RollupConfig(start=start, end=end, step=ec.step,
@@ -850,6 +850,7 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                 # fetch truncation in the shifted frame: prev samples older
                 # than this behave as if never fetched
                 min_ts = fetch_lo - start
+                qk = qt.new_child("fused kernel + D2H")
                 if qx is not None:
                     slots_dev, max_group = qx
                     out = run_quantile_on_tiles(
@@ -860,6 +861,8 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                                              rt.tiles, gids_dev,
                                              len(group_keys), cfg2, shift,
                                              min_ts)
+                qk.donef("[%d, %d] float64 out", len(group_keys),
+                         out.shape[1] if out.ndim > 1 else 0)
                 qt.donef("advanced tile (%d appends), %d groups",
                          rt.appends, len(group_keys))
                 return _emit(out, group_keys)

@@ -306,7 +306,7 @@ class RollingTile:
 
 def advance_rolling(engine: TPUEngine, rt: RollingTile, storage, filters,
                     start: int, fetch_lo: int, end: int, max_series, tenant,
-                    drop_stale: bool) -> bool:
+                    drop_stale: bool, tracer=None) -> bool:
     """Bring `rt` up to date with storage for a query fetching
     [fetch_lo, end]: fetch only the slice newer than the tile's covered
     range and append it on device. Returns False when the tile cannot be
@@ -341,6 +341,8 @@ def advance_rolling(engine: TPUEngine, rt: RollingTile, storage, filters,
         # extend coverage: anything in (hi, end] — new ingest OR data that
         # simply lay beyond the previous query's fetch bound — appends in
         # one slice fetch
+        qt = tracer.new_child("slice fetch (%d, %d]", rt.hi_ms, end) \
+            if tracer is not None else None
         try:
             cols = storage.search_columns(filters, rt.hi_ms + 1, end,
                                           max_series=max_series,
@@ -354,8 +356,15 @@ def advance_rolling(engine: TPUEngine, rt: RollingTile, storage, filters,
             return no("partial slice fetch")
         if drop_stale:
             cols.drop_stale_nans()
+        if qt is not None:
+            qt.donef("%d series, %d samples", cols.n_series, cols.n_samples)
         if cols.n_series:
-            if not _append_cols(engine, rt, cols):
+            qa = tracer.new_child("device append") if tracer is not None \
+                else None
+            ok = _append_cols(engine, rt, cols)
+            if qa is not None:
+                qa.donef("%d samples -> row tails", cols.n_samples)
+            if not ok:
                 return no(engine.last_roll_decline)
             rt.segments.append((rt.hi_ms + 1, end, cols.n_samples))
         rt.hi_ms = end

@@ -29,6 +29,30 @@ from .tsid import TSID
 HEADERS_PER_INDEX_BLOCK = 256
 _META_ROW = struct.Struct(">32sIQIqq")
 
+# numpy mirror of BlockHeader's struct layout (">32sqqIhBBBqqQIQI"); the
+# TSID's trailing 8 bytes are the metric_id (tsid.py _FMT ">IIQIIQ"), split
+# out so header selection is pure array masking
+def sorted_member_mask(mids_sorted, mids: np.ndarray) -> np.ndarray:
+    """Membership mask of each metric id in the SORTED wanted-id array
+    (None = everything matches). Shared by the file-part and in-memory
+    columnar block selectors so their semantics cannot diverge."""
+    if mids_sorted is None:
+        return np.ones(mids.shape, bool)
+    if len(mids_sorted) == 0:
+        return np.zeros(mids.shape, bool)
+    pos = np.searchsorted(mids_sorted, mids)
+    pos_c = np.minimum(pos, len(mids_sorted) - 1)
+    return (mids_sorted[pos_c] == mids) & (pos < len(mids_sorted))
+
+
+_HDR_DTYPE = np.dtype([
+    ("tsid_pre", "S24"), ("mid", ">u8"),
+    ("min_ts", ">i8"), ("max_ts", ">i8"), ("rows", ">u4"),
+    ("scale", ">i2"), ("prec", "u1"), ("ts_mt", "u1"), ("val_mt", "u1"),
+    ("ts_first", ">i8"), ("val_first", ">i8"),
+    ("ts_off", ">u8"), ("ts_size", ">u4"), ("val_off", ">u8"),
+    ("val_size", ">u4")])
+
 
 class MetaindexRow:
     __slots__ = ("first_tsid", "block_count", "index_offset", "index_size",
@@ -171,6 +195,7 @@ class Part:
         self._hdr_cache: dict[int, list[BlockHeader]] = {}
         self._block_cache: "OrderedDict[tuple, Block]" = OrderedDict()
         self._block_cache_bytes = 0
+        self._hdr_cols = None  # lazy columnar view of all block headers
 
     def close(self):
         for f in (self._idx_f, self._ts_f, self._val_f):
@@ -258,6 +283,67 @@ class Part:
                     tsid_lo=None, tsid_hi=None):
         for h in self.iter_headers(tsid_set, min_ts, max_ts, tsid_lo, tsid_hi):
             yield self.read_block(h)
+
+    def header_columns(self):
+        """Columnar view of every block header, built ONCE per part
+        (immutable): header selection for the batched fetch becomes pure
+        numpy masking instead of per-header Python objects."""
+        hc = self._hdr_cols
+        if hc is None:
+            bufs = []
+            for row in self.meta_rows:
+                raw = zstd.decompress(self._read(self._idx_f,
+                                                 row.index_offset,
+                                                 row.index_size))
+                bufs.append(np.frombuffer(raw, dtype=_HDR_DTYPE))
+            arr = (np.concatenate(bufs) if bufs
+                   else np.zeros(0, dtype=_HDR_DTYPE))
+            hc = {k: arr[k].astype(np.int64)
+                  for k in ("mid", "min_ts", "max_ts", "rows", "scale",
+                            "ts_first", "val_first", "ts_off", "ts_size",
+                            "val_off", "val_size")}
+            hc["ts_mt"] = arr["ts_mt"].astype(np.int32)
+            hc["val_mt"] = arr["val_mt"].astype(np.int32)
+            self._hdr_cols = hc
+        return hc
+
+    def collect_columns(self, mids_sorted, min_ts, max_ts):
+        """Vectorized header selection + ONE native decode pass over every
+        matched block. Returns (mids, cnts, scales, ts_concat, mant_concat)
+        or None when the native path is unavailable (caller falls back to
+        the object path) or nothing matches (empty piece is None too)."""
+        from .. import native as _native
+        if self._ts_buf is None or not _native.available():
+            return None
+        hc = self.header_columns()
+        lo = -(1 << 62) if min_ts is None else min_ts
+        hi = (1 << 62) if max_ts is None else max_ts
+        mask = (hc["max_ts"] >= lo) & (hc["min_ts"] <= hi) & \
+            sorted_member_mask(mids_sorted, hc["mid"])
+        idx = np.flatnonzero(mask)
+        if idx.size == 0:
+            return False
+        ts_mt = np.ascontiguousarray(hc["ts_mt"][idx])
+        val_mt = np.ascontiguousarray(hc["val_mt"][idx])
+        if not _native.has_zstd() and \
+                (bool((ts_mt >= 5).any()) or bool((val_mt >= 5).any())):
+            return None  # zstd blocks need the Python per-block decoder
+        cnt = np.ascontiguousarray(hc["rows"][idx])
+        total = int(cnt.sum())
+        ts_out = np.empty(total, np.int64)
+        m_out = np.empty(total, np.int64)
+        _native.decode_blocks(
+            self._ts_buf, np.ascontiguousarray(hc["ts_off"][idx]),
+            np.ascontiguousarray(hc["ts_size"][idx]), ts_mt,
+            np.ascontiguousarray(hc["ts_first"][idx]), cnt, ts_out,
+            validate_ts=True)
+        _native.decode_blocks(
+            self._val_buf, np.ascontiguousarray(hc["val_off"][idx]),
+            np.ascontiguousarray(hc["val_size"][idx]), val_mt,
+            np.ascontiguousarray(hc["val_first"][idx]), cnt, m_out,
+            validate_ts=False)
+        return (np.ascontiguousarray(hc["mid"][idx]), cnt,
+                np.ascontiguousarray(hc["scale"][idx]), ts_out, m_out)
 
     def read_blocks_columns(self, hdrs: list[BlockHeader]):
         """Batched decode of many blocks in ONE native call per stream

@@ -8,6 +8,8 @@ import os
 import shutil
 import threading
 
+import numpy as np
+
 from ..utils import logger
 from .partition import Partition
 
@@ -92,16 +94,19 @@ class Table:
                                      tsid_lo, tsid_hi)
 
     def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
-                        tsid_lo=None, tsid_hi=None):
+                        tsid_lo=None, tsid_hi=None, mids_sorted=None):
         """Batched per-partition block collection (see
         Partition.collect_columns); returns a flat list of pieces."""
         parts = self.partitions_for_range(
             min_ts if min_ts is not None else -(1 << 62),
             max_ts if max_ts is not None else 1 << 62)
+        if mids_sorted is None and tsid_set is not None:
+            mids_sorted = np.fromiter(tsid_set, np.int64, len(tsid_set))
+            mids_sorted.sort()
         out = []
         for p in parts:
             out.extend(p.collect_columns(tsid_set, min_ts, max_ts,
-                                         tsid_lo, tsid_hi))
+                                         tsid_lo, tsid_hi, mids_sorted))
         return out
 
     def enforce_retention(self, min_valid_ts: int) -> int:
