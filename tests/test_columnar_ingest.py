@@ -1,0 +1,442 @@
+"""Columnar native-ingest path: differential tests against the Python
+parsers/row path (the reference treats all protocol parsers as hot paths,
+lib/protoparser/*; here each parser must agree with its Python twin and
+Storage.add_rows_columnar must agree with Storage.add_rows)."""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu import native
+from victoriametrics_tpu.ingest import parsers, remote_write, snappy
+from victoriametrics_tpu.storage.storage import Storage
+from victoriametrics_tpu.storage.tag_filters import filters_from_dict
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+T0 = 1_753_700_000_000
+
+
+# -- parsers ----------------------------------------------------------------
+
+class TestSnappy:
+    def test_roundtrip(self):
+        rng = random.Random(7)
+        for payload in (b"", b"x", b"hello world" * 400,
+                        bytes(rng.randrange(256) for _ in range(10_000)),
+                        b"ab" * 50_000):
+            assert native.snappy_uncompress(snappy.compress(payload)) \
+                == payload
+
+    def test_malformed(self):
+        assert native.snappy_uncompress(b"\xff\xff\xff\xff\xff") is None
+
+
+def rw_roundtrip(series, default_ts=T0):
+    raw = remote_write.build_write_request(series, compress="")
+    cr = native.parse_rw_columnar(raw, default_ts)
+    assert cr is not None
+    return cr.to_rows()
+
+
+class TestRemoteWriteParse:
+    def test_matches_python(self):
+        series = []
+        for i in range(50):
+            labels = [("__name__", "m"), ("idx", str(i)), ("job", "api")]
+            samples = [(T0 + j, float(i + j)) for j in range(4)]
+            series.append((labels, samples))
+        rows = rw_roundtrip(series)
+        raw = remote_write.build_write_request(series, compress="")
+        py = [(dict(labels), ts, val)
+              for labels, samples in remote_write.parse_write_request(raw, "none")
+              for ts, val in samples]
+        assert len(rows) == len(py) == 200
+        for (key, ts, val), (plabels, pts, pval) in zip(rows, py):
+            assert dict(parsers.labels_from_series_key(key)) == plabels
+            assert ts == pts and val == pval
+
+    def test_zero_ts_defaults(self):
+        rows = rw_roundtrip([([("__name__", "m")], [(0, 1.0)])], 4242)
+        assert rows == [(b"m", 4242, 1.0)]
+
+    def test_value_escaping_roundtrips(self):
+        labels = [("__name__", "m"), ("p", 'a\\b"c\nd,e=f')]
+        rows = rw_roundtrip([(labels, [(T0, 1.0)])])
+        assert parsers.labels_from_series_key(rows[0][0]) == labels
+
+    def test_weird_label_name_falls_back(self):
+        raw = remote_write.build_write_request(
+            [([("__name__", "m"), ("bad label", "v")], [(T0, 1.0)])],
+            compress="")
+        assert native.parse_rw_columnar(raw, T0) is None
+
+    def test_missing_name_falls_back(self):
+        raw = remote_write.build_write_request(
+            [([("job", "api")], [(T0, 1.0)])], compress="")
+        assert native.parse_rw_columnar(raw, T0) is None
+
+    def test_nan_inf_values(self):
+        rows = rw_roundtrip([([("__name__", "m")],
+                              [(T0, float("inf")), (T0 + 1, float("nan"))])])
+        assert rows[0][2] == float("inf") and np.isnan(rows[1][2])
+
+
+class TestInfluxParse:
+    CASES = [
+        b"cpu,host=h1 usage=1.5 1753700000000000000",
+        b"cpu value=7",
+        b"cpu,host=h1,region=r usage=1,idle=99i,frac=2.5,flag=t,off=F",
+        b"m field=1u\nm2 value=-3.25e2 1753700000123000000",
+        b"m,t=a\\,b\\ c,u=q\\=r v=1",
+        b"drop msg=\"a string\",ok=2",
+        b"tagless value=0.5\n# comment\n\nweird,empty=,k=v f=1",
+        b"neg v=1 -1753700000000000001",
+    ]
+
+    @pytest.mark.parametrize("body", CASES)
+    def test_matches_python(self, body):
+        cr = native.parse_influx_columnar(body, "db0", T0)
+        assert cr is not None
+        rows = cr.to_rows()
+        py = list(parsers.parse_influx(body.decode(), T0, db="db0"))
+        assert len(rows) == len(py)
+        for (key, ts, val), prow in zip(rows, py):
+            assert dict(parsers.labels_from_series_key(key)) \
+                == dict(prow.labels)
+            assert ts == prow.timestamp
+            assert val == prow.value
+
+    def test_no_db(self):
+        rows = native.parse_influx_columnar(b"cpu usage=1", "", T0).to_rows()
+        assert rows == [(b"cpu_usage", T0, 1.0)]
+
+    def test_metachar_measurement_falls_back(self):
+        # a measurement with ',' cannot round-trip through a text series
+        # key: the native parser must defer to the Python path
+        assert native.parse_influx_columnar(
+            b"esc\\,aped v=1", "", T0) is None
+
+    def test_float_ts_falls_back(self):
+        # Python int() raises on float timestamps; native must defer, not
+        # silently diverge
+        assert native.parse_influx_columnar(b"cpu v=1 1.5e18", "", T0) is None
+
+
+class TestKeyMap:
+    def test_ids_first_occurrence_order(self):
+        km = native.KeyMap()
+        base = b"aaabbbcccaaa"
+        off = np.array([0, 3, 6, 9], np.int64)
+        ln = np.array([3, 3, 3, 3], np.int64)
+        ids, new = km.resolve(base, off, ln)
+        assert list(ids) == [0, 1, 2, 0] and new == 3
+        ids2, new2 = km.resolve(base, off, ln)
+        assert list(ids2) == [0, 1, 2, 0] and new2 == 0 and len(km) == 3
+        km.close()
+
+    def test_growth(self):
+        km = native.KeyMap()
+        keys = b"".join(b"key%07d" % i for i in range(50_000))
+        off = np.arange(50_000, dtype=np.int64) * 10
+        ln = np.full(50_000, 10, np.int64)
+        ids, new = km.resolve(keys, off, ln)
+        assert new == 50_000 and list(ids[:3]) == [0, 1, 2]
+        ids2, new2 = km.resolve(keys, off, ln)
+        assert new2 == 0 and (ids2 == ids).all()
+        km.close()
+
+
+# -- storage columnar path --------------------------------------------------
+
+def fetch_all(st, name, lo=T0 - 10 ** 9, hi=T0 + 10 ** 9, tenant=(0, 0)):
+    out = {}
+    for sd in st.search_series(filters_from_dict({"__name__": name}), lo, hi,
+                               tenant=tenant):
+        key = tuple(sorted([(b"__name__", sd.metric_name.metric_group)]
+                           + list(sd.metric_name.labels)))
+        out[key] = (list(sd.timestamps), [round(v, 10) for v in sd.values])
+    return out
+
+
+def prom_body(n=200, it=0):
+    return ("\n".join(
+        f'cm{{idx="{i}",job="j{i % 5}"}} {i + it}.5 {T0 + it * 1000}'
+        for i in range(n))).encode()
+
+
+class TestAddRowsColumnar:
+    def test_matches_add_rows(self, tmp_path):
+        st_a = Storage(str(tmp_path / "a"))
+        st_b = Storage(str(tmp_path / "b"))
+        try:
+            for it in range(3):
+                body = prom_body(it=it)
+                cr = native.parse_prom_columnar(body, T0)
+                assert cr is not None
+                n_a = st_a.add_rows_columnar(cr)
+                rows = [(dict(parsers.labels_from_series_key(k)), ts, v)
+                        for k, ts, v in cr.to_rows()]
+                n_b = st_b.add_rows(rows)
+                assert n_a == n_b == 200
+            res_a = fetch_all(st_a, "cm")
+            assert len(res_a) == 200
+            assert res_a == fetch_all(st_b, "cm")
+        finally:
+            st_a.close()
+            st_b.close()
+
+    def test_mixed_tuple_and_columnar(self, tmp_path):
+        # both paths interleaved into ONE storage: flush must merge
+        # PendingChunks and tuple rows into correctly sorted parts
+        st = Storage(str(tmp_path / "s"))
+        try:
+            cr = native.parse_prom_columnar(prom_body(50, 0), T0)
+            st.add_rows_columnar(cr)
+            rows = [({"__name__": "cm", "idx": str(i), "job": f"j{i % 5}"},
+                     T0 + 1000, float(i)) for i in range(50)]
+            st.add_rows(rows)
+            st.add_rows_columnar(native.parse_prom_columnar(
+                prom_body(50, 2), T0))
+            st.table.flush_to_disk()
+            res = fetch_all(st, "cm")
+            assert len(res) == 50
+            key = tuple(sorted([(b"__name__", b"cm"), (b"idx", b"7"),
+                                (b"job", b"j2")]))
+            ts, vals = res[key]
+            assert ts == [T0, T0 + 1000, T0 + 2000]
+            assert vals == [7.5, 7.0, 9.5]
+        finally:
+            st.close()
+
+    def test_transform_relabel_caches_per_series(self, tmp_path):
+        st = Storage(str(tmp_path / "s"))
+        calls = []
+
+        def transform(labels):
+            calls.append(1)
+            d = dict(labels)
+            if d.get("idx") == "1":
+                return None  # dropped
+            d["extra"] = "yes"
+            return list(d.items())
+
+        try:
+            body = prom_body(4)
+            stats = {}
+            n = st.add_rows_columnar(native.parse_prom_columnar(body, T0),
+                                     transform=transform, drop_stats=stats)
+            assert n == 3 and stats == {"transform": 1}
+            n_calls = len(calls)
+            assert n_calls == 4  # once per new series
+            # repeat batch: verdicts cached, transform never re-runs
+            stats2 = {}
+            n2 = st.add_rows_columnar(
+                native.parse_prom_columnar(prom_body(4, 1), T0),
+                transform=transform, drop_stats=stats2)
+            assert n2 == 3 and len(calls) == n_calls
+            assert stats2 == {"transform": 1}
+            res = fetch_all(st, "cm")
+            assert len(res) == 3
+            assert all(dict(k)[b"extra"] == b"yes" for k in res)
+            # reset invalidates the cached verdicts
+            st.reset_columnar_spaces()
+            st.add_rows_columnar(
+                native.parse_prom_columnar(prom_body(4, 2), T0),
+                transform=transform)
+            assert len(calls) == n_calls + 4
+        finally:
+            st.close()
+
+    def test_malformed_key_skips_row_keeps_batch(self, tmp_path):
+        st = Storage(str(tmp_path / "s"))
+        try:
+            body = b'good{a="1"} 1 ' + str(T0).encode() + \
+                b'\nbad{a="unterminated 2\ngood2 3 ' + str(T0).encode()
+            cr = native.parse_prom_columnar(body, T0)
+            # native text parser already drops the unterminated line
+            n = st.add_rows_columnar(cr)
+            assert n == 2
+        finally:
+            st.close()
+
+    def test_day_rollover_creates_per_day_indexes(self, tmp_path):
+        st = Storage(str(tmp_path / "s"))
+        try:
+            day0 = (T0 // 86_400_000) * 86_400_000
+            body = (f'dm{{i="0"}} 1 {day0}\n'
+                    f'dm{{i="0"}} 2 {day0 + 86_400_000}\n').encode()
+            st.add_rows_columnar(native.parse_prom_columnar(body, T0))
+            st.table.flush_pending()
+            # per-day postings: search restricted to each day finds it
+            for d in (day0, day0 + 86_400_000):
+                res = st.search_series(filters_from_dict({"__name__": "dm"}),
+                                       d, d + 3_600_000)
+                assert len(res) == 1
+        finally:
+            st.close()
+
+    def test_month_straddle_routes_partitions(self, tmp_path):
+        st = Storage(str(tmp_path / "s"))
+        try:
+            jul = 1_753_900_000_000   # 2025-07-30
+            aug = 1_754_100_000_000   # 2025-08-02
+            body = (f'mm{{i="0"}} 1 {jul}\nmm{{i="0"}} 2 {aug}\n').encode()
+            st.add_rows_columnar(native.parse_prom_columnar(body, jul))
+            st.table.flush_to_disk()
+            assert len(st.table.partitions_for_range(jul, aug)) == 2
+            res = fetch_all(st, "mm", jul - 1, aug + 1)
+            assert list(res.values())[0][0] == [jul, aug]
+        finally:
+            st.close()
+
+    def test_cardinality_limiter_applies(self, tmp_path):
+        st = Storage(str(tmp_path / "s"), max_hourly_series=3)
+        try:
+            stats = {}
+            st.add_rows_columnar(
+                native.parse_prom_columnar(prom_body(10), T0),
+                drop_stats=stats)
+            st.table.flush_pending()
+            res = fetch_all(st, "cm")
+            assert len(res) <= 3
+        finally:
+            st.close()
+
+    def test_cardinality_rejection_is_retried(self, tmp_path):
+        # a series rejected under limiter pressure must be re-judged per
+        # batch (limiter windows rotate) — the drop verdict is not sticky
+        st = Storage(str(tmp_path / "s"), max_hourly_series=3)
+        try:
+            st.add_rows_columnar(native.parse_prom_columnar(
+                prom_body(10), T0))
+            st.table.flush_pending()
+            admitted0 = len(fetch_all(st, "cm"))
+            assert admitted0 <= 3
+            # rotate the hourly window, then resend: previously rejected
+            # series must be admitted now
+            st.hourly_limiter._bucket = -1  # force window rotation
+            st.add_rows_columnar(native.parse_prom_columnar(
+                prom_body(10, 1), T0))
+            st.table.flush_pending()
+            assert len(fetch_all(st, "cm")) > admitted0
+        finally:
+            st.close()
+
+    def test_space_reset_bounds_memory(self, tmp_path):
+        from victoriametrics_tpu.storage.storage import _ColumnarSpace
+        st = Storage(str(tmp_path / "s"))
+        old_max = _ColumnarSpace.MAX_KEYS
+        _ColumnarSpace.MAX_KEYS = 8
+        try:
+            for it in range(4):
+                st.add_rows_columnar(native.parse_prom_columnar(
+                    prom_body(6, it), T0))
+            sp = st._cspaces[(0, 0)]
+            assert len(sp.keymap) <= 8 + 6  # reset happened at least once
+            st.table.flush_pending()
+            assert len(fetch_all(st, "cm")) == 6  # data survived the resets
+        finally:
+            _ColumnarSpace.MAX_KEYS = old_max
+            st.close()
+
+    def test_tenant_isolation(self, tmp_path):
+        st = Storage(str(tmp_path / "s"))
+        try:
+            st.add_rows_columnar(native.parse_prom_columnar(
+                b"tm 1 " + str(T0).encode(), T0), tenant=(1, 2))
+            st.add_rows_columnar(native.parse_prom_columnar(
+                b"tm 9 " + str(T0).encode(), T0), tenant=(3, 4))
+            st.table.flush_pending()
+            a = fetch_all(st, "tm", tenant=(1, 2))
+            b = fetch_all(st, "tm", tenant=(3, 4))
+            assert list(a.values()) == [([T0], [1.0])]
+            assert list(b.values()) == [([T0], [9.0])]
+        finally:
+            st.close()
+
+
+# -- HTTP layer -------------------------------------------------------------
+
+@pytest.fixture()
+def api(tmp_path):
+    from victoriametrics_tpu.httpapi.prometheus_api import PrometheusAPI
+    st = Storage(str(tmp_path / "data"))
+    a = PrometheusAPI(st)
+    yield a
+    st.close()
+
+
+class FakeReq:
+    def __init__(self, body, args=None):
+        self.body = body
+        self.args = args or {}
+
+    def arg(self, name, default=""):
+        return self.args.get(name, default)
+
+
+class TestHTTPColumnar:
+    def test_remote_write_snappy_fast_path(self, api):
+        series = [([("__name__", "hm"), ("i", str(i))], [(T0, float(i))])
+                  for i in range(8)]
+        body = remote_write.build_write_request(series, compress="snappy")
+        resp = api.h_remote_write(FakeReq(body))
+        assert resp.status == 204
+        assert api.rows_inserted == 8
+        api.storage.table.flush_pending()
+        assert len(fetch_all(api.storage, "hm")) == 8
+
+    def test_influx_fast_path_matches_slow(self, api):
+        body = (f"cpu,host=a usage=1.25,idle=2 {T0 * 1_000_000}\n"
+                f"cpu,host=b usage=7 {T0 * 1_000_000}").encode()
+        resp = api.h_influx_write(FakeReq(body, {"db": "telegraf"}))
+        assert resp.status == 204 and api.rows_inserted == 3
+        api.storage.table.flush_pending()
+        res = fetch_all(api.storage, "cpu_usage")
+        assert len(res) == 2
+        assert dict(list(res)[0])[b"db"] == b"telegraf"
+
+    def test_fast_path_composes_with_relabel(self, api, tmp_path):
+        from victoriametrics_tpu.ingest.relabel import parse_relabel_configs
+        api.relabel = parse_relabel_configs(
+            "- action: drop\n"
+            "  source_labels: [idx]\n"
+            "  regex: '1'\n"
+            "- action: replace\n"
+            "  target_label: dc\n"
+            "  replacement: eu\n")
+        req = FakeReq(prom_body(4))
+        assert api.h_import_prometheus(req).status == 204
+        assert api.rows_inserted == 3
+        assert api.rows_relabel_dropped == 1
+        # repeat: cached verdicts, counters still advance per row
+        assert api.h_import_prometheus(FakeReq(prom_body(4, 1))).status == 204
+        assert api.rows_inserted == 6
+        assert api.rows_relabel_dropped == 2
+        api.storage.table.flush_pending()
+        res = fetch_all(api.storage, "cm")
+        assert len(res) == 3
+        assert all(dict(k)[b"dc"] == b"eu" for k in res)
+
+    def test_relabel_reload_resets_cache(self, api):
+        from victoriametrics_tpu.ingest.relabel import parse_relabel_configs
+        assert api.h_import_prometheus(FakeReq(prom_body(4))).status == 204
+        assert api.rows_inserted == 4
+        api.relabel = parse_relabel_configs(
+            "- action: drop\n  source_labels: [idx]\n  regex: '.*'\n")
+        assert api.h_import_prometheus(
+            FakeReq(prom_body(4, 1))).status == 204
+        assert api.rows_inserted == 4  # everything dropped post-reload
+
+    def test_series_limits_compose(self, api):
+        from victoriametrics_tpu.ingest.serieslimits import SeriesLimits
+        api.series_limits = SeriesLimits(max_labels_per_series=1)
+        assert api.h_import_prometheus(FakeReq(prom_body(3))).status == 204
+        assert api.rows_inserted == 0  # cm has 2 labels + name
+        assert api.h_import_prometheus(
+            FakeReq(b"solo 1 " + str(T0).encode())).status == 204
+        assert api.rows_inserted == 1

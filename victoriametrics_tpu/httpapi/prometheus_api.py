@@ -183,6 +183,7 @@ class PrometheusAPI:
         self.stream_aggr = stream_aggr   # ingest.streamaggr.StreamAggregators
         self.stream_aggr_keep_input = stream_aggr_keep_input
         self.series_limits = series_limits  # ingest.serieslimits.SeriesLimits
+        self.columnar_drop_stats: dict = {}
         self.active = ActiveQueries()
         self.qstats = QueryStats()
         self.gate = ConcurrencyGate(max_concurrent_queries)
@@ -194,6 +195,33 @@ class PrometheusAPI:
         self.metadata: dict[str, dict] = {}
         self.tenant_rows: dict[str, int] = {}
         self.name_usage: dict[str, list] = {}  # name -> [count, last_ts]
+
+    # the columnar ingest path caches relabel/series-limit VERDICTS per raw
+    # series key (Storage.add_rows_columnar transform), so any config swap
+    # must invalidate those caches — property setters make hot-reload
+    # (`self.relabel = ...` on SIGHUP) safe without extra call sites
+    @property
+    def relabel(self):
+        return self._relabel
+
+    @relabel.setter
+    def relabel(self, v):
+        self._relabel = v
+        self._reset_columnar()
+
+    @property
+    def series_limits(self):
+        return self._series_limits
+
+    @series_limits.setter
+    def series_limits(self, v):
+        self._series_limits = v
+        self._reset_columnar()
+
+    def _reset_columnar(self):
+        st = getattr(self, "storage", None)
+        if st is not None and getattr(st, "supports_columnar", False):
+            st.reset_columnar_spaces()
 
     # -- wiring ------------------------------------------------------------
 
@@ -687,6 +715,47 @@ class PrometheusAPI:
 
     # -- ingestion -----------------------------------------------------------
 
+    def _columnar_ok(self) -> bool:
+        """Columnar fast path covers relabel + series limits (verdicts are
+        cached per raw key inside Storage); only stream aggregation — which
+        must see every row — forces the Python path."""
+        return (self.stream_aggr is None
+                and getattr(self.storage, "supports_columnar", False))
+
+    def _columnar_transform(self):
+        relabel = self.relabel
+        limits = self.series_limits
+        if relabel is None and limits is None:
+            return None
+
+        def transform(labels):
+            d = dict(labels)
+            if relabel is not None:
+                d = relabel.apply(d)
+                if not d or not d.get("__name__"):
+                    return None
+            if limits is not None and not limits.check(d):
+                return None
+            return list(d.items())
+        return transform
+
+    def _ingest_columnar(self, cr, tenant=(0, 0)) -> int:
+        """Shared columnar ingest tail (native.ColumnarRows batches)."""
+        stats: dict = {}
+        n = self.storage.add_rows_columnar(
+            cr, tenant=tenant, transform=self._columnar_transform(),
+            drop_stats=stats)
+        if stats:
+            self.rows_relabel_dropped += stats.get("transform", 0)
+            for k, v in stats.items():
+                self.columnar_drop_stats[k] = \
+                    self.columnar_drop_stats.get(k, 0) + v
+        self.rows_inserted += n
+        if n and tenant != (0, 0):
+            key = f'{{accountID="{tenant[0]}",projectID="{tenant[1]}"}}'
+            self.tenant_rows[key] = self.tenant_rows.get(key, 0) + n
+        return n
+
     def _add_rows(self, rows_iter, tenant=(0, 0)) -> int:
         now = int(time.time() * 1000)
         batch = []
@@ -741,6 +810,17 @@ class PrometheusAPI:
         # header; clients that omit it still send snappy (the protocol
         # default), so try raw first, then snappy. parse_write_request is a
         # generator — materialize inside the try so errors surface here.
+        if self._columnar_ok():
+            from .. import native
+            now = int(time.time() * 1000)
+            cr = native.parse_rw_columnar(req.body, now)
+            if cr is None:
+                body = native.snappy_uncompress(req.body)
+                if body is not None:
+                    cr = native.parse_rw_columnar(body, now)
+            if cr is not None:
+                self._ingest_columnar(cr, self._tenant(req))
+                return Response(status=204, body=b"")
         try:
             series = list(remote_write.parse_write_request(req.body, "none"))
         except Exception:
@@ -774,11 +854,20 @@ class PrometheusAPI:
                 if len(self.metadata) < 100_000:
                     self.metadata.update(md)
             tenant = self._tenant(req)
-            if self.relabel is None and self.series_limits is None and \
+            cr = None
+            if self._columnar_ok():
+                from .. import native
+                cr = native.parse_prom_columnar(
+                    req.body, ts or int(time.time() * 1000))
+            if cr is not None:
+                # fast path: native parse -> columnar raw-key rows; repeat
+                # scrapes resolve whole batches in one native hash-map call
+                self._ingest_columnar(cr, tenant)
+            elif self.relabel is None and self.series_limits is None and \
                     self.stream_aggr is None and \
                     getattr(self.storage, "supports_raw_keys", False):
-                # fast path: native parse -> raw series-key rows; cache
-                # hits in Storage.add_rows never materialize labels
+                # raw-key row path (native lib present, columnar storage
+                # absent — e.g. cluster vminsert)
                 rows = parsers.parse_prometheus_fast(req.body, ts)
                 self._ingest(rows, tenant)
             else:
@@ -802,9 +891,17 @@ class PrometheusAPI:
     def h_influx_write(self, req: Request) -> Response:
         db = req.arg("db")
         try:
-            self._add_rows(parsers.parse_influx(
-                req.body.decode("utf-8", "replace"), db=db),
-                self._tenant(req))
+            cr = None
+            if self._columnar_ok():
+                from .. import native
+                cr = native.parse_influx_columnar(
+                    req.body, db or "", int(time.time() * 1000))
+            if cr is not None:
+                self._ingest_columnar(cr, self._tenant(req))
+            else:
+                self._add_rows(parsers.parse_influx(
+                    req.body.decode("utf-8", "replace"), db=db),
+                    self._tenant(req))
         except ValueError as e:
             return Response.error(f"cannot parse influx line: {e}", 400)
         return Response(status=204, body=b"")

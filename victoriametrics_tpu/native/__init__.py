@@ -93,6 +93,24 @@ def _configure(lib):
     lib.vm_rollup_counter_2d.argtypes = [pi64, pf64, pi64, i64, i64, i64,
                                          i64, i64, i64, pi64,
                                          ctypes.c_int32, pf64, pf64]
+    lib.vm_snappy_uncompressed_len.restype = i64
+    lib.vm_snappy_uncompressed_len.argtypes = [p8, i64]
+    lib.vm_snappy_uncompress.restype = i64
+    lib.vm_snappy_uncompress.argtypes = [p8, i64, p8, i64]
+    lib.vm_parse_rw.restype = i64
+    lib.vm_parse_rw.argtypes = [p8, i64, i64, p8, i64, pi64, pi64,
+                                pf64, pi64, i64]
+    lib.vm_parse_influx.restype = i64
+    lib.vm_parse_influx.argtypes = [p8, i64, p8, i64, i64, p8, i64,
+                                    pi64, pi64, pf64, pi64, i64]
+    lib.vm_keymap_new.restype = i64
+    lib.vm_keymap_new.argtypes = []
+    lib.vm_keymap_free.restype = None
+    lib.vm_keymap_free.argtypes = [i64]
+    lib.vm_keymap_size.restype = i64
+    lib.vm_keymap_size.argtypes = [i64]
+    lib.vm_keymap_resolve.restype = i64
+    lib.vm_keymap_resolve.argtypes = [i64, p8, pi64, pi64, i64, pi64]
     return lib
 
 
@@ -295,6 +313,181 @@ def rollup_counter_2d(func: str, ts2: np.ndarray, v2: np.ndarray,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), scratch.ctypes.
         data_as(ctypes.POINTER(ctypes.c_double)))
     return out
+
+
+def snappy_uncompress(data: bytes):
+    """Native snappy block-format decompress; None when unavailable or
+    malformed (callers fall back to the Python decoder)."""
+    lib = _load()
+    if lib is None:
+        return None
+    src = _as_u8_ptr(data)
+    n = lib.vm_snappy_uncompressed_len(src, len(data))
+    if n < 0 or n > 1 << 31:
+        # unreasonable claimed length (attacker-controlled varint): refuse
+        # to allocate; the Python decoder raises the proper 400 downstream
+        return None
+    out = ctypes.create_string_buffer(int(n) or 1)
+    w = lib.vm_snappy_uncompress(src, len(data),
+                                 ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)),
+                                 n)
+    if w != n:
+        return None
+    return out.raw[:n]
+
+
+class ColumnarRows:
+    """Columnar ingest rows: keybuf[key_off[i]:key_off[i]+key_len[i]] is the
+    canonical text series key of row i; tss/values are int64/float64."""
+
+    __slots__ = ("keybuf", "key_off", "key_len", "tss", "values")
+
+    def __init__(self, keybuf, key_off, key_len, tss, values):
+        self.keybuf = keybuf
+        self.key_off = key_off
+        self.key_len = key_len
+        self.tss = tss
+        self.values = values
+
+    def __len__(self):
+        return self.key_off.size
+
+    def to_rows(self):
+        """Materialize per-row (key_bytes, ts, value) tuples (slow; tests
+        and non-columnar storages only)."""
+        mv = memoryview(self.keybuf)
+        return [(bytes(mv[o:o + l]), int(t), float(v))
+                for o, l, t, v in zip(self.key_off, self.key_len,
+                                      self.tss, self.values)]
+
+
+def _parse_columnar(call, data: bytes, est_rows: int):
+    """Shared retry driver for the columnar parsers: grows keybuf (-2) and
+    row capacity (-3); -1 = native asked for the Python fallback."""
+    lib = _load()
+    if lib is None:
+        return None
+    keybuf_cap = 2 * len(data) + 4096
+    max_rows = est_rows
+    for _ in range(6):
+        keybuf = ctypes.create_string_buffer(keybuf_cap)
+        key_off = np.empty(max_rows, dtype=np.int64)
+        key_len = np.empty(max_rows, dtype=np.int64)
+        values = np.empty(max_rows, dtype=np.float64)
+        tss = np.empty(max_rows, dtype=np.int64)
+        n = call(lib, keybuf, keybuf_cap, _as_i64_ptr(key_off),
+                 _as_i64_ptr(key_len),
+                 values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                 _as_i64_ptr(tss), max_rows)
+        if n == -2:
+            keybuf_cap *= 4
+            continue
+        if n == -3:
+            max_rows *= 4
+            continue
+        if n < 0:
+            return None
+        return ColumnarRows(keybuf.raw[:_keybuf_used(key_off, key_len, n)],
+                            key_off[:n], key_len[:n], tss[:n], values[:n])
+    return None
+
+
+def _keybuf_used(key_off, key_len, n):
+    if n == 0:
+        return 0
+    return int(key_off[n - 1] + key_len[n - 1])
+
+
+def parse_rw_columnar(data: bytes, default_ts: int):
+    """Native remote-write WriteRequest parse (uncompressed protobuf) ->
+    ColumnarRows; None = fall back to the Python parser."""
+    return _parse_columnar(
+        lambda lib, kb, kc, ko, kl, vs, ts, mr: lib.vm_parse_rw(
+            _as_u8_ptr(data), len(data), default_ts, ctypes.cast(
+                kb, ctypes.POINTER(ctypes.c_uint8)), kc, ko, kl, vs, ts, mr),
+        data, max(data.count(b"\x12") + 16, 64))
+
+
+def parse_influx_columnar(data: bytes, db: str, default_ts: int):
+    """Native influx line-protocol parse -> ColumnarRows; None = fallback."""
+    dbb = db.encode() if db else b""
+    return _parse_columnar(
+        lambda lib, kb, kc, ko, kl, vs, ts, mr: lib.vm_parse_influx(
+            _as_u8_ptr(data), len(data), _as_u8_ptr(dbb), len(dbb),
+            default_ts, ctypes.cast(kb, ctypes.POINTER(ctypes.c_uint8)),
+            kc, ko, kl, vs, ts, mr),
+        data, max(2 * data.count(b"\n") + 16, 64))
+
+
+def parse_prom_columnar(data: bytes, default_ts: int):
+    """Native prometheus text parse -> ColumnarRows (keys reference the
+    request body itself); None = fallback."""
+    lib = _load()
+    if lib is None:
+        return None
+    n_max = data.count(b"\n") + 2
+    key_off = np.empty(n_max, dtype=np.int32)
+    key_len = np.empty(n_max, dtype=np.int32)
+    values = np.empty(n_max, dtype=np.float64)
+    tss = np.empty(n_max, dtype=np.int64)
+    n = lib.vm_parse_prom(
+        data, len(data),
+        key_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        key_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _as_i64_ptr(tss), n_max)
+    tss = tss[:n]
+    # explicit 0 is "no timestamp" too (parity with parse_prom_raw)
+    tss[(tss == _TS_ABSENT) | (tss == 0)] = default_ts
+    return ColumnarRows(data, key_off[:n].astype(np.int64),
+                        key_len[:n].astype(np.int64), tss, values[:n])
+
+
+class KeyMap:
+    """Native byte-string -> dense-id map (vm_keymap). Ids are assigned
+    consecutively in first-occurrence order, so id arrays can index numpy
+    side tables (TSID fields, per-day state) directly."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.vm_keymap_new()
+        if not self._h:
+            raise MemoryError("vm_keymap_new failed")
+
+    def __len__(self):
+        return int(self._lib.vm_keymap_size(self._h))
+
+    def resolve(self, base, key_off: np.ndarray,
+                key_len: np.ndarray) -> tuple[np.ndarray, int]:
+        """Returns (ids int64[n], n_new). New keys get ids
+        len-before..len-before+n_new-1 in first-occurrence order."""
+        n = int(key_off.size)
+        ids = np.empty(n, dtype=np.int64)
+        if isinstance(base, np.ndarray):
+            bp = base.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        else:
+            bp = _as_u8_ptr(base)
+        added = self._lib.vm_keymap_resolve(
+            self._h, bp, _as_i64_ptr(np.ascontiguousarray(key_off, np.int64)),
+            _as_i64_ptr(np.ascontiguousarray(key_len, np.int64)), n,
+            _as_i64_ptr(ids))
+        if added < 0:
+            raise MemoryError("vm_keymap_resolve failed")
+        return ids, int(added)
+
+    def close(self):
+        if self._h:
+            self._lib.vm_keymap_free(self._h)
+            self._h = 0
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def marshal_i64_many(vals: np.ndarray, offsets: np.ndarray):

@@ -309,9 +309,11 @@ class Part:
 
     def collect_columns(self, mids_sorted, min_ts, max_ts):
         """Vectorized header selection + ONE native decode pass over every
-        matched block. Returns (mids, cnts, scales, ts_concat, mant_concat)
-        or None when the native path is unavailable (caller falls back to
-        the object path) or nothing matches (empty piece is None too)."""
+        matched block. Returns (mids, cnts, scales, ts_concat, mant_concat);
+        None when the native path is unavailable (caller falls back to the
+        per-header object path); False when the vectorized path RAN and
+        nothing matched (caller skips this part — do not collapse the two
+        sentinels, Partition.collect_columns branches on them)."""
         from .. import native as _native
         if self._ts_buf is None or not _native.available():
             return None

@@ -132,14 +132,36 @@ class InmemoryPart:
         return (mids[idx], sel_cnts, scales[idx], ts_all[pos], m_all[pos])
 
 
+class PendingChunk:
+    """A columnar ingest batch parked in a partition's pending list: dense
+    id rows resolved by the native key map (Storage.add_rows_columnar).
+    Per-id TSID sort-key columns live in the owning id space, so chunk
+    construction is pure numpy gathers — no per-row Python objects exist
+    anywhere on the columnar ingest hot path."""
+
+    __slots__ = ("space", "ids", "ts", "vals")
+
+    def __init__(self, space, ids, ts, vals):
+        self.space = space
+        self.ids = ids
+        self.ts = ts
+        self.vals = vals
+
+    def __len__(self):
+        return int(self.ids.size)
+
+
 def _rows_to_inmemory_part(rows: list, precision_bits: int = 64) -> InmemoryPart:
-    """rows: list of (TSID, ts_ms, float_value). Sorts by (tsid, ts) and
-    builds <=8k-row blocks (createInmemoryPart, partition.go:877 analog).
+    """rows: list of (TSID, ts_ms, float_value) tuples and/or PendingChunks.
+    Sorts by (tsid, ts) and builds <=8k-row blocks (createInmemoryPart,
+    partition.go:877 analog).
 
     The float->decimal conversion is BATCHED across all blocks
     (float_to_decimal_grouped): per-series scrape flushes produce thousands
     of ~tens-of-rows blocks, where per-block conversion overhead dominates
     the flush."""
+    if any(isinstance(r, PendingChunk) for r in rows):
+        return _mixed_to_inmemory_part(rows, precision_bits)
     from ..ops.decimal import float_to_decimal_grouped
     from .block import MAX_ROWS_PER_BLOCK, Block
     n = len(rows)
@@ -186,6 +208,81 @@ def _rows_to_inmemory_part(rows: list, precision_bits: int = 64) -> InmemoryPart
             for a in range(i, j, MAX_ROWS_PER_BLOCK):
                 segs.append((tsid, a, min(a + MAX_ROWS_PER_BLOCK, j)))
             i = j
+    if not segs:
+        return InmemoryPart([])
+    starts = np.array([a for _, a, _ in segs], dtype=np.int64)
+    m_all, exps = float_to_decimal_grouped(all_vals, starts)
+    return InmemoryPart.from_columns(segs, all_ts, m_all, exps,
+                                     precision_bits)
+
+
+def _mixed_to_inmemory_part(items: list, precision_bits: int) -> InmemoryPart:
+    """Columnar InmemoryPart construction over a mix of PendingChunks and
+    legacy (TSID, ts, val) tuples: sort-key columns are gathered/concatenated
+    and lexsorted; TSID objects are resolved per BLOCK (not per row) via
+    (owner, loc) provenance arrays."""
+    from ..ops.decimal import float_to_decimal_grouped
+    from .block import MAX_ROWS_PER_BLOCK
+    chunks = [x for x in items if isinstance(x, PendingChunk)]
+    tups = [x for x in items if not isinstance(x, PendingChunk)]
+    accs, projs, grps, jobs, insts, mids = [], [], [], [], [], []
+    tss, valss, owners, locs = [], [], [], []
+    n_t = len(tups)
+    if n_t:
+        accs.append(np.fromiter((r[0].account_id for r in tups), np.uint64, n_t))
+        projs.append(np.fromiter((r[0].project_id for r in tups), np.uint64, n_t))
+        grps.append(np.fromiter((r[0].metric_group_id for r in tups), np.uint64, n_t))
+        jobs.append(np.fromiter((r[0].job_id for r in tups), np.uint64, n_t))
+        insts.append(np.fromiter((r[0].instance_id for r in tups), np.uint64, n_t))
+        mids.append(np.fromiter((r[0].metric_id for r in tups), np.uint64, n_t))
+        tss.append(np.fromiter((r[1] for r in tups), np.int64, n_t))
+        valss.append(np.fromiter((r[2] for r in tups), np.float64, n_t))
+        owners.append(np.full(n_t, -1, np.int64))
+        locs.append(np.arange(n_t, dtype=np.int64))
+    for ci, ch in enumerate(chunks):
+        ids = ch.ids
+        sp = ch.space
+        accs.append(sp.acc[ids])
+        projs.append(sp.proj[ids])
+        grps.append(sp.grp[ids])
+        jobs.append(sp.job[ids])
+        insts.append(sp.inst[ids])
+        mids.append(sp.mid[ids])
+        tss.append(ch.ts)
+        valss.append(ch.vals)
+        owners.append(np.full(ids.size, ci, np.int64))
+        locs.append(ids)
+    acc = np.concatenate(accs)
+    proj = np.concatenate(projs)
+    grp = np.concatenate(grps)
+    job = np.concatenate(jobs)
+    inst = np.concatenate(insts)
+    mid = np.concatenate(mids)
+    all_ts = np.concatenate(tss)
+    all_vals = np.concatenate(valss)
+    owner = np.concatenate(owners)
+    loc = np.concatenate(locs)
+    n = int(all_ts.size)
+    if n == 0:
+        return InmemoryPart([])
+    order = np.lexsort((all_ts, mid, inst, job, grp, proj, acc))
+    all_ts = all_ts[order]
+    all_vals = all_vals[order]
+    mid = mid[order]
+    owner = owner[order]
+    loc = loc[order]
+    series_starts = np.concatenate(
+        [[0], np.flatnonzero(mid[1:] != mid[:-1]) + 1, [n]])
+
+    def tsid_at(r: int):
+        o = owner[r]
+        return tups[loc[r]][0] if o < 0 else chunks[o].space.tsids[loc[r]]
+
+    segs = []
+    for a, b in zip(series_starts[:-1], series_starts[1:]):
+        tsid = tsid_at(a)
+        for x in range(a, b, MAX_ROWS_PER_BLOCK):
+            segs.append((tsid, x, min(x + MAX_ROWS_PER_BLOCK, b)))
     if not segs:
         return InmemoryPart([])
     starts = np.array([a for _, a, _ in segs], dtype=np.int64)
@@ -265,7 +362,8 @@ class Partition:
         self.name = name
         self.dedup_interval_ms = dedup_interval_ms
         self._lock = threading.RLock()
-        self._pending: list = []
+        self._pending: list = []        # row tuples and/or PendingChunks
+        self._pending_nrows = 0
         # incremental InmemoryPart views over _pending: each query converts
         # only rows ingested since the previous query (the flusher compacts
         # everything into one part every couple of seconds anyway);
@@ -331,13 +429,24 @@ class Partition:
         """rows: list of (TSID, ts_ms, float_value)."""
         with self._lock:
             self._pending.extend(rows)
-            if len(self._pending) >= MAX_PENDING_ROWS:
+            self._pending_nrows += len(rows)
+            if self._pending_nrows >= MAX_PENDING_ROWS:
+                self._flush_pending_locked()
+
+    def add_rows_columnar(self, chunk: PendingChunk) -> None:
+        """Columnar ingest: the whole batch parks as ONE pending element
+        (no per-row tuples), counted by its row total."""
+        with self._lock:
+            self._pending.append(chunk)
+            self._pending_nrows += len(chunk)
+            if self._pending_nrows >= MAX_PENDING_ROWS:
                 self._flush_pending_locked()
 
     def _flush_pending_locked(self):
         if not self._pending:
             return
         rows, self._pending = self._pending, []
+        self._pending_nrows = 0
         self._pending_parts = []
         self._pending_off = 0
         self._pending_gen += 1
@@ -521,7 +630,7 @@ class Partition:
     @property
     def rows(self) -> int:
         with self._lock:
-            return (len(self._pending)
+            return (self._pending_nrows
                     + sum(m.rows for m in self._mem_parts)
                     + sum(p.rows for p in self._file_parts))
 

@@ -30,6 +30,91 @@ from .tsid import MetricIDGenerator, TSID, generate_tsid
 DEFAULT_RETENTION_MS = 31 * 13 * 86_400_000  # ~13 months, like the reference
 
 
+class _ColumnarSpace:
+    """Per-tenant dense-id state for the columnar ingest path: a native
+    byte-key -> id map plus per-id numpy columns (TSID sort-key fields,
+    per-day index state, drop verdicts). Resolving a batch is ONE native
+    call; everything downstream indexes these arrays.
+
+    Drop verdicts are sticky per id (0 ok, 1 malformed key, 2 dropped by
+    transform/relabel, 3 over cardinality budget at creation) — repeat rows
+    of a dropped series are filtered with one mask, never re-judged."""
+
+    __slots__ = ("keymap", "tsids", "acc", "proj", "grp", "job", "inst",
+                 "mid", "drop", "last_date", "_cap")
+
+    #: distinct raw keys per tenant space before the whole space is rebuilt
+    #: — same bound (and rationale) as the legacy raw TSID cache clear at
+    #: 1<<21 entries (add_rows): high-churn keys must not leak memory
+    MAX_KEYS = 1 << 21
+
+    def __init__(self):
+        from .. import native
+        self.keymap = native.KeyMap()
+        self.tsids: list = []
+        self._cap = 0
+        z = np.zeros(0, np.uint64)
+        self.acc = z
+        self.proj = z.copy()
+        self.grp = z.copy()
+        self.job = z.copy()
+        self.inst = z.copy()
+        self.mid = z.copy()
+        self.drop = np.zeros(0, np.uint8)
+        self.last_date = np.zeros(0, np.int64)
+
+    def _grow(self, need: int) -> None:
+        """Amortized-doubling growth of the per-id columns (append_ids runs
+        per new-series batch; O(total) reallocation there would make churny
+        workloads quadratic)."""
+        if need <= self._cap:
+            return
+        ncap = max(1024, self._cap * 2, need)
+        for f in ("acc", "proj", "grp", "job", "inst", "mid", "drop",
+                  "last_date"):
+            old = getattr(self, f)
+            new = np.empty(ncap, old.dtype)
+            new[:len(self.tsids)] = old[:len(self.tsids)]
+            setattr(self, f, new)
+        self._cap = ncap
+
+    def append_ids(self, tsids: list, drops: list) -> None:
+        """Registers len(tsids) new ids (tsids[i] is None when drops[i]!=0)."""
+        k = len(tsids)
+        n = len(self.tsids)
+        self._grow(n + k)
+        for j, (t, d) in enumerate(zip(tsids, drops)):
+            i = n + j
+            if t is not None:
+                self.acc[i] = t.account_id
+                self.proj[i] = t.project_id
+                self.grp[i] = t.metric_group_id
+                self.job[i] = t.job_id
+                self.inst[i] = t.instance_id
+                self.mid[i] = t.metric_id
+            else:
+                self.acc[i] = self.proj[i] = self.grp[i] = 0
+                self.job[i] = self.inst[i] = self.mid[i] = 0
+            self.drop[i] = d
+            self.last_date[i] = -(1 << 62)
+        self.tsids.extend(tsids)
+
+    def set_tsid(self, i: int, tsid) -> None:
+        """Re-admits a previously dropped id (cardinality retry)."""
+        self.tsids[i] = tsid
+        self.acc[i] = tsid.account_id
+        self.proj[i] = tsid.project_id
+        self.grp[i] = tsid.metric_group_id
+        self.job[i] = tsid.job_id
+        self.inst[i] = tsid.instance_id
+        self.mid[i] = tsid.metric_id
+        self.drop[i] = 0
+        self.last_date[i] = -(1 << 62)
+
+    def close(self):
+        self.keymap.close()
+
+
 class SeriesData:
     """Decoded query result for one series."""
 
@@ -89,6 +174,9 @@ class Storage:
         # reference's MetricNameRaw-keyed tsidCache, storage.go:1874): rows
         # with a cached label tuple skip MetricName construction entirely.
         self._tsid_cache_raw: dict[tuple, TSID] = {}
+        # per-tenant columnar id spaces (native key map + per-id numpy
+        # state), lazily created by add_rows_columnar
+        self._cspaces: dict[tuple, "_ColumnarSpace"] = {}
         self._day_cache: set[tuple[int, int]] = set()  # (metric_id, date)
         self._mid_gen = MetricIDGenerator()
         self._lock = threading.RLock()
@@ -153,6 +241,9 @@ class Storage:
         self.idb.flush()
         self.table.close()
         self.idb.close()
+        for sp in self._cspaces.values():
+            sp.close()
+        self._cspaces = {}
         fcntl.flock(self._flock_f, fcntl.LOCK_UN)
         self._flock_f.close()
 
@@ -348,6 +439,184 @@ class Storage:
                 self._append_log_floor = log[0][0]
             log.append((self.data_version, min(r[1] for r in out)))
         return len(out)
+
+    #: add_rows_columnar accepts native.ColumnarRows batches; ClusterStorage
+    #: does not (it must decompose labels to shard), so HTTP gates on this.
+    supports_columnar = True
+
+    def add_rows_columnar(self, cr, tenant=(0, 0), transform=None,
+                          drop_stats: dict | None = None) -> int:
+        """Columnar ingest batch (native.ColumnarRows): resolves every raw
+        series key to a dense id with ONE native hash-map call, then runs
+        filtering/day-index bookkeeping as numpy masking. Per-row Python
+        exists only for NEW series and day rollovers.
+
+        `transform(labels) -> labels | None` runs ONCE per new series (None
+        = drop); the verdict is cached under the raw key, which is how
+        relabeling composes with the fast path (relabel rules are pure
+        functions of the label set). Callers must reset the columnar spaces
+        when the transform config changes (reset_columnar_spaces).
+
+        `drop_stats`: optional dict, incremented per dropped ROW by reason
+        ("malformed" / "transform" / "cardinality" / "limiter").
+        """
+        if self._readonly:
+            raise RuntimeError("storage is read-only")
+        ids = tss = vals = None
+        with self._lock:
+            sp = self._cspaces.get(tenant)
+            if sp is not None and len(sp.keymap) >= sp.MAX_KEYS:
+                sp.close()  # bound churny key spaces (raw-cache clear analog)
+                sp = None
+            if sp is None:
+                sp = self._cspaces[tenant] = _ColumnarSpace()
+            ids, n_new = sp.keymap.resolve(cr.keybuf, cr.key_off, cr.key_len)
+            if n_new:
+                self._register_columnar_ids(sp, cr, ids, tenant, transform)
+            drop = sp.drop[ids]
+            if (drop == 3).any():
+                # cardinality rejections are transient (limiter windows
+                # rotate hourly/daily): re-judge once per id per batch,
+                # matching the legacy path's per-batch retry
+                retried = set()
+                for r in np.flatnonzero(drop == 3):
+                    i = int(ids[r])
+                    if i in retried:
+                        continue
+                    retried.add(i)
+                    key = bytes(memoryview(cr.keybuf)[
+                        int(cr.key_off[r]):
+                        int(cr.key_off[r]) + int(cr.key_len[r])])
+                    tsid, verdict = self._judge_key(key, tenant, transform)
+                    if tsid is not None:
+                        sp.set_tsid(i, tsid)
+                drop = sp.drop[ids]
+            tss, vals = cr.tss, cr.values
+            sel = None  # surviving-row indices into cr (None = all)
+            if drop.any():
+                if drop_stats is not None:
+                    for code, name in ((1, "malformed"), (2, "transform"),
+                                       (3, "cardinality")):
+                        c = int((drop == code).sum())
+                        if c:
+                            drop_stats[name] = drop_stats.get(name, 0) + c
+                keep = drop == 0
+                sel = np.flatnonzero(keep)
+                ids = ids[keep]
+                tss = tss[keep]
+                vals = vals[keep]
+            if ids.size and (self.hourly_limiter is not None or
+                             self.daily_limiter is not None):
+                # one limiter probe per DISTINCT series per batch preserves
+                # the limiters' distinct-count semantics at columnar cost
+                uniq = np.unique(ids)
+                bad = [i for i in uniq
+                       if not self._cardinality_ok(int(sp.mid[i]))]
+                if bad:
+                    keep = ~np.isin(ids, bad)
+                    if drop_stats is not None:
+                        c = int(ids.size - keep.sum())
+                        drop_stats["limiter"] = drop_stats.get(
+                            "limiter", 0) + c
+                    sel = (np.flatnonzero(keep) if sel is None
+                           else sel[keep])
+                    ids = ids[keep]
+                    tss = tss[keep]
+                    vals = vals[keep]
+            if ids.size == 0:
+                return 0
+            dates = tss // 86_400_000
+            roll = np.flatnonzero(sp.last_date[ids] != dates)
+            for r in roll:
+                i = int(ids[r])
+                d = int(dates[r])
+                if sp.last_date[i] == d:
+                    continue  # later duplicate within this batch
+                mid = int(sp.mid[i])
+                if (mid, d) not in self._day_cache:
+                    mn = self.idb.get_metric_name_by_id(mid)
+                    if mn is None:
+                        # index name cache miss: rebuild from this batch's
+                        # raw key (+ transform, for relabeled series)
+                        from ..ingest.parsers import labels_from_series_key
+                        rr = int(sel[r]) if sel is not None else int(r)
+                        try:
+                            labels = labels_from_series_key(bytes(
+                                memoryview(cr.keybuf)[
+                                    int(cr.key_off[rr]):
+                                    int(cr.key_off[rr]) + int(cr.key_len[rr])]))
+                            if transform is not None:
+                                labels = transform(labels)
+                            if labels:
+                                mn = MetricName.from_labels(labels)
+                        except ValueError:
+                            mn = None
+                    if mn is not None:
+                        self.idb.create_per_day_indexes(mn, sp.tsids[i], d)
+                    self._day_cache.add((mid, d))
+                sp.last_date[i] = d
+        oldest = int(tss.min())
+        from ..query.rollup_result_cache import GLOBAL, OFFSET_MS
+        if oldest < int(time.time() * 1000) - OFFSET_MS:
+            GLOBAL.reset()
+        self.table.add_rows_columnar(sp, ids, tss, vals)
+        n = int(ids.size)
+        self.rows_added += n
+        with self._lock:
+            self.data_version += 1
+            log = self._append_log
+            if log.maxlen is not None and len(log) == log.maxlen:
+                self._append_log_floor = log[0][0]
+            log.append((self.data_version, oldest))
+        return n
+
+    def _judge_key(self, key: bytes, tenant, transform):
+        """Raw key -> (tsid | None, verdict): materialize labels, run the
+        transform, resolve the TSID. Verdicts: 0 ok, 1 malformed, 2 dropped
+        by transform, 3 over the cardinality budget (re-triable)."""
+        from ..ingest.parsers import labels_from_series_key
+        try:
+            labels = labels_from_series_key(key)
+        except ValueError:
+            return None, 1
+        if transform is not None:
+            labels = transform(labels)
+            if labels is None:
+                return None, 2
+        mn = MetricName.from_labels(labels)
+        tsid = self._resolve_tsid(mn, mn.marshal(), tenant, limited=True)
+        if tsid is None:
+            return None, 3
+        return tsid, 0
+
+    def _register_columnar_ids(self, sp, cr, ids, tenant, transform) -> None:
+        """Slow path for first-seen raw keys: materialize labels, run the
+        transform, resolve TSIDs, create indexes. Ids arrive in
+        first-occurrence order, so one ascending pass assigns them all."""
+        old = len(sp.tsids)
+        mv = memoryview(cr.keybuf)
+        new_tsids: list = []
+        drops: list = []
+        for r in np.flatnonzero(ids >= old):
+            i = int(ids[r])
+            if i != old + len(new_tsids):
+                continue  # repeat row of an id registered this pass
+            key = bytes(mv[int(cr.key_off[r]):
+                           int(cr.key_off[r]) + int(cr.key_len[r])])
+            tsid, verdict = self._judge_key(key, tenant, transform)
+            new_tsids.append(tsid)
+            drops.append(verdict)
+        sp.append_ids(new_tsids, drops)
+
+    def reset_columnar_spaces(self) -> None:
+        """Invalidate all cached raw-key -> TSID verdicts (call after the
+        ingest transform config — relabel rules, series limits — changes).
+        In-flight PendingChunks keep the old space objects alive."""
+        with self._lock:
+            spaces = list(self._cspaces.values())
+            self._cspaces = {}
+        for sp in spaces:
+            sp.close()
 
     def min_appended_since(self, version: int):
         """Minimum timestamp inserted after data_version `version`, or None

@@ -76,6 +76,31 @@ class Table:
         for name, rs in by_part.items():
             self.partition_for_ts(rs[0][1]).add_rows(rs)
 
+    def add_rows_columnar(self, space, ids, tss, vals) -> None:
+        """Columnar batch -> monthly partitions. The common case (whole
+        batch inside one month) routes with two scalar checks; straddling
+        batches split by partition name over the distinct days."""
+        from .partition import PendingChunk
+        n = int(ids.size)
+        if n == 0:
+            return
+        t_lo = int(tss.min())
+        t_hi = int(tss.max())
+        lo_name = partition_name_for_ts(t_lo)
+        if partition_name_for_ts(t_hi) == lo_name:
+            self.partition_for_ts(t_lo).add_rows_columnar(
+                PendingChunk(space, ids, tss, vals))
+            return
+        days = tss // 86_400_000
+        by_name: dict[str, list[int]] = {}
+        for d in np.unique(days):
+            by_name.setdefault(
+                partition_name_for_ts(int(d) * 86_400_000), []).append(int(d))
+        for name, ds in by_name.items():
+            mask = np.isin(days, ds)
+            self.partition_for_ts(int(ds[0]) * 86_400_000).add_rows_columnar(
+                PendingChunk(space, ids[mask], tss[mask], vals[mask]))
+
     def partitions_for_range(self, min_ts: int, max_ts: int) -> list[Partition]:
         with self._lock:
             out = []
