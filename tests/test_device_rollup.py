@@ -35,7 +35,7 @@ def make_series(rng, n, kind="gauge", interval=15_000, jitter=True):
 CFG = RollupConfig(start=START + 600_000, end=START + 1_800_000,
                    step=60_000, window=300_000)
 
-FUNCS = list(rollup_np.SUPPORTED)
+FUNCS = list(rollup_np.CORE_SUPPORTED)
 
 
 @pytest.fixture(scope="module")
@@ -251,7 +251,7 @@ class TestRollupBatchVsLoop:
         for seed in (0, 1):
             series = self._mk_series(seed)
             for c in (cfg, cfg2):
-                for func in rollup_np.SUPPORTED:
+                for func in rollup_np.CORE_SUPPORTED:
                     batch = rollup_np.rollup_batch(func, series, c)
                     assert batch is not None, func
                     # stddev/stdvar go through prefix sums: zero-variance
@@ -331,6 +331,55 @@ class TestFusedDeviceAggr:
             np.testing.assert_allclose(dm[k], hm[k], rtol=1e-6, atol=1e-6,
                                        equal_nan=True, err_msg=q)
 
+
+    @pytest.mark.parametrize("q", [
+        "topk(3, rate(fm[5m]))",
+        "bottomk(3, rate(fm[5m]))",
+        "topk(5, fm)",
+        "bottomk(120, rate(fm[5m]))",        # k > S: keep everything
+        "topk_max(4, rate(fm[5m]))",
+        "topk_min(4, increase(fm[3m]))",
+        "topk_avg(6, rate(fm[5m]))",
+        "topk_median(4, rate(fm[5m]))",
+        "topk_last(4, last_over_time(fm[2m]))",
+        "bottomk_max(4, rate(fm[5m]))",
+        "bottomk_avg(3, rate(fm[5m]))",
+        "topk(0, rate(fm[5m]))",
+    ])
+    def test_topk_matches_host(self, store, q):
+        """Device topk selection (topk_select_tile/rank_tile) must pick the
+        same series with the same masked values as _eval_topk_family."""
+        import numpy as np
+        from victoriametrics_tpu.query.exec import exec_query
+        from victoriametrics_tpu.query.tpu_engine import TPUEngine
+        from victoriametrics_tpu.query.types import EvalConfig
+        T0 = 1_753_700_000_000
+        kw = dict(start=T0 - 300_000, end=T0, step=60_000, storage=store)
+        host = exec_query(EvalConfig(**kw), q)
+        dev = exec_query(EvalConfig(**kw, tpu=TPUEngine(min_series=4)), q)
+        assert len(dev) == len(host)
+        hm = {r.metric_name.marshal(): r.values for r in host}
+        dm = {r.metric_name.marshal(): r.values for r in dev}
+        assert set(hm) == set(dm)
+        for k in hm:
+            np.testing.assert_allclose(dm[k], hm[k], rtol=1e-6, atol=1e-6,
+                                       equal_nan=True, err_msg=q)
+
+    def test_topk_decline_rolls_back_sample_count(self, store):
+        """A device decline (min_series too high) must not double-count
+        samples against maxSamplesPerQuery when the host path re-fetches."""
+        from victoriametrics_tpu.query.exec import exec_query
+        from victoriametrics_tpu.query.tpu_engine import TPUEngine
+        from victoriametrics_tpu.query.types import EvalConfig
+        T0 = 1_753_700_000_000
+        # 96 series x <=60 samples: cap at ~1.5x one fetch — double
+        # counting would blow it
+        kw = dict(start=T0 - 300_000, end=T0, step=60_000, storage=store,
+                  max_samples_per_query=9_000)
+        out = exec_query(EvalConfig(**kw, tpu=TPUEngine(min_series=10_000)),
+                         "topk(3, rate(fm[5m]))")
+        host = exec_query(EvalConfig(**kw), "topk(3, rate(fm[5m]))")
+        assert len(out) == len(host) > 0
 
     def test_fused_warm_path_matches(self, store):
         """Second run hits the aux/resident-tile shortcut and must agree."""

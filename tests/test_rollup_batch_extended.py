@@ -1,0 +1,164 @@
+"""Differential tests: the vectorized long-tail rollups in
+rollup_batch_packed vs their per-series twins (query/rollup_funcs
+GENERIC_FUNCS run under generic_rollup) — same inputs, same windows, same
+mpi-gated prevValue (reference doInternal semantics, rollup.go:688-960)."""
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.ops import rollup_np
+from victoriametrics_tpu.ops.rollup_np import RollupConfig
+from victoriametrics_tpu.query.rollup_funcs import rollup_series
+
+T0 = 1_753_700_000_000
+
+# (func, args) cases; None args means ()
+CASES = [
+    ("sum2_over_time", ()),
+    ("range_over_time", ()),
+    ("geomean_over_time", ()),
+    ("count_eq_over_time", (5.0,)),
+    ("count_ne_over_time", (5.0,)),
+    ("count_le_over_time", (10.0,)),
+    ("count_gt_over_time", (10.0,)),
+    ("share_eq_over_time", (5.0,)),
+    ("share_le_over_time", (10.0,)),
+    ("share_gt_over_time", (10.0,)),
+    ("sum_eq_over_time", (5.0,)),
+    ("sum_le_over_time", (10.0,)),
+    ("sum_gt_over_time", (10.0,)),
+    ("resets", ()),
+    ("increases_over_time", ()),
+    ("decreases_over_time", ()),
+    ("ascent_over_time", ()),
+    ("descent_over_time", ()),
+    ("integrate", ()),
+    ("duration_over_time", (120.0,)),
+    ("duration_over_time", ()),
+    ("rate_over_sum", ()),
+    ("ideriv", ()),
+    ("changes_prometheus", ()),
+    ("delta_prometheus", ()),
+    ("increase_prometheus", ()),
+    ("rate_prometheus", ()),
+    ("predict_linear", (300.0,)),
+    ("predict_linear", (0.0,)),
+    ("zscore_over_time", ()),
+    ("hoeffding_bound_lower", (0.95,)),
+    ("hoeffding_bound_upper", (0.95,)),
+    ("hoeffding_bound_upper", (2.0,)),   # out-of-range phi -> bound 0
+    ("quantile_over_time", (0.5,)),
+    ("quantile_over_time", (0.9,)),
+    ("quantile_over_time", (-0.5,)),     # -> -inf on non-empty windows
+    ("quantile_over_time", (1.5,)),      # -> +inf
+    ("median_over_time", ()),
+    ("mad_over_time", ()),
+    ("iqr_over_time", ()),
+    ("outlier_iqr_over_time", ()),
+    ("tmin_over_time", ()),
+    ("tmax_over_time", ()),
+    ("distinct_over_time", ()),
+    ("mode_over_time", ()),
+    ("tlast_change_over_time", ()),
+    ("timestamp_with_name", ()),
+]
+
+
+def make_series(rng, s, kind="gauge"):
+    """Jittered scrape series with gaps; values chosen so eq-comparisons
+    and mode/distinct see repeats."""
+    n = rng.integers(5, 120)
+    gaps = rng.integers(10_000, 20_000, size=n)
+    # a couple of long gaps so some windows are empty / prev gets gated
+    gaps[rng.integers(0, n, size=2)] += 200_000
+    ts = T0 + np.cumsum(gaps)
+    if kind == "counter":
+        vals = np.cumsum(rng.integers(0, 8, size=n)).astype(np.float64)
+        if n > 10:
+            vals[n // 2:] -= vals[n // 2]  # counter reset
+    else:
+        vals = rng.integers(1, 20, size=n).astype(np.float64)
+    return ts.astype(np.int64), vals
+
+
+def pack(series):
+    S = len(series)
+    counts = np.array([t.size for t, _ in series], dtype=np.int64)
+    N = int(counts.max())
+    ts2 = np.full((S, N), np.iinfo(np.int64).max, dtype=np.int64)
+    v2 = np.zeros((S, N))
+    for i, (t, v) in enumerate(series):
+        ts2[i, :t.size] = t
+        v2[i, :v.size] = v
+    return ts2, v2, counts
+
+
+@pytest.mark.parametrize("func,args", CASES,
+                         ids=[f"{f}-{a}" for f, a in CASES])
+@pytest.mark.parametrize("kind", ["gauge", "counter"])
+def test_matches_per_series(func, args, kind):
+    if func == "geomean_over_time" and kind == "counter":
+        pytest.skip("counters contain zeros: packed path defers (tested in "
+                    "test_geomean_zero_falls_back)")
+    rng = np.random.default_rng(hash((func, args, kind)) % 2**32)
+    series = [make_series(rng, s, kind) for s in range(14)]
+    cfg = RollupConfig(start=T0 + 60_000, end=T0 + 1_500_000,
+                       step=30_000, window=90_000)
+    got = rollup_np.rollup_batch(func, series, cfg, args)
+    assert got is not None, f"{func} fell back unexpectedly"
+    for i, (t, v) in enumerate(series):
+        want = rollup_series(func, t, v, cfg, args)
+        np.testing.assert_allclose(
+            got[i], want, rtol=1e-9, atol=1e-9, equal_nan=True,
+            err_msg=f"{func}{args} series {i}")
+
+
+def test_geomean_zero_falls_back():
+    ts = T0 + np.arange(10, dtype=np.int64) * 15_000
+    vals = np.array([1.0, 2, 0, 4, 5, 6, 7, 8, 9, 10])
+    cfg = RollupConfig(start=T0, end=T0 + 300_000, step=30_000, window=0)
+    assert rollup_np.rollup_batch("geomean_over_time", [(ts, vals)] * 9,
+                                  cfg) is None
+
+
+def test_geomean_negative_values_match():
+    rng = np.random.default_rng(3)
+    series = []
+    for _ in range(10):
+        t, v = make_series(rng, 0)
+        v = v - 10.0
+        v[v == 0] = 1.0
+        series.append((t, v))
+    cfg = RollupConfig(start=T0 + 60_000, end=T0 + 900_000,
+                       step=30_000, window=90_000)
+    got = rollup_np.rollup_batch("geomean_over_time", series, cfg)
+    for i, (t, v) in enumerate(series):
+        want = rollup_series("geomean_over_time", t, v, cfg, ())
+        np.testing.assert_allclose(got[i], want, rtol=1e-9, equal_nan=True)
+
+
+def test_batch_supported_validation():
+    assert rollup_np.batch_supported("quantile_over_time", (0.5,))
+    assert not rollup_np.batch_supported("quantile_over_time", ())
+    assert not rollup_np.batch_supported("quantile_over_time", ("x",))
+    assert rollup_np.batch_supported("duration_over_time", ())
+    assert rollup_np.batch_supported("duration_over_time", (60.0,))
+    assert not rollup_np.batch_supported("holt_winters", (0.5, 0.5))
+    assert rollup_np.batch_supported("rate", ())
+    assert not rollup_np.batch_supported("rate", (1.0,))
+
+
+def test_instant_query_grid():
+    # start == end (instant query): mpi falls back to step for everyone
+    rng = np.random.default_rng(11)
+    series = [make_series(rng, s) for s in range(10)]
+    cfg = RollupConfig(start=T0 + 600_000, end=T0 + 600_000,
+                       step=60_000, window=300_000)
+    for func, args in [("resets", ()), ("quantile_over_time", (0.75,)),
+                       ("predict_linear", (60.0,)), ("zscore_over_time", ())]:
+        got = rollup_np.rollup_batch(func, series, cfg, args)
+        for i, (t, v) in enumerate(series):
+            want = rollup_series(func, t, v, cfg, args)
+            np.testing.assert_allclose(got[i], want, rtol=1e-9, atol=1e-9,
+                                       equal_nan=True,
+                                       err_msg=f"{func} instant")

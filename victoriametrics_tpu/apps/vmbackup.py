@@ -141,6 +141,230 @@ class S3Remote(RemoteFS):
                 raise
 
 
+class GcsRemote(RemoteFS):
+    """gs://bucket/prefix destination (lib/backup/gcsremote/gcs.go analog)
+    over the GCS JSON/XML-free REST API. Auth: explicit bearer token
+    (GCS_ACCESS_TOKEN / token kwarg) or the GCE metadata server — the
+    standard on-GCP path; `endpoint` points it at fake-gcs-server-style
+    local fakes."""
+
+    def __init__(self, bucket: str, prefix: str, endpoint: str = "",
+                 token: str = ""):
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.endpoint = (endpoint.rstrip("/") if endpoint
+                         else "https://storage.googleapis.com")
+        self._token = token or os.environ.get("GCS_ACCESS_TOKEN", "")
+        self._meta_token_exp = 0.0
+
+    def _auth(self) -> dict:
+        if not self._token or self._meta_token_exp:
+            import time as _t
+            if self._meta_token_exp and _t.time() < self._meta_token_exp - 60:
+                return {"Authorization": f"Bearer {self._token}"}
+            try:
+                req = urllib.request.Request(
+                    "http://metadata.google.internal/computeMetadata/v1/"
+                    "instance/service-accounts/default/token",
+                    headers={"Metadata-Flavor": "Google"})
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    tok = json.loads(r.read())
+                self._token = tok["access_token"]
+                self._meta_token_exp = _t.time() + tok.get("expires_in", 300)
+            except Exception:
+                pass  # anonymous (public buckets / auth-free fakes)
+        return {"Authorization": f"Bearer {self._token}"} if self._token \
+            else {}
+
+    def _key(self, rel: str) -> str:
+        return "/".join(x for x in (self.prefix, rel) if x)
+
+    def _call(self, method: str, url: str, body: bytes | None = None,
+              headers: dict | None = None) -> bytes:
+        h = dict(headers or {})
+        h.update(self._auth())
+        req = urllib.request.Request(url, data=body, headers=h,
+                                     method=method)
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.read()
+
+    def list_files(self) -> dict[str, int]:
+        import urllib.parse
+        out: dict[str, int] = {}
+        prefix = self._key("")
+        token = ""
+        while True:
+            q = "prefix=" + urllib.parse.quote(
+                prefix + "/" if prefix else "", safe="")
+            if token:
+                q += "&pageToken=" + urllib.parse.quote(token)
+            data = self._call(
+                "GET", f"{self.endpoint}/storage/v1/b/{self.bucket}/o?{q}")
+            resp = json.loads(data)
+            for item in resp.get("items", []):
+                name = item["name"]
+                rel = name[len(prefix) + 1:] if prefix else name
+                out[rel] = int(item["size"])
+            token = resp.get("nextPageToken", "")
+            if not token:
+                break
+        return out
+
+    def upload(self, rel: str, src_path: str):
+        import urllib.parse
+        with open(src_path, "rb") as f:
+            body = f.read()
+        self._call(
+            "POST",
+            f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o"
+            f"?uploadType=media&name=" +
+            urllib.parse.quote(self._key(rel), safe=""),
+            body, {"Content-Type": "application/octet-stream"})
+
+    def download(self, rel: str, dst_path: str):
+        import urllib.parse
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        data = self._call(
+            "GET", f"{self.endpoint}/storage/v1/b/{self.bucket}/o/" +
+            urllib.parse.quote(self._key(rel), safe="") + "?alt=media")
+        with open(dst_path, "wb") as f:
+            f.write(data)
+
+    def delete(self, rel: str):
+        import urllib.parse
+        try:
+            self._call(
+                "DELETE", f"{self.endpoint}/storage/v1/b/{self.bucket}/o/" +
+                urllib.parse.quote(self._key(rel), safe=""))
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+class AzblobRemote(RemoteFS):
+    """azblob://container/prefix destination (lib/backup/azremote/azblob.go
+    analog). Auth: SAS token (AZURE_STORAGE_SAS_TOKEN) or SharedKey request
+    signing with AZURE_STORAGE_ACCOUNT_{NAME,KEY} — pure hmac/hashlib, no
+    SDK. `endpoint` override (AZURE_STORAGE_DOMAIN analog) points it at
+    Azurite-style local fakes."""
+
+    API_VERSION = "2021-06-08"
+
+    def __init__(self, container: str, prefix: str, account: str = "",
+                 key: str = "", sas: str = "", endpoint: str = ""):
+        self.container = container
+        self.prefix = prefix.strip("/")
+        self.account = account or os.environ.get(
+            "AZURE_STORAGE_ACCOUNT_NAME", "")
+        self.key = key or os.environ.get("AZURE_STORAGE_ACCOUNT_KEY", "")
+        self.sas = (sas or os.environ.get(
+            "AZURE_STORAGE_SAS_TOKEN", "")).lstrip("?")
+        self.endpoint = (endpoint.rstrip("/") if endpoint else
+                         f"https://{self.account}.blob.core.windows.net")
+
+    def _key_of(self, rel: str) -> str:
+        return "/".join(x for x in (self.prefix, rel) if x)
+
+    def _signed_headers(self, method: str, url: str, body_len: int,
+                        headers: dict) -> dict:
+        """SharedKey authorization (the x-ms-date + canonicalized string
+        HMAC-SHA256 scheme)."""
+        import base64
+        import hashlib
+        import hmac
+        import urllib.parse
+        from email.utils import formatdate
+        h = dict(headers)
+        h["x-ms-date"] = formatdate(usegmt=True)
+        h["x-ms-version"] = self.API_VERSION
+        if not self.key:
+            return h
+        parsed = urllib.parse.urlsplit(url)
+        canon_headers = "".join(
+            f"{k.lower()}:{v}\n" for k, v in
+            sorted((k, v) for k, v in h.items()
+                   if k.lower().startswith("x-ms-")))
+        canon_res = f"/{self.account}{parsed.path}"
+        if parsed.query:
+            params = urllib.parse.parse_qs(parsed.query,
+                                           keep_blank_values=True)
+            for k in sorted(params):
+                canon_res += f"\n{k.lower()}:{','.join(params[k])}"
+        cl = str(body_len) if body_len else ""
+        to_sign = (f"{method}\n\n\n{cl}\n\n"
+                   f"{h.get('Content-Type', '')}\n\n\n\n\n\n\n"
+                   f"{canon_headers}{canon_res}")
+        sig = base64.b64encode(hmac.new(
+            base64.b64decode(self.key), to_sign.encode("utf-8"),
+            hashlib.sha256).digest()).decode()
+        h["Authorization"] = f"SharedKey {self.account}:{sig}"
+        return h
+
+    def _call(self, method: str, path: str, query: str = "",
+              body: bytes | None = None,
+              headers: dict | None = None) -> bytes:
+        import urllib.parse
+        q = query
+        if self.sas:
+            q = (q + "&" if q else "") + self.sas
+        url = f"{self.endpoint}{path}" + (f"?{q}" if q else "")
+        h = self._signed_headers(method, url, len(body) if body else 0,
+                                 headers or {})
+        req = urllib.request.Request(url, data=body, headers=h,
+                                     method=method)
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.read()
+
+    def list_files(self) -> dict[str, int]:
+        import urllib.parse
+        import xml.etree.ElementTree as ET
+        out: dict[str, int] = {}
+        prefix = self._key_of("")
+        marker = ""
+        while True:
+            q = "restype=container&comp=list&prefix=" + urllib.parse.quote(
+                prefix + "/" if prefix else "", safe="")
+            if marker:
+                q += "&marker=" + urllib.parse.quote(marker)
+            data = self._call("GET", f"/{self.container}", q)
+            root = ET.fromstring(data)
+            for b in root.iter("Blob"):
+                name = b.find("Name").text
+                size = int(b.find("Properties/Content-Length").text)
+                rel = name[len(prefix) + 1:] if prefix else name
+                out[rel] = size
+            nm = root.find("NextMarker")
+            marker = (nm.text or "") if nm is not None else ""
+            if not marker:
+                break
+        return out
+
+    def _blob_path(self, rel: str) -> str:
+        import urllib.parse
+        return f"/{self.container}/" + urllib.parse.quote(
+            self._key_of(rel), safe="/")
+
+    def upload(self, rel: str, src_path: str):
+        with open(src_path, "rb") as f:
+            body = f.read()
+        self._call("PUT", self._blob_path(rel), "", body,
+                   {"x-ms-blob-type": "BlockBlob",
+                    "Content-Type": "application/octet-stream"})
+
+    def download(self, rel: str, dst_path: str):
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        data = self._call("GET", self._blob_path(rel))
+        with open(dst_path, "wb") as f:
+            f.write(data)
+
+    def delete(self, rel: str):
+        try:
+            self._call("DELETE", self._blob_path(rel))
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
 def open_remote(dst: str, **kw) -> RemoteFS:
     if dst.startswith("fs://"):
         return FsRemote(dst[5:])
@@ -148,8 +372,17 @@ def open_remote(dst: str, **kw) -> RemoteFS:
         rest = dst[5:]
         bucket, _, prefix = rest.partition("/")
         return S3Remote(bucket, prefix, **kw)
+    for scheme in ("gs://", "gcs://"):
+        if dst.startswith(scheme):
+            rest = dst[len(scheme):]
+            bucket, _, prefix = rest.partition("/")
+            return GcsRemote(bucket, prefix, **kw)
+    if dst.startswith("azblob://"):
+        rest = dst[9:]
+        container, _, prefix = rest.partition("/")
+        return AzblobRemote(container, prefix, **kw)
     raise ValueError(f"unsupported backup destination {dst!r} "
-                     "(supported: fs://, s3://; gcs/azure not implemented)")
+                     "(supported: fs://, s3://, gs://, azblob://)")
 
 
 def _local_files(root: str) -> dict[str, int]:

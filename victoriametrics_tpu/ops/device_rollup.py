@@ -683,6 +683,64 @@ def pack_series(series: list[tuple[np.ndarray, np.ndarray]], start_ms: int,
     return ts, vals, counts
 
 
+@functools.partial(jax.jit, static_argnames=("func", "cfg", "k", "bottom"))
+def topk_select_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
+                     counts: jnp.ndarray, cfg: RollupConfig, k: int,
+                     bottom: bool, min_ts=MIN_TS_NONE):
+    """Per-timestamp topk/bottomk selection over a rolled tile: the [S, T]
+    rollup never leaves the device — only [T, k] winner indices (+ NaN
+    flags) cross the link, and the caller gathers just the selected rows
+    (aggr.go topk/bottomk; host twin aggr_funcs.topk_mask_per_ts).
+    Returns (rolled [device-resident], idx [T, k], sel_nan [T, k])."""
+    rolled = rollup_tile(func, ts, values, counts, cfg, min_ts)
+    bad = jnp.isnan(rolled)
+    key = jnp.where(bad, -jnp.inf, -rolled if bottom else rolled)
+    _, idx = jax.lax.top_k(key.T, k)                   # [T, k]
+    sel_nan = jnp.take_along_axis(bad.T, idx, axis=1)
+    return rolled, idx, sel_nan
+
+
+@functools.partial(jax.jit, static_argnames=("func", "kind", "cfg"))
+def rank_tile(func: str, kind: str, ts: jnp.ndarray, values: jnp.ndarray,
+              counts: jnp.ndarray, cfg: RollupConfig, min_ts=MIN_TS_NONE):
+    """topk_<kind>/bottomk_<kind> ranking: the whole-series statistic
+    (aggr_funcs.series_rank_metric twin) computed on device — D2H is one
+    float per series; the caller gathers only the k selected rows."""
+    rolled = rollup_tile(func, ts, values, counts, cfg, min_ts)
+    bad = jnp.isnan(rolled)
+    n = jnp.sum(~bad, axis=1)
+    if kind == "max":
+        r = jnp.max(jnp.where(bad, -jnp.inf, rolled), axis=1)
+    elif kind == "min":
+        r = jnp.min(jnp.where(bad, jnp.inf, rolled), axis=1)
+    elif kind == "avg":
+        r = jnp.sum(jnp.where(bad, 0.0, rolled), axis=1) / \
+            jnp.maximum(n, 1).astype(rolled.dtype)
+    elif kind == "median":
+        sv = jnp.sort(jnp.where(bad, jnp.inf, rolled), axis=1)
+        pos = 0.5 * jnp.maximum(n - 1, 0).astype(rolled.dtype)
+        j0 = jnp.floor(pos).astype(jnp.int32)
+        j1 = jnp.minimum(j0 + 1, jnp.maximum(n - 1, 0).astype(jnp.int32))
+        a = jnp.take_along_axis(sv, j0[:, None], axis=1)[:, 0]
+        b = jnp.take_along_axis(sv, j1[:, None], axis=1)[:, 0]
+        r = a + (pos - j0.astype(rolled.dtype)) * (b - a)
+    elif kind == "last":
+        T = rolled.shape[1]
+        j = T - 1 - jnp.argmax(jnp.flip(~bad, axis=1), axis=1)
+        r = jnp.take_along_axis(rolled, j[:, None], axis=1)[:, 0]
+    else:
+        raise ValueError(f"unknown rank kind {kind!r}")
+    nan = jnp.asarray(jnp.nan, rolled.dtype)
+    return rolled, jnp.where(n == 0, nan, r)
+
+
+@jax.jit
+def take_rows(rolled: jnp.ndarray, sel: jnp.ndarray) -> jnp.ndarray:
+    """Row gather on a device-resident rolled tile (the D2H tail of the
+    topk kernels: only selected rows come back)."""
+    return jnp.take(rolled, sel, axis=0)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("rollup_func", "cfg", "num_groups",
                                     "max_group"))

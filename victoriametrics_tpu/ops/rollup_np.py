@@ -284,8 +284,9 @@ def rollup(func: str, ts: np.ndarray, values: np.ndarray, cfg: RollupConfig
     return out
 
 
-# Rollup functions the oracle (and thus the device kernels) understand.
-SUPPORTED = (
+# Core funcs: per-series oracle above + device kernels in
+# ops/device_rollup (DEVICE_FUNCS there mirrors this tuple).
+CORE_SUPPORTED = (
     "count_over_time", "present_over_time", "sum_over_time", "min_over_time",
     "max_over_time", "avg_over_time", "stddev_over_time", "stdvar_over_time",
     "first_over_time", "last_over_time", "default_rollup", "tfirst_over_time",
@@ -294,8 +295,59 @@ SUPPORTED = (
     "lifetime", "scrape_interval",
 )
 
+# Long-tail funcs vectorized ONLY in rollup_batch_packed (per-series
+# semantics live in query/rollup_funcs.GENERIC_FUNCS; differential-tested
+# side by side). Cumsum/gather formulations unless noted.
+EXTENDED_SUPPORTED = (
+    "sum2_over_time", "range_over_time", "geomean_over_time",
+    "count_eq_over_time", "count_ne_over_time", "count_le_over_time",
+    "count_gt_over_time", "share_eq_over_time", "share_le_over_time",
+    "share_gt_over_time", "sum_eq_over_time", "sum_le_over_time",
+    "sum_gt_over_time", "resets", "increases_over_time",
+    "decreases_over_time", "ascent_over_time", "descent_over_time",
+    "integrate", "duration_over_time", "rate_over_sum", "ideriv",
+    "changes_prometheus", "delta_prometheus", "increase_prometheus",
+    "rate_prometheus", "predict_linear", "zscore_over_time",
+    "hoeffding_bound_lower", "hoeffding_bound_upper", "timestamp_with_name",
+    # windowed order statistics (chunked (S, Tc, W) gather + nan-reductions)
+    "quantile_over_time", "median_over_time", "mad_over_time",
+    "iqr_over_time", "outlier_iqr_over_time", "tmin_over_time",
+    "tmax_over_time", "distinct_over_time", "mode_over_time",
+    "tlast_change_over_time",
+)
 
-def rollup_batch(func: str, series: list, cfg: RollupConfig):
+# Every rollup the batched (vectorized multi-series) path understands.
+SUPPORTED = CORE_SUPPORTED + EXTENDED_SUPPORTED
+
+# exact positional-arg count per func (absent = 0 args)
+ARG_COUNTS = {
+    "quantile_over_time": 1, "count_eq_over_time": 1,
+    "count_ne_over_time": 1, "count_le_over_time": 1,
+    "count_gt_over_time": 1, "share_eq_over_time": 1,
+    "share_le_over_time": 1, "share_gt_over_time": 1,
+    "sum_eq_over_time": 1, "sum_le_over_time": 1, "sum_gt_over_time": 1,
+    "predict_linear": 1, "hoeffding_bound_lower": 1,
+    "hoeffding_bound_upper": 1,
+}
+
+
+def batch_supported(func: str, args: tuple = ()) -> bool:
+    """True when rollup_batch/rollup_batch_packed can run (func, args):
+    the eval gates call this instead of `not args and func in SUPPORTED`."""
+    if func not in SUPPORTED:
+        return False
+    want = ARG_COUNTS.get(func, 0)
+    if func == "duration_over_time":
+        if len(args) > 1:
+            return False
+    elif len(args) != want:
+        return False
+    return all(isinstance(a, (int, float, np.integer, np.floating))
+               for a in args)
+
+
+def rollup_batch(func: str, series: list, cfg: RollupConfig,
+                 args: tuple = ()):
     """Vectorized multi-series rollup: one (S, T) computation instead of a
     per-series/per-window Python loop — the host-side analog of the device
     tile kernels (ops/device_rollup.py). `series` is a list of (ts, values)
@@ -333,15 +385,20 @@ def rollup_batch(func: str, series: list, cfg: RollupConfig):
         v2 = np.zeros((S, N), dtype=np.float64)
         v2[mask] = np.concatenate([np.asarray(v, dtype=np.float64)
                                    for _, v in series])
-    return rollup_batch_packed(func, ts2, v2, counts, cfg)
+    return rollup_batch_packed(func, ts2, v2, counts, cfg, args)
 
 
 def rollup_batch_packed(func: str, ts2: np.ndarray, v2: np.ndarray,
-                        counts: np.ndarray, cfg: RollupConfig):
+                        counts: np.ndarray, cfg: RollupConfig,
+                        args: tuple = ()):
     """rollup_batch over pre-packed padded columns: ts2 (S, N) int64 padded
     with INT64_MAX, v2 (S, N) float64 (padding ignored), counts (S,).
     Entry point for callers that already hold packed columns (the columnar
     fetch path), skipping the per-series repack."""
+    if not batch_supported(func, args):
+        return None
+    if func == "timestamp_with_name":
+        func = "timestamp"  # same values; eval keeps the metric name
     S, N = ts2.shape
     out_ts = cfg.out_timestamps()
     T = out_ts.size
@@ -590,4 +647,351 @@ def rollup_batch_packed(func: str, ts2: np.ndarray, v2: np.ndarray,
             res = np.where(den != 0, (n * stv - st * sv) / den, np.nan)
             return np.where(have & (nwin >= 2), res, np.nan)
 
+    # ---- long-tail family (GENERIC_FUNCS semantics, vectorized) ----------
+    # Per-series twins: query/rollup_funcs.py window callables run under
+    # generic_rollup, whose prevValue is mpi-gated — every prev use below
+    # goes through gated_prev_mask() to match bit-for-bit.
+    validc = np.arange(N)[None, :] < counts[:, None]
+
+    def cum0(x):
+        return np.concatenate([np.zeros((S, 1)), np.cumsum(x, axis=1)],
+                              axis=1)
+
+    def wsum_of(c):
+        return (np.take_along_axis(c, hi, axis=1) -
+                np.take_along_axis(c, lo, axis=1))
+
+    def window_min_max():
+        mn_w = np.empty((S, T))
+        mx_w = np.empty((S, T))
+        for s in range(S):
+            arr_mn = np.concatenate([v2[s], [np.inf]])
+            arr_mx = np.concatenate([v2[s], [-np.inf]])
+            idx = np.stack([lo[s], hi[s]], axis=1).reshape(-1)
+            mn_w[s] = np.minimum.reduceat(arr_mn, idx)[::2]
+            mx_w[s] = np.maximum.reduceat(arr_mx, idx)[::2]
+        return mn_w, mx_w
+
+    with np.errstate(all="ignore"):
+        if func == "sum2_over_time":
+            return np.where(have, wsum_of(cum0(v2 * v2)), np.nan)
+
+        if func == "range_over_time":
+            mn_w, mx_w = window_min_max()
+            return np.where(have, mx_w - mn_w, np.nan)
+
+        if func in ("count_eq_over_time", "count_ne_over_time",
+                    "count_le_over_time", "count_gt_over_time",
+                    "share_eq_over_time", "share_le_over_time",
+                    "share_gt_over_time", "sum_eq_over_time",
+                    "sum_le_over_time", "sum_gt_over_time"):
+            x = float(args[0])
+            kind = func.split("_")[1]
+            ind = {"eq": v2 == x, "ne": v2 != x, "le": v2 <= x,
+                   "gt": v2 > x}[kind] & validc
+            if func.startswith("sum_"):
+                s = wsum_of(cum0(np.where(ind, v2, 0.0)))
+            else:
+                s = wsum_of(cum0(ind.astype(np.float64)))
+                if func.startswith("share_"):
+                    s = s / np.where(nwin > 0, nwin, 1)
+            return np.where(have, s, np.nan)
+
+        if func in ("resets", "increases_over_time", "decreases_over_time",
+                    "ascent_over_time", "descent_over_time"):
+            d = np.diff(v2, axis=1)
+            e = np.zeros((S, N))
+            if func in ("resets", "decreases_over_time"):
+                e[:, 1:] = (d < 0).astype(np.float64)
+            elif func == "increases_over_time":
+                e[:, 1:] = (d > 0).astype(np.float64)
+            elif func == "ascent_over_time":
+                e[:, 1:] = np.maximum(d, 0.0)
+            else:  # descent_over_time
+                e[:, 1:] = np.maximum(-d, 0.0)
+            e[~validc] = 0.0
+            ce = cum0(e)
+            gprev = gated_prev_mask()
+            start = np.minimum(lo + np.where(gprev, 0, 1), hi)
+            s = np.take_along_axis(ce, hi, axis=1) - \
+                np.take_along_axis(ce, start, axis=1)
+            return np.where(have, s, np.nan)
+
+        if func == "integrate":
+            # e[i] = v[i-1] * dt(i-1, i): the prev-pair term rides e[lo]
+            e = np.zeros((S, N))
+            e[:, 1:] = v2[:, :-1] * (np.diff(ts2, axis=1) / 1e3)
+            e[~validc] = 0.0
+            ce = cum0(e)
+            gprev = gated_prev_mask()
+            start = np.minimum(lo + np.where(gprev, 0, 1), hi)
+            s = np.take_along_axis(ce, hi, axis=1) - \
+                np.take_along_axis(ce, start, axis=1)
+            return np.where(have, s, np.nan)
+
+        if func == "duration_over_time":
+            e = np.zeros((S, N))
+            dms = np.diff(ts2, axis=1).astype(np.float64)
+            if args:
+                dms = np.where(dms <= float(args[0]) * 1e3, dms, 0.0)
+            e[:, 1:] = dms / 1e3
+            e[~validc] = 0.0
+            ce = cum0(e)
+            start = np.minimum(lo + 1, hi)  # strictly in-window pairs
+            s = np.take_along_axis(ce, hi, axis=1) - \
+                np.take_along_axis(ce, start, axis=1)
+            return np.where(have, s, np.nan)
+
+        if func == "rate_over_sum":
+            s1 = wsum_of(cum0(v2))
+            gprev = gated_prev_mask()
+            t_last = gather(ts2, last_i)
+            t_base = np.where(gprev, gather(ts2, pidx), gather(ts2, lo))
+            dt = (t_last - t_base) / 1e3
+            return np.where(have & (dt > 0), s1 / dt, np.nan)
+
+        if func == "geomean_over_time":
+            if bool(((v2 == 0) & validc).any()):
+                return None  # log-sum form breaks on zeros: per-series path
+            lg = np.where(validc, np.log(np.abs(v2)), 0.0)
+            s = wsum_of(cum0(lg))
+            return np.where(have,
+                            np.exp(s / np.where(nwin > 0, nwin, 1)), np.nan)
+
+        if func == "ideriv":
+            i2 = np.clip(hi - 2, 0, N - 1)
+            two = nwin >= 2
+            v_last = gather(v2, last_i)
+            t_last = gather(ts2, last_i)
+            dt2 = (t_last - gather(ts2, i2)) / 1e3
+            dv2 = v_last - gather(v2, i2)
+            gprev = gated_prev_mask()
+            dt1 = (t_last - gather(ts2, pidx)) / 1e3
+            dv1 = v_last - gather(v2, pidx)
+            r2 = np.where(dt2 > 0, dv2 / dt2, np.nan)
+            r1 = np.where(dt1 > 0, dv1 / dt1, np.nan)
+            res = np.where(two, r2,
+                           np.where((nwin == 1) & gprev, r1, np.nan))
+            return np.where(have, res, np.nan)
+
+        if func == "changes_prometheus":
+            ind = np.zeros((S, N))
+            ind[:, 1:] = (np.diff(v2, axis=1) != 0).astype(np.float64)
+            ind[~validc] = 0.0
+            cz = cum0(ind)
+            start = np.minimum(lo + 1, hi)
+            s = np.take_along_axis(cz, hi, axis=1) - \
+                np.take_along_axis(cz, start, axis=1)
+            return np.where(have, s, np.nan)
+
+        if func in ("delta_prometheus", "increase_prometheus",
+                    "rate_prometheus"):
+            arr = v2 if func == "delta_prometheus" \
+                else remove_counter_resets(v2)
+            d = gather(arr, last_i) - gather(arr, lo)
+            if func == "rate_prometheus":
+                d = d / (cfg.lookback / 1e3)
+            return np.where(have & (nwin >= 2), d, np.nan)
+
+        if func == "predict_linear":
+            t_rel = np.where(validc, (ts2 - cfg.start) / 1e3, 0.0)
+            vv = np.where(validc, v2, 0.0)
+            ct_, ctt = cum0(t_rel), cum0(t_rel * t_rel)
+            cv_, ctv = cum0(vv), cum0(t_rel * vv)
+            n = nwin.astype(np.float64)
+            st, sv = wsum_of(ct_), wsum_of(cv_)
+            stt, stv = wsum_of(ctt), wsum_of(ctv)
+            den = n * stt - st * st
+            k = np.where(den != 0, (n * stv - st * sv) / den, np.nan)
+            u0 = gather(ts2, lo)
+            b = sv / np.where(n > 0, n, 1) - \
+                k * (st / np.where(n > 0, n, 1) - (u0 - cfg.start) / 1e3)
+            dt = (out_ts[None, :] - u0) / 1e3 + float(args[0])
+            res = k * dt + b
+            return np.where(have & (nwin >= 2) & (den != 0), res, np.nan)
+
+        if func == "zscore_over_time":
+            s1 = wsum_of(cum0(v2))
+            n = np.where(nwin > 0, nwin, 1).astype(np.float64)
+            avg = s1 / n
+            shift = v2[:, :1]
+            vc = np.where(validc, v2 - shift, 0.0)
+            s1c = wsum_of(cum0(vc))
+            s2c = wsum_of(cum0(vc * vc))
+            var = np.maximum(s2c / n - (s1c / n) ** 2, 0.0)
+            sd = np.sqrt(var)
+            v_last = gather(v2, last_i)
+            t_last = gather(ts2, last_i)
+            gprev = gated_prev_mask()
+            t_first = gather(ts2, lo)
+            # scrape interval per _w_zscore: prev -> (t_last-pt)/n over n
+            # samples; else (t_last-t[0])/(n-1), needing >= 2 samples
+            si = np.where(gprev, (t_last - gather(ts2, pidx)) / 1e3 / n,
+                          (t_last - t_first) / 1e3 /
+                          np.maximum(nwin - 1, 1))
+            lag = (out_ts[None, :] - t_last) / 1e3
+            ok = have & (gprev | (nwin >= 2)) & (lag <= si)
+            d = v_last - avg
+            res = np.where(d == 0, 0.0, np.where(sd > 0, d / sd, np.nan))
+            return np.where(ok, res, np.nan)
+
+        if func in ("hoeffding_bound_lower", "hoeffding_bound_upper"):
+            phi = float(args[0])
+            s1 = wsum_of(cum0(v2))
+            n = np.where(nwin > 0, nwin, 1).astype(np.float64)
+            avg = s1 / n
+            mn_w, mx_w = window_min_max()
+            rng = mx_w - mn_w
+            if 0 < phi < 1:
+                bound = np.where(
+                    (nwin >= 2) & (rng != 0),
+                    rng * np.sqrt(np.log(1.0 / (1 - phi)) / (2 * n)), 0.0)
+            else:
+                bound = np.zeros((S, T))
+            if func == "hoeffding_bound_lower":
+                res = np.maximum(avg - bound, 0.0)
+            else:
+                res = avg + bound
+            return np.where(have, res, np.nan)
+
+        if func in ("quantile_over_time", "median_over_time",
+                    "mad_over_time", "iqr_over_time",
+                    "outlier_iqr_over_time", "tmin_over_time",
+                    "tmax_over_time", "distinct_over_time",
+                    "mode_over_time", "tlast_change_over_time"):
+            return _order_stat_batch(func, args, ts2, v2, counts, cfg,
+                                     out_ts, lo, hi, nwin, have, last_i,
+                                     pidx, gated_prev_mask, gather)
+
     return None
+
+
+def _order_stat_batch(func, args, ts2, v2, counts, cfg, out_ts, lo, hi,
+                      nwin, have, last_i, pidx, gated_prev_mask, gather):
+    """Windowed order statistics: windows are materialized as a chunked
+    (S, Tc, W) gather (NaN-padded) and reduced with nan-aware numpy ops —
+    the vectorized analog of per-window np.quantile/unique loops. Chunks
+    are sized to a flat element budget so wide windows degrade to smaller
+    T slices instead of blowing memory."""
+    S, N = ts2.shape
+    T = out_ts.size
+    phi = None
+    if func == "quantile_over_time":
+        phi = float(args[0])
+        if phi < 0:
+            return np.where(have, -np.inf, np.nan)
+        if phi > 1:
+            return np.where(have, np.inf, np.nan)
+    out = np.full((S, T), np.nan)
+    col_w = nwin.max(axis=0)  # worst-case window width per output step
+    budget = 4_000_000  # flat elements per chunk (~32MB f64)
+    t0 = 0
+    import warnings
+    with np.errstate(all="ignore"), warnings.catch_warnings():
+        # empty windows are legitimately all-NaN slices; `have` masks them
+        warnings.simplefilter("ignore", RuntimeWarning)
+        while t0 < T:
+            w = int(col_w[t0])
+            t1 = t0 + 1
+            wmax = max(w, 1)
+            while t1 < T:
+                nw = max(wmax, int(col_w[t1]))
+                if S * (t1 + 1 - t0) * nw > budget:
+                    break
+                wmax = nw
+                t1 += 1
+            tc = slice(t0, t1)
+            if col_w[tc].max() == 0:
+                t0 = t1
+                continue
+            idx = lo[:, tc, None] + np.arange(wmax)[None, None, :]
+            valid = idx < hi[:, tc, None]
+            flat = np.clip(idx, 0, N - 1) + \
+                (np.arange(S, dtype=np.int64) * N)[:, None, None]
+            wv = np.where(valid, np.take(v2.reshape(-1), flat), np.nan)
+            _order_stat_chunk(func, phi, out, tc, wv, valid, ts2, flat,
+                              v2, counts, lo, hi, nwin, have, last_i,
+                              pidx, gated_prev_mask, gather)
+            t0 = t1
+    return np.where(have, out, np.nan)
+
+
+def _order_stat_chunk(func, phi, out, tc, wv, valid, ts2, flat, v2,
+                      counts, lo, hi, nwin, have, last_i, pidx,
+                      gated_prev_mask, gather):
+    S = out.shape[0]
+
+    def q_sorted(sv, p):
+        # np.quantile's linear interpolation over the first m valid (sorted)
+        # entries per window; NaN padding sorts to the end. nanquantile
+        # itself degrades to apply_along_axis on NaN-bearing 3-D input
+        # (~1000x slower) — this is the vectorized equivalent.
+        m = nwin[:, tc]
+        pos = p * np.maximum(m - 1, 0)
+        j0 = np.floor(pos).astype(np.int64)
+        frac = pos - j0
+        j1 = np.minimum(j0 + 1, np.maximum(m - 1, 0))
+        a = np.take_along_axis(sv, j0[:, :, None], axis=2)[:, :, 0]
+        b = np.take_along_axis(sv, j1[:, :, None], axis=2)[:, :, 0]
+        return a * (1 - frac) + b * frac
+
+    if func in ("quantile_over_time", "median_over_time"):
+        out[:, tc] = q_sorted(np.sort(wv, axis=2),
+                              phi if func == "quantile_over_time" else 0.5)
+    elif func == "mad_over_time":
+        med = q_sorted(np.sort(wv, axis=2), 0.5)
+        out[:, tc] = q_sorted(np.sort(np.abs(wv - med[:, :, None]), axis=2),
+                              0.5)
+    elif func == "iqr_over_time":
+        sv = np.sort(wv, axis=2)
+        out[:, tc] = q_sorted(sv, 0.75) - q_sorted(sv, 0.25)
+    elif func == "outlier_iqr_over_time":
+        sv = np.sort(wv, axis=2)
+        q25, q75 = q_sorted(sv, 0.25), q_sorted(sv, 0.75)
+        iqr = 1.5 * (q75 - q25)
+        v_last = gather(v2, last_i)[:, tc]
+        hit = (v_last > q75 + iqr) | (v_last < q25 - iqr)
+        out[:, tc] = np.where((nwin[:, tc] >= 2) & hit, v_last, np.nan)
+    elif func in ("tmin_over_time", "tmax_over_time"):
+        fill = np.inf if func == "tmin_over_time" else -np.inf
+        wf = np.where(valid, wv, fill)
+        j = (np.argmin(wf, axis=2) if func == "tmin_over_time"
+             else np.argmax(wf, axis=2))
+        tflat = np.take(ts2.reshape(-1),
+                        np.take_along_axis(flat, j[:, :, None],
+                                           axis=2)[:, :, 0])
+        out[:, tc] = tflat / 1e3
+    elif func == "distinct_over_time":
+        sv = np.sort(wv, axis=2)  # NaN sorts to the end
+        fresh = np.ones(sv.shape, bool)
+        fresh[:, :, 1:] = sv[:, :, 1:] != sv[:, :, :-1]
+        out[:, tc] = (fresh & ~np.isnan(sv)).sum(axis=2)
+    elif func == "mode_over_time":
+        sv = np.sort(wv, axis=2)
+        W = sv.shape[2]
+        newrun = np.ones(sv.shape, bool)
+        newrun[:, :, 1:] = sv[:, :, 1:] != sv[:, :, :-1]
+        pos = np.arange(W)
+        first = np.maximum.accumulate(np.where(newrun, pos, 0), axis=2)
+        # run length at each position's run start = (next run start) - start;
+        # count for position i = i - first[i] + 1, max at the run's END
+        cnt = pos[None, None, :] - first + 1
+        cnt = np.where(np.isnan(sv), -1, cnt)
+        j = np.argmax(cnt, axis=2)
+        out[:, tc] = np.take_along_axis(sv, j[:, :, None], axis=2)[:, :, 0]
+    elif func == "tlast_change_over_time":
+        v_last = gather(v2, last_i)[:, tc]
+        neq = valid & (wv != v_last[:, :, None])
+        W = wv.shape[2]
+        jj = np.where(neq, np.arange(W)[None, None, :], -1).max(axis=2)
+        changed = jj >= 0
+        tflat = np.take(ts2.reshape(-1),
+                        np.take_along_axis(flat,
+                                           np.clip(jj + 1, 0, W - 1)
+                                           [:, :, None], axis=2)[:, :, 0])
+        t_first = gather(ts2, lo)[:, tc]
+        gprev = gated_prev_mask()[:, tc]
+        pv = gather(v2, pidx)[:, tc]
+        no_change_val = np.where(~gprev | (pv != v_last),
+                                 t_first / 1e3, np.nan)
+        out[:, tc] = np.where(changed, tflat / 1e3, no_change_val)

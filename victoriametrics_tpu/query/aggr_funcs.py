@@ -189,9 +189,11 @@ def topk_mask_per_ts(m: np.ndarray, k: int, bottom: bool) -> np.ndarray:
     mask = np.zeros((S, T), dtype=bool)
     if k == 0:
         return mask
+    # ties keep the LOWEST series index (deterministic, and identical to
+    # jax.lax.top_k so the device selection path agrees bit-for-bit)
     key = np.where(np.isnan(m), -np.inf if not bottom else np.inf, m)
-    order = np.argsort(key, axis=0)
-    sel = order[:k] if bottom else order[-k:]
+    order = np.argsort(key if bottom else -key, axis=0, kind="stable")
+    sel = order[:k]
     for j in range(T):
         mask[sel[:, j], j] = True
     mask &= ~np.isnan(m)

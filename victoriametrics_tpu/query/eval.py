@@ -342,7 +342,7 @@ def _rollup_from_storage_cols(ec: EvalConfig, func: str, re_: RollupExpr,
         if per_series_cfg is None:
             qt = ec.tracer.new_child("host rollup %s (columns)", func)
             rows = rollup_np.rollup_batch_packed(func, cols.ts, cols.vals,
-                                                 cols.counts, cfg)
+                                                 cols.counts, cfg, args)
             if rows is not None:
                 qt.donef("%d series (packed)", cols.n_series)
                 return _cache_rollup(ec, ckey,
@@ -401,8 +401,8 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
             return rows
 
     from ..ops import rollup_np as _rnp
-    if (ec.tpu is None and not args and ec.storage is not None
-            and func in _rnp.SUPPORTED
+    if (ec.tpu is None and ec.storage is not None
+            and _rnp.batch_supported(func, args)
             and getattr(ec.storage, "search_columns", None) is not None):
         # columnar host path: batched decode -> packed rollup, no
         # per-series materialization (device tiles go through the series
@@ -451,10 +451,11 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
             qt.donef("fell back to host")
 
         qt = ec.tracer.new_child("host rollup %s", func)
-        if not args and len(series) >= 8:
+        if len(series) >= 8 and _rnp.batch_supported(func, args):
             from ..ops import rollup_np
             rows = rollup_np.rollup_batch(
-                func, [(sd.timestamps, sd.values) for sd in series], cfg)
+                func, [(sd.timestamps, sd.values) for sd in series], cfg,
+                args)
             if rows is not None:
                 qt.donef("%d series (batched)", len(series))
                 return _cache_rollup(
@@ -756,7 +757,7 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                              aux_get, aux_put, group_slots,
                              run_fused_on_tiles, run_quantile_on_tiles,
                              try_aggr_rollup_tpu, try_quantile_rollup_tpu)
-    if func not in rollup_np.SUPPORTED or \
+    if func not in rollup_np.CORE_SUPPORTED or \
             (phi is None and ae.name not in FUSED_AGGRS):
         return None
     offset = rarg.offset.value_ms(ec.step) if rarg.offset is not None else 0
@@ -974,10 +975,14 @@ def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
         elif len(ae.args) != 2:
             raise QueryError(f"{name} needs (k, q)")
         k = float(eval_expr(ec, ae.args[0])[0].values[0])
-        series = eval_expr(ec, ae.args[1])
         if np.isnan(k) or k < 0:
             k = 0.0  # getIntK clamps (aggr.go:793)
-        elif np.isinf(k):
+        if name not in ("limitk", "outliersk") and not np.isinf(k):
+            got = _try_device_topk(ec, ae, name, k, remaining)
+            if got is not None:
+                return got
+        series = eval_expr(ec, ae.args[1])
+        if np.isinf(k):
             k = float(len(series))
         return _eval_topk_family(ec, ae, name, k, series, remaining)
     if name == "quantile":
@@ -1146,6 +1151,67 @@ def _vm_name_hash(mn: MetricName) -> int:
         parts.append(lk)
         parts.append(lv)
     return xxhash.xxh64_intdigest(b"".join(parts))
+
+
+def _try_device_topk(ec, ae, name: str, k: float,
+                     remaining) -> list[Timeseries] | None:
+    """topk/bottomk[_kind](k, rollup(selector)) fused on device: the
+    [S, T] rollup stays in HBM, selection runs there, and only winner
+    indices plus the k chosen rows cross the link (None -> host path)."""
+    if ec.tpu is None or remaining is not None or ae.grouping or ae.without:
+        return None
+    arg = ae.args[1]
+    if isinstance(arg, FuncExpr):
+        if len(arg.args) != 1 or arg.keep_metric_names:
+            return None
+        func, rarg = arg.name, arg.args[0]
+    elif isinstance(arg, (MetricExpr, RollupExpr)):
+        func, rarg = "default_rollup", arg
+    else:
+        return None
+    if isinstance(rarg, MetricExpr):
+        rarg = RollupExpr(expr=rarg)
+    if not isinstance(rarg, RollupExpr) or \
+            not isinstance(rarg.expr, MetricExpr) or rarg.expr.is_empty() or \
+            rarg.needs_subquery() or rarg.at is not None:
+        return None
+    from ..ops import rollup_np
+    if func not in rollup_np.CORE_SUPPORTED:
+        return None
+    from .tpu_engine import try_topk_rollup_tpu
+    keep_name = func in KEEP_METRIC_NAMES
+    offset = rarg.offset.value_ms(ec.step) if rarg.offset is not None else 0
+    window = rarg.window.value_ms(ec.step) if rarg.window is not None else 0
+    series, cfg, admission, fetch_info = _fetch_series_for_rollup(
+        ec, func, rarg, window, offset)
+    adj = adjusted_windows(func, window, ec.step,
+                           [sd.timestamps for sd in series])
+    if adj:
+        if not all(a == adj[0] for a in adj):
+            # per-series windows: host path. Release the admission
+            # reservation and roll back the sample count — the host
+            # re-fetches and re-counts (same contract as
+            # _try_device_fused_aggr's decline path)
+            with admission:
+                pass
+            ec.count_samples(-sum(s.timestamps.size for s in series))
+            return None
+        cfg = RollupConfig(start=cfg.start, end=cfg.end, step=cfg.step,
+                           window=adj[0])
+    with admission:
+        qt = ec.tracer.new_child("tpu fused %s(%s)", name, func)
+        got = try_topk_rollup_tpu(
+            ec.tpu, name, k, func, series, cfg,
+            cache_key=_tile_cache_key(ec, rarg.expr, cfg, fetch_info))
+        if got is None:
+            qt.donef("fell back to host")
+            ec.count_samples(-sum(s.timestamps.size for s in series))
+            return None
+        qt.donef("device selection, %d of %d series kept",
+                 len(got), len(series))
+    return _finish_rollup_names(
+        (series[i].metric_name for i, _ in got),
+        [vals for _, vals in got], keep_name)
 
 
 def _eval_topk_family(ec, ae, name, k, series,

@@ -21,7 +21,7 @@ import numpy as np
 from ..ops import rollup_np
 from ..ops.rollup_np import RollupConfig
 
-ORACLE_FUNCS = set(rollup_np.SUPPORTED)
+ORACLE_FUNCS = set(rollup_np.CORE_SUPPORTED)
 
 nan = float("nan")
 

@@ -78,8 +78,8 @@ def _fingerprint(series, start_ms: int) -> tuple:
 def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
                    args: tuple, cache_key=None):
     """Returns list of per-series value rows, or None for host fallback."""
-    if func not in rollup_np.SUPPORTED:
-        return None
+    if func not in rollup_np.CORE_SUPPORTED:
+        return None  # device kernels cover the core set; host batch the rest
     if args:
         return None
     if len(series) < engine.min_series:
@@ -110,6 +110,93 @@ def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
     return list(np.asarray(out, dtype=np.float64)[:len(series)])
 
 
+TOPK_RANK_KINDS = frozenset({"max", "min", "avg", "median", "last"})
+
+
+def try_topk_rollup_tpu(engine: TPUEngine, name: str, k: float, func: str,
+                        series, cfg: RollupConfig, cache_key=None):
+    """Fused topk/bottomk family on device: the [S, T] rollup stays in HBM;
+    selection (per-timestamp top-k, or whole-series rank for the
+    topk_<kind> variants) runs on device and only winner indices + the k
+    selected rows cross the link (aggr.go:793 getRangeTopKTimeseries /
+    topk per-ts; critical on tunneled links where D2H dominates).
+
+    Returns a list of (orig_series_index, values_row) — the caller attaches
+    names — or None for host fallback."""
+    if func not in rollup_np.CORE_SUPPORTED:
+        return None
+    if len(series) < engine.min_series:
+        return None
+    span = cfg.end - cfg.start + cfg.lookback
+    if span >= 2**31 - 1:
+        return None
+    bottom = name.startswith("bottomk")
+    if name in ("topk", "bottomk"):
+        kind = None
+    else:
+        kind = name.split("_", 1)[1]
+        if kind not in TOPK_RANK_KINDS:
+            return None
+    try:
+        import jax.numpy as jnp
+
+        from ..ops.device_rollup import (normalized_cfg, rank_tile,
+                                         take_rows, topk_select_tile)
+    except Exception:
+        return None
+    k_i = max(int(k), 0)
+    if k_i == 0:
+        return []
+    key = cache_key or _fingerprint(series, cfg.start)
+    cache = engine.cache()
+    tiles = cache.get(key)
+    if tiles is None:
+        tiles = _upload_tiles(engine, series, cfg)
+        cache.put_device(key, tiles)
+    ts_t, v_t, counts = tiles
+    ncfg = normalized_cfg(func, cfg)
+    if kind is None:
+        k_eff = min(k_i, int(ts_t.shape[0]))
+        rolled, idx, sel_nan = topk_select_tile(
+            func, ts_t, v_t, counts, ncfg, k_eff, bottom)
+        idx_h = np.asarray(idx)
+        valid = ~np.asarray(sel_nan)
+        # padded tile rows roll to all-NaN and can never be selected valid
+        sel = np.unique(idx_h[valid])
+        sel = sel[sel < len(series)]
+        if sel.size == 0:
+            return []
+        rows_sel = np.asarray(take_rows(rolled, jnp.asarray(sel)),
+                              dtype=np.float64)
+        # rebuild the kept-sample mask for the selected rows
+        t_pos, j_pos = np.nonzero(valid)
+        s_pos = idx_h[t_pos, j_pos]
+        keep = s_pos < len(series)
+        row_of = np.searchsorted(sel, s_pos[keep])
+        mask = np.zeros((sel.size, rows_sel.shape[1]), dtype=bool)
+        mask[row_of, t_pos[keep]] = True
+        out = []
+        for j, i in enumerate(sel):
+            vals = np.where(mask[j], rows_sel[j], np.nan)
+            if not np.isnan(vals).all():
+                out.append((int(i), vals))
+        return out
+    rolled, rank = rank_tile(func, kind, ts_t, v_t, counts, ncfg)
+    rank_h = np.asarray(rank, dtype=np.float64)[:len(series)]
+    # ordering replicates _eval_topk_family exactly (stable sorts, ties
+    # favor later series)
+    rank_h = np.where(np.isnan(rank_h),
+                      np.inf if bottom else -np.inf, rank_h)
+    if bottom:
+        order = np.argsort(-rank_h, kind="stable")
+    else:
+        order = np.argsort(rank_h, kind="stable")
+    sel = order[-min(k_i, len(series)):]  # rank order, ties favor later
+    rows_sel = np.asarray(take_rows(rolled, jnp.asarray(sel)),
+                          dtype=np.float64)
+    return [(int(i), rows_sel[j]) for j, i in enumerate(sel)]
+
+
 FUSED_AGGRS = frozenset({"sum", "count", "avg", "min", "max", "stddev",
                          "stdvar", "group"})
 
@@ -122,7 +209,7 @@ def try_aggr_rollup_tpu(engine: TPUEngine, aggr: str, func: str, series,
     device->host link (the incrementalAggrFuncCallbacks analog,
     eval.go:1055; critical on tunneled links where D2H dominates).
     Returns an [G, T] float64 array or None for host fallback."""
-    if aggr not in FUSED_AGGRS or func not in rollup_np.SUPPORTED:
+    if aggr not in FUSED_AGGRS or func not in rollup_np.CORE_SUPPORTED:
         return None
     if len(series) < engine.min_series:
         return None
@@ -491,7 +578,7 @@ def try_quantile_rollup_tpu(engine: TPUEngine, phi: float, func: str,
     """Fused quantile/median(phi, rollup(selector)) by (...) on device.
     `slots`/`max_group` come from group_slots(). Returns [G, T] float64 or
     None for host fallback."""
-    if func not in rollup_np.SUPPORTED:
+    if func not in rollup_np.CORE_SUPPORTED:
         return None
     if len(series) < engine.min_series:
         return None
