@@ -1,0 +1,230 @@
+"""Cluster apptest matrix beyond the basic 2-node scatter-gather
+(reference apptest/tests/{replication,sharding,multilevel}_test.go as real
+OS processes): RF=2 write fan-out with query-time replica dedup, node-loss
+completeness under replication, rerouting around a PAUSED (SIGSTOP — still
+accepting TCP, never answering) node, and a multilevel vmselect chain over
+-clusternativeListenAddr."""
+
+import json
+import os
+import signal
+import time
+import urllib.request
+
+import pytest
+
+from tests.apptest_helpers import AppProc, Client, free_ports
+
+T0 = 1_753_700_000_000
+
+
+def _metric(port: int, name: str) -> float:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    for ln in text.splitlines():
+        if ln.startswith(name + " ") or ln.startswith(name + "{"):
+            return float(ln.split()[-1])
+    return 0.0
+
+
+def _flush(port: int):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/internal/force_flush", timeout=10):
+        pass
+
+
+@pytest.fixture(scope="module")
+def rf2(tmp_path_factory):
+    """2x vmstorage + vminsert(RF=2) + vmselect."""
+    d = tmp_path_factory.mktemp("rf2")
+    (s1h, s1i, s1s, s2h, s2i, s2s, ih, sh) = free_ports(8)
+    procs = []
+    try:
+        for n, (hh, ii, ss) in (("s1", (s1h, s1i, s1s)),
+                                ("s2", (s2h, s2i, s2s))):
+            procs.append(AppProc("vmstorage", [
+                f"-storageDataPath={d}/{n}",
+                f"-httpListenAddr=127.0.0.1:{hh}",
+                f"-vminsertAddr=127.0.0.1:{ii}",
+                f"-vmselectAddr=127.0.0.1:{ss}"], hh, f"vmstorage-{n}"))
+        nodes = [f"-storageNode=127.0.0.1:{s1i}:{s1s}",
+                 f"-storageNode=127.0.0.1:{s2i}:{s2s}"]
+        procs.append(AppProc(
+            "vminsert", nodes + [f"-httpListenAddr=127.0.0.1:{ih}",
+                                 "-replicationFactor=2"], ih, "vminsert"))
+        procs.append(AppProc(
+            "vmselect", nodes + [f"-httpListenAddr=127.0.0.1:{sh}"],
+            sh, "vmselect"))
+        yield {"st": procs[:2], "vi": procs[2], "vs": procs[3],
+               "sports": (s1h, s2h)}
+    finally:
+        for p in procs:
+            p.stop(kill=True)
+
+
+def test_rf2_full_replication_and_dedup(rf2):
+    vi = Client(rf2["vi"].port)
+    vs = Client(rf2["vs"].port)
+    lines = [f'rfm{{series="{i}"}} {i} {T0 + k * 15000}'
+             for i in range(100) for k in range(3)]
+    code, _ = vi.post("/insert/0/prometheus/api/v1/import/prometheus",
+                      "\n".join(lines).encode())
+    assert code == 204
+    for p in rf2["sports"]:
+        _flush(p)
+    # RF=2 over 2 nodes: EVERY row lands on BOTH nodes
+    for p in rf2["sports"]:
+        assert _metric(p, "vm_rows_added_to_storage_total") == 300.0
+    # query-time replica dedup: each series exactly once, values intact
+    code, body = vs.get("/select/0/prometheus/api/v1/query",
+                        query="count(rfm)",
+                        time=str((T0 + 30000) // 1000))
+    res = json.loads(body)
+    assert res["status"] == "success"
+    assert float(res["data"]["result"][0]["value"][1]) == 100.0
+    code, body = vs.get("/select/0/prometheus/api/v1/query",
+                        query="sum(rfm)", time=str((T0 + 30000) // 1000))
+    assert float(json.loads(body)["data"]["result"][0]["value"][1]) \
+        == float(sum(range(100)))
+
+
+def test_rf2_node_loss_keeps_full_results(rf2):
+    """With RF=2 every series lives on the surviving node: results stay
+    COMPLETE after a kill (apptest replication_test.go)."""
+    vs = Client(rf2["vs"].port)
+    rf2["st"][1].stop(kill=True)
+    time.sleep(0.3)
+    code, body = vs.get("/select/0/prometheus/api/v1/query",
+                        query="count(rfm)",
+                        time=str((T0 + 30000) // 1000))
+    res = json.loads(body)
+    assert res["status"] == "success"
+    # completeness despite the lost node — replication, not luck
+    assert float(res["data"]["result"][0]["value"][1]) == 100.0
+
+
+@pytest.fixture()
+def pausable(tmp_path_factory):
+    """2x vmstorage + vminsert(RF=1, 1s RPC timeout) for reroute tests."""
+    d = tmp_path_factory.mktemp("pause")
+    (s1h, s1i, s1s, s2h, s2i, s2s, ih, sh) = free_ports(8)
+    procs = []
+    try:
+        for n, (hh, ii, ss) in (("s1", (s1h, s1i, s1s)),
+                                ("s2", (s2h, s2i, s2s))):
+            procs.append(AppProc("vmstorage", [
+                f"-storageDataPath={d}/{n}",
+                f"-httpListenAddr=127.0.0.1:{hh}",
+                f"-vminsertAddr=127.0.0.1:{ii}",
+                f"-vmselectAddr=127.0.0.1:{ss}"], hh, f"vmstorage-{n}"))
+        nodes = [f"-storageNode=127.0.0.1:{s1i}:{s1s}",
+                 f"-storageNode=127.0.0.1:{s2i}:{s2s}"]
+        procs.append(AppProc(
+            "vminsert", nodes + [f"-httpListenAddr=127.0.0.1:{ih}",
+                                 "-rpc.timeout=1.0"], ih, "vminsert"))
+        procs.append(AppProc(
+            "vmselect",
+            [nodes[0], f"-httpListenAddr=127.0.0.1:{sh}",
+             "-rpc.timeout=2.0"], sh, "vmselect"))
+        yield {"st": procs[:2], "vi": procs[2], "vs": procs[3],
+               "sports": (s1h, s2h)}
+    finally:
+        for p in procs:
+            try:
+                os.kill(p.proc.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            p.stop(kill=True)
+
+
+def test_reroute_on_paused_node(pausable):
+    """SIGSTOP (node alive at TCP level but unresponsive — the 'slow node'
+    case, harder than a kill): writes must time out, mark the node down,
+    and reroute its shard to the healthy node without losing rows."""
+    vi = Client(pausable["vi"].port)
+    vs = Client(pausable["vs"].port)
+    # seed both shards while healthy so the hash ring places series on s2
+    lines = [f'prm{{series="{i}"}} {i} {T0}' for i in range(40)]
+    code, _ = vi.post("/insert/0/prometheus/api/v1/import/prometheus",
+                      "\n".join(lines).encode())
+    assert code == 204
+    os.kill(pausable["st"][1].proc.pid, signal.SIGSTOP)
+    t0 = time.time()
+    lines = [f'prm{{series="{i}"}} {i + 1000} {T0 + 15000}'
+             for i in range(40)]
+    code, _ = vi.post("/insert/0/prometheus/api/v1/import/prometheus",
+                      "\n".join(lines).encode())
+    assert code == 204
+    took = time.time() - t0
+    assert took < 8, f"reroute too slow: {took:.1f}s"
+    assert _metric(pausable["vi"].port, "vm_cluster_reroutes_total") > 0
+    # every second-batch row survived on the healthy node: query through
+    # the vmselect wired ONLY to s1
+    _flush(pausable["sports"][0])
+    code, body = vs.get("/select/0/prometheus/api/v1/query",
+                        query='count(prm > 999)',
+                        time=str((T0 + 15000) // 1000))
+    res = json.loads(body)
+    assert res["status"] == "success"
+    assert float(res["data"]["result"][0]["value"][1]) == 40.0
+    os.kill(pausable["st"][1].proc.pid, signal.SIGCONT)
+
+
+@pytest.fixture(scope="module")
+def multilevel(tmp_path_factory):
+    """storage <- vminsert; storage <- vmselect-lower
+    (-clusternativeListenAddr) <- vmselect-top: the top node treats the
+    lower SELECT tier as its storage backend (multilevel federation)."""
+    d = tmp_path_factory.mktemp("ml")
+    (sh, si, ss, ih, lh, ln, th) = free_ports(7)
+    procs = []
+    try:
+        procs.append(AppProc("vmstorage", [
+            f"-storageDataPath={d}/s",
+            f"-httpListenAddr=127.0.0.1:{sh}",
+            f"-vminsertAddr=127.0.0.1:{si}",
+            f"-vmselectAddr=127.0.0.1:{ss}"], sh, "vmstorage"))
+        procs.append(AppProc("vminsert", [
+            f"-storageNode=127.0.0.1:{si}:{ss}",
+            f"-httpListenAddr=127.0.0.1:{ih}"], ih, "vminsert"))
+        procs.append(AppProc("vmselect", [
+            f"-storageNode=127.0.0.1:{si}:{ss}",
+            f"-httpListenAddr=127.0.0.1:{lh}",
+            f"-clusternativeListenAddr=127.0.0.1:{ln}"], lh,
+            "vmselect-lower"))
+        # top level: the lower vmselect's native port serves the SELECT
+        # API; the insert port slot is a dummy (never dialed on reads)
+        procs.append(AppProc("vmselect", [
+            f"-storageNode=127.0.0.1:1:{ln}",
+            f"-httpListenAddr=127.0.0.1:{th}"], th, "vmselect-top"))
+        yield {"procs": procs, "sh": sh, "ih": ih, "lh": lh, "th": th}
+    finally:
+        for p in procs:
+            p.stop(kill=True)
+
+
+def test_multilevel_select_chain(multilevel):
+    vi = Client(multilevel["ih"])
+    lines = [f'mlm{{series="{i}"}} {i * 2} {T0}' for i in range(50)]
+    code, _ = vi.post("/insert/0/prometheus/api/v1/import/prometheus",
+                      "\n".join(lines).encode())
+    assert code == 204
+    _flush(multilevel["sh"])
+    results = {}
+    for tier in ("lh", "th"):
+        c = Client(multilevel[tier])
+        code, body = c.get("/select/0/prometheus/api/v1/query",
+                           query="sum(mlm)", time=str(T0 // 1000))
+        res = json.loads(body)
+        assert res["status"] == "success", (tier, res)
+        results[tier] = float(res["data"]["result"][0]["value"][1])
+    assert results["lh"] == results["th"] == float(sum(i * 2
+                                                       for i in range(50)))
+    # series-level reads traverse the chain too
+    c = Client(multilevel["th"])
+    code, body = c.get("/select/0/prometheus/api/v1/series",
+                       **{"match[]": "mlm", "start": str(T0 // 1000 - 60),
+                          "end": str(T0 // 1000 + 60)})
+    assert code == 200
+    assert len(json.loads(body)["data"]) == 50

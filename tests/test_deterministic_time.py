@@ -96,3 +96,172 @@ class TestLimiterClock:
         c.advance(2_000)           # crosses the hour boundary
         assert lim.add(2)
         assert lim.current_series == 1
+
+
+class TestMergerScheduling:
+    def test_small_part_merge_policy(self, tmp_path, clock):
+        """Repeated disk flushes accumulate small parts; crossing
+        MAX_SMALL_PARTS triggers the merger, which consolidates without
+        losing rows (partition.go merger pools, driven explicitly)."""
+        from victoriametrics_tpu.storage.partition import MAX_SMALL_PARTS
+        s = Storage(str(tmp_path / "mg"))
+        t0 = clock.ms
+        total = 0
+        p = None
+        for i in range(MAX_SMALL_PARTS + 3):
+            s.add_rows([({"__name__": "mm", "i": str(i)},
+                         t0 + i * 1000, float(i))])
+            s.table.flush_to_disk()
+            total += 1
+            p = s.table.partition_for_ts(t0)
+        assert len(p._file_parts) <= MAX_SMALL_PARTS + 1
+        f = filters_from_dict({"__name__": "mm"})
+        assert len(s.search_series(f, t0 - 1000,
+                                   t0 + total * 1000 + 1000)) == total
+        s.close()
+
+    def test_merge_drops_deleted_and_expired(self, tmp_path, clock):
+        """A forced merge under an advanced clock drops tombstoned series
+        and out-of-retention rows in the same pass (merge.go:19 filters)."""
+        s = Storage(str(tmp_path / "md"), retention_ms=30 * DAY)
+        t0 = clock.ms
+        s.add_rows([({"__name__": "keep"}, t0, 1.0),
+                    ({"__name__": "drop"}, t0, 2.0),
+                    ({"__name__": "old"}, t0 - 25 * DAY, 3.0)])
+        s.force_flush()
+        s.delete_series(filters_from_dict({"__name__": "drop"}))
+        clock.advance(10 * DAY)  # "old" rows cross the retention boundary
+        s.force_merge()
+        f_all = lambda n: s.search_series(filters_from_dict(
+            {"__name__": n}), t0 - 30 * DAY, t0 + DAY)
+        assert len(f_all("keep")) == 1
+        assert f_all("drop") == []
+        assert f_all("old") == []
+        s.close()
+
+
+class TestStreamAggrClock:
+    def _agg(self, cfg, sink):
+        from victoriametrics_tpu.ingest.streamaggr import Aggregator
+        return Aggregator(cfg, sink)
+
+    def test_interval_flush_alignment(self):
+        """State resets exactly at each flush: samples land in their own
+        interval's output rows, stamped with the flush-time now_ms
+        (streamaggr.go flushers, driven with explicit virtual times)."""
+        from victoriametrics_tpu.ingest.streamaggr import _interval_str
+        base = (1_753_700_000_000 // 60_000) * 60_000
+        out = []
+        a = self._agg({"interval": "60s", "outputs": ["sum_samples"],
+                       "by": ["job"]}, out.extend)
+        sfx = _interval_str(60_000)
+        for k in range(3):
+            a.push({"__name__": "m", "job": "j"}, base + k * 1000, 10.0)
+        a.flush(now_ms=base + 60_000)
+        a.push({"__name__": "m", "job": "j"}, base + 61_000, 5.0)
+        a.flush(now_ms=base + 120_000)
+        a.flush(now_ms=base + 180_000)  # empty interval: no output
+        assert [(r[0]["__name__"], r[1], r[2]) for r in out] == [
+            (f"m:{sfx}_sum_samples", base + 60_000, 30.0),
+            (f"m:{sfx}_sum_samples", base + 120_000, 5.0)]
+
+    def test_total_state_survives_flushes(self):
+        """total is cumulative ACROSS intervals (only the delta within each
+        interval is new), matching the reference's total output."""
+        base = (1_753_700_000_000 // 60_000) * 60_000
+        out = []
+        a = self._agg({"interval": "60s", "outputs": ["total"]}, out.extend)
+        a.push({"__name__": "c", "job": "j"}, base + 1000, 5.0)
+        a.push({"__name__": "c", "job": "j"}, base + 2000, 8.0)
+        a.flush(now_ms=base + 60_000)
+        a.push({"__name__": "c", "job": "j"}, base + 61_000, 11.0)
+        a.flush(now_ms=base + 120_000)
+        vals = [r[2] for r in out]
+        assert vals == [8.0, 11.0]  # counts from 0 at first sight, then +3
+
+    def test_dedup_keeps_last_per_interval(self):
+        from victoriametrics_tpu.ingest.streamaggr import Deduplicator
+        rows = []
+        d = Deduplicator(30_000, lambda rs: rows.extend(rs))
+        d.push({"__name__": "m"}, 1000, 1.0)
+        d.push({"__name__": "m"}, 2000, 2.0)
+        d.push({"__name__": "m"}, 3000, 3.0)
+        d.flush(now_ms=30_000)
+        assert [(r[1], r[2]) for r in rows] == [(3000, 3.0)]
+
+
+class TestAlertingClock:
+    class FakeDS:
+        def __init__(self):
+            self.results = []
+
+        def query(self, expr, now):
+            return list(self.results)
+
+    def _rule(self, for_s):
+        from victoriametrics_tpu.apps import vmalert
+
+        class G:
+            name = "g"
+            interval = 30.0
+        return vmalert.AlertingRule(
+            {"alert": "HighLoad", "expr": "up == 0",
+             "for": f"{for_s}s", "labels": {"sev": "page"}}, G())
+
+    def test_pending_to_firing_to_resolved(self):
+        from victoriametrics_tpu.apps.vmalert import (STATE_FIRING,
+                                                      STATE_PENDING)
+        ds = self.FakeDS()
+        ds.results = [{"metric": {"instance": "h1"}, "value": 1.0}]
+        r = self._rule(300)
+        t = 1_753_700_000.0
+        st = r.eval(ds, t)
+        assert [s["state"] for s in st] == [STATE_PENDING]
+        st = r.eval(ds, t + 299)      # one second short of `for`
+        assert [s["state"] for s in st] == [STATE_PENDING]
+        st = r.eval(ds, t + 300)      # exactly at the boundary
+        assert [s["state"] for s in st] == [STATE_FIRING]
+        ds.results = []               # condition clears
+        st = r.eval(ds, t + 330)
+        assert st == []               # resolved: removed from active set
+
+    def test_flapping_resets_pending_timer(self):
+        from victoriametrics_tpu.apps.vmalert import (STATE_FIRING,
+                                                      STATE_PENDING)
+        ds = self.FakeDS()
+        ds.results = [{"metric": {"instance": "h1"}, "value": 1.0}]
+        r = self._rule(300)
+        t = 1_753_700_000.0
+        r.eval(ds, t)
+        ds.results = []
+        r.eval(ds, t + 200)           # clears before firing
+        ds.results = [{"metric": {"instance": "h1"}, "value": 1.0}]
+        st = r.eval(ds, t + 290)      # re-activates: timer restarts
+        assert [s["state"] for s in st] == [STATE_PENDING]
+        st = r.eval(ds, t + 290 + 299)
+        assert [s["state"] for s in st] == [STATE_PENDING]
+        st = r.eval(ds, t + 290 + 300)
+        assert [s["state"] for s in st] == [STATE_FIRING]
+
+    def test_restore_preserves_active_at_across_restart(self):
+        """ALERTS_FOR_STATE restore: a restarted rule resumes the original
+        activeAt, so `for` continuity survives the restart
+        (rule/alerting.go Restore)."""
+        from victoriametrics_tpu.apps.vmalert import STATE_FIRING
+        t = 1_753_700_000.0
+
+        class RestoreDS:
+            def query(self, expr, now):
+                if "ALERTS_FOR_STATE" in expr:
+                    return [{"metric": {"alertname": "HighLoad",
+                                        "instance": "h1", "sev": "page"},
+                             "value": t}]
+                return [{"metric": {"instance": "h1"}, "value": 1.0}]
+
+        r = self._rule(300)
+        ds = RestoreDS()
+        r.restore(ds, t + 200, lookback_s=3600)
+        assert len(r._active) == 1
+        # next eval happens 300s after the ORIGINAL activeAt: fires
+        st = r.eval(ds, t + 300)
+        assert [s["state"] for s in st] == [STATE_FIRING]

@@ -448,6 +448,270 @@ class TestVMAgentDepth:
             "__meta_consul_dc": "dc1"})]
         srv.stop()
 
+    def test_http_sd(self):
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+        from victoriametrics_tpu.ingest import discovery
+        seen_auth = []
+
+        def h(r):
+            seen_auth.append(r.headers.get("authorization", ""))
+            return Response.json([
+                {"targets": ["10.0.0.1:9100", "10.0.0.2:9100"],
+                 "labels": {"env": "prod"}},
+                {"targets": ["10.0.0.3:8080"]}])
+        srv = HTTPServer("127.0.0.1", 0)
+        srv.route("/sd", h)
+        srv.start()
+        url = f"http://127.0.0.1:{srv.port}/sd"
+        out = discovery.http_sd({"url": url, "bearer_token": "tk"})
+        assert seen_auth == ["Bearer tk"]
+        assert out == [
+            ("10.0.0.1:9100", {"__meta_env": "prod", "__meta_url": url}),
+            ("10.0.0.2:9100", {"__meta_env": "prod", "__meta_url": url}),
+            ("10.0.0.3:8080", {"__meta_url": url})]
+        srv.stop()
+
+    def test_dns_sd(self):
+        """Fake UDP DNS server answering SRV (with name compression) and A
+        queries; the provider must decode both."""
+        import socket
+        import struct
+        import threading
+        from victoriametrics_tpu.ingest import discovery
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+
+        def serve():
+            for _ in range(4):
+                try:
+                    data, addr = sock.recvfrom(4096)
+                except OSError:
+                    return
+                qid = data[:2]
+                qtype = struct.unpack(">H", data[-4:-2])[0]
+                # question section starts at 12; echo it back
+                question = data[12:]
+                hdr = qid + struct.pack(">HHHHH", 0x8180, 1,
+                                        2 if qtype == 33 else 1, 0, 0)
+                if qtype == 33:   # two SRV records, target via pointer+label
+                    rr = b""
+                    for prt, tgt in ((9100, b"\x05node1"),
+                                     (9200, b"\x05node2")):
+                        # name = pointer to the question name at offset 12
+                        rdata = struct.pack(">HHH", 10, 5, prt) + \
+                            tgt + b"\xc0\x0c"
+                        rr += b"\xc0\x0c" + struct.pack(
+                            ">HHIH", 33, 1, 300, len(rdata)) + rdata
+                elif qtype == 1:  # one A record
+                    rr = b"\xc0\x0c" + struct.pack(
+                        ">HHIH", 1, 1, 300, 4) + bytes([10, 1, 2, 3])
+                else:
+                    continue
+                sock.sendto(hdr + question + rr, addr)
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        srv_out = discovery.dns_sd({
+            "names": ["_metrics._tcp.example.org"],
+            "resolver": f"127.0.0.1:{port}"})
+        assert srv_out == [
+            ("node1._metrics._tcp.example.org:9100",
+             {"__meta_dns_name": "_metrics._tcp.example.org",
+              "__meta_dns_srv_record_target":
+                  "node1._metrics._tcp.example.org",
+              "__meta_dns_srv_record_port": "9100"}),
+            ("node2._metrics._tcp.example.org:9200",
+             {"__meta_dns_name": "_metrics._tcp.example.org",
+              "__meta_dns_srv_record_target":
+                  "node2._metrics._tcp.example.org",
+              "__meta_dns_srv_record_port": "9200"})]
+        a_out = discovery.dns_sd({
+            "names": ["web.example.org"], "type": "A", "port": 9090,
+            "resolver": f"127.0.0.1:{port}"})
+        assert a_out == [("10.1.2.3:9090",
+                          {"__meta_dns_name": "web.example.org"})]
+        sock.close()
+
+    def test_dns_sd_malformed_response_degrades(self):
+        """Garbage datagrams must surface as DiscoveryError (last-known-good
+        fallback), never as IndexError killing the SD loop."""
+        import socket
+        import threading
+        import pytest as _pytest
+        from victoriametrics_tpu.ingest import discovery
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+
+        def serve():
+            try:
+                data, addr = sock.recvfrom(4096)
+                sock.sendto(data[:2] + b"\x81\x80\x00\x01\x00\x05", addr)
+            except OSError:
+                pass
+        threading.Thread(target=serve, daemon=True).start()
+        with _pytest.raises(discovery.DiscoveryError):
+            discovery.dns_sd({"names": ["x.example.org"], "type": "A",
+                              "port": 1, "resolver": f"127.0.0.1:{port}"})
+        sock.close()
+
+    def test_docker_sd(self, tmp_path):
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+        from victoriametrics_tpu.ingest import discovery
+        containers = [{
+            "Id": "abc123", "Names": ["/web-1"], "State": "running",
+            "Labels": {"com.example.app": "web"},
+            "Ports": [{"PrivatePort": 8080, "PublicPort": 32768,
+                       "Type": "tcp"}],
+            "NetworkSettings": {"Networks": {
+                "bridge": {"IPAddress": "172.17.0.2"}}},
+        }, {
+            "Id": "def456", "Names": ["/db-1"], "State": "running",
+            "Labels": {}, "Ports": [],
+            "NetworkSettings": {"Networks": {
+                "bridge": {"IPAddress": "172.17.0.3"}}},
+        }]
+        srv = HTTPServer("127.0.0.1", 0)
+        srv.route("/containers/json", lambda r: Response.json(containers))
+        srv.start()
+        out = discovery.docker_sd(
+            {"host": f"tcp://127.0.0.1:{srv.port}", "port": 9323})
+        srv.stop()
+        assert out[0][0] == "172.17.0.2:8080"
+        assert out[0][1]["__meta_docker_container_name"] == "/web-1"
+        assert out[0][1]["__meta_docker_container_label_com_example_app"] \
+            == "web"
+        assert out[0][1]["__meta_docker_port_public"] == "32768"
+        assert out[1][0] == "172.17.0.3:9323"  # no ports -> cfg port
+
+    def test_docker_sd_unix_socket(self, tmp_path):
+        import http.server
+        import socket
+        import socketserver
+        import threading
+        from victoriametrics_tpu.ingest import discovery
+        spath = str(tmp_path / "docker.sock")
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = (b'[{"Id":"x","Names":["/u1"],"State":"running",'
+                        b'"Ports":[{"PrivatePort":80}],"NetworkSettings":'
+                        b'{"Networks":{"bridge":{"IPAddress":"10.9.9.9"'
+                        b'}}}}]')
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        class UnixHTTP(socketserver.UnixStreamServer):
+            pass
+        UnixHTTP.allow_reuse_address = True
+        usrv = UnixHTTP(spath, H)
+        t = threading.Thread(target=usrv.serve_forever, daemon=True)
+        t.start()
+        try:
+            out = discovery.docker_sd({"host": f"unix://{spath}"})
+            assert out == [("10.9.9.9:80", {
+                "__meta_docker_container_id": "x",
+                "__meta_docker_container_name": "/u1",
+                "__meta_docker_container_state": "running",
+                "__meta_docker_network_name": "bridge",
+                "__meta_docker_network_ip": "10.9.9.9",
+                "__meta_docker_port_private": "80"})]
+        finally:
+            usrv.shutdown()
+            usrv.server_close()
+
+    def test_gce_sd_with_pagination(self):
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+        from victoriametrics_tpu.ingest import discovery
+        page1 = {"items": [{
+            "id": 111, "name": "vm-a", "status": "RUNNING",
+            "machineType": ".../machineTypes/e2-small",
+            "networkInterfaces": [{
+                "networkIP": "10.128.0.2", "network": ".../networks/default",
+                "accessConfigs": [{"natIP": "34.1.2.3"}]}],
+            "metadata": {"items": [{"key": "team", "value": "infra"}]},
+            "tags": {"items": ["metrics"]},
+        }], "nextPageToken": "p2"}
+        page2 = {"items": [{
+            "id": 222, "name": "vm-b", "status": "RUNNING",
+            "machineType": ".../machineTypes/e2-micro",
+            "networkInterfaces": [{"networkIP": "10.128.0.3",
+                                   "network": ".../networks/default"}],
+        }]}
+
+        def h(r):
+            return Response.json(page2 if r.arg("pageToken") else page1)
+        srv = HTTPServer("127.0.0.1", 0)
+        srv.route("/compute/v1/projects/pr1/zones/us-a/instances", h)
+        srv.start()
+        out = discovery.gce_sd({
+            "project": "pr1", "zone": "us-a", "port": 9100,
+            "api_server": f"http://127.0.0.1:{srv.port}"})
+        srv.stop()
+        assert [a for a, _ in out] == ["10.128.0.2:9100", "10.128.0.3:9100"]
+        m = out[0][1]
+        assert m["__meta_gce_instance_name"] == "vm-a"
+        assert m["__meta_gce_machine_type"] == "e2-small"
+        assert m["__meta_gce_public_ip"] == "34.1.2.3"
+        assert m["__meta_gce_metadata_team"] == "infra"
+        assert m["__meta_gce_tags"] == ",metrics,"  # separator-wrapped
+
+    def test_azure_sd_with_token_and_nic(self):
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+        from victoriametrics_tpu.ingest import discovery
+        seen = {}
+
+        def token_h(r):
+            seen["grant"] = r.arg("grant_type")
+            seen["client"] = r.arg("client_id")
+            return Response.json({"access_token": "azt"})
+
+        vm_id = ("/subscriptions/s1/resourceGroups/rg1/providers/"
+                 "Microsoft.Compute/virtualMachines/vm1")
+        nic_id = ("/subscriptions/s1/resourceGroups/rg1/providers/"
+                  "Microsoft.Network/networkInterfaces/nic1")
+        vms = {"value": [{
+            "id": vm_id, "name": "vm1", "location": "westeurope",
+            "tags": {"env": "prod"},
+            "properties": {
+                "storageProfile": {"osDisk": {"osType": "Linux"}},
+                "networkProfile": {"networkInterfaces": [{"id": nic_id}]},
+            }}]}
+        nic = {"properties": {"ipConfigurations": [
+            {"properties": {"privateIPAddress": "10.2.3.4"}}]}}
+
+        def vms_h(r):
+            seen["auth"] = r.headers.get("authorization", "")
+            return Response.json(vms)
+        srv = HTTPServer("127.0.0.1", 0)
+        srv.route("/token", token_h)
+        srv.route("/subscriptions/s1/providers/Microsoft.Compute/"
+                  "virtualMachines", vms_h)
+        srv.route(nic_id, lambda r: Response.json(nic))
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        out = discovery.azure_sd({
+            "subscription_id": "s1", "client_id": "cid",
+            "client_secret": "cs", "tenant_id": "t1", "port": 9100,
+            "api_server": base, "token_url": f"{base}/token"})
+        srv.stop()
+        assert seen["grant"] == "client_credentials"
+        assert seen["auth"] == "Bearer azt"
+        assert out[0][0] == "10.2.3.4:9100"
+        m = out[0][1]
+        assert m["__meta_azure_machine_name"] == "vm1"
+        assert m["__meta_azure_machine_resource_group"] == "rg1"
+        assert m["__meta_azure_machine_os_type"] == "Linux"
+        assert m["__meta_azure_machine_tag_env"] == "prod"
+        assert m["__meta_azure_machine_private_ip"] == "10.2.3.4"
+
     def test_ec2_sd_with_sigv4(self):
         from victoriametrics_tpu.httpapi.server import HTTPServer, Response
         from victoriametrics_tpu.ingest import discovery

@@ -160,8 +160,10 @@ class GcsRemote(RemoteFS):
     def _auth(self) -> dict:
         if not self._token or self._meta_token_exp:
             import time as _t
-            if self._meta_token_exp and _t.time() < self._meta_token_exp - 60:
-                return {"Authorization": f"Bearer {self._token}"}
+            now = _t.time()
+            if self._meta_token_exp and now < self._meta_token_exp - 60:
+                return ({"Authorization": f"Bearer {self._token}"}
+                        if self._token else {})
             try:
                 req = urllib.request.Request(
                     "http://metadata.google.internal/computeMetadata/v1/"
@@ -170,9 +172,12 @@ class GcsRemote(RemoteFS):
                 with urllib.request.urlopen(req, timeout=5) as r:
                     tok = json.loads(r.read())
                 self._token = tok["access_token"]
-                self._meta_token_exp = _t.time() + tok.get("expires_in", 300)
+                self._meta_token_exp = now + tok.get("expires_in", 300)
             except Exception:
-                pass  # anonymous (public buckets / auth-free fakes)
+                # anonymous (public buckets / auth-free fakes): remember the
+                # verdict so every object op doesn't re-stall 5s on a doomed
+                # metadata fetch
+                self._meta_token_exp = now + 300
         return {"Authorization": f"Bearer {self._token}"} if self._token \
             else {}
 

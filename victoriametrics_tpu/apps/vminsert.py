@@ -18,6 +18,9 @@ def parse_flags(argv=None):
                    help="host:insertPort:selectPort, repeatable")
     p.add_argument("-httpListenAddr", default=":8480")
     p.add_argument("-replicationFactor", type=int, default=1)
+    p.add_argument("-rpc.timeout", dest="rpc_timeout", type=float,
+                   default=10.0, help="storage-node RPC timeout, seconds "
+                   "(-vmstorageDialTimeout analog)")
     p.add_argument("-clusternativeListenAddr", dest="native_addr", default="",
                    help="expose the vminsert RPC API so a higher-level "
                         "vminsert can chain into this one (multilevel)")
@@ -29,12 +32,13 @@ def parse_flags(argv=None):
     return args
 
 
-def make_nodes(specs: list[str]):
+def make_nodes(specs: list[str], timeout: float = 10.0):
     from ..parallel.cluster_api import StorageNodeClient
     nodes = []
     for spec in specs:
         host, ip_, sp_ = spec.rsplit(":", 2)
-        nodes.append(StorageNodeClient(host, int(ip_), int(sp_)))
+        nodes.append(StorageNodeClient(host, int(ip_), int(sp_),
+                                       timeout=timeout))
     return nodes
 
 
@@ -45,8 +49,9 @@ def build(args):
 
     if not args.storageNode:
         raise SystemExit("vminsert: at least one -storageNode is required")
-    cluster = ClusterStorage(make_nodes(args.storageNode),
-                             replication_factor=args.replicationFactor)
+    cluster = ClusterStorage(
+        make_nodes(args.storageNode, getattr(args, "rpc_timeout", 10.0)),
+        replication_factor=args.replicationFactor)
     hh, _, hp = args.httpListenAddr.rpartition(":")
     srv = HTTPServer(hh or "0.0.0.0", int(hp))
     api = PrometheusAPI(cluster)

@@ -20,6 +20,8 @@ def parse_flags(argv=None):
     p.add_argument("-httpListenAddr", default=":8481")
     p.add_argument("-search.denyPartialResponse", dest="deny_partial",
                    action="store_true")
+    p.add_argument("-rpc.timeout", dest="rpc_timeout", type=float,
+                   default=10.0)
     p.add_argument("-search.tpuBackend", dest="tpu", action="store_true")
     p.add_argument("-search.maxUniqueTimeseries", dest="max_series",
                    type=int, default=300_000)
@@ -48,8 +50,9 @@ def build(args):
 
     if not args.storageNode:
         raise SystemExit("vmselect: at least one -storageNode is required")
-    cluster = ClusterStorage(make_nodes(args.storageNode),
-                             deny_partial_response=args.deny_partial)
+    cluster = ClusterStorage(
+        make_nodes(args.storageNode, getattr(args, "rpc_timeout", 10.0)),
+        deny_partial_response=args.deny_partial)
     tpu_engine = None
     if args.tpu:
         from ..query.tpu_engine import TPUEngine, auto_mesh

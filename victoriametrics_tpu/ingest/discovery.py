@@ -295,10 +295,411 @@ def _sigv4_headers(method: str, url: str, body, region: str,
             "X-Amz-Content-Sha256": payload_hash}
 
 
+# -- http (discovery/http/) --------------------------------------------------
+
+def http_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Generic HTTP SD (the escape hatch everything else can feed):
+    GET url -> [{"targets": [...], "labels": {...}}, ...]
+    (reference lib/promscrape/discovery/http/api.go)."""
+    url = cfg.get("url", "")
+    if not url:
+        raise DiscoveryError("http_sd: missing url")
+    headers = {}
+    token = cfg.get("bearer_token", "")
+    if cfg.get("bearer_token_file"):
+        try:
+            token = open(cfg["bearer_token_file"]).read().strip()
+        except OSError as e:
+            logger.errorf("http_sd: cannot read token: %s", e)
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    ba = cfg.get("basic_auth") or {}
+    if ba.get("username"):
+        import base64
+        cred = f"{ba['username']}:{ba.get('password', '')}".encode()
+        headers["Authorization"] = \
+            "Basic " + base64.b64encode(cred).decode()
+    try:
+        groups = _get_json(url, headers)
+    except Exception as e:
+        raise DiscoveryError(f"http_sd {url}: {e}") from e
+    out: list[tuple[str, dict]] = []
+    for g in groups or []:
+        labels = {f"__meta_{k}" if not k.startswith("__") else k: str(v)
+                  for k, v in (g.get("labels") or {}).items()}
+        labels["__meta_url"] = url
+        for t in g.get("targets") or []:
+            out.append((t, dict(labels)))
+    return out
+
+
+# -- dns (discovery/dns/) ----------------------------------------------------
+
+_DNS_TYPES = {"SRV": 33, "A": 1, "AAAA": 28}
+
+
+def _dns_encode_name(name: str) -> bytes:
+    out = b""
+    for part in name.rstrip(".").split("."):
+        p = part.encode()
+        out += bytes([len(p)]) + p
+    return out + b"\x00"
+
+
+def _dns_read_name(msg: bytes, off: int) -> tuple[str, int]:
+    """Compression-aware name decode; returns (name, next offset)."""
+    parts = []
+    jumped = False
+    end = off
+    for _ in range(128):  # loop guard
+        ln = msg[off]
+        if ln & 0xC0 == 0xC0:  # pointer
+            ptr = ((ln & 0x3F) << 8) | msg[off + 1]
+            if not jumped:
+                end = off + 2
+            off = ptr
+            jumped = True
+            continue
+        if ln == 0:
+            if not jumped:
+                end = off + 1
+            break
+        parts.append(msg[off + 1:off + 1 + ln].decode("ascii", "replace"))
+        off += 1 + ln
+    return ".".join(parts), end
+
+
+def _dns_query(name: str, qtype: int, server: str, port: int = 53,
+               timeout: float = 3.0) -> list[tuple]:
+    """Minimal UDP DNS client: returns [(rtype, rdata)] answers, where SRV
+    rdata = (prio, weight, port, target) and A/AAAA rdata = ip string."""
+    import socket
+    import struct as _s
+    qid = (hash(name) ^ id(object())) & 0xFFFF
+    msg = _s.pack(">HHHHHH", qid, 0x0100, 1, 0, 0, 0) + \
+        _dns_encode_name(name) + _s.pack(">HH", qtype, 1)
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.settimeout(timeout)
+        s.sendto(msg, (server, port))
+        resp, _ = s.recvfrom(8192)
+    rid, flags, qd, an, _, _ = _s.unpack(">HHHHHH", resp[:12])
+    if rid != qid or (flags & 0x000F) != 0:
+        raise DiscoveryError(f"dns_sd: bad response for {name}")
+    off = 12
+    for _ in range(qd):  # skip questions
+        _, off = _dns_read_name(resp, off)
+        off += 4
+    out = []
+    for _ in range(an):
+        _, off = _dns_read_name(resp, off)
+        rtype, _, _, rdlen = _s.unpack(">HHIH", resp[off:off + 10])
+        off += 10
+        rd = resp[off:off + rdlen]
+        if rtype == 33:  # SRV
+            prio, weight, prt = _s.unpack(">HHH", rd[:6])
+            target, _ = _dns_read_name(resp, off + 6)
+            out.append((rtype, (prio, weight, prt, target)))
+        elif rtype == 1 and rdlen == 4:
+            out.append((rtype, ".".join(str(b) for b in rd)))
+        elif rtype == 28 and rdlen == 16:
+            import socket as _sock
+            out.append((rtype, _sock.inet_ntop(_sock.AF_INET6, rd)))
+        off += rdlen
+    return out
+
+
+def _system_resolver() -> tuple[str, int]:
+    try:
+        with open("/etc/resolv.conf") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2 and parts[0] == "nameserver":
+                    return parts[1], 53
+    except OSError:
+        pass
+    return "127.0.0.1", 53
+
+
+def dns_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """SRV/A/AAAA record discovery (lib/promscrape/discovery/dns). The
+    resolver defaults to /etc/resolv.conf; `resolver` ("host:port")
+    overrides it — tests point it at a fake UDP server."""
+    qtype_name = (cfg.get("type") or "SRV").upper()
+    qtype = _DNS_TYPES.get(qtype_name)
+    if qtype is None:
+        raise DiscoveryError(f"dns_sd: unsupported type {qtype_name!r}")
+    port = cfg.get("port")
+    if qtype_name != "SRV" and port is None:
+        raise DiscoveryError("dns_sd: `port` is required for A/AAAA")
+    resolver = cfg.get("resolver", "")
+    if resolver:
+        host, _, rp = resolver.partition(":")
+        server = (host, int(rp or 53))
+    else:
+        server = _system_resolver()
+    out: list[tuple[str, dict]] = []
+    for name in cfg.get("names", []) or []:
+        import struct
+        try:
+            answers = _dns_query(name, qtype, server[0], server[1])
+        except (OSError, DiscoveryError, IndexError, ValueError,
+                struct.error) as e:
+            # Index/struct errors = malformed/truncated datagrams; they must
+            # degrade to last-known-good targets, not kill the SD loop
+            raise DiscoveryError(f"dns_sd {name}: {e}") from e
+        for rtype, rd in answers:
+            meta = {"__meta_dns_name": name}
+            if rtype == 33:
+                prio, weight, prt, target = rd
+                meta["__meta_dns_srv_record_target"] = target
+                meta["__meta_dns_srv_record_port"] = str(prt)
+                addr = f"{target}:{port if port is not None else prt}"
+            else:
+                addr = f"{rd}:{port}"
+            out.append((addr, meta))
+    return out
+
+
+# -- docker (discovery/docker/) ----------------------------------------------
+
+def _docker_get(host: str, path: str, timeout: float = 10.0):
+    """GET against a docker daemon: tcp/http hosts via urllib, unix://
+    sockets via a raw HTTPConnection bound to the socket path."""
+    if host.startswith("unix://"):
+        import http.client
+        import socket
+
+        class _UnixConn(http.client.HTTPConnection):
+            def __init__(self, spath):
+                super().__init__("localhost", timeout=timeout)
+                self._spath = spath
+
+            def connect(self):
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.settimeout(timeout)
+                s.connect(self._spath)
+                self.sock = s
+
+        conn = _UnixConn(host[len("unix://"):])
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        if resp.status != 200:
+            raise DiscoveryError(f"docker {path}: HTTP {resp.status}")
+        return json.loads(data)
+    base = host.rstrip("/")
+    if base.startswith("tcp://"):
+        base = "http://" + base[len("tcp://"):]
+    return _get_json(base + path)
+
+
+def docker_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Container discovery against the Docker Engine API
+    (lib/promscrape/discovery/docker): one target per container network,
+    port = first private port (or `port` from the config)."""
+    host = cfg.get("host", "unix:///var/run/docker.sock")
+    dport = int(cfg.get("port", 80))
+    try:
+        containers = _docker_get(host, "/containers/json")
+    except (OSError, ValueError, DiscoveryError) as e:
+        raise DiscoveryError(f"docker_sd {host}: {e}") from e
+    out: list[tuple[str, dict]] = []
+    for c in containers or []:
+        names = c.get("Names") or ["/"]
+        meta_base = {
+            "__meta_docker_container_id": c.get("Id", ""),
+            "__meta_docker_container_name": names[0],
+            "__meta_docker_container_state": c.get("State", ""),
+        }
+        for k, v in (c.get("Labels") or {}).items():
+            meta_base[f"__meta_docker_container_label_{_sanitize(k)}"] = v
+        ports = [p for p in (c.get("Ports") or [])
+                 if p.get("PrivatePort")]
+        nets = (c.get("NetworkSettings") or {}).get("Networks") or {}
+        for net_name, net in nets.items():
+            ip = net.get("IPAddress", "")
+            if not ip:
+                continue
+            meta = dict(meta_base)
+            meta["__meta_docker_network_name"] = net_name
+            meta["__meta_docker_network_ip"] = ip
+            if ports:
+                p = ports[0]
+                meta["__meta_docker_port_private"] = str(p["PrivatePort"])
+                if p.get("PublicPort"):
+                    meta["__meta_docker_port_public"] = str(p["PublicPort"])
+                out.append((f"{ip}:{p['PrivatePort']}", meta))
+            else:
+                out.append((f"{ip}:{dport}", meta))
+    return out
+
+
+# -- gce (discovery/gce/) ----------------------------------------------------
+
+def gce_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """GCE instance discovery (lib/promscrape/discovery/gce): compute API
+    instance list with metadata-server auth; `api_server` points it at
+    fakes."""
+    project = cfg.get("project", "")
+    zone = cfg.get("zone", "")
+    if not project or not zone:
+        raise DiscoveryError("gce_sd: project and zone are required")
+    api = cfg.get("api_server",
+                  "https://compute.googleapis.com").rstrip("/")
+    port = int(cfg.get("port", 80))
+    headers = {}
+    token = cfg.get("access_token", "")
+    if not token and "googleapis.com" in api:
+        try:
+            req = urllib.request.Request(
+                "http://metadata.google.internal/computeMetadata/v1/"
+                "instance/service-accounts/default/token",
+                headers={"Metadata-Flavor": "Google"})
+            with urllib.request.urlopen(req, timeout=5) as r:
+                token = json.load(r)["access_token"]
+        except Exception as e:
+            raise DiscoveryError(f"gce_sd: metadata token: {e}") from e
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    url = (f"{api}/compute/v1/projects/{project}/zones/{zone}/instances")
+    out: list[tuple[str, dict]] = []
+    try:
+        while True:
+            resp = _get_json(url, headers)
+            for inst in resp.get("items", []):
+                ifaces = inst.get("networkInterfaces") or []
+                ip = ifaces[0].get("networkIP", "") if ifaces else ""
+                if not ip:
+                    continue
+                meta = {
+                    "__meta_gce_instance_id": str(inst.get("id", "")),
+                    "__meta_gce_instance_name": inst.get("name", ""),
+                    "__meta_gce_instance_status": inst.get("status", ""),
+                    "__meta_gce_machine_type":
+                        inst.get("machineType", "").rsplit("/", 1)[-1],
+                    "__meta_gce_network":
+                        (ifaces[0].get("network", "").rsplit("/", 1)[-1]
+                         if ifaces else ""),
+                    "__meta_gce_private_ip": ip,
+                    "__meta_gce_project": project,
+                    "__meta_gce_zone": zone,
+                }
+                for it in (inst.get("metadata") or {}).get("items", []):
+                    meta[f"__meta_gce_metadata_{_sanitize(it['key'])}"] = \
+                        it.get("value", "")
+                tags = (inst.get("tags") or {}).get("items", [])
+                if tags:
+                    # separator-wrapped, so `,tag,` regexes match every
+                    # position (Prometheus gce_sd format)
+                    meta["__meta_gce_tags"] = "," + ",".join(tags) + ","
+                ac = ifaces[0].get("accessConfigs") if ifaces else None
+                if ac and ac[0].get("natIP"):
+                    meta["__meta_gce_public_ip"] = ac[0]["natIP"]
+                out.append((f"{ip}:{port}", meta))
+            tok = resp.get("nextPageToken")
+            if not tok:
+                break
+            url = (f"{api}/compute/v1/projects/{project}/zones/{zone}"
+                   f"/instances?pageToken={tok}")
+    except (OSError, ValueError) as e:
+        raise DiscoveryError(f"gce_sd {api}: {e}") from e
+    return out
+
+
+# -- azure (discovery/azure/) ------------------------------------------------
+
+def azure_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Azure VM discovery (lib/promscrape/discovery/azure): ARM VM list +
+    NIC private-IP resolution, OAuth client-credentials auth.
+    `api_server`/`token_url` overrides point it at fakes."""
+    sub = cfg.get("subscription_id", "")
+    if not sub:
+        raise DiscoveryError("azure_sd: subscription_id is required")
+    api = cfg.get("api_server",
+                  "https://management.azure.com").rstrip("/")
+    port = int(cfg.get("port", 80))
+    headers = {}
+    token = cfg.get("access_token", "")
+    if not token and cfg.get("client_id"):
+        import urllib.parse
+        tenant = cfg.get("tenant_id", "")
+        token_url = cfg.get(
+            "token_url",
+            f"https://login.microsoftonline.com/{tenant}/oauth2/token")
+        body = urllib.parse.urlencode({
+            "grant_type": "client_credentials",
+            "client_id": cfg["client_id"],
+            "client_secret": cfg.get("client_secret", ""),
+            "resource": api + "/",
+        }).encode()
+        try:
+            req = urllib.request.Request(token_url, data=body)
+            with urllib.request.urlopen(req, timeout=10) as r:
+                token = json.load(r)["access_token"]
+        except Exception as e:
+            raise DiscoveryError(f"azure_sd: token: {e}") from e
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    rg = cfg.get("resource_group", "")
+    scope = (f"/subscriptions/{sub}/resourceGroups/{rg}" if rg
+             else f"/subscriptions/{sub}")
+    url = (f"{api}{scope}/providers/Microsoft.Compute/virtualMachines"
+           f"?api-version=2022-03-01")
+    out: list[tuple[str, dict]] = []
+    try:
+        while url:
+            resp = _get_json(url, headers)
+            for vm in resp.get("value", []):
+                props = vm.get("properties") or {}
+                meta = {
+                    "__meta_azure_machine_id": vm.get("id", ""),
+                    "__meta_azure_machine_name": vm.get("name", ""),
+                    "__meta_azure_machine_location":
+                        vm.get("location", ""),
+                    "__meta_azure_machine_resource_group":
+                        vm.get("id", "").split("/resourceGroups/")[-1]
+                        .split("/")[0] if "/resourceGroups/" in
+                        vm.get("id", "") else "",
+                    "__meta_azure_machine_os_type":
+                        ((props.get("storageProfile") or {})
+                         .get("osDisk") or {}).get("osType", ""),
+                    "__meta_azure_subscription_id": sub,
+                }
+                for k, v in (vm.get("tags") or {}).items():
+                    meta[f"__meta_azure_machine_tag_{_sanitize(k)}"] = v
+                ip = ""
+                nics = ((props.get("networkProfile") or {})
+                        .get("networkInterfaces") or [])
+                if nics:
+                    nic_url = (f"{api}{nics[0].get('id', '')}"
+                               f"?api-version=2022-05-01")
+                    nic = _get_json(nic_url, headers)
+                    for ipc in ((nic.get("properties") or {})
+                                .get("ipConfigurations") or []):
+                        ip = (ipc.get("properties") or {}).get(
+                            "privateIPAddress", "")
+                        if ip:
+                            break
+                if not ip:
+                    continue
+                meta["__meta_azure_machine_private_ip"] = ip
+                out.append((f"{ip}:{port}", meta))
+            url = resp.get("nextLink", "")
+    except (OSError, ValueError) as e:
+        raise DiscoveryError(f"azure_sd {api}: {e}") from e
+    return out
+
+
 PROVIDERS = {
     "kubernetes_sd_configs": kubernetes_sd,
     "consul_sd_configs": consul_sd,
     "ec2_sd_configs": ec2_sd,
+    "http_sd_configs": http_sd,
+    "dns_sd_configs": dns_sd,
+    "docker_sd_configs": docker_sd,
+    "gce_sd_configs": gce_sd,
+    "azure_sd_configs": azure_sd,
 }
 
 

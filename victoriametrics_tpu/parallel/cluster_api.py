@@ -193,10 +193,12 @@ def make_storage_handlers(storage) -> dict:
 
 class StorageNodeClient:
     def __init__(self, host: str, insert_port: int, select_port: int,
-                 name: str | None = None):
+                 name: str | None = None, timeout: float = 10.0):
         self.name = name or f"{host}:{insert_port}"
-        self.insert = RPCClient(host, insert_port, HELLO_INSERT)
-        self.select = RPCClient(host, select_port, HELLO_SELECT)
+        self.insert = RPCClient(host, insert_port, HELLO_INSERT,
+                                timeout=timeout)
+        self.select = RPCClient(host, select_port, HELLO_SELECT,
+                                timeout=timeout)
         self.down_until = 0.0
 
     @property
