@@ -67,20 +67,42 @@ def main() -> None:
     try:
         s = Storage(tmp)
 
-        # -- ingest: realistic jittered counters through the real write path
+        # -- ingest: realistic jittered counters through the real write
+        # path — the COLUMNAR pipeline HTTP ingest uses (raw text series
+        # keys resolved by the native key map, no per-row Python)
+        from victoriametrics_tpu import native
         base = np.arange(N_SAMPLES, dtype=np.int64) * 15_000 + t_start
-        labels = [{"__name__": "http_requests_total",
-                   "instance": f"host-{i % N_INSTANCES}",
-                   "job": f"job-{i % 17}", "idx": str(i)}
-                  for i in range(N_SERIES)]
+        keys = [(f'http_requests_total{{idx="{i}",'
+                 f'instance="host-{i % N_INSTANCES}",'
+                 f'job="job-{i % 17}"}}').encode()
+                for i in range(N_SERIES)]
+        keybuf = b"".join(keys)
+        klens = np.fromiter((len(k) for k in keys), np.int64, N_SERIES)
+        koffs = np.concatenate([[0], np.cumsum(klens)[:-1]])
         last_val = np.zeros(N_SERIES)
+
+        def columnar_rows(ts2, vals2):
+            """(S, K) timestamp/value arrays -> one ColumnarRows batch."""
+            k = ts2.shape[1]
+            return native.ColumnarRows(
+                keybuf, np.repeat(koffs, k), np.repeat(klens, k),
+                ts2.reshape(-1).astype(np.int64), vals2.reshape(-1))
+
         t0 = time.perf_counter()
-        for i in range(N_SERIES):
-            ts = np.sort(base + rng.integers(-2000, 2001, N_SAMPLES))
-            vals = np.cumsum(rng.integers(0, 50, N_SAMPLES)).astype(float)
-            last_val[i] = vals[-1]
-            s.add_rows(list(zip([labels[i]] * N_SAMPLES, ts.tolist(),
-                                vals.tolist())))
+        chunk = 256  # series per batch: ~368k-row columnar batches
+        for i0 in range(0, N_SERIES, chunk):
+            i1 = min(i0 + chunk, N_SERIES)
+            ts2 = np.sort(base[None, :] +
+                          rng.integers(-2000, 2001, (i1 - i0, N_SAMPLES)),
+                          axis=1)
+            vals2 = np.cumsum(rng.integers(0, 50, (i1 - i0, N_SAMPLES)),
+                              axis=1).astype(np.float64)
+            last_val[i0:i1] = vals2[:, -1]
+            cr = native.ColumnarRows(
+                keybuf, np.repeat(koffs[i0:i1], N_SAMPLES),
+                np.repeat(klens[i0:i1], N_SAMPLES),
+                ts2.reshape(-1), vals2.reshape(-1))
+            s.add_rows_columnar(cr)
         ingest_dt = time.perf_counter() - t0
         ingest_rate = N_SERIES * N_SAMPLES / ingest_dt
         s.force_flush()
@@ -101,14 +123,14 @@ def main() -> None:
 
         def ingest_fresh(end_ms: int) -> None:
             """4 new scrapes per series in (end_ms - STEP, end_ms]."""
-            rows = []
-            for i in range(N_SERIES):
-                for k in range(4):
-                    last_val[i] += float(rng.integers(0, 50))
-                    t = end_ms - STEP + (k + 1) * 15_000 + \
-                        int(rng.integers(-2000, 2001))
-                    rows.append((labels[i], t, last_val[i]))
-            s.add_rows(rows)
+            incr = rng.integers(0, 50, (N_SERIES, 4))
+            vals2 = last_val[:, None] + np.cumsum(incr, axis=1)
+            last_val[:] = vals2[:, -1]
+            ts2 = (end_ms - STEP +
+                   (np.arange(4, dtype=np.int64) + 1)[None, :] * 15_000 +
+                   rng.integers(-2000, 2001, (N_SERIES, 4)))
+            ts2.sort(axis=1)
+            s.add_rows_columnar(columnar_rows(ts2, vals2.astype(np.float64)))
 
         results = {}
         traces = {}

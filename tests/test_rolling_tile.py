@@ -256,8 +256,17 @@ class TestRollingTile:
             q = QUERIES[0]
             _run(store, q, engine, T0 - 300_000, T0)
             end = T0
+            # append at the data FRONTIER (store seeds through T0+285s):
+            # strictly-newest regular-cadence ingest, the production rolling
+            # shape. Interleaving new batches BELOW existing samples would
+            # create double-density intervals whose scrape-interval
+            # estimate drift flips marginal prev gates — rollup-cache
+            # reused columns legitimately keep compute-time estimates
+            # (rollup_result_cache.go:283 contract).
+            frontier = T0 + 285_000 + 15_000
             for k in range(12):
-                _ingest_newer(store, end + 10_000, n=8, n_series=70)
+                _ingest_newer(store, frontier, n=8, n_series=70)
+                frontier += 8 * 15_000
                 end += STEP * 2
                 host = _run(store, q, None, end - 300_000, end)
                 dev = _run(store, q, engine, end - 300_000, end)

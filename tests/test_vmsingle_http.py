@@ -173,6 +173,56 @@ class TestMetadataAPIs:
         code, body = app.get("/api/v1/status/tsdb")
         data = json.loads(body)["data"]
         assert data["totalSeries"] == 4
+        assert data["labelValueCountByLabelName"]
+
+    def test_status_tsdb_drilldown(self, app):
+        ingest_remote_write(app)
+        app.post("/api/v1/import/prometheus", b'other{idx="9"} 1\n')
+        code, body = app.get("/api/v1/status/tsdb",
+                             **{"match[]": "rw_metric",
+                                "focusLabel": "idx"})
+        data = json.loads(body)["data"]
+        assert data["totalSeries"] == 4  # `other` filtered out
+        focus = {e["name"]: e["count"]
+                 for e in data["seriesCountByFocusLabelValue"]}
+        assert focus == {"0": 1, "1": 1, "2": 1, "3": 1}
+
+    def test_relabel_debug(self, app):
+        cfg = ("- action: drop\n  source_labels: [idx]\n  regex: '1'\n"
+               "- action: replace\n  target_label: dc\n"
+               "  replacement: eu1\n")
+        code, body = app.get("/metric-relabel-debug",
+                             metric='m{idx="0"}', relabel_configs=cfg)
+        assert code == 200
+        d = json.loads(body)
+        assert d["resultingLabels"]["dc"] == "eu1"
+        assert len(d["steps"]) == 2 and not d["dropped"]
+        code, body = app.get("/metric-relabel-debug",
+                             metric='m{idx="1"}', relabel_configs=cfg)
+        d = json.loads(body)
+        assert d["dropped"] and d["steps"][0]["out"] is None
+
+    def test_prettify_and_parse_query(self, app):
+        code, body = app.get("/prettify-query",
+                             query="sum(rate(m[5m]))by(job)")
+        d = json.loads(body)
+        assert d["status"] == "success" and "by (job)" in d["query"] \
+            or "by(job)" in d["query"].replace(" ", "")
+        code, body = app.get("/api/v1/parse-query",
+                             query="sum(rate(m[5m]))")
+        d = json.loads(body)
+        assert d["status"] == "success"
+        assert d["ast"]["kind"] == "AggrFuncExpr"
+        kinds = []
+
+        def walk(n):
+            kinds.append(n["kind"])
+            for c in n.get("children", []):
+                walk(c)
+        walk(d["ast"])
+        assert "RollupExpr" in kinds or "FuncExpr" in kinds
+        code, body = app.get("/prettify-query", query="sum((")
+        assert json.loads(body)["status"] == "error"
 
     def test_delete_series(self, app):
         ingest_remote_write(app)

@@ -283,6 +283,11 @@ class PrometheusAPI:
         r("/api/v1/status/tsdb", self.h_status_tsdb)
         r("/api/v1/status/active_queries", self.h_active_queries)
         r("/api/v1/status/top_queries", self.h_top_queries)
+        r("/metric-relabel-debug", self.h_relabel_debug)
+        r("/prettify-query", self.h_prettify_query)
+        r("/expand-with-exprs", self.h_prettify_query)  # WITH folding is
+        # part of parsing: the canonical string has templates expanded
+        r("/api/v1/parse-query", self.h_query_ast)
         r("/api/v1/metadata", self.h_metadata)
         r("/api/v1/status/metric_names_stats", self.h_name_stats)
         r("/federate", self.h_federate)
@@ -983,10 +988,105 @@ class PrometheusAPI:
             if date:
                 d = int(datetime.datetime.fromisoformat(date).timestamp()
                         // 86400)
-        except ValueError as e:
+            fl = self._matches_to_filters(req)
+        except (ValueError, QueryError, ParseError) as e:
             return Response.error(f"bad arg: {e}", 400)
-        st = self.storage.tsdb_status(d, topn, tenant=self._tenant(req))
+        kw = {}
+        if fl:
+            kw["filters"] = fl[0]  # drill-down selector (match[])
+        focus = req.arg("focusLabel")
+        if focus:
+            kw["focus_label"] = focus
+        try:
+            st = self.storage.tsdb_status(d, topn, tenant=self._tenant(req),
+                                          **kw)
+        except TypeError:
+            # cluster backend: no drill-down over RPC yet — serve the
+            # unfiltered explorer rather than failing
+            st = self.storage.tsdb_status(d, topn, tenant=self._tenant(req))
         return Response.json({"status": "success", "data": st})
+
+    def h_relabel_debug(self, req: Request) -> Response:
+        """Relabel debugger (reference /metric-relabel-debug +
+        vmui's relabel playground): applies a relabel config to one metric
+        step by step and returns every intermediate label set."""
+        from ..ingest import parsers
+        from ..ingest.relabel import parse_relabel_configs
+        metric = req.arg("metric")
+        cfg_text = req.arg("relabel_configs")
+        if not metric:
+            return Response.error("missing `metric` arg", 400)
+        try:
+            labels = dict(parsers.labels_from_series_key(
+                metric.strip().encode()))
+        except ValueError as e:
+            return Response.error(f"cannot parse metric: {e}", 400)
+        try:
+            cfg = parse_relabel_configs(cfg_text or "")
+        except (ValueError, KeyError) as e:
+            return Response.error(f"cannot parse relabel config: {e}", 400)
+        steps = []
+        cur: dict | None = dict(labels)
+        for rc in cfg.configs:
+            before = dict(cur)
+            cur = rc.apply(cur)
+            desc = {"action": rc.action}
+            if rc.source_labels:
+                desc["source_labels"] = rc.source_labels
+            if rc.regex_orig is not None:
+                desc["regex"] = str(rc.regex_orig)
+            if rc.target_label:
+                desc["target_label"] = rc.target_label
+            if rc.replacement != "$1":
+                desc["replacement"] = rc.replacement
+            steps.append({"rule": desc, "in": before,
+                          "out": dict(cur) if cur is not None else None})
+            if cur is None:
+                break
+        final = cfg.apply(dict(labels))
+        return Response.json({"status": "success",
+                              "originalLabels": labels,
+                              "steps": steps,
+                              "resultingLabels": final or None,
+                              "dropped": not final})
+
+    def h_prettify_query(self, req: Request) -> Response:
+        """Canonicalize/pretty-print a MetricsQL expression (reference
+        /prettify-query): parse -> AST -> formatted text. A parse error
+        comes back as status=error with the message."""
+        q = req.arg("query")
+        try:
+            expr = mql_parse(q)
+        except (ParseError, QueryError) as e:
+            return Response.json({"status": "error", "msg": str(e)})
+        return Response.json({"status": "success", "query": str(expr)})
+
+    def h_query_ast(self, req: Request) -> Response:
+        """AST explorer for the vmui query analyzer: the parsed expression
+        as a nested-node JSON tree."""
+        q = req.arg("query")
+        try:
+            expr = mql_parse(q)
+        except (ParseError, QueryError) as e:
+            return Response.json({"status": "error", "msg": str(e)})
+
+        def node(e):
+            d = {"kind": type(e).__name__, "text": str(e)}
+            kids = []
+            for attr in ("args", ):
+                for c in getattr(e, attr, []) or []:
+                    if hasattr(c, "__class__") and hasattr(c, "__module__") \
+                            and "ast" in type(c).__module__:
+                        kids.append(node(c))
+            for attr in ("expr", "left", "right"):
+                c = getattr(e, attr, None)
+                if c is not None and hasattr(type(c), "__module__") and \
+                        "ast" in type(c).__module__:
+                    kids.append(node(c))
+            if kids:
+                d["children"] = kids
+            return d
+        return Response.json({"status": "success", "ast": node(expr)})
 
     def h_active_queries(self, req: Request) -> Response:
         return Response.json({"status": "ok",

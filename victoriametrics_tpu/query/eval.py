@@ -831,7 +831,8 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
     if roll_state_key is not None:
         stv = aux_get(ec.tpu, roll_state_key)
         if stv is not None:
-            rt, gids_dev, group_keys, qx = stv
+            rt, gids_dev, group_keys, qx = stv[:4]
+            oc = stv[4] if len(stv) > 4 else None
             start = ec.start - offset
             end = ec.end - offset
             fetch_lo = start - lookback - ec.lookback_delta
@@ -847,23 +848,69 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                 ec.count_samples(rt.samples_in_range(fetch_lo))
                 cfg2 = RollupConfig(start=start, end=end, step=ec.step,
                                     window=lookback)
-                shift = start - rt.base_ms
-                # fetch truncation in the shifted frame: prev samples older
-                # than this behave as if never fetched
-                min_ts = fetch_lo - start
-                qk = qt.new_child("fused kernel + D2H")
-                if qx is not None:
-                    slots_dev, max_group = qx
-                    out = run_quantile_on_tiles(
-                        ec.tpu, phi, func, rt.tiles, gids_dev, slots_dev,
-                        len(group_keys), max_group, cfg2, shift, min_ts)
-                else:
-                    out = run_fused_on_tiles(ec.tpu, ae.name, func,
-                                             rt.tiles, gids_dev,
-                                             len(group_keys), cfg2, shift,
-                                             min_ts)
-                qk.donef("[%d, %d] float64 out", len(group_keys),
-                         out.shape[1] if out.ndim > 1 else 0)
+                def kernel(kcfg):
+                    # grid shift + fetch truncation are relative to the
+                    # KERNEL grid's start (the tail sub-grid rebases both)
+                    sh = kcfg.start - rt.base_ms
+                    mt = fetch_lo - kcfg.start
+                    if qx is not None:
+                        slots_dev, max_group = qx
+                        return run_quantile_on_tiles(
+                            ec.tpu, phi, func, rt.tiles, gids_dev,
+                            slots_dev, len(group_keys), max_group, kcfg,
+                            sh, mt)
+                    return run_fused_on_tiles(ec.tpu, ae.name, func,
+                                              rt.tiles, gids_dev,
+                                              len(group_keys), kcfg, sh,
+                                              mt)
+
+                # Incremental grid: an advanced window re-uses the previous
+                # [G, T] result for every column at or before the previous
+                # end — append-only ingest (watermark-guarded) cannot touch
+                # windows ending there, so only the columns past the
+                # previous end run on device (the rollupResultCache
+                # tail-merge contract, rollup_result_cache.go:283, done at
+                # the [G, T] level; like the reference cache, re-used
+                # columns keep the scrape-interval estimates they were
+                # computed under).
+                T_cols = (end - start) // ec.step + 1
+                out = None
+                if (oc is not None and oc.get("out") is not None
+                        and oc["step"] == ec.step
+                        and oc["window"] == lookback
+                        and start >= oc["start"] and end >= oc["end"]
+                        and (start - oc["start"]) % ec.step == 0
+                        and (end - oc["end"]) % ec.step == 0):
+                    shift_cols = (start - oc["start"]) // ec.step
+                    keep = oc["out"].shape[1] - shift_cols
+                    n_new = T_cols - keep
+                    if 0 < keep <= T_cols and n_new >= 0:
+                        if n_new == 0:
+                            out = oc["out"][:, shift_cols:
+                                            shift_cols + T_cols]
+                            qt.printf("pure shift: %d columns reused",
+                                      T_cols)
+                        else:
+                            qk = qt.new_child("fused tail kernel + D2H")
+                            # one extra leading column keeps start < end:
+                            # a single-column sub-grid would hit the
+                            # instant-query maxPrevInterval rule
+                            # (rollup.go:719-728) and flip prev gating
+                            tail = kernel(RollupConfig(
+                                start=end - n_new * ec.step, end=end,
+                                step=ec.step, window=lookback))[:, 1:]
+                            out = np.concatenate(
+                                [oc["out"][:, shift_cols:], tail], axis=1)
+                            qk.donef("[%d, %d] tail, %d columns reused",
+                                     len(group_keys), n_new, keep)
+                if out is None:
+                    qk = qt.new_child("fused kernel + D2H")
+                    out = kernel(cfg2)
+                    qk.donef("[%d, %d] float64 out", len(group_keys),
+                             out.shape[1] if out.ndim > 1 else 0)
+                if oc is not None:
+                    oc.update(out=out, start=start, end=end, step=ec.step,
+                              window=lookback)
                 qt.donef("advanced tile (%d appends), %d groups",
                          rt.appends, len(group_keys))
                 return _emit(out, group_keys)
@@ -954,7 +1001,9 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                         n_samples=n_fetched, adopted_key=tile_key)
                     aux_put(ec.tpu, roll_tile_key, rt)
                 aux_put(ec.tpu, roll_state_key,
-                        (rt, jnp.asarray(gids), list(group_keys), qx))
+                        (rt, jnp.asarray(gids), list(group_keys), qx,
+                         {"out": out, "start": cfg.start, "end": cfg.end,
+                          "step": cfg.step, "window": cfg.lookback}))
     return _emit(out, group_keys)
 
 

@@ -102,6 +102,7 @@ class IndexDB:
         self._name_cache: dict[int, MetricName] = {}
         self._tsid_cache: dict[int, TSID] = {}
         self._filter_cache: "dict[tuple, tuple[int, np.ndarray]]" = {}
+        self._tsids_result_cache: "dict[tuple, tuple[int, list]]" = {}
         self.filter_cache_requests = 0
         self.filter_cache_hits = 0
 
@@ -479,9 +480,24 @@ class IndexDB:
                 ids.add(mid)
         return np.array(sorted(ids), dtype=np.uint64)
 
+    MAX_TSIDS_CACHE = 256
+
     def search_tsids(self, filters: list[TagFilter],
                      min_ts: int | None = None,
                      max_ts: int | None = None, tenant=(0, 0)) -> list[TSID]:
+        # gen-validated result memo: a rolling dashboard repeats the same
+        # selector every refresh; the id->TSID resolution + sort (~ms per
+        # 10k series) would otherwise run every time
+        ckey = (tenant,
+                tuple((tf.key, tf.value, tf.negate, tf.regex)
+                      for tf in filters),
+                None if min_ts is None else date_of_ms(min_ts),
+                None if max_ts is None else date_of_ms(max_ts))
+        with self._lock:
+            got = self._tsids_result_cache.get(ckey)
+            if got is not None and got[0] == self._gen:
+                return got[1]
+            gen = self._gen
         mids = self.search_metric_ids(filters, min_ts, max_ts, tenant)
         out = []
         for mid in mids:
@@ -489,6 +505,10 @@ class IndexDB:
             if t is not None:
                 out.append(t)
         out.sort(key=TSID.sort_key)
+        with self._lock:
+            if len(self._tsids_result_cache) >= self.MAX_TSIDS_CACHE:
+                self._tsids_result_cache.clear()
+            self._tsids_result_cache[ckey] = (gen, out)
         return out
 
     # -- label APIs --------------------------------------------------------

@@ -37,6 +37,7 @@ class InmemoryPart:
     def __init__(self, blocks: list[Block]):
         self._blocks = blocks
         self._segs = None
+        self._lazy = None
         self.rows = sum(b.rows for b in blocks)
         self.min_ts = min((int(b.timestamps[0]) for b in blocks),
                           default=1 << 62)
@@ -51,6 +52,7 @@ class InmemoryPart:
         iterates them; the batched fetch path reads the arrays directly."""
         self = cls.__new__(cls)
         self._blocks = None
+        self._lazy = None
         self._segs = (segs, all_ts, mants, exps, precision_bits)
         self.rows = int(all_ts.size)
         self.min_ts = int(all_ts.min()) if all_ts.size else 1 << 62
@@ -67,13 +69,43 @@ class InmemoryPart:
                       starts, all_ts, mants)
         return self
 
+    @classmethod
+    def from_seg_arrays(cls, starts, ends, mids_sorted, tsid_at, all_ts,
+                        mants, exps, precision_bits=64):
+        """Fully array-backed construction: per-block TSID objects resolve
+        LAZILY (tsid_at(row_index) -> TSID) only if a legacy per-block
+        consumer iterates — the columnar fetch path never pays the
+        per-series Python object loop."""
+        self = cls.__new__(cls)
+        self._blocks = None
+        self._segs = None
+        self._lazy = (starts, ends, tsid_at, precision_bits)
+        self.rows = int(all_ts.size)
+        self.min_ts = int(all_ts.min()) if all_ts.size else 1 << 62
+        self.max_ts = int(all_ts.max()) if all_ts.size else -(1 << 62)
+        cnts = ends - starts
+        bmin = all_ts[starts] if starts.size else np.zeros(0, np.int64)
+        bmax = all_ts[ends - 1] if starts.size else np.zeros(0, np.int64)
+        self._cols = (mids_sorted[starts].astype(np.int64), cnts,
+                      np.asarray(exps, np.int64), bmin, bmax, starts,
+                      all_ts, mants)
+        return self
+
     @property
     def block_list(self):
         if self._blocks is None:
-            segs, all_ts, mants, exps, prec = self._segs
-            self._blocks = [
-                Block(tsid, all_ts[a:b], mants[a:b], int(exps[k]), prec)
-                for k, (tsid, a, b) in enumerate(segs)]
+            if self._segs is not None:
+                segs, all_ts, mants, exps, prec = self._segs
+                self._blocks = [
+                    Block(tsid, all_ts[a:b], mants[a:b], int(exps[k]), prec)
+                    for k, (tsid, a, b) in enumerate(segs)]
+            else:
+                starts, ends, tsid_at, prec = self._lazy
+                _, _, exps, _, _, _, all_ts, mants = self._cols
+                self._blocks = [
+                    Block(tsid_at(int(a)), all_ts[a:b], mants[a:b],
+                          int(exps[k]), prec)
+                    for k, (a, b) in enumerate(zip(starts, ends))]
         return self._blocks
 
     def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None):
@@ -272,23 +304,32 @@ def _mixed_to_inmemory_part(items: list, precision_bits: int) -> InmemoryPart:
     owner = owner[order]
     loc = loc[order]
     series_starts = np.concatenate(
-        [[0], np.flatnonzero(mid[1:] != mid[:-1]) + 1, [n]])
+        [[0], np.flatnonzero(mid[1:] != mid[:-1]) + 1, [n]]).astype(np.int64)
 
     def tsid_at(r: int):
         o = owner[r]
         return tups[loc[r]][0] if o < 0 else chunks[o].space.tsids[loc[r]]
 
-    segs = []
-    for a, b in zip(series_starts[:-1], series_starts[1:]):
-        tsid = tsid_at(a)
-        for x in range(a, b, MAX_ROWS_PER_BLOCK):
-            segs.append((tsid, x, min(x + MAX_ROWS_PER_BLOCK, b)))
-    if not segs:
+    lens = np.diff(series_starts)
+    if int(lens.max(initial=0)) <= MAX_ROWS_PER_BLOCK:
+        # common case (scrape batches are tiny per series): one block per
+        # series, fully vectorized — no per-series Python loop
+        starts = series_starts[:-1]
+        ends = series_starts[1:]
+    else:
+        pieces_s = []
+        pieces_e = []
+        for a, b in zip(series_starts[:-1], series_starts[1:]):
+            xs = np.arange(a, b, MAX_ROWS_PER_BLOCK, dtype=np.int64)
+            pieces_s.append(xs)
+            pieces_e.append(np.minimum(xs + MAX_ROWS_PER_BLOCK, b))
+        starts = np.concatenate(pieces_s)
+        ends = np.concatenate(pieces_e)
+    if starts.size == 0:
         return InmemoryPart([])
-    starts = np.array([a for _, a, _ in segs], dtype=np.int64)
     m_all, exps = float_to_decimal_grouped(all_vals, starts)
-    return InmemoryPart.from_columns(segs, all_ts, m_all, exps,
-                                     precision_bits)
+    return InmemoryPart.from_seg_arrays(starts, ends, mid, tsid_at, all_ts,
+                                        m_all, exps, precision_bits)
 
 
 def _merge_block_streams(sources, deleted_ids: np.ndarray | None,

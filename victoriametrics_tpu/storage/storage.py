@@ -850,14 +850,27 @@ class Storage:
         return self.idb.tenants()
 
     def tsdb_status(self, date: int | None = None, topn: int = 10,
-                    tenant=(0, 0)) -> dict:
-        """Cardinality explorer data (GetTSDBStatus, index_db.go:1284)."""
+                    tenant=(0, 0), filters=None,
+                    focus_label: str = "") -> dict:
+        """Cardinality explorer data (GetTSDBStatus, index_db.go:1284).
+        `filters` (match[] selectors) restrict the series set — the
+        explorer's drill-down; `focus_label` adds a per-value breakdown of
+        that label (focusLabel)."""
         by_metric: dict[bytes, int] = {}
         by_label: dict[bytes, int] = {}
         by_pair: dict[bytes, int] = {}
-        mids = (self.idb._metric_ids_for_date(date, tenant)
-                if date is not None
-                else self.idb._all_metric_ids(tenant))
+        by_focus: dict[bytes, int] = {}
+        values_per_label: dict[bytes, set] = {}
+        fl = focus_label.encode()
+        if filters:
+            mids = self.idb.search_metric_ids(filters, tenant=tenant)
+            if date is not None:
+                day = self.idb._metric_ids_for_date(date, tenant)
+                mids = np.intersect1d(mids, day, assume_unique=True)
+        else:
+            mids = (self.idb._metric_ids_for_date(date, tenant)
+                    if date is not None
+                    else self.idb._all_metric_ids(tenant))
         for mid in mids:
             mn = self.idb.get_metric_name_by_id(int(mid))
             if mn is None:
@@ -867,17 +880,25 @@ class Storage:
                 by_label[k] = by_label.get(k, 0) + 1
                 pair = k + b"=" + v
                 by_pair[pair] = by_pair.get(pair, 0) + 1
+                values_per_label.setdefault(k, set()).add(v)
+                if fl and k == fl:
+                    by_focus[v] = by_focus.get(v, 0) + 1
 
         def top(d):
             return [{"name": k.decode("utf-8", "replace"), "count": c}
                     for k, c in sorted(d.items(), key=lambda kv: -kv[1])[:topn]]
 
-        return {
+        out = {
             "totalSeries": int(mids.size),
             "seriesCountByMetricName": top(by_metric),
             "seriesCountByLabelName": top(by_label),
             "seriesCountByLabelValuePair": top(by_pair),
+            "labelValueCountByLabelName": top(
+                {k: len(v) for k, v in values_per_label.items()}),
         }
+        if fl:
+            out["seriesCountByFocusLabelValue"] = top(by_focus)
+        return out
 
     # -- deletes -----------------------------------------------------------
 
