@@ -87,6 +87,14 @@ def _configure(lib):
                                      i64, pi64, ctypes.c_int32]
     lib.vm_decimal_to_float_blocks.restype = None
     lib.vm_decimal_to_float_blocks.argtypes = [pi64, pi64, pi64, i64, pf64]
+    lib.vm_clip_blocks.restype = None
+    lib.vm_clip_blocks.argtypes = [pi64, pi64, pi64, i64, i64, i64,
+                                   pi64, pi64]
+    lib.vm_gather_rows2.restype = None
+    lib.vm_gather_rows2.argtypes = [pi64, pi64, pi64, pi64, i64, pi64, pi64]
+    lib.vm_scatter_pad.restype = None
+    lib.vm_scatter_pad.argtypes = [pi64, pf64, pi64, pi64, i64, i64, i64,
+                                   i64, pi64, pf64, pi64]
     lib.vm_counter_resets_2d.restype = None
     lib.vm_counter_resets_2d.argtypes = [pf64, i64, i64, pf64]
     lib.vm_rollup_counter_2d.restype = None
@@ -270,6 +278,54 @@ def decimal_to_float_blocks(m: np.ndarray, group_offsets: np.ndarray,
     lib.vm_decimal_to_float_blocks(
         _as_i64_ptr(m), _as_i64_ptr(group_offsets), _as_i64_ptr(exps), k,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+
+
+def clip_blocks(ts: np.ndarray, bstart: np.ndarray, bend: np.ndarray,
+                lo: int, hi: int):
+    """Per-block [lo, hi]-inclusive kept row range over the concatenated
+    (per-block sorted) timestamp column: block i spans rows
+    [bstart[i], bend[i]). Returns (keep_lo, keep_hi) index arrays."""
+    lib = _load()
+    k = int(bstart.size)
+    out_lo = np.empty(k, np.int64)
+    out_hi = np.empty(k, np.int64)
+    lib.vm_clip_blocks(_as_i64_ptr(ts), _as_i64_ptr(bstart),
+                       _as_i64_ptr(bend), k, int(lo), int(hi),
+                       _as_i64_ptr(out_lo), _as_i64_ptr(out_hi))
+    return out_lo, out_hi
+
+
+def gather_rows2(a: np.ndarray, b: np.ndarray, keep_lo: np.ndarray,
+                 keep_hi: np.ndarray, total: int):
+    """Densely gather kept row ranges of two parallel int64 columns (per-
+    segment memcpy; `total` = sum of range lengths)."""
+    lib = _load()
+    out_a = np.empty(total, np.int64)
+    out_b = np.empty(total, np.int64)
+    lib.vm_gather_rows2(_as_i64_ptr(a), _as_i64_ptr(b),
+                        _as_i64_ptr(keep_lo), _as_i64_ptr(keep_hi),
+                        int(keep_lo.size), _as_i64_ptr(out_a),
+                        _as_i64_ptr(out_b))
+    return out_a, out_b
+
+
+def scatter_pad(ts_all: np.ndarray, vals_f: np.ndarray, cnts: np.ndarray,
+                rows: np.ndarray, S: int, N: int, pad_ts: int):
+    """Scatter pre-grouped blocks into padded (S, N) tiles; returns
+    (ts2, v2, counts). Appends block k's samples to row rows[k] in input
+    order, pads row tails with (pad_ts, 0.0)."""
+    lib = _load()
+    ts2 = np.empty((S, N), np.int64)
+    v2 = np.empty((S, N), np.float64)
+    fill = np.zeros(S, np.int64)
+    lib.vm_scatter_pad(
+        _as_i64_ptr(ts_all),
+        vals_f.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _as_i64_ptr(cnts), _as_i64_ptr(rows), int(cnts.size), int(S),
+        int(N), int(pad_ts), _as_i64_ptr(ts2),
+        v2.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _as_i64_ptr(fill))
+    return ts2, v2, fill
 
 
 def counter_resets_2d(v: np.ndarray) -> np.ndarray:

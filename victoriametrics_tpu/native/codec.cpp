@@ -431,6 +431,78 @@ void vm_decimal_to_float_blocks(const int64_t* m, const int64_t* go,
 }
 
 // ---------------------------------------------------------------------------
+// per-block time clipping: the part_search.go block-pruning analog at ROW
+// granularity. For K blocks over the concatenated timestamp column, find the
+// [lo, hi]-inclusive kept row range of each block by binary search (each
+// block's timestamps are sorted). Blocks fully inside the range cost two
+// ~20-compare searches; the caller gathers only kept rows, so a tail fetch
+// of M samples costs O(M + K log rows) instead of O(total decoded rows).
+// ---------------------------------------------------------------------------
+
+void vm_clip_blocks(const int64_t* ts, const int64_t* bstart,
+                    const int64_t* bend, int64_t k, int64_t lo, int64_t hi,
+                    int64_t* out_lo, int64_t* out_hi) {
+    for (int64_t i = 0; i < k; i++) {
+        int64_t a = bstart[i], b = bend[i];
+        // first index with ts >= lo
+        int64_t l = a, r = b;
+        while (l < r) {
+            int64_t m = l + ((r - l) >> 1);
+            if (ts[m] < lo) l = m + 1; else r = m;
+        }
+        out_lo[i] = l;
+        // first index with ts > hi
+        r = b;
+        while (l < r) {
+            int64_t m = l + ((r - l) >> 1);
+            if (ts[m] <= hi) l = m + 1; else r = m;
+        }
+        out_hi[i] = l;
+    }
+}
+
+// Gather the kept row ranges of two parallel int64 columns into dense
+// output (the companion of vm_clip_blocks): out gets a[keep_lo[i]:
+// keep_hi[i]] for each block, concatenated. Pure per-segment memcpy — no
+// index arrays materialize.
+void vm_gather_rows2(const int64_t* a, const int64_t* b,
+                     const int64_t* keep_lo, const int64_t* keep_hi,
+                     int64_t k, int64_t* out_a, int64_t* out_b) {
+    int64_t o = 0;
+    for (int64_t i = 0; i < k; i++) {
+        int64_t n = keep_hi[i] - keep_lo[i];
+        if (n <= 0) continue;
+        memcpy(out_a + o, a + keep_lo[i], (size_t)n * sizeof(int64_t));
+        memcpy(out_b + o, b + keep_lo[i], (size_t)n * sizeof(int64_t));
+        o += n;
+    }
+}
+
+// Scatter K pre-grouped blocks into the padded (S, N) tile layout: block k
+// appends its cnts[k] samples to row rows[k] (input order within a row is
+// preserved), then every row's tail is padded (pad_ts / 0.0). fill must be
+// zeroed S-sized scratch; it ends up holding the per-row valid counts.
+void vm_scatter_pad(const int64_t* ts, const double* vals,
+                    const int64_t* cnts, const int64_t* rows, int64_t K,
+                    int64_t S, int64_t N, int64_t pad_ts,
+                    int64_t* ts2, double* v2, int64_t* fill) {
+    int64_t off = 0;
+    for (int64_t k = 0; k < K; k++) {
+        int64_t r = rows[k], n = cnts[k];
+        memcpy(ts2 + r * N + fill[r], ts + off, (size_t)n * sizeof(int64_t));
+        memcpy(v2 + r * N + fill[r], vals + off, (size_t)n * sizeof(double));
+        fill[r] += n;
+        off += n;
+    }
+    for (int64_t s = 0; s < S; s++) {
+        for (int64_t j = fill[s]; j < N; j++) {
+            ts2[s * N + j] = pad_ts;
+            v2[s * N + j] = 0.0;
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
 // counter-reset removal (rollup.go:921 removeCounterResets), row-batched
 // ---------------------------------------------------------------------------
 

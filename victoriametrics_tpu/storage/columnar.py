@@ -122,8 +122,17 @@ def assemble(rows: np.ndarray, S: int, cnts: np.ndarray, ts_all: np.ndarray,
     series_tot = np.bincount(rows, weights=cnts,
                              minlength=S).astype(np.int64)
     N = int(series_tot.max())
+    from .. import native as _native
     single_block = bool((blocks_per_row <= 1).all())
-    if single_block and tot == S * N:
+    if _native.available():
+        # one native pass: per-block memcpy into the padded layout (no
+        # index arrays, no PAD prefill) — the scatter cost is pure sample
+        # bandwidth for every block shape
+        ts2, v2, _fill = _native.scatter_pad(
+            np.ascontiguousarray(ts_all, np.int64),
+            np.ascontiguousarray(vals_f, np.float64),
+            cnts, rows, S, N, PAD_TS)
+    elif single_block and tot == S * N:
         # one block per series, uniform length: a single row-scatter of the
         # reshaped decode output (the common scrape-grid case)
         ts2 = np.empty((S, N), dtype=np.int64)

@@ -29,6 +29,31 @@ from .tsid import TSID
 HEADERS_PER_INDEX_BLOCK = 256
 _META_ROW = struct.Struct(">32sIQIqq")
 
+# global budget for whole-part decoded-row memos (Part._dec), shared across
+# every open part so many hot parts cannot pin unbounded RAM (the
+# lib/blockcache 25%-of-RAM role); released on part close/GC
+import threading as _threading
+
+DEC_CACHE_TOTAL_BYTES = int(os.environ.get("VM_DEC_CACHE_TOTAL_MB",
+                                           2048)) << 20
+_dec_budget_lock = _threading.Lock()
+_dec_budget_used = 0
+
+
+def _dec_budget_take(cost: int) -> bool:
+    global _dec_budget_used
+    with _dec_budget_lock:
+        if _dec_budget_used + cost > DEC_CACHE_TOTAL_BYTES:
+            return False
+        _dec_budget_used += cost
+        return True
+
+
+def _dec_budget_release(cost: int) -> None:
+    global _dec_budget_used
+    with _dec_budget_lock:
+        _dec_budget_used -= cost
+
 # numpy mirror of BlockHeader's struct layout (">32sqqIhBBBqqQIQI"); the
 # TSID's trailing 8 bytes are the metric_id (tsid.py _FMT ">IIQIIQ"), split
 # out so header selection is pure array masking
@@ -43,6 +68,70 @@ def sorted_member_mask(mids_sorted, mids: np.ndarray) -> np.ndarray:
     pos = np.searchsorted(mids_sorted, mids)
     pos_c = np.minimum(pos, len(mids_sorted) - 1)
     return (mids_sorted[pos_c] == mids) & (pos < len(mids_sorted))
+
+
+def _clip_gather(mids, scales, ts_src, m_src, bstart, bend, min_ts, max_ts,
+                 unchanged=None):
+    """Shared core of the row-granular time clip: block i of the piece
+    lives at rows [bstart[i], bend[i]) of ts_src/m_src. Keeps only samples
+    in [min_ts, max_ts], drops emptied blocks, densely gathers survivors.
+    Returns (mids, cnts, scales, ts, mants) — or `unchanged` verbatim when
+    nothing clips (callers pass their no-copy representation)."""
+    k = int(bstart.size)
+    lo = -(1 << 62) if min_ts is None else min_ts
+    hi = (1 << 62) if max_ts is None else max_ts
+    from .. import native as _native
+    if _native.available():
+        ts_src = np.ascontiguousarray(ts_src)
+        m_src = np.ascontiguousarray(m_src)
+        keep_lo, keep_hi = _native.clip_blocks(ts_src, bstart, bend, lo, hi)
+    else:
+        keep_lo = np.empty(k, np.int64)
+        keep_hi = np.empty(k, np.int64)
+        for i in range(k):
+            a, b = int(bstart[i]), int(bend[i])
+            seg = ts_src[a:b]
+            keep_lo[i] = a + np.searchsorted(seg, lo, side="left")
+            keep_hi[i] = a + np.searchsorted(seg, hi, side="right")
+    new_cnts = keep_hi - keep_lo
+    kept = int(new_cnts.sum())
+    if unchanged is not None and kept == int(bend[-1] - bstart[0]) \
+            and bool((bend[:-1] == bstart[1:]).all()):
+        return unchanged
+    nz = new_cnts > 0
+    if not nz.all():
+        mids, scales = mids[nz], scales[nz]
+        keep_lo, keep_hi = keep_lo[nz], keep_hi[nz]
+        new_cnts = new_cnts[nz]
+    if kept == 0:
+        return (mids, new_cnts, scales, np.zeros(0, np.int64),
+                np.zeros(0, np.int64))
+    if _native.available():
+        ts_k, m_k = _native.gather_rows2(ts_src, m_src, keep_lo, keep_hi,
+                                         kept)
+    else:
+        excl = np.cumsum(new_cnts) - new_cnts
+        pos = np.repeat(keep_lo - excl, new_cnts) + \
+            np.arange(kept, dtype=np.int64)
+        ts_k, m_k = ts_src[pos], m_src[pos]
+    return mids, new_cnts, scales, ts_k, m_k
+
+
+def clip_piece(mids, cnts, scales, ts_all, m_all, min_ts, max_ts):
+    """Row-granular time clip of one collected piece: keep only samples in
+    [min_ts, max_ts] (the part_search.go pruning taken down to rows, so a
+    tail fetch of M samples costs O(M) downstream — float conversion and
+    (S, N) assembly never see out-of-range rows). Blocks left empty are
+    dropped. No-ops (returning the inputs unchanged) when nothing clips."""
+    k = int(cnts.size)
+    if k == 0 or ts_all.size == 0:
+        return mids, cnts, scales, ts_all, m_all
+    goff = np.empty(k + 1, np.int64)
+    goff[0] = 0
+    np.cumsum(cnts, out=goff[1:])
+    return _clip_gather(mids, scales, ts_all, m_all, goff[:-1].copy(),
+                        goff[1:].copy(), min_ts, max_ts,
+                        unchanged=(mids, cnts, scales, ts_all, m_all))
 
 
 _HDR_DTYPE = np.dtype([
@@ -196,10 +285,28 @@ class Part:
         self._block_cache: "OrderedDict[tuple, Block]" = OrderedDict()
         self._block_cache_bytes = 0
         self._hdr_cols = None  # lazy columnar view of all block headers
+        self._dec = None  # memoized whole-part decode (ts, mant, goff)
+        self._dec_cost = 0
 
     def close(self):
+        self._release_dec()
         for f in (self._idx_f, self._ts_f, self._val_f):
             f.close()
+
+    def _release_dec(self):
+        with self._lock:
+            cost, self._dec_cost = self._dec_cost, 0
+            self._dec = None
+        if cost:
+            _dec_budget_release(cost)
+
+    def __del__(self):
+        # merged-away parts are dropped by GC without close(); give their
+        # memo budget back
+        try:
+            self._release_dec()
+        except Exception:
+            pass
 
     def _read(self, f, off: int, size: int) -> bytes:
         with self._lock:
@@ -309,11 +416,18 @@ class Part:
 
     def collect_columns(self, mids_sorted, min_ts, max_ts):
         """Vectorized header selection + ONE native decode pass over every
-        matched block. Returns (mids, cnts, scales, ts_concat, mant_concat);
-        None when the native path is unavailable (caller falls back to the
-        per-header object path); False when the vectorized path RAN and
-        nothing matched (caller skips this part — do not collapse the two
-        sentinels, Partition.collect_columns branches on them)."""
+        matched block, row-clipped to [min_ts, max_ts]. Returns (mids,
+        cnts, scales, ts_concat, mant_concat); None when the native path is
+        unavailable (caller falls back to the per-header object path);
+        False when the vectorized path RAN and nothing matched (caller
+        skips this part — do not collapse the two sentinels,
+        Partition.collect_columns branches on them).
+
+        When a whole-part decode fits MAX_BLOCK_CACHE_BYTES, the decoded
+        (ts, mantissa) columns are memoized — the part is immutable, so
+        every later fetch (rolling dashboard refreshes, cache tail merges,
+        device tile slice loads) is a clip+gather with NO decode at all
+        (the lib/blockcache role, but holding decoded rows)."""
         from .. import native as _native
         if self._ts_buf is None or not _native.available():
             return None
@@ -325,6 +439,15 @@ class Part:
         idx = np.flatnonzero(mask)
         if idx.size == 0:
             return False
+        dec = self._dec
+        if dec is not None:
+            ts_full, m_full, goff_full = dec
+            piece = _clip_gather(
+                np.ascontiguousarray(hc["mid"][idx]),
+                np.ascontiguousarray(hc["scale"][idx]),
+                ts_full, m_full, goff_full[idx], goff_full[idx + 1],
+                min_ts, max_ts)
+            return piece if piece[3].size else False
         ts_mt = np.ascontiguousarray(hc["ts_mt"][idx])
         val_mt = np.ascontiguousarray(hc["val_mt"][idx])
         if not _native.has_zstd() and \
@@ -344,8 +467,22 @@ class Part:
             np.ascontiguousarray(hc["val_size"][idx]), val_mt,
             np.ascontiguousarray(hc["val_first"][idx]), cnt, m_out,
             validate_ts=False)
-        return (np.ascontiguousarray(hc["mid"][idx]), cnt,
-                np.ascontiguousarray(hc["scale"][idx]), ts_out, m_out)
+        if idx.size == hc["mid"].size and self._dec is None and \
+                _dec_budget_take(16 * total):
+            goff_full = np.empty(idx.size + 1, np.int64)
+            goff_full[0] = 0
+            np.cumsum(cnt, out=goff_full[1:])
+            ts_out.setflags(write=False)
+            m_out.setflags(write=False)
+            with self._lock:
+                if self._dec is None:
+                    self._dec = (ts_out, m_out, goff_full)
+                    self._dec_cost = 16 * total
+                else:
+                    _dec_budget_release(16 * total)
+        return clip_piece(np.ascontiguousarray(hc["mid"][idx]), cnt,
+                          np.ascontiguousarray(hc["scale"][idx]),
+                          ts_out, m_out, min_ts, max_ts)
 
     def read_blocks_columns(self, hdrs: list[BlockHeader]):
         """Batched decode of many blocks in ONE native call per stream

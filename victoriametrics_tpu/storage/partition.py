@@ -29,6 +29,13 @@ from .part import Part, PartWriter
 
 MAX_PENDING_ROWS = 256 << 10
 MAX_SMALL_PARTS = 15
+# merged blocks span at most this much time, so tail fetches prune at the
+# block-header level instead of decoding a series' whole history (0 = off).
+# The rows floor keeps sparse series (e.g. 1/min scrapes) from exploding
+# into tiny blocks: a span split never produces blocks under 256 rows, so
+# header/index overhead stays <~0.4B per sample.
+MAX_BLOCK_SPAN_MS = int(os.environ.get("VM_BLOCK_SPAN_MS", 3600 * 1000))
+MIN_SPAN_SPLIT_ROWS = 256
 
 
 class InmemoryPart:
@@ -374,10 +381,21 @@ def _merge_block_streams(sources, deleted_ids: np.ndarray | None,
             ts, vals = deduplicate(ts, vals, dedup_interval)
         out = []
         tsid = pending_tsid
-        for i in range(0, ts.size, MAX_ROWS_PER_BLOCK):
-            j = min(i + MAX_ROWS_PER_BLOCK, ts.size)
-            if j > i:
-                out.append(Block(tsid, ts[i:j], vals[i:j], scale))
+        # split by row cap AND time span: span-capped blocks keep the
+        # header-level time pruning effective after big merges collapse a
+        # series into few blocks, so a tail fetch decodes O(tail) rows (the
+        # reference's 8k-row cap does this implicitly at real scrape rates,
+        # lib/storage/block.go:15)
+        i, n = 0, int(ts.size)
+        while i < n:
+            j = min(i + MAX_ROWS_PER_BLOCK, n)
+            if MAX_BLOCK_SPAN_MS > 0 and j > i + MIN_SPAN_SPLIT_ROWS:
+                j_span = i + int(np.searchsorted(
+                    ts[i:j], ts[i] + MAX_BLOCK_SPAN_MS, side="left"))
+                if j_span < j:
+                    j = max(i + MIN_SPAN_SPLIT_ROWS, j_span)
+            out.append(Block(tsid, ts[i:j], vals[i:j], scale))
+            i = j
         pending_tsid = None
         pend_ts, pend_vals, pend_scales = [], [], []
         return out
@@ -638,13 +656,14 @@ class Partition:
             mids_sorted.sort()
         lo = -(1 << 62) if min_ts is None else min_ts
         hi = (1 << 62) if max_ts is None else max_ts
+        from .part import clip_piece
         pieces = []
         for src in mems:
             if src.max_ts < lo or src.min_ts > hi:
                 continue
             piece = src.collect_columns(mids_sorted, min_ts, max_ts)
             if piece is not None:
-                pieces.append(piece)
+                pieces.append(clip_piece(*piece, min_ts, max_ts))
         for p in files:
             if p.max_ts < lo or p.min_ts > hi:
                 continue
@@ -652,7 +671,7 @@ class Partition:
             if piece is False:
                 continue  # vectorized path ran; nothing matched
             if piece is not None:
-                pieces.append(piece)
+                pieces.append(piece)  # already row-clipped
                 continue
             # fallback: native decode unavailable — per-header object path
             hdrs = list(p.iter_headers(tsid_set, min_ts, max_ts,
@@ -661,11 +680,11 @@ class Partition:
                 continue
             K = len(hdrs)
             ts_c, m_c = p.read_blocks_columns(hdrs)
-            pieces.append((
+            pieces.append(clip_piece(
                 np.fromiter((h.tsid.metric_id for h in hdrs), np.int64, K),
                 np.fromiter((h.rows for h in hdrs), np.int64, K),
                 np.fromiter((h.scale for h in hdrs), np.int64, K),
-                ts_c, m_c))
+                ts_c, m_c, min_ts, max_ts))
         return pieces
 
     @property

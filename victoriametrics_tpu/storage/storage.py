@@ -702,12 +702,15 @@ class Storage:
             return empty
         if len(pieces) == 1:
             mids, cnts, scales, ts_all, mant_all = pieces[0]
+            piece_ids = None  # one piece: every block shares provenance
         else:
             mids = np.concatenate([p[0] for p in pieces])
             cnts = np.concatenate([p[1] for p in pieces])
             scales = np.concatenate([p[2] for p in pieces])
             ts_all = np.concatenate([p[3] for p in pieces])
             mant_all = np.concatenate([p[4] for p in pieces])
+            piece_ids = np.repeat(np.arange(len(pieces)),
+                                  [p[0].size for p in pieces])
         # mantissas -> float64 with per-block exponents, one native pass
         from .. import native as _native
         vals_f = np.empty(mant_all.size, np.float64)
@@ -732,8 +735,18 @@ class Storage:
         have = np.array([int(m) in names for m in uniq], bool)
         kept = uniq[have]
         raws = [names[int(m)][1] for m in kept]
-        perm = np.argsort(np.array(raws, dtype=object), kind="stable") \
-            if len(raws) > 1 else np.arange(len(raws), dtype=np.int64)
+        if len(raws) > 1:
+            # fixed-width bytes argsort (C memcmp) instead of a Python-object
+            # compare per element; numpy's S dtype strips trailing NULs, so
+            # names ending in \0 (never produced by MetricName.marshal, but
+            # cheap to guard) take the object path
+            if any(r[-1:] == b"\x00" for r in raws):
+                arr = np.array(raws, dtype=object)
+            else:
+                arr = np.array(raws)
+            perm = np.argsort(arr, kind="stable")
+        else:
+            perm = np.arange(len(raws), dtype=np.int64)
         ordered_mids = kept[perm]
         # rank[j] = final row of kept[j]
         rank = np.empty(perm.size, np.int64)
@@ -747,10 +760,30 @@ class Storage:
                 mids, cnts = mids[bkeep], cnts[bkeep]
                 ts_all = ts_all[sample_keep]
                 vals_f = vals_f[sample_keep]
+                if piece_ids is not None:
+                    piece_ids = piece_ids[bkeep]
             pos_in_kept = np.searchsorted(kept, mids)
         else:
             pos_in_kept = pos_in_uniq
         block_rows = rank[pos_in_kept]
+        # coalesce adjacent same-series blocks within one piece: a part's
+        # blocks are (tsid, min_ts)-sorted, so a series' span-capped blocks
+        # concatenate in time order — assemble then sees one block per
+        # (series, part) and its uniform-grid reshape fast path survives
+        # the block-span cap (never across pieces: cross-part rows overlap
+        # in time and must keep the per-row sort fix)
+        K = int(block_rows.size)
+        if K > 1:
+            same = block_rows[1:] == block_rows[:-1]
+            if piece_ids is not None:
+                same &= piece_ids[1:] == piece_ids[:-1]
+            if bool(same.any()):
+                starts_blk = np.empty(K, bool)
+                starts_blk[0] = True
+                np.logical_not(same, out=starts_blk[1:])
+                seg = np.cumsum(starts_blk) - 1
+                cnts = np.bincount(seg, weights=cnts).astype(np.int64)
+                block_rows = block_rows[starts_blk]
         cols = assemble(block_rows, int(kept.size), cnts, ts_all, vals_f,
                         min_ts, max_ts, interval, metric_ids=ordered_mids)
         if cols.dropped_rows is not None:
