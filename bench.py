@@ -14,15 +14,24 @@ scatter + traced grid shift; no re-fetch, no re-upload, no recompile), the
 host backend leans on the eval rollup cache's tail merge. Neither backend
 can serve a pure result-cache hit: every refresh sees new bounds AND new
 data. Cold (nocache first query, incl. jit compile) and ingest rates are
-reported inside the metric label. Tiles are float64 — the same numerics the
-golden conformance suite pins.
+reported inside the metric label.
+
+Backend policy — LOUD, never silent: the accelerator is probed in a
+subprocess with a hard deadline (utils/tpu_probe.py) before any in-process
+jax init. The probe outcome is printed to stderr and recorded in the JSON
+as "backend" ("tpu" / "cpu-device" / "host-only:<reason>"); a
+requested-but-absent device engine can no longer masquerade as a device
+result (the round-3 artifact failure). Tile dtype follows the engine's
+auto rule: f32 rebased tiles on real TPU (f64 is emulated there; error
+bounds in tests/test_f32_tiles.py), f64 on CPU-XLA.
 
 Throughput accounting: each refresh logically serves the samples a cold
 evaluation of that window would scan (series x fetch-range samples); the
 rate divides that by the measured p50 refresh latency.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N,
+   "backend": ...}
 
 vs_baseline divides by 1e8 samples/sec — the order of the reference's
 single-core block-unpack + rollup scan rate (its netstorage unpack workers
@@ -38,10 +47,9 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import sys
 import tempfile
 import time
-
-os.environ.setdefault("JAX_ENABLE_X64", "1")  # f64 tiles (before jax import)
 
 import numpy as np
 
@@ -52,7 +60,37 @@ STEP = 60_000
 REFRESHES = 6
 
 
+def _provision_engine():
+    """Probe the accelerator (bounded), set the x64 mode to match the tile
+    dtype, and build the device engine. Returns (engine, backend_label).
+    NEVER silent: every degradation prints its reason to stderr."""
+    from victoriametrics_tpu.utils.tpu_probe import probe_backend
+    timeout = float(os.environ.get("VM_TPU_PROBE_TIMEOUT_S", "90"))
+    platform, n, err = probe_backend(timeout)
+    if err is not None:
+        print(f"bench: DEVICE BACKEND UNAVAILABLE -> host-only path: {err}",
+              file=sys.stderr)
+        return None, f"host-only:{err.split(':')[0]}"
+    if platform != "tpu":
+        # CPU-XLA: f64 tiles need x64 (must be set before jax imports)
+        os.environ.setdefault("JAX_ENABLE_X64", "1")
+    print(f"bench: accelerator probe OK: {n} {platform} device(s)",
+          file=sys.stderr)
+    try:
+        from victoriametrics_tpu.query.tpu_engine import TPUEngine
+        engine = TPUEngine()
+        label = ("tpu" if platform == "tpu" else "cpu-device") + \
+            f"-{np.dtype(engine.value_dtype).name}"
+        return engine, label
+    except Exception as e:  # loud: the engine must not vanish silently
+        print(f"bench: DEVICE ENGINE INIT FAILED -> host-only path: {e!r}",
+              file=sys.stderr)
+        return None, f"host-only:{type(e).__name__}"
+
+
 def main() -> None:
+    engine, backend_label = _provision_engine()
+
     from victoriametrics_tpu.query.exec import exec_query
     from victoriametrics_tpu.query.types import EvalConfig
     from victoriametrics_tpu.storage.storage import Storage

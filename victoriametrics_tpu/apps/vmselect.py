@@ -53,10 +53,8 @@ def build(args):
     cluster = ClusterStorage(
         make_nodes(args.storageNode, getattr(args, "rpc_timeout", 10.0)),
         deny_partial_response=args.deny_partial)
-    tpu_engine = None
-    if args.tpu:
-        from ..query.tpu_engine import TPUEngine, auto_mesh
-        tpu_engine = TPUEngine(mesh=auto_mesh())
+    from .vmsingle import _make_tpu_engine
+    tpu_engine = _make_tpu_engine(args.tpu)
     hh, _, hp = args.httpListenAddr.rpartition(":")
     srv = HTTPServer(hh or "0.0.0.0", int(hp))
     from .vmsingle import _dur_ms

@@ -88,6 +88,25 @@ def _dur_ms(s: str, months_ok=False) -> int:
     return int(ms)
 
 
+def _make_tpu_engine(enabled: bool):
+    """-search.tpuBackend startup: probe the accelerator with a hard
+    deadline BEFORE any in-process jax init (a hung TPU plugin must degrade
+    the server to the host path, not wedge startup), then build the engine
+    with its auto dtype (f32 tiles on real TPU, f64 elsewhere)."""
+    if not enabled:
+        return None
+    from ..utils.tpu_probe import probe_backend
+    timeout = float(os.environ.get("VM_TPU_PROBE_TIMEOUT_S", "90"))
+    platform, n, err = probe_backend(timeout)
+    if err is not None:
+        logger.errorf("tpu backend requested but unavailable (%s); "
+                      "serving on the host path", err)
+        return None
+    logger.infof("accelerator probe: %d %s device(s)", n, platform)
+    from ..query.tpu_engine import TPUEngine, auto_mesh
+    return TPUEngine(mesh=auto_mesh())
+
+
 def build(args):
     from ..httpapi.prometheus_api import PrometheusAPI
     from ..httpapi.server import HTTPServer
@@ -99,10 +118,7 @@ def build(args):
                       dedup_interval_ms=dedup,
                       max_hourly_series=args.max_hourly_series,
                       max_daily_series=args.max_daily_series)
-    tpu_engine = None
-    if args.tpu:
-        from ..query.tpu_engine import TPUEngine, auto_mesh
-        tpu_engine = TPUEngine(mesh=auto_mesh())
+    tpu_engine = _make_tpu_engine(args.tpu)
     relabel = None
     if args.relabel_config:
         from ..ingest.relabel import parse_relabel_configs

@@ -56,7 +56,7 @@ class QueryPipeline:
 
         def run(ts, values, counts, group_ids):
             return fn(ts, values, counts, group_ids, np.int32(0),
-                      MIN_TS_NONE)
+                      MIN_TS_NONE, jnp.zeros(ts.shape[0], values.dtype))
         return run
 
 

@@ -51,8 +51,13 @@ class TileCache:
         self.misses = 0
 
     def _tree_bytes(self, tree) -> int:
-        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                   for a in jax.tree_util.tree_leaves(tree))
+        total = 0
+        for a in jax.tree_util.tree_leaves(tree):
+            if hasattr(a, "shape") and hasattr(a, "dtype"):
+                total += int(np.prod(a.shape)) * a.dtype.itemsize
+            elif hasattr(a, "offsets"):  # V0Info host companion
+                total += a.offsets.nbytes
+        return total
 
     def get(self, key):
         with self._lock:

@@ -58,10 +58,14 @@ def _narrowest_plane(d2: np.ndarray):
     return np.int32
 
 
-def pack_delta_planes(series, start_ms: int, value_dtype=np.float32
-                      ) -> DeltaPlanes | None:
+def pack_delta_planes(series, start_ms: int, value_dtype=np.float32,
+                      rebase: bool = False) -> DeltaPlanes | None:
     """series: [(ts_ms int64[], mantissas int64[], exponent)] — returns None
-    when any series needs >int32 intermediates (caller falls back)."""
+    when any series needs >int32 intermediates (caller falls back).
+
+    `rebase=True` additionally requires every m - m[0] to fit int32: the
+    f32 tile decode reconstructs REBASED mantissas (cumsum from zero), so
+    the running offsets are the intermediates (see tpu_engine f32 design)."""
     S = len(series)
     if S == 0:
         return None
@@ -81,6 +85,8 @@ def pack_delta_planes(series, start_ms: int, value_dtype=np.float32
         m = np.asarray(m, dtype=np.int64)
         if rel.size and (np.abs(rel).max() >= 2**31 or
                          np.abs(m).max() >= 2**31):
+            return None
+        if rebase and m.size and np.abs(m - m[0]).max() >= 2**31:
             return None
         ts_first[i] = rel[0]
         val_first[i] = m[0]
@@ -126,17 +132,22 @@ def _reconstruct(first, fdelta, d2, counts, n):
 
 
 @functools.partial(__import__("jax").jit,
-                   static_argnames=("n", "value_dtype"))
+                   static_argnames=("n", "value_dtype", "rebase"))
 def decode_tiles(planes_ts_first, planes_ts_fd, planes_ts_d2,
                  planes_val_first, planes_val_fd, planes_val_d2,
-                 scale, counts, n: int, value_dtype=np.float32):
-    """On-device decode of delta planes -> (ts int32 [S,n], vals [S,n])."""
+                 scale, counts, n: int, value_dtype=np.float32,
+                 rebase: bool = False):
+    """On-device decode of delta planes -> (ts int32 [S,n], vals [S,n]).
+
+    `rebase=True` reconstructs mantissas from ZERO instead of the first
+    mantissa — the tile then holds v - v0 exactly in integer space before
+    the one dtype-rounding scale multiply (the f32 tile contract)."""
     import jax.numpy as jnp
     ts = _reconstruct(planes_ts_first, planes_ts_fd, planes_ts_d2, counts, n)
     valid = jnp.arange(n, dtype=jnp.int32)[None, :] < counts[:, None]
     ts = jnp.where(valid, ts, TS_PAD)
-    mant = _reconstruct(planes_val_first, planes_val_fd, planes_val_d2,
-                        counts, n)
+    vfirst = (planes_val_first * 0) if rebase else planes_val_first
+    mant = _reconstruct(vfirst, planes_val_fd, planes_val_d2, counts, n)
     vals = mant.astype(value_dtype) * scale[:, None].astype(value_dtype)
     return ts, vals
 

@@ -140,15 +140,26 @@ def _rmq_query(tables: list[jnp.ndarray], lo: jnp.ndarray, hi: jnp.ndarray,
     return op(a, b)
 
 
-def _remove_counter_resets(v: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+def _remove_counter_resets(v: jnp.ndarray, valid: jnp.ndarray,
+                           v0=None) -> jnp.ndarray:
     """Monotonize counters: add back the lost base at each reset (prefix sum
-    of negative jumps). Pad positions contribute nothing."""
+    of negative jumps). Pad positions contribute nothing.
+
+    `v0` is the per-series REBASE offset when the tile holds rebased values
+    (f32 tiles store v - v[0]; see tpu_engine f32 design): the
+    reset-vs-correction threshold and the restarted base are defined on
+    ABSOLUTE values (rollup.go:921 compares against the previous absolute
+    sample), so both re-add v0. Classification happens in tile dtype — data
+    within one ulp of the 8x-drop boundary may classify differently from
+    the f64 host path (documented bound, tests/test_f32_tiles.py)."""
     vm = jnp.where(valid, v, 0.0)
     prev = jnp.concatenate([vm[:, :1], vm[:, :-1]], axis=1)
     pair_valid = valid & jnp.concatenate(
         [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+    prev_abs = prev if v0 is None else prev + v0[:, None].astype(v.dtype)
     drop = jnp.where(pair_valid & (vm < prev),
-                     jnp.where((prev - vm) * 8 < prev, prev - vm, prev), 0.0)
+                     jnp.where((prev - vm) * 8 < prev_abs, prev - vm,
+                               prev_abs), 0.0)
     return v + jnp.cumsum(drop, axis=1)
 
 
@@ -288,7 +299,7 @@ def _masked_window_reduce(ts: jnp.ndarray, cfg: RollupConfig, specs):
 @functools.partial(jax.jit, static_argnames=("func", "cfg"))
 def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
                 counts: jnp.ndarray, cfg: RollupConfig,
-                min_ts=MIN_TS_NONE) -> jnp.ndarray:
+                min_ts=MIN_TS_NONE, v0=None) -> jnp.ndarray:
     """Windowed rollup over a padded tile -> [S, T] float array (NaN = gap).
 
     `min_ts` (traced) reproduces the evaluator's fetch truncation on tiles
@@ -410,7 +421,7 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
         return jnp.where(have & (two | has_gprev), v_last - prev, nan)
 
     if func in ("increase", "increase_pure", "rate", "irate"):
-        cv = _remove_counter_resets(values, valid)
+        cv = _remove_counter_resets(values, valid, v0)
         # pads/invalid tails carry garbage values but ts == TS_PAD, so no
         # mask ever selects them; cv is non-decreasing on the valid prefix,
         # making last/first/prev exact max/min reductions (zero gathers)
@@ -621,7 +632,7 @@ def rollup_aggregate_tile(rollup_func: str, aggr: str, ts: jnp.ndarray,
                           values: jnp.ndarray, counts: jnp.ndarray,
                           group_ids: jnp.ndarray, cfg: RollupConfig,
                           num_groups: int, shift=0,
-                          min_ts=MIN_TS_NONE) -> jnp.ndarray:
+                          min_ts=MIN_TS_NONE, v0=None) -> jnp.ndarray:
     """Fused aggr(rollup(m[d])) over one tile -> [G, T].
 
     `shift` (traced int32, ms) rebases tile timestamps onto the cfg grid:
@@ -629,9 +640,9 @@ def rollup_aggregate_tile(rollup_func: str, aggr: str, ts: jnp.ndarray,
     query grid advances, so shift = query_start - tile_base. Time-valued
     funcs are not supported with shift != 0 (dispatch excludes them).
     `min_ts` is the query's fetch lower bound in the SHIFTED frame (see
-    rollup_tile)."""
+    rollup_tile); `v0` the per-series rebase offsets of f32 tiles."""
     rolled = rollup_tile(rollup_func, ts - jnp.int32(shift), values, counts,
-                         cfg, min_ts)
+                         cfg, min_ts, v0)
     return aggregate_groups(aggr, rolled, group_ids, num_groups)
 
 
@@ -686,13 +697,13 @@ def pack_series(series: list[tuple[np.ndarray, np.ndarray]], start_ms: int,
 @functools.partial(jax.jit, static_argnames=("func", "cfg", "k", "bottom"))
 def topk_select_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
                      counts: jnp.ndarray, cfg: RollupConfig, k: int,
-                     bottom: bool, min_ts=MIN_TS_NONE):
+                     bottom: bool, min_ts=MIN_TS_NONE, v0=None):
     """Per-timestamp topk/bottomk selection over a rolled tile: the [S, T]
     rollup never leaves the device — only [T, k] winner indices (+ NaN
     flags) cross the link, and the caller gathers just the selected rows
     (aggr.go topk/bottomk; host twin aggr_funcs.topk_mask_per_ts).
     Returns (rolled [device-resident], idx [T, k], sel_nan [T, k])."""
-    rolled = rollup_tile(func, ts, values, counts, cfg, min_ts)
+    rolled = rollup_tile(func, ts, values, counts, cfg, min_ts, v0)
     bad = jnp.isnan(rolled)
     key = jnp.where(bad, -jnp.inf, -rolled if bottom else rolled)
     _, idx = jax.lax.top_k(key.T, k)                   # [T, k]
@@ -702,11 +713,12 @@ def topk_select_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
 
 @functools.partial(jax.jit, static_argnames=("func", "kind", "cfg"))
 def rank_tile(func: str, kind: str, ts: jnp.ndarray, values: jnp.ndarray,
-              counts: jnp.ndarray, cfg: RollupConfig, min_ts=MIN_TS_NONE):
+              counts: jnp.ndarray, cfg: RollupConfig, min_ts=MIN_TS_NONE,
+              v0=None):
     """topk_<kind>/bottomk_<kind> ranking: the whole-series statistic
     (aggr_funcs.series_rank_metric twin) computed on device — D2H is one
     float per series; the caller gathers only the k selected rows."""
-    rolled = rollup_tile(func, ts, values, counts, cfg, min_ts)
+    rolled = rollup_tile(func, ts, values, counts, cfg, min_ts, v0)
     bad = jnp.isnan(rolled)
     n = jnp.sum(~bad, axis=1)
     if kind == "max":
@@ -749,7 +761,7 @@ def rollup_quantile_tile(rollup_func: str, phi, ts: jnp.ndarray,
                          group_ids: jnp.ndarray, slots: jnp.ndarray,
                          cfg: RollupConfig, num_groups: int,
                          max_group: int, shift=0,
-                         min_ts=MIN_TS_NONE) -> jnp.ndarray:
+                         min_ts=MIN_TS_NONE, v0=None) -> jnp.ndarray:
     """Fused quantile(phi, rollup(m[d])) by (...) -> [G, T].
 
     The per-series rollup [S, T] is scattered into a dense [G, M, T] tensor
@@ -759,7 +771,7 @@ def rollup_quantile_tile(rollup_func: str, phi, ts: jnp.ndarray,
     np.nanquantile semantics. The caller bounds G*M*T so skewed groupings
     fall back to the host path rather than exploding HBM."""
     rolled = rollup_tile(rollup_func, ts - jnp.int32(shift), values, counts,
-                         cfg, min_ts)  # [S, T]
+                         cfg, min_ts, v0)  # [S, T]
     S, T = rolled.shape
     dtype = rolled.dtype
     nan = jnp.asarray(jnp.nan, dtype)

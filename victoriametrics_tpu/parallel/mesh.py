@@ -64,8 +64,9 @@ def sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
 
     Inputs: ts [S, N] int32, values [S, N], counts [S] int32,
     group_ids [S] int32, shift int32 scalar (rolling-tile grid rebase, 0
-    for freshly built tiles); S must be divisible by the series-axis size.
-    Output: [G, T] fully replicated.
+    for freshly built tiles), min_ts int32 scalar, v0 [S] (per-series
+    rebase offsets of f32 tiles; zeros otherwise); S must be divisible by
+    the series-axis size. Output: [G, T] fully replicated.
     """
 
     _CROSS_REDUCE = {"sum": jax.lax.psum, "min": jax.lax.pmin,
@@ -74,11 +75,12 @@ def sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(AXIS_SERIES, None), P(AXIS_SERIES, None),
-                  P(AXIS_SERIES), P(AXIS_SERIES), P(), P()),
+                  P(AXIS_SERIES), P(AXIS_SERIES), P(), P(),
+                  P(AXIS_SERIES)),
         out_specs=P())
-    def step_moments(ts, values, counts, group_ids, shift, min_ts):
+    def step_moments(ts, values, counts, group_ids, shift, min_ts, v0):
         rolled = rollup_tile(rollup_func, ts - jnp.int32(shift), values,
-                             counts, cfg, min_ts)
+                             counts, cfg, min_ts, v0)
         # psum/pmin/pmax the raw moments across shards, then finalize —
         # the moment split lives in ops.device_rollup so the single-device
         # and sharded paths share one aggregation definition.
@@ -87,7 +89,14 @@ def sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
                    for k, (arr, kind) in moments.items()}
         return finalize_group_moments(aggr, reduced)
 
-    return jax.jit(step_moments)
+    jitted = jax.jit(step_moments)
+
+    def call(ts, values, counts, group_ids, shift, min_ts, v0=None):
+        if v0 is None:
+            v0 = jnp.zeros(ts.shape[0], values.dtype)
+        return jitted(ts, values, counts, group_ids, shift, min_ts, v0)
+
+    return call
 
 
 def time_sharded_rollup(mesh: Mesh, rollup_func: str, cfg: RollupConfig,

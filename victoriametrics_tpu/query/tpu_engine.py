@@ -16,21 +16,113 @@ import numpy as np
 from ..ops import rollup_np
 from ..ops.rollup_np import RollupConfig
 
-_F32_SAFE_FUNCS = frozenset({
-    "count_over_time", "present_over_time", "min_over_time", "max_over_time",
-    "first_over_time", "last_over_time", "default_rollup", "changes",
+# -- the f32 tile design ------------------------------------------------
+# Real TPUs have no native float64 (it is emulated, or silently truncated
+# without x64), so device tiles there are float32 holding REBASED values
+# v - v0, where v0 is the series' first uploaded value. The rebase happens
+# in exact integer mantissa space on device (delta planes reconstruct from
+# zero instead of the first mantissa), so a counter at 1e9 + small
+# increments keeps FULL precision in its deltas — the one f32 rounding is
+# the final scale multiply, bounding the error at ~2^-23 of the REBASED
+# magnitude (window dynamic range), not of the absolute value.
+#   F32_DIRECT funcs are shift-invariant (rate(v - v0) == rate(v)): they
+#     run unchanged. Counter-reset classification needs the absolute base,
+#     so kernels take v0 for the threshold compare (see
+#     device_rollup._remove_counter_resets; post-reset precision degrades
+#     to plain-f32 of the reset magnitude).
+#   F32_AFFINE funcs satisfy f(v) = f(v - v0) + v0: the [S, T] device
+#     output gets a host-side float64 addback per series (NaN gaps stay
+#     NaN). Only valid where per-series outputs come back (not fused
+#     cross-series aggregation, where group members have different v0).
+#   Everything else (sum_over_time needs n*v0; cross-series selection on
+#     absolute values) falls back to the f64 host path.
+# The host evaluator stays float64 — the golden conformance corpus pins
+# those numerics; tests/test_f32_tiles.py bounds device-vs-host error
+# differentially. Precedent for lossy device numerics: the storage codec
+# itself quantizes (lib/encoding/nearest_delta.go:15 precisionBits).
+F32_DIRECT = frozenset({
+    "count_over_time", "present_over_time", "stddev_over_time",
+    "stdvar_over_time", "changes", "delta", "idelta", "increase",
+    "increase_pure", "rate", "irate", "deriv", "deriv_fast", "lag",
+    "lifetime", "scrape_interval", "timestamp", "tfirst_over_time",
+    "tlast_over_time",
 })
+F32_AFFINE = frozenset({
+    "min_over_time", "max_over_time", "avg_over_time", "first_over_time",
+    "last_over_time", "default_rollup",
+})
+
+
+class V0Info:
+    """Host-side companion of an f32 tile: per-series rebase offsets
+    (float64 — the affine addback and append rebasing must not round
+    through f32) plus the wide-range flag.
+
+    `wide_range` is True when any series' REBASED magnitude |v - v0|
+    reaches 2^24 (f32's exact-integer limit) — e.g. a large-base counter
+    that resets mid-tile, or one that grows >16M within the window. The
+    rebase guarantees nothing there: every value-dependent func would see
+    ulp(|v - v0|)-sized noise, so they all fall back to the f64 host path
+    for such tiles (per-series patching is possible future work).
+    Value-free funcs (counts, timestamps) still run."""
+
+    __slots__ = ("offsets", "wide_range")
+
+    def __init__(self, offsets: np.ndarray, wide_range: bool):
+        self.offsets = offsets
+        self.wide_range = wide_range
+
+    def __getitem__(self, i):
+        return self.offsets[i]
+
+
+# funcs whose output never reads sample VALUES: immune to f32 value error
+VALUE_FREE_FUNCS = frozenset({
+    "count_over_time", "present_over_time", "lag", "lifetime",
+    "scrape_interval", "timestamp", "tfirst_over_time", "tlast_over_time",
+})
+# rebased-magnitude bound above which f32 value math is unsafe
+F32_SAFE_RANGE = float(1 << 24)
+
+
+def auto_value_dtype():
+    """float32 tiles on real TPU hardware; float64 elsewhere (CPU XLA has
+    native f64 — the conformance dtype)."""
+    try:
+        import jax
+        plat = jax.default_backend()
+    except Exception:
+        return np.float64
+    return np.float32 if plat == "tpu" else np.float64
 
 
 @dataclasses.dataclass
 class TPUEngine:
     cache_bytes: int = 2 << 30
-    value_dtype: object = np.float64
+    value_dtype: object = None  # None = auto (f32 on TPU, f64 elsewhere)
     min_series: int = 64        # below this the host path wins
     mesh: object = None         # jax.sharding.Mesh; series axis sharding
     last_roll_decline: str = ""  # why the last rolling advance fell back
     _cache: object = None
     _aux: object = None
+
+    def __post_init__(self):
+        if self.value_dtype is None:
+            self.value_dtype = auto_value_dtype()
+
+    def is_f32(self) -> bool:
+        return np.dtype(self.value_dtype) == np.float32
+
+    def func_mode(self, func: str, per_series: bool):
+        """How this engine's dtype can run `func`: "direct", "addback"
+        (per-series host f64 + v0), or None (host fallback)."""
+        if not self.is_f32():
+            return "direct"
+        if func in F32_DIRECT:
+            return "direct"
+        if per_series and func in F32_AFFINE:
+            return "addback"
+        return None
 
     def cache(self):
         if self._cache is None:
@@ -80,6 +172,9 @@ def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
     """Returns list of per-series value rows, or None for host fallback."""
     if func not in rollup_np.CORE_SUPPORTED:
         return None  # device kernels cover the core set; host batch the rest
+    mode = engine.func_mode(func, per_series=True)
+    if mode is None:
+        return None  # f32 tiles cannot run this func; host f64 path
     if args:
         return None
     if len(series) < engine.min_series:
@@ -103,11 +198,17 @@ def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
         # retain the DECODED device tiles (not the planes): hot queries then
         # run straight on HBM-resident data
         cache.put_device(key, tiles)
-    from ..ops.device_rollup import normalized_cfg
-    ts_t, v_t, counts = tiles
-    out = rollup_tile(func, ts_t, v_t, counts, normalized_cfg(func, cfg))
+    from ..ops.device_rollup import MIN_TS_NONE, normalized_cfg
+    if _counter_unsafe(engine, func, tiles):
+        return None
+    ts_t, v_t, counts, v0 = tiles
+    out = rollup_tile(func, ts_t, v_t, counts, normalized_cfg(func, cfg),
+                      MIN_TS_NONE, _v0_dev(engine, v0))
     # mesh tiles are row-padded; only the live rows come back
-    return list(np.asarray(out, dtype=np.float64)[:len(series)])
+    rows = np.asarray(out, dtype=np.float64)[:len(series)]
+    if mode == "addback":
+        rows = rows + v0[:len(series), None]  # NaN gaps stay NaN
+    return list(rows)
 
 
 TOPK_RANK_KINDS = frozenset({"max", "min", "avg", "median", "last"})
@@ -124,6 +225,10 @@ def try_topk_rollup_tpu(engine: TPUEngine, name: str, k: float, func: str,
     Returns a list of (orig_series_index, values_row) — the caller attaches
     names — or None for host fallback."""
     if func not in rollup_np.CORE_SUPPORTED:
+        return None
+    # selection compares values ACROSS series: rebased rows with different
+    # v0 are not comparable, so f32 tiles only run shift-invariant funcs
+    if engine.func_mode(func, per_series=False) != "direct":
         return None
     if len(series) < engine.min_series:
         return None
@@ -153,12 +258,15 @@ def try_topk_rollup_tpu(engine: TPUEngine, name: str, k: float, func: str,
     if tiles is None:
         tiles = _upload_tiles(engine, series, cfg)
         cache.put_device(key, tiles)
-    ts_t, v_t, counts = tiles
+    if _counter_unsafe(engine, func, tiles):
+        return None
+    ts_t, v_t, counts, v0 = tiles
+    v0d = _v0_dev(engine, v0)
     ncfg = normalized_cfg(func, cfg)
     if kind is None:
         k_eff = min(k_i, int(ts_t.shape[0]))
         rolled, idx, sel_nan = topk_select_tile(
-            func, ts_t, v_t, counts, ncfg, k_eff, bottom)
+            func, ts_t, v_t, counts, ncfg, k_eff, bottom, v0=v0d)
         idx_h = np.asarray(idx)
         valid = ~np.asarray(sel_nan)
         # padded tile rows roll to all-NaN and can never be selected valid
@@ -181,7 +289,7 @@ def try_topk_rollup_tpu(engine: TPUEngine, name: str, k: float, func: str,
             if not np.isnan(vals).all():
                 out.append((int(i), vals))
         return out
-    rolled, rank = rank_tile(func, kind, ts_t, v_t, counts, ncfg)
+    rolled, rank = rank_tile(func, kind, ts_t, v_t, counts, ncfg, v0=v0d)
     rank_h = np.asarray(rank, dtype=np.float64)[:len(series)]
     # ordering replicates _eval_topk_family exactly (stable sorts, ties
     # favor later series)
@@ -211,6 +319,10 @@ def try_aggr_rollup_tpu(engine: TPUEngine, aggr: str, func: str, series,
     Returns an [G, T] float64 array or None for host fallback."""
     if aggr not in FUSED_AGGRS or func not in rollup_np.CORE_SUPPORTED:
         return None
+    # group members have different v0, so f32 tiles only run
+    # shift-invariant funcs fused (the affine addback is per-series)
+    if engine.func_mode(func, per_series=False) != "direct":
+        return None
     if len(series) < engine.min_series:
         return None
     span = cfg.end - cfg.start + cfg.lookback
@@ -228,8 +340,26 @@ def try_aggr_rollup_tpu(engine: TPUEngine, aggr: str, func: str, series,
     if tiles is None:
         tiles = _upload_tiles(engine, series, cfg)
         cache.put_device(key, tiles)
+    if _counter_unsafe(engine, func, tiles):
+        return None
     return _dispatch_fused(engine, aggr, func, tiles, jnp.asarray(gids),
                            num_groups, cfg)
+
+
+def _v0_dev(engine: TPUEngine, v0):
+    """Rebase offsets in tile dtype for the kernel's counter-reset
+    threshold (None for f64 engines — no rebase happened)."""
+    if v0 is None:
+        return None
+    import jax.numpy as jnp
+    return jnp.asarray(v0.offsets.astype(np.float32))
+
+
+def _counter_unsafe(engine: TPUEngine, func: str, tiles) -> bool:
+    """True when `func` reads sample values but this f32 tile's rebased
+    dynamic range exceeds the f32-safe bound (see V0Info.wide_range)."""
+    v0 = tiles[3]
+    return v0 is not None and v0.wide_range and func not in VALUE_FREE_FUNCS
 
 
 def _pad_rows(arr, n_rows: int, fill):
@@ -256,19 +386,22 @@ def _dispatch_fused(engine: TPUEngine, aggr: str, func: str, tiles,
                                      rollup_aggregate_tile)
     if min_ts is None:
         min_ts = MIN_TS_NONE
-    ts_t, v_t, counts = tiles
+    ts_t, v_t, counts, v0 = tiles
     gids_dev = _pad_rows(gids_dev, ts_t.shape[0], 0)
     cfg = normalized_cfg(func, cfg)
     if engine.series_shards() > 1:
+        import jax.numpy as jnp
         from ..parallel.mesh import cached_sharded_rollup_aggregate
         fn = cached_sharded_rollup_aggregate(engine.mesh, func, aggr, cfg,
                                              num_groups)
+        v0_arr = (np.zeros(int(ts_t.shape[0]), np.float32) if v0 is None
+                  else v0.offsets.astype(np.float32))
         out = fn(ts_t, v_t, counts, gids_dev, np.int32(shift),
-                 np.int32(min_ts))
+                 np.int32(min_ts), v0_arr)
     else:
         out = rollup_aggregate_tile(func, aggr, ts_t, v_t, counts, gids_dev,
                                     cfg, num_groups, np.int32(shift),
-                                    np.int32(min_ts))
+                                    np.int32(min_ts), _v0_dev(engine, v0))
     return np.asarray(out, dtype=np.float64)
 
 
@@ -311,12 +444,27 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
             return jax.device_put(a, row_sh if a.ndim > 1 else vec_sh)
         return chunked_device_put(a)
 
+    f32 = engine.is_f32()
+    v0 = risky = None
+    if f32:
+        # per-series rebase offsets, float64, HOST-resident: the affine
+        # addback and append-slice rebasing must not round through f32
+        v0 = np.array([sd.values[0] if sd.values.size and
+                       np.isfinite(sd.values[0]) else 0.0
+                       for sd in series], dtype=np.float64)
+        risky = any(
+            sd.values.size and np.isfinite(sd.values).any() and
+            float(np.nanmax(np.abs(np.where(np.isfinite(sd.values),
+                                            sd.values, v0[i]) - v0[i])))
+            >= F32_SAFE_RANGE
+            for i, sd in enumerate(series))
     triples = []
     for sd in series:
         m, e = dec.float_to_decimal(sd.values)
         triples.append((sd.timestamps, m, e))
     planes = dd.pack_delta_planes(triples, cfg.start,
-                                  value_dtype=engine.value_dtype)
+                                  value_dtype=engine.value_dtype,
+                                  rebase=f32)
     if planes is not None:
         n = int(planes.counts.max())
         n_cap = tile_capacity(n)
@@ -328,19 +476,45 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
                 planes,
                 ts_d2=np.pad(planes.ts_d2, ((0, 0), (0, pad))),
                 val_d2=np.pad(planes.val_d2, ((0, 0), (0, pad))))
+        if f32:
+            # v0 must match the DECODED first value exactly (mant * scale),
+            # not the pre-codec float, so addback + decode compose to the
+            # device's own absolute values
+            v0 = np.array([float(m[0]) if m.size else 0.0
+                           for _, m, _ in triples], dtype=np.float64) * \
+                np.array([10.0 ** e for _, _, e in triples])
+            v0[~np.isfinite(v0)] = 0.0
         # padded rows get count=0 and scale=1: decode masks them to TS_PAD
         pad_vals = {"scale": 1}
         dev = [_put(getattr(planes, f.name), pad_vals.get(f.name, 0))
                for f in dataclasses.fields(planes)]
         ts_t, v_t = dd.decode_tiles(*dev[:6], dev[6], dev[7], n_cap,
-                                    engine.value_dtype)
-        return ts_t, v_t, dev[7]
+                                    engine.value_dtype, rebase=f32)
+        return ts_t, v_t, dev[7], _pad_v0(v0, int(ts_t.shape[0]), risky)
+    pairs = []
+    for i, sd in enumerate(series):
+        vals_i = sd.values
+        if f32:
+            vals_i = vals_i - v0[i]
+        pairs.append((sd.timestamps, vals_i))
     ts, vals, counts = pack_series(
-        [(sd.timestamps, sd.values) for sd in series], cfg.start,
+        pairs, cfg.start,
         n_pad=tile_capacity(
             max((sd.timestamps.size for sd in series), default=1)),
         dtype=engine.value_dtype)
-    return (_put(ts, TS_PAD), _put(vals), _put(counts))
+    ts_d = _put(ts, TS_PAD)
+    return (ts_d, _put(vals), _put(counts),
+            _pad_v0(v0, int(ts_d.shape[0]), risky))
+
+
+def _pad_v0(v0, n_rows: int, risky):
+    """Row-pad the host float64 rebase vector to the tile's padded row
+    count and wrap it as V0Info (None passes through for f64 engines)."""
+    if v0 is None:
+        return None
+    if v0.shape[0] < n_rows:
+        v0 = np.concatenate([v0, np.zeros(n_rows - v0.shape[0])])
+    return V0Info(v0, bool(risky))
 
 
 def tile_capacity(n: int) -> int:
@@ -473,21 +647,38 @@ def _append_cols(engine: TPUEngine, rt: RollingTile, cols) -> bool:
     if int(new_n.max()) > rt.n_cap:
         engine.last_roll_decline = "column headroom exhausted"
         return False
-    S_tile = int(rt.tiles[0].shape[0])
+    ts_t0, v_t0, counts_t0, v0 = rt.tiles
+    S_tile = int(ts_t0.shape[0])
     K = int(cols.ts.shape[1])
     K_pad = (K + 7) // 8 * 8  # few distinct compiled append shapes
     new_ts = np.zeros((S_tile, K_pad), dtype=np.int32)
     new_vals = np.zeros((S_tile, K_pad), dtype=np.float64)
     new_counts = np.zeros(S_tile, dtype=np.int32)
     new_ts[rows_idx, :K] = (cols.ts - rt.base_ms).astype(np.int32)
-    new_vals[rows_idx, :K] = cols.vals
+    vals_in = cols.vals
+    if v0 is not None:
+        # f32 tiles hold rebased values: rebase the appended slice by the
+        # SAME per-row offsets (f64 host subtraction, one f32 rounding).
+        # An append pushing the rebased magnitude past the f32-safe range
+        # (large-base counter reset, or >16M of growth) declines — the
+        # caller rebuilds and the cold path re-gates via V0Info.
+        vals_in = vals_in - v0[rows_idx][:, None]
+        live = np.arange(K)[None, :] < cols.counts[:, None]
+        sub = vals_in[live]  # padding rebases to -v0; exclude it
+        finite = sub[np.isfinite(sub)]
+        if not v0.wide_range and finite.size and \
+                float(np.abs(finite).max()) >= F32_SAFE_RANGE:
+            engine.last_roll_decline = \
+                "append exceeds the f32-safe rebased range"
+            return False
+    new_vals[rows_idx, :K] = vals_in
     new_counts[rows_idx] = cols.counts
     # the old buffers are donated: drop the TileCache reference first so no
     # reachable entry keeps deleted arrays
     if rt.adopted_key is not None:
         engine.cache().invalidate(rt.adopted_key)
         rt.adopted_key = None
-    ts_t, v_t, counts_t = rt.tiles
+    ts_t, v_t, counts_t = ts_t0, v_t0, counts_t0
     if engine.series_shards() > 1:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -501,7 +692,7 @@ def _append_cols(engine: TPUEngine, rt: RollingTile, cols) -> bool:
     else:
         new_ts_d, new_vals_d, new_counts_d = new_ts, new_vals, new_counts
     rt.tiles = append_tile(ts_t, v_t, counts_t, new_ts_d, new_vals_d,
-                           new_counts_d)
+                           new_counts_d) + (v0,)
     rt.counts_host[rows_idx] = new_n
     rt.n_samples += cols.n_samples
     rt.appends += 1
@@ -580,6 +771,9 @@ def try_quantile_rollup_tpu(engine: TPUEngine, phi: float, func: str,
     None for host fallback."""
     if func not in rollup_np.CORE_SUPPORTED:
         return None
+    # the quantile interpolates ACROSS group members (different v0)
+    if engine.func_mode(func, per_series=False) != "direct":
+        return None
     if len(series) < engine.min_series:
         return None
     span = cfg.end - cfg.start + cfg.lookback
@@ -599,6 +793,8 @@ def try_quantile_rollup_tpu(engine: TPUEngine, phi: float, func: str,
     if tiles is None:
         tiles = _upload_tiles(engine, series, cfg)
         cache.put_device(key, tiles)
+    if _counter_unsafe(engine, func, tiles):
+        return None
     return run_quantile_on_tiles(engine, phi, func, tiles,
                                  jnp.asarray(gids), jnp.asarray(slots),
                                  num_groups, max_group, cfg)
@@ -616,11 +812,11 @@ def run_quantile_on_tiles(engine: TPUEngine, phi: float, func: str, tiles,
                                      rollup_quantile_tile)
     if min_ts is None:
         min_ts = MIN_TS_NONE
-    ts_t, v_t, counts = tiles
+    ts_t, v_t, counts, v0 = tiles
     gids_dev = _pad_rows(gids_dev, ts_t.shape[0], num_groups)
     slots_dev = _pad_rows(slots_dev, ts_t.shape[0], max_group)
     out = rollup_quantile_tile(func, phi, ts_t, v_t, counts, gids_dev,
                                slots_dev, normalized_cfg(func, cfg),
                                num_groups, max_group, np.int32(shift),
-                               np.int32(min_ts))
+                               np.int32(min_ts), _v0_dev(engine, v0))
     return np.asarray(out, dtype=np.float64)

@@ -1,0 +1,51 @@
+"""Bounded-timeout accelerator probe.
+
+Initializing the TPU backend IN-PROCESS is not cancellable: a hung plugin
+init (e.g. a provisioned-but-unresponsive tunnel) blocks `jax.devices()`
+forever and takes the whole server with it (this exact hang produced a
+timed-out round-3 multichip artifact). The probe pays a subprocess to find
+out whether the backend comes up, with a hard deadline; only on success do
+callers initialize jax in-process (the plugin is then known-healthy, and
+the subprocess's own client is gone by that point).
+
+Used by the serving apps' `-search.tpuBackend` startup and by bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def probe_backend(timeout_s: float = 90.0):
+    """Probe jax backend availability in a subprocess.
+
+    Returns (platform, n_devices, error): platform is e.g. "tpu"/"cpu"
+    (None when the probe failed), error is a human-readable reason on
+    failure (None on success)."""
+    code = (
+        "import jax, json\n"
+        "ds = jax.devices()\n"
+        "print('PROBE:' + json.dumps("
+        "{'platform': ds[0].platform, 'n': len(ds)}))\n"
+    )
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=os.environ.copy())
+    except subprocess.TimeoutExpired:
+        return None, 0, (f"accelerator probe timed out after {timeout_s:g}s "
+                         "(hung backend init?)")
+    except OSError as e:
+        return None, 0, f"accelerator probe could not run: {e}"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-3:]
+        return None, 0, ("accelerator probe failed: " +
+                         (" | ".join(tail) or f"rc={r.returncode}"))
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("PROBE:"):
+            info = json.loads(line[len("PROBE:"):])
+            return info["platform"], int(info["n"]), None
+    return None, 0, "accelerator probe produced no result"
