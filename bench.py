@@ -60,36 +60,62 @@ STEP = 60_000
 REFRESHES = 6
 
 
-def _provision_engine():
-    """Probe the accelerator (bounded), set the x64 mode to match the tile
-    dtype, and build the device engine. Returns (engine, backend_label).
-    NEVER silent: every degradation prints its reason to stderr."""
-    from victoriametrics_tpu.utils.tpu_probe import probe_backend
-    timeout = float(os.environ.get("VM_TPU_PROBE_TIMEOUT_S", "90"))
-    platform, n, err = probe_backend(timeout)
-    if err is not None:
-        print(f"bench: DEVICE BACKEND UNAVAILABLE -> host-only path: {err}",
-              file=sys.stderr)
-        return None, f"host-only:{err.split(':')[0]}"
-    if platform != "tpu":
-        # CPU-XLA: f64 tiles need x64 (must be set before jax imports)
-        os.environ.setdefault("JAX_ENABLE_X64", "1")
-    print(f"bench: accelerator probe OK: {n} {platform} device(s)",
-          file=sys.stderr)
+def _finish_provision(probe_handle):
+    """Resolve the in-flight accelerator probe and build the device
+    engine. Returns (engine, backend_label, probe_info). NEVER silent:
+    every degradation prints its reason to stderr, and a failed probe's
+    outcome (including the hung subprocess's last faulthandler stack)
+    lands in probe_info for the JSON artifact."""
+    res = probe_handle.result()
+    probe_info = {"timeout_s": float(
+        os.environ.get("VM_TPU_PROBE_TIMEOUT_S", "600")),
+        "elapsed_s": round(res.elapsed_s, 1)}
+    if res.error is not None:
+        probe_info["error"] = res.error
+        if res.stack:
+            probe_info["last_stack"] = res.stack
+        print(f"bench: DEVICE BACKEND UNAVAILABLE -> host-only path: "
+              f"{res.error}", file=sys.stderr)
+        if res.stack:
+            print(f"bench: hung probe's last stack:\n{res.stack}",
+                  file=sys.stderr)
+        return None, f"host-only:{res.error.split(':')[0]}", probe_info
+    probe_info["platform"] = res.platform
+    probe_info["n_devices"] = res.n
+    print(f"bench: accelerator probe OK: {res.n} {res.platform} device(s) "
+          f"in {res.elapsed_s:.1f}s", file=sys.stderr)
     try:
+        import jax
+        from victoriametrics_tpu.query.tpu_engine import is_tpu_platform
+        if not is_tpu_platform(res.platform):
+            # Pin the in-process backend to what the probe proved healthy:
+            # the axon TPU plugin overrides JAX_PLATFORMS at import time,
+            # so without this the main process could still hang in the
+            # plugin init the probe just rejected. CPU-XLA f64 tiles also
+            # need x64 (config.update works after import; env var would
+            # be too late — jax is already loaded by the ingest imports
+            # that ran while the probe was in flight).
+            jax.config.update("jax_platforms", res.platform)
+            jax.config.update("jax_enable_x64", True)
         from victoriametrics_tpu.query.tpu_engine import TPUEngine
         engine = TPUEngine()
-        label = ("tpu" if platform == "tpu" else "cpu-device") + \
-            f"-{np.dtype(engine.value_dtype).name}"
-        return engine, label
+        label = ("tpu" if is_tpu_platform(res.platform) else "cpu-device") \
+            + f"-{np.dtype(engine.value_dtype).name}"
+        return engine, label, probe_info
     except Exception as e:  # loud: the engine must not vanish silently
         print(f"bench: DEVICE ENGINE INIT FAILED -> host-only path: {e!r}",
               file=sys.stderr)
-        return None, f"host-only:{type(e).__name__}"
+        probe_info["engine_error"] = repr(e)
+        return None, f"host-only:{type(e).__name__}", probe_info
 
 
 def main() -> None:
-    engine, backend_label = _provision_engine()
+    # Launch the accelerator probe FIRST and let it run concurrently with
+    # ingest (~100s): a slow-but-alive TPU backend is not discarded, and a
+    # hung one costs no extra wall-clock until ingest is done.
+    from victoriametrics_tpu.utils.tpu_probe import start_probe
+    probe_timeout = float(os.environ.get("VM_TPU_PROBE_TIMEOUT_S", "600"))
+    probe_handle = start_probe(probe_timeout)
 
     from victoriametrics_tpu.query.exec import exec_query
     from victoriametrics_tpu.query.types import EvalConfig
@@ -146,14 +172,9 @@ def main() -> None:
         s.force_flush()
         s.force_merge()
 
-        tpu = None
-        try:
-            import jax
-            if jax.devices():
-                from victoriametrics_tpu.query.tpu_engine import TPUEngine
-                tpu = TPUEngine()  # float64 tiles: conformance numerics
-        except Exception:
-            pass
+        # resolve the probe that ran during ingest; build the device
+        # engine ONLY if the probe proved the backend healthy
+        tpu, backend_label, probe_info = _finish_provision(probe_handle)
         q = "sum by (instance)(rate(http_requests_total[5m]))"
         duration = (N_SAMPLES - 1) * 15_000 - 300_000
         # logical scan size of one window (series x fetch-range samples)
@@ -214,18 +235,30 @@ def main() -> None:
         with open("bench_trace.json", "w") as f:
             json.dump(traces, f, indent=1)
         baseline = 1e8  # single-core reference scan rate (see docstring)
+        # honest backend accounting: the headline backend, with the probed
+        # device label ("tpu-float32" etc.) or the probe-failure reason
+        backend_field = (backend_label if backend == "device"
+                         else f"host-batch ({backend_label})")
         print(json.dumps({
             "metric": (f"steady-state rolling-window sum by(rate) serving, "
                        f"{N_SERIES}x{N_SAMPLES} counters, live ingest, via "
-                       f"storage+index+decode+{backend} f64 (cold "
+                       f"storage+index+decode+{backend} (cold "
                        f"{samples / cold_dt / 1e6:.0f}M/s, refresh p50 "
                        f"{warm_dt * 1e3:.0f}ms, ingest "
                        f"{ingest_rate / 1e3:.0f}k rows/s)"),
             "value": round(rate),
             "unit": "samples/sec",
             "vs_baseline": round(rate / baseline, 2),
+            "backend": backend_field,
+            "probe": probe_info,
         }))
     finally:
+        try:
+            # a hung probe child must not outlive the bench holding the
+            # device (no-op once the probe was resolved)
+            probe_handle.cancel()
+        except Exception:
+            pass
         try:
             s.close()
         except Exception:

@@ -124,6 +124,30 @@ def test_counter_resets_large_base_falls_back(engine):
                   1e-9, "count on wide-range tile")
 
 
+def test_fractional_scale_wide_mantissa_falls_back(engine):
+    """Value range < 2^24 but MANTISSA range >= 2^24 (fractional decimal
+    scale 10^-3): the one f32 rounding happens on the rebased mantissa, so
+    the value-space gate alone would silently cost integer exactness for
+    equality-sensitive funcs. Value-dependent funcs must refuse the tile;
+    value-free funcs still run (round-4 advisor finding)."""
+    rng = np.random.default_rng(21)
+    n = 140
+    series = []
+    for i in range(8):
+        ts = np.arange(n, dtype=np.int64) * 15_000 + START
+        # 3-decimal counter reaching ~21k: mantissa range ~2.1e7 > 2^24,
+        # value range far below 2^24
+        v = np.round(np.cumsum(rng.uniform(100.0, 200.0, n)), 3)
+        mn = MetricName.from_dict({"__name__": "frac", "i": str(i)})
+        series.append(SeriesData(mn, ts, v, raw_name=mn.marshal()))
+    for func in ("changes", "rate", "delta"):
+        assert try_rollup_tpu(engine, func, series, CFG, ()) is None, func
+    rows = try_rollup_tpu(engine, "count_over_time", series, CFG, ())
+    assert rows is not None
+    _assert_close(np.stack(rows), _host_rows("count_over_time", series),
+                  1e-9, "count on wide-mantissa tile")
+
+
 # -- affine funcs get per-series f64 addback -------------------------------
 
 @pytest.mark.parametrize("func", ["min_over_time", "max_over_time",

@@ -53,16 +53,15 @@ def build(args):
     cluster = ClusterStorage(
         make_nodes(args.storageNode, getattr(args, "rpc_timeout", 10.0)),
         deny_partial_response=args.deny_partial)
-    from .vmsingle import _make_tpu_engine
-    tpu_engine = _make_tpu_engine(args.tpu)
     hh, _, hp = args.httpListenAddr.rpartition(":")
     srv = HTTPServer(hh or "0.0.0.0", int(hp))
-    from .vmsingle import _dur_ms
+    from .vmsingle import _attach_tpu_engine, _dur_ms
     api = PrometheusAPI(
-        cluster, tpu_engine, max_series=args.max_series,
+        cluster, None, max_series=args.max_series,
         max_samples_per_query=args.max_samples_per_query,
         max_memory_per_query=args.max_memory_per_query,
         max_query_duration_ms=_dur_ms(args.max_query_duration))
+    _attach_tpu_engine(api, args.tpu)
     api.register(srv, mode="select")
     from ..httpapi.graphite_api import GraphiteAPI
     GraphiteAPI(cluster).register(srv)

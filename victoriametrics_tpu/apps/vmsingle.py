@@ -88,23 +88,53 @@ def _dur_ms(s: str, months_ok=False) -> int:
     return int(ms)
 
 
-def _make_tpu_engine(enabled: bool):
+def _attach_tpu_engine(api, enabled: bool):
     """-search.tpuBackend startup: probe the accelerator with a hard
     deadline BEFORE any in-process jax init (a hung TPU plugin must degrade
-    the server to the host path, not wedge startup), then build the engine
-    with its auto dtype (f32 tiles on real TPU, f64 elsewhere)."""
+    the server to the host path, not wedge startup). The probe + engine
+    build + kernel warmup all run on a daemon thread: the HTTP listener
+    comes up immediately serving the host path, and `api.tpu` is attached
+    the moment the device is proven healthy (a hung plugin therefore costs
+    the server NOTHING — queries just keep the host path)."""
     if not enabled:
-        return None
+        return
+    import threading
+
     from ..utils.tpu_probe import probe_backend
-    timeout = float(os.environ.get("VM_TPU_PROBE_TIMEOUT_S", "90"))
-    platform, n, err = probe_backend(timeout)
-    if err is not None:
-        logger.errorf("tpu backend requested but unavailable (%s); "
-                      "serving on the host path", err)
-        return None
-    logger.infof("accelerator probe: %d %s device(s)", n, platform)
-    from ..query.tpu_engine import TPUEngine, auto_mesh
-    return TPUEngine(mesh=auto_mesh())
+
+    def _provision():
+        timeout = float(os.environ.get("VM_TPU_PROBE_TIMEOUT_S", "600"))
+        res = probe_backend(timeout)
+        if res.error is not None:
+            logger.errorf("tpu backend requested but unavailable (%s); "
+                          "serving on the host path", res.error)
+            if res.stack:
+                logger.errorf("hung probe's last stack:\n%s", res.stack)
+            return
+        logger.infof("accelerator probe: %d %s device(s)", res.n,
+                     res.platform)
+        from ..query.tpu_engine import (TPUEngine, auto_mesh,
+                                        is_tpu_platform, warmup)
+        if not is_tpu_platform(res.platform):
+            # Pin jax to the probed backend (the axon TPU plugin overrides
+            # JAX_PLATFORMS at import, so a hung plugin could still wedge
+            # the in-process init the probe just rejected), and enable
+            # x64: CPU-XLA f64 tiles silently truncate to f32 without it.
+            # Must be set before the engine's first jax trace.
+            os.environ.setdefault("JAX_ENABLE_X64", "1")
+            import jax
+            jax.config.update("jax_platforms", res.platform)
+            jax.config.update("jax_enable_x64", True)
+        engine = TPUEngine(mesh=auto_mesh())
+        # pre-compile the hot kernels BEFORE exposing the engine (also
+        # seeds the persistent compilation cache, so restarts stay warm)
+        warmup(engine)
+        api.tpu = engine
+        logger.infof("tpu engine attached (%s tiles)",
+                     getattr(engine, "value_dtype", "?"))
+
+    threading.Thread(target=_provision, daemon=True,
+                     name="tpu-provision").start()
 
 
 def build(args):
@@ -118,7 +148,6 @@ def build(args):
                       dedup_interval_ms=dedup,
                       max_hourly_series=args.max_hourly_series,
                       max_daily_series=args.max_daily_series)
-    tpu_engine = _make_tpu_engine(args.tpu)
     relabel = None
     if args.relabel_config:
         from ..ingest.relabel import parse_relabel_configs
@@ -136,7 +165,7 @@ def build(args):
     from ..ingest.serieslimits import SeriesLimits
     limits = SeriesLimits(max_labels_per_series=args.maxLabelsPerTimeseries,
                           max_label_value_len=args.maxLabelValueLen)
-    api = PrometheusAPI(storage, tpu_engine,
+    api = PrometheusAPI(storage, None,
                         lookback_delta=_dur_ms(args.lookback),
                         max_series=args.max_series,
                         relabel_configs=relabel, stream_aggr=stream_aggr,
@@ -146,6 +175,7 @@ def build(args):
                         max_memory_per_query=args.max_memory_per_query,
                         max_query_duration_ms=_dur_ms(
                             args.max_query_duration))
+    _attach_tpu_engine(api, args.tpu)
     api.flags_map = {k: v for k, v in vars(args).items()}
     api.register(srv)
     from ..httpapi.graphite_api import GraphiteAPI

@@ -496,23 +496,33 @@ class PrometheusAPI:
         cached, new_start = rcache.get(ec, q, now_ms)
         if cached is not None and new_start > ec.end:
             ec.tracer.printf("rollup cache: full hit")
-            return cached
+            return cached.rows()
         if cached is not None:
             ec.tracer.printf("rollup cache: partial hit, computing from %d",
                              new_start)
             sub = ec.child(start=new_start)
             sub.tracer = ec.tracer
             fresh = exec_query(sub, q)
-            rows = rcache.merge(cached, fresh, ec, new_start)
+            # trust_raw=False: these are POST-transform rows — in-place
+            # label edits (multi-output rollups, label_set, binop
+            # keep_metric_names) leave Timeseries.raw stale, so identity
+            # must come from a fresh marshal here
+            rows = rcache.merge(cached, fresh, ec, new_start,
+                                trust_raw=False)
             rows = [r for r in rows
                     if not np.isnan(r.values).all()]
-            rows.sort(key=lambda ts: ts.metric_name.marshal())
+            # merge() just attached authoritative raws to exactly these
+            # rows — reuse them for the sort and let put() trust them
+            # (no further name mutation happens between here and put)
+            rows.sort(key=lambda ts: ts.raw)
+            trust = True
         else:
             rows = exec_query(ec, q)
+            trust = False
         if not getattr(self.storage, "last_partial", False):
             # never cache partial cluster results: a later hit would present
             # incomplete data as complete with isPartial=false
-            rcache.put(ec, q, rows, now_ms)
+            rcache.put(ec, q, rows, now_ms, trust_raw=trust)
         return rows
 
     # -- metadata ----------------------------------------------------------

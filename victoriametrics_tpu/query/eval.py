@@ -138,6 +138,7 @@ def _eval_rollup_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
             for ts in sub:
                 ts.metric_name.labels.append((b"rollup", f.encode()))
                 ts.metric_name.sort_labels()
+                ts.raw = None  # memoized marshal is stale now
             out.extend(sub)
         return out
 
@@ -152,6 +153,7 @@ def _eval_rollup_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
                 ts.metric_name.labels.append(
                     (dst_label.encode(), repr(phi).encode()))
                 ts.metric_name.sort_labels()
+                ts.raw = None  # memoized marshal is stale now
             out.extend(sub)
         return out
 
@@ -187,6 +189,7 @@ def _eval_rollup_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
                 for ts in sub:
                     ts.metric_name.labels.append((b"rollup", tag.encode()))
                     ts.metric_name.sort_labels()
+                    ts.raw = None  # memoized marshal is stale now
                 out.extend(sub)
             return out
         if explicit is not None and explicit not in ("min", "max", "avg"):
@@ -200,6 +203,7 @@ def _eval_rollup_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
                 for ts in sub:
                     ts.metric_name.labels.append((b"rollup", tag.encode()))
                     ts.metric_name.sort_labels()
+                    ts.raw = None  # memoized marshal is stale now
             out.extend(sub)
         return out
 
@@ -317,7 +321,8 @@ def _fetch_columns_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
 
 
 def _finish_rollup_cols(cols, rows, keep_name: bool) -> list[Timeseries]:
-    return _finish_rollup_names(cols.metric_names, rows, keep_name)
+    return _finish_rollup_names(cols.metric_names, rows, keep_name,
+                                cols.raw_names)
 
 
 def _rollup_from_storage_cols(ec: EvalConfig, func: str, re_: RollupExpr,
@@ -388,7 +393,7 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
         cached, new_start = rcache.get(ec, ckey, now_ms)
         if cached is not None and new_start > ec.end:
             ec.tracer.printf("eval rollup cache: full hit %s", ckey)
-            return cached
+            return cached.rows()
         if cached is not None:
             ec.tracer.printf("eval rollup cache: tail from %d", new_start)
             sub = ec.child(start=new_start)
@@ -582,19 +587,40 @@ def _drop_stale_nans(func: str, series):
     return series
 
 
-def _finish_rollup_names(metric_names, rows, keep_name: bool
+def _blank_raw(raw: bytes) -> bytes:
+    """marshal() of the name with metric_group blanked, as a suffix slice:
+    escapes map 0x00 -> 0x02 0x03, so the first LITERAL 0x00 in a
+    canonical raw name is the group/label separator."""
+    i = raw.find(b"\x00")
+    return raw[i:] if i >= 0 else b""
+
+
+def _finish_rollup_names(metric_names, rows, keep_name: bool, raws=None
                          ) -> list[Timeseries]:
+    """Build output rows; when the storage's canonical raw names are
+    available they are attached (sliced for keep_name=False) so the rollup
+    result cache never re-marshals 8k names per refresh."""
     out = []
-    for mn_src, vals in zip(metric_names, rows):
+    if raws is None:
+        for mn_src, vals in zip(metric_names, rows):
+            mn = MetricName(mn_src.metric_group if keep_name else b"",
+                            list(mn_src.labels))
+            out.append(Timeseries(mn, np.asarray(vals, dtype=np.float64)))
+        return out
+    for mn_src, vals, raw in zip(metric_names, rows, raws):
         mn = MetricName(mn_src.metric_group if keep_name else b"",
                         list(mn_src.labels))
-        out.append(Timeseries(mn, np.asarray(vals, dtype=np.float64)))
+        out.append(Timeseries(mn, np.asarray(vals, dtype=np.float64),
+                              raw=raw if keep_name else _blank_raw(raw)))
     return out
 
 
 def _finish_rollup(series, rows, keep_name: bool) -> list[Timeseries]:
+    raws = [getattr(sd, "raw_name", None) for sd in series]
+    if any(r is None for r in raws):
+        raws = None
     return _finish_rollup_names((sd.metric_name for sd in series), rows,
-                                keep_name)
+                                keep_name, raws)
 
 
 def _subquery_series(ec: EvalConfig, re_: RollupExpr, window: int,
@@ -1052,6 +1078,7 @@ def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
                 ts.metric_name.labels.append(
                     (dst.value.encode(), repr(phi).encode()))
                 ts.metric_name.sort_labels()
+                ts.raw = None  # memoized marshal is stale now
             out.extend(rows)
         return out
     if name == "count_values":

@@ -3,12 +3,15 @@ rollup_result_cache.go:39-364): caches range-query results keyed by
 (query, step) so repeated/refreshing queries only compute the new tail,
 merging cached prefixes with freshly computed suffixes.
 
-Entries store per-series NumPy value arrays on the entry's own step-aligned
-grid; hits are served with slices (no per-point Python work). A hit requires
-the request grid to be phase-aligned with the cached grid — the HTTP layer
-aligns start/end to the step (AdjustStartEnd analog) so this always holds
-for dashboard refreshes. Backfill older than the cached window resets the
-cache (ResetRollupResultCacheIfNeeded analog)."""
+Entries store ONE (S, T) float64 block per query on the entry's own
+step-aligned grid plus parallel raw-name/MetricName lists; hits, merges
+and puts are whole-block NumPy ops — no per-series marshal/unmarshal on
+the steady-state path (that churn used to cost more than the tail fetch
+itself). A hit requires the request grid to be phase-aligned with the
+cached grid — the HTTP layer aligns start/end to the step (AdjustStartEnd
+analog) so this always holds for dashboard refreshes. Backfill older than
+the cached window resets the cache (ResetRollupResultCacheIfNeeded
+analog)."""
 
 from __future__ import annotations
 
@@ -34,13 +37,60 @@ def next_storage_token() -> int:
     return next(_storage_tokens)
 
 
+def _copy_name(mn: MetricName) -> MetricName:
+    return MetricName(mn.metric_group, list(mn.labels))
+
+
+def _raw_of(ts: Timeseries, trust_raw: bool) -> bytes:
+    """Series identity for cache keying. `trust_raw=True` is ONLY safe for
+    rows the caller just built and has not exposed to any code that could
+    mutate metric_name in place (the eval-level rollup path): transforms,
+    binops and multi-output rollups edit labels in place, leaving ts.raw
+    stale — distinct output series then collide on one raw and merge()
+    stitches them wrongly. Post-transform callers (the HTTP-level cache)
+    must pass trust_raw=False and pay the marshal."""
+    if trust_raw and ts.raw is not None:
+        return ts.raw
+    return ts.metric_name.marshal()
+
+
+class _Entry:
+    __slots__ = ("c_start", "c_end", "raws", "names", "vals")
+
+    def __init__(self, c_start, c_end, raws, names, vals):
+        self.c_start = c_start
+        self.c_end = c_end
+        self.raws = raws      # list[bytes], parallel to vals rows
+        self.names = names    # list[MetricName], parallel to vals rows
+        self.vals = vals      # (S, n) float64 on the entry grid
+
+
+class CacheHit:
+    """A cache hit covering [ec.start, cov_end] — a zero-copy view into
+    the entry block until rows()/merge materialize it."""
+
+    __slots__ = ("entry", "i0", "n")
+
+    def __init__(self, entry: _Entry, i0: int, n: int):
+        self.entry = entry
+        self.i0 = i0
+        self.n = n
+
+    def rows(self) -> list[Timeseries]:
+        """Materialize as Timeseries (full-hit path). One block copy; the
+        per-row views are handed out with fresh MetricName copies so
+        caller mutation can't corrupt the entry."""
+        e = self.entry
+        vals = e.vals[:, self.i0:self.i0 + self.n].copy()
+        return [Timeseries(_copy_name(e.names[s]), vals[s], raw=e.raws[s])
+                for s in range(len(e.raws))]
+
+
 class RollupResultCache:
     def __init__(self, max_entries: int = 4096):
         from collections import OrderedDict
         self._lock = threading.Lock()
-        # key -> (c_start, c_end, {metric_name_raw: values ndarray})
-        self._cache: "OrderedDict[tuple, tuple[int, int, dict]]" = \
-            OrderedDict()
+        self._cache: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self.max_entries = max_entries
         self.hits = 0
         self.misses = 0
@@ -54,66 +104,80 @@ class RollupResultCache:
                 ec.tenant, q, ec.step)
 
     def get(self, ec: EvalConfig, q: str, now_ms: int
-            ) -> tuple[list[Timeseries] | None, int]:
-        """Returns (cached series on [ec.start, cov_end], first timestamp
+            ) -> tuple[CacheHit | None, int]:
+        """Returns (hit covering [ec.start, cov_end], first timestamp
         still to compute). (None, ec.start) on miss."""
         with self._lock:
             key = self._key(ec, q)
             e = self._cache.get(key)
-            if e is None or e[0] > ec.start or e[1] < ec.start or \
-                    (ec.start - e[0]) % ec.step != 0:
+            if e is None or e.c_start > ec.start or e.c_end < ec.start or \
+                    (ec.start - e.c_start) % ec.step != 0:
                 self.misses += 1
                 return None, ec.start
             self._cache.move_to_end(key)
             self.hits += 1
-            c_start, c_end, series = e
-        cov_end = min(c_end, ec.end)
-        i0 = (ec.start - c_start) // ec.step
+        cov_end = min(e.c_end, ec.end)
+        i0 = (ec.start - e.c_start) // ec.step
         n = (cov_end - ec.start) // ec.step + 1
-        out = [Timeseries(MetricName.unmarshal(raw),
-                          vals[i0:i0 + n].copy())
-               for raw, vals in series.items()]
-        return out, ec.start + n * ec.step
+        return CacheHit(e, i0, n), ec.start + n * ec.step
 
     def put(self, ec: EvalConfig, q: str, rows: list[Timeseries],
-            now_ms: int) -> None:
+            now_ms: int, trust_raw: bool = True) -> None:
         # don't cache the volatile tail
         cov_end_limit = now_ms - OFFSET_MS
         cov_end = ec.start + (
             (min(ec.end, cov_end_limit) - ec.start) // ec.step) * ec.step
         if cov_end < ec.start:
             return
+        # NOTE: empty result sets ARE cached (zero-row entry) — a panel
+        # over a dead selector must refresh tail-only, not re-scan the
+        # full range every 30s
         n = (cov_end - ec.start) // ec.step + 1
-        series = {ts.metric_name.marshal(): ts.values[:n].copy()
-                  for ts in rows}
+        vals = np.empty((len(rows), n))
+        for s, ts in enumerate(rows):
+            v = ts.values
+            vals[s, :] = v[:n] if v.size >= n else np.pad(
+                v, (0, n - v.size), constant_values=np.nan)
+        raws = [_raw_of(ts, trust_raw) for ts in rows]
+        names = [_copy_name(ts.metric_name) for ts in rows]
+        e = _Entry(ec.start, cov_end, raws, names, vals)
         with self._lock:
             key = self._key(ec, q)
-            self._cache[key] = (ec.start, cov_end, series)
+            self._cache[key] = e
             self._cache.move_to_end(key)
             while len(self._cache) > self.max_entries:
                 self._cache.popitem(last=False)  # LRU, not clear-all
 
-    def merge(self, cached: list[Timeseries], fresh: list[Timeseries],
-              ec: EvalConfig, new_start: int) -> list[Timeseries]:
-        """Stitch cached prefix rows with freshly computed suffix rows."""
+    def merge(self, hit: CacheHit, fresh: list[Timeseries],
+              ec: EvalConfig, new_start: int,
+              trust_raw: bool = True) -> list[Timeseries]:
+        """Stitch the cached prefix block with freshly computed suffix
+        rows. Block-at-a-time: the cached prefix is one 2D copy; only the
+        (small) fresh suffix is touched per series."""
         T = ec.n_points
-        n_prefix = (new_start - ec.start) // ec.step
-        by_name: dict[bytes, np.ndarray] = {}
-        for ts in cached:
-            vals = np.full(T, np.nan)
-            m = min(ts.values.size, n_prefix)
-            vals[:m] = ts.values[:m]
-            by_name[ts.metric_name.marshal()] = vals
-        for ts in fresh:
-            raw = ts.metric_name.marshal()
-            vals = by_name.get(raw)
-            if vals is None:
-                vals = np.full(T, np.nan)
-                by_name[raw] = vals
-            m = ts.values.size
-            vals[T - m:] = ts.values if m <= T else ts.values[-T:]
-        return [Timeseries(MetricName.unmarshal(raw), vals)
-                for raw, vals in by_name.items()]
+        e = hit.entry
+        n_prefix = min((new_start - ec.start) // ec.step, hit.n)
+        S_c = len(e.raws)
+        idx = {raw: s for s, raw in enumerate(e.raws)}
+        fresh_raws = [_raw_of(ts, trust_raw) for ts in fresh]
+        extra = [(ts, raw) for ts, raw in zip(fresh, fresh_raws)
+                 if raw not in idx]
+        S = S_c + len(extra)
+        vals = np.full((S, T), np.nan)
+        vals[:S_c, :n_prefix] = e.vals[:, hit.i0:hit.i0 + n_prefix]
+        raws = list(e.raws)
+        names = [_copy_name(nm) for nm in e.names]
+        for ts, raw in extra:
+            idx[raw] = len(raws)
+            raws.append(raw)
+            names.append(_copy_name(ts.metric_name))
+        for ts, raw in zip(fresh, fresh_raws):
+            s = idx[raw]
+            v = ts.values
+            m = v.size
+            vals[s, T - m:] = v if m <= T else v[-T:]
+        return [Timeseries(names[s], vals[s], raw=raws[s])
+                for s in range(S)]
 
     def reset(self):
         with self._lock:

@@ -10,6 +10,7 @@ the transfer (the reference's blockcache-hot behavior).
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import numpy as np
 
@@ -85,6 +86,12 @@ VALUE_FREE_FUNCS = frozenset({
 F32_SAFE_RANGE = float(1 << 24)
 
 
+def is_tpu_platform(platform: str | None) -> bool:
+    """True for real TPU hardware platform names. The axon tunnel plugin
+    in some images reports its own platform name rather than "tpu"."""
+    return platform in ("tpu", "axon")
+
+
 def auto_value_dtype():
     """float32 tiles on real TPU hardware; float64 elsewhere (CPU XLA has
     native f64 — the conformance dtype)."""
@@ -93,7 +100,36 @@ def auto_value_dtype():
         plat = jax.default_backend()
     except Exception:
         return np.float64
-    return np.float32 if plat == "tpu" else np.float64
+    return np.float32 if is_tpu_platform(plat) else np.float64
+
+
+_CACHE_DIR_SET = False
+
+
+def enable_compilation_cache():
+    """Point XLA's persistent compilation cache at a durable directory so
+    the fused-kernel compiles (~minutes cold on CPU-XLA) are paid once per
+    machine, not once per process. The reference's first query doesn't pay
+    a compile (docs/victoriametrics/README.md: p99 < 1s); with the cache
+    warm, neither does ours. Idempotent; loud (not silent) on failure."""
+    global _CACHE_DIR_SET
+    if _CACHE_DIR_SET:
+        return
+    import jax
+    cache_dir = os.environ.get(
+        "VM_JAX_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "vmtpu-jax"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # fused rollup kernels are small but slow to compile: cache all
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _CACHE_DIR_SET = True
+    except Exception as e:  # pragma: no cover - config drift
+        import sys
+        print(f"vmtpu: persistent compilation cache unavailable: {e!r}",
+              file=sys.stderr)
 
 
 @dataclasses.dataclass
@@ -107,6 +143,7 @@ class TPUEngine:
     _aux: object = None
 
     def __post_init__(self):
+        enable_compilation_cache()
         if self.value_dtype is None:
             self.value_dtype = auto_value_dtype()
 
@@ -346,6 +383,48 @@ def try_aggr_rollup_tpu(engine: TPUEngine, aggr: str, func: str, series,
                            num_groups, cfg)
 
 
+def warmup(engine: TPUEngine, funcs=("rate", "increase", "default_rollup"),
+           aggrs=("sum",)) -> int:
+    """Pre-compile the hot fused/per-series kernels on a small canonical
+    shape so the first real query pays neither jit-infrastructure init nor
+    the kernel compile (which also seeds the persistent compilation cache,
+    enable_compilation_cache). Serving apps call this from a daemon thread
+    at startup; returns the number of kernels exercised. Never raises —
+    warmup failure must not take the server down."""
+    import time as _time
+
+    from ..storage.metric_name import MetricName
+    from ..storage.storage import SeriesData
+    n_runs = 0
+    try:
+        S, N = max(int(engine.min_series), 64), 128
+        start = (int(_time.time() * 1000) - N * 15_000) // 60_000 * 60_000
+        rng = np.random.default_rng(7)
+        series = []
+        for i in range(S):
+            ts = np.arange(N, dtype=np.int64) * 15_000 + start
+            v = np.cumsum(rng.integers(0, 50, N)).astype(np.float64)
+            mn = MetricName.from_dict({"__name__": "__warmup__",
+                                       "i": str(i)})
+            series.append(SeriesData(mn, ts, v, raw_name=mn.marshal()))
+        cfg = RollupConfig(start=start + 600_000,
+                           end=start + (N - 1) * 15_000, step=60_000,
+                           window=300_000)
+        gids = np.zeros(S, np.int32)
+        for func in funcs:
+            if try_rollup_tpu(engine, func, series, cfg, ()) is not None:
+                n_runs += 1
+            for aggr in aggrs:
+                if try_aggr_rollup_tpu(engine, aggr, func, series, gids, 1,
+                                       cfg) is not None:
+                    n_runs += 1
+    except Exception as e:  # pragma: no cover - device drift
+        import sys
+        print(f"vmtpu: device warmup failed (serving continues): {e!r}",
+              file=sys.stderr)
+    return n_runs
+
+
 def _v0_dev(engine: TPUEngine, v0):
     """Rebase offsets in tile dtype for the kernel's counter-reset
     threshold (None for f64 engines — no rebase happened)."""
@@ -462,6 +541,25 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
     for sd in series:
         m, e = dec.float_to_decimal(sd.values)
         triples.append((sd.timestamps, m, e))
+    if f32 and not risky:
+        # The one f32 rounding happens on the REBASED MANTISSA (the delta
+        # planes reconstruct m - m[0], then scale): with fractional scales
+        # (10^-k) the mantissa range can exceed 2^24 while the value-space
+        # gate above passes, silently costing integer exactness that
+        # equality-sensitive funcs (changes, reset classification) need.
+        # Specials (NaN/Inf sentinels ~ 2^63) can't reach here: the int32
+        # plane check below rejects them first, but mask to |m|<2^31
+        # anyway so the gate never trips on a sentinel-only artifact.
+        for _, m, _ in triples:
+            if not m.size:
+                continue
+            sane = np.abs(m) < 2 ** 31
+            if not sane.any():
+                continue
+            base = m[0] if sane[0] else m[sane][0]
+            if float(np.abs(m[sane] - base).max()) >= F32_SAFE_RANGE:
+                risky = True
+                break
     planes = dd.pack_delta_planes(triples, cfg.start,
                                   value_dtype=engine.value_dtype,
                                   rebase=f32)

@@ -19,6 +19,10 @@ from ..storage.metric_name import MetricName
 class Timeseries:
     metric_name: MetricName
     values: np.ndarray  # float64 [T], NaN = absent
+    # memoized metric_name.marshal() — set ONLY by producers that know the
+    # name will not be mutated downstream (the rollup result cache); may go
+    # stale if metric_name is edited, so consumers must treat it as a hint
+    raw: bytes | None = None
 
     def copy_shallow_labels(self) -> "Timeseries":
         mn = MetricName(self.metric_name.metric_group,

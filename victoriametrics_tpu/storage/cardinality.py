@@ -21,7 +21,9 @@ class BloomLimiter:
         self.max_series = max_series
         self.rotation_s = rotation_s
         self.name = name
-        nbits = max(max_series * BITS_PER_ITEM, 64)
+        # floor well above BITS_PER_ITEM*k so tiny limits (tests, strict
+        # quotas) don't degenerate into false-positive admissions
+        nbits = max(max_series * BITS_PER_ITEM, 4096)
         self._nbits = nbits
         self._bits = bytearray((nbits + 7) // 8)
         self._count = 0

@@ -13,16 +13,20 @@ with labels sorted by key. Escapes: 0x00->0x02 0x03, 0x01->0x02 0x04,
 
 from __future__ import annotations
 
+import re
+
 SEP_TAG = b"\x00"
 SEP_KV = b"\x01"
 _ESC = b"\x02"
 
 _ESC_MAP = {0x00: b"\x02\x03", 0x01: b"\x02\x04", 0x02: b"\x02\x05"}
 _UNESC_MAP = {0x03: 0x00, 0x04: 0x01, 0x05: 0x02}
+# one C-level scan for the (overwhelmingly common) nothing-to-escape case
+_NEEDS_ESC = re.compile(rb"[\x00-\x02]")
 
 
 def escape(b: bytes) -> bytes:
-    if not (b"\x00" in b or b"\x01" in b or b"\x02" in b):
+    if _NEEDS_ESC.search(b) is None:
         return b
     out = bytearray()
     for c in b:

@@ -778,6 +778,18 @@ class Storage:
             if piece_ids is not None:
                 same &= piece_ids[1:] == piece_ids[:-1]
             if bool(same.any()):
+                # Coalescing disables assemble()'s per-row disorder sort
+                # for the merged rows, so VERIFY the invariant it rests on
+                # (intra-part blocks of one tsid are time-ordered and
+                # non-overlapping): last ts of block j must not exceed
+                # first ts of block j+1 across every merged boundary.
+                # O(#boundaries) gather; on violation keep blocks separate
+                # and let the sort fix handle them.
+                ends = np.cumsum(cnts)
+                j = np.flatnonzero(same)
+                pos = ends[j]
+                same[j[ts_all[pos - 1] > ts_all[pos]]] = False
+            if bool(same.any()):
                 starts_blk = np.empty(K, bool)
                 starts_blk[0] = True
                 np.logical_not(same, out=starts_blk[1:])
