@@ -1,0 +1,71 @@
+"""PartWriter.write_blocks_bulk must be byte-identical to the per-block
+write_block path (same marshal-type choices, zstd gates, headers, index
+layout) — the flush hot path swaps implementations, not formats."""
+
+import filecmp
+import os
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu import native
+from victoriametrics_tpu.storage.block import Block
+from victoriametrics_tpu.storage.part import Part, PartWriter
+from victoriametrics_tpu.storage.tsid import TSID
+
+T0 = 1_753_700_000_000
+
+
+def _mk_blocks():
+    rng = np.random.default_rng(5)
+    out = []
+    for i in range(64):
+        tsid = TSID(0, 0, 7, 1, 2, 1000 + i)
+        n = int(rng.integers(1, 400))
+        ts = np.sort(T0 + np.arange(n, dtype=np.int64) * 15000 +
+                     rng.integers(-2000, 2001, n))
+        kind = i % 5
+        if kind == 0:      # const
+            vals = np.full(n, 42.0)
+        elif kind == 1:    # delta-const (linear)
+            vals = np.arange(n, dtype=np.float64) * 5
+        elif kind == 2:    # counter
+            vals = np.cumsum(rng.integers(0, 50, n)).astype(np.float64)
+        elif kind == 3:    # gauge (noisy)
+            vals = np.round(rng.uniform(-100, 100, n), 3)
+        else:              # counter w/ large values (compressible)
+            vals = 1e9 + np.cumsum(rng.integers(0, 3, n)).astype(np.float64)
+        out.append(Block.from_floats(tsid, ts, vals))
+    return out
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native codec")
+def test_bulk_write_matches_per_block(tmp_path):
+    blocks = _mk_blocks()
+    wa = PartWriter(str(tmp_path / "a"))
+    for b in blocks:
+        wa.write_block(b)
+    wa.close()
+    wb = PartWriter(str(tmp_path / "b"))
+    wb.write_blocks_bulk(blocks)
+    wb.close()
+    for fn in ("timestamps.bin", "values.bin", "index.bin",
+               "metaindex.bin"):
+        fa = os.path.join(str(tmp_path / "a"), fn)
+        fb = os.path.join(str(tmp_path / "b"), fn)
+        assert filecmp.cmp(fa, fb, shallow=False), fn
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native codec")
+def test_bulk_write_roundtrip(tmp_path):
+    blocks = _mk_blocks()
+    w = PartWriter(str(tmp_path / "p"))
+    w.write_blocks_bulk(blocks)
+    w.close()
+    p = Part(str(tmp_path / "p"))
+    got = list(p.iter_blocks())
+    assert len(got) == len(blocks)
+    for a, b in zip(got, blocks):
+        np.testing.assert_array_equal(a.timestamps, b.timestamps)
+        np.testing.assert_allclose(a.float_values(), b.float_values(),
+                                   rtol=1e-12)

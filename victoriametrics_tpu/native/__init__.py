@@ -97,6 +97,8 @@ def _configure(lib):
                                    i64, pi64, pf64, pi64]
     lib.vm_counter_resets_2d.restype = None
     lib.vm_counter_resets_2d.argtypes = [pf64, i64, i64, pf64]
+    lib.vm_f2d_grouped.restype = None
+    lib.vm_f2d_grouped.argtypes = [pf64, pi64, i64, i64, pi64, pi64]
     lib.vm_rollup_counter_2d.restype = None
     lib.vm_rollup_counter_2d.argtypes = [pi64, pf64, pi64, i64, i64, i64,
                                          i64, i64, i64, pi64,
@@ -278,6 +280,24 @@ def decimal_to_float_blocks(m: np.ndarray, group_offsets: np.ndarray,
     lib.vm_decimal_to_float_blocks(
         _as_i64_ptr(m), _as_i64_ptr(group_offsets), _as_i64_ptr(exps), k,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+
+
+def f2d_grouped(values: np.ndarray, starts: np.ndarray):
+    """Grouped float64 -> (int64 mantissas, per-group exponents), the
+    native twin of ops/decimal.float_to_decimal_grouped (flush hot path).
+    Returns None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    v = np.ascontiguousarray(values, dtype=np.float64)
+    st = np.ascontiguousarray(starts, dtype=np.int64)
+    m_out = np.empty(v.size, np.int64)
+    exps = np.empty(st.size, np.int64)
+    lib.vm_f2d_grouped(
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _as_i64_ptr(st), st.size, v.size, _as_i64_ptr(m_out),
+        _as_i64_ptr(exps))
+    return m_out, exps
 
 
 def clip_blocks(ts: np.ndarray, bstart: np.ndarray, bend: np.ndarray,

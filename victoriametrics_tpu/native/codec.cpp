@@ -633,4 +633,173 @@ void vm_rollup_counter_2d(const int64_t* ts, const double* v,
     }
 }
 
+// ---------------------------------------------------------------------------
+// grouped float64 -> decimal (int64 mantissas + per-group common exponent)
+// ---------------------------------------------------------------------------
+// Mirrors ops/decimal.float_to_decimal_grouped exactly (the flush hot
+// path): element-wise mantissa extraction (integer fast path, 15-digit
+// round-trip check, 17-digit fallback, trailing-zero strip), then per-group
+// common-exponent unification and rescale. Sentinels and rounding modes
+// (nearbyint == np.round half-to-even under the default FP environment)
+// match the Python pipeline bit for bit.
+
+#define VM_F2D_MAX_MANTISSA 100000000000000000LL  // 10^17
+#define VM_F2D_MIN_EXP (-320)
+#define VM_F2D_MAX_EXP 310
+#define VM_V_NAN INT64_MIN
+#define VM_V_STALE_NAN (INT64_MIN + 1)
+#define VM_V_INF_NEG (INT64_MIN + 2)
+#define VM_V_INF_POS INT64_MAX
+
+enum { VM_K_NORM = 0, VM_K_ZERO, VM_K_STALE, VM_K_NAN, VM_K_PINF,
+       VM_K_NINF };
+
+// Power-of-ten table built by the SAME recurrence as ops/decimal.py's
+// _POW10_TABLE (T[k] = T[k-1]*10; T[-k] = 1/T[k] while finite, then /10
+// into the subnormals): libm pow and numpy's SIMD pow differ by an ulp at
+// large exponents, so a shared table is the only way both pipelines
+// produce bit-identical mantissas.
+#define VM_POW10_MAX 340
+struct VmPow10Table {
+    double t[2 * VM_POW10_MAX + 1];
+    VmPow10Table() {
+        t[VM_POW10_MAX] = 1.0;
+        for (int k = 1; k <= VM_POW10_MAX; k++) {
+            t[VM_POW10_MAX + k] = t[VM_POW10_MAX + k - 1] * 10.0;
+            if (!std::isinf(t[VM_POW10_MAX + k]))
+                t[VM_POW10_MAX - k] = 1.0 / t[VM_POW10_MAX + k];
+            else
+                t[VM_POW10_MAX - k] = t[VM_POW10_MAX - k + 1] / 10.0;
+        }
+    }
+};
+static const double* vm_pow10_table() {
+    static VmPow10Table p;  // C++11 thread-safe init
+    return p.t;
+}
+
+static inline double vm_pow10d(int64_t e) {
+    if (e > VM_POW10_MAX) e = VM_POW10_MAX;
+    if (e < -VM_POW10_MAX) e = -VM_POW10_MAX;
+    return vm_pow10_table()[e + VM_POW10_MAX];
+}
+
+// x * 10^e for e >= 0 without overflowing the pow (split at 300), matching
+// decimal._scale_up
+static inline double vm_scale_up(double x, int64_t e) {
+    int64_t e1 = e < 300 ? e : 300;
+    return x * vm_pow10d(e1) * vm_pow10d(e - e1);
+}
+
+static void vm_f2d_decompose(double v, int64_t exp10, int digits,
+                             int64_t* mo, int64_t* eo) {
+    int64_t ei = exp10 - (digits - 1);
+    if (ei < VM_F2D_MIN_EXP) ei = VM_F2D_MIN_EXP;
+    if (ei > VM_F2D_MAX_EXP) ei = VM_F2D_MAX_EXP;
+    double scaled = (ei < 0) ? vm_scale_up(v, -ei) : v / vm_pow10d(ei);
+    double mi = nearbyint(scaled);
+    double lim = vm_pow10d(digits);
+    if (fabs(mi) >= lim) {  // 1-off exponent from floor(log10) at edges
+        ei += 1;
+        scaled = (ei < 0) ? vm_scale_up(v, -ei) : v / vm_pow10d(ei);
+        mi = nearbyint(scaled);
+    }
+    if (mi > (double)VM_F2D_MAX_MANTISSA) mi = (double)VM_F2D_MAX_MANTISSA;
+    if (mi < -(double)VM_F2D_MAX_MANTISSA) mi = -(double)VM_F2D_MAX_MANTISSA;
+    *mo = (int64_t)mi;
+    *eo = ei;
+}
+
+static inline void vm_f2d_elem(double x, int64_t* m, int64_t* e,
+                               int* kind) {
+    *m = 0;
+    *e = 0;
+    if (x != x) {
+        uint64_t bits;
+        memcpy(&bits, &x, 8);
+        *kind = (bits == 0x7FF0000000000002ULL) ? VM_K_STALE : VM_K_NAN;
+        return;
+    }
+    if (std::isinf(x)) { *kind = x > 0 ? VM_K_PINF : VM_K_NINF; return; }
+    if (x == 0.0) { *kind = VM_K_ZERO; return; }
+    *kind = VM_K_NORM;
+    double ax = fabs(x);
+    int64_t exp10 = (int64_t)floor(log10(ax));
+    if (x == floor(x) && ax <= (double)VM_F2D_MAX_MANTISSA) {
+        *m = (int64_t)x;
+        *e = 0;
+    } else {
+        int64_t m15, e15;
+        vm_f2d_decompose(x, exp10, 15, &m15, &e15);
+        double recon = (e15 < 0) ? (double)m15 / vm_pow10d(-e15)
+                                 : (double)m15 * vm_pow10d(e15);
+        if (recon == x) {
+            *m = m15;
+            *e = e15;
+        } else {
+            vm_f2d_decompose(x, exp10, 17, m, e);
+        }
+    }
+    while (*m != 0 && *m % 10 == 0) {
+        *m /= 10;
+        *e += 1;
+    }
+}
+
+// v[n] float64 -> m_out[n] int64 mantissas + exps_out[n_groups]; group g
+// covers v[starts[g]..starts[g+1]) (starts[n_groups] == n implied).
+void vm_f2d_grouped(const double* v, const int64_t* starts,
+                    int64_t n_groups, int64_t n, int64_t* m_out,
+                    int64_t* exps_out) {
+    std::vector<int64_t> es(n);
+    std::vector<signed char> kinds(n);
+    for (int64_t i = 0; i < n; i++) {
+        int kind;
+        vm_f2d_elem(v[i], &m_out[i], &es[i], &kind);
+        kinds[i] = (signed char)kind;
+    }
+    for (int64_t g = 0; g < n_groups; g++) {
+        int64_t a = starts[g];
+        int64_t b = (g + 1 < n_groups) ? starts[g + 1] : n;
+        int64_t emin = INT64_MAX, efloor = INT64_MIN;
+        bool has_norm = false;
+        for (int64_t i = a; i < b; i++) {
+            if (kinds[i] != VM_K_NORM) continue;
+            has_norm = true;
+            if (es[i] < emin) emin = es[i];
+            double absm = (double)(m_out[i] < 0 ? -m_out[i] : m_out[i]);
+            if (absm < 1.0) absm = 1.0;
+            int64_t allowed_up = (int64_t)floor(
+                log10((double)VM_F2D_MAX_MANTISSA / absm));
+            int64_t fl = es[i] - allowed_up;
+            if (fl > efloor) efloor = fl;
+        }
+        int64_t exp = emin < VM_F2D_MAX_EXP ? emin : VM_F2D_MAX_EXP;
+        if (efloor > exp) exp = efloor;
+        if (exp > VM_F2D_MAX_EXP) exp = VM_F2D_MAX_EXP;
+        if (exp < VM_F2D_MIN_EXP) exp = VM_F2D_MIN_EXP;
+        if (!has_norm) exp = 0;
+        exps_out[g] = exp;
+        for (int64_t i = a; i < b; i++) {
+            switch (kinds[i]) {
+                case VM_K_STALE: m_out[i] = VM_V_STALE_NAN; continue;
+                case VM_K_NAN: m_out[i] = VM_V_NAN; continue;
+                case VM_K_PINF: m_out[i] = VM_V_INF_POS; continue;
+                case VM_K_NINF: m_out[i] = VM_V_INF_NEG; continue;
+                case VM_K_ZERO: m_out[i] = 0; continue;
+            }
+            int64_t shift = es[i] - exp;
+            if (shift > 0) {
+                int64_t factor = 1;
+                for (int64_t k = 0; k < shift; k++) factor *= 10;
+                m_out[i] *= factor;
+            } else if (shift < 0) {
+                int64_t dshift = -shift < 19 ? -shift : 19;
+                m_out[i] = (int64_t)nearbyint(
+                    (double)m_out[i] / vm_pow10d(dshift));
+            }
+        }
+    }
+}
+
 }  // extern "C"

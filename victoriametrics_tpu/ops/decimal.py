@@ -46,9 +46,31 @@ def is_stale_nan(values: np.ndarray) -> np.ndarray:
     return v.view(np.uint64) == STALE_NAN_BITS
 
 
+# Power-of-ten table built by the SAME multiplicative recurrence as the
+# native codec (T[k] = T[k-1]*10, T[-k] = 1/T[k]): exact for |e| <= 22 and
+# bit-identical across the Python and C++ pipelines — np.power's SIMD path
+# differs from libm pow by an ulp at large exponents, which would make
+# native-encoded mantissas diverge from Python-encoded ones.
+_POW10_MAX = 340
+_POW10_TABLE = np.empty(2 * _POW10_MAX + 1, dtype=np.float64)
+_POW10_TABLE[_POW10_MAX] = 1.0
+with np.errstate(over="ignore"):
+    for _k in range(1, _POW10_MAX + 1):
+        _POW10_TABLE[_POW10_MAX + _k] = \
+            _POW10_TABLE[_POW10_MAX + _k - 1] * 10.0
+        if _POW10_TABLE[_POW10_MAX + _k] != np.inf:
+            _POW10_TABLE[_POW10_MAX - _k] = \
+                1.0 / _POW10_TABLE[_POW10_MAX + _k]
+        else:  # subnormal range: continue by division (1/inf would be 0)
+            _POW10_TABLE[_POW10_MAX - _k] = \
+                _POW10_TABLE[_POW10_MAX - _k + 1] / 10.0
+del _k
+
+
 def _pow10_float(e):
-    """10^e as float64; exact for |e| <= 22."""
-    return np.power(10.0, np.asarray(e, dtype=np.float64))
+    """10^e as float64; exact for |e| <= 22 (table-driven, see above)."""
+    idx = np.asarray(e, dtype=np.int64) + _POW10_MAX
+    return _POW10_TABLE[np.clip(idx, 0, 2 * _POW10_MAX)]
 
 
 def _scalar_mantissa(x: float) -> tuple[int, int]:
@@ -268,8 +290,8 @@ def _f2d_rescale(m, e, normal, exp):
     if down.any():
         # Lossy: value has more precision than the common scale can hold.
         # Shifts beyond 18 decimal places collapse the mantissa to zero.
-        dshift = np.minimum(np.where(down, -shift, 1), 19).astype(np.float64)
-        factor = np.power(10.0, dshift)
+        dshift = np.minimum(np.where(down, -shift, 1), 19)
+        factor = _pow10_float(dshift)
         m = np.where(down, np.round(m.astype(np.float64) / factor).astype(np.int64), m)
     return m
 
@@ -306,6 +328,13 @@ def float_to_decimal_grouped(values: np.ndarray, starts: np.ndarray
     exps = np.zeros(n_groups, dtype=np.int64)
     if v.size == 0 or n_groups == 0:
         return np.zeros(v.size, dtype=np.int64), exps
+    if v.size >= 256:
+        # bit-identical native twin (differentially tested, shared pow10
+        # table) — the flush hot path
+        from .. import native
+        got = native.f2d_grouped(v, starts)
+        if got is not None:
+            return got
     ends = np.append(starts[1:], v.size)
     sizes = ends - starts
     m, e, normal, specials = _f2d_element_phase(v)

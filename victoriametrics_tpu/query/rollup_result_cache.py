@@ -133,13 +133,20 @@ class RollupResultCache:
         # over a dead selector must refresh tail-only, not re-scan the
         # full range every 30s
         n = (cov_end - ec.start) // ec.step + 1
-        vals = np.empty((len(rows), n))
+        # collapse duplicate identities (last row wins, matching the old
+        # dict-keyed entries): keeping both would desync merge()'s
+        # raw->row index and freeze one row's tail forever
+        by_raw: dict[bytes, int] = {}
         for s, ts in enumerate(rows):
-            v = ts.values
-            vals[s, :] = v[:n] if v.size >= n else np.pad(
+            by_raw[_raw_of(ts, trust_raw)] = s
+        raws = list(by_raw.keys())
+        vals = np.empty((len(raws), n))
+        names = []
+        for j, (raw, s) in enumerate(by_raw.items()):
+            v = rows[s].values
+            vals[j, :] = v[:n] if v.size >= n else np.pad(
                 v, (0, n - v.size), constant_values=np.nan)
-        raws = [_raw_of(ts, trust_raw) for ts in rows]
-        names = [_copy_name(ts.metric_name) for ts in rows]
+            names.append(_copy_name(rows[s].metric_name))
         e = _Entry(ec.start, cov_end, raws, names, vals)
         with self._lock:
             key = self._key(ec, q)
@@ -160,17 +167,16 @@ class RollupResultCache:
         S_c = len(e.raws)
         idx = {raw: s for s, raw in enumerate(e.raws)}
         fresh_raws = [_raw_of(ts, trust_raw) for ts in fresh]
-        extra = [(ts, raw) for ts, raw in zip(fresh, fresh_raws)
-                 if raw not in idx]
-        S = S_c + len(extra)
-        vals = np.full((S, T), np.nan)
-        vals[:S_c, :n_prefix] = e.vals[:, hit.i0:hit.i0 + n_prefix]
         raws = list(e.raws)
         names = [_copy_name(nm) for nm in e.names]
-        for ts, raw in extra:
-            idx[raw] = len(raws)
-            raws.append(raw)
-            names.append(_copy_name(ts.metric_name))
+        for ts, raw in zip(fresh, fresh_raws):
+            if raw not in idx:  # dedupe: two fresh rows may share a raw
+                idx[raw] = len(raws)
+                raws.append(raw)
+                names.append(_copy_name(ts.metric_name))
+        S = len(raws)
+        vals = np.full((S, T), np.nan)
+        vals[:S_c, :n_prefix] = e.vals[:, hit.i0:hit.i0 + n_prefix]
         for ts, raw in zip(fresh, fresh_raws):
             s = idx[raw]
             v = ts.values

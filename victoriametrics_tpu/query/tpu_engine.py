@@ -553,7 +553,9 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
         for _, m, _ in triples:
             if not m.size:
                 continue
-            sane = np.abs(m) < 2 ** 31
+            # range test, NOT np.abs: abs(INT64_MIN) overflows back to
+            # INT64_MIN (the V_NAN sentinel) and would pass an abs-< gate
+            sane = (m > -(2 ** 31)) & (m < 2 ** 31)
             if not sane.any():
                 continue
             base = m[0] if sane[0] else m[sane][0]

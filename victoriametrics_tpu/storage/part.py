@@ -171,7 +171,78 @@ class PartWriter:
 
     def write_block(self, blk: Block) -> None:
         h, ts_data, val_data = blk.marshal()
-        key = (blk.tsid.sort_key(), h.min_ts)
+        self._write_marshaled(blk.tsid, h, ts_data, val_data)
+
+    def write_blocks_bulk(self, blocks: list[Block]) -> None:
+        """Marshal + write a (tsid, min_ts)-sorted run of blocks with ONE
+        native call per stream (timestamps, mantissas) instead of
+        per-block Python — the flush hot path spends its time in encode,
+        and per-block overhead dominates at scrape-sized blocks. Falls
+        back to write_block when the native codec is absent or a block
+        needs the lossy (<64-bit precision) path."""
+        from .. import native
+        if (len(blocks) < 8 or not native.available() or
+                any(b.precision_bits < 64 for b in blocks)):
+            for b in blocks:
+                self.write_block(b)
+            return
+        from ..ops.encoding import (MIN_COMPRESSIBLE_BLOCK_SIZE,
+                                    _MIN_COMPRESS_RATIO, MarshalType, zstd)
+        K = len(blocks)
+        counts = np.fromiter((b.timestamps.size for b in blocks),
+                             np.int64, K)
+        offs = np.empty(K + 1, np.int64)
+        offs[0] = 0
+        np.cumsum(counts, out=offs[1:])
+        ts_all = np.concatenate([b.timestamps for b in blocks])
+        m_all = np.concatenate([np.asarray(b.values, np.int64)
+                                for b in blocks])
+        ts_pay, ts_t, ts_first, ts_len = native.marshal_i64_many(
+            ts_all, offs)
+        v_pay, v_t, v_first, v_len = native.marshal_i64_many(m_all, offs)
+        ts_off = np.empty(K + 1, np.int64)
+        ts_off[0] = 0
+        np.cumsum(ts_len, out=ts_off[1:])
+        v_off = np.empty(K + 1, np.int64)
+        v_off[0] = 0
+        np.cumsum(v_len, out=v_off[1:])
+        zstd_map = {int(MarshalType.NEAREST_DELTA):
+                    MarshalType.ZSTD_NEAREST_DELTA,
+                    int(MarshalType.NEAREST_DELTA2):
+                    MarshalType.ZSTD_NEAREST_DELTA2}
+        for i, blk in enumerate(blocks):
+            ts_data = ts_pay[ts_off[i]:ts_off[i + 1]]
+            val_data = v_pay[v_off[i]:v_off[i + 1]]
+            ts_mt, val_mt = int(ts_t[i]), int(v_t[i])
+            if len(ts_data) >= MIN_COMPRESSIBLE_BLOCK_SIZE and \
+                    ts_mt in zstd_map:
+                packed = zstd.compress(ts_data)
+                if len(packed) * _MIN_COMPRESS_RATIO < len(ts_data):
+                    ts_data, ts_mt = packed, int(zstd_map[ts_mt])
+            if len(val_data) >= MIN_COMPRESSIBLE_BLOCK_SIZE and \
+                    val_mt in zstd_map:
+                packed = zstd.compress(val_data)
+                if len(packed) * _MIN_COMPRESS_RATIO < len(val_data):
+                    val_data, val_mt = packed, int(zstd_map[val_mt])
+            h = BlockHeader()
+            h.tsid = blk.tsid
+            h.min_ts = int(blk.timestamps[0])
+            h.max_ts = int(blk.timestamps[-1])
+            h.rows = int(counts[i])
+            h.scale = blk.scale
+            h.precision_bits = blk.precision_bits
+            h.ts_marshal_type = ts_mt
+            h.val_marshal_type = val_mt
+            h.ts_first = int(ts_first[i])
+            h.val_first = int(v_first[i])
+            h.ts_offset = h.val_offset = 0
+            h.ts_size = len(ts_data)
+            h.val_size = len(val_data)
+            self._write_marshaled(blk.tsid, h, ts_data, val_data)
+
+    def _write_marshaled(self, tsid, h, ts_data: bytes,
+                         val_data: bytes) -> None:
+        key = (tsid.sort_key(), h.min_ts)
         if self._prev_key is not None and key < self._prev_key:
             raise ValueError("part writer: blocks out of order")
         self._prev_key = key
@@ -180,7 +251,7 @@ class PartWriter:
         self._ts_f.write(ts_data)
         self._val_f.write(val_data)
         if self._hdr_block_first is None:
-            self._hdr_block_first = blk.tsid
+            self._hdr_block_first = tsid
         self._hdrs.append(h.marshal())
         self._hdr_min_ts = min(self._hdr_min_ts, h.min_ts)
         self._hdr_max_ts = max(self._hdr_max_ts, h.max_ts)

@@ -36,6 +36,9 @@ MAX_SMALL_PARTS = 15
 # header/index overhead stays <~0.4B per sample.
 MAX_BLOCK_SPAN_MS = int(os.environ.get("VM_BLOCK_SPAN_MS", 3600 * 1000))
 MIN_SPAN_SPLIT_ROWS = 256
+# blocks buffered per bulk-marshal call on the flush/merge write path
+# (bounds the transient concat memory: ~8k blocks x 8k rows x 16B = cap)
+_BULK_WRITE_BLOCKS = 4096
 
 
 class InmemoryPart:
@@ -421,6 +424,9 @@ class Partition:
         self.name = name
         self.dedup_interval_ms = dedup_interval_ms
         self._lock = threading.RLock()
+        # serializes whole flush/merge operations (heavy part writes run
+        # outside _lock so ingest/reads never stall behind them)
+        self._flush_mutex = threading.RLock()
         self._pending: list = []        # row tuples and/or PendingChunks
         self._pending_nrows = 0
         # incremental InmemoryPart views over _pending: each query converts
@@ -538,27 +544,50 @@ class Partition:
             self._flush_pending_locked()
 
     def flush_to_disk(self):
-        """pending + in-memory parts -> one small file part (durable)."""
-        with self._lock:
-            self._flush_pending_locked()
-            if not self._mem_parts:
-                return
-            mems = self._mem_parts
-            self._write_merged_locked([m.iter_blocks() for m in mems])
-            # clear only after the durable write succeeded: an ENOSPC abort
-            # must not drop the buffered rows
-            self._mem_parts = []
-            if len(self._file_parts) > MAX_SMALL_PARTS:
-                self._merge_file_parts_locked(self._file_parts)
+        """pending + in-memory parts -> one small file part (durable).
 
-    def _write_merged_locked(self, sources, deleted_ids=None, min_valid_ts=None):
+        The heavy encode+fsync runs OUTSIDE the partition data lock:
+        ingest only pauses for the two brief list swaps, not the multi-
+        second part write (the reference's background merger pool
+        behavior, partition.go:663 — here the flusher thread is that
+        pool). _flush_mutex serializes concurrent flushers/mergers."""
+        with self._flush_mutex:
+            with self._lock:
+                self._flush_pending_locked()
+                if not self._mem_parts:
+                    return
+                mems = list(self._mem_parts)
+            p = self._write_part([m.iter_blocks() for m in mems])
+            with self._lock:
+                if p is not None:
+                    self._file_parts.append(p)
+                    self._write_parts_json_locked()
+                # drop exactly the flushed parts; newer mem parts appended
+                # during the write stay (an ENOSPC abort keeps everything)
+                flushed = {id(m) for m in mems}
+                self._mem_parts = [m for m in self._mem_parts
+                                   if id(m) not in flushed]
+                merge_now = len(self._file_parts) > MAX_SMALL_PARTS
+            if merge_now:
+                self._merge_file_parts(self._file_parts)
+
+    def _write_part(self, sources, deleted_ids=None, min_valid_ts=None):
+        """Merge block streams into a new on-disk part (no data lock held;
+        callers register the returned Part under the lock)."""
         name = f"p_{next(self._seq):016d}"
         w = PartWriter(os.path.join(self.path, name))
         wrote = False
         try:
+            buf: list = []
             for b in _merge_block_streams(sources, deleted_ids, min_valid_ts,
                                           self.dedup_interval_ms):
-                w.write_block(b)
+                buf.append(b)
+                if len(buf) >= _BULK_WRITE_BLOCKS:
+                    w.write_blocks_bulk(buf)
+                    wrote = True
+                    buf = []
+            if buf:
+                w.write_blocks_bulk(buf)
                 wrote = True
             if not wrote:
                 w.abort()
@@ -567,54 +596,39 @@ class Partition:
         except BaseException:
             w.abort()
             raise
-        p = Part(os.path.join(self.path, name))
-        self._file_parts.append(p)
-        self._write_parts_json_locked()
-        return p
+        return Part(os.path.join(self.path, name))
 
-    def _merge_file_parts_locked(self, parts, deleted_ids=None,
-                                 min_valid_ts=None):
-        olds = list(parts)
-        if not olds:
-            return
-        survivors = [p for p in self._file_parts if p not in olds]
-        name = f"p_{next(self._seq):016d}"
-        w = PartWriter(os.path.join(self.path, name))
-        wrote = False
-        try:
-            for b in _merge_block_streams([p.iter_blocks() for p in olds],
-                                          deleted_ids, min_valid_ts,
-                                          self.dedup_interval_ms):
-                w.write_block(b)
-                wrote = True
-            if wrote:
-                w.close()
-            else:
-                w.abort()
-        except BaseException:
-            w.abort()
-            raise
-        self._file_parts = survivors + (
-            [Part(os.path.join(self.path, name))] if wrote else [])
-        self._write_parts_json_locked()
-        for old in olds:
-            # Unlink only: concurrent readers may still iterate `old`; open
-            # fds keep the data alive until the last reference drops (the
-            # reference's part-refcount pattern, here via Python GC).
-            shutil.rmtree(old.path, ignore_errors=True)
+    def _merge_file_parts(self, parts, deleted_ids=None,
+                          min_valid_ts=None):
+        """Merge `parts` into one; the heavy merge runs outside the data
+        lock (ingest and reads proceed), list swap + unlink under it."""
+        with self._flush_mutex:
+            with self._lock:
+                olds = [p for p in parts if p in self._file_parts]
+            if not olds:
+                return
+            merged = self._write_part([p.iter_blocks() for p in olds],
+                                      deleted_ids, min_valid_ts)
+            with self._lock:
+                survivors = [p for p in self._file_parts if p not in olds]
+                self._file_parts = survivors + (
+                    [merged] if merged is not None else [])
+                self._write_parts_json_locked()
+            for old in olds:
+                # Unlink only: concurrent readers may still iterate `old`;
+                # open fds keep the data alive until the last reference
+                # drops (the reference's part-refcount pattern, via GC).
+                shutil.rmtree(old.path, ignore_errors=True)
 
     def force_merge(self, deleted_ids=None, min_valid_ts=None):
         """Merge everything into one part, applying tombstones/retention
         (the /internal/force_merge + final-dedup path)."""
-        with self._lock:
-            self._flush_pending_locked()
-            mems = self._mem_parts
-            if mems:
-                self._write_merged_locked([m.iter_blocks() for m in mems])
-            self._mem_parts = []  # only after the durable write succeeded
-            if self._file_parts:
-                self._merge_file_parts_locked(self._file_parts, deleted_ids,
-                                              min_valid_ts)
+        self.flush_to_disk()
+        with self._flush_mutex:
+            with self._lock:
+                parts = list(self._file_parts)
+            if parts:
+                self._merge_file_parts(parts, deleted_ids, min_valid_ts)
 
     # -- reads -------------------------------------------------------------
 

@@ -527,6 +527,16 @@ class Storage:
                 return 0
             dates = tss // 86_400_000
             roll = np.flatnonzero(sp.last_date[ids] != dates)
+            if roll.size:
+                # touch each distinct (id, date) pair ONCE: a fresh
+                # series' first batch used to walk every ROW here (the
+                # memo only updates after the first row, but the Python
+                # loop still visited all of them)
+                d_clip = np.clip(dates[roll], -(1 << 20), (1 << 20) - 1)
+                key = (ids[roll].astype(np.int64) * (1 << 21) +
+                       d_clip + (1 << 20))
+                _, first = np.unique(key, return_index=True)
+                roll = roll[first]
             for r in roll:
                 i = int(ids[r])
                 d = int(dates[r])
@@ -597,10 +607,19 @@ class Storage:
         mv = memoryview(cr.keybuf)
         new_tsids: list = []
         drops: list = []
-        for r in np.flatnonzero(ids >= old):
-            i = int(ids[r])
-            if i != old + len(new_tsids):
-                continue  # repeat row of an id registered this pass
+        mask = ids >= old
+        if not mask.any():
+            return
+        # touch only the FIRST row of each new id, not every row of the
+        # (typically sample-dense) first batch: ids are assigned in
+        # first-occurrence order, so ascending unique ids == registration
+        # order (a 1440-sample first batch used to cost 1440 iterations
+        # per new series here)
+        rows = np.flatnonzero(mask)
+        uniq, first = np.unique(ids[rows], return_index=True)
+        for i, r in zip(uniq, rows[first]):
+            if int(i) != old + len(new_tsids):
+                continue  # defensive: gap means a concurrent registration
             key = bytes(mv[int(cr.key_off[r]):
                            int(cr.key_off[r]) + int(cr.key_len[r])])
             tsid, verdict = self._judge_key(key, tenant, transform)

@@ -80,20 +80,44 @@ def _last_stack_dump(stderr: str):
 
 
 class ProbeHandle:
-    """In-flight accelerator probe; `result()` blocks until done/deadline."""
+    """In-flight accelerator probe; `result()` blocks until done/deadline.
 
-    def __init__(self, proc: subprocess.Popen, timeout_s: float):
+    The child's stdout/stderr go to TEMP FILES, not pipes: the caller may
+    not call result() for minutes (bench overlaps the probe with ingest),
+    and a chatty backend init writing >64KB into an undrained pipe would
+    block mid-init — misdiagnosing a healthy device as hung."""
+
+    def __init__(self, proc: subprocess.Popen, timeout_s: float,
+                 out_f, err_f):
         self._proc = proc
         self._timeout_s = timeout_s
         self._t0 = time.monotonic()
         self._result = None
+        self._out_f = out_f
+        self._err_f = err_f
+
+    def _read_files(self):
+        out = err = ""
+        for attr, f in (("out", self._out_f), ("err", self._err_f)):
+            try:
+                f.seek(0)
+                data = f.read()
+                f.close()
+            except (OSError, ValueError):
+                data = ""
+            if attr == "out":
+                out = data
+            else:
+                err = data
+        return out, err
 
     def cancel(self) -> None:
         """Kill the probe child if still running (callers' error paths:
         a hung child must not outlive its parent holding the device)."""
         if self._result is None and self._proc.poll() is None:
             self._proc.kill()
-            self._proc.communicate()
+            self._proc.wait()
+            self._read_files()
 
     def result(self) -> ProbeResult:
         if self._result is not None:
@@ -101,10 +125,11 @@ class ProbeHandle:
         remaining = max(0.0, self._timeout_s -
                         (time.monotonic() - self._t0))
         try:
-            out, err = self._proc.communicate(timeout=remaining)
+            self._proc.wait(timeout=remaining)
         except subprocess.TimeoutExpired:
             self._proc.kill()
-            out, err = self._proc.communicate()
+            self._proc.wait()
+            _, err = self._read_files()
             self._result = ProbeResult(
                 None, 0,
                 f"accelerator probe timed out after {self._timeout_s:g}s "
@@ -113,6 +138,7 @@ class ProbeHandle:
                 elapsed_s=time.monotonic() - self._t0)
             return self._result
         elapsed = time.monotonic() - self._t0
+        out, err = self._read_files()
         if self._proc.returncode != 0:
             tail = (err or "").strip().splitlines()[-3:]
             self._result = ProbeResult(
@@ -134,11 +160,13 @@ class ProbeHandle:
 
 def start_probe(timeout_s: float = 600.0) -> ProbeHandle:
     """Launch the probe subprocess; returns immediately."""
+    import tempfile
     code = _PROBE_CODE.format(dump=_DUMP_INTERVAL_S)
     try:
+        out_f = tempfile.TemporaryFile(mode="w+", prefix="vmtpu-probe-out")
+        err_f = tempfile.TemporaryFile(mode="w+", prefix="vmtpu-probe-err")
         proc = subprocess.Popen([sys.executable, "-c", code],
-                                stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE, text=True,
+                                stdout=out_f, stderr=err_f, text=True,
                                 env=os.environ.copy())
     except OSError as e:
         class _Failed:
@@ -149,7 +177,7 @@ def start_probe(timeout_s: float = 600.0) -> ProbeHandle:
             def cancel(self):
                 pass
         return _Failed()
-    return ProbeHandle(proc, timeout_s)
+    return ProbeHandle(proc, timeout_s, out_f, err_f)
 
 
 def probe_backend(timeout_s: float = 600.0):
