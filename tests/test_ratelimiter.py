@@ -1,0 +1,168 @@
+"""Ingestion rate limiter (lib/ratelimiter analog): budget semantics with
+a fake clock, burst smoothing, per-tenant composition, and the HTTP
+429 + Retry-After surface."""
+
+import threading
+import time
+
+import pytest
+
+from victoriametrics_tpu.ingest.ratelimiter import (RateLimitedError,
+                                                    RateLimiter,
+                                                    TenantRateLimiters)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestRateLimiter:
+    def test_disabled_when_zero(self):
+        rl = RateLimiter(0)
+        assert rl.register_bounded(10 ** 9, max_wait_s=0) == 0.0
+
+    def test_first_burst_within_limit_admitted(self):
+        clk = FakeClock()
+        rl = RateLimiter(1000, clock=clk)
+        assert rl.register_bounded(1000, max_wait_s=0) == 0.0
+
+    def test_over_budget_reports_retry_after(self):
+        clk = FakeClock()
+        rl = RateLimiter(1000, clock=clk)
+        rl.register_bounded(1000, max_wait_s=0)  # budget exhausted
+        retry = rl.register_bounded(500, max_wait_s=0)
+        assert retry > 0
+        assert rl.limit_reached == 1
+        # a huge burst advertises a proportionally longer retry
+        retry_big = rl.register_bounded(5000, max_wait_s=0)
+        assert retry_big > retry
+
+    def test_budget_refills_with_time(self):
+        clk = FakeClock()
+        rl = RateLimiter(1000, clock=clk)
+        rl.register_bounded(1000, max_wait_s=0)
+        assert rl.register_bounded(1, max_wait_s=0) > 0
+        clk.t += 1.1  # one refill period passes
+        assert rl.register_bounded(900, max_wait_s=0) == 0.0
+
+    def test_burst_is_smoothed_by_blocking(self):
+        # real clock: 3000 rows at limit=2000/s must take >= ~0.5s (one
+        # refill wait), demonstrating the burst is spread over time
+        rl = RateLimiter(2000)
+        t0 = time.monotonic()
+        for _ in range(3):
+            rl.register(1000)  # blocking variant
+        dt = time.monotonic() - t0
+        assert dt >= 0.4, f"burst was not smoothed: {dt:.3f}s"
+        assert rl.limit_reached >= 1
+
+    def test_stop_unblocks_waiters(self):
+        rl = RateLimiter(10)
+        rl.register(10)  # exhaust
+        done = threading.Event()
+
+        def waiter():
+            rl.register(1000)  # would block ~100s
+            done.set()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        rl.stop()
+        assert done.wait(2.0), "stop() must unblock register()"
+
+
+class TestTenantRateLimiters:
+    def test_global_limit_raises(self):
+        clk = FakeClock()
+        trl = TenantRateLimiters(global_limit=100, max_wait_s=0,
+                                 clock=clk)
+        trl.register(100)
+        with pytest.raises(RateLimitedError) as ei:
+            trl.register(50)
+        assert ei.value.retry_after_s >= 1
+
+    def test_per_tenant_isolation(self):
+        clk = FakeClock()
+        trl = TenantRateLimiters(per_tenant_limit=100, max_wait_s=0,
+                                 clock=clk)
+        trl.register(100, tenant=(1, 0))
+        with pytest.raises(RateLimitedError):
+            trl.register(1, tenant=(1, 0))
+        # a different tenant still has its own budget
+        trl.register(100, tenant=(2, 0))
+
+    def test_disabled(self):
+        trl = TenantRateLimiters()
+        assert not trl.enabled()
+        trl.register(10 ** 9)  # no-op
+
+    def test_saturated_tenant_does_not_starve_global(self):
+        """A tenant-rejected batch must not consume global budget (the
+        tenant check runs first; a global rejection refunds the tenant)."""
+        clk = FakeClock()
+        trl = TenantRateLimiters(global_limit=1000, per_tenant_limit=100,
+                                 max_wait_s=0, clock=clk)
+        trl.register(100, tenant=(1, 0))  # tenant A exhausted
+        for _ in range(20):  # A's retries are tenant-rejected
+            with pytest.raises(RateLimitedError):
+                trl.register(100, tenant=(1, 0))
+        # the other tenants still get the full remaining global budget
+        for t in range(2, 11):
+            trl.register(100, tenant=(t, 0))
+
+    def test_empty_batch_never_limited(self):
+        clk = FakeClock()
+        trl = TenantRateLimiters(global_limit=10, max_wait_s=0, clock=clk)
+        trl.register(10)
+        trl.register(0)  # metadata-only post: must not 429
+
+
+class TestHTTP429:
+    def test_429_with_retry_after(self, tmp_path):
+        """Sustained overload through the real server returns 429 with a
+        Retry-After header; admitted rows still land."""
+        from victoriametrics_tpu.httpapi.prometheus_api import PrometheusAPI
+        from victoriametrics_tpu.httpapi.server import HTTPServer
+        from victoriametrics_tpu.ingest.ratelimiter import \
+            TenantRateLimiters
+        from victoriametrics_tpu.storage.storage import Storage
+        import http.client
+
+        storage = Storage(str(tmp_path / "s"))
+        api = PrometheusAPI(
+            storage, None,
+            rate_limiter=TenantRateLimiters(global_limit=100,
+                                            max_wait_s=0))
+        srv = HTTPServer("127.0.0.1", 0)
+        api.register(srv)
+        srv.start()
+        try:
+            port = srv.port
+            now_ms = int(time.time() * 1000)
+            body = "\n".join(
+                f'rlm{{i="{i}"}} {i} {now_ms}' for i in range(100)
+            ).encode()
+
+            def post(b):
+                c = http.client.HTTPConnection("127.0.0.1", port,
+                                               timeout=10)
+                c.request("POST", "/api/v1/import/prometheus", body=b)
+                r = c.getresponse()
+                data = r.read()
+                c.close()
+                return r.status, dict(r.getheaders()), data
+
+            st1, _, _ = post(body)
+            assert st1 == 204
+            st2, hdrs, data = post(body)
+            assert st2 == 429, (st2, data)
+            ra = {k.lower(): v for k, v in hdrs.items()}.get("retry-after")
+            assert ra is not None and int(ra) >= 1
+        finally:
+            srv.stop()
+            storage.close()

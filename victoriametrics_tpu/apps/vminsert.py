@@ -25,6 +25,9 @@ def parse_flags(argv=None):
                    help="expose the vminsert RPC API so a higher-level "
                         "vminsert can chain into this one (multilevel)")
     p.add_argument("-loggerLevel", default="INFO")
+    p.add_argument("-maxIngestionRate", dest="max_ingestion_rate",
+                   type=int, default=0,
+                   help="rows/s ingest ceiling, 0 = unlimited")
     args, _ = p.parse_known_args(argv)
     env = os.environ.get("VM_STORAGENODE")
     if env:
@@ -54,14 +57,20 @@ def build(args):
         replication_factor=args.replicationFactor)
     hh, _, hp = args.httpListenAddr.rpartition(":")
     srv = HTTPServer(hh or "0.0.0.0", int(hp))
-    api = PrometheusAPI(cluster)
+    rate_limiter = None
+    if getattr(args, "max_ingestion_rate", 0) > 0:
+        from ..ingest.ratelimiter import TenantRateLimiters
+        rate_limiter = TenantRateLimiters(
+            global_limit=args.max_ingestion_rate)
+    api = PrometheusAPI(cluster, rate_limiter=rate_limiter)
     api.register(srv, mode="insert")
     native_srv = None
     if getattr(args, "native_addr", ""):
         from ..parallel.cluster_api import start_native_server
         from ..parallel.rpc import HELLO_INSERT
         native_srv = start_native_server(args.native_addr, HELLO_INSERT,
-                                         cluster)
+                                         cluster,
+                                         rate_limiter=rate_limiter)
     return cluster, srv, api, native_srv
 
 

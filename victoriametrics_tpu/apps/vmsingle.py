@@ -53,6 +53,15 @@ def parse_flags(argv=None):
                    action="store_true")
     p.add_argument("-maxLabelsPerTimeseries", type=int, default=40)
     p.add_argument("-maxLabelValueLen", type=int, default=4096)
+    p.add_argument("-maxIngestionRate", dest="max_ingestion_rate",
+                   type=int, default=0,
+                   help="rows/s ingest ceiling, 0 = unlimited "
+                        "(lib/ratelimiter analog: bursts within ~1s are "
+                        "smoothed by blocking; sustained overload gets "
+                        "429 + Retry-After)")
+    p.add_argument("-maxTenantIngestionRate",
+                   dest="max_tenant_ingestion_rate", type=int, default=0,
+                   help="per-tenant rows/s ingest ceiling, 0 = unlimited")
     p.add_argument("-pushmetrics.url", dest="pushmetrics_urls",
                    action="append", default=[])
     p.add_argument("-pushmetrics.interval", dest="pushmetrics_interval",
@@ -165,6 +174,12 @@ def build(args):
     from ..ingest.serieslimits import SeriesLimits
     limits = SeriesLimits(max_labels_per_series=args.maxLabelsPerTimeseries,
                           max_label_value_len=args.maxLabelValueLen)
+    rate_limiter = None
+    if args.max_ingestion_rate > 0 or args.max_tenant_ingestion_rate > 0:
+        from ..ingest.ratelimiter import TenantRateLimiters
+        rate_limiter = TenantRateLimiters(
+            global_limit=args.max_ingestion_rate,
+            per_tenant_limit=args.max_tenant_ingestion_rate)
     api = PrometheusAPI(storage, None,
                         lookback_delta=_dur_ms(args.lookback),
                         max_series=args.max_series,
@@ -174,7 +189,8 @@ def build(args):
                         max_samples_per_query=args.max_samples_per_query,
                         max_memory_per_query=args.max_memory_per_query,
                         max_query_duration_ms=_dur_ms(
-                            args.max_query_duration))
+                            args.max_query_duration),
+                        rate_limiter=rate_limiter)
     _attach_tpu_engine(api, args.tpu)
     api.flags_map = {k: v for k, v in vars(args).items()}
     api.register(srv)

@@ -170,8 +170,11 @@ class PrometheusAPI:
                  stream_aggr=None, stream_aggr_keep_input=False,
                  max_concurrent_queries=None, series_limits=None,
                  max_samples_per_query=1_000_000_000,
-                 max_memory_per_query=0, max_query_duration_ms=30_000):
+                 max_memory_per_query=0, max_query_duration_ms=30_000,
+                 rate_limiter=None):
         self.storage = storage
+        # ingest.ratelimiter.TenantRateLimiters (-maxIngestionRate analog)
+        self.rate_limiter = rate_limiter
         self.tpu = tpu_engine
         self.lookback_delta = lookback_delta
         self.max_series = max_series
@@ -756,6 +759,10 @@ class PrometheusAPI:
 
     def _ingest_columnar(self, cr, tenant=(0, 0)) -> int:
         """Shared columnar ingest tail (native.ColumnarRows batches)."""
+        if self.rate_limiter is not None and self.rate_limiter.enabled():
+            # registers the raw batch size (insert_ctx.go:286 semantics);
+            # raises RateLimitedError -> 429 + Retry-After at the server
+            self.rate_limiter.register(len(cr), tenant)
         stats: dict = {}
         n = self.storage.add_rows_columnar(
             cr, tenant=tenant, transform=self._columnar_transform(),
@@ -782,6 +789,8 @@ class PrometheusAPI:
     def _ingest(self, batch: list, tenant=(0, 0)) -> int:
         """Shared ingest tail: global relabeling (-relabelConfig analog,
         app/vminsert/relabel) -> stream aggregation hook -> storage."""
+        if self.rate_limiter is not None and self.rate_limiter.enabled():
+            self.rate_limiter.register(len(batch), tenant)
         if self.relabel is not None:
             out = []
             for labels, ts, val in batch:
@@ -1199,6 +1208,10 @@ class PrometheusAPI:
             self.srv.request_count or 0
         m["vm_rows_inserted_total"] = self.rows_inserted
         m["vm_relabel_metrics_dropped_total"] = self.rows_relabel_dropped
+        if self.rate_limiter is not None and \
+                self.rate_limiter.global_rl is not None:
+            m["vm_max_ingestion_rate_limit_reached_total"] = \
+                self.rate_limiter.global_rl.limit_reached
         if self.series_limits is not None:
             m.update(self.series_limits.metrics())
         m["vm_app_uptime_seconds"] = round(time.time() - self.started_at, 3)

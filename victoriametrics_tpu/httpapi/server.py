@@ -10,6 +10,7 @@ import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..ingest.ratelimiter import RateLimitedError
 from ..ops import compress as zstd
 from ..utils import logger
 
@@ -102,6 +103,10 @@ class HTTPServer:
                     return
                 try:
                     resp = fn(req)
+                except RateLimitedError as e:
+                    resp = Response.error(str(e), 429,
+                                          "too_many_requests")
+                    resp.headers["Retry-After"] = str(e.retry_after_s)
                 except Exception as e:  # noqa: BLE001 - error boundary
                     logger.errorf("http handler %s: %s", req.path, e)
                     import traceback

@@ -59,8 +59,10 @@ def _write_tenant(w: Writer, tenant) -> Writer:
     return w.u64(tenant[0]).u64(tenant[1])
 
 
-def make_storage_handlers(storage) -> dict:
-    """RPC dispatch table for a vmstorage node."""
+def make_storage_handlers(storage, rate_limiter=None) -> dict:
+    """RPC dispatch table for a vmstorage node. `rate_limiter` applies
+    -maxIngestionRate to RPC writes too (the multilevel/clusternative
+    chaining path must honor the same ceiling as HTTP ingest)."""
 
     def h_write_rows(r: Reader):
         tenant = _read_tenant(r)
@@ -71,6 +73,8 @@ def make_storage_handlers(storage) -> dict:
             ts = r.i64()
             val = r.f64()
             rows.append((MetricName.unmarshal(raw), ts, val))
+        if rate_limiter is not None and rate_limiter.enabled():
+            rate_limiter.register(len(rows), tenant)
         storage.add_rows(rows, tenant=tenant)
         return Writer().u64(len(rows))
 
@@ -289,13 +293,14 @@ class PartialResultError(RuntimeError):
     pass
 
 
-def start_native_server(addr: str, hello: bytes, storage):
+def start_native_server(addr: str, hello: bytes, storage,
+                        rate_limiter=None):
     """Start a cluster-native RPC server exposing `storage` (used by the
     -clusternativeListenAddr multilevel flags on vminsert/vmselect)."""
     from .rpc import RPCServer
     host, _, port = addr.rpartition(":")
     srv = RPCServer(host or "0.0.0.0", int(port), hello,
-                    make_storage_handlers(storage))
+                    make_storage_handlers(storage, rate_limiter))
     srv.start()
     return srv
 
