@@ -602,6 +602,31 @@ def labels_from_series_key(key: bytes) -> list:
     return list(dict(row.labels).items())
 
 
+def series_key_from_labels(labels) -> bytes:
+    """Inverse of labels_from_series_key: build the canonical raw
+    `name{k="v",...}` text key from [(name, value)] pairs (labels sorted,
+    values escaped). Used by cluster vminsert to ship RELABELED series
+    keys columnar — the storage node must see the post-transform key."""
+    name = ""
+    rest = []
+    for k, v in labels:
+        ks = k.decode() if isinstance(k, bytes) else k
+        vs = v.decode() if isinstance(v, bytes) else v
+        if ks == "__name__":
+            name = vs
+        else:
+            rest.append((ks, vs))
+    rest.sort()
+    if not rest:
+        return name.encode()
+    parts = []
+    for ks, vs in rest:
+        vs = vs.replace("\\", "\\\\").replace('"', '\\"') \
+            .replace("\n", "\\n")
+        parts.append(f'{ks}="{vs}"')
+    return f"{name}{{{','.join(parts)}}}".encode()
+
+
 def parse_prometheus_fast(data: bytes, default_ts: int = 0):
     """Native-accelerated prometheus parse returning raw-key rows
     [(series_key_bytes, ts_ms, value)] suitable for Storage.add_rows.

@@ -78,6 +78,35 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         storage.add_rows(rows, tenant=tenant)
         return Writer().u64(len(rows))
 
+    def h_write_rows_columnar(r: Reader):
+        """writeRows_v2: ColumnarRows shipped raw — text series keys +
+        ts/value columns. The storage node resolves whole batches through
+        its native key map (no per-row Python unmarshal on either side;
+        the reference's raw-row routing, lib/vminsertapi/api.go:15)."""
+        tenant = _read_tenant(r)
+        keybuf = r.bytes_()
+        key_off = r.array()
+        key_len = r.array()
+        tss = r.array()
+        vals = r.array()
+        if rate_limiter is not None and rate_limiter.enabled():
+            rate_limiter.register(int(key_off.size), tenant)
+        from .. import native
+        cr = native.ColumnarRows(keybuf, key_off, key_len, tss, vals)
+        if getattr(storage, "add_rows_columnar", None) is not None:
+            n = storage.add_rows_columnar(cr, tenant=tenant)
+        else:  # storage without a columnar path: materialize rows
+            from ..ingest.parsers import labels_from_series_key
+            rows = []
+            for k, ts, val in cr.to_rows():
+                try:
+                    rows.append((MetricName.from_labels(
+                        labels_from_series_key(k)), ts, val))
+                except ValueError:
+                    continue
+            n = storage.add_rows(rows, tenant=tenant)
+        return Writer().u64(int(n))
+
     def h_is_readonly(r: Reader):
         return Writer().u64(1 if getattr(storage, "is_readonly", False) else 0)
 
@@ -105,6 +134,65 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                 yield w
             # trailing metadata frame: propagate partial-result state up
             # through multilevel chains
+            meta = Writer().u64(META_FRAME)
+            meta.u64(1 if getattr(storage, "last_partial", False) else 0)
+            yield meta
+        return frames()
+
+    def h_search_columns(r: Reader):
+        """searchColumns_v1: the columnar read plane — per-frame batches
+        of (raw names, counts, concatenated ts/value columns) instead of
+        per-series decoded arrays. Cluster reads then feed the same
+        columnar host path and device tile packer as single-node reads
+        (the MetricBlock-streaming role, lib/vmselectapi/server.go:1010)."""
+        tenant = _read_tenant(r)
+        filters = _read_filters(r)
+        min_ts, max_ts = r.i64(), r.i64()
+        if hasattr(storage, "reset_partial"):
+            storage.reset_partial()
+        if getattr(storage, "search_columns", None) is not None:
+            cols = storage.search_columns(filters, min_ts, max_ts,
+                                          tenant=tenant)
+            raw_names = cols.raw_names
+            counts = cols.counts
+            ts2, v2 = cols.ts, cols.vals
+            S = cols.n_series
+
+            def series_arrays(a, b):
+                sel = np.arange(ts2.shape[1])[None, :] < \
+                    counts[a:b, None]
+                return ts2[a:b][sel], v2[a:b][sel]
+        else:  # per-series storage: adapt
+            series = storage.search_series(filters, min_ts, max_ts,
+                                           tenant=tenant)
+            raw_names = [getattr(sd, "raw_name", None) or
+                         sd.metric_name.marshal() for sd in series]
+            counts = np.fromiter((sd.timestamps.size for sd in series),
+                                 np.int64, len(series))
+            S = len(series)
+
+            def series_arrays(a, b):
+                ts_cat = (np.concatenate(
+                    [sd.timestamps for sd in series[a:b]])
+                    if b > a else np.zeros(0, np.int64))
+                v_cat = (np.concatenate([sd.values for sd in series[a:b]])
+                         if b > a else np.zeros(0, np.float64))
+                return ts_cat, v_cat
+
+        def frames():
+            for a in range(0, S, SERIES_PER_FRAME):
+                b = min(a + SERIES_PER_FRAME, S)
+                w = Writer()
+                w.u64(b - a)
+                names = raw_names[a:b]
+                w.array(np.fromiter((len(nm) for nm in names), np.int64,
+                                    b - a))
+                w.bytes_(b"".join(names))
+                w.array(np.asarray(counts[a:b], np.int64))
+                ts_cat, v_cat = series_arrays(a, b)
+                w.array(np.asarray(ts_cat, np.int64))
+                w.array(np.asarray(v_cat, np.float64))
+                yield w
             meta = Writer().u64(META_FRAME)
             meta.u64(1 if getattr(storage, "last_partial", False) else 0)
             yield meta
@@ -178,8 +266,10 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
 
     return {
         "writeRows_v1": h_write_rows,
+        "writeRowsColumnar_v1": h_write_rows_columnar,
         "isReadOnly_v1": h_is_readonly,
         "search_v1": h_search,
+        "searchColumns_v1": h_search_columns,
         "searchMetricNames_v1": h_search_metric_names,
         "labelNames_v1": h_label_names,
         "labelValues_v1": h_label_values,
@@ -223,6 +313,39 @@ class StorageNodeClient:
             w.f64(float(val))
         self.insert.call("writeRows_v1", w)
 
+    supports_columnar_write = True  # cleared on first unknown-method error
+
+    def write_rows_columnar(self, keybuf: bytes, key_off, key_len,
+                            tss, vals, tenant=(0, 0)) -> int:
+        """Ship a ColumnarRows shard raw (writeRowsColumnar_v1); falls
+        back to per-row writeRows_v1 against old storage nodes."""
+        if self.supports_columnar_write:
+            w = _write_tenant(Writer(), tenant)
+            w.bytes_(keybuf)
+            w.array(np.asarray(key_off, np.int64))
+            w.array(np.asarray(key_len, np.int64))
+            w.array(np.asarray(tss, np.int64))
+            w.array(np.asarray(vals, np.float64))
+            try:
+                return self.insert.call("writeRowsColumnar_v1", w).u64()
+            except RPCError as e:
+                if "unknown rpc method" not in str(e):
+                    raise
+                self.supports_columnar_write = False
+        # legacy node: canonical-marshal rows (slow path)
+        from ..ingest.parsers import labels_from_series_key
+        mv = memoryview(keybuf)
+        rows = []
+        for o, ln, ts, val in zip(key_off, key_len, tss, vals):
+            key = bytes(mv[int(o):int(o) + int(ln)])
+            try:
+                mn = MetricName.from_labels(labels_from_series_key(key))
+            except ValueError:
+                continue
+            rows.append((mn.marshal(), int(ts), float(val)))
+        self.write_rows(rows, tenant)
+        return len(rows)
+
     def search_series(self, filters, min_ts, max_ts, tenant=(0, 0)):
         """Returns (series_list, remote_partial)."""
         w = _write_tenant(Writer(), tenant)
@@ -241,6 +364,57 @@ class StorageNodeClient:
                 vals = r.array()
                 out.append((mn, ts, vals))
         return out, partial
+
+    supports_columnar_read = True  # cleared on first unknown-method error
+
+    def search_columns(self, filters, min_ts, max_ts, tenant=(0, 0)):
+        """Columnar read plane: returns (raw_names list, counts int64[],
+        ts_cat int64[], vals_cat float64[], remote_partial). Falls back to
+        search_v1 against old nodes (same return shape)."""
+        if self.supports_columnar_read:
+            w = _write_tenant(Writer(), tenant)
+            _write_filters(w, filters)
+            w.i64(min_ts).i64(max_ts)
+            try:
+                frames = self.select.call_stream("searchColumns_v1", w)
+            except RPCError as e:
+                if "unknown rpc method" not in str(e):
+                    raise
+                self.supports_columnar_read = False
+                frames = None
+            if frames is not None:
+                names: list[bytes] = []
+                cnt_parts, ts_parts, val_parts = [], [], []
+                partial = False
+                for r in frames:
+                    sf = r.u64()
+                    if sf == (1 << 32) - 1:  # trailing metadata frame
+                        partial = bool(r.u64())
+                        continue
+                    lens = r.array()
+                    namebuf = r.bytes_()
+                    off = 0
+                    for ln in lens:
+                        names.append(namebuf[off:off + int(ln)])
+                        off += int(ln)
+                    cnt_parts.append(r.array())
+                    ts_parts.append(r.array())
+                    val_parts.append(r.array())
+                cat = (lambda ps, dt: np.concatenate(ps) if ps
+                       else np.zeros(0, dt))
+                return (names, cat(cnt_parts, np.int64),
+                        cat(ts_parts, np.int64),
+                        cat(val_parts, np.float64), partial)
+        series, partial = self.search_series(filters, min_ts, max_ts,
+                                             tenant)
+        names = [mn.marshal() for mn, _, _ in series]
+        counts = np.fromiter((ts.size for _, ts, _ in series), np.int64,
+                             len(series))
+        ts_cat = (np.concatenate([ts for _, ts, _ in series])
+                  if series else np.zeros(0, np.int64))
+        val_cat = (np.concatenate([v for _, _, v in series])
+                   if series else np.zeros(0, np.float64))
+        return names, counts, ts_cat, val_cat, partial
 
     def search_metric_names(self, filters, min_ts, max_ts, tenant=(0, 0)):
         w = _write_tenant(Writer(), tenant)
@@ -305,13 +479,7 @@ def start_native_server(addr: str, hello: bytes, storage,
     return srv
 
 
-class SeriesData:
-    __slots__ = ("metric_name", "timestamps", "values")
-
-    def __init__(self, mn, ts, vals):
-        self.metric_name = mn
-        self.timestamps = ts
-        self.values = vals
+_MISSING = object()
 
 
 class ClusterStorage:
@@ -324,6 +492,9 @@ class ClusterStorage:
         self.rf = replication_factor
         self.deny_partial = deny_partial_response
         self.ch = ConsistentHash([n.name for n in nodes])
+        # per-tenant raw-key -> send-key verdicts (relabel applied once
+        # per distinct series key; see add_rows_columnar)
+        self._key_verdicts: dict[tuple, dict] = {}
         from ..query.rollup_result_cache import next_storage_token
         self.cache_token = next_storage_token()
         self.rows_sent = 0
@@ -389,6 +560,188 @@ class ClusterStorage:
         self.rows_sent += sent
         return len(rows)
 
+    # columnar ingest: the vminsert HTTP fast path (native text parse ->
+    # ColumnarRows) ships shards RAW over writeRowsColumnar_v1 — the
+    # storage node's native key map resolves whole batches, no per-row
+    # Python on either side (the r4 verdict measured the per-row RPC
+    # path at <2k rows/s; this is the fix)
+    supports_columnar = True
+    _MAX_KEY_VERDICTS = 1 << 20
+
+    def add_rows_columnar(self, cr, tenant=(0, 0), transform=None,
+                          drop_stats: dict | None = None) -> int:
+        import struct as _struct
+        tkey = _struct.pack(">II", tenant[0], tenant[1])
+        n_rows = len(cr)
+        if n_rows == 0:
+            return 0
+        key_off = np.asarray(cr.key_off, np.int64)
+        key_len = np.asarray(cr.key_len, np.int64)
+        mv = memoryview(cr.keybuf)
+        # same (offset, len) => same key bytes: unique-ify cheaply first
+        # (the native parser reuses key slots for repeat series)
+        packed = key_off * (np.int64(1) << 24) + key_len
+        uniq, inv = np.unique(packed, return_inverse=True)
+        # rows grouped by unique key
+        order = np.argsort(inv, kind="stable")
+        bounds = np.searchsorted(inv[order], np.arange(uniq.size + 1))
+        # verdict cache, TRANSFORM PATH ONLY: transform is a pure function
+        # of the label set, so each distinct key is parsed/relabeled ONCE
+        # across batches. The transform=None path (multilevel RPC ingest,
+        # where relabeling already happened upstream) passes keys through
+        # untouched and must NOT share verdicts — a cached no-transform
+        # passthrough would silently skip a later HTTP request's relabel
+        # rules (and vice versa).
+        vc = None
+        if transform is not None:
+            with self._lock:
+                vc = self._key_verdicts.setdefault(tenant, {})
+        excluded = {i for i, n in enumerate(self.nodes) if not n.healthy}
+        # per-node shards: node -> (list of key bytes, list of row arrays)
+        shards: dict[int, tuple[list, list]] = {}
+        # series whose transformed labels don't survive the text-key
+        # round-trip (names with key-syntax bytes): per-row canonical path
+        legacy_shards: dict[int, list] = {}
+        dropped_transform = dropped_malformed = 0
+        for j in range(uniq.size):
+            o = int(uniq[j] >> 24)
+            ln = int(uniq[j] & ((1 << 24) - 1))
+            key = bytes(mv[o:o + ln])
+            if transform is None:
+                sk = key
+            else:
+                sk = vc.get(key, _MISSING)
+                if sk is _MISSING:
+                    sk = self._judge_key(key, transform)
+                    if len(vc) >= self._MAX_KEY_VERDICTS:
+                        vc.clear()
+                    vc[key] = sk
+            rows_j = order[bounds[j]:bounds[j + 1]]
+            if sk is False:
+                dropped_malformed += rows_j.size
+                continue
+            if sk is None:
+                dropped_transform += rows_j.size
+                continue
+            if isinstance(sk, tuple):  # ("legacy", canonical_marshal)
+                raw = sk[1]
+                targets = self.ch.nodes_for_key(tkey + raw, self.rf,
+                                                excluded)
+                if not targets:
+                    targets = self.ch.nodes_for_key(tkey + raw, self.rf,
+                                                    set())
+                for i in targets:
+                    rl = legacy_shards.setdefault(i, [])
+                    for rix in rows_j:
+                        rl.append((raw, int(cr.tss[rix]),
+                                   float(cr.values[rix])))
+                continue
+            targets = self.ch.nodes_for_key(tkey + sk, self.rf, excluded)
+            if not targets:
+                targets = self.ch.nodes_for_key(tkey + sk, self.rf, set())
+            for i in targets:
+                keys, rowsl = shards.setdefault(i, ([], []))
+                keys.append(sk)
+                rowsl.append(rows_j)
+        if drop_stats is not None:
+            if dropped_transform:
+                drop_stats["transform"] = drop_stats.get(
+                    "transform", 0) + int(dropped_transform)
+            if dropped_malformed:
+                drop_stats["malformed"] = drop_stats.get(
+                    "malformed", 0) + int(dropped_malformed)
+        tss = np.asarray(cr.tss, np.int64)
+        vals = np.asarray(cr.values, np.float64)
+        sent = 0
+        for i, rows in legacy_shards.items():
+            try:
+                self.nodes[i].write_rows(rows, tenant)
+                sent += len(rows)
+            except (OSError, RPCError, ConnectionError):
+                self.nodes[i].mark_down()
+                ex = {j2 for j2, n in enumerate(self.nodes)
+                      if not n.healthy} | {i}
+                for raw, ts_, v_ in rows:
+                    alt = self.ch.nodes_for_key(tkey + raw, 1, ex)
+                    if alt:
+                        self.nodes[alt[0]].write_rows(
+                            [(raw, ts_, v_)], tenant)
+                        sent += 1
+        for i, (keys, rowsl) in shards.items():
+            try:
+                sent += self._send_columnar_shard(self.nodes[i], keys,
+                                                  rowsl, tss, vals, tenant)
+            except (OSError, RPCError, ConnectionError) as e:
+                self.nodes[i].mark_down()
+                with self._lock:
+                    self.reroutes += 1
+                ex = {j2 for j2, n in enumerate(self.nodes)
+                      if not n.healthy} | {i}
+                alt_shards: dict[int, tuple[list, list]] = {}
+                for key, rows_j in zip(keys, rowsl):
+                    alt = self.ch.nodes_for_key(tkey + key, 1, ex)
+                    if not alt:
+                        raise RPCError(
+                            f"no healthy storage nodes for reroute: {e}")
+                    ks, rl = alt_shards.setdefault(alt[0], ([], []))
+                    ks.append(key)
+                    rl.append(rows_j)
+                for j2, (ks, rl) in alt_shards.items():
+                    sent += self._send_columnar_shard(self.nodes[j2], ks,
+                                                      rl, tss, vals, tenant)
+        self.rows_sent += sent
+        return int(n_rows - dropped_transform - dropped_malformed)
+
+    @staticmethod
+    def _judge_key(key: bytes, transform):
+        """One-time verdict for a distinct raw key under `transform`:
+        bytes = ship this (relabeled) text key columnar; None = dropped
+        by the transform; False = malformed; ("legacy", marshal) = the
+        transformed labels don't survive the text round-trip (key-syntax
+        bytes in names) and must go per-row canonical."""
+        from ..ingest.parsers import (labels_from_series_key,
+                                      series_key_from_labels)
+        try:
+            labels = labels_from_series_key(key)
+        except ValueError:
+            return False
+        labels = transform(labels)
+        if not labels:
+            return None
+        sk = series_key_from_labels(labels)
+        try:
+            back = labels_from_series_key(sk)
+        except ValueError:
+            back = None
+        canon = sorted((k.decode() if isinstance(k, bytes) else k,
+                        v.decode() if isinstance(v, bytes) else v)
+                       for k, v in labels if v)
+        if back is None or sorted(back) != canon:
+            return ("legacy", MetricName.from_labels(labels).marshal())
+        return sk
+
+    def reset_columnar_spaces(self) -> None:
+        """Invalidate cached raw-key -> send-key verdicts (call after the
+        ingest transform config — relabel rules, series limits —
+        changes)."""
+        with self._lock:
+            self._key_verdicts = {}
+
+    def _send_columnar_shard(self, node, keys, rowsl, tss, vals,
+                             tenant) -> int:
+        """One writeRowsColumnar_v1 call: build the shard's keybuf +
+        per-row offset columns from (key, row-index-array) pairs."""
+        counts = np.fromiter((r.size for r in rowsl), np.int64, len(rowsl))
+        klens = np.fromiter((len(k) for k in keys), np.int64, len(keys))
+        koffs = np.concatenate([[0], np.cumsum(klens)[:-1]])
+        row_order = (np.concatenate(rowsl) if rowsl
+                     else np.zeros(0, np.int64))
+        node.write_rows_columnar(
+            b"".join(keys), np.repeat(koffs, counts),
+            np.repeat(klens, counts), tss[row_order], vals[row_order],
+            tenant)
+        return int(row_order.size)
+
     # -- read path (vmselect) -------------------------------------------
 
     def _fanout(self, fn):
@@ -432,39 +785,81 @@ class ClusterStorage:
                 f"partial response denied: {errors[0][0]}: {errors[0][1]}")
         return results
 
+    def search_columns(self, filters, min_ts, max_ts,
+                       dedup_interval_ms=None, max_series=None,
+                       tenant=(0, 0)):
+        """Columnar scatter-gather: every node streams (raw names,
+        counts, concatenated columns) over searchColumns_v1; the merge is
+        ONE vectorized assembly into the padded (S, N) layout — cluster
+        reads feed the same columnar host rollups and device tile packer
+        as single-node reads. Replica overlap is handled by assemble()'s
+        per-row sort fix + exact-duplicate-timestamp dedup (keep last),
+        identical to the old per-series merge semantics."""
+        from ..storage.columnar import ColumnarSeries, assemble
+        node_results = self._fanout(
+            lambda n: n.search_columns(filters, min_ts, max_ts, tenant))
+        names_all: list[bytes] = []
+        cnt_parts, ts_parts, val_parts = [], [], []
+        for names, counts, ts_cat, val_cat, remote_partial in node_results:
+            if remote_partial:
+                # a lower level (multilevel chain) saw an incomplete
+                # fan-out
+                self._tls.partial = True
+            names_all.extend(names)
+            cnt_parts.append(counts)
+            ts_parts.append(ts_cat)
+            val_parts.append(val_cat)
+        empty = ColumnarSeries(np.zeros(0, np.int64),
+                               np.zeros((0, 0), np.int64),
+                               np.zeros((0, 0), np.float64),
+                               np.zeros(0, np.int64), [], [])
+        if not names_all:
+            return empty
+        cnts = np.concatenate(cnt_parts)
+        ts_cat = np.concatenate(ts_parts)
+        val_cat = np.concatenate(val_parts)
+        # canonical row order = sorted raw names (matches single-node
+        # search_columns); same bytes from replicas collapse to one row
+        if any(nm[-1:] == b"\x00" for nm in names_all):
+            arr = np.array(names_all, dtype=object)
+        else:
+            arr = np.array(names_all)
+        uniq_names, rows = np.unique(arr, return_inverse=True)
+        S = int(uniq_names.size)
+        if max_series is not None and S > max_series:
+            raise ResourceWarning(
+                f"query matches {S} series, limit {max_series}")
+        keep = cnts > 0
+        if not keep.all():
+            sample_keep = np.repeat(keep, cnts)
+            rows, cnts = rows[keep], cnts[keep]
+            ts_cat, val_cat = ts_cat[sample_keep], val_cat[sample_keep]
+            if rows.size == 0:
+                return empty
+        cols = assemble(np.asarray(rows, np.int64), S,
+                        np.asarray(cnts, np.int64), ts_cat, val_cat,
+                        min_ts, max_ts, dedup_interval_ms or 0,
+                        metric_ids=np.arange(S, dtype=np.int64))
+        raws = [bytes(u) for u in uniq_names]
+        if cols.dropped_rows is not None:
+            live = np.delete(np.arange(S), cols.dropped_rows)
+            raws = [raws[i] for i in live]
+        cols.raw_names = raws
+        cols.metric_names = [MetricName.unmarshal(r) for r in raws]
+        if cols.n_series:
+            from ..ops.decimal import is_stale_nan
+            if bool(np.isnan(cols.vals).any()):
+                stale = is_stale_nan(cols.vals)
+                stale &= cols.ts != np.iinfo(np.int64).max
+                srows = stale.any(axis=1)
+                cols.stale_rows = srows if bool(srows.any()) else None
+        return cols
+
     def search_series(self, filters, min_ts, max_ts, dedup_interval_ms=None,
                       max_series=None, tenant=(0, 0)):
-        node_results = self._fanout(
-            lambda n: n.search_series(filters, min_ts, max_ts, tenant))
-        merged: dict[bytes, list] = {}
-        names: dict[bytes, MetricName] = {}
-        for res, remote_partial in node_results:
-            if remote_partial:
-                # a lower level (multilevel chain) saw an incomplete fan-out
-                self._tls.partial = True
-            for mn, ts, vals in res:
-                raw = mn.marshal()
-                merged.setdefault(raw, []).append((ts, vals))
-                names.setdefault(raw, mn)
-        out = []
-        for raw, chunks in merged.items():
-            if len(chunks) == 1:
-                ts, vals = chunks[0]
-            else:
-                ts = np.concatenate([c[0] for c in chunks])
-                vals = np.concatenate([c[1] for c in chunks])
-                order = np.argsort(ts, kind="stable")
-                ts, vals = ts[order], vals[order]
-                # replica dedup: collapse equal timestamps (keep last)
-                if ts.size > 1:
-                    dup = np.concatenate([ts[1:] == ts[:-1], [False]])
-                    ts, vals = ts[~dup], vals[~dup]
-            out.append(SeriesData(names[raw], ts, vals))
-        if max_series is not None and len(out) > max_series:
-            raise ResourceWarning(
-                f"query matches {len(out)} series, limit {max_series}")
-        out.sort(key=lambda s: s.metric_name.marshal())
-        return out
+        return self.search_columns(
+            filters, min_ts, max_ts, dedup_interval_ms=dedup_interval_ms,
+            max_series=max_series, tenant=tenant).to_series_list()
 
     def search_metric_names(self, filters, min_ts, max_ts, limit=2**31,
                             tenant=(0, 0)):
