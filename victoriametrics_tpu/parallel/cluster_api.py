@@ -657,16 +657,22 @@ class ClusterStorage:
             try:
                 self.nodes[i].write_rows(rows, tenant)
                 sent += len(rows)
-            except (OSError, RPCError, ConnectionError):
+            except (OSError, RPCError, ConnectionError) as e:
                 self.nodes[i].mark_down()
+                with self._lock:
+                    self.reroutes += 1
                 ex = {j2 for j2, n in enumerate(self.nodes)
                       if not n.healthy} | {i}
-                for raw, ts_, v_ in rows:
-                    alt = self.ch.nodes_for_key(tkey + raw, 1, ex)
-                    if alt:
-                        self.nodes[alt[0]].write_rows(
-                            [(raw, ts_, v_)], tenant)
-                        sent += 1
+                alt_batches: dict[int, list] = {}
+                for row in rows:
+                    alt = self.ch.nodes_for_key(tkey + row[0], 1, ex)
+                    if not alt:
+                        raise RPCError(
+                            f"no healthy storage nodes for reroute: {e}")
+                    alt_batches.setdefault(alt[0], []).append(row)
+                for j2, batch in alt_batches.items():
+                    self.nodes[j2].write_rows(batch, tenant)
+                    sent += len(batch)
         for i, (keys, rowsl) in shards.items():
             try:
                 sent += self._send_columnar_shard(self.nodes[i], keys,
@@ -809,12 +815,8 @@ class ClusterStorage:
             cnt_parts.append(counts)
             ts_parts.append(ts_cat)
             val_parts.append(val_cat)
-        empty = ColumnarSeries(np.zeros(0, np.int64),
-                               np.zeros((0, 0), np.int64),
-                               np.zeros((0, 0), np.float64),
-                               np.zeros(0, np.int64), [], [])
         if not names_all:
-            return empty
+            return ColumnarSeries.empty()
         cnts = np.concatenate(cnt_parts)
         ts_cat = np.concatenate(ts_parts)
         val_cat = np.concatenate(val_parts)
@@ -835,7 +837,7 @@ class ClusterStorage:
             rows, cnts = rows[keep], cnts[keep]
             ts_cat, val_cat = ts_cat[sample_keep], val_cat[sample_keep]
             if rows.size == 0:
-                return empty
+                return ColumnarSeries.empty()
         cols = assemble(np.asarray(rows, np.int64), S,
                         np.asarray(cnts, np.int64), ts_cat, val_cat,
                         min_ts, max_ts, dedup_interval_ms or 0,
@@ -846,13 +848,7 @@ class ClusterStorage:
             raws = [raws[i] for i in live]
         cols.raw_names = raws
         cols.metric_names = [MetricName.unmarshal(r) for r in raws]
-        if cols.n_series:
-            from ..ops.decimal import is_stale_nan
-            if bool(np.isnan(cols.vals).any()):
-                stale = is_stale_nan(cols.vals)
-                stale &= cols.ts != np.iinfo(np.int64).max
-                srows = stale.any(axis=1)
-                cols.stale_rows = srows if bool(srows.any()) else None
+        cols.compute_stale_rows()
         return cols
 
     def search_series(self, filters, min_ts, max_ts, dedup_interval_ms=None,

@@ -1033,12 +1033,194 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
     return _emit(out, group_keys)
 
 
+_CHUNK_AGGRS = frozenset({"sum", "count", "avg", "min", "max"})
+
+
+def _try_host_chunked_aggr(ec: EvalConfig, ae) -> list[Timeseries] | None:
+    """Bounded-memory host incremental aggregation for BIG
+    aggr by(...)(rollup(selector)) queries: chunked columnar fetch ->
+    batched rollup per chunk -> running [G, T] accumulators, so the full
+    padded (S, N) sample matrix never exists (the reference's
+    tmp-blocks-spool + incremental-aggregation pairing,
+    netstorage/tmp_blocks_file.go + eval.go:1055). Engages only when the
+    estimated fetch would overflow half the rollup memory budget — the
+    small/medium case keeps the cached full-fetch path. None = not
+    applicable, use the normal path."""
+    if ec.tpu is not None or ae.name not in _CHUNK_AGGRS:
+        return None
+    if len(ae.args) != 1 or ae.limit:
+        return None
+    arg = ae.args[0]
+    if isinstance(arg, FuncExpr):
+        if len(arg.args) != 1 or arg.keep_metric_names:
+            return None
+        func, rarg = arg.name, arg.args[0]
+    elif isinstance(arg, (MetricExpr, RollupExpr)):
+        func, rarg = "default_rollup", arg
+    else:
+        return None
+    if isinstance(rarg, MetricExpr):
+        rarg = RollupExpr(expr=rarg)
+    if not isinstance(rarg, RollupExpr) or \
+            not isinstance(rarg.expr, MetricExpr) or rarg.expr.is_empty() or \
+            rarg.needs_subquery() or rarg.at is not None:
+        return None
+    from ..ops import rollup_np
+    if not rollup_np.batch_supported(func, ()):
+        return None
+    st = ec.storage
+    if getattr(st, "search_columns_chunked", None) is None or \
+            getattr(st, "estimate_series", None) is None:
+        return None
+    offset = rarg.offset.value_ms(ec.step) if rarg.offset is not None else 0
+    window = rarg.window.value_ms(ec.step) if rarg.window is not None else 0
+    lookback = window if window > 0 else (
+        ec.lookback_delta if func == "default_rollup" else ec.step)
+    start = ec.start - offset
+    end = ec.end - offset
+    fetch_lo = start - lookback - ec.lookback_delta
+    filters = filters_from_metric_expr(rarg.expr)
+    from .limits import admit_rollup, rollup_memory_limiter
+    try:
+        n_series_est = st.estimate_series(filters, fetch_lo, end,
+                                          tenant=ec.tenant)
+    except Exception:
+        return None
+    est_samples = n_series_est * max((end - fetch_lo) // 15_000, 1)
+    import os as _os
+    budget = rollup_memory_limiter().max_size
+    threshold = int(_os.environ.get("VM_CHUNKED_AGGR_MIN_BYTES",
+                                    budget // 2))
+    if est_samples * 16 <= threshold:
+        return None  # fits comfortably: the cached full-fetch path wins
+
+    T = ec.n_points
+    cfg0 = RollupConfig(start=start, end=end, step=ec.step,
+                        window=lookback)
+    gb = [g.encode() for g in ae.grouping]
+    # rollups that drop the metric name must group on the BLANKED name,
+    # exactly like _finish_rollup_names(keep_name=False) before _group_key
+    # on the normal path — `by (__name__)` output names must not depend
+    # on which path ran
+    keep_name = func == "default_rollup" or func in KEEP_METRIC_NAMES
+    gidx: dict[bytes, int] = {}
+    aggr = ae.name
+    init = np.inf if aggr == "min" else -np.inf if aggr == "max" else 0.0
+    acc = np.zeros((0, T))   # [G, T] running accumulator (grows by vstack
+    cnt = np.zeros((0, T))   # ONLY when a chunk introduces new groups)
+    qt = ec.tracer.new_child(
+        "host chunked %s(%s) %s: ~%d series", aggr, func, rarg.expr,
+        n_series_est)
+    n_samples = n_chunks = 0
+    max_chunk = int(_os.environ.get(
+        "VM_CHUNK_FETCH_SAMPLES", max(int(budget // 4 // 16), 1_000_000)))
+    seen_series = 0
+    try:
+        for cols in st.search_columns_chunked(
+                filters, fetch_lo, end, tenant=ec.tenant,
+                max_chunk_samples=max_chunk):
+            ec.check_deadline()
+            if cols.n_series == 0:
+                continue
+            seen_series += cols.n_series
+            if seen_series > ec.max_series:
+                raise ResourceWarning(
+                    f"query matches more than {ec.max_series} series")
+            if func not in ("default_rollup", "stale_samples_over_time"):
+                cols.drop_stale_nans()
+            n_samples += cols.n_samples
+            ec.count_samples(cols.n_samples)
+            with admit_rollup(str(rarg.expr), cols.n_series, T,
+                              ec.max_memory_per_query):
+                cfg = cfg0
+                adj = adjusted_windows(func, window, ec.step,
+                                       cols.ts_list())
+                per_series_cfg = None
+                if adj:
+                    if all(a == adj[0] for a in adj):
+                        cfg = RollupConfig(start=start, end=end,
+                                           step=ec.step, window=adj[0])
+                    else:
+                        per_series_cfg = [
+                            RollupConfig(start=start, end=end,
+                                         step=ec.step, window=a)
+                            for a in adj]
+                rows = None
+                if per_series_cfg is None:
+                    rows = rollup_np.rollup_batch_packed(
+                        func, cols.ts, cols.vals, cols.counts, cfg, ())
+                if rows is None:  # non-finite values / per-series windows
+                    counts = cols.counts
+                    rows = np.empty((cols.n_series, T))
+                    for i in range(cols.n_series):
+                        if i % 256 == 0:
+                            ec.check_deadline()
+                        c = (per_series_cfg[i]
+                             if per_series_cfg is not None else cfg)
+                        rows[i] = rollup_series(
+                            func, cols.ts[i, :counts[i]],
+                            cols.vals[i, :counts[i]], c, ())
+                rows = np.asarray(rows, dtype=np.float64)
+                gids = np.empty(cols.n_series, np.int64)
+                for i, mn in enumerate(cols.metric_names):
+                    if gb or ae.without:
+                        gmn = mn if keep_name else \
+                            MetricName(b"", mn.labels)
+                        key = _group_key(gmn, gb, ae.without)
+                    else:
+                        key = b""
+                    g = gidx.get(key)
+                    if g is None:
+                        g = len(gidx)
+                        gidx[key] = g
+                    gids[i] = g
+                if len(gidx) > acc.shape[0]:
+                    grow = len(gidx) - acc.shape[0]
+                    acc = np.vstack([acc, np.full((grow, T), init)])
+                    cnt = np.vstack([cnt, np.zeros((grow, T))])
+                finite = ~np.isnan(rows)
+                if aggr in ("sum", "avg"):
+                    np.add.at(acc, gids, np.where(finite, rows, 0.0))
+                elif aggr == "min":
+                    np.minimum.at(acc, gids,
+                                  np.where(finite, rows, np.inf))
+                elif aggr == "max":
+                    np.maximum.at(acc, gids,
+                                  np.where(finite, rows, -np.inf))
+                np.add.at(cnt, gids, finite.astype(np.float64))
+            n_chunks += 1
+    except ResourceWarning as e:
+        from .limits import QueryLimitError
+        raise QueryLimitError(
+            f"{e}; either narrow the selector or raise "
+            f"-search.maxUniqueTimeseries") from None
+    qt.donef("%d chunks, %d samples, %d groups", n_chunks, n_samples,
+             len(gidx))
+    out = []
+    nan = np.nan
+    for key, g in gidx.items():
+        have = cnt[g] > 0
+        if aggr == "count":
+            vals = np.where(have, cnt[g], nan)
+        elif aggr == "avg":
+            with np.errstate(invalid="ignore"):
+                vals = np.where(have, acc[g] / cnt[g], nan)
+        else:
+            vals = np.where(have, acc[g], nan)
+        out.append(Timeseries(MetricName.unmarshal(key), vals))
+    out.sort(key=lambda ts: ts.metric_name.marshal())
+    return out
+
+
 def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
     name = ae.name
 
     fused = _try_device_fused_aggr(ec, ae)
     if fused is not None:
         return fused
+    chunked = _try_host_chunked_aggr(ec, ae)
+    if chunked is not None:
+        return chunked
 
     # arg layouts
     if name in ("topk", "bottomk", "limitk", "outliersk") or \

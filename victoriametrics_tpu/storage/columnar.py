@@ -42,6 +42,24 @@ class ColumnarSeries:
         # row indices (pre-drop numbering) removed as empty by the clip
         self.dropped_rows = None
 
+    @classmethod
+    def empty(cls) -> "ColumnarSeries":
+        return cls(np.zeros(0, np.int64), np.zeros((0, 0), np.int64),
+                   np.zeros((0, 0), np.float64), np.zeros(0, np.int64),
+                   [], [])
+
+    def compute_stale_rows(self) -> None:
+        """Set stale_rows from the decoded values (staleness-marker
+        presence per row; skips eval-side scans in the no-stale case)."""
+        if not self.n_series:
+            return
+        from ..ops.decimal import is_stale_nan
+        if bool(np.isnan(self.vals).any()):
+            stale = is_stale_nan(self.vals)
+            stale &= self.ts != PAD_TS
+            rows = stale.any(axis=1)
+            self.stale_rows = rows if bool(rows.any()) else None
+
     @property
     def n_series(self) -> int:
         return int(self.metric_ids.size)

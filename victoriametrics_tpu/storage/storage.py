@@ -695,9 +695,50 @@ class Storage:
             tsid_set, min_ts, max_ts,
             tsid_lo=tsids[0].sort_key(), tsid_hi=tsids[-1].sort_key())
 
+    def estimate_series(self, filters: list[TagFilter], min_ts: int,
+                        max_ts: int, tenant=(0, 0)) -> int:
+        """Matching-series count without fetching samples (the tsid
+        search is cached, so a following search_columns* reuses it)."""
+        return len(self.idb.search_tsids(filters, min_ts, max_ts, tenant))
+
+    def search_columns_chunked(self, filters: list[TagFilter], min_ts: int,
+                               max_ts: int,
+                               dedup_interval_ms: int | None = None,
+                               max_series: int | None = None, tenant=(0, 0),
+                               max_chunk_samples: int = 50_000_000):
+        """Bounded-memory fetch: yields ColumnarSeries chunks over
+        disjoint series subsets, each holding at most ~max_chunk_samples
+        resident samples (the tmp-blocks-spool role,
+        app/vmselect/netstorage/tmp_blocks_file.go — here the spool is
+        the on-disk part itself and each chunk decodes only its own
+        blocks). The per-series density estimate starts at the 15s scrape
+        grid and adapts to what the first chunk actually returned."""
+        tsids = self.idb.search_tsids(filters, min_ts, max_ts, tenant)
+        if not tsids:
+            return
+        est = max((max_ts - min_ts) // 15_000 + 2, 1)
+        i, S = 0, len(tsids)
+        seen = 0
+        while i < S:
+            k = max(int(max_chunk_samples // est), 64)
+            cols = self.search_columns(filters, min_ts, max_ts,
+                                       dedup_interval_ms, None, tenant,
+                                       _tsids=tsids[i:i + k])
+            # limit counts series WITH DATA in range (cumulative),
+            # matching search_columns' post-collection semantics
+            seen += cols.n_series
+            if max_series is not None and seen > max_series:
+                raise ResourceWarning(
+                    f"query matches more than {max_series} series")
+            yield cols
+            if cols.n_series:
+                est = max(cols.n_samples // cols.n_series, 1)
+            i += k
+
     def search_columns(self, filters: list[TagFilter], min_ts: int,
                        max_ts: int, dedup_interval_ms: int | None = None,
-                       max_series: int | None = None, tenant=(0, 0)):
+                       max_series: int | None = None, tenant=(0, 0),
+                       _tsids=None):
         """Batched columnar search: one native decode pass per part, one
         vectorized assembly into padded (S, N) columns — no per-series
         Python on the fetch path (the netstorage.go:374-421 unpack-worker
@@ -706,11 +747,9 @@ class Storage:
         from .columnar import ColumnarSeries, assemble
         interval = (self.dedup_interval_ms if dedup_interval_ms is None
                     else dedup_interval_ms)
-        tsids = self.idb.search_tsids(filters, min_ts, max_ts, tenant)
-        empty = ColumnarSeries(np.zeros(0, np.int64),
-                               np.zeros((0, 0), np.int64),
-                               np.zeros((0, 0), np.float64),
-                               np.zeros(0, np.int64), [], [])
+        tsids = (self.idb.search_tsids(filters, min_ts, max_ts, tenant)
+                 if _tsids is None else _tsids)
+        empty = ColumnarSeries.empty()
         if not tsids:
             return empty
         tsid_set = {t.metric_id for t in tsids}
@@ -826,15 +865,7 @@ class Storage:
         else:
             cols.raw_names = [raws[i] for i in perm]
             cols.metric_names = [names[int(m)][0] for m in ordered_mids]
-        # staleness-marker presence per row (skips eval-side scans entirely
-        # in the common no-stale case)
-        if cols.n_series:
-            from ..ops.decimal import is_stale_nan
-            if bool(np.isnan(cols.vals).any()):
-                stale = is_stale_nan(cols.vals)
-                stale &= cols.ts != np.iinfo(np.int64).max
-                rows = stale.any(axis=1)
-                cols.stale_rows = rows if bool(rows.any()) else None
+        cols.compute_stale_rows()
         return cols
 
     def search_series(self, filters: list[TagFilter], min_ts: int,
