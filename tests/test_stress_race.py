@@ -1,0 +1,161 @@
+"""Concurrency stress harness for the threaded host plane (the
+reference's `-race` CI + synctest role, Makefile test-race): hammer ONE
+Storage with concurrent columnar ingest, queries, flushes, merges,
+snapshots and deletes under randomized scheduling, with assertion-checked
+invariants.
+
+Torn reads are detectable by construction: every written sample satisfies
+value == timestamp % 1e9, so any mixed-up (ts, value) pairing, partial
+block, or cross-series contamination trips an exact-equality check.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu import native
+from victoriametrics_tpu.query.exec import exec_query
+from victoriametrics_tpu.query.types import EvalConfig
+from victoriametrics_tpu.storage.storage import Storage
+from victoriametrics_tpu.storage.tag_filters import filters_from_dict
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="needs native lib")
+
+T0 = 1_753_700_000_000
+DURATION_S = 8.0
+N_WRITERS = 2
+SERIES_PER_WRITER = 24
+
+
+def _val(ts_arr):
+    return (ts_arr % 1_000_000_000).astype(np.float64)
+
+
+class _Stress:
+    def __init__(self, storage):
+        self.storage = storage
+        self.stop = threading.Event()
+        self.errors: list[BaseException] = []
+        self.appended = [0] * N_WRITERS  # samples per writer (monotonic)
+        self.lock = threading.Lock()
+
+    def guard(self, fn):
+        def run():
+            rng = random.Random(id(fn) & 0xFFFF)
+            try:
+                while not self.stop.is_set():
+                    fn(rng)
+                    time.sleep(rng.uniform(0, 0.01))  # chaos scheduling
+            except BaseException as e:  # noqa: BLE001 - harness boundary
+                self.errors.append(e)
+                self.stop.set()
+        return run
+
+    # -- workers ---------------------------------------------------------
+
+    def writer(self, w):
+        step = [0]
+        keys = [f'stress{{w="{w}",i="{i}"}}'.encode()
+                for i in range(SERIES_PER_WRITER)]
+        keybuf = b"".join(keys)
+        klens = np.fromiter((len(k) for k in keys), np.int64, len(keys))
+        koffs = np.concatenate([[0], np.cumsum(klens)[:-1]])
+
+        def run(rng):
+            k = rng.randint(1, 6)  # scrapes per series this batch
+            base = T0 + step[0] * 15_000
+            step[0] += k
+            ts = (base + np.arange(k, dtype=np.int64)[None, :] * 15_000 +
+                  w)  # writer-unique phase: series never collide
+            ts = np.broadcast_to(ts, (len(keys), k)).reshape(-1).copy()
+            cr = native.ColumnarRows(
+                keybuf, np.repeat(koffs, k), np.repeat(klens, k),
+                ts, _val(ts))
+            self.storage.add_rows_columnar(cr)
+            with self.lock:
+                self.appended[w] += k
+        return run
+
+    def reader(self, rng):
+        w = rng.randrange(N_WRITERS)
+        cols = self.storage.search_columns(
+            filters_from_dict({"__name__": "stress", "w": str(w)}),
+            T0 - 10**6, T0 + 10**10)
+        for s in range(cols.n_series):
+            n = int(cols.counts[s])
+            ts = cols.ts[s, :n]
+            vals = cols.vals[s, :n]
+            assert bool((np.diff(ts) > 0).all()), \
+                "timestamps not strictly increasing"
+            np.testing.assert_array_equal(vals, _val(ts))
+
+    def querier(self, rng):
+        rows = exec_query(
+            EvalConfig(start=T0, end=T0 + 4_000_000, step=60_000,
+                       storage=self.storage, tpu=None,
+                       disable_cache=bool(rng.getrandbits(1))),
+            'count(last_over_time(stress[10m]))')
+        for ts in rows:
+            v = ts.values[np.isfinite(ts.values)]
+            assert bool((v <= N_WRITERS * SERIES_PER_WRITER).all())
+
+    def flusher(self, rng):
+        if rng.random() < 0.3:
+            self.storage.force_merge()
+        else:
+            self.storage.force_flush()
+
+    def snapshotter(self, rng):
+        name = self.storage.create_snapshot()
+        time.sleep(rng.uniform(0, 0.02))
+        assert self.storage.delete_snapshot(name)
+
+    def deleter(self, rng):
+        # disposable series: create then delete; must never affect the
+        # stress/metric invariants
+        self.storage.add_rows(
+            [({"__name__": "victim", "i": str(rng.randrange(4))},
+              T0 + rng.randrange(10**6), 1.0)])
+        self.storage.delete_series(
+            filters_from_dict({"__name__": "victim"}))
+
+
+def test_concurrent_ingest_query_flush_snapshot(tmp_path):
+    s = Storage(str(tmp_path / "s"))
+    st = _Stress(s)
+    workers = [st.guard(st.writer(w)) for w in range(N_WRITERS)]
+    workers += [st.guard(st.reader), st.guard(st.querier),
+                st.guard(st.flusher), st.guard(st.snapshotter),
+                st.guard(st.deleter)]
+    threads = [threading.Thread(target=f, daemon=True) for f in workers]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    while time.monotonic() - t0 < DURATION_S and not st.stop.is_set():
+        time.sleep(0.1)
+    st.stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "stress worker wedged (deadlock?)"
+    if st.errors:
+        raise st.errors[0]
+    # final invariant: exactly the appended samples are durable and
+    # correct after a full flush+merge
+    s.force_flush()
+    s.force_merge()
+    for w in range(N_WRITERS):
+        cols = s.search_columns(
+            filters_from_dict({"__name__": "stress", "w": str(w)}),
+            T0 - 10**6, T0 + 10**10)
+        assert cols.n_series == SERIES_PER_WRITER
+        expected = st.appended[w]
+        for i in range(cols.n_series):
+            n = int(cols.counts[i])
+            assert n == expected, (w, i, n, expected)
+            ts = cols.ts[i, :n]
+            np.testing.assert_array_equal(cols.vals[i, :n], _val(ts))
+    s.close()
