@@ -1,0 +1,218 @@
+"""Round-5 SD providers (nomad, dockerswarm, eureka, openstack,
+digitalocean) against local mock APIs (the reference's discovery
+fixtures, lib/promscrape/discovery/*/..._test.go)."""
+
+from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+from victoriametrics_tpu.ingest import discovery
+
+
+def _srv(routes):
+    srv = HTTPServer("127.0.0.1", 0)
+    for path, payload in routes.items():
+        srv.route(path, (lambda p: (lambda r: Response.json(p)))(payload))
+    srv.start()
+    return srv
+
+
+class TestNomadSD:
+    def test_services(self):
+        srv = _srv({
+            "/v1/services": [
+                {"Namespace": "default",
+                 "Services": [{"ServiceName": "redis", "Tags": []}]}],
+            "/v1/service/redis": [
+                {"ID": "sid1", "ServiceName": "redis",
+                 "Address": "10.2.0.5", "Port": 6379, "NodeID": "n1",
+                 "Datacenter": "dc1", "JobID": "cache",
+                 "AllocID": "a1", "Namespace": "default",
+                 "Tags": ["db", "tier=back"]}],
+        })
+        try:
+            out = discovery.nomad_sd(
+                {"server": f"127.0.0.1:{srv.port}"})
+            assert len(out) == 1
+            tgt, meta = out[0]
+            assert tgt == "10.2.0.5:6379"
+            assert meta["__meta_nomad_service"] == "redis"
+            assert meta["__meta_nomad_dc"] == "dc1"
+            assert meta["__meta_nomad_service_job_id"] == "cache"
+            assert meta["__meta_nomad_tags"] == ",db,tier=back,"
+            assert meta["__meta_nomad_tag_tier"] == "back"
+            assert meta["__meta_nomad_tagpresent_db"] == "true"
+        finally:
+            srv.stop()
+
+
+class TestDockerswarmSD:
+    NODES = [{"ID": "n1", "Spec": {"Role": "manager",
+                                   "Availability": "active",
+                                   "Labels": {"zone": "a"}},
+              "Description": {"Hostname": "h1",
+                              "Platform": {"Architecture": "x86_64",
+                                           "OS": "linux"},
+                              "Engine": {"EngineVersion": "24.0"}},
+              "Status": {"State": "ready", "Addr": "10.3.0.1"}}]
+    SERVICES = [{"ID": "s1",
+                 "Spec": {"Name": "web", "Mode": {"Replicated": {}},
+                          "Labels": {"team": "x"}},
+                 "Endpoint": {"VirtualIPs": [
+                     {"NetworkID": "net1", "Addr": "10.0.0.9/24"}]}}]
+    TASKS = [{"ID": "t1", "ServiceID": "s1", "NodeID": "n1", "Slot": 1,
+              "DesiredState": "running", "Status": {"State": "running"},
+              "Spec": {"ContainerSpec": {"Labels": {"com.x": "1"}}},
+              "NetworksAttachments": [
+                  {"Addresses": ["10.0.0.12/24"]}]}]
+
+    def _srv(self):
+        return _srv({"/nodes": self.NODES, "/services": self.SERVICES,
+                     "/tasks": self.TASKS})
+
+    def test_role_tasks(self):
+        srv = self._srv()
+        try:
+            out = discovery.dockerswarm_sd(
+                {"host": f"http://127.0.0.1:{srv.port}", "port": 9100})
+            assert out[0][0] == "10.0.0.12:9100"
+            meta = out[0][1]
+            assert meta["__meta_dockerswarm_service_name"] == "web"
+            assert meta["__meta_dockerswarm_node_hostname"] == "h1"
+            assert meta["__meta_dockerswarm_task_state"] == "running"
+            assert meta["__meta_dockerswarm_container_label_com_x"] == "1"
+        finally:
+            srv.stop()
+
+    def test_role_services_and_nodes(self):
+        srv = self._srv()
+        try:
+            svc = discovery.dockerswarm_sd(
+                {"host": f"http://127.0.0.1:{srv.port}",
+                 "role": "services"})
+            assert svc[0][0] == "10.0.0.9:80"
+            assert svc[0][1]["__meta_dockerswarm_service_label_team"] \
+                == "x"
+            nodes = discovery.dockerswarm_sd(
+                {"host": f"http://127.0.0.1:{srv.port}", "role": "nodes",
+                 "port": 9323})
+            assert nodes[0][0] == "10.3.0.1:9323"
+            assert nodes[0][1]["__meta_dockerswarm_node_role"] \
+                == "manager"
+            assert nodes[0][1]["__meta_dockerswarm_node_label_zone"] \
+                == "a"
+        finally:
+            srv.stop()
+
+
+class TestEurekaSD:
+    def test_apps(self):
+        srv = _srv({"/eureka/v2/apps": {"applications": {"application": [
+            {"name": "CART", "instance": [{
+                "instanceId": "i-1", "hostName": "cart-1.local",
+                "ipAddr": "10.4.0.2", "status": "UP",
+                "port": {"$": 8081, "@enabled": "true"},
+                "vipAddress": "cart", "countryId": 1,
+                "dataCenterInfo": {"name": "MyOwn"},
+                "metadata": {"zone": "b"},
+                "homePageUrl": "http://cart-1.local/"}]}]}}})
+        try:
+            out = discovery.eureka_sd(
+                {"server": f"127.0.0.1:{srv.port}/eureka/v2"})
+            assert len(out) == 1
+            tgt, meta = out[0]
+            assert tgt == "cart-1.local:8081"
+            assert meta["__meta_eureka_app_name"] == "CART"
+            assert meta["__meta_eureka_app_instance_status"] == "UP"
+            assert meta["__meta_eureka_app_instance_metadata_zone"] == "b"
+            assert meta["__meta_eureka_app_instance_port_enabled"] \
+                == "true"
+        finally:
+            srv.stop()
+
+
+class TestOpenstackSD:
+    def test_instances(self):
+        srv = HTTPServer("127.0.0.1", 0)
+
+        def tokens(r):
+            resp = Response.json({"token": {"catalog": [
+                {"type": "compute", "endpoints": [
+                    {"interface": "public",
+                     "url": f"http://127.0.0.1:{srv.port}/compute"}]}]}})
+            resp.headers["X-Subject-Token"] = "tok123"
+            return resp
+        srv.route("/identity/auth/tokens", tokens)
+        srv.route("/compute/servers/detail", lambda r: Response.json(
+            {"servers": [{
+                "id": "vm1", "name": "web-1", "status": "ACTIVE",
+                "tenant_id": "p1", "user_id": "u1",
+                "flavor": {"original_name": "m1.small"},
+                "metadata": {"role": "web"},
+                "addresses": {"private": [{"addr": "192.168.1.5"}]}}]}))
+        srv.start()
+        try:
+            out = discovery.openstack_sd({
+                "identity_endpoint":
+                    f"http://127.0.0.1:{srv.port}/identity",
+                "username": "u", "password": "p",
+                "project_name": "demo", "port": 9100})
+            assert out == [("192.168.1.5:9100", {
+                "__meta_openstack_instance_id": "vm1",
+                "__meta_openstack_instance_name": "web-1",
+                "__meta_openstack_instance_status": "ACTIVE",
+                "__meta_openstack_instance_flavor": "m1.small",
+                "__meta_openstack_project_id": "p1",
+                "__meta_openstack_user_id": "u1",
+                "__meta_openstack_tag_role": "web",
+                "__meta_openstack_address_pool": "private",
+                "__meta_openstack_private_ip": "192.168.1.5"})]
+        finally:
+            srv.stop()
+
+
+class TestDigitaloceanSD:
+    def test_droplets_with_pagination(self):
+        srv = HTTPServer("127.0.0.1", 0)
+        page2 = {"droplets": [{
+            "id": 2, "name": "d2", "status": "active",
+            "image": {"slug": "ubuntu", "name": "Ubuntu"},
+            "region": {"slug": "nyc1"}, "size": {"slug": "s-1vcpu"},
+            "tags": ["web"], "features": ["ipv6"],
+            "networks": {"v4": [
+                {"type": "public", "ip_address": "1.2.3.5"}]}}]}
+        page1 = {"droplets": [{
+            "id": 1, "name": "d1", "status": "active",
+            "image": {"slug": "deb", "name": "Debian"},
+            "region": {"slug": "fra1"}, "size": {"slug": "s-2vcpu"},
+            "tags": [], "features": [],
+            "networks": {"v4": [
+                {"type": "public", "ip_address": "1.2.3.4"},
+                {"type": "private", "ip_address": "10.9.0.4"}]}}]}
+
+        def h(r):
+            if r.arg("page") == "2":
+                return Response.json(page2)
+            p1 = dict(page1)
+            p1["links"] = {"pages": {"next":
+                f"http://127.0.0.1:{srv.port}/v2/droplets?page=2"}}
+            return Response.json(p1)
+        srv.route("/v2/droplets", h)
+        srv.start()
+        try:
+            out = discovery.digitalocean_sd(
+                {"server": f"http://127.0.0.1:{srv.port}",
+                 "bearer_token": "tk", "port": 9100})
+            assert [t for t, _ in out] == ["1.2.3.4:9100", "1.2.3.5:9100"]
+            m1 = out[0][1]
+            assert m1["__meta_digitalocean_private_ipv4"] == "10.9.0.4"
+            assert m1["__meta_digitalocean_region"] == "fra1"
+            m2 = out[1][1]
+            assert m2["__meta_digitalocean_tags"] == ",web,"
+            assert m2["__meta_digitalocean_features"] == ",ipv6,"
+        finally:
+            srv.stop()
+
+
+def test_all_providers_registered():
+    for key in ("nomad_sd_configs", "dockerswarm_sd_configs",
+                "eureka_sd_configs", "openstack_sd_configs",
+                "digitalocean_sd_configs"):
+        assert key in discovery.PROVIDERS

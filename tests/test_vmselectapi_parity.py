@@ -1,0 +1,119 @@
+"""Round-5 vmselectapi parity RPCs: tagValueSuffixes,
+metricNamesUsageStats, resetMetricNamesStats, searchMetadata
+(lib/vmselectapi/server.go:560-584)."""
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu import native
+from victoriametrics_tpu.parallel.cluster_api import (ClusterStorage,
+                                                      StorageNodeClient,
+                                                      make_storage_handlers)
+from victoriametrics_tpu.parallel.rpc import (HELLO_INSERT, HELLO_SELECT,
+                                              RPCServer)
+from victoriametrics_tpu.storage.storage import Storage
+from victoriametrics_tpu.storage.tag_filters import filters_from_dict
+
+T0 = 1_753_700_000_000
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="needs native lib")
+
+
+@pytest.fixture()
+def cluster2(tmp_path):
+    nodes = []
+    for i in range(2):
+        st = Storage(str(tmp_path / f"n{i}"))
+        h = make_storage_handlers(st)
+        isrv = RPCServer("127.0.0.1", 0, HELLO_INSERT, h)
+        ssrv = RPCServer("127.0.0.1", 0, HELLO_SELECT, h)
+        isrv.start()
+        ssrv.start()
+        nodes.append((st, isrv, ssrv))
+    cluster = ClusterStorage([
+        StorageNodeClient("127.0.0.1", i.port, s.port)
+        for _, i, s in nodes])
+    yield cluster, [st for st, _, _ in nodes]
+    cluster.close()
+    for st, i, s in nodes:
+        i.stop()
+        s.stop()
+        st.close()
+
+
+def seed(cluster):
+    rows = []
+    for name in ("foo.bar.baz", "foo.bar.qux", "foo.other", "top"):
+        for i in range(3):
+            rows.append(({"__name__": name, "idx": str(i)},
+                         T0 + i * 15_000, float(i)))
+    cluster.add_rows(rows)
+
+
+class TestTagValueSuffixes:
+    def test_graphite_path_expansion(self, cluster2):
+        cluster, _ = cluster2
+        seed(cluster)
+        # top level: everything before the first dot (+ dot for non-leaf)
+        sfx = cluster.tag_value_suffixes("__name__", "", ".")
+        assert sfx == ["foo.", "top"]
+        sfx = cluster.tag_value_suffixes("__name__", "foo.", ".")
+        assert sfx == ["bar.", "other"]
+        sfx = cluster.tag_value_suffixes("__name__", "foo.bar.", ".")
+        assert sfx == ["baz", "qux"]
+        # plain tag keys expand too
+        sfx = cluster.tag_value_suffixes("idx", "", ".")
+        assert sfx == ["0", "1", "2"]
+
+    def test_single_node_storage(self, tmp_path):
+        st = Storage(str(tmp_path / "s"))
+        try:
+            st.add_rows([({"__name__": "a.b.c", "x": "1"}, T0, 1.0)])
+            assert st.tag_value_suffixes("__name__", "") == ["a."]
+            assert st.tag_value_suffixes("__name__", "a.") == ["b."]
+            assert st.tag_value_suffixes("__name__", "a.b.") == ["c"]
+        finally:
+            st.close()
+
+
+class TestNameUsageStats:
+    def test_tracks_and_resets_across_cluster(self, cluster2):
+        cluster, stores = cluster2
+        seed(cluster)
+        # two queries touch the foo.* family, one touches top
+        for _ in range(2):
+            cluster.search_columns(
+                filters_from_dict({"__name__": ("=~", "foo\\..*")}),
+                T0 - 1000, T0 + 10**6)
+        cluster.search_columns(filters_from_dict({"__name__": "top"}),
+                               T0 - 1000, T0 + 10**6)
+        stats = cluster.metric_names_usage_stats()
+        by_name = {x["metricName"]: x["requestsCount"] for x in stats}
+        # the cluster merge SUMS per-node counters, so the merged count
+        # must equal the per-store totals exactly (how many nodes hold a
+        # given name is a sharding accident — don't assert on it)
+        per_store: dict[str, int] = {}
+        for st in stores:
+            for x in st.metric_names_usage_stats(10_000):
+                per_store[x["metricName"]] = \
+                    per_store.get(x["metricName"], 0) + x["requestsCount"]
+        assert by_name == per_store
+        assert by_name.get("top", 0) >= 1
+        assert by_name.get("foo.other", 0) >= 2
+        assert all(x["lastRequestTimestamp"] > 0 for x in stats)
+        cluster.reset_metric_names_stats()
+        assert cluster.metric_names_usage_stats() == []
+
+
+class TestSearchMetadata:
+    def test_fanout_merge(self, cluster2):
+        cluster, stores = cluster2
+        stores[0].set_metadata(
+            {"m1": {"type": "counter", "help": "h1"}})
+        stores[1].set_metadata(
+            {"m2": {"type": "gauge", "help": "h2"}})
+        md = cluster.search_metadata()
+        assert md == {"m1": {"type": "counter", "help": "h1"},
+                      "m2": {"type": "gauge", "help": "h2"}}
+        assert cluster.search_metadata(metric="m2") == {
+            "m2": {"type": "gauge", "help": "h2"}}

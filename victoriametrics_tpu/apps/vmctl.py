@@ -136,6 +136,70 @@ def import_file(path: str, dst: str, fmt: str, chunk_lines: int = 50_000) -> int
     return total
 
 
+def _esc_label(v: str) -> str:
+    """Prometheus text-format label value escaping."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def prometheus_tsdb(path: str, dst: str, chunk_lines: int = 50_000) -> int:
+    """Migrate a Prometheus TSDB data dir (or one block dir) into dst —
+    the reference vmctl `prometheus` snapshot mode (app/vmctl/main.go:259
+    via prometheus/tsdb). Reads the binary block format directly
+    (utils/promtsdb: index + XOR chunks) and streams prometheus text."""
+    import os
+
+    from ..utils.promtsdb import read_block
+    if os.path.exists(os.path.join(path, "index")):
+        blocks = [path]
+    else:
+        blocks = sorted(
+            os.path.join(path, d) for d in os.listdir(path)
+            if os.path.isdir(os.path.join(path, d)) and
+            os.path.exists(os.path.join(path, d, "index")))
+    if not blocks:
+        raise SystemExit(f"vmctl prometheus-tsdb: no blocks under {path}")
+    total = 0
+    buf: list[str] = []
+    for bdir in blocks:
+        logger.infof("vmctl prometheus-tsdb: reading block %s", bdir)
+        from ..query.format_value import fmt_value
+        for labels, ts, vals in read_block(bdir):
+            name = labels.get("__name__", "")
+            if not name:
+                continue
+            rest = ",".join(f'{k}="{_esc_label(v)}"'
+                            for k, v in sorted(labels.items())
+                            if k != "__name__")
+            head = f"{name}{{{rest}}}" if rest else name
+            for t, v in zip(ts.tolist(), vals.tolist()):
+                buf.append(f"{head} {fmt_value(v)} {t}")
+                total += 1
+                if len(buf) >= chunk_lines:
+                    _post(dst.rstrip("/") + "/api/v1/import/prometheus",
+                          "\n".join(buf).encode())
+                    buf = []
+    if buf:
+        _post(dst.rstrip("/") + "/api/v1/import/prometheus",
+              "\n".join(buf).encode())
+    logger.infof("vmctl prometheus-tsdb: migrated %d samples from %d "
+                 "block(s)", total, len(blocks))
+    return total
+
+
+def verify_block_cmd(path: str) -> int:
+    """vmctl verify-block (app/vmctl/main.go:514): walk one TSDB block's
+    structures + CRCs, print the report, exit nonzero on problems."""
+    import json as _json
+
+    from ..utils.promtsdb import verify_block
+    rep = verify_block(path)
+    try:
+        print(_json.dumps(rep, indent=1))
+    except BrokenPipeError:  # e.g. piped into head
+        pass
+    return 0 if rep["ok"] else 1
+
+
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
     p = argparse.ArgumentParser(prog="vmctl")
@@ -153,12 +217,27 @@ def main(argv=None):
         pf.add_argument("--file", required=True)
         pf.add_argument("--dst-addr", required=True)
 
+    pt = sub.add_parser("prometheus-tsdb",
+                        help="migrate Prometheus TSDB blocks (binary "
+                             "snapshot format)")
+    pt.add_argument("--tsdb-path", required=True,
+                    help="a data dir of blocks, or one block dir")
+    pt.add_argument("--dst-addr", required=True)
+
+    pv = sub.add_parser("verify-block",
+                        help="validate one TSDB block's structure + CRCs")
+    pv.add_argument("--block-path", required=True)
+
     args = p.parse_args(argv)
     if args.mode == "vm-native":
         vm_native(args.vm_native_src_addr, args.vm_native_dst_addr,
                   args.vm_native_filter_match,
                   args.vm_native_filter_time_start,
                   args.vm_native_filter_time_end)
+    elif args.mode == "prometheus-tsdb":
+        prometheus_tsdb(args.tsdb_path, args.dst_addr)
+    elif args.mode == "verify-block":
+        raise SystemExit(verify_block_cmd(args.block_path))
     else:
         import_file(args.file, args.dst_addr, args.mode)
 

@@ -2,11 +2,13 @@
 app/vmselect/graphite/functions.json — 151 entries — evaluated by
 app/vmselect/graphite/eval.go and transform.go).
 
-Implements the widely-used ~110 functions on top of the evaluator in
-graphite_api.py. Everything is vectorized numpy over the aligned render
-grid; functions receive (api, args, grid, step, tenant) and return
-GraphiteSeries lists. register() installs them into the dispatch table and
-backs the /functions introspection endpoint.
+Together with the core functions defined in graphite_api.py this covers
+ALL 151 reference entries (the combined dispatch table _G_FUNCS is
+asserted against functions.json in tests/test_graphite_funcs.py).
+Everything is vectorized numpy over the aligned render grid; functions
+receive (api, args, grid, step, tenant) and return GraphiteSeries lists.
+register() installs them into the dispatch table and backs the
+/functions introspection endpoint.
 """
 
 from __future__ import annotations

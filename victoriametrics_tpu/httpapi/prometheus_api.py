@@ -293,6 +293,8 @@ class PrometheusAPI:
         r("/api/v1/parse-query", self.h_query_ast)
         r("/api/v1/metadata", self.h_metadata)
         r("/api/v1/status/metric_names_stats", self.h_name_stats)
+        r("/api/v1/admin/status/metric_names_stats/reset",
+          self.h_reset_name_stats)
         r("/federate", self.h_federate)
         if hasattr(self.storage, "create_snapshot"):
             r("/snapshot/create", self.h_snapshot_create)
@@ -877,6 +879,8 @@ class PrometheusAPI:
                     req.body.decode("utf-8", "replace"))
                 if len(self.metadata) < 100_000:
                     self.metadata.update(md)
+                if getattr(self.storage, "set_metadata", None) is not None:
+                    self.storage.set_metadata(md)
             tenant = self._tenant(req)
             cr = None
             if self._columnar_ok():
@@ -1136,24 +1140,49 @@ class PrometheusAPI:
             e[1] = now
 
     def h_metadata(self, req: Request) -> Response:
-        """Prometheus /api/v1/metadata shape."""
+        """Prometheus /api/v1/metadata shape. Merges the API-local store
+        with storage-resident metadata (on a cluster vmselect that is the
+        searchMetadata RPC fan-out)."""
         limit = int(req.arg("limit", "0") or 0)
         metric = req.arg("metric", "")
+        merged = dict(self.metadata)
+        if getattr(self.storage, "search_metadata", None) is not None:
+            try:
+                merged.update(self.storage.search_metadata(
+                    limit or 100_000, metric))
+            except Exception as e:
+                logger.errorf("search_metadata: %s", e)
         data = {}
-        for name, md in self.metadata.items():
+        for name, md in merged.items():
             if metric and name != metric:
                 continue
-            data[name] = [{"type": md["type"] or "unknown",
-                           "help": md["help"], "unit": ""}]
+            data[name] = [{"type": md.get("type") or "unknown",
+                           "help": md.get("help", ""), "unit": ""}]
             if limit and len(data) >= limit:
                 break
         return Response.json({"status": "success", "data": data})
 
     def h_name_stats(self, req: Request) -> Response:
         """Per-metric-name query usage (the reference's
-        /api/v1/status/metric_names_stats, lib/storage/metricnamestats)."""
+        /api/v1/status/metric_names_stats, lib/storage/metricnamestats).
+        Merges the API-local tracker with storage-resident stats (on a
+        cluster vmselect that is the metricNamesUsageStats RPC)."""
         limit = int(req.arg("limit", "1000") or 1000)
         le = req.arg("le", "")
+        # storage-resident stats are authoritative when available (the
+        # reference serves these from vmstorage); the API-local tracker
+        # records the SAME query events, so merging would double-count
+        if getattr(self.storage, "metric_names_usage_stats",
+                   None) is not None:
+            try:
+                items = self.storage.metric_names_usage_stats(
+                    limit, int(le) if le else None)
+                return Response.json(
+                    {"status": "success",
+                     "statsCollectedSince": int(self.started_at),
+                     "records": items})
+            except Exception as e:
+                logger.errorf("metric_names_usage_stats: %s", e)
         items = [{"metricName": n, "requestsCount": c,
                   "lastRequestTimestamp": t}
                  for n, (c, t) in self.name_usage.items()]
@@ -1163,6 +1192,14 @@ class PrometheusAPI:
         return Response.json({"status": "success",
                               "statsCollectedSince": int(self.started_at),
                               "records": items[:limit]})
+
+    def h_reset_name_stats(self, req: Request) -> Response:
+        """/api/v1/admin/status/metric_names_stats/reset."""
+        self.name_usage.clear()
+        if getattr(self.storage, "reset_metric_names_stats",
+                   None) is not None:
+            self.storage.reset_metric_names_stats()
+        return Response.json({"status": "success"})
 
     flags_map: dict | None = None  # set by apps for the /flags page
 

@@ -691,6 +691,378 @@ def azure_sd(cfg: dict) -> list[tuple[str, dict]]:
     return out
 
 
+# -- nomad (discovery/nomad/) ------------------------------------------------
+
+def nomad_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Nomad service discovery (lib/promscrape/discovery/nomad): list
+    service names, then each service's registrations; one target per
+    registration at Address:Port."""
+    server = cfg.get("server", "localhost:4646")
+    if not server.startswith(("http://", "https://")):
+        server = "http://" + server
+    ns = cfg.get("namespace", "default")
+    region = cfg.get("region", "global")
+    base = f"{server.rstrip('/')}/v1"
+    q = f"?namespace={ns}&region={region}"
+    try:
+        listing = _get_json(f"{base}/services{q}")
+        out: list[tuple[str, dict]] = []
+        for group in listing or []:
+            for svc in group.get("Services") or []:
+                name = svc.get("ServiceName", "")
+                if not name:
+                    continue
+                for reg in _get_json(f"{base}/service/{name}{q}") or []:
+                    addr = reg.get("Address", "")
+                    port = reg.get("Port", 0)
+                    meta = {
+                        "__meta_nomad_address": addr,
+                        "__meta_nomad_dc": reg.get("Datacenter", ""),
+                        "__meta_nomad_namespace":
+                            reg.get("Namespace", ""),
+                        "__meta_nomad_node_id": reg.get("NodeID", ""),
+                        "__meta_nomad_service":
+                            reg.get("ServiceName", ""),
+                        "__meta_nomad_service_address": addr,
+                        "__meta_nomad_service_alloc_id":
+                            reg.get("AllocID", ""),
+                        "__meta_nomad_service_id": reg.get("ID", ""),
+                        "__meta_nomad_service_job_id":
+                            reg.get("JobID", ""),
+                        "__meta_nomad_service_port": str(port),
+                        "__meta_nomad_tags":
+                            "," + ",".join(reg.get("Tags") or []) + ",",
+                    }
+                    for tag in reg.get("Tags") or []:
+                        k, sep, v = tag.partition("=")
+                        if sep:
+                            meta[f"__meta_nomad_tag_{_sanitize(k)}"] = v
+                        meta[f"__meta_nomad_tagpresent_{_sanitize(k)}"] \
+                            = "true"
+                    out.append((f"{addr}:{port}", meta))
+        return out
+    except (OSError, ValueError, KeyError) as e:
+        raise DiscoveryError(f"nomad_sd {server}: {e}") from e
+
+
+# -- dockerswarm (discovery/dockerswarm/) ------------------------------------
+
+def dockerswarm_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Docker Swarm discovery (lib/promscrape/discovery/dockerswarm):
+    roles tasks (default), services, nodes against the engine API."""
+    host = cfg.get("host", "unix:///var/run/docker.sock")
+    role = cfg.get("role", "tasks")
+    dport = int(cfg.get("port", 80))
+    try:
+        if role == "nodes":
+            out = []
+            for n in _docker_get(host, "/nodes") or []:
+                desc = n.get("Description") or {}
+                status = n.get("Status") or {}
+                spec = n.get("Spec") or {}
+                meta = {
+                    "__meta_dockerswarm_node_id": n.get("ID", ""),
+                    "__meta_dockerswarm_node_address":
+                        status.get("Addr", ""),
+                    "__meta_dockerswarm_node_availability":
+                        spec.get("Availability", ""),
+                    "__meta_dockerswarm_node_hostname":
+                        desc.get("Hostname", ""),
+                    "__meta_dockerswarm_node_role": spec.get("Role", ""),
+                    "__meta_dockerswarm_node_status":
+                        status.get("State", ""),
+                    "__meta_dockerswarm_node_platform_architecture":
+                        (desc.get("Platform") or {}).get(
+                            "Architecture", ""),
+                    "__meta_dockerswarm_node_platform_os":
+                        (desc.get("Platform") or {}).get("OS", ""),
+                    "__meta_dockerswarm_node_engine_version":
+                        (desc.get("Engine") or {}).get(
+                            "EngineVersion", ""),
+                }
+                for k, v in (spec.get("Labels") or {}).items():
+                    meta["__meta_dockerswarm_node_label_"
+                         f"{_sanitize(k)}"] = v
+                out.append((f"{status.get('Addr', '')}:{dport}", meta))
+            return out
+        services = {s["ID"]: s for s in _docker_get(host, "/services")
+                    or []}
+        if role == "services":
+            out = []
+            for s in services.values():
+                spec = s.get("Spec") or {}
+                meta = {
+                    "__meta_dockerswarm_service_id": s.get("ID", ""),
+                    "__meta_dockerswarm_service_name":
+                        spec.get("Name", ""),
+                    "__meta_dockerswarm_service_mode":
+                        next(iter(spec.get("Mode") or {"": None})),
+                }
+                for k, v in (spec.get("Labels") or {}).items():
+                    meta["__meta_dockerswarm_service_label_"
+                         f"{_sanitize(k)}"] = v
+                eps = ((s.get("Endpoint") or {}).get("VirtualIPs")
+                       or [])
+                for ep in eps:
+                    ip = (ep.get("Addr") or "").split("/")[0]
+                    if ip:
+                        out.append((f"{ip}:{dport}", dict(meta)))
+            return out
+        # role == tasks
+        nodes = {n["ID"]: n for n in _docker_get(host, "/nodes") or []}
+        out = []
+        for t in _docker_get(host, "/tasks") or []:
+            svc = services.get(t.get("ServiceID", "")) or {}
+            node = nodes.get(t.get("NodeID", "")) or {}
+            meta = {
+                "__meta_dockerswarm_task_id": t.get("ID", ""),
+                "__meta_dockerswarm_task_desired_state":
+                    t.get("DesiredState", ""),
+                "__meta_dockerswarm_task_state":
+                    (t.get("Status") or {}).get("State", ""),
+                "__meta_dockerswarm_task_slot": str(t.get("Slot", "")),
+                "__meta_dockerswarm_service_id":
+                    t.get("ServiceID", ""),
+                "__meta_dockerswarm_service_name":
+                    (svc.get("Spec") or {}).get("Name", ""),
+                "__meta_dockerswarm_node_id": t.get("NodeID", ""),
+                "__meta_dockerswarm_node_hostname":
+                    ((node.get("Description") or {})
+                     .get("Hostname", "")),
+                "__meta_dockerswarm_node_address":
+                    (node.get("Status") or {}).get("Addr", ""),
+            }
+            for k, v in (((t.get("Spec") or {}).get("ContainerSpec")
+                          or {}).get("Labels") or {}).items():
+                meta["__meta_dockerswarm_container_label_"
+                     f"{_sanitize(k)}"] = v
+            nets = t.get("NetworksAttachments") or []
+            placed = False
+            for na in nets:
+                for addr in na.get("Addresses") or []:
+                    ip = addr.split("/")[0]
+                    out.append((f"{ip}:{dport}", dict(meta)))
+                    placed = True
+            if not placed:
+                node_addr = (node.get("Status") or {}).get("Addr", "")
+                if node_addr:
+                    out.append((f"{node_addr}:{dport}", meta))
+        return out
+    except (OSError, ValueError, KeyError, DiscoveryError) as e:
+        raise DiscoveryError(f"dockerswarm_sd {host}: {e}") from e
+
+
+# -- eureka (discovery/eureka/) ----------------------------------------------
+
+def eureka_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Eureka app-instance discovery (lib/promscrape/discovery/eureka):
+    GET {server}/apps, one target per instance at hostName:port."""
+    server = cfg.get("server", "localhost:8080/eureka/v2")
+    if not server.startswith(("http://", "https://")):
+        server = "http://" + server
+    try:
+        data = _get_json(f"{server.rstrip('/')}/apps",
+                         headers={"Accept": "application/json"})
+        out: list[tuple[str, dict]] = []
+        apps = ((data or {}).get("applications") or {}) \
+            .get("application") or []
+        if isinstance(apps, dict):
+            apps = [apps]
+        for app in apps:
+            instances = app.get("instance") or []
+            if isinstance(instances, dict):
+                instances = [instances]
+            for inst in instances:
+                port_info = inst.get("port") or {}
+                port = int(port_info.get("$", 80))
+                meta = {
+                    "__meta_eureka_app_name": app.get("name", ""),
+                    "__meta_eureka_app_instance_id":
+                        inst.get("instanceId", ""),
+                    "__meta_eureka_app_instance_hostname":
+                        inst.get("hostName", ""),
+                    "__meta_eureka_app_instance_ip_addr":
+                        inst.get("ipAddr", ""),
+                    "__meta_eureka_app_instance_status":
+                        inst.get("status", ""),
+                    "__meta_eureka_app_instance_port": str(port),
+                    "__meta_eureka_app_instance_port_enabled":
+                        str(port_info.get("@enabled", "")),
+                    "__meta_eureka_app_instance_vip_address":
+                        inst.get("vipAddress", ""),
+                    "__meta_eureka_app_instance_secure_vip_address":
+                        inst.get("secureVipAddress", ""),
+                    "__meta_eureka_app_instance_homepage_url":
+                        inst.get("homePageUrl", ""),
+                    "__meta_eureka_app_instance_statuspage_url":
+                        inst.get("statusPageUrl", ""),
+                    "__meta_eureka_app_instance_healthcheck_url":
+                        inst.get("healthCheckUrl", ""),
+                    "__meta_eureka_app_instance_country_id":
+                        str(inst.get("countryId", "")),
+                    "__meta_eureka_app_instance_datacenterinfo_name":
+                        (inst.get("dataCenterInfo") or {})
+                        .get("name", ""),
+                }
+                for k, v in (inst.get("metadata") or {}).items():
+                    meta["__meta_eureka_app_instance_metadata_"
+                         f"{_sanitize(k)}"] = str(v)
+                out.append((f"{inst.get('hostName', '')}:{port}", meta))
+        return out
+    except (OSError, ValueError, KeyError) as e:
+        raise DiscoveryError(f"eureka_sd {server}: {e}") from e
+
+
+# -- openstack (discovery/openstack/) ----------------------------------------
+
+def openstack_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """OpenStack Nova instance discovery
+    (lib/promscrape/discovery/openstack): keystone password auth for a
+    token, then /servers/detail; role=hypervisor lists hypervisors."""
+    import urllib.request
+    identity = cfg.get("identity_endpoint", "")
+    if not identity:
+        raise DiscoveryError("openstack_sd: identity_endpoint is required")
+    dport = int(cfg.get("port", 80))
+    role = cfg.get("role", "instance")
+    try:
+        auth = {"auth": {
+            "identity": {"methods": ["password"], "password": {"user": {
+                "name": cfg.get("username", ""),
+                "domain": {"name": cfg.get("domain_name", "Default")},
+                "password": cfg.get("password", "")}}},
+            "scope": {"project": {
+                "name": cfg.get("project_name", ""),
+                "domain": {"name": cfg.get("domain_name", "Default")}}}}}
+        req = urllib.request.Request(
+            f"{identity.rstrip('/')}/auth/tokens",
+            data=json.dumps(auth).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            token = resp.headers.get("X-Subject-Token", "")
+            body = json.loads(resp.read())
+        catalog = ((body.get("token") or {}).get("catalog")) or []
+        nova = ""
+        for svc in catalog:
+            if svc.get("type") == "compute":
+                for ep in svc.get("endpoints") or []:
+                    if ep.get("interface") == "public":
+                        nova = ep.get("url", "")
+        if not nova:
+            raise DiscoveryError("no compute endpoint in catalog")
+        hdrs = {"X-Auth-Token": token}
+        out: list[tuple[str, dict]] = []
+        if role == "hypervisor":
+            data = _get_json(f"{nova.rstrip('/')}/os-hypervisors/detail",
+                             headers=hdrs)
+            for h in data.get("hypervisors") or []:
+                meta = {
+                    "__meta_openstack_hypervisor_id": str(h.get("id", "")),
+                    "__meta_openstack_hypervisor_hostname":
+                        h.get("hypervisor_hostname", ""),
+                    "__meta_openstack_hypervisor_host_ip":
+                        h.get("host_ip", ""),
+                    "__meta_openstack_hypervisor_state":
+                        h.get("state", ""),
+                    "__meta_openstack_hypervisor_status":
+                        h.get("status", ""),
+                    "__meta_openstack_hypervisor_type":
+                        h.get("hypervisor_type", ""),
+                }
+                out.append((f"{h.get('host_ip', '')}:{dport}", meta))
+            return out
+        url = f"{nova.rstrip('/')}/servers/detail"
+        while url:
+            data = _get_json(url, headers=hdrs)
+            for srv in data.get("servers") or []:
+                flavor = (srv.get("flavor") or {})
+                meta_base = {
+                    "__meta_openstack_instance_id": srv.get("id", ""),
+                    "__meta_openstack_instance_name":
+                        srv.get("name", ""),
+                    "__meta_openstack_instance_status":
+                        srv.get("status", ""),
+                    "__meta_openstack_instance_flavor":
+                        flavor.get("original_name", flavor.get("id", "")),
+                    "__meta_openstack_project_id":
+                        srv.get("tenant_id", ""),
+                    "__meta_openstack_user_id": srv.get("user_id", ""),
+                }
+                for k, v in (srv.get("metadata") or {}).items():
+                    meta_base[f"__meta_openstack_tag_{_sanitize(k)}"] = \
+                        str(v)
+                for pool, addrs in (srv.get("addresses") or {}).items():
+                    for a in addrs or []:
+                        ip = a.get("addr", "")
+                        if not ip:
+                            continue
+                        meta = dict(meta_base)
+                        meta["__meta_openstack_address_pool"] = pool
+                        meta["__meta_openstack_private_ip"] = ip
+                        out.append((f"{ip}:{dport}", meta))
+            # Nova caps page size server-side; follow the next link
+            url = next((ln.get("href", "")
+                        for ln in data.get("servers_links") or []
+                        if ln.get("rel") == "next"), "")
+        return out
+    except (OSError, ValueError, KeyError) as e:
+        raise DiscoveryError(f"openstack_sd {identity}: {e}") from e
+
+
+# -- digitalocean (discovery/digitalocean/) ----------------------------------
+
+def digitalocean_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """DigitalOcean droplet discovery
+    (lib/promscrape/discovery/digitalocean): /v2/droplets with bearer
+    auth; target = public IPv4:port."""
+    server = cfg.get("server", "https://api.digitalocean.com")
+    dport = int(cfg.get("port", 80))
+    headers = {}
+    if cfg.get("bearer_token"):
+        headers["Authorization"] = f"Bearer {cfg['bearer_token']}"
+    out: list[tuple[str, dict]] = []
+    url = f"{server.rstrip('/')}/v2/droplets?per_page=200"
+    try:
+        while url:
+            data = _get_json(url, headers=headers)
+            for d in data.get("droplets") or []:
+                v4 = (d.get("networks") or {}).get("v4") or []
+                pub = next((n["ip_address"] for n in v4
+                            if n.get("type") == "public"), "")
+                priv = next((n["ip_address"] for n in v4
+                             if n.get("type") == "private"), "")
+                if not pub:
+                    continue
+                meta = {
+                    "__meta_digitalocean_droplet_id":
+                        str(d.get("id", "")),
+                    "__meta_digitalocean_droplet_name":
+                        d.get("name", ""),
+                    "__meta_digitalocean_image":
+                        (d.get("image") or {}).get("slug", ""),
+                    "__meta_digitalocean_image_name":
+                        (d.get("image") or {}).get("name", ""),
+                    "__meta_digitalocean_private_ipv4": priv,
+                    "__meta_digitalocean_public_ipv4": pub,
+                    "__meta_digitalocean_region":
+                        (d.get("region") or {}).get("slug", ""),
+                    "__meta_digitalocean_size":
+                        (d.get("size") or {}).get("slug", ""),
+                    "__meta_digitalocean_status": d.get("status", ""),
+                    "__meta_digitalocean_vpc": d.get("vpc_uuid", ""),
+                    "__meta_digitalocean_tags":
+                        "," + ",".join(d.get("tags") or []) + ",",
+                    "__meta_digitalocean_features":
+                        "," + ",".join(d.get("features") or []) + ",",
+                }
+                out.append((f"{pub}:{dport}", meta))
+            url = (((data.get("links") or {}).get("pages") or {})
+                   .get("next", ""))
+        return out
+    except (OSError, ValueError, KeyError) as e:
+        raise DiscoveryError(f"digitalocean_sd {server}: {e}") from e
+
+
 PROVIDERS = {
     "kubernetes_sd_configs": kubernetes_sd,
     "consul_sd_configs": consul_sd,
@@ -700,6 +1072,11 @@ PROVIDERS = {
     "docker_sd_configs": docker_sd,
     "gce_sd_configs": gce_sd,
     "azure_sd_configs": azure_sd,
+    "nomad_sd_configs": nomad_sd,
+    "dockerswarm_sd_configs": dockerswarm_sd,
+    "eureka_sd_configs": eureka_sd,
+    "openstack_sd_configs": openstack_sd,
+    "digitalocean_sd_configs": digitalocean_sd,
 }
 
 

@@ -264,6 +264,44 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
             w.u64(a).u64(p)
         return w
 
+    def h_tag_value_suffixes(r: Reader):
+        tenant = _read_tenant(r)
+        min_ts, max_ts = r.i64(), r.i64()
+        tag_key = r.str_()
+        prefix = r.str_()
+        delim = r.str_()
+        max_sfx = r.u64()
+        sfx = storage.tag_value_suffixes(
+            tag_key, prefix, delim or ".", max_sfx,
+            min_ts or None, max_ts or None, tenant) \
+            if hasattr(storage, "tag_value_suffixes") else []
+        w = Writer().u64(len(sfx))
+        for s in sfx:
+            w.str_(s)
+        return w
+
+    def h_metric_names_usage_stats(r: Reader):
+        import json
+        limit = r.u64()
+        le_plus1 = r.u64()  # 0 = no le filter
+        items = storage.metric_names_usage_stats(
+            limit, le_plus1 - 1 if le_plus1 else None) \
+            if hasattr(storage, "metric_names_usage_stats") else []
+        return Writer().bytes_(json.dumps(items).encode())
+
+    def h_reset_metric_names_stats(r: Reader):
+        if hasattr(storage, "reset_metric_names_stats"):
+            storage.reset_metric_names_stats()
+        return Writer().u64(1)
+
+    def h_search_metadata(r: Reader):
+        import json
+        limit = r.u64()
+        metric = r.str_()
+        md = storage.search_metadata(limit, metric) \
+            if hasattr(storage, "search_metadata") else {}
+        return Writer().bytes_(json.dumps(md).encode())
+
     return {
         "writeRows_v1": h_write_rows,
         "writeRowsColumnar_v1": h_write_rows_columnar,
@@ -278,6 +316,10 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         "tsdbStatus_v1": h_tsdb_status,
         "registerMetricNames_v1": h_register_metric_names,
         "tenants_v1": h_tenants,
+        "tagValueSuffixes_v1": h_tag_value_suffixes,
+        "metricNamesUsageStats_v1": h_metric_names_usage_stats,
+        "resetMetricNamesStats_v1": h_reset_metric_names_stats,
+        "searchMetadata_v1": h_search_metadata,
     }
 
 
@@ -453,6 +495,31 @@ class StorageNodeClient:
     def tenants(self):
         r = self.select.call("tenants_v1", Writer())
         return [(r.u64(), r.u64()) for _ in range(r.u64())]
+
+    def tag_value_suffixes(self, tag_key, prefix, delimiter=".",
+                           max_suffixes=100_000, min_ts=None, max_ts=None,
+                           tenant=(0, 0)):
+        w = _write_tenant(Writer(), tenant)
+        w.i64(min_ts or 0).i64(max_ts or 0)
+        w.str_(tag_key).str_(prefix).str_(delimiter)
+        w.u64(max_suffixes)
+        r = self.select.call("tagValueSuffixes_v1", w)
+        return [r.str_() for _ in range(r.u64())]
+
+    def metric_names_usage_stats(self, limit=1000, le=None):
+        import json
+        w = Writer().u64(limit).u64(0 if le is None else le + 1)
+        r = self.select.call("metricNamesUsageStats_v1", w)
+        return json.loads(r.bytes_())
+
+    def reset_metric_names_stats(self):
+        self.select.call("resetMetricNamesStats_v1", Writer())
+
+    def search_metadata(self, limit=1000, metric=""):
+        import json
+        w = Writer().u64(limit).str_(metric)
+        r = self.select.call("searchMetadata_v1", w)
+        return json.loads(r.bytes_())
 
     def close(self):
         self.insert.close()
@@ -875,6 +942,42 @@ class ClusterStorage:
         res = self._fanout(
             lambda n: n.label_values(key, min_ts, max_ts, tenant))
         return sorted(set().union(*map(set, res))) if res else []
+
+    def tag_value_suffixes(self, tag_key, prefix, delimiter=".",
+                           max_suffixes=100_000, min_ts=None, max_ts=None,
+                           tenant=(0, 0)):
+        res = self._fanout(lambda n: n.tag_value_suffixes(
+            tag_key, prefix, delimiter, max_suffixes, min_ts, max_ts,
+            tenant))
+        return sorted(set().union(*map(set, res)))[:max_suffixes] \
+            if res else []
+
+    def metric_names_usage_stats(self, limit=1000, le=None):
+        merged: dict[str, list] = {}
+        for items in self._fanout(
+                lambda n: n.metric_names_usage_stats(limit, le)):
+            for x in items:
+                e = merged.setdefault(x["metricName"], [0, 0])
+                e[0] += x["requestsCount"]
+                e[1] = max(e[1], x["lastRequestTimestamp"])
+        items = [{"metricName": k, "requestsCount": c,
+                  "lastRequestTimestamp": t}
+                 for k, (c, t) in merged.items()]
+        if le is not None:
+            items = [x for x in items if x["requestsCount"] <= le]
+        items.sort(key=lambda x: x["requestsCount"])
+        return items[:limit]
+
+    def reset_metric_names_stats(self):
+        self._fanout(lambda n: n.reset_metric_names_stats())
+
+    def search_metadata(self, limit=1000, metric=""):
+        out: dict = {}
+        for md in self._fanout(
+                lambda n: n.search_metadata(limit, metric)):
+            for k, v in md.items():
+                out.setdefault(k, v)
+        return dict(list(out.items())[:limit])
 
     def delete_series(self, filters, tenant=(0, 0)):
         return sum(self._fanout(lambda n: n.delete_series(filters, tenant)))

@@ -199,6 +199,10 @@ class Storage:
         #                             missing from the bounded log
         self.slow_row_inserts = 0
         self.new_series_created = 0
+        # metric-name usage stats + TYPE/HELP metadata (storage-resident
+        # so cluster RPCs can serve them; lib/storage/metricnamestats)
+        self._name_usage: dict = {}
+        self.metadata: dict[str, dict] = {}
         from ..query.rollup_result_cache import next_storage_token
         self.cache_token = next_storage_token()
         self._load_caches()
@@ -866,6 +870,9 @@ class Storage:
             cols.raw_names = [raws[i] for i in perm]
             cols.metric_names = [names[int(m)][0] for m in ordered_mids]
         cols.compute_stale_rows()
+        if cols.metric_names:
+            self.track_name_usage(
+                {mn.metric_group for mn in cols.metric_names})
         return cols
 
     def search_series(self, filters: list[TagFilter], min_ts: int,
@@ -937,6 +944,80 @@ class Storage:
     def label_values(self, key: str, min_ts=None, max_ts=None,
                      tenant=(0, 0)) -> list[str]:
         return self.idb.label_values(key, min_ts, max_ts, tenant)
+
+    def tag_value_suffixes(self, tag_key: str, tag_value_prefix: str,
+                           delimiter: str = ".", max_suffixes: int = 100_000,
+                           min_ts=None, max_ts=None,
+                           tenant=(0, 0)) -> list[str]:
+        """Graphite path expansion (GetTagValueSuffixes,
+        lib/storage/index_db.go): distinct suffixes of `tag_key` values
+        that start with `tag_value_prefix`, cut AFTER the next delimiter
+        (suffix keeps the trailing delimiter, marking a non-leaf)."""
+        key = "__name__" if tag_key in ("", "__name__") else tag_key
+        vals = self.idb.label_values(key, min_ts, max_ts, tenant)
+        plen = len(tag_value_prefix)
+        out: set[str] = set()
+        for v in vals:
+            if not v.startswith(tag_value_prefix):
+                continue
+            rest = v[plen:]
+            i = rest.find(delimiter)
+            out.add(rest if i < 0 else rest[:i + 1])
+            if len(out) >= max_suffixes:
+                break
+        return sorted(out)
+
+    # -- metric-name usage stats (lib/storage/metricnamestats) -----------
+
+    _MAX_NAME_USAGE = 100_000
+
+    def track_name_usage(self, metric_groups) -> None:
+        """Record a query hit for each distinct metric name (called by
+        the search paths; drives /api/v1/status/metric_names_stats and
+        the metricNamesUsageStats RPC)."""
+        now = int(time.time())
+        nu = self._name_usage
+        for g in metric_groups:
+            e = nu.get(g)
+            if e is None:
+                if len(nu) >= self._MAX_NAME_USAGE:
+                    continue
+                e = nu[g] = [0, 0]
+            e[0] += 1
+            e[1] = now
+
+    def metric_names_usage_stats(self, limit: int = 1000,
+                                 le: int | None = None) -> list[dict]:
+        items = [{"metricName": (g.decode("utf-8", "replace")
+                                 if isinstance(g, bytes) else g),
+                  "requestsCount": c, "lastRequestTimestamp": t}
+                 for g, (c, t) in self._name_usage.items()]
+        if le is not None:
+            items = [x for x in items if x["requestsCount"] <= le]
+        items.sort(key=lambda x: x["requestsCount"])
+        return items[:limit]
+
+    def reset_metric_names_stats(self) -> None:
+        self._name_usage.clear()
+
+    # -- metric metadata (TYPE/HELP; /api/v1/metadata storage side) ------
+
+    def set_metadata(self, metadata: dict) -> None:
+        """Merge parsed # TYPE / # HELP exposition metadata."""
+        if len(self.metadata) < 100_000:
+            self.metadata.update(metadata)
+
+    def search_metadata(self, limit: int = 1000,
+                        metric: str = "") -> dict:
+        if metric:
+            md = self.metadata.get(metric)
+            return {metric: md} if md else {}
+        out = {}
+        for name, md in self.metadata.items():
+            if len(out) >= limit:
+                break
+            out[name] = md
+        return out
 
     def series_count(self, tenant=(0, 0)) -> int:
         return int(self.idb._all_metric_ids(tenant).size)
