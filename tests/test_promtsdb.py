@@ -101,6 +101,40 @@ class TestBlockRoundtrip:
         assert not rep["ok"]
         assert any("crc" in e or "chunk" in e for e in rep["errors"])
 
+    def test_verify_detects_index_corruption(self, tmp_path):
+        rng = np.random.default_rng(2)
+        blk = str(tmp_path / "b4")
+        pt.write_block(blk, _mk_series(rng, 3))
+        p = os.path.join(blk, "index")
+        data = bytearray(open(p, "rb").read())
+        # flip a byte inside the series section (after the symbol table)
+        blk_obj = pt.TSDBBlock(blk)
+        data[blk_obj._toc["series"] + 3] ^= 0xFF
+        open(p, "wb").write(bytes(data))
+        rep = pt.verify_block(blk)
+        assert not rep["ok"]
+        assert any("crc" in e or "index" in e for e in rep["errors"])
+
+    def test_unsupported_encoding_skipped_with_callback(self, tmp_path):
+        rng = np.random.default_rng(3)
+        blk = str(tmp_path / "b5")
+        pt.write_block(blk, _mk_series(rng, 3))
+        # rewrite one chunk's encoding byte to 2 (native histogram) and
+        # fix up its crc so only the encoding is "unsupported"
+        p = os.path.join(blk, "chunks", "000001")
+        seg = bytearray(open(p, "rb").read())
+        ln, i = pt._uvarint(seg, 8)
+        seg[i] = 2
+        body = bytes(seg[i:i + 1 + ln])
+        seg[i + 1 + ln:i + 1 + ln + 4] = \
+            pt.struct.pack(">I", pt.crc32c(body))
+        open(p, "wb").write(bytes(seg))
+        skipped = []
+        got = list(pt.read_block(
+            blk, on_unsupported=lambda l, e: skipped.append(l)))
+        assert len(skipped) == 1
+        assert len(got) == 2
+
     def test_verify_rejects_bad_magic(self, tmp_path):
         blk = tmp_path / "b3"
         (blk / "chunks").mkdir(parents=True)

@@ -159,11 +159,18 @@ def prometheus_tsdb(path: str, dst: str, chunk_lines: int = 50_000) -> int:
     if not blocks:
         raise SystemExit(f"vmctl prometheus-tsdb: no blocks under {path}")
     total = 0
+    skipped = [0]
     buf: list[str] = []
+
+    def on_unsupported(labels, err):
+        skipped[0] += 1
+        logger.errorf("vmctl prometheus-tsdb: skipping series %s: %s",
+                      labels.get("__name__", "?"), err)
     for bdir in blocks:
         logger.infof("vmctl prometheus-tsdb: reading block %s", bdir)
         from ..query.format_value import fmt_value
-        for labels, ts, vals in read_block(bdir):
+        for labels, ts, vals in read_block(bdir,
+                                           on_unsupported=on_unsupported):
             name = labels.get("__name__", "")
             if not name:
                 continue
@@ -182,7 +189,8 @@ def prometheus_tsdb(path: str, dst: str, chunk_lines: int = 50_000) -> int:
         _post(dst.rstrip("/") + "/api/v1/import/prometheus",
               "\n".join(buf).encode())
     logger.infof("vmctl prometheus-tsdb: migrated %d samples from %d "
-                 "block(s)", total, len(blocks))
+                 "block(s); %d series skipped (unsupported chunk "
+                 "encodings)", total, len(blocks), skipped[0])
     return total
 
 

@@ -697,13 +697,13 @@ def nomad_sd(cfg: dict) -> list[tuple[str, dict]]:
     """Nomad service discovery (lib/promscrape/discovery/nomad): list
     service names, then each service's registrations; one target per
     registration at Address:Port."""
+    import urllib.parse as _up
     server = cfg.get("server", "localhost:4646")
     if not server.startswith(("http://", "https://")):
         server = "http://" + server
-    ns = cfg.get("namespace", "default")
-    region = cfg.get("region", "global")
     base = f"{server.rstrip('/')}/v1"
-    q = f"?namespace={ns}&region={region}"
+    q = "?" + _up.urlencode({"namespace": cfg.get("namespace", "default"),
+                             "region": cfg.get("region", "global")})
     try:
         listing = _get_json(f"{base}/services{q}")
         out: list[tuple[str, dict]] = []
@@ -712,7 +712,9 @@ def nomad_sd(cfg: dict) -> list[tuple[str, dict]]:
                 name = svc.get("ServiceName", "")
                 if not name:
                     continue
-                for reg in _get_json(f"{base}/service/{name}{q}") or []:
+                for reg in _get_json(
+                        f"{base}/service/"
+                        f"{_up.quote(name, safe='')}{q}") or []:
                     addr = reg.get("Address", "")
                     port = reg.get("Port", 0)
                     meta = {
@@ -796,7 +798,7 @@ def dockerswarm_sd(cfg: dict) -> list[tuple[str, dict]]:
                     "__meta_dockerswarm_service_name":
                         spec.get("Name", ""),
                     "__meta_dockerswarm_service_mode":
-                        next(iter(spec.get("Mode") or {"": None})),
+                        next(iter(spec.get("Mode") or {"": None})).lower(),
                 }
                 for k, v in (spec.get("Labels") or {}).items():
                     meta["__meta_dockerswarm_service_label_"

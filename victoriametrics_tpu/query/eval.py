@@ -87,6 +87,7 @@ def _eval_func(ec: EvalConfig, fe: FuncExpr) -> list[Timeseries]:
         if srcs and len(srcs[0]) == len(out):
             for ts, src in zip(out, srcs[0]):
                 ts.metric_name.metric_group = src.metric_name.metric_group
+                ts.raw = None  # in-place name edit: memo is stale
     return out
 
 
@@ -700,6 +701,14 @@ def _group_key(mn: MetricName, grouping: list[bytes], without: bool) -> bytes:
     return MetricName(group, sorted(kept)).marshal()
 
 
+# (raw name, grouping signature) -> group key: a steady-state dashboard
+# re-groups the SAME 10k series every refresh; the key is a pure function
+# of the (immutable) raw name, so memoizing kills the per-refresh
+# label-scan + marshal (bounded; cleared wholesale when full)
+_GROUP_KEY_MEMO: dict = {}
+_GROUP_KEY_MEMO_MAX = 1 << 18  # ~40MB worst case; clear-all on overflow
+
+
 def _group_series(series: list[Timeseries], grouping: list[str],
                   without: bool):
     if not grouping and not without:
@@ -710,10 +719,22 @@ def _group_series(series: list[Timeseries], grouping: list[str],
         key = MetricName(b"", []).marshal()
         return {key: list(series)}, {key: MetricName.unmarshal(key)}
     gb = [g.encode() for g in grouping]
+    sig = (tuple(gb), without)
+    memo = _GROUP_KEY_MEMO
     groups: dict[bytes, list[Timeseries]] = {}
     names: dict[bytes, MetricName] = {}
     for ts in series:
-        key = _group_key(ts.metric_name, gb, without)
+        raw = ts.raw
+        if raw is not None:
+            mkey = (raw, sig)
+            key = memo.get(mkey)
+            if key is None:
+                key = _group_key(ts.metric_name, gb, without)
+                if len(memo) >= _GROUP_KEY_MEMO_MAX:
+                    memo.clear()
+                memo[mkey] = key
+        else:  # mutated/synthetic name: compute directly
+            key = _group_key(ts.metric_name, gb, without)
         groups.setdefault(key, []).append(ts)
         if key not in names:
             names[key] = MetricName.unmarshal(key)

@@ -310,6 +310,7 @@ def tf_drop_common_labels(ec, args):
             ts.metric_name.metric_group = b""
         ts.metric_name.labels = [
             (k, v) for k, v in ts.metric_name.labels if k not in common]
+        ts.raw = None  # in-place name edit: memoized marshal is stale
     return series
 
 
