@@ -216,3 +216,151 @@ def test_all_providers_registered():
                 "eureka_sd_configs", "openstack_sd_configs",
                 "digitalocean_sd_configs"):
         assert key in discovery.PROVIDERS
+
+
+class TestConsulagentSD:
+    def test_agent_services(self):
+        srv = _srv({
+            "/v1/agent/self": {"Member": {"Name": "node1",
+                                          "Addr": "10.5.0.1"},
+                               "Config": {"Datacenter": "dc1"}},
+            "/v1/agent/services": {
+                "redis-1": {"ID": "redis-1", "Service": "redis",
+                            "Address": "10.5.0.2", "Port": 6379,
+                            "Tags": ["primary"],
+                            "Meta": {"redis_version": "7"}}},
+        })
+        try:
+            out = discovery.consulagent_sd(
+                {"server": f"127.0.0.1:{srv.port}"})
+            assert out[0][0] == "10.5.0.2:6379"
+            meta = out[0][1]
+            assert meta["__meta_consulagent_service"] == "redis"
+            assert meta["__meta_consulagent_dc"] == "dc1"
+            assert meta["__meta_consulagent_node"] == "node1"
+            assert meta["__meta_consulagent_tag_primary"] == "primary"
+            assert meta["__meta_consulagent_service_metadata_"
+                        "redis_version"] == "7"
+            # service filter
+            assert discovery.consulagent_sd(
+                {"server": f"127.0.0.1:{srv.port}",
+                 "services": ["other"]}) == []
+        finally:
+            srv.stop()
+
+
+class TestHetznerSD:
+    def test_hcloud_pagination(self):
+        srv = HTTPServer("127.0.0.1", 0)
+        page = {1: {"servers": [{
+            "id": 7, "name": "web-1", "status": "running",
+            "public_net": {"ipv4": {"ip": "5.6.7.8"}},
+            "datacenter": {"name": "fsn1-dc14",
+                           "location": {"name": "fsn1",
+                                        "network_zone": "eu-central"}},
+            "server_type": {"name": "cx11", "cores": 1,
+                            "cpu_type": "shared", "memory": 2,
+                            "disk": 20},
+            "image": {"name": "ubuntu-22.04", "os_flavor": "ubuntu",
+                      "os_version": "22.04"},
+            "labels": {"env": "prod"}}],
+            "meta": {"pagination": {"next_page": 2}}},
+            2: {"servers": [], "meta": {"pagination": {}}}}
+
+        def h(r):
+            return Response.json(page[int(r.arg("page") or 1)])
+        srv.route("/v1/servers", h)
+        srv.start()
+        try:
+            out = discovery.hetzner_sd(
+                {"endpoint": f"http://127.0.0.1:{srv.port}",
+                 "bearer_token": "tk", "port": 9100})
+            assert out == [("5.6.7.8:9100", out[0][1])]
+            meta = out[0][1]
+            assert meta["__meta_hetzner_hcloud_server_type"] == "cx11"
+            assert meta["__meta_hetzner_hcloud_label_env"] == "prod"
+            assert meta["__meta_hetzner_hcloud_labelpresent_env"] \
+                == "true"
+            assert meta["__meta_hetzner_hcloud_datacenter_location_"
+                        "network_zone"] == "eu-central"
+        finally:
+            srv.stop()
+
+
+class TestVultrSD:
+    def test_instances(self):
+        srv = _srv({"/v2/instances": {"instances": [{
+            "id": "i-1", "label": "db", "hostname": "db-1",
+            "os": "Ubuntu", "os_id": 1743, "region": "ewr",
+            "plan": "vc2-1c-1gb", "main_ip": "45.1.2.3",
+            "internal_ip": "10.1.1.1", "v6_main_ip": "::1",
+            "server_status": "ok", "vcpu_count": 1, "ram": 1024,
+            "disk": 25, "allowed_bandwidth": 1000,
+            "features": ["ipv6"], "tags": ["db"]}],
+            "meta": {"links": {"next": ""}}}})
+        try:
+            out = discovery.vultr_sd(
+                {"endpoint": f"http://127.0.0.1:{srv.port}",
+                 "bearer_token": "tk", "port": 9100})
+            assert out[0][0] == "45.1.2.3:9100"
+            meta = out[0][1]
+            assert meta["__meta_vultr_instance_plan"] == "vc2-1c-1gb"
+            assert meta["__meta_vultr_instance_tags"] == ",db,"
+            assert meta["__meta_vultr_instance_ram_mb"] == "1024"
+        finally:
+            srv.stop()
+
+
+class TestMarathonSD:
+    def test_apps_tasks(self):
+        srv = _srv({"/v2/apps": {"apps": [{
+            "id": "/web", "labels": {"team": "x"},
+            "container": {"docker": {"image": "nginx:1"}},
+            "portDefinitions": [{"labels": {"metrics": "/metrics"}}],
+            "tasks": [{"id": "web.t1", "host": "10.6.0.1",
+                       "ports": [31001]}]}]}})
+        try:
+            out = discovery.marathon_sd(
+                {"servers": [f"http://127.0.0.1:{srv.port}"]})
+            assert out[0][0] == "10.6.0.1:31001"
+            meta = out[0][1]
+            assert meta["__meta_marathon_app"] == "/web"
+            assert meta["__meta_marathon_image"] == "nginx:1"
+            assert meta["__meta_marathon_app_label_team"] == "x"
+            assert meta["__meta_marathon_port_definition_label_"
+                        "metrics"] == "/metrics"
+        finally:
+            srv.stop()
+
+
+class TestPuppetdbSD:
+    def test_resources(self):
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+
+        srv = HTTPServer("127.0.0.1", 0)
+        seen = []
+
+        def h(r):
+            import json as _j
+            seen.append(_j.loads(r.body))
+            return Response.json([{
+                "certname": "agent1.local", "environment": "production",
+                "exported": False, "file": "/etc/pp/site.pp",
+                "resource": "abc123", "tags": ["class", "apache"],
+                "parameters": {"port": 8080}}])
+        srv.route("/pdb/query/v4", h)
+        srv.start()
+        try:
+            out = discovery.puppetdb_sd({
+                "url": f"http://127.0.0.1:{srv.port}",
+                "query": 'resources { type = "Class" }',
+                "port": 9100, "include_parameters": True})
+            assert seen[0]["query"] == 'resources { type = "Class" }'
+            assert out[0][0] == "agent1.local:9100"
+            meta = out[0][1]
+            assert meta["__meta_puppetdb_environment"] == "production"
+            assert meta["__meta_puppetdb_exported"] == "false"
+            assert meta["__meta_puppetdb_parameter_port"] == "8080"
+            assert meta["__meta_puppetdb_tags"] == ",class,apache,"
+        finally:
+            srv.stop()

@@ -921,7 +921,6 @@ def openstack_sd(cfg: dict) -> list[tuple[str, dict]]:
     """OpenStack Nova instance discovery
     (lib/promscrape/discovery/openstack): keystone password auth for a
     token, then /servers/detail; role=hypervisor lists hypervisors."""
-    import urllib.request
     identity = cfg.get("identity_endpoint", "")
     if not identity:
         raise DiscoveryError("openstack_sd: identity_endpoint is required")
@@ -1065,6 +1064,295 @@ def digitalocean_sd(cfg: dict) -> list[tuple[str, dict]]:
         raise DiscoveryError(f"digitalocean_sd {server}: {e}") from e
 
 
+# -- consulagent (discovery/consulagent/) ------------------------------------
+
+def consulagent_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Consul local-agent discovery (lib/promscrape/discovery/
+    consulagent): /v1/agent/services + per-service health, no catalog."""
+    server = cfg.get("server", "localhost:8500")
+    if not server.startswith(("http://", "https://")):
+        server = "http://" + server
+    base = server.rstrip("/")
+    try:
+        node = _get_json(f"{base}/v1/agent/self") or {}
+        member = node.get("Member") or {}
+        node_name = member.get("Name", "")
+        dc = (node.get("Config") or {}).get("Datacenter", "")
+        services = _get_json(f"{base}/v1/agent/services") or {}
+        want = set(cfg.get("services") or [])
+        out: list[tuple[str, dict]] = []
+        for svc in services.values():
+            name = svc.get("Service", "")
+            if want and name not in want:
+                continue
+            addr = svc.get("Address") or member.get("Addr", "")
+            port = svc.get("Port", 0)
+            meta = {
+                "__meta_consulagent_address": member.get("Addr", ""),
+                "__meta_consulagent_dc": dc,
+                "__meta_consulagent_namespace":
+                    svc.get("Namespace", ""),
+                "__meta_consulagent_node": node_name,
+                "__meta_consulagent_service": name,
+                "__meta_consulagent_service_address": addr,
+                "__meta_consulagent_service_id": svc.get("ID", ""),
+                "__meta_consulagent_service_port": str(port),
+                "__meta_consulagent_tags":
+                    "," + ",".join(svc.get("Tags") or []) + ",",
+            }
+            for t in svc.get("Tags") or []:
+                meta[f"__meta_consulagent_tag_{_sanitize(t)}"] = t
+            for k, v in (svc.get("Meta") or {}).items():
+                meta["__meta_consulagent_service_metadata_"
+                     f"{_sanitize(k)}"] = str(v)
+            out.append((f"{addr}:{port}", meta))
+        return out
+    except (OSError, ValueError, KeyError) as e:
+        raise DiscoveryError(f"consulagent_sd {server}: {e}") from e
+
+
+# -- hetzner (discovery/hetzner/) --------------------------------------------
+
+def hetzner_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Hetzner Cloud discovery (lib/promscrape/discovery/hetzner,
+    role=hcloud): /v1/servers with bearer auth, paginated."""
+    role = cfg.get("role", "hcloud")
+    if role != "hcloud":
+        raise DiscoveryError(f"hetzner_sd: unsupported role {role!r}")
+    server = cfg.get("endpoint", "https://api.hetzner.cloud")
+    dport = int(cfg.get("port", 80))
+    headers = {}
+    if cfg.get("bearer_token"):
+        headers["Authorization"] = f"Bearer {cfg['bearer_token']}"
+    url = f"{server.rstrip('/')}/v1/servers?page=1&per_page=50"
+    out: list[tuple[str, dict]] = []
+    try:
+        # network id -> name (private_net entries carry numeric ids; the
+        # documented label shape uses the network NAME)
+        net_names = {}
+        try:
+            for nw in (_get_json(f"{server.rstrip('/')}/v1/networks",
+                                 headers=headers) or {}).get(
+                    "networks") or []:
+                net_names[nw.get("id")] = nw.get("name", "")
+        except (OSError, ValueError, KeyError):
+            pass  # label falls back to the id
+        while url:
+            data = _get_json(url, headers=headers)
+            for s in data.get("servers") or []:
+                pub = ((s.get("public_net") or {}).get("ipv4")
+                       or {}).get("ip", "")
+                dc = s.get("datacenter") or {}
+                loc = dc.get("location") or {}
+                stype = s.get("server_type") or {}
+                img = s.get("image") or {}
+                meta = {
+                    "__meta_hetzner_server_id": str(s.get("id", "")),
+                    "__meta_hetzner_server_name": s.get("name", ""),
+                    "__meta_hetzner_server_status": s.get("status", ""),
+                    "__meta_hetzner_public_ipv4": pub,
+                    "__meta_hetzner_datacenter": dc.get("name", ""),
+                    "__meta_hetzner_hcloud_datacenter_location":
+                        loc.get("name", ""),
+                    "__meta_hetzner_hcloud_datacenter_location_network_zone":
+                        loc.get("network_zone", ""),
+                    "__meta_hetzner_hcloud_server_type":
+                        stype.get("name", ""),
+                    "__meta_hetzner_hcloud_cpu_cores":
+                        str(stype.get("cores", "")),
+                    "__meta_hetzner_hcloud_cpu_type":
+                        stype.get("cpu_type", ""),
+                    "__meta_hetzner_hcloud_memory_size_gb":
+                        str(stype.get("memory", "")),
+                    "__meta_hetzner_hcloud_disk_size_gb":
+                        str(stype.get("disk", "")),
+                    "__meta_hetzner_hcloud_image_name":
+                        img.get("name", ""),
+                    "__meta_hetzner_hcloud_image_os_flavor":
+                        img.get("os_flavor", ""),
+                    "__meta_hetzner_hcloud_image_os_version":
+                        img.get("os_version", ""),
+                }
+                for k, v in (s.get("labels") or {}).items():
+                    meta[f"__meta_hetzner_hcloud_label_{_sanitize(k)}"] \
+                        = str(v)
+                    meta["__meta_hetzner_hcloud_labelpresent_"
+                         f"{_sanitize(k)}"] = "true"
+                for pn in (s.get("private_net") or []):
+                    ip = pn.get("ip", "")
+                    if ip:
+                        nid = pn.get("network", "")
+                        nname = net_names.get(nid, str(nid))
+                        meta.setdefault(
+                            "__meta_hetzner_hcloud_private_ipv4_"
+                            f"{_sanitize(str(nname))}", ip)
+                if pub:
+                    out.append((f"{pub}:{dport}", meta))
+            nxt = (((data.get("meta") or {}).get("pagination") or {})
+                   .get("next_page"))
+            url = (f"{server.rstrip('/')}/v1/servers?page={nxt}"
+                   f"&per_page=50") if nxt else ""
+        return out
+    except (OSError, ValueError, KeyError) as e:
+        raise DiscoveryError(f"hetzner_sd {server}: {e}") from e
+
+
+# -- vultr (discovery/vultr/) ------------------------------------------------
+
+def vultr_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Vultr instance discovery (lib/promscrape/discovery/vultr):
+    /v2/instances with bearer auth, cursor-paginated."""
+    server = cfg.get("endpoint", "https://api.vultr.com")
+    dport = int(cfg.get("port", 80))
+    headers = {}
+    if cfg.get("bearer_token"):
+        headers["Authorization"] = f"Bearer {cfg['bearer_token']}"
+    url = f"{server.rstrip('/')}/v2/instances?per_page=100"
+    out: list[tuple[str, dict]] = []
+    try:
+        while url:
+            data = _get_json(url, headers=headers)
+            for inst in data.get("instances") or []:
+                ip = inst.get("main_ip", "")
+                if not ip:
+                    continue
+                meta = {
+                    "__meta_vultr_instance_id": inst.get("id", ""),
+                    "__meta_vultr_instance_label": inst.get("label", ""),
+                    "__meta_vultr_instance_hostname":
+                        inst.get("hostname", ""),
+                    "__meta_vultr_instance_os": inst.get("os", ""),
+                    "__meta_vultr_instance_os_id":
+                        str(inst.get("os_id", "")),
+                    "__meta_vultr_instance_region":
+                        inst.get("region", ""),
+                    "__meta_vultr_instance_plan": inst.get("plan", ""),
+                    "__meta_vultr_instance_main_ip": ip,
+                    "__meta_vultr_instance_internal_ip":
+                        inst.get("internal_ip", ""),
+                    "__meta_vultr_instance_main_ipv6":
+                        inst.get("v6_main_ip", ""),
+                    "__meta_vultr_instance_server_status":
+                        inst.get("server_status", ""),
+                    "__meta_vultr_instance_vcpu_count":
+                        str(inst.get("vcpu_count", "")),
+                    "__meta_vultr_instance_ram_mb":
+                        str(inst.get("ram", "")),
+                    "__meta_vultr_instance_disk_gb":
+                        str(inst.get("disk", "")),
+                    "__meta_vultr_instance_allowed_bandwidth_gb":
+                        str(inst.get("allowed_bandwidth", "")),
+                    "__meta_vultr_instance_features":
+                        "," + ",".join(inst.get("features") or []) + ",",
+                    "__meta_vultr_instance_tags":
+                        "," + ",".join(inst.get("tags") or []) + ",",
+                }
+                out.append((f"{ip}:{dport}", meta))
+            cursor = (((data.get("meta") or {}).get("links") or {})
+                      .get("next", ""))
+            import urllib.parse as _up
+            url = (f"{server.rstrip('/')}/v2/instances?per_page=100"
+                   f"&cursor={_up.quote(cursor, safe='')}") \
+                if cursor else ""
+        return out
+    except (OSError, ValueError, KeyError) as e:
+        raise DiscoveryError(f"vultr_sd {server}: {e}") from e
+
+
+# -- marathon (discovery/marathon/) ------------------------------------------
+
+def marathon_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Marathon app/task discovery (lib/promscrape/discovery/marathon):
+    /v2/apps?embed=apps.tasks, one target per task port."""
+    servers = cfg.get("servers") or ["http://localhost:8080"]
+    data = None
+    errs = []
+    for srv_url in servers:  # try each configured server (failover)
+        base = srv_url.rstrip("/")
+        try:
+            data = _get_json(f"{base}/v2/apps?embed=apps.tasks")
+            break
+        except (OSError, ValueError) as e:
+            errs.append(f"{base}: {e}")
+    if data is None:
+        raise DiscoveryError(f"marathon_sd: all servers failed: "
+                             f"{'; '.join(errs)}")
+    try:
+        out: list[tuple[str, dict]] = []
+        for app in (data.get("apps") or []):
+            app_id = app.get("id", "")
+            labels_app = app.get("labels") or {}
+            container = app.get("container") or {}
+            image = (container.get("docker") or {}).get("image", "")
+            port_defs = app.get("portDefinitions") or []
+            for task in app.get("tasks") or []:
+                host = task.get("host", "")
+                ports = task.get("ports") or []
+                for pi, port in enumerate(ports):
+                    meta = {
+                        "__meta_marathon_app": app_id,
+                        "__meta_marathon_task": task.get("id", ""),
+                        "__meta_marathon_image": image,
+                        "__meta_marathon_port_index": str(pi),
+                    }
+                    for k, v in labels_app.items():
+                        meta[f"__meta_marathon_app_label_{_sanitize(k)}"] \
+                            = str(v)
+                    if pi < len(port_defs):
+                        for k, v in (port_defs[pi].get("labels")
+                                     or {}).items():
+                            meta["__meta_marathon_port_definition_label_"
+                                 f"{_sanitize(k)}"] = str(v)
+                    out.append((f"{host}:{port}", meta))
+        return out
+    except (ValueError, KeyError) as e:
+        raise DiscoveryError(f"marathon_sd {base}: {e}") from e
+
+
+# -- puppetdb (discovery/puppetdb/) ------------------------------------------
+
+def puppetdb_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """PuppetDB resource discovery (lib/promscrape/discovery/puppetdb):
+    POST a PQL query to /pdb/query/v4, one target per resource."""
+    url = cfg.get("url", "")
+    query = cfg.get("query", "")
+    if not url or not query:
+        raise DiscoveryError("puppetdb_sd: url and query are required")
+    dport = int(cfg.get("port", 80))
+    include_params = bool(cfg.get("include_parameters"))
+    try:
+        req = urllib.request.Request(
+            f"{url.rstrip('/')}/pdb/query/v4",
+            data=json.dumps({"query": query}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resources = json.loads(resp.read())
+        out: list[tuple[str, dict]] = []
+        for r in resources or []:
+            certname = r.get("certname", "")
+            if not certname:
+                continue
+            meta = {
+                "__meta_puppetdb_certname": certname,
+                "__meta_puppetdb_environment": r.get("environment", ""),
+                "__meta_puppetdb_exported":
+                    str(bool(r.get("exported"))).lower(),
+                "__meta_puppetdb_file": r.get("file", "") or "",
+                "__meta_puppetdb_query": query,
+                "__meta_puppetdb_resource": r.get("resource", ""),
+                "__meta_puppetdb_tags":
+                    "," + ",".join(r.get("tags") or []) + ",",
+            }
+            if include_params:
+                for k, v in (r.get("parameters") or {}).items():
+                    meta[f"__meta_puppetdb_parameter_{_sanitize(k)}"] = \
+                        str(v)
+            out.append((f"{certname}:{dport}", meta))
+        return out
+    except (OSError, ValueError, KeyError) as e:
+        raise DiscoveryError(f"puppetdb_sd {url}: {e}") from e
+
+
 PROVIDERS = {
     "kubernetes_sd_configs": kubernetes_sd,
     "consul_sd_configs": consul_sd,
@@ -1079,6 +1367,11 @@ PROVIDERS = {
     "eureka_sd_configs": eureka_sd,
     "openstack_sd_configs": openstack_sd,
     "digitalocean_sd_configs": digitalocean_sd,
+    "consulagent_sd_configs": consulagent_sd,
+    "hetzner_sd_configs": hetzner_sd,
+    "vultr_sd_configs": vultr_sd,
+    "marathon_sd_configs": marathon_sd,
+    "puppetdb_sd_configs": puppetdb_sd,
 }
 
 
