@@ -60,16 +60,15 @@ STEP = 60_000
 REFRESHES = 6
 
 
-def _finish_provision(probe_handle):
+def _finish_provision(probe_handle, probe_timeout: float):
     """Resolve the in-flight accelerator probe and build the device
     engine. Returns (engine, backend_label, probe_info). NEVER silent:
     every degradation prints its reason to stderr, and a failed probe's
     outcome (including the hung subprocess's last faulthandler stack)
     lands in probe_info for the JSON artifact."""
     res = probe_handle.result()
-    probe_info = {"timeout_s": float(
-        os.environ.get("VM_TPU_PROBE_TIMEOUT_S", "450")),
-        "elapsed_s": round(res.elapsed_s, 1)}
+    probe_info = {"timeout_s": probe_timeout,
+                  "elapsed_s": round(res.elapsed_s, 1)}
     if res.error is not None:
         probe_info["error"] = res.error
         if res.stack:
@@ -107,6 +106,17 @@ def _finish_provision(probe_handle):
               file=sys.stderr)
         probe_info["engine_error"] = repr(e)
         return None, f"host-only:{type(e).__name__}", probe_info
+
+
+def _assert_rows_equal(a, b) -> None:
+    """Served (cached) rows must be bit-identical to a cold eval
+    (equal_nan covers NaN==NaN; infinities compare exactly)."""
+    da = {ts.metric_name.marshal(): ts.values for ts in a}
+    db = {ts.metric_name.marshal(): ts.values for ts in b}
+    assert set(da) == set(db), (len(da), len(db))
+    for k, va in da.items():
+        assert np.array_equal(va, db[k], equal_nan=True), \
+            "served result diverged from cold evaluation"
 
 
 def main() -> None:
@@ -178,7 +188,8 @@ def main() -> None:
 
         # resolve the probe that ran during ingest; build the device
         # engine ONLY if the probe proved the backend healthy
-        tpu, backend_label, probe_info = _finish_provision(probe_handle)
+        tpu, backend_label, probe_info = _finish_provision(probe_handle,
+                                                           probe_timeout)
         q = "sum by (instance)(rate(http_requests_total[5m]))"
         duration = (N_SAMPLES - 1) * 15_000 - 300_000
         # logical scan size of one window (series x fetch-range samples)
@@ -198,9 +209,20 @@ def main() -> None:
         results = {}
         traces = {}
         end0 = t_start + (N_SAMPLES - 1) * 15_000 // STEP * STEP
+        from victoriametrics_tpu.httpapi.prometheus_api import PrometheusAPI
         for backend, engine in (("device", tpu), ("host-batch", None)):
             if backend == "device" and engine is None:
                 continue
+            # the result cache is process-global and NOT backend-keyed:
+            # reset between legs so the host leg can't serve (or be
+            # timed against) device-seeded entries
+            from victoriametrics_tpu.query.rollup_result_cache import \
+                GLOBAL as _rcache
+            _rcache.reset()
+            # steady-state refreshes go through the SAME cached executor
+            # the HTTP layer serves (result-cache tail merge + full eval
+            # stack) — this is the path a dashboard actually pays
+            api = PrometheusAPI(s, engine)
             start = end0 - duration
             kw = dict(step=STEP, storage=s, tpu=engine)
             # cold: full fetch+decode+compute, result caches off, jit
@@ -214,8 +236,9 @@ def main() -> None:
             traces[backend + "-cold"] = tr.to_dict()
             assert len(rows) == N_INSTANCES, len(rows)
             # warm-up with caches on: builds the rolling tile / seeds the
-            # eval cache
-            exec_query(EvalConfig(start=start, end=end0, **kw), q)
+            # result + eval caches
+            api._exec_range_cached(EvalConfig(start=start, end=end0, **kw),
+                                   q, end0)
             # steady-state: live ingest + window advance per refresh
             lat = []
             end = end0
@@ -225,11 +248,17 @@ def main() -> None:
                 ingest_fresh(end)
                 tr = Tracer(True)
                 t0 = time.perf_counter()
-                rows = exec_query(EvalConfig(start=start, end=end, **kw,
-                                             tracer=tr), q)
+                rows = api._exec_range_cached(
+                    EvalConfig(start=start, end=end, **kw, tracer=tr), q,
+                    end)
                 lat.append(time.perf_counter() - t0)
                 assert len(rows) == N_INSTANCES, len(rows)
             traces[backend + "-steady"] = tr.to_dict()
+            # honesty check: the served refresh must equal a cold
+            # (nocache) evaluation of the same window bit-for-bit
+            cold_rows = exec_query(EvalConfig(start=start, end=end, **kw,
+                                              disable_cache=True), q)
+            _assert_rows_equal(rows, cold_rows)
             results[backend] = (float(np.median(lat)), cold_dt)
             end0 = end  # the next backend continues on the grown storage
 

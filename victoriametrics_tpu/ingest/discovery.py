@@ -1068,7 +1068,10 @@ def digitalocean_sd(cfg: dict) -> list[tuple[str, dict]]:
 
 def consulagent_sd(cfg: dict) -> list[tuple[str, dict]]:
     """Consul local-agent discovery (lib/promscrape/discovery/
-    consulagent): /v1/agent/services + per-service health, no catalog."""
+    consulagent): /v1/agent/self + /v1/agent/services — the agent's own
+    registrations, no catalog and no health filtering (services in
+    critical state are still emitted; relabel on the health metadata if
+    you need to drop them)."""
     server = cfg.get("server", "localhost:8500")
     if not server.startswith(("http://", "https://")):
         server = "http://" + server
@@ -1128,13 +1131,19 @@ def hetzner_sd(cfg: dict) -> list[tuple[str, dict]]:
     out: list[tuple[str, dict]] = []
     try:
         # network id -> name (private_net entries carry numeric ids; the
-        # documented label shape uses the network NAME)
+        # documented label shape uses the network NAME); paginated like
+        # /v1/servers
         net_names = {}
         try:
-            for nw in (_get_json(f"{server.rstrip('/')}/v1/networks",
-                                 headers=headers) or {}).get(
-                    "networks") or []:
-                net_names[nw.get("id")] = nw.get("name", "")
+            nurl = f"{server.rstrip('/')}/v1/networks?page=1&per_page=50"
+            while nurl:
+                ndata = _get_json(nurl, headers=headers) or {}
+                for nw in ndata.get("networks") or []:
+                    net_names[nw.get("id")] = nw.get("name", "")
+                nxt = (((ndata.get("meta") or {}).get("pagination") or {})
+                       .get("next_page"))
+                nurl = (f"{server.rstrip('/')}/v1/networks?page={nxt}"
+                        f"&per_page=50") if nxt else ""
         except (OSError, ValueError, KeyError):
             pass  # label falls back to the id
         while url:
