@@ -507,6 +507,9 @@ class PrometheusAPI:
                              new_start)
             sub = ec.child(start=new_start)
             sub.tracer = ec.tracer
+            # the device rolling tail-reuse must not layer under this
+            # cache's own tail merge (see EvalConfig.no_device_roll)
+            sub.no_device_roll = True
             fresh = exec_query(sub, q)
             # trust_raw=False: these are POST-transform rows — in-place
             # label edits (multi-output rollups, label_set, binop

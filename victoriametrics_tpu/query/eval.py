@@ -825,6 +825,8 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
     # aggregate crosses the link)
     aux_key = None
     ver = getattr(ec.storage, "data_version", None)
+    if ec.no_device_roll:  # result-cache suffix eval: fresh tiles only
+        ver = None         # (see EvalConfig.no_device_roll)
     if ec.disable_cache:  # nocache=1 / -search.disableCache bypasses every
         ver = None        # resident-tile reuse path (aux, rolling) too
     if ver is not None:
@@ -927,7 +929,10 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                         and oc["window"] == lookback
                         and start >= oc["start"] and end >= oc["end"]
                         and (start - oc["start"]) % ec.step == 0
-                        and (end - oc["end"]) % ec.step == 0):
+                        # constant grid shape only: the designed sliding-
+                        # dashboard advance. Variable-length grids (e.g.
+                        # suffix evals, narrowed ranges) recompute fresh
+                        and (start - oc["start"]) == (end - oc["end"])):
                     shift_cols = (start - oc["start"]) // ec.step
                     keep = oc["out"].shape[1] - shift_cols
                     n_new = T_cols - keep

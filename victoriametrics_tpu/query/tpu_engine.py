@@ -631,6 +631,13 @@ class RollingTile:
     rollup_result_cache.go:283 — here the TILE is the cache and new blocks
     append into reserved column headroom).
 
+    Accuracy contract: the tail kernel's estimate-dependent prev-sample
+    gating can drift vs a cold fresh-tile eval by up to ~one gated
+    sample's increase per window under jittered scrape intervals
+    (bounded in tests/test_served_device_path.py; the reference's cached
+    columns drift the same way). Paths that need cold-exact results
+    (the HTTP result cache's suffix eval) set EvalConfig.no_device_roll.
+
     Shared per selector across every fused query shape over it (sum/avg/...
     states reference the same RollingTile, so one append serves them all).
     The append DONATES the old device buffers; anything else holding them

@@ -49,6 +49,15 @@ class EvalConfig:
     # write the eval rollup cache under its parent's key, but MAY still use
     # the device tile reuse paths (unlike user-facing disable_cache)
     no_eval_cache: bool = False
+    # internal: disable the device ROLLING/aux tile-reuse shortcuts while
+    # keeping fresh device compute. Set by the HTTP result cache's suffix
+    # eval: its VARIABLE-LENGTH suffix grids confuse the rolling tail
+    # reuse (observed ~35% rate error on reused columns), while the
+    # constant-shape advance direct dashboards produce is correct (both
+    # patterns are pinned by tests/test_served_device_path.py). Cost of
+    # the flag: the first full eval's rolling tile stays resident in the
+    # (bounded, LRU) device caches unused once the suffix path takes over.
+    no_device_roll: bool = False
     tracer: object = None      # querytracer.Tracer | NOP (set in __post_init__)
     tpu: object = None         # TPUEngine when the device path is enabled
     _grid: np.ndarray | None = None
@@ -93,6 +102,7 @@ class EvalConfig:
                  deadline=self.deadline, tenant=self.tenant,
                  disable_cache=self.disable_cache,
                  no_eval_cache=self.no_eval_cache,
+                 no_device_roll=self.no_device_roll,
                  tracer=self.tracer, tpu=self.tpu,
                  _samples_scanned=self._samples_scanned,
                  _partial=self._partial)
