@@ -8,13 +8,15 @@ assembly -> device tiles -> fused rollup+aggregation.
 
 Headline = STEADY-STATE serving rate for the realistic dashboard loop: the
 window advances one step per refresh while live ingest appends new scrapes
-between refreshes. This is the path production serving actually pays — the
-engine's rolling HBM tiles absorb only the new samples per refresh (device
-scatter + traced grid shift; no re-fetch, no re-upload, no recompile), the
-host backend leans on the eval rollup cache's tail merge. Neither backend
-can serve a pure result-cache hit: every refresh sees new bounds AND new
-data. Cold (nocache first query, incl. jit compile) and ingest rates are
-reported inside the metric label.
+between refreshes, and every refresh goes through the SAME cached range
+executor the HTTP layer serves (result-cache tail merge over the full
+eval stack). Each refresh therefore computes only the uncovered suffix —
+fetch, rollup, aggregation — and merges it onto the cached prefix; a
+built-in assert proves the served rows equal a cold nocache evaluation
+(bit-for-bit on the f64 host path, within the f32 tile bound on device).
+Neither backend can serve a pure cache hit: every refresh sees new bounds
+AND new data. Cold (nocache first query, incl. jit compile) and ingest
+rates are reported inside the metric label.
 
 Backend policy — LOUD, never silent: the accelerator is probed in a
 subprocess with a hard deadline (utils/tpu_probe.py) before any in-process
