@@ -364,3 +364,116 @@ class TestPuppetdbSD:
             assert meta["__meta_puppetdb_tags"] == ",class,apache,"
         finally:
             srv.stop()
+
+
+class TestOvhcloudSD:
+    def test_vps_with_signature(self):
+        seen_headers = []
+        srv = HTTPServer("127.0.0.1", 0)
+        srv.route("/1.0/auth/time", lambda r: Response.json(1_700_000_000))
+
+        def vps_list(r):
+            seen_headers.append({k.lower(): v
+                                 for k, v in r.headers.items()})
+            return Response.json(["vps-a1.vps.ovh.net"])
+        srv.route("/1.0/vps", vps_list)
+        srv.route("/1.0/vps/vps-a1.vps.ovh.net", lambda r: Response.json({
+            "name": "vps-a1.vps.ovh.net", "displayName": "my-vps",
+            "cluster": "cluster_021", "state": "running", "zone": "zone",
+            "memoryLimit": 2048,
+            "model": {"name": "vps-starter", "disk": 20, "memory": 2048,
+                      "vcore": 1, "maximumAdditionnalIp": 16,
+                      "version": "2019v1"}}))
+        srv.route("/1.0/vps/vps-a1.vps.ovh.net/ips",
+                  lambda r: Response.json(["139.99.1.2", "2001:41d0::1"]))
+        srv.start()
+        try:
+            out = discovery.ovhcloud_sd({
+                "endpoint": f"http://127.0.0.1:{srv.port}/1.0",
+                "application_key": "ak", "application_secret": "as",
+                "consumer_key": "ck", "port": 9100})
+            assert out[0][0] == "139.99.1.2:9100"
+            meta = out[0][1]
+            assert meta["__meta_ovhcloud_vps_model_name"] == "vps-starter"
+            assert meta["__meta_ovhcloud_vps_ipv4"] == "139.99.1.2"
+            assert meta["__meta_ovhcloud_vps_ipv6"] == "2001:41d0::1"
+            h = seen_headers[0]
+            assert h.get("x-ovh-application") == "ak"
+            assert h.get("x-ovh-consumer") == "ck"
+            assert h.get("x-ovh-signature", "").startswith("$1$")
+            # signature reproducible from the documented formula
+            import hashlib
+            url = f"http://127.0.0.1:{srv.port}/1.0/vps"
+            ts = h["x-ovh-timestamp"]
+            want = hashlib.sha1(
+                f"as+ck+GET+{url}++{ts}".encode()).hexdigest()
+            assert h["x-ovh-signature"] == f"$1${want}"
+        finally:
+            srv.stop()
+
+    def test_dedicated_server(self):
+        srv = _srv({
+            "/1.0/auth/time": 1_700_000_000,
+            "/1.0/dedicated/server": ["ns1.ip-1-2-3.eu"],
+            "/1.0/dedicated/server/ns1.ip-1-2-3.eu": {
+                "name": "ns1.ip-1-2-3.eu", "serverId": 42,
+                "state": "ok", "os": "debian12", "datacenter": "gra1",
+                "rack": "R01", "reverse": "ns1.ip-1-2-3.eu",
+                "commercialRange": "rise-1", "linkSpeed": 1000,
+                "supportLevel": "pro", "noIntervention": False},
+            "/1.0/dedicated/server/ns1.ip-1-2-3.eu/ips":
+                ["1.2.3.4/32", "2001:41d0:2::1/64"],
+        })
+        try:
+            out = discovery.ovhcloud_sd({
+                "endpoint": f"http://127.0.0.1:{srv.port}/1.0",
+                "service": "dedicated_server"})
+            assert out[0][0] == "1.2.3.4:80"
+            meta = out[0][1]
+            assert meta["__meta_ovhcloud_dedicated_server_datacenter"] \
+                == "gra1"
+            assert meta["__meta_ovhcloud_dedicated_server_ipv4"] \
+                == "1.2.3.4"
+            assert meta["__meta_ovhcloud_dedicated_server_"
+                        "no_intervention"] == "false"
+        finally:
+            srv.stop()
+
+
+class TestYandexcloudSD:
+    def test_instances(self):
+        srv = _srv({
+            "/resource-manager/v1/clouds": {"clouds": [{"id": "c1"}]},
+            "/resource-manager/v1/folders": {"folders": [{"id": "f1"}]},
+            "/compute/v1/instances": {"instances": [{
+                "id": "i1", "name": "web-1", "fqdn": "web-1.internal",
+                "status": "RUNNING", "platformId": "standard-v3",
+                "labels": {"env": "prod"},
+                "resources": {"cores": "2", "memory": "4294967296",
+                              "coreFraction": "100"},
+                "networkInterfaces": [{
+                    "primaryV4Address": {
+                        "address": "10.128.0.5",
+                        "oneToOneNat": {"address": "84.201.1.2"},
+                        "dnsRecords": [{"fqdn": "web-1.ru-central1"}]}}],
+            }]},
+        })
+        try:
+            out = discovery.yandexcloud_sd({
+                "api_endpoint": f"http://127.0.0.1:{srv.port}",
+                "iam_token": "tk", "port": 9100})
+            assert out[0][0] == "10.128.0.5:9100"
+            meta = out[0][1]
+            assert meta["__meta_yandexcloud_folder_id"] == "f1"
+            assert meta["__meta_yandexcloud_instance_label_env"] == "prod"
+            assert meta["__meta_yandexcloud_instance_private_ip_0"] \
+                == "10.128.0.5"
+            assert meta["__meta_yandexcloud_instance_public_ip_0"] \
+                == "84.201.1.2"
+            # prefer_public_ip switches the target address
+            out2 = discovery.yandexcloud_sd({
+                "api_endpoint": f"http://127.0.0.1:{srv.port}",
+                "iam_token": "tk", "prefer_public_ip": True})
+            assert out2[0][0] == "84.201.1.2:80"
+        finally:
+            srv.stop()

@@ -1362,6 +1362,226 @@ def puppetdb_sd(cfg: dict) -> list[tuple[str, dict]]:
         raise DiscoveryError(f"puppetdb_sd {url}: {e}") from e
 
 
+# -- ovhcloud (discovery/ovhcloud/) ------------------------------------------
+
+def _ovh_get(cfg: dict, endpoint: str, path: str, _delta_memo={}):
+    """Signed OVH API GET (discovery/ovhcloud/common.go): signature =
+    "$1$" + sha1(AS+CK+method+url+body+timestamp). The server/local
+    clock delta is fetched once per endpoint and reused (the official
+    client does the same); a failed /auth/time is LOUD — local time
+    would just produce mysterious 403s on skewed hosts."""
+    import hashlib
+    import time as _time
+    app_key = cfg.get("application_key", "")
+    app_secret = cfg.get("application_secret", "")
+    consumer = cfg.get("consumer_key", "")
+    delta = _delta_memo.get(endpoint)
+    if delta is None:
+        try:
+            delta = int(_get_json(f"{endpoint}/auth/time")) - \
+                int(_time.time())
+        except (OSError, ValueError, TypeError) as e:
+            raise DiscoveryError(
+                f"ovhcloud: cannot fetch {endpoint}/auth/time for "
+                f"request signing: {e}") from e
+        _delta_memo[endpoint] = delta
+    ts = int(_time.time()) + delta
+    url = endpoint + path
+    sig = hashlib.sha1(
+        f"{app_secret}+{consumer}+GET+{url}++{ts}".encode()).hexdigest()
+    return _get_json(url, headers={
+        "X-Ovh-Application": app_key,
+        "X-Ovh-Consumer": consumer,
+        "X-Ovh-Timestamp": str(ts),
+        "X-Ovh-Signature": f"$1${sig}",
+        "Accept": "application/json"})
+
+
+def ovhcloud_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """OVHcloud discovery (lib/promscrape/discovery/ovhcloud): roles
+    vps (default) and dedicated_server, per-name detail + /ips calls."""
+    import urllib.parse as _up
+    endpoint = cfg.get("endpoint", "https://eu.api.ovh.com/1.0")
+    role = cfg.get("service", cfg.get("role", "vps"))
+    if role not in ("vps", "dedicated_server"):
+        raise DiscoveryError(
+            f"ovhcloud_sd: unknown service {role!r} "
+            "(want `vps` or `dedicated_server`)")
+    dport = int(cfg.get("port", 80))
+    out: list[tuple[str, dict]] = []
+    try:
+        if role == "dedicated_server":
+            for name in _ovh_get(cfg, endpoint, "/dedicated/server") or []:
+                qn = _up.quote(name, safe="")
+                d = _ovh_get(cfg, endpoint, f"/dedicated/server/{qn}")
+                ips = _ovh_get(cfg, endpoint,
+                               f"/dedicated/server/{qn}/ips") or []
+                v4 = next((ip for ip in ips if ":" not in ip), "")
+                v6 = next((ip for ip in ips if ":" in ip), "")
+                meta = {
+                    "__meta_ovhcloud_dedicated_server_name":
+                        d.get("name", name),
+                    "__meta_ovhcloud_dedicated_server_server_id":
+                        str(d.get("serverId", "")),
+                    "__meta_ovhcloud_dedicated_server_state":
+                        d.get("state", ""),
+                    "__meta_ovhcloud_dedicated_server_os":
+                        d.get("os", ""),
+                    "__meta_ovhcloud_dedicated_server_datacenter":
+                        d.get("datacenter", ""),
+                    "__meta_ovhcloud_dedicated_server_rack":
+                        d.get("rack", ""),
+                    "__meta_ovhcloud_dedicated_server_reverse":
+                        d.get("reverse", ""),
+                    "__meta_ovhcloud_dedicated_server_commercial_range":
+                        d.get("commercialRange", ""),
+                    "__meta_ovhcloud_dedicated_server_link_speed":
+                        str(d.get("linkSpeed", "")),
+                    "__meta_ovhcloud_dedicated_server_support_level":
+                        d.get("supportLevel", ""),
+                    "__meta_ovhcloud_dedicated_server_no_intervention":
+                        str(bool(d.get("noIntervention"))).lower(),
+                    "__meta_ovhcloud_dedicated_server_ipv4": v4.split(
+                        "/")[0],
+                    "__meta_ovhcloud_dedicated_server_ipv6": v6.split(
+                        "/")[0],
+                }
+                addr = v4.split("/")[0] or d.get("reverse", name)
+                out.append((f"{addr}:{dport}", meta))
+            return out
+        for name in _ovh_get(cfg, endpoint, "/vps") or []:
+            qn = _up.quote(name, safe="")
+            d = _ovh_get(cfg, endpoint, f"/vps/{qn}")
+            ips = _ovh_get(cfg, endpoint, f"/vps/{qn}/ips") or []
+            v4 = next((ip for ip in ips if ":" not in ip), "")
+            v6 = next((ip for ip in ips if ":" in ip), "")
+            model = d.get("model") or {}
+            meta = {
+                "__meta_ovhcloud_vps_name": d.get("name", name),
+                "__meta_ovhcloud_vps_display_name":
+                    d.get("displayName", ""),
+                "__meta_ovhcloud_vps_cluster": d.get("cluster", ""),
+                "__meta_ovhcloud_vps_state": d.get("state", ""),
+                "__meta_ovhcloud_vps_zone": d.get("zone", ""),
+                "__meta_ovhcloud_vps_datacenter":
+                    str(d.get("datacenter", "")),
+                "__meta_ovhcloud_vps_disk": str(model.get("disk", "")),
+                "__meta_ovhcloud_vps_memory_limit":
+                    str(d.get("memoryLimit", "")),
+                "__meta_ovhcloud_vps_memory":
+                    str(model.get("memory", "")),
+                "__meta_ovhcloud_vps_model_name":
+                    model.get("name", ""),
+                "__meta_ovhcloud_vps_model_vcore":
+                    str(model.get("vcore", "")),
+                "__meta_ovhcloud_vps_maximum_additional_ip":
+                    str(model.get("maximumAdditionnalIp", "")),
+                "__meta_ovhcloud_vps_version": str(model.get(
+                    "version", "")),
+                "__meta_ovhcloud_vps_ipv4": v4.split("/")[0],
+                "__meta_ovhcloud_vps_ipv6": v6.split("/")[0],
+            }
+            addr = v4.split("/")[0] or name
+            out.append((f"{addr}:{dport}", meta))
+        return out
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise DiscoveryError(f"ovhcloud_sd {endpoint}: {e}") from e
+
+
+# -- yandexcloud (discovery/yandexcloud/) ------------------------------------
+
+def yandexcloud_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Yandex Cloud compute discovery
+    (lib/promscrape/discovery/yandexcloud): IAM-token auth, then
+    clouds -> folders -> instances; one target per instance with
+    per-interface ip/dns labels."""
+    api = cfg.get("api_endpoint", "https://api.cloud.yandex.net") \
+        .rstrip("/")
+    dport = int(cfg.get("port", 80))
+    token = cfg.get("iam_token", "")
+    try:
+        if not token:
+            md = _get_json(
+                "http://169.254.169.254/computeMetadata/v1/instance/"
+                "service-accounts/default/token",
+                headers={"Metadata-Flavor": "Google"})
+            token = md.get("access_token", "")
+        hdrs = {"Authorization": f"Bearer {token}"}
+
+        def paged(url: str, key: str):
+            """Follow nextPageToken like every other paginated provider
+            here."""
+            sep = "&" if "?" in url else "?"
+            page = ""
+            while True:
+                got = _get_json(url + (f"{sep}pageToken={page}" if page
+                                       else ""), headers=hdrs) or {}
+                yield from got.get(key) or []
+                page = got.get("nextPageToken", "")
+                if not page:
+                    return
+
+        folders = []
+        for cloud in paged(f"{api}/resource-manager/v1/clouds", "clouds"):
+            folders.extend(paged(
+                f"{api}/resource-manager/v1/folders?cloudId="
+                f"{cloud.get('id', '')}", "folders"))
+        out: list[tuple[str, dict]] = []
+        for folder in folders:
+            fid = folder.get("id", "")
+            for inst in paged(
+                    f"{api}/compute/v1/instances?folderId={fid}",
+                    "instances"):
+                res = inst.get("resources") or {}
+                meta = {
+                    "__meta_yandexcloud_instance_id": inst.get("id", ""),
+                    "__meta_yandexcloud_instance_name":
+                        inst.get("name", ""),
+                    "__meta_yandexcloud_instance_fqdn":
+                        inst.get("fqdn", ""),
+                    "__meta_yandexcloud_instance_status":
+                        inst.get("status", ""),
+                    "__meta_yandexcloud_instance_platform_id":
+                        inst.get("platformId", ""),
+                    "__meta_yandexcloud_folder_id": fid,
+                    "__meta_yandexcloud_instance_resources_cores":
+                        str(res.get("cores", "")),
+                    "__meta_yandexcloud_instance_resources_core_fraction":
+                        str(res.get("coreFraction", "")),
+                    "__meta_yandexcloud_instance_resources_memory":
+                        str(res.get("memory", "")),
+                }
+                for k, v in (inst.get("labels") or {}).items():
+                    meta["__meta_yandexcloud_instance_label_"
+                         f"{_sanitize(k)}"] = str(v)
+                addr = ""
+                for i, nic in enumerate(
+                        inst.get("networkInterfaces") or []):
+                    v4 = nic.get("primaryV4Address") or {}
+                    priv = v4.get("address", "")
+                    if priv:
+                        meta[f"__meta_yandexcloud_instance_private_ip_"
+                             f"{i}"] = priv
+                        addr = addr or priv
+                    nat = (v4.get("oneToOneNat") or {}).get("address", "")
+                    if nat:
+                        meta[f"__meta_yandexcloud_instance_public_ip_"
+                             f"{i}"] = nat
+                        if cfg.get("prefer_public_ip"):
+                            addr = nat
+                    for di, rec in enumerate(
+                            v4.get("dnsRecords") or []):
+                        meta[f"__meta_yandexcloud_instance_private_dns_"
+                             f"{di}"] = rec.get("fqdn", "")
+                if not addr:
+                    addr = inst.get("fqdn", "")
+                if addr:
+                    out.append((f"{addr}:{dport}", meta))
+        return out
+    except (OSError, ValueError, KeyError) as e:
+        raise DiscoveryError(f"yandexcloud_sd {api}: {e}") from e
+
+
 PROVIDERS = {
     "kubernetes_sd_configs": kubernetes_sd,
     "consul_sd_configs": consul_sd,
@@ -1381,6 +1601,8 @@ PROVIDERS = {
     "vultr_sd_configs": vultr_sd,
     "marathon_sd_configs": marathon_sd,
     "puppetdb_sd_configs": puppetdb_sd,
+    "ovhcloud_sd_configs": ovhcloud_sd,
+    "yandexcloud_sd_configs": yandexcloud_sd,
 }
 
 
