@@ -189,6 +189,27 @@ class GraphiteAPI:
 
     def _find_nodes(self, query: str, tenant=(0, 0)):
         """(text, full_path, is_leaf) nodes one level below the glob."""
+        # the common tree-expansion shape ("*" / "prefix.*") pushes down
+        # to the storage's path-suffix index (tagValueSuffixes — on a
+        # cluster that is one fanned-out RPC instead of pulling every
+        # metric name)
+        sfx_fn = getattr(self.storage, "tag_value_suffixes", None)
+        m = re.fullmatch(r"((?:[^*{}\[\]]+\.)?)\*", query)
+        if sfx_fn is not None and m:
+            prefix = m.group(1)
+            merged: dict[str, list] = {}
+            for s in sfx_fn("__name__", prefix, ".", tenant=tenant):
+                kids = s.endswith(".")
+                text = s[:-1] if kids else s
+                if not text:
+                    continue
+                e = merged.setdefault(text, [False, False])
+                if kids:
+                    e[1] = True
+                else:
+                    e[0] = True
+            return [(text, prefix + text, leaf, kids)
+                    for text, (leaf, kids) in sorted(merged.items())]
         depth = query.count(".") + 1
         rx = re.compile("^" + _glob_to_regex(query))
         # path -> [is_leaf, has_children]: a path can be both a metric and
