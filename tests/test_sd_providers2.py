@@ -477,3 +477,41 @@ class TestYandexcloudSD:
             assert out2[0][0] == "84.201.1.2:80"
         finally:
             srv.stop()
+
+
+class TestKumaSD:
+    def test_monitoring_assignments(self):
+        from victoriametrics_tpu.httpapi.server import HTTPServer, Response
+        seen = []
+        srv = HTTPServer("127.0.0.1", 0)
+
+        def h(r):
+            import json as _j
+            seen.append(_j.loads(r.body))
+            return Response.json({
+                "version_info": "v1",
+                "resources": [{
+                    "mesh": "default", "service": "backend",
+                    "labels": {"team": "core"},
+                    "targets": [{
+                        "name": "backend-01", "address": "10.7.0.2:5670",
+                        "scheme": "http", "metrics_path": "/metrics",
+                        "labels": {"kuma.io/protocol": "http"}}]}],
+                "nonce": "n1"})
+        srv.route("/v3/discovery:monitoringassignments", h)
+        srv.start()
+        try:
+            out = discovery.kuma_sd(
+                {"server": f"127.0.0.1:{srv.port}"})
+            assert seen[0]["type_url"].endswith("MonitoringAssignment")
+            assert seen[0]["version_info"] == ""
+            assert out[0][0] == "10.7.0.2:5670"
+            meta = out[0][1]
+            assert meta["__meta_kuma_dataplane"] == "backend-01"
+            assert meta["__meta_kuma_mesh"] == "default"
+            assert meta["__meta_kuma_service"] == "backend"
+            assert meta["__meta_kuma_label_team"] == "core"
+            assert meta["__meta_kuma_label_kuma_io_protocol"] == "http"
+            assert meta["__metrics_path__"] == "/metrics"
+        finally:
+            srv.stop()

@@ -194,7 +194,7 @@ class GraphiteAPI:
         # cluster that is one fanned-out RPC instead of pulling every
         # metric name)
         sfx_fn = getattr(self.storage, "tag_value_suffixes", None)
-        m = re.fullmatch(r"((?:[^*{}\[\]]+\.)?)\*", query)
+        m = re.fullmatch(r"((?:[^*?,{}\[\]]+\.)?)\*", query)
         if sfx_fn is not None and m:
             prefix = m.group(1)
             merged: dict[str, list] = {}

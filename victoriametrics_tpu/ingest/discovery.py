@@ -1582,6 +1582,70 @@ def yandexcloud_sd(cfg: dict) -> list[tuple[str, dict]]:
         raise DiscoveryError(f"yandexcloud_sd {api}: {e}") from e
 
 
+# -- kuma (discovery/kuma/) --------------------------------------------------
+
+def kuma_sd(cfg: dict) -> list[tuple[str, dict]]:
+    """Kuma service-mesh discovery (lib/promscrape/discovery/kuma): one
+    xDS DiscoveryRequest POSTed as JSON to
+    {server}/v3/discovery:monitoringassignments (the MADS REST variant;
+    an empty version/nonce fetches the full assignment set — the
+    stateless pull matching every other provider here)."""
+    import urllib.parse as _up
+    server = cfg.get("server", "")
+    if not server:
+        raise DiscoveryError("kuma_sd: missing server")
+    if "://" not in server:
+        server = "http://" + server
+    psu = _up.urlparse(server)
+    path = psu.path
+    if not path.endswith("/"):
+        path += "/"
+    url = (f"{psu.scheme}://{psu.netloc}{path}"
+           "v3/discovery:monitoringassignments")
+    if psu.query:
+        url += "?" + psu.query
+    body = json.dumps({
+        "version_info": "",
+        "node": {"id": cfg.get("client_id", "victoriametrics_tpu")},
+        "resource_names": [],
+        "type_url": "type.googleapis.com/"
+                    "kuma.observability.v1.MonitoringAssignment",
+        "response_nonce": "",
+    }).encode()
+    try:
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json",
+                                     "Accept": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            dresp = json.loads(resp.read())
+        if not isinstance(dresp, dict):
+            raise DiscoveryError(
+                f"kuma_sd {server}: unexpected response shape "
+                f"{type(dresp).__name__}")
+        out: list[tuple[str, dict]] = []
+        for r in dresp.get("resources") or []:
+            for t in r.get("targets") or []:
+                meta = {
+                    "instance": t.get("name", ""),
+                    "__scheme__": t.get("scheme", ""),
+                    "__metrics_path__": t.get("metrics_path", ""),
+                    "__meta_kuma_dataplane": t.get("name", ""),
+                    "__meta_kuma_mesh": r.get("mesh", ""),
+                    "__meta_kuma_service": r.get("service", ""),
+                }
+                for src in (r.get("labels") or {}, t.get("labels") or {}):
+                    for k, v in src.items():
+                        meta[f"__meta_kuma_label_{_sanitize(k)}"] = str(v)
+                meta = {k: v for k, v in meta.items() if v}
+                addr = t.get("address", "")
+                if addr:
+                    out.append((addr, meta))
+        return out
+    except (OSError, ValueError, KeyError, AttributeError,
+            TypeError) as e:
+        raise DiscoveryError(f"kuma_sd {server}: {e}") from e
+
+
 PROVIDERS = {
     "kubernetes_sd_configs": kubernetes_sd,
     "consul_sd_configs": consul_sd,
@@ -1603,6 +1667,7 @@ PROVIDERS = {
     "puppetdb_sd_configs": puppetdb_sd,
     "ovhcloud_sd_configs": ovhcloud_sd,
     "yandexcloud_sd_configs": yandexcloud_sd,
+    "kuma_sd_configs": kuma_sd,
 }
 
 
