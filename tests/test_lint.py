@@ -1,0 +1,159 @@
+"""Lint-engine tests: each rule id fires on a known-bad fixture, stays
+quiet on a known-good one, suppressions and the baseline ratchet work,
+and the real package is clean against the checked-in baseline (this is
+the tier-1 wiring of `python -m victoriametrics_tpu.devtools.lint`)."""
+
+import os
+
+import pytest
+
+from victoriametrics_tpu.devtools import lint
+from victoriametrics_tpu.devtools.lint import (lint_paths, lint_source,
+                                               load_baseline, new_findings)
+
+# (rule, bad snippet that must fire exactly there, good twin that must not)
+FIXTURES = {
+    "VMT001": (
+        "import time\n"
+        "def stamp(rows):\n"
+        "    now = int(time.time() * 1000)\n"
+        "    return [(now, r) for r in rows]\n",
+        "from victoriametrics_tpu.utils import fasttime\n"
+        "import time\n"
+        "def stamp(rows):\n"
+        "    now = fasttime.unix_ms()\n"
+        "    t0 = time.monotonic()  # monotonic is fine\n"
+        "    return [(now, r) for r in rows], t0\n",
+    ),
+    "VMT002": (
+        "def fetch(url, _memo={}):\n"
+        "    return _memo.setdefault(url, url.upper())\n",
+        "_MEMO = {}\n"
+        "def fetch(url, timeout=10, tags=()):\n"
+        "    return _MEMO.setdefault(url, url.upper())\n",
+    ),
+    "VMT003": (
+        "def load(path):\n"
+        "    try:\n"
+        "        return open(path).read()\n"
+        "    except Exception:\n"
+        "        pass\n",
+        "def load(path):\n"
+        "    try:\n"
+        "        return open(path).read()\n"
+        "    except (OSError, ValueError) as e:\n"
+        "        log(e)\n"
+        "    except ValueError:\n"
+        "        pass  # narrow except-pass is idiomatic control flow\n",
+    ),
+    "VMT004": (
+        "import time\n"
+        "class Q:\n"
+        "    def drain(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.1)\n",
+        "import time\n"
+        "class Q:\n"
+        "    def drain(self):\n"
+        "        with self._lock:\n"
+        "            items = list(self._items)\n"
+        "        time.sleep(0.1)\n"
+        "    def reload(self):\n"
+        "        def later():\n"
+        "            time.sleep(1)  # runs outside the critical section\n"
+        "        with self._lock:\n"
+        "            self._cb = later\n",
+    ),
+    "VMT005": (
+        "class C:\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "    def reset(self):\n"
+        "        self.n = 0\n",
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0  # __init__ is single-threaded\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "    def reset(self):\n"
+        "        with self._lock:\n"
+        "            self._reset_locked()\n"
+        "    def _reset_locked(self):\n"
+        "        self.n = 0\n",
+    ),
+    "VMT006": (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def rollup(x):\n"
+        "    return float(np.asarray(x).sum())\n",
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def rollup(x):\n"
+        "    return x.sum()\n"
+        "def host_side(x):\n"
+        "    return float(np.asarray(x).sum())  # not traced: fine\n",
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_fires_on_bad_fixture(rule):
+    bad, _ = FIXTURES[rule]
+    found = {f.rule for f in lint_source(bad, f"fixture_{rule}_bad.py")}
+    assert rule in found, f"{rule} did not fire on its bad fixture"
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_quiet_on_good_fixture(rule):
+    _, good = FIXTURES[rule]
+    found = [f for f in lint_source(good, f"fixture_{rule}_good.py")
+             if f.rule == rule]
+    assert not found, f"false positives: {[str(f) for f in found]}"
+
+
+def test_inline_suppression_silences_only_that_line_and_rule():
+    src = ("import time\n"
+           "a = time.time()  # vmt: disable=VMT001\n"
+           "b = time.time()\n")
+    found = lint_source(src, "supp.py")
+    assert [(f.rule, f.line) for f in found] == [("VMT001", 3)]
+
+
+def test_baseline_ratchet(tmp_path):
+    src = "import time\na = time.time()\nb = time.time()\n"
+    findings = lint_source(src, str(tmp_path / "mod.py"))
+    assert len(findings) == 2
+    bl = tmp_path / "baseline.txt"
+    lint.write_baseline(str(bl), findings)
+    # grandfathered: nothing new
+    assert new_findings(findings, load_baseline(str(bl))) == []
+    # one more hit in the same file exceeds the baselined count
+    worse = lint_source(src + "c = time.time()\n", str(tmp_path / "mod.py"))
+    assert len(new_findings(worse, load_baseline(str(bl)))) == 3
+
+
+def test_package_is_clean_against_checked_in_baseline():
+    """The canonical tier-1 invariant: linting the real package against
+    devtools/lint_baseline.txt yields zero new findings."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    findings = lint_paths([pkg])
+    assert not any(f.rule == "VMT000" for f in findings), "syntax errors?!"
+    baseline = load_baseline(lint.DEFAULT_BASELINE)
+    fresh = new_findings(findings, baseline)
+    assert fresh == [], "new lint findings:\n" + \
+        "\n".join(str(f) for f in fresh)
+
+
+def test_cli_main_exits_zero_on_clean_tree():
+    assert lint.main([]) == 0
+
+
+def test_cli_lists_all_six_rules(capsys):
+    assert lint.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in sorted(FIXTURES):
+        assert rid in out

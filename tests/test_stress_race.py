@@ -16,14 +16,23 @@ import time
 import numpy as np
 import pytest
 
-from victoriametrics_tpu import native
-from victoriametrics_tpu.query.exec import exec_query
-from victoriametrics_tpu.query.types import EvalConfig
-from victoriametrics_tpu.storage.storage import Storage
-from victoriametrics_tpu.storage.tag_filters import filters_from_dict
+from victoriametrics_tpu.devtools import locktrace
+from victoriametrics_tpu.devtools.locktrace import (LockHeldTooLongWarning,
+                                                    LockOrderError,
+                                                    TracedLock)
 
-pytestmark = pytest.mark.skipif(not native.available(),
-                                reason="needs native lib")
+try:
+    from victoriametrics_tpu import native
+    from victoriametrics_tpu.query.exec import exec_query
+    from victoriametrics_tpu.query.types import EvalConfig
+    from victoriametrics_tpu.storage.storage import Storage
+    from victoriametrics_tpu.storage.tag_filters import filters_from_dict
+    _HAVE_NATIVE = native.available()
+except ImportError:  # optional deps (zstandard) missing
+    _HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(not _HAVE_NATIVE,
+                                  reason="needs native lib")
 
 T0 = 1_753_700_000_000
 DURATION_S = 8.0
@@ -124,6 +133,7 @@ class _Stress:
             filters_from_dict({"__name__": "victim"}))
 
 
+@needs_native
 def test_concurrent_ingest_query_flush_snapshot(tmp_path):
     s = Storage(str(tmp_path / "s"))
     st = _Stress(s)
@@ -159,3 +169,148 @@ def test_concurrent_ingest_query_flush_snapshot(tmp_path):
             ts = cols.ts[i, :n]
             np.testing.assert_array_equal(cols.vals[i, :n], _val(ts))
     s.close()
+
+# -- runtime lock-order tracing (devtools/locktrace) -------------------------
+
+
+class TestLockTrace:
+    def test_cycle_detected_fails_fast(self):
+        """A->B in one thread then B->A in another must raise
+        LockOrderError promptly — the whole point is that the synthetic
+        deadlock FAILS instead of hanging the suite."""
+        g = locktrace.LockGraph()
+        a = TracedLock("stress.A", graph=g, mode="raise")
+        b = TracedLock("stress.B", graph=g, mode="raise")
+        phase1_done = threading.Event()
+        errors: list[BaseException] = []
+
+        def t1():
+            with a:
+                with b:
+                    pass
+            phase1_done.set()
+
+        def t2():
+            assert phase1_done.wait(10)
+            try:
+                with b:
+                    with a:  # reverse order: potential ABBA deadlock
+                        pass
+            except LockOrderError as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=t1, daemon=True),
+                   threading.Thread(target=t2, daemon=True)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+            assert not t.is_alive(), "locktrace test wedged"
+        assert time.monotonic() - t0 < 15
+        assert len(errors) == 1
+        assert "stress.A" in str(errors[0]) and "stress.B" in str(errors[0])
+
+    def test_consistent_order_is_quiet(self):
+        g = locktrace.LockGraph()
+        a = TracedLock("q.A", graph=g)
+        b = TracedLock("q.B", graph=g)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert g.edges() == {"q.A": {"q.B"}}
+
+    def test_rlock_reentry_and_nonreentrant_self_deadlock(self):
+        g = locktrace.LockGraph()
+        r = TracedLock("q.R", graph=g, reentrant=True)
+        with r:
+            with r:  # fine: RLock semantics
+                assert r.locked()
+        plain = TracedLock("q.P", graph=g)
+        with plain:
+            with pytest.raises(LockOrderError, match="re-acquired"):
+                plain.acquire()
+
+    def test_failed_trylock_leaves_no_phantom_edge(self):
+        """hold A, try-lock B, fail, retake in the safe B->A order: the
+        aborted attempt must not have poisoned the graph."""
+        g = locktrace.LockGraph()
+        a = TracedLock("t.A", graph=g)
+        b = TracedLock("t.B", graph=g)
+        acquired, release = threading.Event(), threading.Event()
+
+        def holder():
+            with b:
+                acquired.set()
+                release.wait(10)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert acquired.wait(10)
+        with a:
+            assert b.acquire(blocking=False) is False  # contended: aborts
+        release.set()
+        t.join(10)
+        assert "t.B" not in g.edges().get("t.A", set())
+        with b:
+            with a:  # safe order must stay legal
+                pass
+
+    def test_cycle_abort_rolls_back_partial_edges(self):
+        """When acquiring C while holding A and B raises on the B->C
+        cycle, the A->C edge recorded a moment earlier must be rolled
+        back too — C->A later is legitimate."""
+        g = locktrace.LockGraph()
+        a = TracedLock("r.A", graph=g)
+        b = TracedLock("r.B", graph=g)
+        c = TracedLock("r.C", graph=g)
+        with c:
+            with b:  # establishes C->B
+                pass
+        with a:
+            with b:
+                with pytest.raises(LockOrderError):
+                    c.acquire()  # A->C recorded, then B->C finds cycle
+        assert "r.C" not in g.edges().get("r.A", set())
+        with c:
+            with a:  # must stay legal
+                pass
+
+    def test_cross_thread_handoff_reacquire(self):
+        lk = TracedLock("t.H", graph=locktrace.LockGraph())
+        lk.acquire()
+        t = threading.Thread(target=lk.release)
+        t.start(); t.join()
+        lk.acquire()  # stale stack entry must be purged, not fatal
+        lk.release()
+
+    def test_held_too_long_warns(self):
+        lk = TracedLock("q.slow", graph=locktrace.LockGraph(),
+                        max_hold_ms=1.0)
+        with pytest.warns(LockHeldTooLongWarning):
+            with lk:
+                time.sleep(0.02)
+
+    def test_factory_injects_traced_locks(self, monkeypatch):
+        monkeypatch.setenv("VMT_LOCKTRACE", "1")
+        assert isinstance(locktrace.make_lock("x"), TracedLock)
+        assert isinstance(locktrace.make_rlock("x"), TracedLock)
+        monkeypatch.setenv("VMT_LOCKTRACE", "0")
+        assert isinstance(locktrace.make_lock("x"), type(threading.Lock()))
+
+    @needs_native
+    def test_storage_lock_hierarchy_under_tracing(self, tmp_path,
+                                                  monkeypatch):
+        """The real ingest/flush path runs clean under the tracer: the
+        Table -> Partition -> flush-mutex hierarchy is acyclic."""
+        monkeypatch.setenv("VMT_LOCKTRACE", "1")
+        s = Storage(str(tmp_path / "lt"))
+        t0 = 1_753_700_000_000
+        s.add_rows([({"__name__": "lt", "i": str(i)}, t0 + i * 1000, 1.0)
+                    for i in range(32)])
+        s.force_flush()
+        s.force_merge()
+        assert len(s.search_series(
+            filters_from_dict({"__name__": "lt"}), t0 - 1, t0 + 10**6)) == 32
+        s.close()

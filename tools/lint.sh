@@ -1,0 +1,12 @@
+#!/bin/sh
+# Canonical static-analysis entry point (tier-1 / CI): runs the project
+# lint engine over the package and exits non-zero on any finding not in
+# devtools/lint_baseline.txt. Extra args are passed through, e.g.:
+#   tools/lint.sh --update-baseline
+#   tools/lint.sh --no-baseline victoriametrics_tpu/storage/
+set -eu
+cd "$(dirname "$0")/.."
+if [ "$#" -eq 0 ]; then
+    set -- victoriametrics_tpu/
+fi
+exec python -m victoriametrics_tpu.devtools.lint "$@"

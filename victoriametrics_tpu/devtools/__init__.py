@@ -1,0 +1,14 @@
+"""Static-analysis suite for the project (the role `go vet` + `-race`
+play in the reference repo).
+
+Two halves:
+
+- :mod:`lint` — an AST lint engine with project-specific rules
+  (VMT001..VMT006) covering deterministic-time discipline, classic
+  Python foot-guns, lock discipline, and JAX host-sync anti-patterns.
+  Run as ``python -m victoriametrics_tpu.devtools.lint victoriametrics_tpu/``.
+- :mod:`locktrace` — a runtime lock-order tracer: ``TracedLock`` is a
+  drop-in for ``threading.Lock``/``RLock`` that records the per-thread
+  lock-acquisition graph and fails fast on cycles (potential deadlock).
+  Enabled by running any entry point with ``VMT_LOCKTRACE=1``.
+"""

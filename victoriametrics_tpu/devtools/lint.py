@@ -1,0 +1,267 @@
+"""Project lint engine (the `go vet` analog for this repo).
+
+Rules live in sibling ``rules_*`` modules, one module per rule family;
+each exposes ``RULES``, a list of objects with a ``rule_id``, a one-line
+``summary`` and a ``check(ctx)`` generator yielding :class:`Finding`.
+
+Usage::
+
+    python -m victoriametrics_tpu.devtools.lint victoriametrics_tpu/
+    python -m victoriametrics_tpu.devtools.lint --update-baseline
+    python -m victoriametrics_tpu.devtools.lint --no-baseline file.py
+
+Findings are ``path:line: VMTxxx message``.  A finding is silenced
+either by an inline comment on the offending line::
+
+    t = time.time()  # vmt: disable=VMT001
+
+or by the checked-in grandfather baseline
+(``devtools/lint_baseline.txt``, per-file per-rule counts — line-number
+free so unrelated edits don't invalidate it).  The check fails only when
+a (file, rule) pair exceeds its baselined count, so the suite starts
+green and ratchets: fixing findings shrinks the baseline via
+``--update-baseline``, new code can't add any.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import os
+import re
+import sys
+from collections import Counter
+
+_DEVTOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(os.path.dirname(_DEVTOOLS_DIR))
+DEFAULT_BASELINE = os.path.join(_DEVTOOLS_DIR, "lint_baseline.txt")
+
+_SUPPRESS_RE = re.compile(r"#\s*vmt:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str     # repo-root-relative when under the repo, else as given
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def dotted_name(node) -> str | None:
+    """"a.b.c" for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def normalize_path(path: str) -> str:
+    """Repo-root-relative (the baseline key) when under the repo, else
+    the path as given."""
+    rel = os.path.relpath(os.path.abspath(path), REPO_ROOT)
+    return path if rel.startswith("..") else rel.replace(os.sep, "/")
+
+
+class FileContext:
+    """One parsed source file handed to every rule."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.rel_path = normalize_path(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of rule ids disabled on that line
+        self.suppressed: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                ids = {s.strip().upper() for s in m.group(1).split(",")}
+                self.suppressed[i] = {s for s in ids if s}
+
+    def finding(self, node, rule: str, message: str) -> Finding:
+        line = getattr(node, "lineno", 0) if not isinstance(node, int) else node
+        return Finding(self.rel_path, line, rule, message)
+
+    def is_suppressed(self, f: Finding) -> bool:
+        return f.rule in self.suppressed.get(f.line, ())
+
+
+def all_rules() -> list:
+    from . import rules_jax, rules_locks, rules_pyflaws, rules_time
+    rules = []
+    for mod in (rules_time, rules_pyflaws, rules_locks, rules_jax):
+        rules.extend(mod.RULES)
+    return sorted(rules, key=lambda r: r.rule_id)
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__" and not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules=None) -> list[Finding]:
+    """Lint one source string; returns unsuppressed findings."""
+    ctx = FileContext(path, source)
+    out = []
+    for rule in rules if rules is not None else all_rules():
+        for f in rule.check(ctx):
+            if not ctx.is_suppressed(f):
+                out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_paths(paths, rules=None) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            print(f"lint: cannot read {path}: {e}", file=sys.stderr)
+            continue
+        try:
+            findings.extend(lint_source(src, path, rules))
+        except SyntaxError as e:
+            findings.append(Finding(normalize_path(path), e.lineno or 0,
+                                    "VMT000", f"syntax error: {e.msg}"))
+    return findings
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: str) -> Counter:
+    """Baseline lines are ``relpath:RULE:count``; '#' starts a comment."""
+    counts: Counter = Counter()
+    if not os.path.exists(path):
+        return counts
+    with open(path, encoding="utf-8") as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                rel, rule, n = line.rsplit(":", 2)
+                counts[(rel, rule)] = int(n)
+            except ValueError:
+                print(f"lint: bad baseline line skipped: {line!r}",
+                      file=sys.stderr)
+    return counts
+
+
+def write_baseline(path: str, findings: list[Finding],
+                   linted_files: set[str] | None = None) -> None:
+    """Rewrite the baseline. When ``linted_files`` is given (a subset
+    lint), entries for files OUTSIDE the subset are carried over
+    unchanged instead of being silently dropped."""
+    counts = Counter((f.path, f.rule) for f in findings)
+    if linted_files is not None:
+        for key, n in load_baseline(path).items():
+            if key[0] not in linted_files:
+                counts[key] = n
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("# Grandfathered lint findings: relpath:RULE:count.\n"
+                 "# Regenerate with: python -m victoriametrics_tpu.devtools."
+                 "lint --update-baseline\n")
+        for (rel, rule), n in sorted(counts.items()):
+            if n:
+                fh.write(f"{rel}:{rule}:{n}\n")
+
+
+def new_findings(findings: list[Finding], baseline: Counter) -> list[Finding]:
+    """Findings in (file, rule) groups that exceed their baselined count.
+
+    The whole group is returned when it exceeds (line numbers drift, so
+    individual findings can't be matched against the baseline)."""
+    groups: dict[tuple, list[Finding]] = {}
+    for f in findings:
+        groups.setdefault((f.path, f.rule), []).append(f)
+    out = []
+    for key, fs in groups.items():
+        if len(fs) > baseline.get(key, 0):
+            out.extend(fs)
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def stale_baseline_entries(findings: list[Finding], baseline: Counter,
+                           linted_files: set[str] | None = None) -> list[tuple]:
+    """Baseline entries whose count exceeds what the lint found — only
+    meaningful for files that were actually linted this run."""
+    counts = Counter((f.path, f.rule) for f in findings)
+    return sorted(k for k, n in baseline.items()
+                  if counts.get(k, 0) < n and
+                  (linted_files is None or k[0] in linted_files))
+
+
+# -- CLI --------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m victoriametrics_tpu.devtools.lint",
+        description="Project-specific AST lint (rules VMT001..VMT006).")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: devtools/lint_baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.rule_id}  {r.summary}")
+        return 0
+
+    paths = args.paths or [os.path.join(REPO_ROOT, "victoriametrics_tpu")]
+    linted = {normalize_path(p) for p in iter_py_files(paths)}
+    findings = lint_paths(paths)
+
+    if args.update_baseline:
+        write_baseline(args.baseline, findings, linted)
+        print(f"baseline updated: {len(findings)} finding(s) grandfathered "
+              f"-> {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        fresh = findings
+    else:
+        baseline = load_baseline(args.baseline)
+        fresh = new_findings(findings, baseline)
+        for rel, rule in stale_baseline_entries(findings, baseline, linted):
+            print(f"note: baseline for {rel}:{rule} is stale (fixed?); "
+                  f"shrink it with --update-baseline", file=sys.stderr)
+
+    for f in fresh:
+        print(f)
+    if fresh:
+        print(f"\n{len(fresh)} new finding(s) "
+              f"({len(findings)} total incl. baseline). "
+              f"Fix, add '# vmt: disable=<RULE>' with a reason, or "
+              f"--update-baseline if truly grandfathered.", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

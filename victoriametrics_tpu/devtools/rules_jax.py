@@ -1,0 +1,62 @@
+"""VMT006 — JAX host-sync anti-patterns inside traced functions.
+
+``block_until_ready``, ``np.asarray`` and ``.item()`` inside a function
+decorated with ``jax.jit``/``pmap`` either fail at trace time or force a
+device->host sync on every call, silently serializing the pipeline the
+decorator was supposed to overlap.  (See /opt/skills/guides on keeping
+host transfers out of compiled regions.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .lint import dotted_name
+
+_JIT_NAMES = {"jit", "pmap", "jax.jit", "jax.pmap"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+_HOST_SYNC_EXACT = {"np.asarray", "numpy.asarray", "onp.asarray",
+                    "jax.device_get", "jax.block_until_ready"}
+_HOST_SYNC_ATTRS = {"block_until_ready", "item"}
+
+
+def _is_jit_decorator(dec) -> bool:
+    name = dotted_name(dec)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in _JIT_NAMES:
+            return True  # @jax.jit(static_argnums=...)
+        if fname in _PARTIAL_NAMES and dec.args:
+            return dotted_name(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+class JaxHostSyncRule:
+    rule_id = "VMT006"
+    summary = ("block_until_ready/np.asarray/.item() inside a "
+               "jit/pmap-decorated function")
+
+    def check(self, ctx):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(_is_jit_decorator(d) for d in fn.decorator_list):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                attr = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) else None
+                if name in _HOST_SYNC_EXACT or attr in _HOST_SYNC_ATTRS:
+                    what = name or f".{attr}"
+                    yield ctx.finding(
+                        node, self.rule_id,
+                        f"{what}() inside jit/pmap function {fn.name}(); "
+                        f"host syncs don't belong in traced code — hoist "
+                        f"it to the caller or keep the value on device")
+
+
+RULES = [JaxHostSyncRule()]

@@ -32,7 +32,7 @@ from ..query.metricsql.ast import MetricExpr
 from ..query.metricsql.parser import ParseError, parse_duration_ms
 from ..query.types import EvalConfig
 from ..storage.metric_name import MetricName
-from ..utils import logger
+from ..utils import fasttime, logger
 from .server import HTTPServer, Request, Response
 
 
@@ -48,7 +48,7 @@ def parse_time(s: str, default_ms: int) -> int:
         try:
             ms, step_based = parse_duration_ms(s[1:])
             if not step_based and ms > 0:
-                return int(time.time() * 1000) - int(ms)
+                return fasttime.unix_ms() - int(ms)
         except Exception:
             pass
     try:
@@ -91,7 +91,7 @@ class ActiveQueries:
             qid = self._next
             self._live[qid] = {"qid": qid, "query": query, "start": start,
                                "end": end, "step": step,
-                               "t": time.time()}
+                               "t": fasttime.unix_seconds()}
             return qid
 
     def unregister(self, qid: int):
@@ -100,7 +100,7 @@ class ActiveQueries:
 
     def snapshot(self) -> list[dict]:
         with self._lock:
-            now = time.time()
+            now = fasttime.unix_seconds()
             return [{**q, "duration": f"{now - q['t']:.3f}s"}
                     for q in self._live.values()]
 
@@ -190,7 +190,7 @@ class PrometheusAPI:
         self.active = ActiveQueries()
         self.qstats = QueryStats()
         self.gate = ConcurrencyGate(max_concurrent_queries)
-        self.started_at = time.time()
+        self.started_at = fasttime.unix_seconds()
         self.rows_inserted = 0
         self.rows_relabel_dropped = 0
         # TYPE/HELP metadata (lib/storage/metricsmetadata analog) and
@@ -385,7 +385,7 @@ class PrometheusAPI:
         q = req.arg("query")
         if not q:
             return Response.error("missing 'query' arg")
-        now = int(time.time() * 1000)
+        now = fasttime.unix_ms()
         ts = parse_time(req.arg("time"), now)
         step = parse_step(req.arg("step"), 300_000)
         qid = self.active.register(q, ts, ts, step)
@@ -430,7 +430,7 @@ class PrometheusAPI:
         q = req.arg("query")
         if not q:
             return Response.error("missing 'query' arg")
-        now = int(time.time() * 1000)
+        now = fasttime.unix_ms()
         start = parse_time(req.arg("start"), now - 300_000)
         end = parse_time(req.arg("end"), now)
         step = parse_step(req.arg("step"))
@@ -547,7 +547,7 @@ class PrometheusAPI:
     def _time_range(self, req: Request, full_default: bool = False):
         """Default range: last 30 days for metadata APIs, everything for
         export (the reference exports the full retention by default)."""
-        now = int(time.time() * 1000)
+        now = fasttime.unix_ms()
         default_start = 0 if full_default else now - 86_400_000 * 30
         start = parse_time(req.arg("start"), default_start)
         end = parse_time(req.arg("end"), now)
@@ -713,7 +713,7 @@ class PrometheusAPI:
             fl = self._matches_to_filters(req)
             if not fl:
                 return Response.error("missing match[] arg")
-            now = int(time.time() * 1000)
+            now = fasttime.unix_ms()
             start = now - self.lookback_delta
             lines = []
             for filters in fl:
@@ -784,7 +784,7 @@ class PrometheusAPI:
         return n
 
     def _add_rows(self, rows_iter, tenant=(0, 0)) -> int:
-        now = int(time.time() * 1000)
+        now = fasttime.unix_ms()
         batch = []
         for row in rows_iter:
             ts = row.timestamp or now
@@ -823,7 +823,7 @@ class PrometheusAPI:
             # tails (ResetRollupResultCacheIfNeeded analog)
             from ..query.rollup_result_cache import GLOBAL as rcache
             from ..query.rollup_result_cache import OFFSET_MS
-            now = int(time.time() * 1000)
+            now = fasttime.unix_ms()
             if min(ts for _, ts, _ in batch) < now - OFFSET_MS:
                 rcache.reset()
         n = self.storage.add_rows(batch, tenant=tenant) if batch else 0
@@ -841,7 +841,7 @@ class PrometheusAPI:
         # generator — materialize inside the try so errors surface here.
         if self._columnar_ok():
             from .. import native
-            now = int(time.time() * 1000)
+            now = fasttime.unix_ms()
             cr = native.parse_rw_columnar(req.body, now)
             if cr is None:
                 body = native.snappy_uncompress(req.body)
@@ -859,7 +859,7 @@ class PrometheusAPI:
             except Exception as e:
                 return Response.error(f"cannot parse remote write: {e}", 400)
         batch = []
-        now = int(time.time() * 1000)
+        now = fasttime.unix_ms()
         for labels, samples in series:
             for ts, val in samples:
                 batch.append((dict(labels), ts or now, val))
@@ -889,7 +889,7 @@ class PrometheusAPI:
             if self._columnar_ok():
                 from .. import native
                 cr = native.parse_prom_columnar(
-                    req.body, ts or int(time.time() * 1000))
+                    req.body, ts or fasttime.unix_ms())
             if cr is not None:
                 # fast path: native parse -> columnar raw-key rows; repeat
                 # scrapes resolve whole batches in one native hash-map call
@@ -926,7 +926,7 @@ class PrometheusAPI:
             if self._columnar_ok():
                 from .. import native
                 cr = native.parse_influx_columnar(
-                    req.body, db or "", int(time.time() * 1000))
+                    req.body, db or "", fasttime.unix_ms())
             if cr is not None:
                 self._ingest_columnar(cr, self._tenant(req))
             else:
@@ -1128,7 +1128,7 @@ class PrometheusAPI:
         })
 
     def _track_usage(self, rows):
-        now = int(time.time())
+        now = fasttime.unix_timestamp()
         for r in rows:
             g = r.metric_name.metric_group
             if not g:
@@ -1254,7 +1254,7 @@ class PrometheusAPI:
                 self.rate_limiter.global_rl.limit_reached
         if self.series_limits is not None:
             m.update(self.series_limits.metrics())
-        m["vm_app_uptime_seconds"] = round(time.time() - self.started_at, 3)
+        m["vm_app_uptime_seconds"] = round(fasttime.unix_seconds() - self.started_at, 3)
         for k, v in sorted(m.items()):
             lines.append(f"{k} {v}")
         for lvl, cnt in logger.message_counters().items():

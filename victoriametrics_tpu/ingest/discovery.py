@@ -263,7 +263,8 @@ def _sigv4_headers(method: str, url: str, body, region: str,
     if isinstance(body, str):
         body = body.encode()
     u = urlparse(url)
-    now = datetime.datetime.now(datetime.timezone.utc)
+    # SigV4 signing embeds an absolute timestamp the server skew-checks
+    now = datetime.datetime.now(datetime.timezone.utc)  # vmt: disable=VMT001
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     datestamp = now.strftime("%Y%m%d")
     payload_hash = hashlib.sha256(body).hexdigest()
@@ -1364,28 +1365,34 @@ def puppetdb_sd(cfg: dict) -> list[tuple[str, dict]]:
 
 # -- ovhcloud (discovery/ovhcloud/) ------------------------------------------
 
-def _ovh_get(cfg: dict, endpoint: str, path: str, _delta_memo={}):
+# per-endpoint server/local clock delta for OVH request signing, fetched
+# once and reused (the official client does the same)
+_OVH_TIME_DELTA: dict[str, int] = {}
+
+
+def _ovh_get(cfg: dict, endpoint: str, path: str):
     """Signed OVH API GET (discovery/ovhcloud/common.go): signature =
-    "$1$" + sha1(AS+CK+method+url+body+timestamp). The server/local
-    clock delta is fetched once per endpoint and reused (the official
-    client does the same); a failed /auth/time is LOUD — local time
-    would just produce mysterious 403s on skewed hosts."""
+    "$1$" + sha1(AS+CK+method+url+body+timestamp). A failed /auth/time
+    is LOUD — local time would just produce mysterious 403s on skewed
+    hosts."""
     import hashlib
     import time as _time
     app_key = cfg.get("application_key", "")
     app_secret = cfg.get("application_secret", "")
     consumer = cfg.get("consumer_key", "")
-    delta = _delta_memo.get(endpoint)
+    delta = _OVH_TIME_DELTA.get(endpoint)
     if delta is None:
         try:
             delta = int(_get_json(f"{endpoint}/auth/time")) - \
-                int(_time.time())
+                int(_time.time())  # vmt: disable=VMT001 (signing skew)
         except (OSError, ValueError, TypeError) as e:
             raise DiscoveryError(
                 f"ovhcloud: cannot fetch {endpoint}/auth/time for "
                 f"request signing: {e}") from e
-        _delta_memo[endpoint] = delta
-    ts = int(_time.time()) + delta
+        _OVH_TIME_DELTA[endpoint] = delta
+    # request signing needs the real wall clock, not the cached one: the
+    # signature embeds an absolute timestamp the server checks for skew
+    ts = int(_time.time()) + delta  # vmt: disable=VMT001
     url = endpoint + path
     sig = hashlib.sha1(
         f"{app_secret}+{consumer}+GET+{url}++{ts}".encode()).hexdigest()

@@ -19,12 +19,12 @@ from __future__ import annotations
 import math
 import re
 import threading
-import time
 
 from ..query.metricsql import parse as mql_parse
 from ..query.metricsql.ast import MetricExpr
 from ..query.metricsql.parser import parse_duration_ms
 from ..storage.tag_filters import TagFilter
+from ..utils import fasttime
 
 OUTPUT_KINDS = (
     "avg count_samples count_series histogram_bucket increase "
@@ -176,7 +176,7 @@ class Aggregator:
                 st.rate_prev_ts = ts_ms
 
     def flush(self, now_ms: int | None = None) -> None:
-        now_ms = now_ms or int(time.time() * 1000)
+        now_ms = now_ms or fasttime.unix_ms()
         with self._lock:
             state, self._state = self._state, {}
         suffix_base = _interval_str(self.interval_ms)

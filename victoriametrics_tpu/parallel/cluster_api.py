@@ -18,6 +18,7 @@ import time
 
 import numpy as np
 
+from ..devtools.locktrace import make_lock
 from ..storage.metric_name import MetricName
 from ..storage.tag_filters import TagFilter
 from ..utils import logger
@@ -566,7 +567,7 @@ class ClusterStorage:
         self.cache_token = next_storage_token()
         self.rows_sent = 0
         self.reroutes = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("parallel.VMSelect._lock")
         # partial-result tracking is per handler thread and STICKY across
         # the fanouts of one query (a shared flag would race between
         # concurrent queries and be cleared by a later clean fanout)
@@ -823,7 +824,7 @@ class ClusterStorage:
         nodes are skipped but still count toward the partial flag."""
         results: list = []
         errors: list = []
-        lock = threading.Lock()
+        lock = make_lock("parallel.cluster_api.fanout_lock")
 
         def run(node):
             try:

@@ -18,6 +18,8 @@ import threading
 
 import numpy as np
 
+from ..devtools.locktrace import make_lock
+
 from ..ops import compress as zstd
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
 from ..utils import logger
@@ -210,7 +212,7 @@ class RPCClient:
         self.addr = (host, port)
         self.hello = hello
         self.timeout = timeout
-        self._lock = threading.Lock()
+        self._lock = make_lock("rpc.RPCClient._lock")
         self._sock = None
         self._f = None
 

@@ -10,7 +10,7 @@ bloomfilter sizing), reset at each window rollover.
 
 from __future__ import annotations
 
-import time
+from ..utils import fasttime
 
 K_PROBES = 4
 BITS_PER_ITEM = 16
@@ -27,11 +27,11 @@ class BloomLimiter:
         self._nbits = nbits
         self._bits = bytearray((nbits + 7) // 8)
         self._count = 0
-        self._bucket = int(time.time()) // rotation_s
+        self._bucket = fasttime.unix_timestamp() // rotation_s
         self.rows_dropped = 0
 
     def _rotate_if_needed(self):
-        b = int(time.time()) // self.rotation_s
+        b = fasttime.unix_timestamp() // self.rotation_s
         if b != self._bucket:
             self._bucket = b
             self._bits = bytearray(len(self._bits))

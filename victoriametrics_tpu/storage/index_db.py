@@ -27,10 +27,10 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
 
 import numpy as np
 
+from ..devtools.locktrace import make_lock
 from .mergeset import Table
 from .metric_name import MetricName, escape, unescape
 from .tag_filters import TagFilter
@@ -96,7 +96,7 @@ class IndexDB:
                 if len(name) == 7 and name[4] == "_":
                     self._month_tables[name] = Table(
                         os.path.join(months_dir, name))
-        self._lock = threading.Lock()
+        self._lock = make_lock("storage.IndexDB._lock")
         self._deleted = self._load_deleted()
         self._gen = 0
         self._name_cache: dict[int, MetricName] = {}

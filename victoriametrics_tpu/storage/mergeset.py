@@ -22,9 +22,9 @@ import itertools
 import json
 import os
 import struct
-import threading
 from collections import OrderedDict
 
+from ..devtools.locktrace import make_lock, make_rlock
 from ..ops import compress as zstd
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
 from ..utils import logger
@@ -90,7 +90,7 @@ class _FilePart:
             self.blocks.append((first, boff, bsize, cnt))
         self._firsts = [b[0] for b in self.blocks]
         self._f = open(os.path.join(path, "items.bin"), "rb")
-        self._lock = threading.Lock()
+        self._lock = make_lock("mergeset._FilePart._lock")
         self._block_cache: "OrderedDict[int, list[bytes]]" = OrderedDict()
 
     def close(self):
@@ -200,7 +200,7 @@ class Table:
     def __init__(self, path: str):
         self.path = path
         os.makedirs(path, exist_ok=True)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("mergeset.Table._lock")
         self._pending: list[bytes] = []
         self._pending_sorted: list[bytes] | None = []  # None = dirty
         self._mem_parts: list[list[bytes]] = []

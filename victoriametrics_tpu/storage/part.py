@@ -347,8 +347,8 @@ class Part:
                     setattr(self, attr, np.frombuffer(mm, dtype=np.uint8))
         except (OSError, ValueError):
             self._ts_buf = self._val_buf = None  # fall back to pread path
-        import threading
-        self._lock = threading.Lock()
+        from ..devtools.locktrace import make_lock
+        self._lock = make_lock("storage.Part._lock")
         # parts are immutable, so both caches never go stale (the reference
         # keeps compressed blocks in lib/blockcache sized to 25% RAM; here we
         # cache the *decoded* form so warm queries skip unmarshal entirely)

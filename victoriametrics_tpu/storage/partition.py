@@ -18,10 +18,10 @@ import itertools
 import json
 import os
 import shutil
-import threading
 
 import numpy as np
 
+from ..devtools.locktrace import make_rlock
 from ..utils import logger
 from .block import MAX_ROWS_PER_BLOCK, Block, rows_to_blocks
 from .dedup import deduplicate
@@ -423,10 +423,10 @@ class Partition:
         self.path = path
         self.name = name
         self.dedup_interval_ms = dedup_interval_ms
-        self._lock = threading.RLock()
+        self._lock = make_rlock("storage.Partition._lock")
         # serializes whole flush/merge operations (heavy part writes run
         # outside _lock so ingest/reads never stall behind them)
-        self._flush_mutex = threading.RLock()
+        self._flush_mutex = make_rlock("storage.Partition._flush_mutex")
         self._pending: list = []        # row tuples and/or PendingChunks
         self._pending_nrows = 0
         # incremental InmemoryPart views over _pending: each query converts

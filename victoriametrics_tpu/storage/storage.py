@@ -19,6 +19,7 @@ import time
 
 import numpy as np
 
+from ..devtools.locktrace import make_rlock
 from ..utils import logger
 from .dedup import deduplicate
 from .index_db import IndexDB, date_of_ms
@@ -179,7 +180,7 @@ class Storage:
         self._cspaces: dict[tuple, "_ColumnarSpace"] = {}
         self._day_cache: set[tuple[int, int]] = set()  # (metric_id, date)
         self._mid_gen = MetricIDGenerator()
-        self._lock = threading.RLock()
+        self._lock = make_rlock("storage.Storage._lock")
         self._stop = threading.Event()
         self._readonly = False
         self.rows_added = 0

@@ -6,10 +6,9 @@ from __future__ import annotations
 import datetime
 import os
 import shutil
-import threading
-
 import numpy as np
 
+from ..devtools.locktrace import make_rlock
 from ..utils import logger
 from .partition import Partition
 
@@ -32,7 +31,7 @@ class Table:
     def __init__(self, path: str, dedup_interval_ms: int = 0):
         self.path = path
         self.dedup_interval_ms = dedup_interval_ms
-        self._lock = threading.RLock()
+        self._lock = make_rlock("storage.Table._lock")
         self._partitions: dict[str, Partition] = {}
         self._day_to_partition: dict[int, str] = {}
         os.makedirs(path, exist_ok=True)

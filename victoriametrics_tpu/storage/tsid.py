@@ -9,10 +9,11 @@ globally unique and is the key used by posting lists and caches.
 from __future__ import annotations
 
 import struct
-import threading
 import time
 
 import xxhash
+
+from ..devtools.locktrace import make_lock
 
 _FMT = struct.Struct(">IIQIIQ")  # account, project, group, job, instance, metric
 
@@ -69,7 +70,7 @@ class MetricIDGenerator:
     stay unique across restarts without persistence)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("storage.MetricIDGenerator._lock")
         self._next = time.time_ns() & ((1 << 62) - 1)
 
     def next_id(self) -> int:

@@ -25,3 +25,8 @@ def unix_timestamp() -> int:
 
 def unix_ms() -> int:
     return int(time.time() * 1000)
+
+
+def unix_seconds() -> float:
+    """Float unix seconds (for durations/uptime at ms resolution)."""
+    return time.time()

@@ -122,7 +122,8 @@ def verify(token: str, secrets: list[str] | None = None,
         raise JWTError(f"unsupported alg {alg!r}")
     if not ok:
         raise JWTError("signature verification failed")
-    t = time.time() if now is None else now
+    # token exp/nbf claims are absolute wall-clock by spec
+    t = time.time() if now is None else now  # vmt: disable=VMT001
     try:
         if "exp" in claims and t > float(claims["exp"]):
             raise JWTError("token expired")
