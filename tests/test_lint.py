@@ -97,6 +97,20 @@ FIXTURES = {
         "def host_side(x):\n"
         "    return float(np.asarray(x).sum())  # not traced: fine\n",
     ),
+    "VMT007": (
+        "class Ingestor:\n"
+        "    def push(self, rows):\n"
+        "        self.rows_pushed_total += 1\n"
+        "        self.errors += len(rows)\n",
+        "from victoriametrics_tpu.utils import metrics as metricslib\n"
+        "class Ingestor:\n"
+        "    def push(self, rows):\n"
+        "        metricslib.REGISTRY.counter(\n"
+        "            'vm_rows_pushed_total').inc()\n"
+        "        self.batch_size += len(rows)  # not a counter name\n"
+        "        total = 0\n"
+        "        total += 1  # plain local accumulator: fine\n",
+    ),
 }
 
 
@@ -152,7 +166,7 @@ def test_cli_main_exits_zero_on_clean_tree():
     assert lint.main([]) == 0
 
 
-def test_cli_lists_all_six_rules(capsys):
+def test_cli_lists_all_rules(capsys):
     assert lint.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in sorted(FIXTURES):

@@ -50,8 +50,9 @@ def build(args):
     hh, _, hp = args.httpListenAddr.rpartition(":")
     http = HTTPServer(hh or "0.0.0.0", int(hp))
     http.route("/health", lambda req: Response.text("OK"))
+    from ..utils import metrics as metricslib
     http.route("/metrics", lambda req: Response.text(
-        "".join(f"{k} {v}\n" for k, v in sorted(storage.metrics().items()))))
+        metricslib.REGISTRY.write_prometheus(extra=storage.metrics())))
     http.route("/snapshot/create", lambda req: Response.json(
         {"status": "ok", "snapshot": storage.create_snapshot()}))
     http.route("/snapshot/list", lambda req: Response.json(

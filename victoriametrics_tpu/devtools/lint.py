@@ -96,9 +96,11 @@ class FileContext:
 
 
 def all_rules() -> list:
-    from . import rules_jax, rules_locks, rules_pyflaws, rules_time
+    from . import (rules_jax, rules_locks, rules_metrics, rules_pyflaws,
+                   rules_time)
     rules = []
-    for mod in (rules_time, rules_pyflaws, rules_locks, rules_jax):
+    for mod in (rules_time, rules_pyflaws, rules_locks, rules_jax,
+                rules_metrics):
         rules.extend(mod.RULES)
     return sorted(rules, key=lambda r: r.rule_id)
 
@@ -216,7 +218,7 @@ def stale_baseline_entries(findings: list[Finding], baseline: Counter,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m victoriametrics_tpu.devtools.lint",
-        description="Project-specific AST lint (rules VMT001..VMT006).")
+        description="Project-specific AST lint (rules VMT001..VMT007).")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the package)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,

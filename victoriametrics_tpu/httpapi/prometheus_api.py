@@ -30,9 +30,11 @@ from ..query.eval import QueryError, filters_from_metric_expr
 from ..query.metricsql import parse as mql_parse
 from ..query.metricsql.ast import MetricExpr
 from ..query.metricsql.parser import ParseError, parse_duration_ms
+from ..query.querystats import ActiveQueries, QueryStats
 from ..query.types import EvalConfig
 from ..storage.metric_name import MetricName
 from ..utils import fasttime, logger
+from ..utils import metrics as metricslib
 from .server import HTTPServer, Request, Response
 
 
@@ -75,66 +77,6 @@ def parse_step(s: str, default_ms: int = 60_000) -> int:
 
 
 from ..query.format_value import fmt_value as _fmt_value  # noqa: E402
-
-
-class ActiveQueries:
-    """In-flight query registry (app/vmselect/promql/active_queries.go)."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._next = 0
-        self._live: dict[int, dict] = {}
-
-    def register(self, query: str, start, end, step) -> int:
-        with self._lock:
-            self._next += 1
-            qid = self._next
-            self._live[qid] = {"qid": qid, "query": query, "start": start,
-                               "end": end, "step": step,
-                               "t": fasttime.unix_seconds()}
-            return qid
-
-    def unregister(self, qid: int):
-        with self._lock:
-            self._live.pop(qid, None)
-
-    def snapshot(self) -> list[dict]:
-        with self._lock:
-            now = fasttime.unix_seconds()
-            return [{**q, "duration": f"{now - q['t']:.3f}s"}
-                    for q in self._live.values()]
-
-
-class QueryStats:
-    """Top-queries registry (app/vmselect/querystats)."""
-
-    def __init__(self, max_entries: int = 1000):
-        self._lock = threading.Lock()
-        self._stats: dict[tuple, list] = {}
-        self.max_entries = max_entries
-
-    def record(self, query: str, time_range_s: float, duration_s: float):
-        key = (query, round(time_range_s))
-        with self._lock:
-            e = self._stats.get(key)
-            if e is None:
-                if len(self._stats) >= self.max_entries:
-                    return
-                e = self._stats[key] = [0, 0.0]
-            e[0] += 1
-            e[1] += duration_s
-
-    def top(self, n: int, key: str) -> list[dict]:
-        with self._lock:
-            items = [{"query": q, "timeRangeSeconds": tr, "count": c,
-                      "sumDurationSeconds": round(d, 6),
-                      "avgDurationSeconds": round(d / c, 6)}
-                     for (q, tr), (c, d) in self._stats.items()]
-        sorters = {"count": lambda x: -x["count"],
-                   "sumDuration": lambda x: -x["sumDurationSeconds"],
-                   "avgDuration": lambda x: -x["avgDurationSeconds"]}
-        items.sort(key=sorters.get(key, sorters["count"]))
-        return items[:n]
 
 
 class ConcurrencyGate:
@@ -1120,11 +1062,12 @@ class PrometheusAPI:
 
     def h_top_queries(self, req: Request) -> Response:
         n = int(req.arg("topN", "20"))
+        tops = self.qstats.tops(n)
         return Response.json({
             "status": "ok",
-            "topByCount": self.qstats.top(n, "count"),
-            "topBySumDuration": self.qstats.top(n, "sumDuration"),
-            "topByAvgDuration": self.qstats.top(n, "avgDuration"),
+            "topByCount": tops["count"],
+            "topBySumDuration": tops["sumDuration"],
+            "topByAvgDuration": tops["avgDuration"],
         })
 
     def _track_usage(self, rows):
@@ -1242,10 +1185,17 @@ class PrometheusAPI:
                               "not_found")
 
     def h_metrics(self, req: Request) -> Response:
-        lines = []
-        m = dict(self.storage.metrics())
-        m["vm_http_requests_total"] = getattr(self, "srv", None) and \
-            self.srv.request_count or 0
+        """Prometheus exposition for the whole process: the central
+        registry (per-path HTTP histograms, cache hit/miss, RPC
+        durations, TPU kernel split, process_*) plus the app-level
+        counters collected here."""
+        m = dict(self.storage.metrics()) \
+            if getattr(self.storage, "metrics", None) is not None else {}
+        srv = getattr(self, "srv", None)
+        if srv is not None:
+            m["vm_http_requests_all_total"] = srv.request_count
+        else:
+            m["vm_http_requests_all_total"] = 0
         m["vm_rows_inserted_total"] = self.rows_inserted
         m["vm_relabel_metrics_dropped_total"] = self.rows_relabel_dropped
         if self.rate_limiter is not None and \
@@ -1254,14 +1204,13 @@ class PrometheusAPI:
                 self.rate_limiter.global_rl.limit_reached
         if self.series_limits is not None:
             m.update(self.series_limits.metrics())
-        m["vm_app_uptime_seconds"] = round(fasttime.unix_seconds() - self.started_at, 3)
-        for k, v in sorted(m.items()):
-            lines.append(f"{k} {v}")
+        m["vm_concurrent_select_limit_reached_total"] = self.gate.rejected
         for lvl, cnt in logger.message_counters().items():
-            lines.append(f'vm_log_messages_total{{level="{lvl}"}} {cnt}')
-        for tkey, cnt in sorted(self.tenant_rows.items()):
-            lines.append(f"vm_tenant_inserted_rows_total{tkey} {cnt}")
-        return Response.text("\n".join(lines) + "\n")
+            m[metricslib.format_name("vm_log_messages_total",
+                                     {"level": lvl})] = cnt
+        for tkey, cnt in self.tenant_rows.items():
+            m[f"vm_tenant_inserted_rows_total{tkey}"] = cnt
+        return Response.text(metricslib.REGISTRY.write_prometheus(extra=m))
 
     def h_snapshot_create(self, req: Request) -> Response:
         name = self.storage.create_snapshot()

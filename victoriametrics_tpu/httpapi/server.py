@@ -7,12 +7,14 @@ from __future__ import annotations
 import gzip
 import json
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..ingest.ratelimiter import RateLimitedError
 from ..ops import compress as zstd
 from ..utils import logger
+from ..utils import metrics as metricslib
 
 
 class Request:
@@ -67,6 +69,7 @@ class HTTPServer:
                  tls_cert_file: str = "", tls_key_file: str = ""):
         self.routes: dict[str, object] = {}
         self.prefix_routes: list[tuple[str, object]] = []
+        self._path_metric_memo: dict[str, tuple] = {}
         self.auth_key = auth_key
         self.basic_auth = basic_auth
         self.request_count = 0
@@ -96,11 +99,17 @@ class HTTPServer:
                                               400))
                     return
                 req = Request(self, body)
-                fn = outer._route_for(req.path)
+                fn, pattern = outer._route_match(req.path)
                 if fn is None:
+                    # unmatched paths share one label: raw-path labels
+                    # would let clients mint unbounded series
+                    outer._path_metrics("*unsupported*")[0].inc()
                     self._send(Response.error(
                         f"unsupported path {req.path}", 404, "not_found"))
                     return
+                requests, duration, errors = outer._path_metrics(pattern)
+                requests.inc()
+                t0 = time.perf_counter()
                 try:
                     resp = fn(req)
                 except RateLimitedError as e:
@@ -112,6 +121,9 @@ class HTTPServer:
                     import traceback
                     traceback.print_exc()
                     resp = Response.error(str(e), 500, "internal")
+                duration.update(time.perf_counter() - t0)
+                if resp.status >= 500:
+                    errors.inc()
                 self._send(resp)
 
             def _send(self, resp: Response):
@@ -157,14 +169,36 @@ class HTTPServer:
         else:
             self.routes[path] = fn
 
+    def _path_metrics(self, pattern: str):
+        """(requests counter, duration histogram, errors counter) for one
+        route pattern, resolved once per pattern — keeps the name
+        formatting and registry lock off the per-request path.  Patterns
+        are the registered routes, so the memo is bounded."""
+        m = self._path_metric_memo.get(pattern)
+        if m is None:
+            labels = {"path": pattern}
+            m = self._path_metric_memo[pattern] = (
+                metricslib.REGISTRY.counter(metricslib.format_name(
+                    "vm_http_requests_total", labels)),
+                metricslib.REGISTRY.histogram(metricslib.format_name(
+                    "vm_request_duration_seconds", labels)),
+                metricslib.REGISTRY.counter(metricslib.format_name(
+                    "vm_http_request_errors_total", labels)))
+        return m
+
     def _route_for(self, path: str):
+        return self._route_match(path)[0]
+
+    def _route_match(self, path: str):
+        """(handler, route pattern) — the pattern (exact path or prefix)
+        is the bounded-cardinality label for per-path metrics."""
         fn = self.routes.get(path)
         if fn is not None:
-            return fn
+            return fn, path
         for prefix, pfn in self.prefix_routes:
             if path.startswith(prefix):
-                return pfn
-        return None
+                return pfn, prefix
+        return None, ""
 
     def start(self):
         self._started = True

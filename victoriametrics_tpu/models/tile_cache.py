@@ -16,12 +16,30 @@ from __future__ import annotations
 
 import collections
 import threading
+import weakref
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import metrics as metricslib
+
 UPLOAD_CHUNK_BYTES = 8 << 20
+
+# cache self-metrics (reference vm_cache_{requests,misses}_total +
+# vm_cache_{size_bytes,entries}{type=...}); gauges sum over every live
+# TileCache so embedded/test setups with several engines stay correct
+_instances: "weakref.WeakSet[TileCache]" = weakref.WeakSet()
+_CACHE_REQUESTS = metricslib.REGISTRY.counter(
+    'vm_cache_requests_total{type="tpu/tile_cache"}')
+_CACHE_MISSES = metricslib.REGISTRY.counter(
+    'vm_cache_misses_total{type="tpu/tile_cache"}')
+metricslib.REGISTRY.gauge(
+    'vm_cache_size_bytes{type="tpu/tile_cache"}',
+    callback=lambda: sum(c.size_bytes for c in list(_instances)))
+metricslib.REGISTRY.gauge(
+    'vm_cache_entries{type="tpu/tile_cache"}',
+    callback=lambda: sum(c.entry_count() for c in list(_instances)))
 
 
 def chunked_device_put(x: np.ndarray, device=None) -> jax.Array:
@@ -49,6 +67,7 @@ class TileCache:
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        _instances.add(self)
 
     def _tree_bytes(self, tree) -> int:
         total = 0
@@ -60,13 +79,15 @@ class TileCache:
         return total
 
     def get(self, key):
+        _CACHE_REQUESTS.inc()
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
                 self.hits += 1
                 return self._entries[key]
             self.misses += 1
-            return None
+        _CACHE_MISSES.inc()
+        return None
 
     def put(self, key, host_tree):
         """Upload a pytree of numpy arrays; returns the device tree. A tree
@@ -126,6 +147,11 @@ class TileCache:
             elif key in self._entries:
                 self._bytes -= self._sizes.pop(key)
                 del self._entries[key]
+
+    def entry_count(self) -> int:
+        # locked: a /metrics scrape must not read len() mid-evict
+        with self._lock:
+            return len(self._entries)
 
     @property
     def size_bytes(self) -> int:

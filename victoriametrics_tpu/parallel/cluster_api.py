@@ -21,7 +21,8 @@ import numpy as np
 from ..devtools.locktrace import make_lock
 from ..storage.metric_name import MetricName
 from ..storage.tag_filters import TagFilter
-from ..utils import logger
+from ..utils import logger, querytracer
+from ..utils import metrics as metricslib
 from .consistenthash import ConsistentHash
 from .rpc import HELLO_INSERT, HELLO_SELECT, RPCClient, RPCError, Reader, Writer
 
@@ -114,14 +115,37 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
     # sentinel "count" marking the trailing metadata frame of search_v1
     META_FRAME = (1 << 32) - 1
 
+    def _read_trace_flag(r: Reader) -> bool:
+        """Optional trailing trace-request flag (search_v1 extension).
+        Old clients simply don't send it — Reader tolerance gives
+        rolling-upgrade compat both ways."""
+        return bool(r.u64()) if r.remaining else False
+
+    def _meta_frame(qt) -> Writer:
+        """Trailing metadata frame: partial-result flag + (when tracing)
+        the storage-side span tree, grafted into the caller's trace."""
+        import json
+        meta = Writer().u64(META_FRAME)
+        meta.u64(1 if getattr(storage, "last_partial", False) else 0)
+        if qt.enabled:
+            qt.donef("")
+            meta.bytes_(json.dumps(qt.to_dict()).encode())
+        return meta
+
     def h_search(r: Reader):
         tenant = _read_tenant(r)
         filters = _read_filters(r)
         min_ts, max_ts = r.i64(), r.i64()
+        qt = querytracer.new(_read_trace_flag(r),
+                             "vmstorage search_v1: %d filters, "
+                             "timeRange=[%d..%d]", len(filters), min_ts,
+                             max_ts)
         if hasattr(storage, "reset_partial"):
             storage.reset_partial()
-        series = storage.search_series(filters, min_ts, max_ts,
-                                       tenant=tenant)
+        with qt.new_child("search_series") as sq:
+            series = storage.search_series(filters, min_ts, max_ts,
+                                           tenant=tenant)
+            sq.donef("%d series", len(series))
 
         def frames():
             for i in range(0, len(series), SERIES_PER_FRAME):
@@ -133,11 +157,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                     w.array(sd.timestamps)
                     w.array(sd.values)
                 yield w
-            # trailing metadata frame: propagate partial-result state up
-            # through multilevel chains
-            meta = Writer().u64(META_FRAME)
-            meta.u64(1 if getattr(storage, "last_partial", False) else 0)
-            yield meta
+            yield _meta_frame(qt)
         return frames()
 
     def h_search_columns(r: Reader):
@@ -149,11 +169,18 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         tenant = _read_tenant(r)
         filters = _read_filters(r)
         min_ts, max_ts = r.i64(), r.i64()
+        qt = querytracer.new(_read_trace_flag(r),
+                             "vmstorage searchColumns_v1: %d filters, "
+                             "timeRange=[%d..%d]", len(filters), min_ts,
+                             max_ts)
         if hasattr(storage, "reset_partial"):
             storage.reset_partial()
         if getattr(storage, "search_columns", None) is not None:
-            cols = storage.search_columns(filters, min_ts, max_ts,
-                                          tenant=tenant)
+            with qt.new_child("search_columns") as sq:
+                cols = storage.search_columns(filters, min_ts, max_ts,
+                                              tenant=tenant)
+                sq.donef("%d series, %d samples", cols.n_series,
+                         cols.n_samples)
             raw_names = cols.raw_names
             counts = cols.counts
             ts2, v2 = cols.ts, cols.vals
@@ -164,8 +191,10 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                     counts[a:b, None]
                 return ts2[a:b][sel], v2[a:b][sel]
         else:  # per-series storage: adapt
-            series = storage.search_series(filters, min_ts, max_ts,
-                                           tenant=tenant)
+            with qt.new_child("search_series (columnar adapt)") as sq:
+                series = storage.search_series(filters, min_ts, max_ts,
+                                               tenant=tenant)
+                sq.donef("%d series", len(series))
             raw_names = [getattr(sd, "raw_name", None) or
                          sd.metric_name.marshal() for sd in series]
             counts = np.fromiter((sd.timestamps.size for sd in series),
@@ -194,9 +223,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                 w.array(np.asarray(ts_cat, np.int64))
                 w.array(np.asarray(v_cat, np.float64))
                 yield w
-            meta = Writer().u64(META_FRAME)
-            meta.u64(1 if getattr(storage, "last_partial", False) else 0)
-            yield meta
+            yield _meta_frame(qt)
         return frames()
 
     def h_search_metric_names(r: Reader):
@@ -389,17 +416,33 @@ class StorageNodeClient:
         self.write_rows(rows, tenant)
         return len(rows)
 
-    def search_series(self, filters, min_ts, max_ts, tenant=(0, 0)):
+    @staticmethod
+    def _read_meta(r: Reader, tracer) -> bool:
+        """Parse the trailing metadata frame: partial flag + (when the
+        server traced) the storage-side span tree, grafted under
+        `tracer`.  Old servers send no trace bytes — remaining==0."""
+        partial = bool(r.u64())
+        if r.remaining:
+            import json
+            try:
+                tracer.add_remote(json.loads(r.bytes_()))
+            except (ValueError, RPCError):
+                pass  # malformed remote trace must never fail the search
+        return partial
+
+    def search_series(self, filters, min_ts, max_ts, tenant=(0, 0),
+                      tracer=querytracer.NOP):
         """Returns (series_list, remote_partial)."""
         w = _write_tenant(Writer(), tenant)
         _write_filters(w, filters)
         w.i64(min_ts).i64(max_ts)
+        w.u64(1 if tracer.enabled else 0)
         out = []
         partial = False
         for r in self.select.call_stream("search_v1", w):
             n = r.u64()
             if n == (1 << 32) - 1:  # trailing metadata frame
-                partial = bool(r.u64())
+                partial = self._read_meta(r, tracer)
                 continue
             for _ in range(n):
                 mn = MetricName.unmarshal(r.bytes_())
@@ -410,7 +453,8 @@ class StorageNodeClient:
 
     supports_columnar_read = True  # cleared on first unknown-method error
 
-    def search_columns(self, filters, min_ts, max_ts, tenant=(0, 0)):
+    def search_columns(self, filters, min_ts, max_ts, tenant=(0, 0),
+                       tracer=querytracer.NOP):
         """Columnar read plane: returns (raw_names list, counts int64[],
         ts_cat int64[], vals_cat float64[], remote_partial). Falls back to
         search_v1 against old nodes (same return shape)."""
@@ -418,6 +462,7 @@ class StorageNodeClient:
             w = _write_tenant(Writer(), tenant)
             _write_filters(w, filters)
             w.i64(min_ts).i64(max_ts)
+            w.u64(1 if tracer.enabled else 0)
             try:
                 frames = self.select.call_stream("searchColumns_v1", w)
             except RPCError as e:
@@ -432,7 +477,7 @@ class StorageNodeClient:
                 for r in frames:
                     sf = r.u64()
                     if sf == (1 << 32) - 1:  # trailing metadata frame
-                        partial = bool(r.u64())
+                        partial = self._read_meta(r, tracer)
                         continue
                     lens = r.array()
                     namebuf = r.bytes_()
@@ -449,7 +494,7 @@ class StorageNodeClient:
                         cat(ts_parts, np.int64),
                         cat(val_parts, np.float64), partial)
         series, partial = self.search_series(filters, min_ts, max_ts,
-                                             tenant)
+                                             tenant, tracer=tracer)
         names = [mn.marshal() for mn, _, _ in series]
         counts = np.fromiter((ts.size for _, ts, _ in series), np.int64,
                              len(series))
@@ -567,6 +612,10 @@ class ClusterStorage:
         self.cache_token = next_storage_token()
         self.rows_sent = 0
         self.reroutes = 0
+        self._rows_sent_counter = metricslib.REGISTRY.counter(
+            "vm_rpc_rows_sent_total")
+        self._reroutes_counter = metricslib.REGISTRY.counter(
+            "vm_rpc_rows_rerouted_total")
         self._lock = make_lock("parallel.VMSelect._lock")
         # partial-result tracking is per handler thread and STICKY across
         # the fanouts of one query (a shared flag would race between
@@ -611,6 +660,7 @@ class ClusterStorage:
                 node.mark_down()
                 with self._lock:
                     self.reroutes += 1
+                self._reroutes_counter.inc()
                 # regroup the failed batch by alternate node: one RPC per
                 # target, not one per row
                 ex = {j for j, n in enumerate(self.nodes)
@@ -626,6 +676,7 @@ class ClusterStorage:
                     self.nodes[j].write_rows(batch, tenant)
                     sent += len(batch)
         self.rows_sent += sent
+        self._rows_sent_counter.inc(sent)
         return len(rows)
 
     # columnar ingest: the vminsert HTTP fast path (native text parse ->
@@ -729,6 +780,7 @@ class ClusterStorage:
                 self.nodes[i].mark_down()
                 with self._lock:
                     self.reroutes += 1
+                self._reroutes_counter.inc()
                 ex = {j2 for j2, n in enumerate(self.nodes)
                       if not n.healthy} | {i}
                 alt_batches: dict[int, list] = {}
@@ -749,6 +801,7 @@ class ClusterStorage:
                 self.nodes[i].mark_down()
                 with self._lock:
                     self.reroutes += 1
+                self._reroutes_counter.inc()
                 ex = {j2 for j2, n in enumerate(self.nodes)
                       if not n.healthy} | {i}
                 alt_shards: dict[int, tuple[list, list]] = {}
@@ -764,6 +817,7 @@ class ClusterStorage:
                     sent += self._send_columnar_shard(self.nodes[j2], ks,
                                                       rl, tss, vals, tenant)
         self.rows_sent += sent
+        self._rows_sent_counter.inc(sent)
         return int(n_rows - dropped_transform - dropped_malformed)
 
     @staticmethod
@@ -859,9 +913,13 @@ class ClusterStorage:
                 f"partial response denied: {errors[0][0]}: {errors[0][1]}")
         return results
 
+    # eval passes ec.tracer down so storage-node spans land in the query
+    # trace (the vmselect->vmstorage half of cross-RPC tracing)
+    supports_search_tracer = True
+
     def search_columns(self, filters, min_ts, max_ts,
                        dedup_interval_ms=None, max_series=None,
-                       tenant=(0, 0)):
+                       tenant=(0, 0), tracer=querytracer.NOP):
         """Columnar scatter-gather: every node streams (raw names,
         counts, concatenated columns) over searchColumns_v1; the merge is
         ONE vectorized assembly into the padded (S, N) layout — cluster
@@ -870,8 +928,16 @@ class ClusterStorage:
         per-row sort fix + exact-duplicate-timestamp dedup (keep last),
         identical to the old per-series merge semantics."""
         from ..storage.columnar import ColumnarSeries, assemble
-        node_results = self._fanout(
-            lambda n: n.search_columns(filters, min_ts, max_ts, tenant))
+
+        def query_node(n):
+            # one child span per storage node; children.append is
+            # GIL-atomic, so concurrent fan-out threads are safe
+            with tracer.new_child("rpc searchColumns_v1 node %s",
+                                  n.name) as nqt:
+                return n.search_columns(filters, min_ts, max_ts, tenant,
+                                        tracer=nqt)
+
+        node_results = self._fanout(query_node)
         names_all: list[bytes] = []
         cnt_parts, ts_parts, val_parts = [], [], []
         for names, counts, ts_cat, val_cat, remote_partial in node_results:
@@ -920,10 +986,12 @@ class ClusterStorage:
         return cols
 
     def search_series(self, filters, min_ts, max_ts, dedup_interval_ms=None,
-                      max_series=None, tenant=(0, 0)):
+                      max_series=None, tenant=(0, 0),
+                      tracer=querytracer.NOP):
         return self.search_columns(
             filters, min_ts, max_ts, dedup_interval_ms=dedup_interval_ms,
-            max_series=max_series, tenant=tenant).to_series_list()
+            max_series=max_series, tenant=tenant,
+            tracer=tracer).to_series_list()
 
     def search_metric_names(self, filters, min_ts, max_ts, limit=2**31,
                             tenant=(0, 0)):

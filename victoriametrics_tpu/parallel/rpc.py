@@ -15,14 +15,44 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 
 import numpy as np
 
 from ..devtools.locktrace import make_lock
 
-from ..ops import compress as zstd
+try:
+    from ..ops import compress as zstd
+except ImportError:  # optional native dep (zstandard): the marshal layer
+    zstd = None      # (Writer/Reader) stays importable; only frame I/O needs it
+
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
 from ..utils import logger
+from ..utils import metrics as metricslib
+
+
+# per-(family, method) handle memo: keeps the format_name + name-regex +
+# registry-lock round trip off the per-call path (method sets are tiny and
+# bounded; a benign double-create under race resolves to the same handle)
+_metric_memo: dict[tuple, object] = {}
+
+
+def _rpc_counter(name: str, method: str):
+    key = (name, method)
+    m = _metric_memo.get(key)
+    if m is None:
+        m = _metric_memo[key] = metricslib.REGISTRY.counter(
+            metricslib.format_name(name, {"method": method}))
+    return m
+
+
+def _rpc_histogram(name: str, method: str):
+    key = (name, method)
+    m = _metric_memo.get(key)
+    if m is None:
+        m = _metric_memo[key] = metricslib.REGISTRY.histogram(
+            metricslib.format_name(name, {"method": method}))
+    return m
 
 HELLO_INSERT = b"vmtpu-insert.v2\n"
 HELLO_SELECT = b"vmtpu-select.v2\n"
@@ -44,6 +74,8 @@ def _read_exact(sock_file, n: int) -> bytes:
 
 
 def write_frame(sock_file, payload: bytes, compress: bool = True):
+    if zstd is None:
+        raise RPCError("rpc frames need the 'zstandard' package")
     if compress:
         payload = zstd.compress(payload)
     sock_file.write(_U32.pack(len(payload)) + payload)
@@ -51,6 +83,8 @@ def write_frame(sock_file, payload: bytes, compress: bool = True):
 
 
 def read_frame(sock_file, compressed: bool = True) -> bytes:
+    if zstd is None:
+        raise RPCError("rpc frames need the 'zstandard' package")
     n = _U32.unpack(_read_exact(sock_file, 4))[0]
     if n > MAX_FRAME:
         raise RPCError(f"rpc frame too large: {n}")
@@ -181,8 +215,11 @@ class RPCServer:
 
     def _dispatch(self, req: bytes, wfile):
         r = Reader(req)
+        method = "?"
+        t0 = time.perf_counter()
         try:
             method = r.str_()
+            _rpc_counter("vm_rpc_server_calls_total", method).inc()
             fn = self.handlers.get(method)
             if fn is None:
                 raise RPCError(f"unknown rpc method {method!r}")
@@ -195,11 +232,15 @@ class RPCServer:
                 body = out.payload() if isinstance(out, Writer) else b""
                 write_frame(wfile, b"\x00" + body)
         except Exception as e:  # noqa: BLE001 — rpc error boundary
+            _rpc_counter("vm_rpc_server_errors_total", method).inc()
             logger.errorf("rpc handler error: %s", e)
             try:
                 write_frame(wfile, b"\x01" + str(e).encode())
             except OSError:
                 pass
+        finally:
+            _rpc_histogram("vm_rpc_server_call_duration_seconds",
+                           method).update(time.perf_counter() - t0)
 
 
 # -- client ------------------------------------------------------------------
@@ -260,32 +301,43 @@ class RPCClient:
         if w is not None:
             req.buf += w.buf
         frames: list[Reader] = []
-        with self._lock:
-            # A stale kept-alive connection (peer restarted) usually fails at
-            # the FIRST read, not the write (which lands in the send buffer),
-            # so retry once on a fresh connection as long as no frame has
-            # been received yet.
-            for attempt in (0, 1):
-                try:
-                    if self._f is None:
-                        self._connect()
-                    write_frame(self._f, req.payload())
-                    while True:
-                        resp = read_frame(self._f)
-                        status = resp[0]
-                        if status == 0:
-                            if len(resp) > 1:
-                                frames.append(Reader(resp[1:]))
-                            return iter(frames)
-                        if status == 1:
-                            # server-reported error: stream is cleanly
-                            # terminated, the connection stays usable
-                            raise RPCError(resp[1:].decode())
-                        frames.append(Reader(resp[1:]))
-                except RPCError:
-                    raise
-                except (OSError, ConnectionError, TimeoutError):
-                    self._close_locked()
-                    if attempt == 1 or frames:
+        _rpc_counter("vm_rpc_client_calls_total", method).inc()
+        t0 = time.perf_counter()
+        try:
+            with self._lock:
+                # A stale kept-alive connection (peer restarted) usually
+                # fails at the FIRST read, not the write (which lands in the
+                # send buffer), so retry once on a fresh connection as long
+                # as no frame has been received yet.
+                for attempt in (0, 1):
+                    try:
+                        if self._f is None:
+                            self._connect()
+                        write_frame(self._f, req.payload())
+                        while True:
+                            resp = read_frame(self._f)
+                            status = resp[0]
+                            if status == 0:
+                                if len(resp) > 1:
+                                    frames.append(Reader(resp[1:]))
+                                return iter(frames)
+                            if status == 1:
+                                # server-reported error: stream is cleanly
+                                # terminated, the connection stays usable
+                                raise RPCError(resp[1:].decode())
+                            frames.append(Reader(resp[1:]))
+                    except RPCError:
                         raise
-        return iter(frames)
+                    except (OSError, ConnectionError, TimeoutError):
+                        self._close_locked()
+                        if attempt == 1 or frames:
+                            raise
+                        _rpc_counter("vm_rpc_client_retries_total",
+                                     method).inc()
+            return iter(frames)
+        except Exception:
+            _rpc_counter("vm_rpc_client_errors_total", method).inc()
+            raise
+        finally:
+            _rpc_histogram("vm_rpc_client_call_duration_seconds",
+                           method).update(time.perf_counter() - t0)

@@ -252,9 +252,11 @@ def _fetch_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
     and columnar): deadline, -search.maxSamplesPerQuery, rollup memory
     admission (eval.go:1776-1885), partial-result capture, tracing.
 
-    `fetcher(filters, lo, hi)` performs the storage search plus any
-    stale-sample handling and returns (payload, n_series, n_samples); the
-    caller holds the returned `admission` while computing the rollup."""
+    `fetcher(filters, lo, hi, qt)` performs the storage search plus any
+    stale-sample handling and returns (payload, n_series, n_samples); `qt`
+    is the fetch span (cluster storages thread it through the RPC so
+    storage-node spans graft under it); the caller holds the returned
+    `admission` while computing the rollup."""
     from .limits import admit_rollup
     me: MetricExpr = re_.expr
     if ec.storage is None:
@@ -272,32 +274,43 @@ def _fetch_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
     fetch_info = (fetch_lo, end,
                   getattr(ec.storage, "data_version", None))
     filters = filters_from_metric_expr(me)
-    qt = ec.tracer.new_child(trace_label + " %s window=%dms", me, lookback)
-    try:
-        payload, n_series, n_samples = fetcher(filters, fetch_lo, end)
-    except ResourceWarning as e:
-        from .limits import QueryLimitError
-        raise QueryLimitError(
-            f"{e}; either narrow the selector or raise "
-            f"-search.maxUniqueTimeseries") from None
-    if getattr(ec.storage, "last_partial", False):
-        # capture partiality PER QUERY right after the fetch: the shared
-        # storage flag is reset by every new incoming request
-        ec._partial[0] = True
-    ec.count_samples(n_samples)
-    qt.donef("%d series, %d samples", n_series, n_samples)
+    with ec.tracer.new_child(trace_label + " %s window=%dms", me,
+                             lookback) as qt:
+        try:
+            payload, n_series, n_samples = fetcher(filters, fetch_lo, end,
+                                                   qt)
+        except ResourceWarning as e:
+            from .limits import QueryLimitError
+            raise QueryLimitError(
+                f"{e}; either narrow the selector or raise "
+                f"-search.maxUniqueTimeseries") from None
+        if getattr(ec.storage, "last_partial", False):
+            # capture partiality PER QUERY right after the fetch: the
+            # shared storage flag is reset by every new incoming request
+            ec._partial[0] = True
+        ec.count_samples(n_samples)
+        qt.donef("%d series, %d samples", n_series, n_samples)
     cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
     admission = admit_rollup(str(me), n_series, ec.n_points,
                              ec.max_memory_per_query)
     return payload, cfg, admission, fetch_info
 
 
+def _tracer_kw(ec: EvalConfig, qt) -> dict:
+    """Thread the fetch span through storages that can propagate it over
+    RPC (ClusterStorage); plain storages take no tracer kwarg."""
+    if qt.enabled and getattr(ec.storage, "supports_search_tracer", False):
+        return {"tracer": qt}
+    return {}
+
+
 def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
                              window: int, offset: int):
-    def fetcher(filters, lo, hi):
+    def fetcher(filters, lo, hi, qt):
         series = ec.storage.search_series(filters, lo, hi,
                                           max_series=ec.max_series,
-                                          tenant=ec.tenant)
+                                          tenant=ec.tenant,
+                                          **_tracer_kw(ec, qt))
         series = _drop_stale_nans(func, series)
         return series, len(series), sum(s.timestamps.size for s in series)
 
@@ -309,10 +322,11 @@ def _fetch_columns_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
                               window: int, offset: int):
     """Columnar twin of _fetch_series_for_rollup: one batched decode pass
     into padded (S, N) columns (storage.search_columns)."""
-    def fetcher(filters, lo, hi):
+    def fetcher(filters, lo, hi, qt):
         cols = ec.storage.search_columns(filters, lo, hi,
                                          max_series=ec.max_series,
-                                         tenant=ec.tenant)
+                                         tenant=ec.tenant,
+                                         **_tracer_kw(ec, qt))
         if func not in ("default_rollup", "stale_samples_over_time"):
             cols.drop_stale_nans()  # dropStaleNaNs (eval.go:2081), batched
         return cols, cols.n_series, cols.n_samples
@@ -346,26 +360,28 @@ def _rollup_from_storage_cols(ec: EvalConfig, func: str, re_: RollupExpr,
                               for a in adj]
     with admission:
         if per_series_cfg is None:
-            qt = ec.tracer.new_child("host rollup %s (columns)", func)
-            rows = rollup_np.rollup_batch_packed(func, cols.ts, cols.vals,
-                                                 cols.counts, cfg, args)
-            if rows is not None:
-                qt.donef("%d series (packed)", cols.n_series)
-                return _cache_rollup(ec, ckey,
-                                     _finish_rollup_cols(cols, rows,
-                                                         keep_name))
-            qt.donef("fell back to per-series (non-finite values)")
-        qt = ec.tracer.new_child("host rollup %s (per-series)", func)
-        out_rows = []
-        counts = cols.counts
-        for i in range(cols.n_series):
-            if i % 256 == 0:
-                ec.check_deadline()
-            n = int(counts[i])
-            c = per_series_cfg[i] if per_series_cfg is not None else cfg
-            out_rows.append(rollup_series(func, cols.ts[i, :n],
-                                          cols.vals[i, :n], c, args))
-        qt.donef("%d series", cols.n_series)
+            with ec.tracer.new_child("host rollup %s (columns)",
+                                     func) as qt:
+                rows = rollup_np.rollup_batch_packed(func, cols.ts,
+                                                     cols.vals, cols.counts,
+                                                     cfg, args)
+                if rows is not None:
+                    qt.donef("%d series (packed)", cols.n_series)
+                    return _cache_rollup(ec, ckey,
+                                         _finish_rollup_cols(cols, rows,
+                                                             keep_name))
+                qt.donef("fell back to per-series (non-finite values)")
+        with ec.tracer.new_child("host rollup %s (per-series)", func) as qt:
+            out_rows = []
+            counts = cols.counts
+            for i in range(cols.n_series):
+                if i % 256 == 0:
+                    ec.check_deadline()
+                n = int(counts[i])
+                c = per_series_cfg[i] if per_series_cfg is not None else cfg
+                out_rows.append(rollup_series(func, cols.ts[i, :n],
+                                              cols.vals[i, :n], c, args))
+            qt.donef("%d series", cols.n_series)
         return _cache_rollup(ec, ckey,
                              _finish_rollup_cols(cols, out_rows, keep_name))
 
@@ -432,47 +448,50 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
     with admission:
         if per_series_cfg is not None:
             # windows differ per series: per-series host loop
-            qt = ec.tracer.new_child("host rollup %s (per-series window)",
-                                     func)
-            out_rows = []
-            for i, (sd, c) in enumerate(zip(series, per_series_cfg)):
-                if i % 256 == 0:
-                    ec.check_deadline()
-                out_rows.append(rollup_series(func, sd.timestamps,
-                                              sd.values, c, args))
-            qt.donef("%d series", len(out_rows))
+            with ec.tracer.new_child("host rollup %s (per-series window)",
+                                     func) as qt:
+                out_rows = []
+                for i, (sd, c) in enumerate(zip(series, per_series_cfg)):
+                    if i % 256 == 0:
+                        ec.check_deadline()
+                    out_rows.append(rollup_series(func, sd.timestamps,
+                                                  sd.values, c, args))
+                qt.donef("%d series", len(out_rows))
             return _cache_rollup(ec, ckey,
                                  _finish_rollup(series, out_rows,
                                                 keep_name))
         if ec.tpu is not None:
             from .tpu_engine import try_rollup_tpu
-            qt = ec.tracer.new_child("tpu rollup %s", func)
-            got = try_rollup_tpu(ec.tpu, func, series, cfg, args,
-                                 cache_key=_tile_cache_key(ec, me, cfg,
-                                                           fetch_info))
-            if got is not None:
-                qt.donef("device path, %d series", len(got))
-                return _cache_rollup(ec, ckey,
-                                     _finish_rollup(series, got, keep_name))
-            qt.donef("fell back to host")
+            with ec.tracer.new_child("tpu rollup %s", func) as qt:
+                got = try_rollup_tpu(ec.tpu, func, series, cfg, args,
+                                     cache_key=_tile_cache_key(ec, me, cfg,
+                                                               fetch_info))
+                if got is not None:
+                    qt.donef("device path, %d series", len(got))
+                    return _cache_rollup(ec, ckey,
+                                         _finish_rollup(series, got,
+                                                        keep_name))
+                qt.donef("fell back to host")
 
-        qt = ec.tracer.new_child("host rollup %s", func)
-        if len(series) >= 8 and _rnp.batch_supported(func, args):
-            from ..ops import rollup_np
-            rows = rollup_np.rollup_batch(
-                func, [(sd.timestamps, sd.values) for sd in series], cfg,
-                args)
-            if rows is not None:
-                qt.donef("%d series (batched)", len(series))
-                return _cache_rollup(
-                    ec, ckey, _finish_rollup(series, list(rows), keep_name))
-        out_rows = []
-        for i, sd in enumerate(series):
-            if i % 256 == 0:
-                ec.check_deadline()
-            vals = rollup_series(func, sd.timestamps, sd.values, cfg, args)
-            out_rows.append(vals)
-        qt.donef("%d series", len(out_rows))
+        with ec.tracer.new_child("host rollup %s", func) as qt:
+            if len(series) >= 8 and _rnp.batch_supported(func, args):
+                from ..ops import rollup_np
+                rows = rollup_np.rollup_batch(
+                    func, [(sd.timestamps, sd.values) for sd in series],
+                    cfg, args)
+                if rows is not None:
+                    qt.donef("%d series (batched)", len(series))
+                    return _cache_rollup(
+                        ec, ckey, _finish_rollup(series, list(rows),
+                                                 keep_name))
+            out_rows = []
+            for i, sd in enumerate(series):
+                if i % 256 == 0:
+                    ec.check_deadline()
+                vals = rollup_series(func, sd.timestamps, sd.values, cfg,
+                                     args)
+                out_rows.append(vals)
+            qt.donef("%d series", len(out_rows))
         return _cache_rollup(ec, ckey,
                              _finish_rollup(series, out_rows, keep_name))
 
@@ -842,18 +861,18 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
             if tiles is not None:
                 ec.check_deadline()
                 ec.count_samples(n_samples)
-                qt = ec.tracer.new_child("tpu fused %s(%s) warm", ae.name,
-                                         func)
-                if qx is not None:
-                    slots_dev, max_group = qx
-                    out = run_quantile_on_tiles(
-                        ec.tpu, phi, func, tiles, gids_dev, slots_dev,
-                        len(group_keys), max_group, cfg2)
-                else:
-                    out = run_fused_on_tiles(ec.tpu, ae.name, func, tiles,
-                                             gids_dev, len(group_keys),
-                                             cfg2)
-                qt.donef("resident tile, %d groups", len(group_keys))
+                with ec.tracer.new_child("tpu fused %s(%s) warm", ae.name,
+                                         func) as qt:
+                    if qx is not None:
+                        slots_dev, max_group = qx
+                        out = run_quantile_on_tiles(
+                            ec.tpu, phi, func, tiles, gids_dev, slots_dev,
+                            len(group_keys), max_group, cfg2)
+                    else:
+                        out = run_fused_on_tiles(ec.tpu, ae.name, func,
+                                                 tiles, gids_dev,
+                                                 len(group_keys), cfg2)
+                    qt.donef("resident tile, %d groups", len(group_keys))
                 return _emit(out, group_keys)
 
     # rolling shortcut: the same query SHAPE with advanced bounds and/or
@@ -1004,24 +1023,25 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                 key_to_gid[key] = gid
                 group_keys.append(key)
             gids[i] = gid
-        qt = ec.tracer.new_child("tpu fused %s(%s)", ae.name, func)
-        tile_key = _tile_cache_key(ec, rarg.expr, cfg, fetch_info)
-        qx = None
-        slots = max_group = None
-        if phi is not None:
-            slots, max_group = group_slots(gids, len(group_keys))
-            out = try_quantile_rollup_tpu(ec.tpu, phi, func, series, gids,
-                                          len(group_keys), cfg, slots,
-                                          max_group, cache_key=tile_key)
-        else:
-            out = try_aggr_rollup_tpu(ec.tpu, ae.name, func, series, gids,
-                                      len(group_keys), cfg,
-                                      cache_key=tile_key)
-        if out is None:
-            qt.donef("fell back to host")
-            return _decline()
-        qt.donef("device path, %d series -> %d groups", len(series),
-                 len(group_keys))
+        with ec.tracer.new_child("tpu fused %s(%s)", ae.name, func) as qt:
+            tile_key = _tile_cache_key(ec, rarg.expr, cfg, fetch_info)
+            qx = None
+            slots = max_group = None
+            if phi is not None:
+                slots, max_group = group_slots(gids, len(group_keys))
+                out = try_quantile_rollup_tpu(ec.tpu, phi, func, series,
+                                              gids, len(group_keys), cfg,
+                                              slots, max_group,
+                                              cache_key=tile_key)
+            else:
+                out = try_aggr_rollup_tpu(ec.tpu, ae.name, func, series,
+                                          gids, len(group_keys), cfg,
+                                          cache_key=tile_key)
+            if out is None:
+                qt.donef("fell back to host")
+                return _decline()
+            qt.donef("device path, %d series -> %d groups", len(series),
+                     len(group_keys))
         import jax.numpy as jnp
         if phi is not None:
             qx = (jnp.asarray(slots), max_group)
@@ -1243,9 +1263,13 @@ def _try_host_chunked_aggr(ec: EvalConfig, ae) -> list[Timeseries] | None:
             n_chunks += 1
     except ResourceWarning as e:
         from .limits import QueryLimitError
+        qt.donef("error: %s", e)
         raise QueryLimitError(
             f"{e}; either narrow the selector or raise "
             f"-search.maxUniqueTimeseries") from None
+    except BaseException as e:
+        qt.donef("error: %s", e)  # close the span on deadline/limit aborts
+        raise
     qt.donef("%d chunks, %d samples, %d groups", n_chunks, n_samples,
              len(gidx))
     out = []
@@ -1509,16 +1533,16 @@ def _try_device_topk(ec, ae, name: str, k: float,
         cfg = RollupConfig(start=cfg.start, end=cfg.end, step=cfg.step,
                            window=adj[0])
     with admission:
-        qt = ec.tracer.new_child("tpu fused %s(%s)", name, func)
-        got = try_topk_rollup_tpu(
-            ec.tpu, name, k, func, series, cfg,
-            cache_key=_tile_cache_key(ec, rarg.expr, cfg, fetch_info))
-        if got is None:
-            qt.donef("fell back to host")
-            ec.count_samples(-sum(s.timestamps.size for s in series))
-            return None
-        qt.donef("device selection, %d of %d series kept",
-                 len(got), len(series))
+        with ec.tracer.new_child("tpu fused %s(%s)", name, func) as qt:
+            got = try_topk_rollup_tpu(
+                ec.tpu, name, k, func, series, cfg,
+                cache_key=_tile_cache_key(ec, rarg.expr, cfg, fetch_info))
+            if got is None:
+                qt.donef("fell back to host")
+                ec.count_samples(-sum(s.timestamps.size for s in series))
+                return None
+            qt.donef("device selection, %d of %d series kept",
+                     len(got), len(series))
     return _finish_rollup_names(
         (series[i].metric_name for i, _ in got),
         [vals for _, vals in got], keep_name)

@@ -1,0 +1,116 @@
+"""Per-query runtime stats (reference app/vmselect/promql/active_queries.go
++ lib/querystats): the in-flight query registry behind
+``/api/v1/status/active_queries`` and the last-N query-stats ring behind
+``/api/v1/status/top_queries``.
+
+Both register themselves with the self-metrics registry
+(``vm_active_queries``, ``vm_search_queries_total``) so ``/metrics``
+sees them too.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import weakref
+
+from ..utils import fasttime
+from ..utils import metrics as metricslib
+
+_active_instances: "weakref.WeakSet[ActiveQueries]" = weakref.WeakSet()
+
+metricslib.REGISTRY.gauge(
+    "vm_active_queries",
+    callback=lambda: sum(len(a) for a in list(_active_instances)))
+
+
+class ActiveQueries:
+    """In-flight query registry (app/vmselect/promql/active_queries.go)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._live: dict[int, dict] = {}
+        _active_instances.add(self)
+
+    def register(self, query: str, start, end, step) -> int:
+        with self._lock:
+            self._next += 1
+            qid = self._next
+            self._live[qid] = {"qid": qid, "query": query, "start": start,
+                               "end": end, "step": step,
+                               "t": fasttime.unix_seconds()}
+            return qid
+
+    def unregister(self, qid: int):
+        with self._lock:
+            self._live.pop(qid, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            now = fasttime.unix_seconds()
+            return [{**q, "duration": f"{now - q['t']:.3f}s"}
+                    for q in self._live.values()]
+
+
+class QueryStats:
+    """Top-queries stats ring (reference lib/querystats: the last
+    ``max_records`` query executions, aggregated at read time within
+    ``max_lifetime_s``).  A bounded deque — old entries age out instead of
+    freezing the table once an entry cap is hit."""
+
+    def __init__(self, max_records: int = 20_000,
+                 max_lifetime_s: float = 300.0):
+        self._lock = threading.Lock()
+        # ring of (query, time_range_s rounded, duration_s, unix_s)
+        self._ring: collections.deque = collections.deque(
+            maxlen=max_records)
+        self.max_lifetime_s = max_lifetime_s
+        self._queries_total = metricslib.REGISTRY.counter(
+            "vm_search_queries_total")
+
+    def record(self, query: str, time_range_s: float, duration_s: float):
+        self._queries_total.inc()
+        with self._lock:
+            self._ring.append((query, round(time_range_s), duration_s,
+                               fasttime.unix_seconds()))
+
+    def _aggregate(self) -> list[dict]:
+        cutoff = fasttime.unix_seconds() - self.max_lifetime_s
+        acc: dict[tuple, list] = {}
+        with self._lock:
+            records = list(self._ring)
+        for q, tr, d, at in records:
+            if at < cutoff:
+                continue
+            e = acc.get((q, tr))
+            if e is None:
+                e = acc[(q, tr)] = [0, 0.0]
+            e[0] += 1
+            e[1] += d
+        return [{"query": q, "timeRangeSeconds": tr, "count": c,
+                 "sumDurationSeconds": round(d, 6),
+                 "avgDurationSeconds": round(d / c, 6)}
+                for (q, tr), (c, d) in acc.items()]
+
+    _SORTERS = {"count": lambda x: -x["count"],
+                "sumDuration": lambda x: -x["sumDurationSeconds"],
+                "avgDuration": lambda x: -x["avgDurationSeconds"]}
+
+    def top(self, n: int, key: str) -> list[dict]:
+        items = self._aggregate()
+        items.sort(key=self._SORTERS.get(key, self._SORTERS["count"]))
+        return items[:n]
+
+    def tops(self, n: int) -> dict[str, list[dict]]:
+        """All three top-N orderings from ONE aggregation pass over the
+        ring (the /top_queries endpoint serves all three at once)."""
+        items = self._aggregate()
+        out = {}
+        for key, sorter in self._SORTERS.items():
+            out[key] = sorted(items, key=sorter)[:n]
+        return out

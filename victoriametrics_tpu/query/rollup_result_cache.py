@@ -17,11 +17,25 @@ from __future__ import annotations
 
 import itertools
 import threading
+import weakref
 
 import numpy as np
 
 from ..storage.metric_name import MetricName
+from ..utils import metrics as metricslib
 from .types import EvalConfig, Timeseries
+
+_instances: "weakref.WeakSet[RollupResultCache]" = weakref.WeakSet()
+_CACHE_REQUESTS = metricslib.REGISTRY.counter(
+    'vm_cache_requests_total{type="promql/rollupResult"}')
+_CACHE_MISSES = metricslib.REGISTRY.counter(
+    'vm_cache_misses_total{type="promql/rollupResult"}')
+metricslib.REGISTRY.gauge(
+    'vm_cache_entries{type="promql/rollupResult"}',
+    callback=lambda: sum(c.entry_count() for c in list(_instances)))
+metricslib.REGISTRY.gauge(
+    'vm_cache_size_bytes{type="promql/rollupResult"}',
+    callback=lambda: sum(c.size_bytes() for c in list(_instances)))
 
 # Cached series tails are clipped back by this much: the freshest points may
 # still change (late samples within the flush window) — cacheTimestampOffset.
@@ -94,6 +108,7 @@ class RollupResultCache:
         self.max_entries = max_entries
         self.hits = 0
         self.misses = 0
+        _instances.add(self)
 
     def _key(self, ec: EvalConfig, q: str) -> tuple:
         # tenant MUST be part of the key (a shared entry would leak across
@@ -107,12 +122,14 @@ class RollupResultCache:
             ) -> tuple[CacheHit | None, int]:
         """Returns (hit covering [ec.start, cov_end], first timestamp
         still to compute). (None, ec.start) on miss."""
+        _CACHE_REQUESTS.inc()
         with self._lock:
             key = self._key(ec, q)
             e = self._cache.get(key)
             if e is None or e.c_start > ec.start or e.c_end < ec.start or \
                     (ec.start - e.c_start) % ec.step != 0:
                 self.misses += 1
+                _CACHE_MISSES.inc()
                 return None, ec.start
             self._cache.move_to_end(key)
             self.hits += 1
@@ -184,6 +201,16 @@ class RollupResultCache:
             vals[s, T - m:] = v if m <= T else v[-T:]
         return [Timeseries(names[s], vals[s], raw=raws[s])
                 for s in range(S)]
+
+    def entry_count(self) -> int:
+        # locked: a /metrics scrape must not iterate under concurrent
+        # put()/evict mutation
+        with self._lock:
+            return len(self._cache)
+
+    def size_bytes(self) -> int:
+        with self._lock:
+            return sum(e.vals.nbytes for e in self._cache.values())
 
     def reset(self):
         with self._lock:

@@ -11,11 +11,51 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 
 import numpy as np
 
 from ..ops import rollup_np
 from ..ops.rollup_np import RollupConfig
+from ..utils import metrics as metricslib
+
+# (kernel, phase) -> histogram handle; keeps name formatting and the
+# registry lock off the per-dispatch path (same memo pattern as rpc.py)
+_kernel_hist_memo: dict = {}
+
+
+def _kernel_histogram(kernel: str, phase: str):
+    key = (kernel, phase)
+    h = _kernel_hist_memo.get(key)
+    if h is None:
+        h = _kernel_hist_memo[key] = metricslib.REGISTRY.histogram(
+            metricslib.format_name("vm_tpu_kernel_duration_seconds",
+                                   {"kernel": kernel, "phase": phase}))
+    return h
+
+
+def timed_kernel_call(kernel: str, jit_fn, *args, **kw):
+    """Run a jitted kernel recording its wall time into
+    vm_tpu_kernel_duration_seconds, split compile vs. execute: a call
+    that grew the jit cache (jax's _cache_size) paid a trace+compile,
+    everything else is pure dispatch/execute.  The split is the first
+    thing to look at when p99 spikes — a 'compile' sample on a steady
+    workload means a shape/dtype churned a cached kernel."""
+    import jax
+    cache_size = getattr(jit_fn, "_cache_size", None)
+    before = cache_size() if callable(cache_size) else None
+    t0 = time.perf_counter()
+    out = jit_fn(*args, **kw)
+    # async dispatch returns immediately; without this sync the histogram
+    # would record dispatch overhead, not the kernel (callers convert the
+    # result to numpy right after, so no extra blocking is introduced)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    phase = "execute"
+    if before is not None and cache_size() > before:
+        phase = "compile"
+    _kernel_histogram(kernel, phase).update(dt)
+    return out
 
 # -- the f32 tile design ------------------------------------------------
 # Real TPUs have no native float64 (it is emulated, or silently truncated
@@ -239,8 +279,9 @@ def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
     if _counter_unsafe(engine, func, tiles):
         return None
     ts_t, v_t, counts, v0 = tiles
-    out = rollup_tile(func, ts_t, v_t, counts, normalized_cfg(func, cfg),
-                      MIN_TS_NONE, _v0_dev(engine, v0))
+    out = timed_kernel_call("rollup_tile", rollup_tile, func, ts_t, v_t,
+                            counts, normalized_cfg(func, cfg), MIN_TS_NONE,
+                            _v0_dev(engine, v0))
     # mesh tiles are row-padded; only the live rows come back
     rows = np.asarray(out, dtype=np.float64)[:len(series)]
     if mode == "addback":
@@ -475,12 +516,15 @@ def _dispatch_fused(engine: TPUEngine, aggr: str, func: str, tiles,
                                              num_groups)
         v0_arr = (np.zeros(int(ts_t.shape[0]), np.float32) if v0 is None
                   else v0.offsets.astype(np.float32))
-        out = fn(ts_t, v_t, counts, gids_dev, np.int32(shift),
-                 np.int32(min_ts), v0_arr)
+        out = timed_kernel_call("sharded_rollup_aggregate", fn, ts_t, v_t,
+                                counts, gids_dev, np.int32(shift),
+                                np.int32(min_ts), v0_arr)
     else:
-        out = rollup_aggregate_tile(func, aggr, ts_t, v_t, counts, gids_dev,
-                                    cfg, num_groups, np.int32(shift),
-                                    np.int32(min_ts), _v0_dev(engine, v0))
+        out = timed_kernel_call("rollup_aggregate_tile",
+                                rollup_aggregate_tile, func, aggr, ts_t,
+                                v_t, counts, gids_dev, cfg, num_groups,
+                                np.int32(shift), np.int32(min_ts),
+                                _v0_dev(engine, v0))
     return np.asarray(out, dtype=np.float64)
 
 

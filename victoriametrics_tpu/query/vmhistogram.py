@@ -45,6 +45,10 @@ def vmrange_for(v: float) -> str | None:
         return None
     if v == 0:
         return LOWER_RANGE
+    if math.isinf(v):
+        # +Inf lands in the upper catch-all like the reference (the
+        # log10 path below would overflow int())
+        return UPPER_RANGE
     idx = (math.log10(v) - E10_MIN) * BUCKETS_PER_DECIMAL
     if idx < 0:
         return LOWER_RANGE

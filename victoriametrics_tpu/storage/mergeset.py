@@ -22,12 +22,23 @@ import itertools
 import json
 import os
 import struct
+import time
 from collections import OrderedDict
 
 from ..devtools.locktrace import make_lock, make_rlock
 from ..ops import compress as zstd
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
 from ..utils import logger
+from ..utils import metrics as metricslib
+
+_FLUSH_DURATION = metricslib.REGISTRY.histogram(
+    'vm_storage_flush_duration_seconds{type="indexdb/mergeset"}')
+_MERGE_DURATION = metricslib.REGISTRY.histogram(
+    'vm_storage_merge_duration_seconds{type="indexdb/mergeset"}')
+_MERGES_TOTAL = metricslib.REGISTRY.counter(
+    'vm_merges_total{type="indexdb/mergeset"}')
+_ACTIVE_MERGES = metricslib.REGISTRY.gauge(
+    'vm_active_merges{type="indexdb/mergeset"}')
 
 MAX_BLOCK_BYTES = 64 << 10
 MAX_INMEMORY_PARTS = 15
@@ -271,22 +282,33 @@ class Table:
     def _merge_mem_to_file_locked(self):
         if not self._mem_parts:
             return
+        t0 = time.perf_counter()
         merged = _dedup_sorted(heapq.merge(*self._mem_parts))
         name = f"part_{next(self._part_seq):016d}"
         p = os.path.join(self.path, name)
         _FilePart.write(p, merged)
         self._mem_parts = []
         self._file_parts.append(_FilePart(p))
+        _FLUSH_DURATION.update(time.perf_counter() - t0)
         if len(self._file_parts) > MAX_INMEMORY_PARTS:
             self._merge_file_parts_locked()
 
     def _merge_file_parts_locked(self):
         olds = self._file_parts
-        merged = _dedup_sorted(heapq.merge(*[p.iter_all() for p in olds]))
-        name = f"part_{next(self._part_seq):016d}"
-        p = os.path.join(self.path, name)
-        _FilePart.write(p, merged)
-        self._file_parts = [_FilePart(p)]
+        _ACTIVE_MERGES.inc()
+        t0 = time.perf_counter()
+        try:
+            merged = _dedup_sorted(
+                heapq.merge(*[p.iter_all() for p in olds]))
+            name = f"part_{next(self._part_seq):016d}"
+            p = os.path.join(self.path, name)
+            _FilePart.write(p, merged)
+            self._file_parts = [_FilePart(p)]
+            # success only: aborted merges must not count as progress
+            _MERGE_DURATION.update(time.perf_counter() - t0)
+            _MERGES_TOTAL.inc()
+        finally:
+            _ACTIVE_MERGES.dec()
         for old in olds:
             # Unlink only: concurrent readers may still iterate `old`; the
             # open fds keep the data alive until the last reference drops

@@ -18,14 +18,28 @@ import itertools
 import json
 import os
 import shutil
+import time
 
 import numpy as np
 
 from ..devtools.locktrace import make_rlock
 from ..utils import logger
+from ..utils import metrics as metricslib
 from .block import MAX_ROWS_PER_BLOCK, Block, rows_to_blocks
 from .dedup import deduplicate
 from .part import Part, PartWriter
+
+# engine self-metrics (reference vm_active_merges / vm_merges_total per
+# part type): flush = pending+mem parts -> one small file part; merge =
+# small file parts -> one bigger part
+_FLUSH_DURATION = metricslib.REGISTRY.histogram(
+    'vm_storage_flush_duration_seconds{type="storage/small"}')
+_MERGE_DURATION = metricslib.REGISTRY.histogram(
+    'vm_storage_merge_duration_seconds{type="storage/file"}')
+_MERGES_TOTAL = metricslib.REGISTRY.counter(
+    'vm_merges_total{type="storage/file"}')
+_ACTIVE_MERGES = metricslib.REGISTRY.gauge(
+    'vm_active_merges{type="storage/file"}')
 
 MAX_PENDING_ROWS = 256 << 10
 MAX_SMALL_PARTS = 15
@@ -557,7 +571,9 @@ class Partition:
                 if not self._mem_parts:
                     return
                 mems = list(self._mem_parts)
+            t0 = time.perf_counter()
             p = self._write_part([m.iter_blocks() for m in mems])
+            _FLUSH_DURATION.update(time.perf_counter() - t0)
             with self._lock:
                 if p is not None:
                     self._file_parts.append(p)
@@ -607,8 +623,17 @@ class Partition:
                 olds = [p for p in parts if p in self._file_parts]
             if not olds:
                 return
-            merged = self._write_part([p.iter_blocks() for p in olds],
-                                      deleted_ids, min_valid_ts)
+            _ACTIVE_MERGES.inc()
+            t0 = time.perf_counter()
+            try:
+                merged = self._write_part([p.iter_blocks() for p in olds],
+                                          deleted_ids, min_valid_ts)
+                # counted only on success: an aborted merge (ENOSPC)
+                # must not look like the compactor making progress
+                _MERGE_DURATION.update(time.perf_counter() - t0)
+                _MERGES_TOTAL.inc()
+            finally:
+                _ACTIVE_MERGES.dec()
             with self._lock:
                 survivors = [p for p in self._file_parts if p not in olds]
                 self._file_parts = survivors + (

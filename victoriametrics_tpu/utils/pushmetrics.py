@@ -4,10 +4,12 @@ POST it to each -pushmetrics.url with extra labels appended."""
 
 from __future__ import annotations
 
+import gzip
 import threading
 import urllib.request
 
 from . import logger
+from .metrics import splice_extra_labels
 
 
 class MetricsPusher:
@@ -31,21 +33,11 @@ class MetricsPusher:
         self._stop.set()
 
     def _render(self) -> bytes:
-        text = self.collect_fn()
-        if not self.extra_labels:
-            return text.encode()
-        out = []
-        for line in text.splitlines():
-            if not line or line.startswith("#"):
-                out.append(line)
-                continue
-            name, _, rest = line.partition(" ")
-            if "{" in name:
-                base, _, tail = name.partition("{")
-                out.append(f"{base}{{{self.extra_labels},{tail} {rest}")
-            else:
-                out.append(f"{name}{{{self.extra_labels}}} {rest}")
-        return "\n".join(out).encode()
+        # the shared exposition splicer is quote-aware: label values with
+        # spaces/braces survive (the old partition(" ") surgery did not)
+        text = splice_extra_labels(self.collect_fn(), self.extra_labels)
+        # gzip like the reference metrics.InitPush (push.go:167)
+        return gzip.compress(text.encode(), 5)
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):
@@ -55,7 +47,8 @@ class MetricsPusher:
                     try:
                         req = urllib.request.Request(
                             url, data=body, method="POST",
-                            headers={"Content-Type": "text/plain"})
+                            headers={"Content-Type": "text/plain",
+                                     "Content-Encoding": "gzip"})
                         with urllib.request.urlopen(req, timeout=10):
                             self.pushes += 1
                     except OSError as e:

@@ -51,6 +51,35 @@ class Tracer:
             extra = (fmt % args) if args else fmt
             self.message = f"{self.message}: {extra}" if self.message else extra
 
+    def __enter__(self) -> "Tracer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        # close the span even when the traced layer raises; donef is
+        # idempotent, so a span already closed with a success message
+        # keeps it
+        if exc is not None:
+            self.donef("error: %s", exc)
+        else:
+            self.donef("")
+        return False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Tracer":
+        """Rebuild a (finished) span tree from its to_dict() form — the
+        receiving half of cross-RPC trace propagation."""
+        t = cls(str(d.get("message", "")))
+        t.duration_s = float(d.get("duration_msec", 0.0)) / 1e3
+        t._done = True
+        t.children = [cls.from_dict(c) for c in d.get("children", ())]
+        return t
+
+    def add_remote(self, d: dict) -> None:
+        """Graft a remote span tree (a storage node's to_dict()) under
+        this span, giving one host+device+network tree per query."""
+        if d:
+            self.children.append(Tracer.from_dict(d))
+
     def to_dict(self) -> dict:
         if not self._done:
             self.donef("")
@@ -75,6 +104,15 @@ class _NopTracer:
         pass
 
     def donef(self, fmt="", *args):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def add_remote(self, d):
         pass
 
     def to_dict(self):
