@@ -19,3 +19,10 @@ import jax  # noqa: E402
 if not os.environ.get("VMTPU_TEST_TPU"):
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "race: concurrency/race-detector tests "
+        "(tools/race.sh runs these under VMT_RACETRACE=1)")
+    config.addinivalue_line("markers", "slow: excluded from tier-1 (-m 'not slow')")

@@ -111,6 +111,57 @@ FIXTURES = {
         "        total = 0\n"
         "        total += 1  # plain local accumulator: fine\n",
     ),
+    "VMT008": (
+        "import threading\n"
+        "def serve(fns, names):\n"
+        "    banner = ','.join(names)  # str.join must not suppress\n"
+        "    for fn in fns:\n"
+        "        threading.Thread(target=fn).start()\n",
+        "import threading\n"
+        "def serve(fns):\n"
+        "    ts = [threading.Thread(target=fn) for fn in fns]\n"
+        "    for t in ts:\n"
+        "        t.start()\n"
+        "    for t in ts:\n"
+        "        t.join()\n"
+        "def background(fn):\n"
+        "    threading.Thread(target=fn, daemon=True).start()\n",
+    ),
+    "VMT009": (
+        "class Node:\n"
+        "    def mark(self):\n"
+        "        with self._lock:\n"
+        "            self.healthy = False\n"
+        "def poke(node):\n"
+        "    node.healthy = True\n",
+        "class Node:\n"
+        "    def mark(self):\n"
+        "        with self._lock:\n"
+        "            self.healthy = False\n"
+        "def poke(node, lock):\n"
+        "    with lock:\n"
+        "        node.healthy = True\n"
+        "def poke_locked(node):\n"
+        "    node.healthy = True  # *_locked: caller holds the lock\n",
+    ),
+    "VMT010": (
+        "import queue\n"
+        "def drain(q):\n"
+        "    try:\n"
+        "        return q.get(timeout=1.0)\n"
+        "    except queue.Empty:\n"
+        "        pass\n",
+        "import queue\n"
+        "def drain(q, log):\n"
+        "    try:\n"
+        "        return q.get(timeout=1.0)\n"
+        "    except queue.Empty:\n"
+        "        log('drain starved for 1s')\n"
+        "    try:\n"
+        "        return q.get()\n"
+        "    except queue.Empty:\n"
+        "        pass  # no timeout in play: interrupted blocking get\n",
+    ),
 }
 
 
@@ -160,6 +211,31 @@ def test_package_is_clean_against_checked_in_baseline():
     fresh = new_findings(findings, baseline)
     assert fresh == [], "new lint findings:\n" + \
         "\n".join(str(f) for f in fresh)
+
+
+def test_stale_baseline_entries_fail_with_exit_3(tmp_path, capsys):
+    """A baseline entry whose findings were fixed is slack in the ratchet
+    (it could hide that many regressions); the CLI must fail distinctly
+    (exit 3) until the baseline is regenerated."""
+    mod = tmp_path / "mod.py"
+    mod.write_text("import time\na = time.time()\n")
+    bl = tmp_path / "baseline.txt"
+    findings = lint.lint_paths([str(mod)])
+    lint.write_baseline(str(bl), findings)
+    assert lint.main([str(mod), "--baseline", str(bl)]) == 0
+    # fix the finding; the baselined count is now stale
+    mod.write_text("import time\na = time.monotonic()\n")
+    rc = lint.main([str(mod), "--baseline", str(bl)])
+    assert rc == 3
+    err = capsys.readouterr().err
+    assert "BASELINE STALE" in err and "--update-baseline" in err
+    # new findings still win over staleness (exit 1 beats exit 3)
+    mod.write_text("import time\na = time.time()\nb = time.time()\n"
+                   "c = eval('1')  # vmt: disable=VMT001\n")
+    assert lint.main([str(mod), "--baseline", str(bl)]) == 1
+    # regenerating clears it
+    lint.write_baseline(str(bl), lint.lint_paths([str(mod)]))
+    assert lint.main([str(mod), "--baseline", str(bl)]) == 0
 
 
 def test_cli_main_exits_zero_on_clean_tree():

@@ -9,17 +9,23 @@ value == timestamp % 1e9, so any mixed-up (ts, value) pairing, partial
 block, or cross-series contamination trips an exact-equality check.
 """
 
+import queue
 import random
 import threading
 import time
+import warnings
 
 import numpy as np
 import pytest
 
-from victoriametrics_tpu.devtools import locktrace
+from victoriametrics_tpu.devtools import locktrace, racetrace
 from victoriametrics_tpu.devtools.locktrace import (LockHeldTooLongWarning,
                                                     LockOrderError,
-                                                    TracedLock)
+                                                    TracedLock, make_lock)
+from victoriametrics_tpu.devtools.racetrace import RaceWarning, traced_fields
+from victoriametrics_tpu.devtools.sched import DeterministicScheduler
+
+pytestmark = pytest.mark.race  # the tools/race.sh selection
 
 try:
     from victoriametrics_tpu import native
@@ -297,7 +303,12 @@ class TestLockTrace:
         assert isinstance(locktrace.make_lock("x"), TracedLock)
         assert isinstance(locktrace.make_rlock("x"), TracedLock)
         monkeypatch.setenv("VMT_LOCKTRACE", "0")
-        assert isinstance(locktrace.make_lock("x"), type(threading.Lock()))
+        if racetrace.enabled():
+            # the racetrace sanitizer also claims the factory seam
+            assert isinstance(locktrace.make_lock("x"), TracedLock)
+        else:
+            assert isinstance(locktrace.make_lock("x"),
+                              type(threading.Lock()))
 
     @needs_native
     def test_storage_lock_hierarchy_under_tracing(self, tmp_path,
@@ -314,3 +325,253 @@ class TestLockTrace:
         assert len(s.search_series(
             filters_from_dict({"__name__": "lt"}), t0 - 1, t0 + 10**6)) == 32
         s.close()
+
+# -- happens-before race sanitizer (devtools/racetrace) -----------------------
+
+
+@pytest.fixture
+def race_on(monkeypatch):
+    """Sanitizer on for the test body; restores prior state after (no-op
+    teardown when the whole run came in via tools/race.sh with
+    VMT_RACETRACE=1)."""
+    monkeypatch.setenv("VMT_LOCKTRACE_MAX_HOLD_MS", "60000")
+    was = racetrace.enabled()
+    racetrace.enable()
+    racetrace.reset()
+    yield racetrace
+    racetrace.reset()
+    if not was:
+        racetrace.disable()
+
+
+@traced_fields("n")
+class _Scratch:
+    """The seeded-race fixture: one traced int, no lock."""
+
+    def __init__(self):
+        self.n = 0
+        self.d = {}
+
+
+class TestRaceTrace:
+    def test_seeded_race_is_detected_with_both_stacks(self, race_on):
+        """Two unjoined threads bump the same unsynchronized field: a
+        happens-before race EXISTS regardless of how the OS interleaves
+        them, so detection is deterministic — no lucky timing needed."""
+        b = _Scratch()
+
+        def bump():
+            for _ in range(4):
+                b.n = b.n + 1
+                b.d["k"] = b.d.get("k", 0) + 1  # dict update, same story
+
+        ts = [threading.Thread(target=bump) for _ in range(2)]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RaceWarning)
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        reps = racetrace.reports()
+        assert reps, "unsynchronized cross-thread access not reported"
+        r = reps[0]
+        assert r.field == "n" and r.cls_name == "_Scratch"
+        assert r.kind in ("write-write", "read-write", "write-read")
+        first = "".join(str(f) for f in r.first_stack.format())
+        second = "".join(str(f) for f in r.second_stack.format())
+        assert "test_stress_race" in first and "bump" in first
+        assert "test_stress_race" in second and "bump" in second
+        assert r.first_thread != r.second_thread
+
+    def test_report_counted_in_registry(self, race_on):
+        from victoriametrics_tpu.utils import metrics as metricslib
+        c = metricslib.REGISTRY.counter("vm_race_reports_total")
+        before = c.get()
+        b = _Scratch()
+        ts = [threading.Thread(target=lambda: setattr(b, "n", b.n + 1))
+              for _ in range(2)]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RaceWarning)
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        assert c.get() > before
+
+    def test_make_lock_synchronized_twin_is_clean(self, race_on):
+        b = _Scratch()
+        lk = make_lock("race.scratch._lock")
+        assert isinstance(lk, TracedLock)  # racetrace reached the seam
+
+        def bump():
+            for _ in range(8):
+                with lk:
+                    b.n = b.n + 1
+
+        ts = [threading.Thread(target=bump) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert racetrace.reports() == []
+        assert b.n == 24
+
+    def test_queue_handoff_is_clean(self, race_on):
+        b = _Scratch()
+        q = queue.Queue()
+
+        def producer():
+            b.n = 41
+            q.put("ready")
+
+        def consumer():
+            q.get()
+            b.n = b.n + 1
+
+        t1 = threading.Thread(target=producer)
+        t2 = threading.Thread(target=consumer)
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        assert racetrace.reports() == []
+        assert b.n == 42
+
+    def test_thread_start_join_create_edges(self, race_on):
+        b = _Scratch()
+        b.n = 1                       # parent write before fork
+
+        def child():
+            b.n += 1                  # ordered after start()
+
+        t = threading.Thread(target=child)
+        t.start()
+        t.join()
+        b.n += 1                      # ordered after join()
+        assert racetrace.reports() == []
+        assert b.n == 3
+
+    def test_disabled_is_plain_attribute(self, monkeypatch):
+        """With the sanitizer off, traced classes carry no descriptor (the
+        zero-overhead guarantee bench.py relies on)."""
+        if racetrace.enabled():
+            pytest.skip("suite running under VMT_RACETRACE=1")
+        monkeypatch.setenv("VMT_LOCKTRACE", "0")
+        assert not isinstance(_Scratch.__dict__.get("n"),
+                              racetrace._TracedField)
+        try:
+            from victoriametrics_tpu.storage.partition import Partition
+        except ImportError:          # zstandard absent: storage not loadable
+            Partition = None
+        if Partition is not None:
+            assert not isinstance(Partition.__dict__.get("_pending"),
+                                  racetrace._TracedField)
+        assert isinstance(make_lock("x"), type(threading.Lock()))
+
+
+# -- deterministic interleaving scheduler (devtools/sched) --------------------
+
+
+class TestDeterministicScheduler:
+    def _racy_run(self, seed):
+        racetrace.reset()
+        sched = DeterministicScheduler(seed=seed, change_prob=0.3)
+        b = _Scratch()
+
+        def bump():
+            for _ in range(6):
+                b.n = b.n + 1
+
+        for i in range(3):
+            sched.spawn(f"w{i}", bump)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RaceWarning)
+            sched.run(timeout=30)
+        reps = racetrace.reports()
+        pairs = [(r.field, r.kind, r.first_thread, r.second_thread)
+                 for r in reps]
+        return sched.trace, pairs
+
+    def test_same_seed_replays_same_interleaving_and_reports(self, race_on):
+        """The acceptance property: the seed IS the interleaving.  Two
+        runs with one seed produce the identical traced-point schedule and
+        the identical race reports; the report's seed is therefore a full
+        reproducer."""
+        t1, p1 = self._racy_run(1234)
+        t2, p2 = self._racy_run(1234)
+        assert t1 == t2
+        assert p1 == p2
+        assert p1, "the seeded racy workload must be flagged"
+        assert len(t1) > 10
+
+    def test_locked_workload_is_clean_and_deterministic(self, race_on):
+        def run(seed):
+            racetrace.reset()
+            sched = DeterministicScheduler(seed=seed, change_prob=0.3)
+            b = _Scratch()
+            lk = make_lock("sched.locked._lock")
+
+            def bump():
+                for _ in range(6):
+                    with lk:
+                        b.n = b.n + 1
+
+            for i in range(3):
+                sched.spawn(f"w{i}", bump)
+            sched.run(timeout=30)
+            return sched.trace, b.n, racetrace.reports()
+
+        t1, n1, r1 = run(77)
+        t2, n2, r2 = run(77)
+        assert t1 == t2 and n1 == n2 == 18
+        assert r1 == [] and r2 == []
+        # lock contention descheduled someone at least once
+        assert any(x.endswith("/blocked") for x in t1)
+
+    @needs_native
+    def test_partition_and_mergeset_stress_clean_under_scheduler(
+            self, tmp_path, race_on):
+        """The real LSM paths — partition ingest/flush/merge/read and
+        mergeset add/flush/search — run under seeded preemption with the
+        sanitizer on and produce ZERO race reports."""
+        from victoriametrics_tpu.storage import mergeset
+        from victoriametrics_tpu.storage.partition import Partition
+        from victoriametrics_tpu.storage.tsid import TSID
+
+        part = Partition(str(tmp_path / "p"), "2025_07")
+        mtab = mergeset.Table(str(tmp_path / "m"))
+        t0 = 1_753_700_000_000
+
+        def writer(w):
+            for i in range(6):
+                tsid = TSID(metric_group_id=1, metric_id=w * 100 + i)
+                part.add_rows([(tsid, t0 + i * 1000 + w, float(i))])
+                mtab.add_items([b"k%02d_%03d" % (w, i)])
+
+        def flusher():
+            for _ in range(3):
+                part.flush_to_disk()
+                mtab.flush_to_disk()
+
+        def reader():
+            for _ in range(4):
+                _ = part.rows
+                list(part.iter_blocks())
+                mtab.first_with_prefix(b"k00")
+                list(mtab.search_prefix(b"k01"))
+
+        sched = DeterministicScheduler(seed=4242, change_prob=0.2)
+        sched.spawn("w0", writer, 0)
+        sched.spawn("w1", writer, 1)
+        sched.spawn("flush", flusher)
+        sched.spawn("read", reader)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", LockHeldTooLongWarning)
+            sched.run(timeout=120)
+        assert racetrace.reports() == [], "\n\n".join(
+            r.format() for r in racetrace.reports())
+        part.flush_to_disk()
+        assert part.rows == 12
+        assert sum(1 for _ in mtab.iter_from(b"")) == 12
+        part.close()
+        mtab.close()

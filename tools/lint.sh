@@ -1,7 +1,11 @@
 #!/bin/sh
 # Canonical static-analysis entry point (tier-1 / CI): runs the project
-# lint engine over the package and exits non-zero on any finding not in
-# devtools/lint_baseline.txt. Extra args are passed through, e.g.:
+# lint engine over the package. Exit codes:
+#   0  clean against devtools/lint_baseline.txt
+#   1  new findings (not grandfathered, not inline-disabled)
+#   3  baseline staleness: grandfathered entries that no longer fire —
+#      slack in the ratchet; regenerate with --update-baseline
+# Extra args are passed through, e.g.:
 #   tools/lint.sh --update-baseline
 #   tools/lint.sh --no-baseline victoriametrics_tpu/storage/
 set -eu

@@ -1,0 +1,22 @@
+#!/bin/sh
+# Race-detection entry point (the `go test -race` analog): runs the
+# race-marked tests with the happens-before sanitizer enabled.
+#
+#   VMT_RACETRACE=1   vector-clock sanitizer on (devtools/racetrace.py):
+#                     traced fields in storage/parallel/models are checked,
+#                     make_lock/make_rlock return TracedLocks, Thread
+#                     start/join and queue.Queue put/get carry clocks.
+#
+# Reports print both stack traces, count into vm_race_reports_total, and
+# surface as RaceWarning; a failing interleaving is replayed from the
+# seed shown in the failure via devtools.sched.DeterministicScheduler.
+# Extra args pass through to pytest, e.g.:
+#   tools/race.sh -k scheduler
+#   tools/race.sh tests/test_stress_race.py::TestRaceTrace
+set -eu
+cd "$(dirname "$0")/.."
+# Scoped to the race-marked modules (not tests/) so collection errors in
+# unrelated zstandard-dependent modules can't fail a green race run.
+exec env VMT_RACETRACE=1 VMT_LOCKTRACE_MAX_HOLD_MS=60000 \
+    python -m pytest tests/test_stress_race.py -q -m race \
+    -p no:cacheprovider "$@"

@@ -20,6 +20,7 @@ import urllib.parse
 import urllib.request
 
 from ..utils import logger
+from ..utils import metrics as metricslib
 
 STATE_INACTIVE = "inactive"
 STATE_PENDING = "pending"
@@ -56,8 +57,20 @@ class Notifier:
     def __init__(self, url: str, timeout=10):
         self.url = url.rstrip("/")
         self.timeout = timeout
-        self.sent = 0
-        self.errors = 0
+        # registry-backed, per-notifier (reference vmalert
+        # vmalert_alerts_sent_total{addr=...})
+        self._sent = metricslib.REGISTRY.counter(metricslib.format_name(
+            "vm_vmalert_alerts_sent_total", {"addr": self.url}))
+        self._errors = metricslib.REGISTRY.counter(metricslib.format_name(
+            "vm_vmalert_alerts_send_errors_total", {"addr": self.url}))
+
+    @property
+    def sent(self) -> int:
+        return self._sent.get()
+
+    @property
+    def errors(self) -> int:
+        return self._errors.get()
 
     def send(self, alerts: list[dict]) -> None:
         body = json.dumps(alerts).encode()
@@ -66,9 +79,9 @@ class Notifier:
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req, timeout=self.timeout):
-                self.sent += len(alerts)
+                self._sent.inc(len(alerts))
         except OSError as e:
-            self.errors += 1
+            self._errors.inc()
             logger.throttled_warnf("notifier", 10, "notifier %s: %s",
                                    self.url, e)
 

@@ -1,14 +1,28 @@
-"""Static-analysis suite for the project (the role `go vet` + `-race`
-play in the reference repo).
+"""Static-analysis & race-detection suite for the project (the role
+`go vet` + `-race` play in the reference repo).
 
-Two halves:
+Four pieces:
 
 - :mod:`lint` — an AST lint engine with project-specific rules
-  (VMT001..VMT006) covering deterministic-time discipline, classic
-  Python foot-guns, lock discipline, and JAX host-sync anti-patterns.
-  Run as ``python -m victoriametrics_tpu.devtools.lint victoriametrics_tpu/``.
+  (VMT001..VMT010) covering deterministic-time discipline, classic
+  Python foot-guns, lock discipline, JAX host-sync anti-patterns,
+  metrics-registry discipline, and thread/queue lifecycle.  Run as
+  ``python -m victoriametrics_tpu.devtools.lint victoriametrics_tpu/``.
+  The grandfather baseline ratchets both ways: new findings fail (exit
+  1), stale grandfathered entries fail distinctly (exit 3).
 - :mod:`locktrace` — a runtime lock-order tracer: ``TracedLock`` is a
   drop-in for ``threading.Lock``/``RLock`` that records the per-thread
   lock-acquisition graph and fails fast on cycles (potential deadlock).
-  Enabled by running any entry point with ``VMT_LOCKTRACE=1``.
+  Enabled by running any entry point with ``VMT_LOCKTRACE=1``; findings
+  are counted as ``vm_locktrace_*`` registry metrics.
+- :mod:`racetrace` — a FastTrack-style happens-before sanitizer:
+  vector clocks synchronized at the ``make_lock`` seam, Thread
+  start/join, and queue put/get; unsynchronized access pairs to
+  ``traced_fields``-declared storage/RPC state are reported with both
+  stacks and counted as ``vm_race_reports_total``.  Enabled with
+  ``VMT_RACETRACE=1`` (zero cost when unset); ``tools/race.sh`` runs
+  the race-marked tests under it.
+- :mod:`sched` — a seeded deterministic cooperative scheduler (simple
+  PCT) preempting at racetrace's traced points, so the interleaving
+  that produced a race report is replayed from its seed.
 """

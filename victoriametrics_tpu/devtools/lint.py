@@ -21,6 +21,11 @@ free so unrelated edits don't invalidate it).  The check fails only when
 a (file, rule) pair exceeds its baselined count, so the suite starts
 green and ratchets: fixing findings shrinks the baseline via
 ``--update-baseline``, new code can't add any.
+
+The ratchet cuts both ways: a baseline entry whose findings no longer
+fire is STALE debt shielding future regressions, so the CLI fails with
+exit code 3 (distinct from 1 = new findings) until the baseline is
+regenerated.
 """
 
 from __future__ import annotations
@@ -97,10 +102,10 @@ class FileContext:
 
 def all_rules() -> list:
     from . import (rules_jax, rules_locks, rules_metrics, rules_pyflaws,
-                   rules_time)
+                   rules_threads, rules_time)
     rules = []
     for mod in (rules_time, rules_pyflaws, rules_locks, rules_jax,
-                rules_metrics):
+                rules_metrics, rules_threads):
         rules.extend(mod.RULES)
     return sorted(rules, key=lambda r: r.rule_id)
 
@@ -218,7 +223,7 @@ def stale_baseline_entries(findings: list[Finding], baseline: Counter,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m victoriametrics_tpu.devtools.lint",
-        description="Project-specific AST lint (rules VMT001..VMT007).")
+        description="Project-specific AST lint (rules VMT001..VMT010).")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the package)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -245,14 +250,13 @@ def main(argv=None) -> int:
               f"-> {args.baseline}")
         return 0
 
+    stale = []
     if args.no_baseline:
         fresh = findings
     else:
         baseline = load_baseline(args.baseline)
         fresh = new_findings(findings, baseline)
-        for rel, rule in stale_baseline_entries(findings, baseline, linted):
-            print(f"note: baseline for {rel}:{rule} is stale (fixed?); "
-                  f"shrink it with --update-baseline", file=sys.stderr)
+        stale = stale_baseline_entries(findings, baseline, linted)
 
     for f in fresh:
         print(f)
@@ -262,6 +266,15 @@ def main(argv=None) -> int:
               f"Fix, add '# vmt: disable=<RULE>' with a reason, or "
               f"--update-baseline if truly grandfathered.", file=sys.stderr)
         return 1
+    if stale:
+        for rel, rule in stale:
+            print(f"stale baseline entry: {rel}:{rule} no longer fires "
+                  f"at its baselined count", file=sys.stderr)
+        print(f"\nBASELINE STALE: {len(stale)} grandfathered entr"
+              f"{'y' if len(stale) == 1 else 'ies'} exceed what the lint "
+              f"finds; the ratchet has slack that would hide regressions. "
+              f"Regenerate with --update-baseline.", file=sys.stderr)
+        return 3
     return 0
 
 

@@ -48,6 +48,21 @@ class LockHeldTooLongWarning(UserWarning):
 
 _tls = threading.local()  # .held: list[_Held], shared by all traced locks
 
+# Installed by devtools.racetrace.enable(): an object with
+# acquire_inner/acquired/released used to bracket the inner lock ops with
+# vector-clock joins (and scheduler-aware spin acquires). None = off.
+_race_hooks = None
+
+
+def _inc_counter(name: str) -> None:
+    """Best-effort registry counter bump (findings are also exported as
+    vm_locktrace_* self-metrics, not just warnings/exceptions)."""
+    try:
+        from ..utils import metrics as metricslib
+        metricslib.REGISTRY.counter(name).inc()
+    except ImportError:
+        pass                        # registry unavailable mid-bootstrap
+
 
 def _held_stack():
     stack = getattr(_tls, "held", None)
@@ -161,6 +176,7 @@ class TracedLock:
                        f"holding '{held.lock.name}', but the reverse order "
                        f"was already observed ({' -> '.join(cycle)}); two "
                        f"threads on these paths can deadlock")
+                _inc_counter("vm_locktrace_cycles_total")
                 if self._mode == "warn":
                     import warnings
                     warnings.warn(msg, LockOrderWarning, stacklevel=3)
@@ -188,8 +204,14 @@ class TracedLock:
             raise LockOrderError(
                 f"non-reentrant lock '{self.name}' re-acquired by the "
                 f"same thread (self-deadlock)")
-        ok = self._inner.acquire(blocking, timeout)
+        hooks = _race_hooks
+        if hooks is not None:
+            ok = hooks.acquire_inner(self._inner, blocking, timeout)
+        else:
+            ok = self._inner.acquire(blocking, timeout)
         if ok:
+            if hooks is not None:
+                hooks.acquired(self)
             self._owner = me
             self._owner_depth += 1
             stack.append(_Held(self, time.monotonic()))
@@ -212,6 +234,11 @@ class TracedLock:
         self._owner_depth = max(self._owner_depth - 1, 0)
         if self._owner_depth == 0:
             self._owner = None
+        hooks = _race_hooks
+        if hooks is not None:
+            # publish this thread's clock into the lock BEFORE the inner
+            # release makes the protected state visible to the next owner
+            hooks.released(self)
         try:
             self._inner.release()
         except RuntimeError:
@@ -223,6 +250,7 @@ class TracedLock:
             held_ms = (time.monotonic() - entry.t0) * 1e3
             if held_ms > self._max_hold_ms:
                 import warnings
+                _inc_counter("vm_locktrace_hold_warnings_total")
                 warnings.warn(
                     f"lock '{self.name}' held for {held_ms:.0f}ms "
                     f"(budget {self._max_hold_ms:.0f}ms); slow work "
@@ -254,17 +282,20 @@ def locktrace_enabled() -> bool:
 
 
 def make_lock(name: str):
-    """A ``threading.Lock`` — traced when VMT_LOCKTRACE is set.
+    """A ``threading.Lock`` — traced when VMT_LOCKTRACE is set or the
+    racetrace sanitizer is enabled (its vector clocks synchronize at this
+    seam).
 
     ``name`` should be the lock's role, e.g. ``"storage.Table._lock"``:
     stable per call site and shared by all instances."""
-    if locktrace_enabled():
+    if locktrace_enabled() or _race_hooks is not None:
         return TracedLock(name)
     return threading.Lock()
 
 
 def make_rlock(name: str):
-    """A ``threading.RLock`` — traced when VMT_LOCKTRACE is set."""
-    if locktrace_enabled():
+    """A ``threading.RLock`` — traced when VMT_LOCKTRACE or racetrace is
+    enabled."""
+    if locktrace_enabled() or _race_hooks is not None:
         return TracedLock(name, reentrant=True)
     return threading.RLock()

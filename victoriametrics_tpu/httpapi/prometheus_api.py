@@ -92,11 +92,17 @@ class ConcurrencyGate:
         self._sem = threading.Semaphore(max_concurrent)
         self.max_concurrent = max_concurrent
         self.max_queue_duration_s = max_queue_duration_s
-        self.rejected = 0
+        # per-instance thread-safe counter (several APIs per test process;
+        # exposed as vm_concurrent_select_limit_reached_total in metrics())
+        self._rejected = metricslib.Counter("rejected")
+
+    @property
+    def rejected(self) -> int:
+        return self._rejected.get()
 
     def __enter__(self):
         if not self._sem.acquire(timeout=self.max_queue_duration_s):
-            self.rejected += 1
+            self._rejected.inc()
             raise TimeoutError(
                 f"query queue wait exceeded {self.max_queue_duration_s}s "
                 f"({self.max_concurrent} concurrent queries)")

@@ -72,7 +72,9 @@ class HTTPServer:
         self._path_metric_memo: dict[str, tuple] = {}
         self.auth_key = auth_key
         self.basic_auth = basic_auth
-        self.request_count = 0
+        # per-instance thread-safe counter (tests run several servers per
+        # process; the per-path vm_http_requests_total metrics are global)
+        self._request_count = metricslib.Counter("requests")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -82,7 +84,7 @@ class HTTPServer:
                 pass
 
             def _handle(self):
-                outer.request_count += 1
+                outer._request_count.inc()
                 ln = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(ln) if ln else b""
                 enc = (self.headers.get("Content-Encoding") or "").lower()
@@ -162,6 +164,10 @@ class HTTPServer:
         self.port = self._srv.server_address[1]
         self.addr = addr
         self._thread: threading.Thread | None = None
+
+    @property
+    def request_count(self) -> int:
+        return self._request_count.get()
 
     def route(self, path: str, fn):
         if path.endswith("/"):

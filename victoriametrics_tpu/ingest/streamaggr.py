@@ -40,7 +40,7 @@ _HIST_BUCKETS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100,
 class _SeriesState:
     __slots__ = ("count", "sum", "sum2", "min", "max", "last", "last_ts",
                  "first", "prev_value", "total", "uniq", "hist", "rate_prev",
-                 "rate_prev_ts", "rate_total")
+                 "rate_prev_ts", "rate_acc")
 
     def __init__(self):
         self.count = 0
@@ -57,7 +57,7 @@ class _SeriesState:
         self.hist = None
         self.rate_prev = None
         self.rate_prev_ts = None
-        self.rate_total = 0.0
+        self.rate_acc = 0.0
 
 
 def _match_selectors(expr):
@@ -169,7 +169,7 @@ class Aggregator:
                         d = value
                     st.total += d
                     if st.rate_prev_ts and ts_ms > st.rate_prev_ts:
-                        st.rate_total += d / ((ts_ms - st.rate_prev_ts) / 1e3)
+                        st.rate_acc += d / ((ts_ms - st.rate_prev_ts) / 1e3)
                 elif self_outputs_include_initial(self.outputs):
                     st.total += value
                 st.rate_prev = value
@@ -212,7 +212,7 @@ class Aggregator:
                            "increase_prometheus"):
                     vals.append((o, st.total, {}))
                 elif o in ("rate_sum", "rate_avg"):
-                    r = st.rate_total
+                    r = st.rate_acc
                     if o == "rate_avg":
                         r = r  # per-series avg handled at merge below
                     vals.append((o, r, {}))

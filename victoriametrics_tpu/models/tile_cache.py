@@ -15,13 +15,14 @@ device_put goes up in <=8MB slices re-assembled on device.
 from __future__ import annotations
 
 import collections
-import threading
 import weakref
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..devtools.locktrace import make_lock
+from ..devtools.racetrace import traced_fields
 from ..utils import metrics as metricslib
 
 UPLOAD_CHUNK_BYTES = 8 << 20
@@ -54,20 +55,33 @@ def chunked_device_put(x: np.ndarray, device=None) -> jax.Array:
     return jnp.concatenate(parts, axis=0)
 
 
+@traced_fields("_entries", "_sizes", "_bytes")
 class TileCache:
     """LRU byte-bounded cache of device-resident pytrees."""
 
     def __init__(self, capacity_bytes: int, device=None):
         self.capacity = capacity_bytes
         self.device = device or jax.devices()[0]
-        self._lock = threading.Lock()
+        # through the locktrace seam: the racetrace sanitizer needs the
+        # release->acquire clock edge to see these accesses as ordered
+        self._lock = make_lock("models.TileCache._lock")
         self._entries: collections.OrderedDict[object, tuple] = \
             collections.OrderedDict()
         self._sizes: dict[object, int] = {}
         self._bytes = 0
-        self.hits = 0
-        self.misses = 0
+        # per-instance thread-safe counters (the global vm_cache_* metrics
+        # above aggregate over instances; these feed per-cache stats)
+        self._hits = metricslib.Counter("hits")
+        self._misses = metricslib.Counter("misses")
         _instances.add(self)
+
+    @property
+    def hits(self) -> int:
+        return self._hits.get()
+
+    @property
+    def misses(self) -> int:
+        return self._misses.get()
 
     def _tree_bytes(self, tree) -> int:
         total = 0
@@ -83,9 +97,9 @@ class TileCache:
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
-                self.hits += 1
+                self._hits.inc()
                 return self._entries[key]
-            self.misses += 1
+        self._misses.inc()
         _CACHE_MISSES.inc()
         return None
 
@@ -155,4 +169,6 @@ class TileCache:
 
     @property
     def size_bytes(self) -> int:
-        return self._bytes
+        # locked: a /metrics scrape must not read mid-evict
+        with self._lock:
+            return self._bytes

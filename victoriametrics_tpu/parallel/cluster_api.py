@@ -610,8 +610,11 @@ class ClusterStorage:
         self._key_verdicts: dict[tuple, dict] = {}
         from ..query.rollup_result_cache import next_storage_token
         self.cache_token = next_storage_token()
-        self.rows_sent = 0
-        self.reroutes = 0
+        # per-instance counters (metrics() is per-cluster; tests build
+        # several ClusterStorages per process), mirrored into the process
+        # registry below on every inc
+        self._rows_sent = metricslib.Counter("rows_sent")
+        self._reroutes = metricslib.Counter("reroutes")
         self._rows_sent_counter = metricslib.REGISTRY.counter(
             "vm_rpc_rows_sent_total")
         self._reroutes_counter = metricslib.REGISTRY.counter(
@@ -621,6 +624,14 @@ class ClusterStorage:
         # the fanouts of one query (a shared flag would race between
         # concurrent queries and be cleared by a later clean fanout)
         self._tls = threading.local()
+
+    @property
+    def rows_sent(self) -> int:
+        return self._rows_sent.get()
+
+    @property
+    def reroutes(self) -> int:
+        return self._reroutes.get()
 
     def reset_partial(self):
         self._tls.partial = False
@@ -658,8 +669,7 @@ class ClusterStorage:
                 sent += len(node_rows)
             except (OSError, RPCError, ConnectionError) as e:
                 node.mark_down()
-                with self._lock:
-                    self.reroutes += 1
+                self._reroutes.inc()
                 self._reroutes_counter.inc()
                 # regroup the failed batch by alternate node: one RPC per
                 # target, not one per row
@@ -675,7 +685,7 @@ class ClusterStorage:
                 for j, batch in alt_batches.items():
                     self.nodes[j].write_rows(batch, tenant)
                     sent += len(batch)
-        self.rows_sent += sent
+        self._rows_sent.inc(sent)
         self._rows_sent_counter.inc(sent)
         return len(rows)
 
@@ -778,8 +788,7 @@ class ClusterStorage:
                 sent += len(rows)
             except (OSError, RPCError, ConnectionError) as e:
                 self.nodes[i].mark_down()
-                with self._lock:
-                    self.reroutes += 1
+                self._reroutes.inc()
                 self._reroutes_counter.inc()
                 ex = {j2 for j2, n in enumerate(self.nodes)
                       if not n.healthy} | {i}
@@ -799,8 +808,7 @@ class ClusterStorage:
                                                   rowsl, tss, vals, tenant)
             except (OSError, RPCError, ConnectionError) as e:
                 self.nodes[i].mark_down()
-                with self._lock:
-                    self.reroutes += 1
+                self._reroutes.inc()
                 self._reroutes_counter.inc()
                 ex = {j2 for j2, n in enumerate(self.nodes)
                       if not n.healthy} | {i}
@@ -816,7 +824,7 @@ class ClusterStorage:
                 for j2, (ks, rl) in alt_shards.items():
                     sent += self._send_columnar_shard(self.nodes[j2], ks,
                                                       rl, tss, vals, tenant)
-        self.rows_sent += sent
+        self._rows_sent.inc(sent)
         self._rows_sent_counter.inc(sent)
         return int(n_rows - dropped_transform - dropped_malformed)
 

@@ -20,6 +20,7 @@ import time
 import numpy as np
 
 from ..devtools.locktrace import make_lock
+from ..devtools.racetrace import traced_fields
 
 try:
     from ..ops import compress as zstd
@@ -245,6 +246,7 @@ class RPCServer:
 
 # -- client ------------------------------------------------------------------
 
+@traced_fields("_sock", "_f")
 class RPCClient:
     """One connection per client; callers serialize via a lock (the pool
     layer holds several clients per node)."""

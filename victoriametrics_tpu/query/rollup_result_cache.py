@@ -106,9 +106,19 @@ class RollupResultCache:
         self._lock = threading.Lock()
         self._cache: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self.max_entries = max_entries
-        self.hits = 0
-        self.misses = 0
+        # per-instance thread-safe counters (the global vm_cache_* metrics
+        # above aggregate over every live cache)
+        self._hits = metricslib.Counter("hits")
+        self._misses = metricslib.Counter("misses")
         _instances.add(self)
+
+    @property
+    def hits(self) -> int:
+        return self._hits.get()
+
+    @property
+    def misses(self) -> int:
+        return self._misses.get()
 
     def _key(self, ec: EvalConfig, q: str) -> tuple:
         # tenant MUST be part of the key (a shared entry would leak across
@@ -128,11 +138,11 @@ class RollupResultCache:
             e = self._cache.get(key)
             if e is None or e.c_start > ec.start or e.c_end < ec.start or \
                     (ec.start - e.c_start) % ec.step != 0:
-                self.misses += 1
+                self._misses.inc()
                 _CACHE_MISSES.inc()
                 return None, ec.start
             self._cache.move_to_end(key)
-            self.hits += 1
+            self._hits.inc()
         cov_end = min(e.c_end, ec.end)
         i0 = (ec.start - e.c_start) // ec.step
         n = (cov_end - ec.start) // ec.step + 1

@@ -26,7 +26,7 @@ class BloomLimiter:
         nbits = max(max_series * BITS_PER_ITEM, 4096)
         self._nbits = nbits
         self._bits = bytearray((nbits + 7) // 8)
-        self._count = 0
+        self._tracked = 0
         self._bucket = fasttime.unix_timestamp() // rotation_s
         self.rows_dropped = 0
 
@@ -35,7 +35,7 @@ class BloomLimiter:
         if b != self._bucket:
             self._bucket = b
             self._bits = bytearray(len(self._bits))
-            self._count = 0
+            self._tracked = 0
 
     def add(self, metric_id: int) -> bool:
         """True if the id is admitted (already tracked, or capacity left);
@@ -55,18 +55,18 @@ class BloomLimiter:
                 missing.append((byte, mask))
         if not missing:
             return True  # (probabilistically) already tracked
-        if self._count >= self.max_series:
+        if self._tracked >= self.max_series:
             self.rows_dropped += 1
             return False
         for byte, mask in missing:
             bits[byte] |= mask
-        self._count += 1
+        self._tracked += 1
         return True
 
     @property
     def current_series(self) -> int:
         self._rotate_if_needed()
-        return self._count
+        return self._tracked
 
     def metrics(self) -> dict:
         p = f"vm_{self.name}_series_limit"

@@ -31,6 +31,7 @@ import struct
 import numpy as np
 
 from ..devtools.locktrace import make_lock
+from ..devtools.racetrace import traced_fields
 from .mergeset import Table
 from .metric_name import MetricName, escape, unescape
 from .tag_filters import TagFilter
@@ -65,6 +66,7 @@ def _tag_key_bytes(key: bytes, value: bytes) -> bytes:
     return escape(key) + b"\x01" + escape(value) + b"\x00"
 
 
+@traced_fields("_deleted", "_gen", "_filter_cache", "_tsids_result_cache")
 class IndexDB:
     """One index table + in-memory caches.
 

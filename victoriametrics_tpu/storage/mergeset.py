@@ -26,6 +26,7 @@ import time
 from collections import OrderedDict
 
 from ..devtools.locktrace import make_lock, make_rlock
+from ..devtools.racetrace import traced_fields
 from ..ops import compress as zstd
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
 from ..utils import logger
@@ -79,6 +80,7 @@ def _decode_block(data: bytes, count: int) -> list[bytes]:
     return items
 
 
+@traced_fields("_block_cache")
 class _FilePart:
     """Immutable on-disk sorted run."""
 
@@ -205,6 +207,7 @@ def _dedup_sorted(it):
             prev = x
 
 
+@traced_fields("_pending", "_pending_sorted", "_mem_parts", "_file_parts")
 class Table:
     """The mergeset table: add_items / prefix search / snapshot."""
 

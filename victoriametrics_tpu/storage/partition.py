@@ -23,6 +23,7 @@ import time
 import numpy as np
 
 from ..devtools.locktrace import make_rlock
+from ..devtools.racetrace import traced_fields
 from ..utils import logger
 from ..utils import metrics as metricslib
 from .block import MAX_ROWS_PER_BLOCK, Block, rows_to_blocks
@@ -430,6 +431,8 @@ def _merge_block_streams(sources, deleted_ids: np.ndarray | None,
     yield from flush()
 
 
+@traced_fields("_pending", "_pending_nrows", "_pending_parts",
+               "_pending_off", "_pending_gen", "_mem_parts", "_file_parts")
 class Partition:
     """One month of data ("2006_01" naming, time.go:79 analog)."""
 

@@ -9,6 +9,7 @@ import shutil
 import numpy as np
 
 from ..devtools.locktrace import make_rlock
+from ..devtools.racetrace import traced_fields
 from ..utils import logger
 from .partition import Partition
 
@@ -27,6 +28,7 @@ def _partition_bounds(name: str) -> tuple[int, int]:
     return int(start.timestamp() * 1e3), int(end.timestamp() * 1e3) - 1
 
 
+@traced_fields("_partitions", "_day_to_partition")
 class Table:
     def __init__(self, path: str, dedup_interval_ms: int = 0):
         self.path = path
