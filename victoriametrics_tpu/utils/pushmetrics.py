@@ -9,7 +9,7 @@ import threading
 import urllib.request
 
 from . import logger
-from .metrics import splice_extra_labels
+from .metrics import REGISTRY, splice_extra_labels
 
 
 class MetricsPusher:
@@ -22,8 +22,18 @@ class MetricsPusher:
         self.extra_labels = extra_labels
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
-        self.pushes = 0
-        self.errors = 0
+        # registry-backed (reference metrics_push_total /
+        # metrics_push_errors_total, vendor/.../metrics/push.go:128)
+        self._pushes = REGISTRY.counter("vm_pushmetrics_pushes_total")
+        self._errors = REGISTRY.counter("vm_pushmetrics_errors_total")
+
+    @property
+    def pushes(self) -> int:
+        return self._pushes.get()
+
+    @property
+    def errors(self) -> int:
+        return self._errors.get()
 
     def start(self):
         if self.urls:
@@ -50,12 +60,12 @@ class MetricsPusher:
                             headers={"Content-Type": "text/plain",
                                      "Content-Encoding": "gzip"})
                         with urllib.request.urlopen(req, timeout=10):
-                            self.pushes += 1
+                            self._pushes.inc()
                     except OSError as e:
-                        self.errors += 1
+                        self._errors.inc()
                         logger.throttled_warnf("pushmetrics", 30,
                                                "pushmetrics %s: %s", url, e)
             except Exception as e:  # collect_fn error must not kill the loop
-                self.errors += 1
+                self._errors.inc()
                 logger.throttled_warnf("pushmetrics-collect", 30,
                                        "pushmetrics collect: %s", e)
