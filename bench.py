@@ -62,6 +62,27 @@ STEP = 60_000
 REFRESHES = 6
 JITTER_MS = 2_000  # scrape-time jitter; the end0 ceil below depends on it
 
+# per-phase attribution (vm_fetch_phase_seconds_total, storage + eval):
+# deltas across a timed region divide the time between the fetch stages
+# and the host rollup, so a bench round says WHERE a win/regression lives
+PHASES = ("index_search", "collect", "decode", "assemble", "rollup")
+
+
+def _phase_totals() -> dict:
+    from victoriametrics_tpu.utils import metrics as metricslib
+    return {ph: metricslib.REGISTRY.float_counter(
+        f'vm_fetch_phase_seconds_total{{phase="{ph}"}}').get()
+        for ph in PHASES}
+
+
+def _phase_label(d0: dict, d1: dict, n: int) -> str:
+    """'idx=2/collect=31/decode=4/assemble=9/rollup=12ms' per refresh."""
+    short = {"index_search": "idx", "collect": "collect", "decode": "decode",
+             "assemble": "assemble", "rollup": "rollup"}
+    parts = [f"{short[ph]}={(d1[ph] - d0[ph]) * 1e3 / max(n, 1):.0f}"
+             for ph in PHASES]
+    return "/".join(parts) + "ms"
+
 
 def _finish_provision(probe_handle, probe_timeout: float):
     """Resolve the in-flight accelerator probe and build the device
@@ -258,6 +279,7 @@ def main() -> None:
                                    q, end0)
             # steady-state: live ingest + window advance per refresh
             lat = []
+            ph0 = _phase_totals()
             end = end0
             for _ in range(REFRESHES):
                 end += STEP
@@ -279,12 +301,16 @@ def main() -> None:
             f32 = engine is not None and engine.is_f32()
             _assert_rows_equal(rows, cold_rows,
                                rtol=1e-4 if f32 else 0.0)
-            results[backend] = (float(np.median(lat)), cold_dt)
+            results[backend] = (float(np.median(lat)), cold_dt,
+                                _phase_label(ph0, _phase_totals(),
+                                             REFRESHES))
             end0 = end  # the next backend continues on the grown storage
 
-        backend, (warm_dt, cold_dt) = min(results.items(),
-                                          key=lambda kv: kv[1][0])
+        backend, (warm_dt, cold_dt, phase_lbl) = min(
+            results.items(), key=lambda kv: kv[1][0])
         rate = samples / warm_dt
+        from victoriametrics_tpu.utils import workpool
+        n_workers = workpool.POOL.workers()
         with open("bench_trace.json", "w") as f:
             json.dump(traces, f, indent=1)
         baseline = 1e8  # single-core reference scan rate (see docstring)
@@ -298,7 +324,9 @@ def main() -> None:
                        f"storage+index+decode+{backend} (cold "
                        f"{samples / cold_dt / 1e6:.0f}M/s, refresh p50 "
                        f"{warm_dt * 1e3:.0f}ms, ingest "
-                       f"{ingest_rate / 1e3:.0f}k rows/s)"),
+                       f"{ingest_rate / 1e3:.0f}k rows/s, "
+                       f"{n_workers} fetch workers, "
+                       f"phases {phase_lbl})"),
             "value": round(rate),
             "unit": "samples/sec",
             "vs_baseline": round(rate / baseline, 2),

@@ -19,13 +19,16 @@ from victoriametrics_tpu.utils.metrics import (MetricsRegistry,
                                                splice_extra_labels)
 
 try:
-    import zstandard  # noqa: F401
-    _ZSTD_ERR = None
-except ImportError as e:  # optional native dep: storage/RPC tests skip
-    _ZSTD_ERR = e
+    # the storage stack itself is the gate: ops/compress falls back to
+    # zlib when the zstandard package is absent, so these run either way
+    import victoriametrics_tpu.storage.storage  # noqa: F401
+    _STORAGE_ERR = None
+except ImportError as e:
+    _STORAGE_ERR = e
 
 needs_storage = pytest.mark.skipif(
-    _ZSTD_ERR is not None, reason=f"storage deps unavailable: {_ZSTD_ERR}")
+    _STORAGE_ERR is not None,
+    reason=f"storage deps unavailable: {_STORAGE_ERR}")
 
 T0 = 1_753_700_000_000
 

@@ -528,6 +528,120 @@ class TestDeterministicScheduler:
         # lock contention descheduled someone at least once
         assert any(x.endswith("/blocked") for x in t1)
 
+    def test_workpool_runs_inline_under_scheduler(self, race_on):
+        """A scheduled thread's pool batches execute INLINE (pool workers
+        are not turnstile participants), so the interleaving stays a pure
+        function of the seed: two runs with one seed produce identical
+        traces and identical results."""
+        from victoriametrics_tpu.utils.workpool import WorkPool
+
+        pool = WorkPool(workers=4)
+
+        def run(seed):
+            racetrace.reset()
+            sched = DeterministicScheduler(seed=seed, change_prob=0.3)
+            b = _Scratch()
+            lk = make_lock("sched.pool._lock")
+            logs = {}
+
+            def body(w):
+                def job(j):
+                    with lk:
+                        b.n = b.n + 1
+                    return (w, j, threading.current_thread().name)
+                got = pool.run([lambda j=j: job(j) for j in range(4)])
+                logs[w] = got
+
+            for i in range(3):
+                sched.spawn(f"w{i}", body, i)
+            sched.run(timeout=60)
+            return sched.trace, b.n, dict(logs), racetrace.reports()
+
+        t1, n1, l1, r1 = run(321)
+        t2, n2, l2, r2 = run(321)
+        assert t1 == t2 and n1 == n2 == 12
+        assert l1 == l2
+        # inline: every job ran on its submitting (scheduled) thread
+        for w, got in l1.items():
+            assert [g[:2] for g in got] == [(w, j) for j in range(4)]
+            assert all(g[2] == f"w{w}" for g in got)
+        assert r1 == [] and r2 == []
+        assert pool._threads == []   # the pool never started workers
+
+    @needs_native
+    def test_parallel_fetch_stress_racetrace_clean(self, tmp_path, race_on,
+                                                   monkeypatch):
+        """The concurrent fetch stress with the WORK POOL engaged: several
+        reader threads fan multi-part collection across pool workers while
+        a writer appends and a flusher compacts — the sanitizer must stay
+        silent and every read must satisfy the value == f(ts) invariant."""
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "2")
+        s = Storage(str(tmp_path / "pf"))
+        keys = [f'pfetch{{i="{i}"}}'.encode() for i in range(16)]
+        keybuf = b"".join(keys)
+        klens = np.fromiter((len(k) for k in keys), np.int64, len(keys))
+        koffs = np.concatenate([[0], np.cumsum(klens)[:-1]])
+
+        def append(step, k):
+            ts = (T0 + (step + np.arange(k, dtype=np.int64))[None, :]
+                  * 15_000)
+            ts = np.broadcast_to(ts, (len(keys), k)).reshape(-1).copy()
+            s.add_rows_columnar(native.ColumnarRows(
+                keybuf, np.repeat(koffs, k), np.repeat(klens, k),
+                ts, _val(ts)))
+
+        # seed several file parts so readers fan >1 unit per query
+        for p in range(3):
+            append(p * 8, 8)
+            s.force_flush()
+
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def guard(fn):
+            def run():
+                try:
+                    i = 0
+                    while not stop.is_set() and i < 40:
+                        fn(i)
+                        i += 1
+                except BaseException as e:  # noqa: BLE001 — harness edge
+                    errors.append(e)
+                    stop.set()
+            return run
+
+        def reader(_i):
+            cols = s.search_columns(
+                filters_from_dict({"__name__": "pfetch"}),
+                T0 - 10**6, T0 + 10**10)
+            for r in range(cols.n_series):
+                n = int(cols.counts[r])
+                np.testing.assert_array_equal(cols.vals[r, :n],
+                                              _val(cols.ts[r, :n]))
+
+        def writer(i):
+            append(24 + i, 2)
+
+        def flusher(i):
+            if i % 4 == 0:
+                s.force_flush()
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", LockHeldTooLongWarning)
+            threads = [threading.Thread(target=f, daemon=True)
+                       for f in (guard(reader), guard(reader),
+                                 guard(writer), guard(flusher))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), "parallel fetch stress wedged"
+        if errors:
+            raise errors[0]
+        assert racetrace.reports() == [], "\n\n".join(
+            r.format() for r in racetrace.reports())
+        s.close()
+
     @needs_native
     def test_partition_and_mergeset_stress_clean_under_scheduler(
             self, tmp_path, race_on):

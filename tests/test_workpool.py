@@ -1,0 +1,420 @@
+"""Shared fetch/decode work pool (utils/workpool): ordering, nesting,
+inline modes, the search-concurrency gate, the vectorized decimal
+fallback, and — the acceptance property — bit-identical parallel vs
+sequential fetch results on multi-partition, multi-part stores."""
+
+import os
+import threading
+import time
+from functools import partial
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.utils import metrics as metricslib
+from victoriametrics_tpu.utils import workpool
+from victoriametrics_tpu.utils.workpool import (SearchGate, SearchLimitError,
+                                                WorkPool)
+
+try:
+    from victoriametrics_tpu.storage.storage import Storage
+    from victoriametrics_tpu.storage.tag_filters import filters_from_dict
+    _HAVE_STORAGE = True
+except ImportError:  # optional native deps missing
+    _HAVE_STORAGE = False
+
+needs_storage = pytest.mark.skipif(not _HAVE_STORAGE,
+                                   reason="storage deps unavailable")
+
+T0 = 1_753_700_000_000  # 2025-07-28 (a few days before the month edge)
+
+
+# -- pool semantics ----------------------------------------------------------
+
+class TestWorkPool:
+    def test_run_preserves_submit_order(self):
+        pool = WorkPool(workers=4)
+        try:
+            def job(i):
+                time.sleep(0.001 * (7 - i % 7))  # finish out of order
+                return i * i
+            assert pool.run([partial(job, i) for i in range(40)]) == \
+                [i * i for i in range(40)]
+        finally:
+            pool.shutdown()
+
+    def test_run_actually_uses_worker_threads(self):
+        pool = WorkPool(workers=3)
+        try:
+            names = set()
+
+            def job():
+                names.add(threading.current_thread().name)
+                time.sleep(0.02)
+            pool.run([job for _ in range(6)])
+            assert any(n.startswith("vm-workpool-") for n in names)
+        finally:
+            pool.shutdown()
+
+    def test_exception_propagates_after_batch_drains(self):
+        pool = WorkPool(workers=2)
+        try:
+            ran = []
+
+            def ok(i):
+                ran.append(i)
+
+            def boom():
+                raise ValueError("task failed")
+
+            with pytest.raises(ValueError, match="task failed"):
+                pool.run([partial(ok, 0), boom, partial(ok, 1),
+                          partial(ok, 2)])
+            # every sibling task still ran (no cancellation surprises)
+            assert sorted(ran) == [0, 1, 2]
+        finally:
+            pool.shutdown()
+
+    def test_nested_run_does_not_deadlock(self):
+        """A task fanning out on the same pool (cluster fanout -> local
+        table collect) must complete even when tasks outnumber workers:
+        waiters help execute queued work."""
+        pool = WorkPool(workers=2)
+        try:
+            def inner(i):
+                return i + 1
+
+            def outer(k):
+                return pool.run([partial(inner, 10 * k + j)
+                                 for j in range(4)])
+
+            got = pool.run([partial(outer, k) for k in range(6)])
+            assert got == [[10 * k + j + 1 for j in range(4)]
+                           for k in range(6)]
+        finally:
+            pool.shutdown()
+
+    def test_workers_1_runs_inline_without_threads(self, monkeypatch):
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "1")
+        pool = WorkPool()
+        tid = threading.get_ident()
+        out = pool.run([lambda: threading.get_ident() for _ in range(5)])
+        assert out == [tid] * 5
+        assert pool._threads == []          # never lazily started
+        assert not pool.parallel_enabled()
+
+    def test_submit_pipelines_and_inline_mode(self, monkeypatch):
+        pool = WorkPool(workers=2)
+        try:
+            fut = pool.submit(lambda: 42)
+            assert fut.result() == 42
+        finally:
+            pool.shutdown()
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "1")
+        inline = WorkPool()
+        assert inline.submit(lambda: 7).result() == 7
+        assert inline._threads == []
+
+    def test_submit_error_reraises(self):
+        pool = WorkPool(workers=2)
+        try:
+            fut = pool.submit(partial(int, "nope"))
+            with pytest.raises(ValueError):
+                fut.result()
+        finally:
+            pool.shutdown()
+
+    def test_env_resize_default_is_cpu_count(self, monkeypatch):
+        monkeypatch.delenv("VM_SEARCH_WORKERS", raising=False)
+        assert workpool.configured_workers() == (os.cpu_count() or 1)
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "7")
+        assert workpool.configured_workers() == 7
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "garbage")
+        assert workpool.configured_workers() == (os.cpu_count() or 1)
+
+    def test_lowered_worker_count_retires_excess_threads(self, monkeypatch):
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "4")
+        pool = WorkPool()
+        try:
+            pool.run([(lambda: time.sleep(0.01)) for _ in range(8)])
+            assert len(pool._threads) == 4
+            monkeypatch.setenv("VM_SEARCH_WORKERS", "2")
+            pool.run([(lambda: time.sleep(0.01)) for _ in range(8)])
+            assert len(pool._threads) <= 2
+        finally:
+            pool.shutdown()
+
+    def test_decompress_fallback_is_size_bounded(self):
+        """The zlib fallback must cap allocation like the zstd path's
+        max_output_size (a small frame must not balloon into RAM)."""
+        import zlib
+
+        from victoriametrics_tpu.ops import compress
+        bomb = zlib.compress(b"\0" * (8 << 20))
+        with pytest.raises(ValueError, match="exceeds"):
+            compress.decompress(bomb, max_size=1 << 20)
+        ok = zlib.compress(b"payload" * 100)
+        assert compress.decompress(ok, max_size=1 << 20) == b"payload" * 100
+
+    def test_tasks_total_metric_counts(self):
+        c = metricslib.REGISTRY.counter("vm_workpool_tasks_total")
+        before = c.get()
+        workpool.POOL.run([lambda: None, lambda: None, lambda: None])
+        assert c.get() >= before + 3
+
+
+# -- search concurrency gate -------------------------------------------------
+
+class TestSearchGate:
+    def test_admits_up_to_limit_then_queues(self):
+        gate = SearchGate(limit=2, max_queue_ms=5000)
+        release = threading.Event()
+        inside = []
+
+        def hold():
+            with gate:
+                inside.append(1)
+                release.wait(10)
+
+        ts = [threading.Thread(target=hold, daemon=True) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for _ in range(100):
+            if len(inside) == 2:
+                break
+            time.sleep(0.01)
+        assert len(inside) == 2
+        queued = metricslib.REGISTRY.counter(
+            "vm_search_requests_queued_total")
+        q_before = queued.get()
+        t3 = threading.Thread(target=hold, daemon=True)
+        t3.start()
+        for _ in range(100):
+            if queued.get() > q_before:
+                break
+            time.sleep(0.01)
+        assert queued.get() == q_before + 1   # third caller had to queue
+        release.set()
+        t3.join(10)
+        for t in ts:
+            t.join(10)
+        assert len(inside) == 3               # ... and then got admitted
+
+    def test_rejects_after_queue_timeout_with_metric(self):
+        gate = SearchGate(limit=1, max_queue_ms=50)
+        rejected = metricslib.REGISTRY.counter(
+            "vm_search_requests_rejected_total")
+        r_before = rejected.get()
+        release = threading.Event()
+
+        def hold():
+            with gate:
+                release.wait(10)
+
+        t = threading.Thread(target=hold, daemon=True)
+        t.start()
+        for _ in range(100):
+            if gate._current.get() == 1:
+                break
+            time.sleep(0.01)
+        with pytest.raises(SearchLimitError, match="concurrent searches"):
+            with gate:
+                pass
+        assert rejected.get() == r_before + 1
+        release.set()
+        t.join(10)
+
+    def test_current_gauge_tracks(self):
+        gate = SearchGate(limit=3, max_queue_ms=1000)
+        cur = gate._current
+        base = cur.get()
+        with gate:
+            assert cur.get() == base + 1
+        assert cur.get() == base
+
+    def test_metrics_surface_in_exposition(self):
+        txt = metricslib.REGISTRY.write_prometheus()
+        for name in ("vm_search_concurrent_limit",
+                     "vm_search_concurrent_current",
+                     "vm_search_requests_queued_total",
+                     "vm_search_requests_rejected_total",
+                     "vm_workpool_tasks_total", "vm_workpool_workers",
+                     "vm_workpool_queue_depth"):
+            assert name in txt, name
+
+
+# -- vectorized decimal fallback ---------------------------------------------
+
+class TestDecimalBlocksFallback:
+    def _reference(self, mants, goff, scales):
+        from victoriametrics_tpu.ops import decimal as dec
+        out = np.empty(mants.size, np.float64)
+        for k in range(scales.size):
+            a, b = int(goff[k]), int(goff[k + 1])
+            out[a:b] = dec.decimal_to_float(mants[a:b], int(scales[k]))
+        return out
+
+    @pytest.mark.parametrize("seed,k", [(0, 1), (1, 7), (2, 64), (3, 300)])
+    def test_matches_per_block_reference(self, seed, k):
+        from victoriametrics_tpu.ops import decimal as dec
+        rng = np.random.default_rng(seed)
+        cnts = rng.integers(0, 50, k)
+        goff = np.concatenate([[0], np.cumsum(cnts)]).astype(np.int64)
+        n = int(goff[-1])
+        mants = rng.integers(-10**12, 10**12, n)
+        # sprinkle specials
+        for v in (dec.V_STALE_NAN, dec.V_NAN, dec.V_INF_POS, dec.V_INF_NEG):
+            idx = rng.integers(0, n, max(n // 17, 1))
+            mants[idx] = v
+        scales = rng.integers(-6, 4, k)
+        want = self._reference(mants, goff, scales)
+        out = np.empty(n, np.float64)
+        dec.decimal_to_float_blocks_py(mants, goff, scales, out)
+        np.testing.assert_array_equal(
+            out.view(np.int64), want.view(np.int64))  # bit-exact, NaN-safe
+
+    def test_pool_split_is_bit_identical(self, monkeypatch):
+        from victoriametrics_tpu.ops import decimal as dec
+        monkeypatch.setattr(dec, "_BLOCKS_SPLIT_MIN", 64)
+        rng = np.random.default_rng(9)
+        k = 40
+        cnts = rng.integers(1, 64, k)
+        goff = np.concatenate([[0], np.cumsum(cnts)]).astype(np.int64)
+        n = int(goff[-1])
+        mants = rng.integers(-10**9, 10**9, n)
+        scales = rng.integers(-3, 3, k)
+        want = self._reference(mants, goff, scales)
+        pool = WorkPool(workers=3)
+        try:
+            out = np.empty(n, np.float64)
+            dec.decimal_to_float_blocks_py(mants, goff, scales, out,
+                                           pool=pool)
+            np.testing.assert_array_equal(out.view(np.int64),
+                                          want.view(np.int64))
+        finally:
+            pool.shutdown()
+
+    @needs_storage
+    def test_search_columns_no_native_fallback(self, tmp_path, monkeypatch):
+        """The fallback decode path (native unavailable) must return the
+        same result as the native path — exercised through the full
+        search_columns stack."""
+        s = Storage(str(tmp_path / "s"))
+        # distinct exponents per series: 0.5 vs 3.0 vs 1e-3 step values
+        rows = []
+        for i, scale in enumerate((0.5, 3.0, 0.001, 12345.0)):
+            rows += [({"__name__": "fb", "i": str(i)},
+                      T0 + j * 15_000, (j + 1) * scale) for j in range(40)]
+        s.add_rows(rows)
+        s.force_flush()
+        flt = filters_from_dict({"__name__": "fb"})
+        native_cols = s.search_columns(flt, T0 - 1, T0 + 10**7)
+        from victoriametrics_tpu import native as native_mod
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+        fb_cols = s.search_columns(flt, T0 - 1, T0 + 10**7)
+        assert native_cols.ts.tobytes() == fb_cols.ts.tobytes()
+        assert native_cols.vals.tobytes() == fb_cols.vals.tobytes()
+        np.testing.assert_array_equal(native_cols.counts, fb_cols.counts)
+        assert native_cols.raw_names == fb_cols.raw_names
+        s.close()
+
+
+# -- parallel vs sequential fetch equivalence --------------------------------
+
+def _assert_cols_identical(a, b):
+    assert a.n_series == b.n_series
+    np.testing.assert_array_equal(a.metric_ids, b.metric_ids)
+    np.testing.assert_array_equal(a.counts, b.counts)
+    assert a.ts.tobytes() == b.ts.tobytes()
+    assert a.vals.tobytes() == b.vals.tobytes()
+    assert a.raw_names == b.raw_names
+    if a.stale_rows is None or b.stale_rows is None:
+        assert a.stale_rows is None and b.stale_rows is None
+    else:
+        np.testing.assert_array_equal(a.stale_rows, b.stale_rows)
+
+
+@needs_storage
+class TestParallelSequentialEquivalence:
+    def _build_multipart(self, path, coalescing: bool):
+        """Two monthly partitions; several file parts each; plus pending
+        in-memory rows.  With coalescing=True each series spans many
+        span-capped blocks per part (the coalesce branch in
+        search_columns runs); with False every series is a single tiny
+        block per part."""
+        s = Storage(str(path))
+        n_series = 12
+        per_flush = 60 if not coalescing else 700  # 700*15s ≈ 2.9h: >2 span
+        #                                            blocks after the merge
+        for part_i in range(3):
+            rows = []
+            for i in range(n_series):
+                base = T0 + part_i * per_flush * 15_000
+                rows += [({"__name__": "eq", "i": str(i)},
+                          base + j * 15_000 + i, float((i + 1) * (j + 1)))
+                         for j in range(per_flush)]
+            s.add_rows(rows)
+            s.force_flush()
+        if coalescing:
+            s.force_merge()  # one part, many adjacent same-series blocks
+        # second month partition + unflushed pending rows
+        t1 = T0 + 10 * 86_400_000  # crosses into 2025-08
+        s.add_rows([({"__name__": "eq", "i": str(i)}, t1 + j * 15_000,
+                     float(i + j)) for i in range(n_series)
+                    for j in range(30)])
+        s.force_flush()
+        s.add_rows([({"__name__": "eq", "i": str(i)}, t1 + 10**6 + i, 1.0)
+                    for i in range(n_series)])  # stays pending/in-memory
+        return s
+
+    @pytest.mark.parametrize("coalescing", [False, True])
+    def test_bitwise_equal_and_faster_path_used(self, tmp_path, monkeypatch,
+                                                coalescing):
+        s = self._build_multipart(tmp_path / f"s{coalescing}", coalescing)
+        flt = filters_from_dict({"__name__": "eq"})
+        lo, hi = T0 - 1, T0 + 20 * 86_400_000
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "4")
+        tasks = metricslib.REGISTRY.counter("vm_workpool_tasks_total")
+        before = tasks.get()
+        par = s.search_columns(flt, lo, hi)
+        assert tasks.get() > before, "pool was not used"
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "1")
+        seq = s.search_columns(flt, lo, hi)
+        _assert_cols_identical(par, seq)
+        assert par.n_series == 12 and par.n_samples > 0
+        s.close()
+
+    def test_chunked_prefetch_equivalence(self, tmp_path, monkeypatch):
+        # >64 series (the per-chunk floor) so the tiny sample budget
+        # splits the fetch into several chunks and the prefetch pipeline
+        # actually runs
+        s = Storage(str(tmp_path / "sc"))
+        for flush in range(2):
+            s.add_rows([({"__name__": "eq", "i": str(i)},
+                         T0 + (flush * 10 + j) * 15_000, float(i + j))
+                        for i in range(150) for j in range(10)])
+            s.force_flush()
+        flt = filters_from_dict({"__name__": "eq"})
+        lo, hi = T0 - 1, T0 + 3_600_000
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "4")
+        par_chunks = list(s.search_columns_chunked(
+            flt, lo, hi, max_chunk_samples=400))
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "1")
+        seq_chunks = list(s.search_columns_chunked(
+            flt, lo, hi, max_chunk_samples=400))
+        assert len(par_chunks) == len(seq_chunks) > 1
+        for a, b in zip(par_chunks, seq_chunks):
+            _assert_cols_identical(a, b)
+        s.close()
+
+    def test_chunked_early_close_drains_prefetch(self, tmp_path,
+                                                 monkeypatch):
+        s = self._build_multipart(tmp_path / "se", False)
+        flt = filters_from_dict({"__name__": "eq"})
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "4")
+        gen = s.search_columns_chunked(flt, T0 - 1,
+                                       T0 + 20 * 86_400_000,
+                                       max_chunk_samples=400)
+        next(gen)
+        gen.close()  # must not leave a background fetch racing close()
+        s.close()

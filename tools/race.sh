@@ -10,6 +10,12 @@
 # Reports print both stack traces, count into vm_race_reports_total, and
 # surface as RaceWarning; a failing interleaving is replayed from the
 # seed shown in the failure via devtools.sched.DeterministicScheduler.
+#
+# Covers the parallel read path too: the concurrent fetch stress runs
+# with VM_SEARCH_WORKERS=2 so the shared work pool's submit/result seam
+# (utils/workpool) is exercised under the sanitizer, and the
+# DeterministicScheduler tests pin down the pool's inline-under-
+# scheduler behavior.
 # Extra args pass through to pytest, e.g.:
 #   tools/race.sh -k scheduler
 #   tools/race.sh tests/test_stress_race.py::TestRaceTrace

@@ -223,7 +223,7 @@ def stale_baseline_entries(findings: list[Finding], baseline: Counter,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m victoriametrics_tpu.devtools.lint",
-        description="Project-specific AST lint (rules VMT001..VMT010).")
+        description="Project-specific AST lint (rules VMT001..VMT011).")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the package)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,

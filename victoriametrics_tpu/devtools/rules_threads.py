@@ -1,5 +1,5 @@
-"""VMT008/VMT009/VMT010 — thread-lifecycle and queue discipline (the
-static companions of devtools/racetrace).
+"""VMT008/VMT009/VMT010/VMT011 — thread-lifecycle and queue discipline
+(the static companions of devtools/racetrace).
 
 VMT008: a ``threading.Thread(...)`` constructed without ``daemon=True``
 in a scope that never ``join()``s anything and never sets ``.daemon`` —
@@ -17,6 +17,14 @@ VMT010: a ``queue.Queue`` ``get``/``put`` carrying ``timeout=`` (or
 handler is only ``pass`` — the timeout fires, the signal is dropped,
 and starvation/backpressure becomes invisible.  Handle it: log, break,
 re-check a stop flag, or count it.
+
+VMT011: direct ``threading.Thread(...)`` construction outside
+``devtools/`` and ``apps/`` — hot-path code must go through the shared
+work pool (``utils/workpool``), which bounds thread count at
+``cpu_count``, preserves result order, carries the racetrace
+happens-before seam, and honors ``VM_SEARCH_WORKERS=1``.  Long-lived
+service threads (servers, flush loops) are grandfathered via the
+baseline or an inline disable with a reason.
 """
 
 from __future__ import annotations
@@ -196,5 +204,29 @@ class SwallowedQueueTimeoutRule:
                         "flag explicitly")
 
 
+class DirectThreadRule:
+    rule_id = "VMT011"
+    summary = "threading.Thread(...) outside devtools//apps/ (use workpool)"
+
+    #: path fragments where direct Thread construction is legitimate:
+    #: dev tooling (schedulers, harnesses) and app entry points (servers)
+    _EXEMPT = ("devtools/", "apps/")
+
+    def check(self, ctx):
+        rel = ctx.rel_path.replace("\\", "/")
+        if any(frag in rel for frag in self._EXEMPT):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_thread_ctor(node):
+                yield ctx.finding(
+                    node, self.rule_id,
+                    "direct threading.Thread(...) on a non-devtools/apps "
+                    "path; hot-path fan-out must go through "
+                    "utils.workpool.POOL (bounded, ordered, racetrace-"
+                    "aware, VM_SEARCH_WORKERS-gated) — long-lived service "
+                    "threads need a '# vmt: disable=VMT011' with a reason "
+                    "or a baseline entry")
+
+
 RULES = [UnjoinedThreadRule(), CrossObjectGuardedWriteRule(),
-         SwallowedQueueTimeoutRule()]
+         SwallowedQueueTimeoutRule(), DirectThreadRule()]

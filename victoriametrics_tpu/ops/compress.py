@@ -8,20 +8,40 @@ the reference reaches the same trade-off via its cgo fast path.
 (De)compressor objects are NOT thread-safe for concurrent use, so they are
 kept thread-local — the storage engine decompresses from query threads while
 flusher threads compress.
+
+Gated dependency: when the `zstandard` package is absent (minimal dev
+containers), `compress` falls back to stdlib zlib so the storage engine
+stays importable and testable.  `decompress` sniffs the frame magic and
+accepts BOTH encodings regardless of which codec produced the part, so
+data written by either build reads back on either build; only
+zstd-compressed data on a host with neither libzstd binding fails, and it
+fails loudly.
 """
 
 from __future__ import annotations
 
 import threading
+import zlib
 
-import zstandard
+try:
+    import zstandard
+except ImportError:  # minimal container: stdlib fallback, see docstring
+    zstandard = None
 
 DEFAULT_LEVEL = 1
+
+#: every zstd frame starts with this magic (RFC 8878); zlib streams start
+#: with 0x78 — disjoint, so decompress can sniff the producer
+_ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
 
 _tls = threading.local()
 
 
-def _compressor(level: int) -> zstandard.ZstdCompressor:
+def zstd_available() -> bool:
+    return zstandard is not None
+
+
+def _compressor(level: int):
     cs = getattr(_tls, "compressors", None)
     if cs is None:
         cs = _tls.compressors = {}
@@ -31,7 +51,7 @@ def _compressor(level: int) -> zstandard.ZstdCompressor:
     return c
 
 
-def _decompressor() -> zstandard.ZstdDecompressor:
+def _decompressor():
     d = getattr(_tls, "decompressor", None)
     if d is None:
         d = _tls.decompressor = zstandard.ZstdDecompressor()
@@ -39,8 +59,23 @@ def _decompressor() -> zstandard.ZstdDecompressor:
 
 
 def compress(data: bytes, level: int = DEFAULT_LEVEL) -> bytes:
+    if zstandard is None:
+        return zlib.compress(data, level)
     return _compressor(level).compress(data)
 
 
 def decompress(data: bytes, max_size: int = 1 << 30) -> bytes:
-    return _decompressor().decompress(data, max_output_size=max_size)
+    if data.startswith(_ZSTD_MAGIC):
+        if zstandard is None:
+            raise RuntimeError(
+                "cannot decompress zstd data: the 'zstandard' package is "
+                "not installed in this build")
+        return _decompressor().decompress(data, max_output_size=max_size)
+    # bounded like the zstd path's max_output_size: cap BEFORE the whole
+    # stream materializes, so a hostile/corrupt frame (zlib bomb over an
+    # RPC boundary) cannot balloon memory
+    d = zlib.decompressobj()
+    out = d.decompress(data, max_size + 1)
+    if len(out) > max_size:
+        raise ValueError(f"decompressed size exceeds {max_size}")
+    return out + d.flush()

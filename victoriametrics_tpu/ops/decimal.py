@@ -394,6 +394,69 @@ def decimal_to_float(ints: np.ndarray, exponent: int) -> np.ndarray:
     return out
 
 
+#: sample count above which one exponent run is split across pool workers
+_BLOCKS_SPLIT_MIN = 1 << 19
+
+
+def decimal_to_float_blocks_py(mants: np.ndarray, goff: np.ndarray,
+                               scales: np.ndarray, out: np.ndarray,
+                               pool=None) -> np.ndarray:
+    """Pure-numpy twin of native.decimal_to_float_blocks: convert
+    per-block (mantissa, exponent) columns into float64 `out` in place.
+
+    ``goff`` is the (K+1,) exclusive block-offset prefix; block k owns
+    samples [goff[k], goff[k+1]) at exponent ``scales[k]``.
+
+    One sort-by-scale pass: blocks are argsorted by exponent (K log K on
+    BLOCK count, not samples), their sample positions gathered once, and
+    each distinct exponent converts its whole sample run in one
+    decimal_to_float call — O(samples + K log K), replacing the old
+    per-exponent full-length repeat mask that made the fallback
+    O(samples x distinct_exponents).
+
+    Disjoint runs (and oversized single runs) optionally split across
+    ``pool`` (utils/workpool.WorkPool): every task writes a disjoint
+    region of ``out``, so parallel execution is bit-identical."""
+    K = int(scales.size)
+    if K == 0 or out.size == 0:
+        return out
+    uniq = np.unique(scales)
+    if uniq.size == 1:
+        # common case (one part, uniform scrape payloads): no gather at all
+        out[:] = decimal_to_float(mants, int(uniq[0]))
+        return out
+    cnts = goff[1:] - goff[:-1]
+    order = np.argsort(scales, kind="stable")
+    ss = scales[order]
+    sorted_cnts = cnts[order]
+    tot = int(sorted_cnts.sum())
+    excl = np.cumsum(sorted_cnts) - sorted_cnts
+    pos = np.repeat(goff[:-1][order] - excl, sorted_cnts) + \
+        np.arange(tot, dtype=np.int64)
+    runs = []                       # (sample_lo, sample_hi, exponent)
+    bstart = np.flatnonzero(np.concatenate([[True], ss[1:] != ss[:-1]]))
+    sstart = excl[bstart]
+    send = np.append(sstart[1:], tot)
+    for lo, hi, e in zip(sstart, send, ss[bstart]):
+        lo, hi, e = int(lo), int(hi), int(e)
+        # split giant runs so the pool can overlap them too
+        step = max(_BLOCKS_SPLIT_MIN, -(-(hi - lo) // 8))
+        for a in range(lo, hi, step):
+            runs.append((a, min(a + step, hi), e))
+
+    def conv(lo: int, hi: int, e: int):
+        p = pos[lo:hi]
+        out[p] = decimal_to_float(mants[p], e)
+
+    if pool is not None and len(runs) > 1 and tot >= _BLOCKS_SPLIT_MIN:
+        from functools import partial
+        pool.run([partial(conv, *r) for r in runs])
+    else:
+        for r in runs:
+            conv(*r)
+    return out
+
+
 def calibrate_scale(a: np.ndarray, a_exp: int, b: np.ndarray, b_exp: int
                     ) -> tuple[np.ndarray, np.ndarray, int]:
     """Bring two mantissa arrays to a common exponent (reference

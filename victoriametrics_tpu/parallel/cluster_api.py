@@ -882,8 +882,17 @@ class ClusterStorage:
 
     def _fanout(self, fn):
         """Run fn(node) on every healthy node concurrently (scatter-gather;
-        the reference fans out to all vmstorage nodes in parallel). Known-down
-        nodes are skipped but still count toward the partial flag."""
+        the reference fans out to all vmstorage nodes in parallel) via the
+        shared work pool (utils/workpool) instead of spawning fresh
+        threads per query — RPC reads release the GIL, and a fanout task
+        hitting an in-process LocalNode may fan its own part collection
+        across the same pool (the pool's helping waiters make that
+        nesting deadlock-free). Trade-off: network waits share the
+        cpu_count-sized pool with decode units, so very wide clusters
+        (nodes >> cores) serialize some per-node waits; at this port's
+        node counts that is cheaper than a thread per node per query,
+        and the helping caller always makes progress. Known-down nodes
+        are skipped but still count toward the partial flag."""
         results: list = []
         errors: list = []
         lock = make_lock("parallel.cluster_api.fanout_lock")
@@ -906,12 +915,10 @@ class ClusterStorage:
             for n in live:
                 run(n)
         else:
-            threads = [threading.Thread(target=run, args=(n,), daemon=True)
-                       for n in live]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
+            from functools import partial
+
+            from ..utils import workpool
+            workpool.POOL.run([partial(run, n) for n in live])
         if errors and not results:
             raise RPCError(f"all storage nodes failed: {errors[0][1]}")
         if errors:

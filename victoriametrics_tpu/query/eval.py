@@ -27,6 +27,17 @@ from .types import EvalConfig, Timeseries, const_series, new_series
 
 nan = np.nan
 
+# host-rollup share of vm_fetch_phase_seconds_total (storage/storage.py
+# owns the fetch-side phases; bench.py reads the whole family to
+# attribute a refresh between index/collect/decode/assemble/rollup)
+def _rollup_phase_lap(t0: float) -> None:
+    import time as _t
+
+    from ..utils import metrics as _metricslib
+    _metricslib.REGISTRY.float_counter(
+        'vm_fetch_phase_seconds_total{phase="rollup"}').inc(
+            _t.perf_counter() - t0)
+
 
 class QueryError(ValueError):
     pass
@@ -359,6 +370,8 @@ def _rollup_from_storage_cols(ec: EvalConfig, func: str, re_: RollupExpr,
                                            step=cfg.step, window=a)
                               for a in adj]
     with admission:
+        import time as _time
+        t0r = _time.perf_counter()
         if per_series_cfg is None:
             with ec.tracer.new_child("host rollup %s (columns)",
                                      func) as qt:
@@ -366,6 +379,7 @@ def _rollup_from_storage_cols(ec: EvalConfig, func: str, re_: RollupExpr,
                                                      cols.vals, cols.counts,
                                                      cfg, args)
                 if rows is not None:
+                    _rollup_phase_lap(t0r)
                     qt.donef("%d series (packed)", cols.n_series)
                     return _cache_rollup(ec, ckey,
                                          _finish_rollup_cols(cols, rows,
@@ -381,6 +395,7 @@ def _rollup_from_storage_cols(ec: EvalConfig, func: str, re_: RollupExpr,
                 c = per_series_cfg[i] if per_series_cfg is not None else cfg
                 out_rows.append(rollup_series(func, cols.ts[i, :n],
                                               cols.vals[i, :n], c, args))
+            _rollup_phase_lap(t0r)
             qt.donef("%d series", cols.n_series)
         return _cache_rollup(ec, ckey,
                              _finish_rollup_cols(cols, out_rows, keep_name))
@@ -1195,6 +1210,8 @@ def _try_host_chunked_aggr(ec: EvalConfig, ae) -> list[Timeseries] | None:
                             RollupConfig(start=start, end=end,
                                          step=ec.step, window=a)
                             for a in adj]
+                import time as _time
+                t0r = _time.perf_counter()
                 rows = None
                 if per_series_cfg is None:
                     rows = rollup_np.rollup_batch_packed(
@@ -1210,6 +1227,7 @@ def _try_host_chunked_aggr(ec: EvalConfig, ae) -> list[Timeseries] | None:
                         rows[i] = rollup_series(
                             func, cols.ts[i, :counts[i]],
                             cols.vals[i, :counts[i]], c, ())
+                _rollup_phase_lap(t0r)
                 rows = np.asarray(rows, dtype=np.float64)
                 gids = np.empty(cols.n_series, np.int64)
                 for i, mn in enumerate(cols.metric_names):

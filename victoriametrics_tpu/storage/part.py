@@ -349,6 +349,11 @@ class Part:
             self._ts_buf = self._val_buf = None  # fall back to pread path
         from ..devtools.locktrace import make_lock
         self._lock = make_lock("storage.Part._lock")
+        # serializes the one-time header-column build: with the shared
+        # work pool, two workers routinely hit a cold part at once, and
+        # racing duplicate builds would double the index decompression
+        # (distinct from _lock, which read_headers takes inside the build)
+        self._hdr_cols_lock = make_lock("storage.Part._hdr_cols_lock")
         # parts are immutable, so both caches never go stale (the reference
         # keeps compressed blocks in lib/blockcache sized to 25% RAM; here we
         # cache the *decoded* form so warm queries skip unmarshal entirely)
@@ -468,21 +473,25 @@ class Part:
         numpy masking instead of per-header Python objects."""
         hc = self._hdr_cols
         if hc is None:
-            bufs = []
-            for row in self.meta_rows:
-                raw = zstd.decompress(self._read(self._idx_f,
-                                                 row.index_offset,
-                                                 row.index_size))
-                bufs.append(np.frombuffer(raw, dtype=_HDR_DTYPE))
-            arr = (np.concatenate(bufs) if bufs
-                   else np.zeros(0, dtype=_HDR_DTYPE))
-            hc = {k: arr[k].astype(np.int64)
-                  for k in ("mid", "min_ts", "max_ts", "rows", "scale",
-                            "ts_first", "val_first", "ts_off", "ts_size",
-                            "val_off", "val_size")}
-            hc["ts_mt"] = arr["ts_mt"].astype(np.int32)
-            hc["val_mt"] = arr["val_mt"].astype(np.int32)
-            self._hdr_cols = hc
+            with self._hdr_cols_lock:
+                hc = self._hdr_cols
+                if hc is not None:
+                    return hc
+                bufs = []
+                for row in self.meta_rows:
+                    raw = zstd.decompress(self._read(self._idx_f,
+                                                     row.index_offset,
+                                                     row.index_size))
+                    bufs.append(np.frombuffer(raw, dtype=_HDR_DTYPE))
+                arr = (np.concatenate(bufs) if bufs
+                       else np.zeros(0, dtype=_HDR_DTYPE))
+                hc = {k: arr[k].astype(np.int64)
+                      for k in ("mid", "min_ts", "max_ts", "rows", "scale",
+                                "ts_first", "val_first", "ts_off", "ts_size",
+                                "val_off", "val_size")}
+                hc["ts_mt"] = arr["ts_mt"].astype(np.int32)
+                hc["val_mt"] = arr["val_mt"].astype(np.int32)
+                self._hdr_cols = hc
         return hc
 
     def collect_columns(self, mids_sorted, min_ts, max_ts):

@@ -678,13 +678,23 @@ class Partition:
             yield from p.iter_blocks(tsid_set, min_ts, max_ts,
                                      tsid_lo, tsid_hi)
 
-    def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
-                        tsid_lo=None, tsid_hi=None, mids_sorted=None):
-        """Batched block collection: returns (mids, cnts, scales, ts_concat,
-        mant_concat) numpy arrays over every matching block in this
-        partition. File parts decode ALL their matched blocks in one native
-        call (part.read_blocks_columns); in-memory parts are masked
-        columnar views with zero per-block Python."""
+    def collect_units(self, tsid_set=None, min_ts=None, max_ts=None,
+                      tsid_lo=None, tsid_hi=None, mids_sorted=None):
+        """Batched block collection, split into independent work units
+        for the shared fetch pool (utils/workpool): returns a list of
+        zero-arg callables, each yielding a list of (mids, cnts, scales,
+        ts_concat, mant_concat) pieces.  Executing the units in ORDER and
+        concatenating their outputs is bit-identical to the sequential
+        collection — the pool preserves submit order, so parallel and
+        sequential fetches return the same bytes.
+
+        Unit granularity: all in-memory parts form ONE unit (masked
+        columnar views, pure numpy — cheap); each file part is its own
+        unit (zstd + native decode release the GIL, so units genuinely
+        overlap on workers).  Snapshotting the part lists (and converting
+        pending rows) happens HERE on the calling thread, under the
+        partition lock discipline; the returned closures touch only
+        immutable parts."""
         while True:
             pend, gen = self._pending_views()
             with self._lock:
@@ -699,35 +709,57 @@ class Partition:
         lo = -(1 << 62) if min_ts is None else min_ts
         hi = (1 << 62) if max_ts is None else max_ts
         from .part import clip_piece
-        pieces = []
-        for src in mems:
-            if src.max_ts < lo or src.min_ts > hi:
-                continue
-            piece = src.collect_columns(mids_sorted, min_ts, max_ts)
-            if piece is not None:
-                pieces.append(clip_piece(*piece, min_ts, max_ts))
+        units = []
+        mems = [src for src in mems
+                if src.max_ts >= lo and src.min_ts <= hi]
+        if mems:
+            def mem_unit(mems=mems):
+                pieces = []
+                for src in mems:
+                    piece = src.collect_columns(mids_sorted, min_ts, max_ts)
+                    if piece is not None:
+                        pieces.append(clip_piece(*piece, min_ts, max_ts))
+                return pieces
+            units.append(mem_unit)
         for p in files:
             if p.max_ts < lo or p.min_ts > hi:
                 continue
-            piece = p.collect_columns(mids_sorted, min_ts, max_ts)
-            if piece is False:
-                continue  # vectorized path ran; nothing matched
-            if piece is not None:
-                pieces.append(piece)  # already row-clipped
-                continue
-            # fallback: native decode unavailable — per-header object path
-            hdrs = list(p.iter_headers(tsid_set, min_ts, max_ts,
-                                       tsid_lo, tsid_hi))
-            if not hdrs:
-                continue
-            K = len(hdrs)
-            ts_c, m_c = p.read_blocks_columns(hdrs)
-            pieces.append(clip_piece(
-                np.fromiter((h.tsid.metric_id for h in hdrs), np.int64, K),
-                np.fromiter((h.rows for h in hdrs), np.int64, K),
-                np.fromiter((h.scale for h in hdrs), np.int64, K),
-                ts_c, m_c, min_ts, max_ts))
-        return pieces
+
+            def file_unit(p=p):
+                piece = p.collect_columns(mids_sorted, min_ts, max_ts)
+                if piece is False:
+                    return []  # vectorized path ran; nothing matched
+                if piece is not None:
+                    return [piece]  # already row-clipped
+                # fallback: native decode unavailable — per-header path
+                hdrs = list(p.iter_headers(tsid_set, min_ts, max_ts,
+                                           tsid_lo, tsid_hi))
+                if not hdrs:
+                    return []
+                K = len(hdrs)
+                ts_c, m_c = p.read_blocks_columns(hdrs)
+                return [clip_piece(
+                    np.fromiter((h.tsid.metric_id for h in hdrs),
+                                np.int64, K),
+                    np.fromiter((h.rows for h in hdrs), np.int64, K),
+                    np.fromiter((h.scale for h in hdrs), np.int64, K),
+                    ts_c, m_c, min_ts, max_ts)]
+            units.append(file_unit)
+        return units
+
+    def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
+                        tsid_lo=None, tsid_hi=None, mids_sorted=None):
+        """Batched block collection: returns (mids, cnts, scales, ts_concat,
+        mant_concat) numpy arrays over every matching block in this
+        partition. File parts decode ALL their matched blocks in one native
+        call (part.read_blocks_columns); in-memory parts are masked
+        columnar views with zero per-block Python.  (Sequential execution
+        of collect_units; Table.collect_columns fans the same units across
+        the shared work pool.)"""
+        return [piece
+                for unit in self.collect_units(tsid_set, min_ts, max_ts,
+                                               tsid_lo, tsid_hi, mids_sorted)
+                for piece in unit()]
 
     @property
     def rows(self) -> int:

@@ -21,6 +21,8 @@ import numpy as np
 
 from ..devtools.locktrace import make_rlock
 from ..utils import logger
+from ..utils import metrics as metricslib
+from ..utils import workpool
 from .dedup import deduplicate
 from .index_db import IndexDB, date_of_ms
 from .metric_name import MetricName
@@ -29,6 +31,15 @@ from .tag_filters import TagFilter
 from .tsid import MetricIDGenerator, TSID, generate_tsid
 
 DEFAULT_RETENTION_MS = 31 * 13 * 86_400_000  # ~13 months, like the reference
+
+# per-phase fetch attribution (bench.py and /metrics read these): seconds
+# spent in each stage of the columnar read path, labeled like the
+# reference's per-stage vmselect metrics
+_PHASE = {
+    ph: metricslib.REGISTRY.float_counter(
+        f'vm_fetch_phase_seconds_total{{phase="{ph}"}}')
+    for ph in ("index_search", "collect", "decode", "assemble")
+}
 
 
 class _ColumnarSpace:
@@ -114,6 +125,13 @@ class _ColumnarSpace:
 
     def close(self):
         self.keymap.close()
+
+
+def _phase_lap(phase: str, t0: float) -> float:
+    """Account wall time since t0 to a fetch phase; returns the new t0."""
+    now = time.perf_counter()
+    _PHASE[phase].inc(now - t0)
+    return now
 
 
 class SeriesData:
@@ -724,21 +742,57 @@ class Storage:
         est = max((max_ts - min_ts) // 15_000 + 2, 1)
         i, S = 0, len(tsids)
         seen = 0
-        while i < S:
-            k = max(int(max_chunk_samples // est), 64)
-            cols = self.search_columns(filters, min_ts, max_ts,
+
+        def fetch(lo: int, k: int):
+            return self.search_columns(filters, min_ts, max_ts,
                                        dedup_interval_ms, None, tenant,
-                                       _tsids=tsids[i:i + k])
-            # limit counts series WITH DATA in range (cumulative),
-            # matching search_columns' post-collection semantics
-            seen += cols.n_series
-            if max_series is not None and seen > max_series:
-                raise ResourceWarning(
-                    f"query matches more than {max_series} series")
-            yield cols
-            if cols.n_series:
-                est = max(cols.n_samples // cols.n_series, 1)
-            i += k
+                                       _tsids=tsids[lo:lo + k])
+
+        # pipelined prefetch: chunk i+1's fetch/decode runs on the shared
+        # work pool while the consumer rolls chunk i up (the netstorage
+        # fetch/compute overlap); chunk boundaries, results and error
+        # behavior are identical to the sequential loop because est is
+        # updated from chunk i BEFORE chunk i+1's size is computed in
+        # both modes.  With VM_SEARCH_WORKERS=1 there is no prefetch.
+        pool = workpool.POOL
+        pending = None
+        try:
+            k = max(int(max_chunk_samples // est), 64)
+            cols = fetch(i, k)
+            while True:
+                # limit counts series WITH DATA in range (cumulative),
+                # matching search_columns' post-collection semantics
+                seen += cols.n_series
+                if max_series is not None and seen > max_series:
+                    raise ResourceWarning(
+                        f"query matches more than {max_series} series")
+                if cols.n_series:
+                    est = max(cols.n_samples // cols.n_series, 1)
+                i += k
+                if i >= S:
+                    yield cols
+                    return
+                k = max(int(max_chunk_samples // est), 64)
+                if pool.parallel_enabled():
+                    from functools import partial
+                    pending = pool.submit(partial(fetch, i, k))
+                    yield cols
+                    cols, pending = pending.result(), None
+                else:
+                    yield cols
+                    cols = fetch(i, k)
+        except GeneratorExit:
+            # consumer abandoned the generator: drain the in-flight
+            # prefetch so no background fetch outlives the query (it may
+            # race a storage close)
+            if pending is not None:
+                try:
+                    pending.result()
+                except BaseException:  # vmt: disable=VMT003 — the query
+                    pass               # was abandoned; its error has no
+                #                        consumer and must not mask the
+                #                        GeneratorExit being re-raised
+            raise
 
     def search_columns(self, filters: list[TagFilter], min_ts: int,
                        max_ts: int, dedup_interval_ms: int | None = None,
@@ -752,8 +806,18 @@ class Storage:
         from .columnar import ColumnarSeries, assemble
         interval = (self.dedup_interval_ms if dedup_interval_ms is None
                     else dedup_interval_ms)
+        with workpool.SEARCH_GATE:
+            return self._search_columns_gated(
+                filters, min_ts, max_ts, interval, max_series, tenant,
+                _tsids, ColumnarSeries, assemble)
+
+    def _search_columns_gated(self, filters, min_ts, max_ts, interval,
+                              max_series, tenant, _tsids, ColumnarSeries,
+                              assemble):
+        t_ph = time.perf_counter()
         tsids = (self.idb.search_tsids(filters, min_ts, max_ts, tenant)
                  if _tsids is None else _tsids)
+        t_ph = _phase_lap("index_search", t_ph)
         empty = ColumnarSeries.empty()
         if not tsids:
             return empty
@@ -761,6 +825,7 @@ class Storage:
         pieces = self.table.collect_columns(
             tsid_set, min_ts, max_ts,
             tsid_lo=tsids[0].sort_key(), tsid_hi=tsids[-1].sort_key())
+        t_ph = _phase_lap("collect", t_ph)
         if not pieces:
             return empty
         if len(pieces) == 1:
@@ -784,10 +849,12 @@ class Storage:
             _native.decimal_to_float_blocks(
                 np.ascontiguousarray(mant_all), goff, scales, vals_f)
         else:
+            # one sort-by-scale pass, split across the work pool (every
+            # task writes a disjoint out region: bit-identical results)
             from ..ops import decimal as dec_ops
-            for e in np.unique(scales):
-                sel = np.repeat(scales == e, cnts)
-                vals_f[sel] = dec_ops.decimal_to_float(mant_all[sel], int(e))
+            dec_ops.decimal_to_float_blocks_py(mant_all, goff, scales,
+                                               vals_f, pool=workpool.POOL)
+        t_ph = _phase_lap("decode", t_ph)
         # resolve names FIRST and bake the canonical raw-name row order into
         # the assembly scatter (no post-assembly reorder pass)
         uniq = np.unique(mids)
@@ -874,6 +941,7 @@ class Storage:
         if cols.metric_names:
             self.track_name_usage(
                 {mn.metric_group for mn in cols.metric_names})
+        _phase_lap("assemble", t_ph)
         return cols
 
     def search_series(self, filters: list[TagFilter], min_ts: int,

@@ -122,18 +122,27 @@ class Table:
     def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
                         tsid_lo=None, tsid_hi=None, mids_sorted=None):
         """Batched per-partition block collection (see
-        Partition.collect_columns); returns a flat list of pieces."""
+        Partition.collect_units); returns a flat list of pieces.
+
+        The per-partition/per-part units fan across the shared work pool
+        (utils/workpool — the netstorage unpack-worker role): zstd +
+        native decode release the GIL, so a cold multi-part fetch scales
+        with cores.  The pool returns unit results in submit order, so
+        the flattened piece list is bit-identical to sequential
+        collection; VM_SEARCH_WORKERS=1 runs the exact sequential path."""
         parts = self.partitions_for_range(
             min_ts if min_ts is not None else -(1 << 62),
             max_ts if max_ts is not None else 1 << 62)
         if mids_sorted is None and tsid_set is not None:
             mids_sorted = np.fromiter(tsid_set, np.int64, len(tsid_set))
             mids_sorted.sort()
-        out = []
+        units = []
         for p in parts:
-            out.extend(p.collect_columns(tsid_set, min_ts, max_ts,
+            units.extend(p.collect_units(tsid_set, min_ts, max_ts,
                                          tsid_lo, tsid_hi, mids_sorted))
-        return out
+        from ..utils import workpool
+        return [piece for pieces in workpool.POOL.run(units)
+                for piece in pieces]
 
     def enforce_retention(self, min_valid_ts: int) -> int:
         """Drop partitions entirely older than retention; returns count

@@ -1,0 +1,322 @@
+"""Shared fetch/decode work pool + search-concurrency gate (the
+single-node analog of the reference's per-CPU read parallelism:
+app/vmselect/netstorage unpack workers fanning block decode across
+gomaxprocs goroutines, and lib/storage's search concurrency limiter
+bounding how many searches run at once).
+
+One process owns ONE lazily-started pool (:data:`POOL`) of
+``cpu_count`` daemon workers.  The hot storage read path fans
+per-partition/per-part piece collection across it (zstd + native decode
+release the GIL, so the workers genuinely overlap), the cluster fanout
+reuses it instead of spawning fresh threads per query, and the chunked
+fetch pipeline prefetches chunk *i+1* on it while chunk *i* rolls up.
+
+Design constraints, in order:
+
+- **Determinism of results.**  ``run(fns)`` returns results in submit
+  order; callers that concatenate them get byte-identical output to the
+  sequential loop.  ``VM_SEARCH_WORKERS=1`` disables the pool entirely
+  (every ``run`` degenerates to an inline ``[fn() for fn in fns]``),
+  restoring today's single-threaded execution exactly — the escape
+  hatch the deterministic scheduler and bisection both rely on.
+- **No deadlocks under nesting.**  A task may itself call ``run`` (a
+  cluster fanout task fetches from a local node whose table fans parts
+  across the same pool).  Waiters therefore HELP: while its batch is
+  incomplete, the submitting thread drains and executes queued tasks
+  instead of parking, so every ``run`` makes progress even when all
+  workers are blocked in nested waits.
+- **Happens-before edges the racetrace sanitizer understands.**  Tasks
+  travel through a ``queue.Queue`` (put/get carry vector clocks when
+  the sanitizer is on: submit *happens-before* execute), each batch's
+  result slots are written and read under a ``make_lock`` lock
+  (execute *happens-before* collect), and completion is signalled by
+  one ``queue.Queue`` put (the final execute *happens-before* the
+  waiter's wakeup).  No bare Events/Conditions anywhere on the seam.
+- **Deterministic-scheduler safety.**  A thread scheduled by
+  ``devtools.sched.DeterministicScheduler`` executes its batch INLINE:
+  pool workers are not turnstile participants, so handing them work
+  would reintroduce the wall-clock nondeterminism the scheduler exists
+  to remove (and a scheduled thread parked in ``done.get()`` would
+  stall the turnstile until ``step_timeout`` seizes it).
+
+Pool sizing: ``VM_SEARCH_WORKERS`` — unset/``0`` means ``cpu_count``,
+``1`` disables parallelism, ``N>1`` pins the worker count.  The env var
+is re-read at every ``run``/``submit`` so tests (and the deterministic
+scheduler harness) can flip modes without restarting the process.
+
+Self-metrics (PR-2 registry): ``vm_workpool_tasks_total``,
+``vm_workpool_queue_depth``, ``vm_workpool_workers``, and from the
+gate ``vm_search_concurrent_{current,limit}`` plus
+``vm_search_requests_{queued,rejected}_total``.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+
+from ..devtools.locktrace import make_lock
+from . import metrics as metricslib
+
+__all__ = ["WorkPool", "Future", "SearchGate", "SearchLimitError",
+           "POOL", "SEARCH_GATE", "configured_workers"]
+
+_TASKS_TOTAL = metricslib.REGISTRY.counter("vm_workpool_tasks_total")
+
+
+def configured_workers() -> int:
+    """Worker count from ``VM_SEARCH_WORKERS`` (unset/0 -> cpu_count,
+    1 -> parallelism disabled, N -> N)."""
+    raw = os.environ.get("VM_SEARCH_WORKERS", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        n = 0
+    if n <= 0:
+        n = os.cpu_count() or 1
+    return n
+
+
+def _sched_active() -> bool:
+    """True when the calling thread runs under the deterministic
+    scheduler (devtools.sched) — batches then execute inline."""
+    from ..devtools import racetrace
+    return getattr(racetrace._tls, "sched", None) is not None
+
+
+class _Batch:
+    """One run()/submit() call's shared state: ordered result slots, a
+    pending count, the first error, and a one-shot completion queue."""
+
+    __slots__ = ("lock", "results", "pending", "error", "done")
+
+    def __init__(self, n: int):
+        self.lock = make_lock("utils.workpool._Batch.lock")
+        self.results = [None] * n
+        self.pending = n
+        self.error: BaseException | None = None
+        self.done: queue.Queue = queue.Queue()
+
+
+class Future:
+    """Handle for one submitted task; ``result()`` waits (helping the
+    pool while it does) and re-raises the task's exception."""
+
+    __slots__ = ("_pool", "_batch")
+
+    def __init__(self, pool: "WorkPool", batch: _Batch):
+        self._pool = pool
+        self._batch = batch
+
+    def result(self):
+        return self._pool._collect(self._batch)[0]
+
+
+class WorkPool:
+    def __init__(self, workers: int | None = None):
+        # None = resolve VM_SEARCH_WORKERS at every run (the shared POOL);
+        # an int pins the size (tests)
+        self._cfg_workers = workers
+        self._lock = make_lock("utils.workpool.WorkPool._lock")
+        self._q: queue.Queue = queue.Queue()
+        self._threads: list[threading.Thread] = []
+
+    # -- sizing ------------------------------------------------------------
+
+    def workers(self) -> int:
+        return self._cfg_workers if self._cfg_workers is not None \
+            else configured_workers()
+
+    def parallel_enabled(self) -> bool:
+        """True when run()/submit() would actually use worker threads."""
+        return self.workers() > 1 and not _sched_active()
+
+    def _ensure_started(self, want: int) -> None:
+        with self._lock:
+            while len(self._threads) < want:
+                t = threading.Thread(  # vmt: disable=VMT011 — the pool itself
+                    target=self._worker, daemon=True,
+                    name=f"vm-workpool-{len(self._threads)}")
+                self._threads.append(t)
+                t.start()
+
+    def _worker(self) -> None:
+        me = threading.current_thread()
+        while True:
+            item = self._q.get()
+            if item is None:        # shutdown sentinel (tests only)
+                return
+            self._exec(item)
+            # converge toward a LOWERED VM_SEARCH_WORKERS: excess workers
+            # retire after finishing a task (threads can't be resized in
+            # place; idle excess workers retire at their next task)
+            with self._lock:
+                if len(self._threads) > max(self.workers(), 1) and \
+                        me in self._threads:
+                    self._threads.remove(me)
+                    return
+
+    def shutdown(self) -> None:
+        """Stop the workers (tests; call between batches, not racing an
+        in-flight run()); in production the daemon workers simply die
+        with the process."""
+        with self._lock:
+            threads, self._threads = self._threads, []
+        for _ in threads:
+            self._q.put(None)
+        for t in threads:
+            t.join(timeout=10)
+
+    # -- execution ---------------------------------------------------------
+
+    def _exec(self, item) -> None:
+        fn, i, batch = item
+        err = None
+        try:
+            r = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised in _collect
+            err = e
+            r = None
+        with batch.lock:
+            batch.results[i] = r
+            if err is not None and batch.error is None:
+                batch.error = err
+            batch.pending -= 1
+            last = batch.pending == 0
+        if last:
+            # exactly one put per batch: the waiter's done.get() pairs
+            # with it (and carries the finisher's vector clock)
+            batch.done.put(None)
+
+    def _collect(self, batch: _Batch):
+        """Wait for a batch, helping with queued work (any batch's)
+        while waiting — the no-deadlock-under-nesting guarantee."""
+        while True:
+            with batch.lock:
+                if batch.pending == 0:
+                    break
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                batch.done.get()
+                break
+            if item is None:
+                # a shutdown sentinel racing this waiter: hand it back to
+                # the worker it was meant for and park — our batch's tasks
+                # were enqueued before the sentinel, so workers drain them
+                # first (FIFO)
+                self._q.put(None)
+                batch.done.get()
+                break
+            self._exec(item)
+        with batch.lock:
+            err = batch.error
+            results = list(batch.results)
+        if err is not None:
+            raise err
+        return results
+
+    def run(self, fns) -> list:
+        """Execute every callable, returning results in submit order;
+        the first raised exception is re-raised after the whole batch
+        drains (no task of a failed batch is left running)."""
+        fns = list(fns)
+        n = len(fns)
+        if n == 0:
+            return []
+        w = self.workers()
+        if n == 1 or w <= 1 or _sched_active():
+            return [fn() for fn in fns]
+        self._ensure_started(min(w, n))
+        batch = _Batch(n)
+        _TASKS_TOTAL.inc(n)
+        for i, fn in enumerate(fns):
+            self._q.put((fn, i, batch))
+        return self._collect(batch)
+
+    def submit(self, fn) -> Future:
+        """Pipeline seam: run one task in the background (inline when
+        the pool is disabled) and collect it later via Future.result()."""
+        batch = _Batch(1)
+        if self.workers() <= 1 or _sched_active():
+            self._exec((fn, 0, batch))
+            return Future(self, batch)
+        self._ensure_started(1)
+        _TASKS_TOTAL.inc()
+        self._q.put((fn, 0, batch))
+        return Future(self, batch)
+
+
+#: the one shared pool; sized by VM_SEARCH_WORKERS at first parallel use
+POOL = WorkPool()
+
+metricslib.REGISTRY.gauge("vm_workpool_workers",
+                          callback=lambda: len(POOL._threads))
+metricslib.REGISTRY.gauge("vm_workpool_queue_depth",
+                          callback=POOL._q.qsize)
+
+
+# -- search concurrency gate --------------------------------------------------
+
+class SearchLimitError(RuntimeError):
+    """The search could not start within the queue-wait budget."""
+
+
+class SearchGate:
+    """Bounded admission for storage searches (the vmstorage
+    ``-search.maxConcurrentRequests`` limiter analog): up to ``limit``
+    searches run concurrently; excess callers queue for at most
+    ``max_queue_ms`` and are then rejected loudly instead of piling
+    unbounded decode work onto a saturated host.
+
+    ``VM_SEARCH_CONCURRENCY`` (default ``2*cpu_count``) sizes the gate;
+    ``VM_SEARCH_MAX_QUEUE_MS`` (default 10s) bounds the queue wait."""
+
+    def __init__(self, limit: int | None = None,
+                 max_queue_ms: float | None = None):
+        if limit is None:
+            try:
+                limit = int(os.environ.get("VM_SEARCH_CONCURRENCY", "0"))
+            except ValueError:
+                limit = 0
+        if limit <= 0:
+            limit = 2 * (os.cpu_count() or 1)
+        if max_queue_ms is None:
+            try:
+                max_queue_ms = float(
+                    os.environ.get("VM_SEARCH_MAX_QUEUE_MS", "10000"))
+            except ValueError:
+                max_queue_ms = 10000.0
+        self.limit = limit
+        self.max_queue_s = max_queue_ms / 1e3
+        self._sem = threading.Semaphore(limit)
+        metricslib.REGISTRY.gauge("vm_search_concurrent_limit").set(limit)
+        self._current = metricslib.REGISTRY.gauge(
+            "vm_search_concurrent_current")
+        self._queued = metricslib.REGISTRY.counter(
+            "vm_search_requests_queued_total")
+        self._rejected = metricslib.REGISTRY.counter(
+            "vm_search_requests_rejected_total")
+
+    def __enter__(self):
+        if not self._sem.acquire(blocking=False):
+            self._queued.inc()
+            if not self._sem.acquire(timeout=self.max_queue_s):
+                self._rejected.inc()
+                raise SearchLimitError(
+                    f"couldn't start the search within "
+                    f"{self.max_queue_s:.1f}s: {self.limit} concurrent "
+                    f"searches are already running (raise "
+                    f"VM_SEARCH_CONCURRENCY or reduce query load)")
+        self._current.inc()
+        return self
+
+    def __exit__(self, *exc):
+        self._current.dec()
+        self._sem.release()
+        return False
+
+
+#: process-wide gate (one storage engine per process in production)
+SEARCH_GATE = SearchGate()
