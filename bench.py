@@ -66,6 +66,9 @@ JITTER_MS = 2_000  # scrape-time jitter; the end0 ceil below depends on it
 # deltas across a timed region divide the time between the fetch stages
 # and the host rollup, so a bench round says WHERE a win/regression lives
 PHASES = ("index_search", "collect", "decode", "assemble", "rollup")
+# the write-path twin (vm_ingest_phase_seconds_total): where the live
+# steady-state ingest spends its time, per refresh
+ING_PHASES = ("resolve", "register", "append")
 
 
 def _phase_totals() -> dict:
@@ -81,6 +84,18 @@ def _phase_label(d0: dict, d1: dict, n: int) -> str:
              "assemble": "assemble", "rollup": "rollup"}
     parts = [f"{short[ph]}={(d1[ph] - d0[ph]) * 1e3 / max(n, 1):.0f}"
              for ph in PHASES]
+    return "/".join(parts) + "ms"
+
+
+def _ingest_phase_totals() -> dict:
+    from victoriametrics_tpu.utils import metrics as metricslib
+    return {ph: metricslib.ingest_phase(ph).get() for ph in ING_PHASES}
+
+
+def _ingest_phase_label(d0: dict, d1: dict, n: int) -> str:
+    """'resolve=3/register=0/append=1ms' of live ingest per refresh."""
+    parts = [f"{ph}={(d1[ph] - d0[ph]) * 1e3 / max(n, 1):.0f}"
+             for ph in ING_PHASES]
     return "/".join(parts) + "ms"
 
 
@@ -280,6 +295,7 @@ def main() -> None:
             # steady-state: live ingest + window advance per refresh
             lat = []
             ph0 = _phase_totals()
+            ing0 = _ingest_phase_totals()
             end = end0
             for _ in range(REFRESHES):
                 end += STEP
@@ -303,10 +319,13 @@ def main() -> None:
                                rtol=1e-4 if f32 else 0.0)
             results[backend] = (float(np.median(lat)), cold_dt,
                                 _phase_label(ph0, _phase_totals(),
-                                             REFRESHES))
+                                             REFRESHES),
+                                _ingest_phase_label(
+                                    ing0, _ingest_phase_totals(),
+                                    REFRESHES))
             end0 = end  # the next backend continues on the grown storage
 
-        backend, (warm_dt, cold_dt, phase_lbl) = min(
+        backend, (warm_dt, cold_dt, phase_lbl, ing_lbl) = min(
             results.items(), key=lambda kv: kv[1][0])
         rate = samples / warm_dt
         from victoriametrics_tpu.utils import workpool
@@ -326,7 +345,9 @@ def main() -> None:
                        f"{warm_dt * 1e3:.0f}ms, ingest "
                        f"{ingest_rate / 1e3:.0f}k rows/s, "
                        f"{n_workers} fetch workers, "
-                       f"phases {phase_lbl})"),
+                       f"{workpool.configured_shards()} ingest shards, "
+                       f"phases {phase_lbl}, "
+                       f"ingest phases {ing_lbl})"),
             "value": round(rate),
             "unit": "samples/sec",
             "vs_baseline": round(rate / baseline, 2),

@@ -643,6 +643,110 @@ class TestDeterministicScheduler:
         s.close()
 
     @needs_native
+    def test_sharded_ingest_query_stress_racetrace_clean(self, tmp_path,
+                                                         race_on,
+                                                         monkeypatch):
+        """The striped WRITE path under the sanitizer: concurrent
+        columnar + legacy writers fan registration stripes and pending
+        conversions across the pool (VM_INGEST_SHARDS=4) while readers
+        fetch and a flusher compacts — zero race reports, and every read
+        satisfies the value == f(ts) invariant.  VM_INGEST_SHARDS=1 is
+        the bisection escape hatch (tools/race.sh notes)."""
+        monkeypatch.setenv("VM_INGEST_SHARDS", "4")
+        monkeypatch.setenv("VM_SEARCH_WORKERS", "2")
+        s = Storage(str(tmp_path / "si"))
+        keys = [f'shing{{i="{i}"}}'.encode() for i in range(16)]
+        keybuf = b"".join(keys)
+        klens = np.fromiter((len(k) for k in keys), np.int64, len(keys))
+        koffs = np.concatenate([[0], np.cumsum(klens)[:-1]])
+
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def guard(fn):
+            def run():
+                try:
+                    i = 0
+                    while not stop.is_set() and i < 30:
+                        fn(i)
+                        i += 1
+                except BaseException as e:  # noqa: BLE001 — harness edge
+                    errors.append(e)
+                    stop.set()
+            return run
+
+        def col_writer(i):
+            k = 4
+            ts = (T0 + (i * k + np.arange(k, dtype=np.int64))[None, :]
+                  * 15_000)
+            ts = np.broadcast_to(ts, (len(keys), k)).reshape(-1).copy()
+            s.add_rows_columnar(native.ColumnarRows(
+                keybuf, np.repeat(koffs, k), np.repeat(klens, k),
+                ts, _val(ts)))
+
+        def leg_writer(i):
+            ts = T0 + i * 15_000 + 7_000
+            s.add_rows([({"__name__": "shleg", "i": str(j)}, ts,
+                         float(ts % 1_000_000_000)) for j in range(8)])
+
+        def reader(_i):
+            cols = s.search_columns(
+                filters_from_dict({"__name__": "shing"}),
+                T0 - 10**6, T0 + 10**10)
+            for r in range(cols.n_series):
+                n = int(cols.counts[r])
+                np.testing.assert_array_equal(cols.vals[r, :n],
+                                              _val(cols.ts[r, :n]))
+
+        def flusher(i):
+            if i % 5 == 0:
+                s.force_flush()
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", LockHeldTooLongWarning)
+            threads = [threading.Thread(target=f, daemon=True)
+                       for f in (guard(col_writer), guard(col_writer),
+                                 guard(leg_writer), guard(reader),
+                                 guard(flusher))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), "sharded ingest stress wedged"
+        if errors:
+            raise errors[0]
+        assert racetrace.reports() == [], "\n\n".join(
+            r.format() for r in racetrace.reports())
+        s.close()
+
+    @needs_native
+    def test_sharded_ingest_inline_under_scheduler(self, tmp_path,
+                                                   race_on, monkeypatch):
+        """With the deterministic scheduler driving the threads, the
+        sharded write path must execute INLINE (no pool workers) and
+        stay clean: same seed == same interleaving."""
+        monkeypatch.setenv("VM_INGEST_SHARDS", "4")
+        s = Storage(str(tmp_path / "sched"))
+
+        def writer(w):
+            for j in range(5):
+                s.add_rows([({"__name__": "sw", "w": str(w), "j": str(j)},
+                             T0 + j * 1000 + w, float(j))])
+
+        sched = DeterministicScheduler(seed=77, change_prob=0.2)
+        sched.spawn("w0", writer, 0)
+        sched.spawn("w1", writer, 1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", LockHeldTooLongWarning)
+            sched.run(timeout=120)
+        assert racetrace.reports() == [], "\n\n".join(
+            r.format() for r in racetrace.reports())
+        res = s.search_series(filters_from_dict({"__name__": "sw"}),
+                              T0 - 10**6, T0 + 10**9)
+        assert len(res) == 10
+        s.close()
+
+    @needs_native
     def test_partition_and_mergeset_stress_clean_under_scheduler(
             self, tmp_path, race_on):
         """The real LSM paths — partition ingest/flush/merge/read and

@@ -16,6 +16,13 @@
 # (utils/workpool) is exercised under the sanitizer, and the
 # DeterministicScheduler tests pin down the pool's inline-under-
 # scheduler behavior.
+#
+# The parallel WRITE path (sharded ingest) is covered by the sharded
+# ingest+query stress with VM_INGEST_SHARDS=4: striped registration,
+# async pending conversion and gated merges all run under the
+# sanitizer.  When bisecting a write-path failure, VM_INGEST_SHARDS=1
+# restores the exact sequential ingest pipeline (the escape hatch
+# mirroring VM_SEARCH_WORKERS=1 on the read path).
 # Extra args pass through to pytest, e.g.:
 #   tools/race.sh -k scheduler
 #   tools/race.sh tests/test_stress_race.py::TestRaceTrace

@@ -10,6 +10,7 @@ bloomfilter sizing), reset at each window rollover.
 
 from __future__ import annotations
 
+from ..devtools.locktrace import make_lock
 from ..utils import fasttime
 
 K_PROBES = 4
@@ -29,8 +30,11 @@ class BloomLimiter:
         self._tracked = 0
         self._bucket = fasttime.unix_timestamp() // rotation_s
         self.rows_dropped = 0
+        # concurrent striped writers probe the same limiter; admissions
+        # must be atomic or the budget can be oversubscribed
+        self._lock = make_lock("storage.BloomLimiter._lock")
 
-    def _rotate_if_needed(self):
+    def _rotate_if_needed_locked(self):
         b = fasttime.unix_timestamp() // self.rotation_s
         if b != self._bucket:
             self._bucket = b
@@ -40,33 +44,35 @@ class BloomLimiter:
     def add(self, metric_id: int) -> bool:
         """True if the id is admitted (already tracked, or capacity left);
         False means the row must be dropped (limiter.go:62 Add)."""
-        self._rotate_if_needed()
-        bits = self._bits
-        nbits = self._nbits
         # splitmix64-style probe sequence off the (already well-mixed) id
+        nbits = self._nbits
         h = (metric_id ^ (metric_id >> 33)) * 0xff51afd7ed558ccd & (2**64 - 1)
-        missing = []
+        probes = []
         for i in range(K_PROBES):
             h = (h + 0x9e3779b97f4a7c15) & (2**64 - 1)
             x = (h ^ (h >> 29)) * 0xbf58476d1ce4e5b9 & (2**64 - 1)
             pos = x % nbits
-            byte, mask = pos >> 3, 1 << (pos & 7)
-            if not bits[byte] & mask:
-                missing.append((byte, mask))
-        if not missing:
-            return True  # (probabilistically) already tracked
-        if self._tracked >= self.max_series:
-            self.rows_dropped += 1
-            return False
-        for byte, mask in missing:
-            bits[byte] |= mask
-        self._tracked += 1
-        return True
+            probes.append((pos >> 3, 1 << (pos & 7)))
+        with self._lock:
+            self._rotate_if_needed_locked()
+            bits = self._bits
+            missing = [(byte, mask) for byte, mask in probes
+                       if not bits[byte] & mask]
+            if not missing:
+                return True  # (probabilistically) already tracked
+            if self._tracked >= self.max_series:
+                self.rows_dropped += 1
+                return False
+            for byte, mask in missing:
+                bits[byte] |= mask
+            self._tracked += 1
+            return True
 
     @property
     def current_series(self) -> int:
-        self._rotate_if_needed()
-        return self._tracked
+        with self._lock:
+            self._rotate_if_needed_locked()
+            return self._tracked
 
     def metrics(self) -> dict:
         p = f"vm_{self.name}_series_limit"

@@ -32,10 +32,20 @@ import numpy as np
 
 from ..devtools.locktrace import make_lock
 from ..devtools.racetrace import traced_fields
+from ..utils import metrics as metricslib
+from ..utils.workingset import WorkingSetCache
 from .mergeset import Table
 from .metric_name import MetricName, escape, unescape
 from .tag_filters import TagFilter
 from .tsid import TSID
+
+# posting-cache traffic, reference vm_cache_{requests,misses}_total shape
+# (global across IndexDB instances; per-instance counts come from the
+# read-only filter_cache_* property shims)
+_FILTER_CACHE_REQUESTS = metricslib.REGISTRY.counter(
+    'vm_cache_requests_total{type="indexdb/tagFilters"}')
+_FILTER_CACHE_MISSES = metricslib.REGISTRY.counter(
+    'vm_cache_misses_total{type="indexdb/tagFilters"}')
 
 NS_NAME_TO_TSID = b"\x00"
 NS_TAG_TO_MID = b"\x01"
@@ -66,17 +76,21 @@ def _tag_key_bytes(key: bytes, value: bytes) -> bytes:
     return escape(key) + b"\x01" + escape(value) + b"\x00"
 
 
-@traced_fields("_deleted", "_gen", "_filter_cache", "_tsids_result_cache")
+@traced_fields("_deleted", "_gen", "_filter_cache", "_filter_cache_prev",
+               "_tsids_result_cache")
 class IndexDB:
     """One index table + in-memory caches.
 
     Caches (reference lib/storage/index_db.go:306-361 analogs):
-    - metricID->MetricName / metricID->TSID dicts: entries are immutable
+    - metricID->MetricName / metricID->TSID maps: entries are immutable
       once created (append-only LSM), so they never go stale; bounded by
-      eviction of arbitrary entries at MAX_ID_CACHE.
+      two-generation rotation at MAX_ID_CACHE (workingsetcache analog —
+      no multi-million-entry wipe on the hot path, the working set
+      survives each rotation).
     - tagFilters->metricIDs posting cache: keyed by (filters, date range),
       invalidated via a generation counter bumped on every index write —
       steady-state ingest (no new series) leaves the generation stable.
+      Also generation-rotated on overflow instead of cleared.
     """
 
     MAX_ID_CACHE = 1 << 20
@@ -101,12 +115,26 @@ class IndexDB:
         self._lock = make_lock("storage.IndexDB._lock")
         self._deleted = self._load_deleted()
         self._gen = 0
-        self._name_cache: dict[int, MetricName] = {}
-        self._tsid_cache: dict[int, TSID] = {}
+        self._name_cache = WorkingSetCache(self.MAX_ID_CACHE,
+                                           "indexdb.name_cache")
+        self._tsid_cache = WorkingSetCache(self.MAX_ID_CACHE,
+                                           "indexdb.tsid_cache")
         self._filter_cache: "dict[tuple, tuple[int, np.ndarray]]" = {}
+        self._filter_cache_prev: "dict[tuple, tuple[int, np.ndarray]]" = {}
         self._tsids_result_cache: "dict[tuple, tuple[int, list]]" = {}
-        self.filter_cache_requests = 0
-        self.filter_cache_hits = 0
+        # registry-backed traffic counters with per-instance shims (the
+        # legacy filter_cache_requests/filter_cache_hits attributes are
+        # read-only properties over these)
+        self._filter_cache_requests = metricslib.Counter("requests")
+        self._filter_cache_hits = metricslib.Counter("hits")
+
+    @property
+    def filter_cache_requests(self) -> int:
+        return self._filter_cache_requests.get()
+
+    @property
+    def filter_cache_hits(self) -> int:
+        return self._filter_cache_hits.get()
 
     def close(self):
         self.table.close()
@@ -166,10 +194,9 @@ class IndexDB:
         with self._lock:
             self._gen += 1
 
-    def _cache_ids(self, cache: dict, key: int, value) -> None:
-        if len(cache) >= self.MAX_ID_CACHE:
-            cache.clear()
-        cache[key] = value
+    def _cache_ids(self, cache: WorkingSetCache, key: int, value) -> None:
+        # two-generation rotation on overflow (no wipe): see WorkingSetCache
+        cache.put(key, value)
 
     # -- writes ------------------------------------------------------------
 
@@ -386,19 +413,35 @@ class IndexDB:
                       for tf in filters),
                 None if min_ts is None else date_of_ms(min_ts),
                 None if max_ts is None else date_of_ms(max_ts))
-        self.filter_cache_requests += 1
+        self._filter_cache_requests.inc()
+        _FILTER_CACHE_REQUESTS.inc()
         with self._lock:
             got = self._filter_cache.get(ckey)
+            if got is None:
+                # previous generation: promote hits instead of losing the
+                # whole working set to an overflow wipe
+                got = self._filter_cache_prev.get(ckey)
+                if got is not None and got[0] == self._gen:
+                    if len(self._filter_cache) >= self.MAX_FILTER_CACHE:
+                        self._filter_cache_prev = self._filter_cache
+                        self._filter_cache = {}
+                    self._filter_cache[ckey] = got
             if got is not None and got[0] == self._gen:
-                self.filter_cache_hits += 1
+                self._filter_cache_hits.inc()
                 return got[1]
             gen = self._gen  # capture BEFORE the search: a concurrent index
             # write during the scan must invalidate what we store
+        _FILTER_CACHE_MISSES.inc()
         result = self._search_metric_ids_uncached(filters, min_ts, max_ts,
                                                   tenant)
         with self._lock:
-            if len(self._filter_cache) >= self.MAX_FILTER_CACHE:
-                self._filter_cache.clear()
+            # rotate only when inserting a NEW key into a full current
+            # generation (refreshing a resident stale entry must not
+            # discard the whole previous generation)
+            if ckey not in self._filter_cache and \
+                    len(self._filter_cache) >= self.MAX_FILTER_CACHE:
+                self._filter_cache_prev = self._filter_cache
+                self._filter_cache = {}
             self._filter_cache[ckey] = (gen, result)
         return result
 
@@ -469,9 +512,13 @@ class IndexDB:
                 matching = np.intersect1d(result, matched, assume_unique=True)
                 result = np.union1d(lacking, matching)
 
-        # drop tombstoned series
-        if self._deleted.size:
-            result = np.setdiff1d(result, self._deleted, assume_unique=True)
+        # drop tombstoned series (snapshot under the lock: the deleted
+        # array is replaced wholesale by delete_series_by_ids, so a
+        # locked reference read is race-free and cheap)
+        with self._lock:
+            deleted = self._deleted
+        if deleted.size:
+            result = np.setdiff1d(result, deleted, assume_unique=True)
         return result
 
     def _ids_with_key(self, key: bytes, use_dates, tenant=(0, 0)) -> np.ndarray:

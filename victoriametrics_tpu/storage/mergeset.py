@@ -31,6 +31,7 @@ from ..ops import compress as zstd
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
 from ..utils import logger
 from ..utils import metrics as metricslib
+from ..utils import workpool
 
 _FLUSH_DURATION = metricslib.REGISTRY.histogram(
     'vm_storage_flush_duration_seconds{type="indexdb/mergeset"}')
@@ -40,6 +41,8 @@ _MERGES_TOTAL = metricslib.REGISTRY.counter(
     'vm_merges_total{type="indexdb/mergeset"}')
 _ACTIVE_MERGES = metricslib.REGISTRY.gauge(
     'vm_active_merges{type="indexdb/mergeset"}')
+_ING_FLUSH = metricslib.ingest_phase("flush")
+_ING_MERGE = metricslib.ingest_phase("merge")
 
 MAX_BLOCK_BYTES = 64 << 10
 MAX_INMEMORY_PARTS = 15
@@ -215,6 +218,11 @@ class Table:
         self.path = path
         os.makedirs(path, exist_ok=True)
         self._lock = make_rlock("mergeset.Table._lock")
+        # serializes heavy mem->file / file->file merges per table; the
+        # merge itself runs OUTSIDE _lock (immutable inputs), so adds
+        # and searches proceed while a part is being written.  Ordering
+        # is strictly _merge_mutex -> _lock, never the reverse.
+        self._merge_mutex = make_rlock("mergeset.Table._merge_mutex")
         self._pending: list[bytes] = []
         self._pending_sorted: list[bytes] | None = []  # None = dirty
         self._mem_parts: list[list[bytes]] = []
@@ -244,8 +252,8 @@ class Table:
             self._part_seq = itertools.count(max(seqs) + 1)
 
     def close(self):
+        self.flush_to_disk()
         with self._lock:
-            self.flush_to_disk()
             for p in self._file_parts:
                 p.close()
             self._file_parts.clear()
@@ -264,10 +272,16 @@ class Table:
                     bisect.insort(self._pending_sorted, it)
             else:
                 self._pending_sorted = None
+            compact = False
             if len(self._pending) >= MAX_PENDING_ITEMS:
                 self._flush_pending_locked()
-                if len(self._mem_parts) > MAX_INMEMORY_PARTS:
-                    self._merge_mem_to_file_locked()
+                compact = len(self._mem_parts) > MAX_INMEMORY_PARTS
+        if compact:
+            # the heavy merge runs OUTSIDE _lock: concurrent add_items
+            # and searches proceed while the part is written; the
+            # threshold is re-checked under the merge mutex so queued
+            # adders don't stampede into serial tiny compactions
+            self._compact_mem_parts(min_parts=MAX_INMEMORY_PARTS + 1)
 
     def _flush_pending_locked(self):
         if not self._pending:
@@ -282,54 +296,87 @@ class Table:
             self._pending_sorted = sorted(set(self._pending))
         return self._pending_sorted
 
-    def _merge_mem_to_file_locked(self):
-        if not self._mem_parts:
-            return
-        t0 = time.perf_counter()
-        merged = _dedup_sorted(heapq.merge(*self._mem_parts))
-        name = f"part_{next(self._part_seq):016d}"
-        p = os.path.join(self.path, name)
-        _FilePart.write(p, merged)
-        self._mem_parts = []
-        self._file_parts.append(_FilePart(p))
-        _FLUSH_DURATION.update(time.perf_counter() - t0)
-        if len(self._file_parts) > MAX_INMEMORY_PARTS:
-            self._merge_file_parts_locked()
+    def _compact_mem_parts(self, min_parts: int = 1):
+        """Merge the in-memory parts into one file part.  The write runs
+        with no data lock held (mem parts are immutable once listed) and
+        under the process-wide MERGE_GATE, so index compactions and data
+        part writes together stay bounded at VM_MERGE_WORKERS.
 
-    def _merge_file_parts_locked(self):
-        olds = self._file_parts
-        _ACTIVE_MERGES.inc()
-        t0 = time.perf_counter()
-        try:
-            merged = _dedup_sorted(
-                heapq.merge(*[p.iter_all() for p in olds]))
-            name = f"part_{next(self._part_seq):016d}"
-            p = os.path.join(self.path, name)
-            _FilePart.write(p, merged)
-            self._file_parts = [_FilePart(p)]
-            # success only: aborted merges must not count as progress
-            _MERGE_DURATION.update(time.perf_counter() - t0)
-            _MERGES_TOTAL.inc()
-        finally:
-            _ACTIVE_MERGES.dec()
-        for old in olds:
-            # Unlink only: concurrent readers may still iterate `old`; the
-            # open fds keep the data alive until the last reference drops
-            # (the part-refcount pattern, via Python GC).
-            import shutil
-            shutil.rmtree(old.path, ignore_errors=True)
+        `min_parts` is re-checked AFTER the merge mutex is acquired:
+        concurrent adders that all crossed the threshold queue here, and
+        the first compaction usually swallows every mem part — the rest
+        must not each write a near-empty file part."""
+        with self._merge_mutex:
+            with self._lock:
+                mems = list(self._mem_parts)
+            if len(mems) < min_parts:
+                return
+            with workpool.MERGE_GATE:
+                # timed inside the gate: pure write time (queue wait is
+                # visible as vm_merge_pending)
+                t0 = time.perf_counter()
+                merged = _dedup_sorted(heapq.merge(*mems))
+                name = f"part_{next(self._part_seq):016d}"
+                p = os.path.join(self.path, name)
+                _FilePart.write(p, merged)
+                dt = time.perf_counter() - t0
+            with self._lock:
+                flushed = {id(m) for m in mems}
+                self._mem_parts = [m for m in self._mem_parts
+                                   if id(m) not in flushed]
+                self._file_parts.append(_FilePart(p))
+                merge_files = len(self._file_parts) > MAX_INMEMORY_PARTS
+            _FLUSH_DURATION.update(dt)
+            _ING_FLUSH.inc(dt)
+        if merge_files:
+            self._merge_file_parts()
+
+    def _merge_file_parts(self):
+        """Collapse every file part into one (set semantics); the k-way
+        merge runs outside _lock — readers keep iterating the old parts
+        (open fds keep the bytes alive) until the swap."""
+        with self._merge_mutex:
+            with self._lock:
+                olds = list(self._file_parts)
+            if len(olds) <= 1:
+                return
+            _ACTIVE_MERGES.inc()
+            try:
+                with workpool.MERGE_GATE:
+                    t0 = time.perf_counter()
+                    merged = _dedup_sorted(
+                        heapq.merge(*[p.iter_all() for p in olds]))
+                    name = f"part_{next(self._part_seq):016d}"
+                    p = os.path.join(self.path, name)
+                    _FilePart.write(p, merged)
+                    dt = time.perf_counter() - t0
+                new_part = _FilePart(p)
+                with self._lock:
+                    keep = [q for q in self._file_parts if q not in olds]
+                    self._file_parts = [new_part] + keep
+                # success only: aborted merges must not count as progress
+                _MERGE_DURATION.update(dt)
+                _ING_MERGE.inc(dt)
+                _MERGES_TOTAL.inc()
+            finally:
+                _ACTIVE_MERGES.dec()
+            for old in olds:
+                # Unlink only: concurrent readers may still iterate `old`;
+                # the open fds keep the data alive until the last
+                # reference drops (the part-refcount pattern, via GC).
+                import shutil
+                shutil.rmtree(old.path, ignore_errors=True)
 
     def flush_to_disk(self):
         """Durably persist everything buffered (shutdown / snapshot prep)."""
-        with self._lock:
-            self._flush_pending_locked()
-            self._merge_mem_to_file_locked()
+        with self._merge_mutex:
+            with self._lock:
+                self._flush_pending_locked()
+            self._compact_mem_parts()
 
     def force_merge(self):
-        with self._lock:
-            self.flush_to_disk()
-            if len(self._file_parts) > 1:
-                self._merge_file_parts_locked()
+        self.flush_to_disk()
+        self._merge_file_parts()
 
     # -- reads -------------------------------------------------------------
 

@@ -26,6 +26,7 @@ from ..devtools.locktrace import make_rlock
 from ..devtools.racetrace import traced_fields
 from ..utils import logger
 from ..utils import metrics as metricslib
+from ..utils import workpool
 from .block import MAX_ROWS_PER_BLOCK, Block, rows_to_blocks
 from .dedup import deduplicate
 from .part import Part, PartWriter
@@ -41,9 +42,17 @@ _MERGES_TOTAL = metricslib.REGISTRY.counter(
     'vm_merges_total{type="storage/file"}')
 _ACTIVE_MERGES = metricslib.REGISTRY.gauge(
     'vm_active_merges{type="storage/file"}')
+_ING_FLUSH = metricslib.ingest_phase("flush")
+_ING_MERGE = metricslib.ingest_phase("merge")
+_SPILL_ERRORS = metricslib.REGISTRY.counter("vm_ingest_spill_errors_total")
 
 MAX_PENDING_ROWS = 256 << 10
 MAX_SMALL_PARTS = 15
+# async pending->InmemoryPart conversions in flight per partition before
+# the ingest thread blocks on the oldest: 2 keeps the produce/convert
+# pipeline full while bounding both resident raw rows (~3x cap) and how
+# long a reader's visibility barrier can wait behind conversions
+_MAX_INFLIGHT_PARTS = 2
 # merged blocks span at most this much time, so tail fetches prune at the
 # block-header level instead of decoding a series' whole history (0 = off).
 # The rows floor keeps sparse series (e.g. 1/min scrapes) from exploding
@@ -432,7 +441,9 @@ def _merge_block_streams(sources, deleted_ids: np.ndarray | None,
 
 
 @traced_fields("_pending", "_pending_nrows", "_pending_parts",
-               "_pending_off", "_pending_gen", "_mem_parts", "_file_parts")
+               "_pending_off", "_pending_gen", "_mem_parts", "_file_parts",
+               "_pending_inflight", "_inflight_nrows", "_spill_done",
+               "_spill_next")
 class Partition:
     """One month of data ("2006_01" naming, time.go:79 analog)."""
 
@@ -453,6 +464,20 @@ class Partition:
         self._pending_parts: list = []
         self._pending_off = 0
         self._pending_gen = 0
+        # cap-triggered pending conversions handed to the work pool.
+        # Each conversion TASK lands its own part into _mem_parts under
+        # _lock, strictly in spill-sequence order (_spill_done holds
+        # out-of-order completions), so parts are byte-identical to the
+        # sequential path; _pending_inflight only tracks completion
+        # Futures for waiters — no consumer-side mutual exclusion is
+        # needed, so waiters hold NO locks while pool-helping (a waiter
+        # that held one could help-execute another partition's flush and
+        # deadlock ABBA-style on the pair of consumer locks).
+        self._pending_inflight: list = []
+        self._inflight_nrows = 0
+        self._spill_seq = 0       # next spill's sequence number
+        self._spill_next = 0      # next sequence to land in _mem_parts
+        self._spill_done: dict[int, tuple] = {}  # seq -> (part|None, nrows)
         self._mem_parts: list[InmemoryPart] = []
         self._file_parts: list[Part] = []
         self._seq = itertools.count()
@@ -512,8 +537,11 @@ class Partition:
         with self._lock:
             self._pending.extend(rows)
             self._pending_nrows += len(rows)
-            if self._pending_nrows >= MAX_PENDING_ROWS:
-                self._flush_pending_locked()
+            spill = self._pending_nrows >= MAX_PENDING_ROWS
+            if spill:
+                self._cap_flush_locked()
+        if spill:
+            self._drain_inflight(keep=_MAX_INFLIGHT_PARTS)
 
     def add_rows_columnar(self, chunk: PendingChunk) -> None:
         """Columnar ingest: the whole batch parks as ONE pending element
@@ -521,17 +549,106 @@ class Partition:
         with self._lock:
             self._pending.append(chunk)
             self._pending_nrows += len(chunk)
-            if self._pending_nrows >= MAX_PENDING_ROWS:
-                self._flush_pending_locked()
+            spill = self._pending_nrows >= MAX_PENDING_ROWS
+            if spill:
+                self._cap_flush_locked()
+        if spill:
+            self._drain_inflight(keep=_MAX_INFLIGHT_PARTS)
 
-    def _flush_pending_locked(self):
-        if not self._pending:
+    def _cap_flush_locked(self):
+        """Pending hit the row cap: convert to an InmemoryPart.  With the
+        sharded write path enabled the conversion (lexsort + decimal
+        encode — GIL-releasing numpy) runs on the work pool while ingest
+        continues; the conversion task lands its part into _mem_parts in
+        SPILL ORDER itself (_convert_spill), so part contents equal the
+        sequential path's byte for byte.  VM_INGEST_SHARDS=1 (or the
+        deterministic scheduler) keeps today's inline conversion."""
+        if not self._pending_inflight and \
+                not workpool.ingest_parallel_enabled():
+            self._flush_pending_locked()
             return
+        # NOTE: with older spills still in flight the conversion must go
+        # through the spill sequence even when the pool is now disabled
+        # (submit executes inline then), or _mem_parts would be appended
+        # out of ingest order
+        rows, n = self._take_pending_locked()
+        seq = self._spill_seq
+        self._spill_seq += 1
+        self._inflight_nrows += n
+        from functools import partial
+        self._pending_inflight.append(
+            workpool.POOL.submit(partial(self._convert_spill, rows, n,
+                                         seq)))
+
+    def _convert_spill(self, rows, n, seq):
+        """Pool task: convert one spilled pending batch and land every
+        ready part into _mem_parts in spill order (out-of-order
+        completions park in _spill_done until their turn).  On a
+        conversion error the batch is dropped with consistent
+        bookkeeping — the same outcome as a failed inline conversion,
+        whose rows were already swapped out — and the error propagates
+        to whoever waits on the Future (the flusher logs it)."""
+        part = err = None
+        try:
+            part = _rows_to_inmemory_part(rows)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            err = e
+            _SPILL_ERRORS.inc()
+            logger.errorf("partition %s: async pending conversion failed, "
+                          "%d rows dropped: %s", self.name, n, e)
+        with self._lock:
+            self._spill_done[seq] = (part, n)
+            while self._spill_next in self._spill_done:
+                p, pn = self._spill_done.pop(self._spill_next)
+                self._spill_next += 1
+                self._inflight_nrows -= pn
+                if self._pending_inflight:
+                    self._pending_inflight.pop(0)
+                if p is not None:
+                    self._mem_parts.append(p)
+        if err is not None:
+            raise err
+        return part
+
+    def _drain_inflight(self, keep: int = 0) -> None:
+        """Wait until at most `keep` conversions remain in flight (the
+        tasks land their own parts; this only blocks on completion).
+        keep=0 is the visibility barrier for queries/flushes; keep>0 is
+        ingest backpressure.  Holds NO locks across the wait: the
+        pool-helping wait may execute arbitrary queued tasks, including
+        other partitions' flushes."""
+        while True:
+            with self._lock:
+                if len(self._pending_inflight) <= keep:
+                    return
+                fut = self._pending_inflight[0]
+            # multi-waiter safe (the completion token re-arms); when the
+            # head future resolves its task has already landed the part
+            # and popped itself, so the loop re-check makes progress
+            try:
+                fut.result()
+            except Exception:  # vmt: disable=VMT003 — the failing task
+                # already logged the error, counted it in
+                # vm_ingest_spill_errors_total and dropped its batch with
+                # consistent books; re-raising here would fail an
+                # unrelated READER for an ingest-side error
+                pass
+
+    def _take_pending_locked(self):
+        """Swap the pending rows out and invalidate the incremental
+        query views; returns (rows, row_count)."""
         rows, self._pending = self._pending, []
+        n = self._pending_nrows
         self._pending_nrows = 0
         self._pending_parts = []
         self._pending_off = 0
         self._pending_gen += 1
+        return rows, n
+
+    def _flush_pending_locked(self):
+        if not self._pending:
+            return
+        rows, _ = self._take_pending_locked()
         self._mem_parts.append(_rows_to_inmemory_part(rows))
 
     def _pending_views(self):
@@ -557,8 +674,14 @@ class Partition:
                 # worked — loop and re-snapshot
 
     def flush_pending(self):
-        with self._lock:
-            self._flush_pending_locked()
+        while True:
+            self._drain_inflight()
+            with self._lock:
+                if not self._pending_inflight:
+                    self._flush_pending_locked()
+                    return
+                # spilled between the drain and the lock: drain again so
+                # _mem_parts keeps ingest order
 
     def flush_to_disk(self):
         """pending + in-memory parts -> one small file part (durable).
@@ -567,16 +690,37 @@ class Partition:
         ingest only pauses for the two brief list swaps, not the multi-
         second part write (the reference's background merger pool
         behavior, partition.go:663 — here the flusher thread is that
-        pool). _flush_mutex serializes concurrent flushers/mergers."""
+        pool, fanned across partitions by Table).  _flush_mutex
+        serializes concurrent flushers/mergers per partition; the
+        process-wide MERGE_GATE (VM_MERGE_WORKERS) bounds how many part
+        writes run at once across all partitions and mergesets.
+
+        In-flight async conversions are drained BEFORE taking
+        _flush_mutex (never while holding it: the pool-helping wait may
+        execute another partition's flush task, and flush-inside-drain
+        plus drain-inside-flush would deadlock)."""
+        while True:
+            self._drain_inflight()
+            if self._flush_to_disk_once():
+                return
+
+    def _flush_to_disk_once(self) -> bool:
         with self._flush_mutex:
             with self._lock:
+                if self._pending_inflight:
+                    return False  # spilled since the drain: retry
                 self._flush_pending_locked()
                 if not self._mem_parts:
-                    return
+                    return True
                 mems = list(self._mem_parts)
-            t0 = time.perf_counter()
-            p = self._write_part([m.iter_blocks() for m in mems])
-            _FLUSH_DURATION.update(time.perf_counter() - t0)
+            with workpool.MERGE_GATE:
+                # timed inside the gate: the histograms mean pure write
+                # time; queue wait is visible as vm_merge_pending
+                t0 = time.perf_counter()
+                p = self._write_part([m.iter_blocks() for m in mems])
+                dt = time.perf_counter() - t0
+            _FLUSH_DURATION.update(dt)
+            _ING_FLUSH.inc(dt)
             with self._lock:
                 if p is not None:
                     self._file_parts.append(p)
@@ -589,6 +733,7 @@ class Partition:
                 merge_now = len(self._file_parts) > MAX_SMALL_PARTS
             if merge_now:
                 self._merge_file_parts(self._file_parts)
+            return True
 
     def _write_part(self, sources, deleted_ids=None, min_valid_ts=None):
         """Merge block streams into a new on-disk part (no data lock held;
@@ -627,13 +772,17 @@ class Partition:
             if not olds:
                 return
             _ACTIVE_MERGES.inc()
-            t0 = time.perf_counter()
             try:
-                merged = self._write_part([p.iter_blocks() for p in olds],
-                                          deleted_ids, min_valid_ts)
+                with workpool.MERGE_GATE:
+                    t0 = time.perf_counter()
+                    merged = self._write_part(
+                        [p.iter_blocks() for p in olds],
+                        deleted_ids, min_valid_ts)
+                    dt = time.perf_counter() - t0
                 # counted only on success: an aborted merge (ENOSPC)
                 # must not look like the compactor making progress
-                _MERGE_DURATION.update(time.perf_counter() - t0)
+                _MERGE_DURATION.update(dt)
+                _ING_MERGE.inc(dt)
                 _MERGES_TOTAL.inc()
             finally:
                 _ACTIVE_MERGES.dec()
@@ -665,9 +814,10 @@ class Partition:
         """Blocks from all parts (NOT cross-part merged; the search layer
         merges rows per series)."""
         while True:
+            self._drain_inflight()
             pend, gen = self._pending_views()
             with self._lock:
-                if self._pending_gen == gen:
+                if self._pending_gen == gen and not self._pending_inflight:
                     mems = list(self._mem_parts)
                     files = list(self._file_parts)
                     break
@@ -696,9 +846,10 @@ class Partition:
         partition lock discipline; the returned closures touch only
         immutable parts."""
         while True:
+            self._drain_inflight()
             pend, gen = self._pending_views()
             with self._lock:
-                if self._pending_gen == gen:
+                if self._pending_gen == gen and not self._pending_inflight:
                     mems = list(self._mem_parts)
                     files = list(self._file_parts)
                     break
@@ -764,7 +915,7 @@ class Partition:
     @property
     def rows(self) -> int:
         with self._lock:
-            return (self._pending_nrows
+            return (self._pending_nrows + self._inflight_nrows
                     + sum(m.rows for m in self._mem_parts)
                     + sum(p.rows for p in self._file_parts))
 

@@ -16,13 +16,15 @@ import os
 import shutil
 import threading
 import time
+from contextlib import contextmanager
 
 import numpy as np
 
-from ..devtools.locktrace import make_rlock
+from ..devtools.locktrace import make_lock, make_rlock
 from ..utils import logger
 from ..utils import metrics as metricslib
 from ..utils import workpool
+from ..utils.workingset import WorkingSetCache
 from .dedup import deduplicate
 from .index_db import IndexDB, date_of_ms
 from .metric_name import MetricName
@@ -41,6 +43,34 @@ _PHASE = {
     for ph in ("index_search", "collect", "decode", "assemble")
 }
 
+# write-path twin of _PHASE: where ingest time goes (the flush/merge
+# phases are fed by partition.py / mergeset.py)
+_ING_PHASE = {ph: metricslib.ingest_phase(ph)
+              for ph in ("resolve", "register", "append")}
+_INGEST_ROWS = metricslib.REGISTRY.counter("vm_ingest_rows_total")
+_SHARD_WAIT = metricslib.REGISTRY.float_counter(
+    "vm_ingest_shard_lock_wait_seconds_total")
+
+#: fan per-day registrations across the pool only past this size (small
+#: batches lose more to task handoff than they gain)
+_FANOUT_MIN_REGS = 64
+
+
+class _IngestShard:
+    """One registration stripe of the sharded write path (the
+    rawRowsShards analog, partition.go): the per-day cache slice for
+    metric ids with ``hash(metric_id) % N == index``, guarded by its own
+    lock so concurrent writers (and the striped fan-out of one large
+    batch) only contend when they touch the same stripe."""
+
+    __slots__ = ("lock", "day_cache")
+
+    def __init__(self):
+        # one role name for every stripe: same-role edges are exempt
+        # from lock-order cycle checks (stripes are never nested)
+        self.lock = make_lock("storage.Storage._ingest_shard")
+        self.day_cache: set[tuple[int, int]] = set()  # (metric_id, date)
+
 
 class _ColumnarSpace:
     """Per-tenant dense-id state for the columnar ingest path: a native
@@ -53,7 +83,7 @@ class _ColumnarSpace:
     of a dropped series are filtered with one mask, never re-judged."""
 
     __slots__ = ("keymap", "tsids", "acc", "proj", "grp", "job", "inst",
-                 "mid", "drop", "last_date", "_cap")
+                 "mid", "drop", "last_date", "_cap", "lock", "retired")
 
     #: distinct raw keys per tenant space before the whole space is rebuilt
     #: — same bound (and rationale) as the legacy raw TSID cache clear at
@@ -63,6 +93,13 @@ class _ColumnarSpace:
     def __init__(self):
         from .. import native
         self.keymap = native.KeyMap()
+        # per-space lock: same-tenant columnar writers serialize HERE,
+        # not on the storage-wide lock (cross-tenant ingest is parallel);
+        # `retired` marks a rotated-out space whose key map is closed —
+        # holders must re-fetch (pending chunks only read the numpy
+        # columns, which stay alive)
+        self.lock = make_lock("storage._ColumnarSpace.lock")
+        self.retired = False
         self.tsids: list = []
         self._cap = 0
         z = np.zeros(0, np.uint64)
@@ -124,13 +161,22 @@ class _ColumnarSpace:
         self.last_date[i] = -(1 << 62)
 
     def close(self):
-        self.keymap.close()
+        km, self.keymap = self.keymap, None
+        if km is not None:
+            km.close()
 
 
 def _phase_lap(phase: str, t0: float) -> float:
     """Account wall time since t0 to a fetch phase; returns the new t0."""
     now = time.perf_counter()
     _PHASE[phase].inc(now - t0)
+    return now
+
+
+def _ingest_lap(phase: str, t0: float) -> float:
+    """Account wall time since t0 to an ingest phase; returns the new t0."""
+    now = time.perf_counter()
+    _ING_PHASE[phase].inc(now - t0)
     return now
 
 
@@ -192,11 +238,17 @@ class Storage:
         # fast-path cache keyed by the UNMARSHALED label identity (the
         # reference's MetricNameRaw-keyed tsidCache, storage.go:1874): rows
         # with a cached label tuple skip MetricName construction entirely.
-        self._tsid_cache_raw: dict[tuple, TSID] = {}
+        # Two-generation rotation (workingsetcache analog) instead of a
+        # multi-million-entry clear() on overflow.
+        self._tsid_cache_raw = WorkingSetCache(1 << 21, "storage.tsid_raw")
         # per-tenant columnar id spaces (native key map + per-id numpy
         # state), lazily created by add_rows_columnar
         self._cspaces: dict[tuple, "_ColumnarSpace"] = {}
-        self._day_cache: set[tuple[int, int]] = set()  # (metric_id, date)
+        # striped registration shards: the per-day cache is split by
+        # hash(metric_id) % VM_INGEST_SHARDS, each slice with its own
+        # lock (VM_INGEST_SHARDS=1 restores the single-stripe layout)
+        self._shards = [_IngestShard()
+                        for _ in range(workpool.configured_shards())]
         self._mid_gen = MetricIDGenerator()
         self._lock = make_rlock("storage.Storage._lock")
         self._stop = threading.Event()
@@ -225,7 +277,12 @@ class Storage:
         from ..query.rollup_result_cache import next_storage_token
         self.cache_token = next_storage_token()
         self._load_caches()
-        self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
+        # long-lived service timer, not hot-path fan-out: it owns the
+        # periodic flush cadence and is joined cleanly in close() (the
+        # daemon flag only covers processes that never call close)
+        self._flusher = threading.Thread(  # vmt: disable=VMT011 — service
+            target=self._flush_loop, daemon=True,  # timer; close() joins it
+            name="vm-storage-flusher")
         self._flusher.start()
 
     FORMAT_VERSION = 3  # v2: 32-byte tenant TSID; v3: indexdb/global layout
@@ -295,7 +352,10 @@ class Storage:
         tmp = os.path.join(d, "tsid_cache.bin.tmp")
         with self._lock:
             tsid_items = list(self._tsid_cache.items())
-            day_items = list(self._day_cache)
+        day_items = []
+        for shard in self._shards:
+            with shard.lock:
+                day_items.extend(shard.day_cache)
         with open(tmp, "wb") as f:
             f.write(self._CACHE_MAGIC)
             f.write(_st.pack("<Q", len(tsid_items)))
@@ -332,14 +392,16 @@ class Storage:
                 self._tsid_cache[((a, p), raw)] = t
             (n,) = _st.unpack_from("<Q", data, off)
             off += 8
+            nsh = len(self._shards)
             for _ in range(n):
                 mid, date = _st.unpack_from("<QI", data, off)
                 off += 12
-                self._day_cache.add((mid, date))
+                self._shards[mid % nsh].day_cache.add((mid, date))
         except (_st.error, IndexError):
             # torn write: caches are an optimization, start cold
             self._tsid_cache.clear()
-            self._day_cache.clear()
+            for shard in self._shards:
+                shard.day_cache.clear()
 
     @property
     def is_readonly(self) -> bool:
@@ -355,25 +417,29 @@ class Storage:
         """Resolve or create the TSID. With limited=True the cardinality
         limiter is consulted BEFORE any index writes, so an over-budget
         NEW series creates no index entries at all (storage.go:2136
-        ordering); returns None when the limiter rejects."""
+        ordering); returns None when the limiter rejects.
+
+        This is the slow index path; it serializes on the storage lock,
+        which fast-path (cache-hit) rows no longer take at all."""
         ck = (tenant, raw)
-        tsid = self._tsid_cache.get(ck)
-        if tsid is not None:
-            if limited and not self._cardinality_ok(tsid.metric_id):
+        with self._lock:
+            tsid = self._tsid_cache.get(ck)
+            if tsid is not None:
+                if limited and not self._cardinality_ok(tsid.metric_id):
+                    return None
+                return tsid
+            self.slow_row_inserts += 1
+            tsid = self.idb.get_tsid_by_name(raw, tenant)
+            if tsid is None:
+                tsid = generate_tsid(mn, self._mid_gen.next_id(), tenant)
+                if limited and not self._cardinality_ok(tsid.metric_id):
+                    return None
+                self.idb.create_indexes_for_metric(mn, tsid)
+                self.new_series_created += 1
+            elif limited and not self._cardinality_ok(tsid.metric_id):
                 return None
+            self._tsid_cache[ck] = tsid
             return tsid
-        self.slow_row_inserts += 1
-        tsid = self.idb.get_tsid_by_name(raw, tenant)
-        if tsid is None:
-            tsid = generate_tsid(mn, self._mid_gen.next_id(), tenant)
-            if limited and not self._cardinality_ok(tsid.metric_id):
-                return None
-            self.idb.create_indexes_for_metric(mn, tsid)
-            self.new_series_created += 1
-        elif limited and not self._cardinality_ok(tsid.metric_id):
-            return None
-        self._tsid_cache[ck] = tsid
-        return tsid
 
     #: add_rows accepts raw `name{labels}` BYTES keys (native parser fast
     #: path); ClusterStorage does NOT — it must decompose labels to shard
@@ -384,84 +450,164 @@ class Storage:
         """rows: iterable of (MetricName | dict | list[(k,v)], ts_ms, value).
         Returns rows added (AddRows/Storage.add analog, storage.go:1655).
 
-        Fast path (storage.go:1874 split): a raw-label-keyed cache hit skips
-        MetricName construction/marshaling; only new series and day
-        rollovers take the slow path through the index.
+        Sharded write path (rawRowsShards analog). Three phases:
+
+        1. **resolve** — input-order pass over the batch with NO
+           storage-wide lock: raw-label cache lookups (rotating
+           working-set cache), cardinality probes, per-day cache checks.
+           Only first-seen series drop into the slow index path, which
+           serializes on the storage lock — fast-path rows from
+           concurrent writers never wait behind it.
+        2. **register** — per-day index registration striped by
+           ``hash(metric_id) % VM_INGEST_SHARDS``, each stripe under its
+           own lock; large batches fan stripes across the shared work
+           pool.  Index items are set-semantic, so stripe order never
+           changes what the index contains.
+        3. **append** — rows land in the partitions in input order, so
+           part contents are byte-identical to the sequential path
+           (``VM_INGEST_SHARDS=1`` restores it exactly).
         """
         if self._readonly:
             raise RuntimeError("storage is read-only")
+        t0 = time.perf_counter()
         out = []
+        regs = []       # (mn, tsid, date) needing per-day registration
+        reg_seen = set()  # batch-local (mid, date) dedup: one regs entry
+        #                   per distinct rollover, not per row
         raw_cache = self._tsid_cache_raw
-        day_cache = self._day_cache
-        with self._lock:
-            for labels, ts, val in rows:
-                key = None
-                if type(labels) is dict:
-                    key = (tenant, *labels.items())
-                elif type(labels) is list:
-                    key = (tenant, *labels)
-                elif type(labels) is bytes:
-                    # raw `name{labels}` series key from the native parser:
-                    # cache hits never materialize labels at all
-                    key = (tenant, labels)
-                tsid = raw_cache.get(key) if key is not None else None
-                date = ts // 86_400_000
-                mn = None
-                if tsid is not None:
-                    if not self._cardinality_ok(tsid.metric_id):
-                        continue
-                    dk = (tsid.metric_id, date)
-                    if dk in day_cache:
-                        out.append((tsid, ts, val))
-                        continue
-                    # day rollover: rebuild the name from the index cache
-                    mn = self.idb.get_metric_name_by_id(tsid.metric_id)
-                if mn is None:
-                    if isinstance(labels, MetricName):
-                        mn = labels
-                    elif isinstance(labels, dict):
-                        mn = MetricName.from_dict(labels)
-                    elif isinstance(labels, bytes):
-                        from ..ingest.parsers import labels_from_series_key
-                        try:
-                            mn = MetricName.from_labels(
-                                labels_from_series_key(labels))
-                        except ValueError:
-                            continue  # malformed key: skip row, keep batch
-                    else:
-                        mn = MetricName.from_labels(labels)
-                    tsid = self._resolve_tsid(mn, mn.marshal(), tenant,
-                                              limited=True)
-                    if tsid is None:
-                        continue  # over the cardinality budget
-                    if key is not None:
-                        if len(raw_cache) >= 1 << 21:
-                            raw_cache.clear()
-                        raw_cache[key] = tsid
-                    dk = (tsid.metric_id, date)
-                    if dk in day_cache:
-                        out.append((tsid, ts, val))
-                        continue
-                self.idb.create_per_day_indexes(mn, tsid, date)
-                day_cache.add(dk)
-                out.append((tsid, ts, val))
-        if out:
-            # backfill older than the result-cache offset invalidates
-            # cached rollup tails (ResetRollupResultCacheIfNeeded) — at
-            # STORAGE level so library/embedded writers are covered too
-            from ..query.rollup_result_cache import GLOBAL, OFFSET_MS
-            oldest = min(r[1] for r in out)
-            if oldest < int(time.time() * 1000) - OFFSET_MS:
-                GLOBAL.reset()
+        nsh = len(self._shards)
+        for labels, ts, val in rows:
+            key = None
+            if type(labels) is dict:
+                key = (tenant, *labels.items())
+            elif type(labels) is list:
+                key = (tenant, *labels)
+            elif type(labels) is bytes:
+                # raw `name{labels}` series key from the native parser:
+                # cache hits never materialize labels at all
+                key = (tenant, labels)
+            tsid = raw_cache.get(key) if key is not None else None
+            date = ts // 86_400_000
+            mn = None
+            if tsid is not None:
+                if not self._cardinality_ok(tsid.metric_id):
+                    continue
+                mid = tsid.metric_id
+                # OPTIMISTIC day-cache probe, no stripe lock: GIL-atomic
+                # set membership against adds that happen only under the
+                # stripe lock; a stale miss merely routes the row through
+                # _register_days, which re-checks under the lock (entries
+                # are never removed during ingest).  Taking the stripe
+                # lock here would re-serialize the whole fast path.
+                if (mid, date) in reg_seen or \
+                        (mid, date) in self._shards[mid % nsh].day_cache:
+                    out.append((tsid, ts, val))
+                    continue
+                # day rollover: rebuild the name from the index cache
+                mn = self.idb.get_metric_name_by_id(mid)
+            if mn is None:
+                if isinstance(labels, MetricName):
+                    mn = labels
+                elif isinstance(labels, dict):
+                    mn = MetricName.from_dict(labels)
+                elif isinstance(labels, bytes):
+                    from ..ingest.parsers import labels_from_series_key
+                    try:
+                        mn = MetricName.from_labels(
+                            labels_from_series_key(labels))
+                    except ValueError:
+                        continue  # malformed key: skip row, keep batch
+                else:
+                    mn = MetricName.from_labels(labels)
+                tsid = self._resolve_tsid(mn, mn.marshal(), tenant,
+                                          limited=True)
+                if tsid is None:
+                    continue  # over the cardinality budget
+                if key is not None:
+                    raw_cache.put(key, tsid)
+                mid = tsid.metric_id
+                if (mid, date) in reg_seen or \
+                        (mid, date) in self._shards[mid % nsh].day_cache:
+                    out.append((tsid, ts, val))
+                    continue
+            reg_seen.add((mid, date))
+            regs.append((mn, tsid, date))
+            out.append((tsid, ts, val))
+        t0 = _ingest_lap("resolve", t0)
+        if regs:
+            self._register_days(regs)
+        t0 = _ingest_lap("register", t0)
+        n = len(out)
+        if n == 0:
+            return 0
+        # backfill older than the result-cache offset invalidates cached
+        # rollup tails (ResetRollupResultCacheIfNeeded) — at STORAGE
+        # level so library/embedded writers are covered too; the batch
+        # minimum is computed ONCE and reused for the append log
+        oldest = min(r[1] for r in out)
+        from ..query.rollup_result_cache import GLOBAL, OFFSET_MS
+        if oldest < int(time.time() * 1000) - OFFSET_MS:
+            GLOBAL.reset()
         self.table.add_rows(out)
-        self.rows_added += len(out)
-        if out:
+        _ingest_lap("append", t0)
+        _INGEST_ROWS.inc(n)
+        with self._lock:
+            self.rows_added += n
             self.data_version += 1
             log = self._append_log
             if log.maxlen is not None and len(log) == log.maxlen:
                 self._append_log_floor = log[0][0]
-            log.append((self.data_version, min(r[1] for r in out)))
-        return len(out)
+            log.append((self.data_version, oldest))
+        return n
+
+    @contextmanager
+    def _shard_locked(self, si: int):
+        """Acquire stripe si's lock, accounting the wait time to
+        vm_ingest_shard_lock_wait_seconds_total."""
+        shard = self._shards[si]
+        tw = time.perf_counter()
+        shard.lock.acquire()
+        _SHARD_WAIT.inc(time.perf_counter() - tw)
+        try:
+            yield shard
+        finally:
+            shard.lock.release()
+
+    def _fan_stripes(self, by_shard: dict, run_stripe, total: int) -> None:
+        """Execute run_stripe(shard_index, payload) for every stripe —
+        across the shared pool for large batches (>= _FANOUT_MIN_REGS
+        items, several stripes, pool enabled), inline otherwise.  Stripe
+        execution order is unobservable: per-day index items collapse
+        set-semantically in the mergeset."""
+        stripes = sorted(by_shard.items())
+        if len(stripes) > 1 and total >= _FANOUT_MIN_REGS and \
+                workpool.ingest_parallel_enabled():
+            from functools import partial
+            workpool.POOL.run([partial(run_stripe, si, payload)
+                               for si, payload in stripes])
+        else:
+            for si, payload in stripes:
+                run_stripe(si, payload)
+
+    def _register_days(self, regs) -> None:
+        """Per-day index registration, striped by hash(metric_id) % N:
+        each stripe runs under its own lock (in input order within the
+        stripe), large batches fanned across the shared work pool."""
+        nsh = len(self._shards)
+        by_shard: dict[int, list] = {}
+        for reg in regs:
+            by_shard.setdefault(reg[1].metric_id % nsh, []).append(reg)
+
+        def run_stripe(si, items):
+            with self._shard_locked(si) as shard:
+                for mn, tsid, date in items:
+                    dk = (tsid.metric_id, date)
+                    if dk in shard.day_cache:
+                        continue
+                    self.idb.create_per_day_indexes(mn, tsid, date)
+                    shard.day_cache.add(dk)
+
+        self._fan_stripes(by_shard, run_stripe, len(regs))
 
     #: add_rows_columnar accepts native.ColumnarRows batches; ClusterStorage
     #: does not (it must decompose labels to shard), so HTTP gates on this.
@@ -485,14 +631,9 @@ class Storage:
         """
         if self._readonly:
             raise RuntimeError("storage is read-only")
-        ids = tss = vals = None
-        with self._lock:
-            sp = self._cspaces.get(tenant)
-            if sp is not None and len(sp.keymap) >= sp.MAX_KEYS:
-                sp.close()  # bound churny key spaces (raw-cache clear analog)
-                sp = None
-            if sp is None:
-                sp = self._cspaces[tenant] = _ColumnarSpace()
+        t0 = time.perf_counter()
+        sp = self._acquire_cspace(tenant)  # returns with sp.lock HELD
+        try:
             ids, n_new = sp.keymap.resolve(cr.keybuf, cr.key_off, cr.key_len)
             if n_new:
                 self._register_columnar_ids(sp, cr, ids, tenant, transform)
@@ -560,48 +701,110 @@ class Storage:
                        d_clip + (1 << 20))
                 _, first = np.unique(key, return_index=True)
                 roll = roll[first]
-            for r in roll:
-                i = int(ids[r])
-                d = int(dates[r])
-                if sp.last_date[i] == d:
-                    continue  # later duplicate within this batch
-                mid = int(sp.mid[i])
-                if (mid, d) not in self._day_cache:
-                    mn = self.idb.get_metric_name_by_id(mid)
-                    if mn is None:
-                        # index name cache miss: rebuild from this batch's
-                        # raw key (+ transform, for relabeled series)
-                        from ..ingest.parsers import labels_from_series_key
-                        rr = int(sel[r]) if sel is not None else int(r)
-                        try:
-                            labels = labels_from_series_key(bytes(
-                                memoryview(cr.keybuf)[
-                                    int(cr.key_off[rr]):
-                                    int(cr.key_off[rr]) + int(cr.key_len[rr])]))
-                            if transform is not None:
-                                labels = transform(labels)
-                            if labels:
-                                mn = MetricName.from_labels(labels)
-                        except ValueError:
-                            mn = None
-                    if mn is not None:
-                        self.idb.create_per_day_indexes(mn, sp.tsids[i], d)
-                    self._day_cache.add((mid, d))
-                sp.last_date[i] = d
+            t0 = _ingest_lap("resolve", t0)
+            if roll.size:
+                self._register_columnar_days(sp, cr, ids, dates, sel, roll,
+                                             transform)
+            t0 = _ingest_lap("register", t0)
+        finally:
+            sp.lock.release()
         oldest = int(tss.min())
         from ..query.rollup_result_cache import GLOBAL, OFFSET_MS
         if oldest < int(time.time() * 1000) - OFFSET_MS:
             GLOBAL.reset()
         self.table.add_rows_columnar(sp, ids, tss, vals)
+        _ingest_lap("append", t0)
         n = int(ids.size)
-        self.rows_added += n
+        _INGEST_ROWS.inc(n)
         with self._lock:
+            self.rows_added += n
             self.data_version += 1
             log = self._append_log
             if log.maxlen is not None and len(log) == log.maxlen:
                 self._append_log_floor = log[0][0]
             log.append((self.data_version, oldest))
         return n
+
+    def _acquire_cspace(self, tenant) -> "_ColumnarSpace":
+        """The tenant's columnar id space with its lock HELD (caller
+        releases): same-tenant columnar writers serialize here instead
+        of on the storage-wide lock.  Spaces whose native key map
+        outgrew MAX_KEYS are retired under their lock (the raw-cache
+        rotation analog) and replaced with a fresh one; in-flight
+        PendingChunks keep the retired space's numpy columns alive."""
+        while True:
+            with self._lock:
+                sp = self._cspaces.get(tenant)
+                if sp is None:
+                    sp = self._cspaces[tenant] = _ColumnarSpace()
+            sp.lock.acquire()
+            if sp.retired:
+                sp.lock.release()
+                continue  # lost the race with a rotation: re-fetch
+            if len(sp.keymap) < sp.MAX_KEYS:
+                return sp
+            # bound churny key spaces (raw-cache clear analog)
+            sp.retired = True
+            sp.close()
+            with self._lock:
+                if self._cspaces.get(tenant) is sp:
+                    del self._cspaces[tenant]
+            sp.lock.release()
+
+    def _register_columnar_days(self, sp, cr, ids, dates, sel, roll,
+                                transform) -> None:
+        """Columnar per-day registration for the distinct (id, date)
+        rollovers in `roll`, striped by hash(metric_id) % N.  Runs with
+        sp.lock held — the per-id `last_date` memo is batch-exclusive —
+        and fans stripes across the shared pool for large rollover sets
+        (first batch of a high-cardinality scrape)."""
+        nsh = len(self._shards)
+        by_shard: dict[int, list] = {}
+        for r in roll:
+            by_shard.setdefault(
+                int(sp.mid[int(ids[r])]) % nsh, []).append(int(r))
+
+        def run_stripe(si, rs):
+            with self._shard_locked(si) as shard:
+                for r in rs:
+                    i = int(ids[r])
+                    d = int(dates[r])
+                    if sp.last_date[i] == d:
+                        continue
+                    mid = int(sp.mid[i])
+                    if (mid, d) not in shard.day_cache:
+                        mn = self.idb.get_metric_name_by_id(mid)
+                        if mn is None:
+                            # index name cache miss: rebuild from this
+                            # batch's raw key (+ transform, for
+                            # relabeled series)
+                            mn = self._rebuild_mn_from_row(cr, sel, r,
+                                                           transform)
+                        if mn is not None:
+                            self.idb.create_per_day_indexes(
+                                mn, sp.tsids[i], d)
+                        shard.day_cache.add((mid, d))
+                    sp.last_date[i] = d
+
+        self._fan_stripes(by_shard, run_stripe, int(roll.size))
+
+    def _rebuild_mn_from_row(self, cr, sel, r, transform):
+        """MetricName from row r's raw series key (sel maps surviving
+        rows back to cr rows); None on malformed/transform-dropped."""
+        from ..ingest.parsers import labels_from_series_key
+        rr = int(sel[r]) if sel is not None else int(r)
+        try:
+            labels = labels_from_series_key(bytes(
+                memoryview(cr.keybuf)[
+                    int(cr.key_off[rr]):
+                    int(cr.key_off[rr]) + int(cr.key_len[rr])]))
+            if transform is not None:
+                labels = transform(labels)
+            if labels:
+                return MetricName.from_labels(labels)
+        except ValueError:
+            pass
+        return None
 
     def _judge_key(self, key: bytes, tenant, transform):
         """Raw key -> (tsid | None, verdict): materialize labels, run the
@@ -653,12 +856,16 @@ class Storage:
     def reset_columnar_spaces(self) -> None:
         """Invalidate all cached raw-key -> TSID verdicts (call after the
         ingest transform config — relabel rules, series limits — changes).
-        In-flight PendingChunks keep the old space objects alive."""
+        In-flight PendingChunks keep the old space objects alive; spaces
+        are retired under their own lock so a concurrent columnar writer
+        either finishes its batch first or re-fetches a fresh space."""
         with self._lock:
             spaces = list(self._cspaces.values())
             self._cspaces = {}
         for sp in spaces:
-            sp.close()
+            with sp.lock:
+                sp.retired = True
+                sp.close()
 
     def min_appended_since(self, version: int):
         """Minimum timestamp inserted after data_version `version`, or None
@@ -1153,15 +1360,14 @@ class Storage:
         mids = self.idb.search_metric_ids(filters, tenant=tenant)
         if mids.size:
             self.idb.delete_series_by_ids(mids)
+            dead = set(int(m) for m in mids)
             with self._lock:
-                dead = set(int(m) for m in mids)
                 self._tsid_cache = {
                     k: t for k, t in self._tsid_cache.items()
                     if t.metric_id not in dead}
-                # the raw-label cache would resurrect tombstoned metric_ids
-                self._tsid_cache_raw = {
-                    k: t for k, t in self._tsid_cache_raw.items()
-                    if t.metric_id not in dead}
+            # the raw-label cache would resurrect tombstoned metric_ids
+            self._tsid_cache_raw.filter(
+                lambda k, t: t.metric_id not in dead)
             # AFTER the tombstones land: a racing query that fetched the
             # old data keys its tile under the pre-delete version
             self.data_version += 1
@@ -1190,9 +1396,11 @@ class Storage:
             # a later backfill into a dropped date must recreate its
             # per-day index entries
             min_date = self.min_valid_ts // 86_400_000
-            with self._lock:
-                self._day_cache = {dk for dk in self._day_cache
-                                   if dk[1] >= min_date}
+            for shard in self._shards:
+                with shard.lock:
+                    dead = {dk for dk in shard.day_cache
+                            if dk[1] < min_date}
+                    shard.day_cache -= dead
         if n:
             self.data_version += 1  # after the drop; no-op sweeps keep tiles
             self.structural_version += 1

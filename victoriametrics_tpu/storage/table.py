@@ -159,23 +159,37 @@ class Table:
                     dropped += 1
         return dropped
 
+    @staticmethod
+    def _fan_partitions(parts, fn):
+        """Run fn(partition) for every partition — across the shared
+        work pool when the sharded write path is on and several
+        partitions exist (flush/merge of different months are
+        independent; the MERGE_GATE inside each bounds total disk
+        concurrency at VM_MERGE_WORKERS).  Callers hold NO locks here,
+        so the pool-helping wait is safe."""
+        from ..utils import workpool
+        if len(parts) > 1 and workpool.ingest_parallel_enabled():
+            from functools import partial
+            workpool.POOL.run([partial(fn, p) for p in parts])
+        else:
+            for p in parts:
+                fn(p)
+
     def flush_pending(self):
         with self._lock:
             parts = list(self._partitions.values())
-        for p in parts:
-            p.flush_pending()
+        self._fan_partitions(parts, lambda p: p.flush_pending())
 
     def flush_to_disk(self):
         with self._lock:
             parts = list(self._partitions.values())
-        for p in parts:
-            p.flush_to_disk()
+        self._fan_partitions(parts, lambda p: p.flush_to_disk())
 
     def force_merge(self, deleted_ids=None, min_valid_ts=None):
         with self._lock:
             parts = list(self._partitions.values())
-        for p in parts:
-            p.force_merge(deleted_ids, min_valid_ts)
+        self._fan_partitions(
+            parts, lambda p: p.force_merge(deleted_ids, min_valid_ts))
 
     def snapshot_to(self, dst: str):
         os.makedirs(dst, exist_ok=True)

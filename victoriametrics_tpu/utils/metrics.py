@@ -338,6 +338,18 @@ def _process_metrics():
 REGISTRY = MetricsRegistry()
 
 
+def ingest_phase(phase: str) -> FloatCounter:
+    """Per-phase write-path attribution counter (the ingest twin of the
+    read path's ``vm_fetch_phase_seconds_total``): seconds spent in one
+    stage of the ingestion pipeline.  Phases: ``resolve`` (raw key ->
+    TSID), ``register`` (per-day index registration), ``append``
+    (partition pending append), ``flush`` (part encode+fsync), ``merge``
+    (background part merges).  Shared by storage/partition/mergeset and
+    read by bench.py's per-refresh split."""
+    return REGISTRY.float_counter(
+        f'vm_ingest_phase_seconds_total{{phase="{phase}"}}')
+
+
 # -- exposition utilities ----------------------------------------------------
 
 def _sample_name_end(line: str) -> int:

@@ -60,7 +60,9 @@ from ..devtools.locktrace import make_lock
 from . import metrics as metricslib
 
 __all__ = ["WorkPool", "Future", "SearchGate", "SearchLimitError",
-           "POOL", "SEARCH_GATE", "configured_workers"]
+           "MergeGate", "POOL", "SEARCH_GATE", "MERGE_GATE",
+           "configured_workers", "configured_shards",
+           "ingest_parallel_enabled"]
 
 _TASKS_TOTAL = metricslib.REGISTRY.counter("vm_workpool_tasks_total")
 
@@ -76,6 +78,30 @@ def configured_workers() -> int:
     if n <= 0:
         n = os.cpu_count() or 1
     return n
+
+
+def configured_shards() -> int:
+    """Ingest stripe count from ``VM_INGEST_SHARDS`` (the rawRowsShards
+    analog): unset/0 -> cpu_count, 1 -> the exact sequential write path,
+    N -> N registration stripes."""
+    raw = os.environ.get("VM_INGEST_SHARDS", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        n = 0
+    if n <= 0:
+        n = os.cpu_count() or 1
+    return n
+
+
+def ingest_parallel_enabled() -> bool:
+    """True when the write path may hand work to the pool:
+    ``VM_INGEST_SHARDS`` > 1 AND the pool itself is enabled.
+    ``VM_INGEST_SHARDS=1`` is the write path's own escape hatch; note
+    that ``VM_SEARCH_WORKERS=1`` disables the SHARED pool entirely and
+    therefore reverts BOTH the read and the write path to sequential —
+    bisect write-path issues with VM_INGEST_SHARDS, not the pool knob."""
+    return configured_shards() > 1 and POOL.parallel_enabled()
 
 
 def _sched_active() -> bool:
@@ -101,7 +127,9 @@ class _Batch:
 
 class Future:
     """Handle for one submitted task; ``result()`` waits (helping the
-    pool while it does) and re-raises the task's exception."""
+    pool while it does) and re-raises the task's exception.  Safe for
+    multiple waiters/repeat calls: the completion token is re-armed
+    after each successful wait, so every ``result()`` returns."""
 
     __slots__ = ("_pool", "_batch")
 
@@ -200,6 +228,10 @@ class WorkPool:
                 item = self._q.get_nowait()
             except queue.Empty:
                 batch.done.get()
+                # re-arm: Futures may be waited by several threads (or
+                # twice by a helper that re-entered); each waiter must
+                # find a token (its put also chains the clock edge on)
+                batch.done.put(None)
                 break
             if item is None:
                 # a shutdown sentinel racing this waiter: hand it back to
@@ -208,6 +240,7 @@ class WorkPool:
                 # first (FIFO)
                 self._q.put(None)
                 batch.done.get()
+                batch.done.put(None)
                 break
             self._exec(item)
         with batch.lock:
@@ -320,3 +353,64 @@ class SearchGate:
 
 #: process-wide gate (one storage engine per process in production)
 SEARCH_GATE = SearchGate()
+
+
+# -- merge concurrency gate ---------------------------------------------------
+
+class MergeGate:
+    """Bounded admission for heavy part writes — flush encodes and
+    background merges (the reference's ``mergeWorkersCount`` bound,
+    lib/storage/partition.go): at most ``limit`` part writes run at
+    once across data partitions AND index mergesets, so a flush storm
+    cannot saturate every core with zstd/fsync while ingest and queries
+    starve.
+
+    ``VM_MERGE_WORKERS`` (default ``cpu_count``) sizes the gate; the
+    gate only *bounds* concurrency — the work itself is fanned by
+    ``Table.flush_to_disk``/``force_merge`` over :data:`POOL`."""
+
+    def __init__(self, limit: int | None = None):
+        if limit is None:
+            try:
+                limit = int(os.environ.get("VM_MERGE_WORKERS", "0"))
+            except ValueError:
+                limit = 0
+        if limit <= 0:
+            limit = os.cpu_count() or 1
+        self.limit = limit
+        self._sem = threading.Semaphore(limit)
+        self._pending = metricslib.Gauge("pending")
+        self._active = metricslib.Gauge("active")
+
+    @property
+    def pending(self) -> int:
+        """Writers waiting for a merge slot."""
+        return int(self._pending.get())
+
+    @property
+    def active(self) -> int:
+        """Writers holding a merge slot."""
+        return int(self._active.get())
+
+    def __enter__(self):
+        self._pending.inc()
+        try:
+            self._sem.acquire()
+        finally:
+            self._pending.dec()
+        self._active.inc()
+        return self
+
+    def __exit__(self, *exc):
+        self._active.dec()
+        self._sem.release()
+        return False
+
+
+#: process-wide merge gate; sized by VM_MERGE_WORKERS at import
+MERGE_GATE = MergeGate()
+
+metricslib.REGISTRY.gauge("vm_merge_pending",
+                          callback=lambda: MERGE_GATE.pending)
+metricslib.REGISTRY.gauge("vm_merge_active",
+                          callback=lambda: MERGE_GATE.active)
