@@ -15,6 +15,7 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 if not os.environ.get("VMTPU_TEST_TPU"):
     jax.config.update("jax_platforms", "cpu")
@@ -26,3 +27,22 @@ def pytest_configure(config):
         "markers", "race: concurrency/race-detector tests "
         "(tools/race.sh runs these under VMT_RACETRACE=1)")
     config.addinivalue_line("markers", "slow: excluded from tier-1 (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "requires_native: needs the native codec library "
+        "(libvmcodec.so); skipped cleanly on minimal containers without "
+        "a C++ toolchain instead of erroring")
+
+
+def pytest_collection_modifyitems(config, items):
+    try:
+        from victoriametrics_tpu import native
+        have_native = native.available()
+    except Exception:
+        have_native = False
+    if have_native:
+        return
+    skip = pytest.mark.skip(
+        reason="native codec library unavailable (no g++ / libvmcodec.so)")
+    for item in items:
+        if "requires_native" in item.keywords:
+            item.add_marker(skip)

@@ -1,0 +1,330 @@
+"""Bit-exactness of the fused native read kernel (VM_NATIVE_ASSEMBLE=1:
+native/codec.cpp vm_assemble_part + vm_dedup_rows) against the split
+Python-orchestrated path (VM_NATIVE_ASSEMBLE=0 — the escape hatch AND the
+correctness oracle).
+
+The equality matrix covers: multi-partition/multi-part stores, dedup
+boundaries (interval multiples, equal-timestamp ties, staleness markers),
+range clips landing mid-block, zstd AND zlib-fallback compressed parts,
+and VM_SEARCH_WORKERS>1 pool fan-out. Every comparison is a sha256 over
+the full assembled columnar result, so a single flipped byte fails."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu import native
+from victoriametrics_tpu.ops import compress
+from victoriametrics_tpu.ops.decimal import STALE_NAN
+from victoriametrics_tpu.storage.storage import Storage
+from victoriametrics_tpu.storage.tag_filters import TagFilter
+
+pytestmark = pytest.mark.requires_native
+
+BASE = 1_700_000_000_000
+MONTH = 32 * 86_400_000  # next monthly partition for sure
+
+
+def _filters(name: str):
+    return [TagFilter(b"", name.encode())]
+
+
+def _digest(cols) -> str:
+    h = hashlib.sha256()
+    h.update(cols.metric_ids.tobytes())
+    h.update(cols.counts.tobytes())
+    h.update(np.ascontiguousarray(cols.ts).tobytes())
+    h.update(np.ascontiguousarray(cols.vals).tobytes())
+    h.update(repr(cols.ts.shape).encode())
+    for r in cols.raw_names:
+        h.update(r)
+    return h.hexdigest()
+
+
+def _search_digest(st, name, lo, hi, dedup=None) -> str:
+    return _digest(st.search_columns(_filters(name), lo, hi,
+                                     dedup_interval_ms=dedup))
+
+
+def _assert_modes_equal(monkeypatch, st, name, lo, hi, dedup=None):
+    monkeypatch.setenv("VM_NATIVE_ASSEMBLE", "1")
+    fused = _search_digest(st, name, lo, hi, dedup)
+    monkeypatch.setenv("VM_NATIVE_ASSEMBLE", "0")
+    oracle = _search_digest(st, name, lo, hi, dedup)
+    monkeypatch.delenv("VM_NATIVE_ASSEMBLE", raising=False)
+    assert fused == oracle, (name, lo - BASE, hi - BASE, dedup)
+    return fused
+
+
+@pytest.fixture
+def store(tmp_path):
+    st = Storage(str(tmp_path / "st"))
+    yield st
+    st.close()
+
+
+class TestEqualityMatrix:
+    def test_multi_partition_multi_part(self, store, monkeypatch):
+        """Two monthly partitions, several file parts each (no merge),
+        plus unflushed pending rows; full-range and interior fetches."""
+        rng = np.random.default_rng(7)
+        for part in range(3):
+            rows = []
+            for i in range(24):
+                lbl = {"__name__": "mp", "i": str(i)}
+                t0 = BASE + part * 200_000
+                vals = np.cumsum(rng.integers(0, 9, 150)).astype(float)
+                rows += [(lbl, t0 + j * 1000, float(vals[j]))
+                         for j in range(150)]
+                rows += [(lbl, t0 + MONTH + j * 1000, float(vals[j]))
+                         for j in range(150)]
+            store.add_rows(rows)
+            store.force_flush()
+        # pending tail on top of file parts
+        store.add_rows([({"__name__": "mp", "i": str(i)},
+                         BASE + 900_000 + j * 500, float(j))
+                        for i in range(6) for j in range(40)])
+        for lo, hi in ((BASE, BASE + MONTH + 10**6),
+                       (BASE + 123_456, BASE + 456_789),
+                       (BASE + MONTH + 50_500, BASE + MONTH + 250_250)):
+            _assert_modes_equal(monkeypatch, store, "mp", lo, hi)
+
+    def test_mid_block_clips(self, store, monkeypatch):
+        """Range edges inside blocks: every boundary alignment (first
+        sample, mid, exact edge, one-past) against the oracle."""
+        rows = [({"__name__": "clip", "i": str(i)}, BASE + j * 1000,
+                 float(i * 1000 + j))
+                for i in range(8) for j in range(500)]
+        store.add_rows(rows)
+        store.force_flush()
+        for lo_off, hi_off in ((0, 499_000), (1, 498_999),
+                               (250_000, 250_000), (249_500, 250_499),
+                               (498_999, 10**7), (-10**6, 500)):
+            _assert_modes_equal(monkeypatch, store, "clip",
+                                BASE + lo_off, BASE + hi_off)
+
+    def test_dedup_boundaries_ties_and_stale(self, store, monkeypatch):
+        """Interval dedup across exact window multiples, equal-timestamp
+        ties (max non-stale value must win), staleness markers, and
+        replica-style exact duplicates — with and without dedup."""
+        rows = []
+        for i in range(5):
+            lbl = {"__name__": "dd", "i": str(i)}
+            for j in range(120):
+                ts = BASE + j * 500  # 2 samples per 1000ms window
+                rows.append((lbl, ts, float(j)))
+            # equal-ts ties: higher value later AND earlier (both orders)
+            rows.append((lbl, BASE + 70_000, 5.0))
+            rows.append((lbl, BASE + 70_000, 9.0))
+            rows.append((lbl, BASE + 71_000, 9.0))
+            rows.append((lbl, BASE + 71_000, 5.0))
+            # stale-marker tie: non-stale must win
+            rows.append((lbl, BASE + 72_000, STALE_NAN))
+            rows.append((lbl, BASE + 72_000, 3.0))
+            # all-stale window
+            rows.append((lbl, BASE + 73_000, STALE_NAN))
+        store.add_rows(rows)
+        store.force_flush()
+        # a second overlapping part makes cross-part duplicates
+        store.add_rows([({"__name__": "dd", "i": str(i)},
+                         BASE + j * 1000, float(2 * j))
+                        for i in range(5) for j in range(60)])
+        store.force_flush()
+        for dedup in (None, 1000, 3000):
+            _assert_modes_equal(monkeypatch, store, "dd",
+                                BASE - 10, BASE + 80_000, dedup)
+
+    def test_zlib_fallback_parts(self, store, monkeypatch):
+        """Parts whose compressed blocks are zlib streams (the minimal-
+        container write path): the native kernel must inflate them too."""
+        # force the zlib fallback for this ingest (no zstandard, native
+        # zstd hidden)
+        monkeypatch.setattr(compress, "zstandard", None)
+        monkeypatch.setattr(compress, "_native_zstd", False)
+        self._ingest_compressible(store, "zl")
+        monkeypatch.undo()
+        self._check_compressed(store, monkeypatch, "zl")
+
+    def test_zstd_parts(self, store, monkeypatch):
+        """Parts whose compressed blocks are zstd frames (python binding
+        or the dlopen'd runtime library)."""
+        if not compress.zstd_available():
+            pytest.skip("no zstd binding in this container")
+        self._ingest_compressible(store, "zs")
+        self._check_compressed(store, monkeypatch, "zs")
+
+    @staticmethod
+    def _ingest_compressible(store, name):
+        # highly repetitive deltas -> payloads beat the 12.5% zstd/zlib
+        # compression gate, so blocks marshal as type 5/6
+        rows = [({"__name__": name, "i": str(i)}, BASE + j * 1000,
+                 float(j % 3))
+                for i in range(6) for j in range(2000)]
+        store.add_rows(rows)
+        store.force_flush()
+
+    @staticmethod
+    def _check_compressed(store, monkeypatch, name):
+        # the matrix is vacuous unless compressed blocks actually exist
+        parts = [p for part in store.table._partitions.values()
+                 for p in part._file_parts]
+        assert parts
+        hc_mts = np.concatenate(
+            [np.concatenate([p.header_columns()["ts_mt"],
+                             p.header_columns()["val_mt"]]) for p in parts])
+        assert bool((hc_mts >= 5).any()), "no compressed blocks were written"
+        _assert_modes_equal(monkeypatch, store, name, BASE - 1,
+                            BASE + 2_000_000)
+        _assert_modes_equal(monkeypatch, store, name, BASE + 500_500,
+                            BASE + 1_200_499)
+
+    def test_zlib_parts_degrade_without_libz(self, store, monkeypatch):
+        """A build whose runtime resolved zstd but NOT zlib (caps==1) must
+        route zlib-compressed parts onto the per-block Python fallback —
+        same bytes, no crash (both the fused and the split path gates
+        consult the per-payload capability check)."""
+        monkeypatch.setattr(compress, "zstandard", None)
+        monkeypatch.setattr(compress, "_native_zstd", False)
+        self._ingest_compressible(store, "nolibz")
+        monkeypatch.undo()
+        want = _search_digest(store, "nolibz", BASE - 1, BASE + 2_000_000)
+        monkeypatch.setattr(native, "decompress_caps", lambda: 1)
+        got = _assert_modes_equal(monkeypatch, store, "nolibz", BASE - 1,
+                                  BASE + 2_000_000)
+        assert got == want
+
+    def test_multiworker_fanout(self, store, monkeypatch):
+        """VM_SEARCH_WORKERS>1 fans per-part kernel calls across the pool;
+        results must equal the sequential run of either mode."""
+        rng = np.random.default_rng(3)
+        for part in range(4):
+            rows = [({"__name__": "fan", "i": str(i)},
+                     BASE + part * 111_000 + j * 1000,
+                     float(rng.integers(0, 1000)))
+                    for i in range(16) for j in range(200)]
+            store.add_rows(rows)
+            store.force_flush()
+        digests = set()
+        for workers in ("1", "4"):
+            monkeypatch.setenv("VM_SEARCH_WORKERS", workers)
+            digests.add(_assert_modes_equal(monkeypatch, store, "fan",
+                                            BASE + 5_500, BASE + 400_000))
+        assert len(digests) == 1, "pool fan-out changed the bytes"
+
+
+class TestKernelInternals:
+    def test_part_float_memo_round_trip(self, store, monkeypatch):
+        """An unclipped fused fetch memoizes decoded float columns; the
+        next (clipped) fetch serves from the memo with identical bytes."""
+        monkeypatch.setenv("VM_NATIVE_ASSEMBLE", "1")
+        rows = [({"__name__": "memo", "i": str(i)}, BASE + j * 1000,
+                 float(i + j)) for i in range(4) for j in range(300)]
+        store.add_rows(rows)
+        store.force_flush()
+        d_cold = _search_digest(store, "memo", BASE - 10**6, BASE + 10**9)
+        parts = [p for part in store.table._partitions.values()
+                 for p in part._file_parts]
+        assert any(p._dec is not None and p._dec[0] == "float"
+                   for p in parts), "full fetch did not memoize"
+        assert _search_digest(store, "memo", BASE - 10**6,
+                              BASE + 10**9) == d_cold
+        # clipped fetch from the memo == oracle
+        _assert_modes_equal(monkeypatch, store, "memo", BASE + 50_500,
+                            BASE + 200_499)
+
+    def test_dedup_rows_kernel_matches_python_loop(self, monkeypatch):
+        """vm_dedup_rows vs the assemble() per-row Python loop on crafted
+        duplicate/tie/stale rows (incl. a column-sliced view layout)."""
+        from victoriametrics_tpu.storage import columnar
+
+        def build():
+            rows = np.array([0, 0, 1, 1, 2], np.int64)
+            cnts = np.array([3, 4, 2, 3, 6], np.int64)
+            ts = np.concatenate([
+                [1000, 1500, 2000], [2000, 2500, 2500, 3100],
+                [900, 900], [900, 1700, 1700],
+                [100, 600, 600, 600, 1100, 1100]]).astype(np.int64)
+            vals = np.array([1.0, STALE_NAN, 2.0,
+                             5.0, 4.0, STALE_NAN, 7.0,
+                             3.0, 1.0,
+                             STALE_NAN, STALE_NAN, 2.0,
+                             9.0, 1.0, 8.0, STALE_NAN, 4.0, 4.5])
+            return rows, cnts, ts, vals
+
+        outs = []
+        for use_native in (True, False):
+            if not use_native:
+                monkeypatch.setattr(
+                    "victoriametrics_tpu.native.available", lambda: False)
+            rows, cnts, ts, vals = build()
+            cols = columnar.assemble(rows, 3, cnts, ts, vals, 0, 10**6,
+                                     dedup_interval_ms=1000)
+            outs.append((cols.ts.tobytes(), cols.vals.tobytes(),
+                         cols.counts.tobytes()))
+            monkeypatch.undo()
+        assert outs[0] == outs[1]
+
+    def test_fused_phase_attribution(self, store, monkeypatch):
+        """Fused queries tick phase="assemble_native"; the split path
+        keeps ticking collect/decode — labels never lie about the mode."""
+        from victoriametrics_tpu.utils import metrics as metricslib
+
+        def phase(ph):
+            return metricslib.REGISTRY.float_counter(
+                f'vm_fetch_phase_seconds_total{{phase="{ph}"}}').get()
+
+        store.add_rows([({"__name__": "ph", "i": str(i)},
+                         BASE + j * 1000, float(j))
+                        for i in range(4) for j in range(100)])
+        store.force_flush()
+        monkeypatch.setenv("VM_NATIVE_ASSEMBLE", "1")
+        before = {p: phase(p) for p in ("collect", "decode",
+                                        "assemble_native")}
+        _search_digest(store, "ph", BASE, BASE + 10**6)
+        assert phase("assemble_native") > before["assemble_native"]
+        assert phase("collect") == before["collect"]
+        assert phase("decode") == before["decode"]
+        monkeypatch.setenv("VM_NATIVE_ASSEMBLE", "0")
+        before = {p: phase(p) for p in ("collect", "assemble_native")}
+        _search_digest(store, "ph", BASE, BASE + 10**6)
+        assert phase("collect") > before["collect"]
+        assert phase("assemble_native") == before["assemble_native"]
+
+    def test_dec_budget_balanced_under_concurrency(self, tmp_path):
+        """The global decode-memo budget must return to its baseline after
+        concurrent fused fetches + part closes (the satellite fix: the
+        budget seam is a locktrace lock now)."""
+        import threading
+
+        from victoriametrics_tpu.storage import part as part_mod
+        st = Storage(str(tmp_path / "b"))
+        try:
+            for p in range(3):
+                st.add_rows([({"__name__": "bud", "i": str(i)},
+                              BASE + p * 50_000 + j * 1000, float(j))
+                             for i in range(8) for j in range(50)])
+                st.force_flush()
+            with part_mod._dec_budget_lock:
+                base_used = part_mod._dec_budget_used
+            errs = []
+
+            def fetch():
+                try:
+                    for _ in range(10):
+                        _search_digest(st, "bud", BASE - 10**6, BASE + 10**9)
+                except BaseException as e:  # noqa: BLE001 — test harness
+                    errs.append(e)
+
+            ths = [threading.Thread(target=fetch) for _ in range(4)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=60)
+            assert not errs, errs
+        finally:
+            st.close()
+        # closing the storage released every memo the fetches built
+        with part_mod._dec_budget_lock:
+            assert part_mod._dec_budget_used == base_used

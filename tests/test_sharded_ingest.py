@@ -27,15 +27,14 @@ try:
     from victoriametrics_tpu.storage.storage import Storage
     from victoriametrics_tpu.storage.tag_filters import filters_from_dict
     _HAVE_STORAGE = True
-    _HAVE_NATIVE = native.available()
 except ImportError:  # optional deps (zstandard) missing
     _HAVE_STORAGE = False
-    _HAVE_NATIVE = False
 
 needs_storage = pytest.mark.skipif(not _HAVE_STORAGE,
                                    reason="storage deps unavailable")
-needs_native = pytest.mark.skipif(not _HAVE_NATIVE,
-                                  reason="needs native lib")
+# canonical native gate (conftest skips the marked tests when the codec
+# library is unavailable)
+needs_native = pytest.mark.requires_native
 
 T0 = 1_753_700_000_000  # 2025-07-28
 DAY = 86_400_000

@@ -33,12 +33,12 @@ try:
     from victoriametrics_tpu.query.types import EvalConfig
     from victoriametrics_tpu.storage.storage import Storage
     from victoriametrics_tpu.storage.tag_filters import filters_from_dict
-    _HAVE_NATIVE = native.available()
 except ImportError:  # optional deps (zstandard) missing
-    _HAVE_NATIVE = False
+    pass
 
-needs_native = pytest.mark.skipif(not _HAVE_NATIVE,
-                                  reason="needs native lib")
+# canonical native gate (conftest skips the marked tests when the codec
+# library is unavailable)
+needs_native = pytest.mark.requires_native
 
 T0 = 1_753_700_000_000
 DURATION_S = 8.0
@@ -569,13 +569,18 @@ class TestDeterministicScheduler:
         assert pool._threads == []   # the pool never started workers
 
     @needs_native
+    @pytest.mark.parametrize("assemble", ["1", "0"])
     def test_parallel_fetch_stress_racetrace_clean(self, tmp_path, race_on,
-                                                   monkeypatch):
+                                                   monkeypatch, assemble):
         """The concurrent fetch stress with the WORK POOL engaged: several
         reader threads fan multi-part collection across pool workers while
         a writer appends and a flusher compacts — the sanitizer must stay
-        silent and every read must satisfy the value == f(ts) invariant."""
+        silent and every read must satisfy the value == f(ts) invariant.
+        Runs once with the fused native assemble kernel (the per-part
+        vm_assemble_part calls race on the _dec memo + budget seams) and
+        once on the split Python oracle path."""
         monkeypatch.setenv("VM_SEARCH_WORKERS", "2")
+        monkeypatch.setenv("VM_NATIVE_ASSEMBLE", assemble)
         s = Storage(str(tmp_path / "pf"))
         keys = [f'pfetch{{i="{i}"}}'.encode() for i in range(16)]
         keybuf = b"".join(keys)

@@ -23,6 +23,13 @@
 # sanitizer.  When bisecting a write-path failure, VM_INGEST_SHARDS=1
 # restores the exact sequential ingest pipeline (the escape hatch
 # mirroring VM_SEARCH_WORKERS=1 on the read path).
+#
+# The fused native read kernel (VM_NATIVE_ASSEMBLE, vm_assemble_part)
+# runs the concurrent fetch stress in BOTH modes: the kernel-enabled
+# leg exercises the per-part GIL-released calls racing on the decode-
+# memo/budget seams, the VM_NATIVE_ASSEMBLE=0 leg is the split Python
+# oracle — which is also the escape hatch when bisecting a read-path
+# miscompare (flip it before reaching for VM_SEARCH_WORKERS=1).
 # Extra args pass through to pytest, e.g.:
 #   tools/race.sh -k scheduler
 #   tools/race.sh tests/test_stress_race.py::TestRaceTrace

@@ -82,6 +82,23 @@ def _configure(lib):
                                         pi32, pi64, pi64]
     lib.vm_has_zstd.restype = ctypes.c_int32
     lib.vm_has_zstd.argtypes = []
+    lib.vm_decompress_caps.restype = ctypes.c_int32
+    lib.vm_decompress_caps.argtypes = []
+    lib.vm_zstd_compress_bound.restype = i64
+    lib.vm_zstd_compress_bound.argtypes = [i64]
+    lib.vm_zstd_compress.restype = i64
+    lib.vm_zstd_compress.argtypes = [p8, i64, p8, i64, ctypes.c_int32]
+    lib.vm_zstd_content_size.restype = i64
+    lib.vm_zstd_content_size.argtypes = [p8, i64]
+    lib.vm_zstd_decompress.restype = i64
+    lib.vm_zstd_decompress.argtypes = [p8, i64, p8, i64]
+    lib.vm_assemble_part.restype = i64
+    lib.vm_assemble_part.argtypes = [p8, p8, pi64, pi64, pi32, pi64,
+                                     pi64, pi64, pi32, pi64, pi64, pi64,
+                                     i64, i64, i64, pi64, pf64, pi64]
+    lib.vm_dedup_rows.restype = None
+    lib.vm_dedup_rows.argtypes = [pi64, i64, pf64, i64, pi64, pi64, i64,
+                                  i64, i64]
     lib.vm_decode_blocks.restype = i64
     lib.vm_decode_blocks.argtypes = [p8, pi64, pi64, pi32, pi64, pi64,
                                      i64, pi64, ctypes.c_int32]
@@ -129,10 +146,67 @@ def available() -> bool:
 
 
 def has_zstd() -> bool:
-    """True when the native library was built against libzstd; callers
-    with zstd-marshaled blocks must otherwise take their Python path."""
+    """True when zstd frames decode natively (linked libzstd or the
+    runtime libzstd.so.1 resolved via dlopen); callers with zstd-marshaled
+    blocks must otherwise take their Python path."""
     lib = _load()
     return bool(lib is not None and lib.vm_has_zstd())
+
+
+def decompress_caps() -> int:
+    """Bitmask of compressed-payload codecs the native decoder can
+    inflate: bit 0 = zstd frames, bit 1 = zlib fallback streams."""
+    lib = _load()
+    return int(lib.vm_decompress_caps()) if lib is not None else 0
+
+
+def assemble_enabled() -> bool:
+    """Whether the fused native read kernel (vm_assemble_part) serves
+    queries. ``VM_NATIVE_ASSEMBLE=0`` is the escape hatch AND the
+    correctness oracle: it restores the split Python-orchestrated
+    collect/decode/assemble path exactly. Re-read per call, like
+    VM_SEARCH_WORKERS, so tests can flip modes without restarting."""
+    return os.environ.get("VM_NATIVE_ASSEMBLE", "1") != "0" and available()
+
+
+def zstd_compress(data: bytes, level: int = 1):
+    """One-shot zstd compress via the runtime library; None when zstd is
+    unavailable (callers fall back to zlib)."""
+    lib = _load()
+    if lib is None:
+        return None
+    cap = lib.vm_zstd_compress_bound(len(data))
+    if cap < 0:
+        return None
+    out = ctypes.create_string_buffer(int(cap) or 1)
+    n = lib.vm_zstd_compress(
+        _as_u8_ptr(data), len(data),
+        ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)), cap, level)
+    if n < 0:
+        return None
+    return out.raw[:n]
+
+
+def zstd_decompress(data: bytes, max_size: int = 1 << 30):
+    """One-shot zstd decompress, allocation-bounded by the frame's claimed
+    content size (refused when unknown or above max_size — a hostile frame
+    cannot balloon memory). None when zstd is unavailable; raises on a
+    corrupt/oversized frame."""
+    lib = _load()
+    if lib is None or not lib.vm_has_zstd():
+        return None
+    src = _as_u8_ptr(data)
+    size = lib.vm_zstd_content_size(src, len(data))
+    if size < 0 or size > max_size:
+        raise ValueError(
+            f"zstd frame claims unknown or oversized content ({size})")
+    out = ctypes.create_string_buffer(int(size) or 1)
+    n = lib.vm_zstd_decompress(
+        src, len(data), ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)),
+        size)
+    if n != size:
+        raise ValueError("native zstd: malformed frame")
+    return out.raw[:n]
 
 
 def _as_i64_ptr(a: np.ndarray):
@@ -268,6 +342,63 @@ def decode_blocks(buf, off: np.ndarray, sz: np.ndarray, mt: np.ndarray,
         1 if validate_ts else 0)
     if r != int(cnt.sum()):
         raise ValueError(f"native decode_blocks: malformed block {-r - 1}")
+
+
+def _as_base_ptr(buf):
+    if isinstance(buf, np.ndarray):
+        return buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    return ctypes.cast(ctypes.c_char_p(buf), ctypes.POINTER(ctypes.c_uint8))
+
+
+def assemble_part(ts_buf, val_buf, ts_off, ts_sz, ts_mt, ts_first,
+                  val_off, val_sz, val_mt, val_first, cnt, exps,
+                  lo: int, hi: int):
+    """Fused per-part read kernel (vm_assemble_part): decode K blocks'
+    timestamp+value streams from the part's mmap'd payload buffers, clip
+    each block to [lo, hi], convert kept mantissas to float64 with the
+    block exponents, and compact into freshly allocated output columns —
+    ONE GIL-released call per part. Returns (kept_per_block int64[K],
+    ts int64[kept], vals float64[kept]); the ts/vals arrays are zero-copy
+    views of the kernel-filled buffers. Raises on a malformed block."""
+    lib = _load()
+    k = int(cnt.size)
+    total = int(cnt.sum())
+    out_ts = np.empty(total, np.int64)
+    out_vals = np.empty(total, np.float64)
+    out_cnt = np.empty(k, np.int64)
+    r = lib.vm_assemble_part(
+        _as_base_ptr(ts_buf), _as_base_ptr(val_buf),
+        _as_i64_ptr(ts_off), _as_i64_ptr(ts_sz),
+        ts_mt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _as_i64_ptr(ts_first),
+        _as_i64_ptr(val_off), _as_i64_ptr(val_sz),
+        val_mt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _as_i64_ptr(val_first),
+        _as_i64_ptr(cnt), _as_i64_ptr(exps), k, int(lo), int(hi),
+        _as_i64_ptr(out_ts),
+        out_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _as_i64_ptr(out_cnt))
+    if r < 0:
+        raise ValueError(f"native assemble_part: malformed block {-r - 1}")
+    return out_cnt, out_ts[:r], out_vals[:r]
+
+
+def dedup_rows(ts2: np.ndarray, v2: np.ndarray, counts: np.ndarray,
+               rows: np.ndarray, interval_ms: int, pad_ts: int) -> None:
+    """In-place per-row dedup + exact-duplicate removal over the padded
+    (S, N) layout for the listed rows (vm_dedup_rows; bit-exact with
+    storage/dedup.deduplicate + the keep-last pass). ts2/v2 may be
+    column-sliced views (row stride is passed through); counts is
+    rewritten in place."""
+    lib = _load()
+    if ts2.strides[1] != 8 or v2.strides[1] != 8:
+        raise ValueError("dedup_rows needs row-contiguous columns")
+    rows = np.ascontiguousarray(rows, np.int64)
+    lib.vm_dedup_rows(
+        _as_i64_ptr(ts2), ts2.strides[0] // 8,
+        v2.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        v2.strides[0] // 8, _as_i64_ptr(counts), _as_i64_ptr(rows),
+        int(rows.size), int(interval_ms), int(pad_ts))
 
 
 def decimal_to_float_blocks(m: np.ndarray, group_offsets: np.ndarray,

@@ -15,21 +15,156 @@
 #include <cstring>
 #include <vector>
 
+#include <dlfcn.h>
+
 #ifdef VM_HAVE_ZSTD
 #include <zstd.h>
 #endif
 
+// ---------------------------------------------------------------------------
+// runtime payload codecs: zstd + zlib
+//
+// Compressed block payloads (MarshalType 5/6) are zstd frames when the
+// Python side has a zstd binding and zlib streams otherwise
+// (ops/compress.py falls back to stdlib zlib and sniffs the frame magic on
+// read). Minimal containers ship libzstd.so.1 / libz.so.1 without the dev
+// headers, so instead of requiring -lzstd at build time the needed entry
+// points are resolved with dlopen on first use; a build against real
+// headers (VM_HAVE_ZSTD) binds them directly. Everything is one-shot
+// stateless API, safe from concurrent GIL-released callers.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct VmRtCodecs {
+    // zstd one-shot API (resolved lazily; null = unavailable)
+    size_t (*zd)(void*, size_t, const void*, size_t) = nullptr;
+    unsigned (*zerr)(size_t) = nullptr;
+    size_t (*zc)(void*, size_t, const void*, size_t, int) = nullptr;
+    size_t (*zbound)(size_t) = nullptr;
+    unsigned long long (*zsize)(const void*, size_t) = nullptr;
+    // zlib one-shot inflate
+    int (*inflate_buf)(unsigned char*, unsigned long*, const unsigned char*,
+                       unsigned long) = nullptr;
+
+    VmRtCodecs() {
+#ifdef VM_HAVE_ZSTD
+        zd = ZSTD_decompress;
+        zerr = ZSTD_isError;
+        zc = ZSTD_compress;
+        zbound = ZSTD_compressBound;
+        zsize = ZSTD_getFrameContentSize;
+#else
+        void* hz = dlopen("libzstd.so.1", RTLD_NOW | RTLD_LOCAL);
+        if (!hz) hz = dlopen("libzstd.so", RTLD_NOW | RTLD_LOCAL);
+        if (hz) {
+            zd = reinterpret_cast<size_t (*)(void*, size_t, const void*,
+                                             size_t)>(
+                dlsym(hz, "ZSTD_decompress"));
+            zerr = reinterpret_cast<unsigned (*)(size_t)>(
+                dlsym(hz, "ZSTD_isError"));
+            zc = reinterpret_cast<size_t (*)(void*, size_t, const void*,
+                                             size_t, int)>(
+                dlsym(hz, "ZSTD_compress"));
+            zbound = reinterpret_cast<size_t (*)(size_t)>(
+                dlsym(hz, "ZSTD_compressBound"));
+            zsize = reinterpret_cast<unsigned long long (*)(const void*,
+                                                            size_t)>(
+                dlsym(hz, "ZSTD_getFrameContentSize"));
+            if (!zd || !zerr) {  // partial API: treat as absent
+                zd = nullptr;
+                zc = nullptr;
+            }
+        }
+#endif
+        void* hl = dlopen("libz.so.1", RTLD_NOW | RTLD_LOCAL);
+        if (!hl) hl = dlopen("libz.so", RTLD_NOW | RTLD_LOCAL);
+        if (hl) {
+            inflate_buf = reinterpret_cast<int (*)(
+                unsigned char*, unsigned long*, const unsigned char*,
+                unsigned long)>(dlsym(hl, "uncompress"));
+        }
+    }
+};
+
+const VmRtCodecs& vm_rt() {
+    static VmRtCodecs c;  // C++11 thread-safe init
+    return c;
+}
+
+// Inflate one compressed block payload into dst[0:cap], sniffing the
+// producer exactly like ops/compress.py decompress(): zstd frames start
+// 28 B5 2F FD, anything else is the zlib fallback stream. Returns
+// decompressed size, or -1 (codec unavailable / malformed / overflow).
+int64_t vm_inflate(const uint8_t* p, int64_t sz, uint8_t* dst, int64_t cap) {
+    const VmRtCodecs& c = vm_rt();
+    if (sz >= 4 && p[0] == 0x28 && p[1] == 0xb5 && p[2] == 0x2f &&
+        p[3] == 0xfd) {
+        if (!c.zd) return -1;
+        size_t got = c.zd(dst, (size_t)cap, p, (size_t)sz);
+        if (c.zerr(got)) return -1;
+        return (int64_t)got;
+    }
+    if (!c.inflate_buf) return -1;
+    unsigned long dlen = (unsigned long)cap;
+    if (c.inflate_buf(dst, &dlen, p, (unsigned long)sz) != 0) return -1;
+    return (int64_t)dlen;
+}
+
+}  // namespace
+
 extern "C" {
 
-// 1 when built against libzstd; 0 means zstd-marshaled blocks (MarshalType
-// 5/6) must take the Python per-block path while everything else stays
-// native.
+// Bitmask of payload codecs the native decode path can inflate: bit 0 =
+// zstd frames, bit 1 = zlib streams. The Python gate peeks each
+// compressed block's leading byte and checks the matching bit.
+int32_t vm_decompress_caps(void) {
+    const VmRtCodecs& c = vm_rt();
+    return (c.zd ? 1 : 0) | (c.inflate_buf ? 2 : 0);
+}
+
+// 1 when zstd frames decode natively (built against libzstd OR resolved
+// from libzstd.so.1 at runtime); historical name kept for the ctypes ABI.
 int32_t vm_has_zstd(void) {
-#ifdef VM_HAVE_ZSTD
-    return 1;
-#else
-    return 0;
-#endif
+    return vm_decompress_caps() & 1;
+}
+
+// One-shot zstd compress/decompress for ops/compress.py when the Python
+// `zstandard` binding is absent but the runtime library exists. Returns
+// bytes written, or -1 (unavailable / error / cap exceeded).
+int64_t vm_zstd_compress_bound(int64_t n) {
+    const VmRtCodecs& c = vm_rt();
+    if (!c.zbound) return -1;
+    return (int64_t)c.zbound((size_t)n);
+}
+
+int64_t vm_zstd_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                         int64_t cap, int32_t level) {
+    const VmRtCodecs& c = vm_rt();
+    if (!c.zc) return -1;
+    size_t got = c.zc(dst, (size_t)cap, src, (size_t)n, (int)level);
+    if (c.zerr(got)) return -1;
+    return (int64_t)got;
+}
+
+// Claimed decompressed size of a zstd frame; -1 = unknown/error (callers
+// must then refuse rather than guess — the size caps allocation).
+int64_t vm_zstd_content_size(const uint8_t* src, int64_t n) {
+    const VmRtCodecs& c = vm_rt();
+    if (!c.zsize) return -1;
+    unsigned long long s = c.zsize(src, (size_t)n);
+    if (s == (unsigned long long)-1 || s == (unsigned long long)-2)
+        return -1;
+    return (int64_t)s;
+}
+
+int64_t vm_zstd_decompress(const uint8_t* src, int64_t n, uint8_t* dst,
+                           int64_t cap) {
+    const VmRtCodecs& c = vm_rt();
+    if (!c.zd) return -1;
+    size_t got = c.zd(dst, (size_t)cap, src, (size_t)n);
+    if (c.zerr(got)) return -1;
+    return (int64_t)got;
 }
 
 // ---------------------------------------------------------------------------
@@ -334,17 +469,13 @@ int64_t vm_decode_blocks(const uint8_t* base, const int64_t* off,
         if (n <= 0) return -(i + 1);
         int64_t r;
         if (t == VM_MT_ZSTD_NEAREST_DELTA || t == VM_MT_ZSTD_NEAREST_DELTA2) {
-#ifndef VM_HAVE_ZSTD
-            return -(i + 1);
-#else
             // decompressed payload is <= 10 bytes per varint (+lead varint)
             size_t cap = (size_t)(n + 1) * 10 + 16;
             if (scratch.size() < cap) scratch.resize(cap);
-            size_t got = ZSTD_decompress(scratch.data(), cap, p, (size_t)s);
-            if (ZSTD_isError(got)) return -(i + 1);
-            r = vm_decode_plain(scratch.data(), (int64_t)got, t - 2, first[i],
+            int64_t got = vm_inflate(p, s, scratch.data(), (int64_t)cap);
+            if (got < 0) return -(i + 1);
+            r = vm_decode_plain(scratch.data(), got, t - 2, first[i],
                                 n, out + pos);
-#endif
         } else {
             r = vm_decode_plain(p, s, t, first[i], n, out + pos);
         }
@@ -798,6 +929,204 @@ void vm_f2d_grouped(const double* v, const int64_t* starts,
                 m_out[i] = (int64_t)nearbyint(
                     (double)m_out[i] / vm_pow10d(dshift));
             }
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// fused part assemble: fetch -> decode -> clip -> float, one call per part
+// ---------------------------------------------------------------------------
+// The served-read-path kernel (ROADMAP item 1): for K (header-selected)
+// blocks of one immutable part, decode the timestamp stream, clamp lossy
+// sequences, row-clip each block to the [lo, hi]-inclusive query range by
+// binary search, decode the value stream ONLY for blocks that kept rows,
+// convert the kept mantissas to float64 with the block's decimal exponent
+// (vm_d2f_one — bit-exact with ops/decimal.decimal_to_float), and write the
+// surviving rows densely into caller-provided columnar buffers.
+//
+// Buffer contract (the zero-copy handoff): out_ts / out_vals hold at least
+// sum(cnt) entries — block i may be decoded in place at the current write
+// head before compaction, which fits because the head only advances by
+// kept rows. out_cnt[i] receives block i's kept-row count (callers drop
+// zero-count blocks from their per-block id/exponent columns, mirroring
+// clip_piece). Returns total kept rows, or -(i+1) when block i is
+// malformed / needs an unavailable payload codec.
+int64_t vm_assemble_part(
+    const uint8_t* ts_base, const uint8_t* val_base,
+    const int64_t* ts_off, const int64_t* ts_sz, const int32_t* ts_mt,
+    const int64_t* ts_first,
+    const int64_t* val_off, const int64_t* val_sz, const int32_t* val_mt,
+    const int64_t* val_first,
+    const int64_t* cnt, const int64_t* exps, int64_t k,
+    int64_t lo, int64_t hi,
+    int64_t* out_ts, double* out_vals, int64_t* out_cnt) {
+    int64_t opos = 0;
+    std::vector<int64_t> mant;
+    std::vector<uint8_t> infl;
+    for (int64_t i = 0; i < k; i++) {
+        int64_t n = cnt[i];
+        if (n <= 0) return -(i + 1);
+        // timestamps decode straight into the output at the write head
+        int32_t t = ts_mt[i];
+        const uint8_t* p = ts_base + ts_off[i];
+        int64_t r;
+        if (t == VM_MT_ZSTD_NEAREST_DELTA || t == VM_MT_ZSTD_NEAREST_DELTA2) {
+            int64_t cap = (n + 1) * 10 + 16;
+            if ((int64_t)infl.size() < cap) infl.resize((size_t)cap);
+            int64_t got = vm_inflate(p, ts_sz[i], infl.data(), cap);
+            if (got < 0) return -(i + 1);
+            r = vm_decode_plain(infl.data(), got, t - 2, ts_first[i], n,
+                                out_ts + opos);
+        } else {
+            r = vm_decode_plain(p, ts_sz[i], t, ts_first[i], n,
+                                out_ts + opos);
+        }
+        if (r != n) return -(i + 1);
+        if (t == VM_MT_NEAREST_DELTA || t == VM_MT_NEAREST_DELTA2) {
+            // lossy uncompressed types carry no checksum: re-validate
+            // non-decreasing order (ops/encoding.py needs_validation)
+            int64_t* o = out_ts + opos;
+            for (int64_t j = 1; j < n; j++) {
+                if (o[j] < o[j - 1]) o[j] = o[j - 1];
+            }
+        }
+        // row clip to [lo, hi] inclusive (vm_clip_blocks semantics)
+        int64_t* bt = out_ts + opos;
+        int64_t a, b;
+        {
+            int64_t l = 0, r2 = n;
+            while (l < r2) {
+                int64_t m = l + ((r2 - l) >> 1);
+                if (bt[m] < lo) l = m + 1; else r2 = m;
+            }
+            a = l;
+            r2 = n;
+            while (l < r2) {
+                int64_t m = l + ((r2 - l) >> 1);
+                if (bt[m] <= hi) l = m + 1; else r2 = m;
+            }
+            b = l;
+        }
+        int64_t kept = b - a;
+        out_cnt[i] = kept;
+        if (kept == 0) continue;  // fully clipped: value decode skipped
+        if (a > 0) memmove(bt, bt + a, (size_t)kept * sizeof(int64_t));
+        // values: full-block decode to scratch, convert only kept rows
+        t = val_mt[i];
+        p = val_base + val_off[i];
+        if ((int64_t)mant.size() < n) mant.resize((size_t)n);
+        if (t == VM_MT_ZSTD_NEAREST_DELTA || t == VM_MT_ZSTD_NEAREST_DELTA2) {
+            int64_t cap = (n + 1) * 10 + 16;
+            if ((int64_t)infl.size() < cap) infl.resize((size_t)cap);
+            int64_t got = vm_inflate(p, val_sz[i], infl.data(), cap);
+            if (got < 0) return -(i + 1);
+            r = vm_decode_plain(infl.data(), got, t - 2, val_first[i], n,
+                                mant.data());
+        } else {
+            r = vm_decode_plain(p, val_sz[i], t, val_first[i], n,
+                                mant.data());
+        }
+        if (r != n) return -(i + 1);
+        vm_d2f_one(mant.data() + a, kept, exps[i], out_vals + opos);
+        opos += kept;
+    }
+    return opos;
+}
+
+// ---------------------------------------------------------------------------
+// per-row query-time dedup over the padded (S, N) layout
+// ---------------------------------------------------------------------------
+
+static inline bool vm_is_stale(double x) {
+    uint64_t b;
+    memcpy(&b, &x, 8);
+    return b == 0x7FF0000000000002ULL;
+}
+
+// right-inclusive dedup window id, bit-exact with storage/dedup.py
+// _buckets (numpy // is floor division, C++ / truncates: adjust)
+static inline int64_t vm_bucket(int64_t ts, int64_t interval) {
+    int64_t x = ts + interval - 1;
+    int64_t q = x / interval;
+    if ((x % interval != 0) && ((x < 0) != (interval < 0))) q--;
+    return q;
+}
+
+// For each listed row of the (S, N) ts/vals layout: apply interval dedup
+// (keep the max-ts sample per window; on timestamp ties prefer the max
+// non-stale value via the reference's backward scan — dedup.go:30-121 as
+// mirrored by storage/dedup.py), then drop exact-duplicate timestamps
+// keeping the LAST sample, compact the row in place, pad the freed tail
+// with (pad_ts, 0.0) and rewrite counts[row]. Row strides are in elements
+// (the arrays may be column-sliced views). interval <= 0 runs only the
+// exact-duplicate pass — byte-for-byte what columnar.assemble()'s per-row
+// Python loop does.
+void vm_dedup_rows(int64_t* ts, int64_t ts_stride, double* v,
+                   int64_t v_stride, int64_t* counts, const int64_t* rows,
+                   int64_t n_rows, int64_t interval, int64_t pad_ts) {
+    for (int64_t ri = 0; ri < n_rows; ri++) {
+        int64_t s = rows[ri];
+        int64_t n = counts[s];
+        int64_t* t = ts + s * ts_stride;
+        double* vv = v + s * v_stride;
+        int64_t m = n;
+        if (interval > 0 && n >= 2) {
+            bool need = false;
+            int64_t bprev = vm_bucket(t[0], interval);
+            for (int64_t j = 1; j < n; j++) {
+                int64_t bj = vm_bucket(t[j], interval);
+                if (bj == bprev) { need = true; break; }
+                bprev = bj;
+            }
+            if (need) {
+                m = 0;
+                int64_t a = 0;
+                while (a < n) {
+                    int64_t ba = vm_bucket(t[a], interval);
+                    int64_t b = a + 1;
+                    while (b < n && vm_bucket(t[b], interval) == ba) b++;
+                    int64_t tmax = t[b - 1];
+                    double val = vv[b - 1];
+                    // tie run: rows are time-sorted, so the equal-tmax
+                    // samples are the window's suffix
+                    int64_t f = b - 1;
+                    while (f > a && t[f - 1] == tmax) f--;
+                    if (b - f >= 2) {
+                        double vprev = vv[b - 1];
+                        bool vprev_stale = vm_is_stale(vprev);
+                        for (int64_t j = b - 2; j >= f; j--) {
+                            if (vm_is_stale(vv[j])) continue;
+                            if (vprev_stale) {
+                                vprev = vv[j];
+                                vprev_stale = false;
+                            } else if (vv[j] > vprev) {
+                                vprev = vv[j];
+                            }
+                        }
+                        val = vprev;
+                    }
+                    t[m] = tmax;  // m <= a: never clobbers unread input
+                    vv[m] = val;
+                    m++;
+                    a = b;
+                }
+            }
+        }
+        // exact-duplicate timestamps (replica merges): keep the LAST
+        int64_t w = 0;
+        for (int64_t j = 0; j < m; j++) {
+            if (j + 1 < m && t[j + 1] == t[j]) continue;
+            t[w] = t[j];
+            vv[w] = vv[j];
+            w++;
+        }
+        m = w;
+        if (m != n) {
+            for (int64_t j = m; j < n; j++) {
+                t[j] = pad_ts;
+                vv[j] = 0.0;
+            }
+            counts[s] = m;
         }
     }
 }

@@ -236,6 +236,16 @@ def assemble(rows: np.ndarray, S: int, cnts: np.ndarray, ts_all: np.ndarray,
     if dup_rows.any():
         from .dedup import deduplicate
         rows_iter = np.flatnonzero(dup_rows)
+        if _native.available():
+            # one GIL-released pass over the flagged rows (vm_dedup_rows):
+            # interval dedup + exact-duplicate keep-last, compaction and
+            # tail padding in place — bit-exact with the loop below (the
+            # no-native oracle the equality tests diff against)
+            counts = np.ascontiguousarray(counts, dtype=np.int64)
+            _native.dedup_rows(ts2, v2, counts, rows_iter,
+                               dedup_interval_ms if need_dedup else 0,
+                               PAD_TS)
+            rows_iter = ()
         for s in rows_iter:
             n = int(counts[s])
             t = ts2[s, :n]

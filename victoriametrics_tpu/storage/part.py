@@ -31,12 +31,15 @@ _META_ROW = struct.Struct(">32sIQIqq")
 
 # global budget for whole-part decoded-row memos (Part._dec), shared across
 # every open part so many hot parts cannot pin unbounded RAM (the
-# lib/blockcache 25%-of-RAM role); released on part close/GC
-import threading as _threading
+# lib/blockcache 25%-of-RAM role); released on part close/GC.  Guarded by
+# a locktrace-made lock so the happens-before sanitizer sees the seam
+# (concurrent pool workers race to memoize different parts; a bare
+# threading.Lock would carry no vector clocks).
+from ..devtools.locktrace import make_lock as _make_lock
 
 DEC_CACHE_TOTAL_BYTES = int(os.environ.get("VM_DEC_CACHE_TOTAL_MB",
                                            2048)) << 20
-_dec_budget_lock = _threading.Lock()
+_dec_budget_lock = _make_lock("storage.part._dec_budget")
 _dec_budget_used = 0
 
 
@@ -115,6 +118,28 @@ def _clip_gather(mids, scales, ts_src, m_src, bstart, bend, min_ts, max_ts,
             np.arange(kept, dtype=np.int64)
         ts_k, m_k = ts_src[pos], m_src[pos]
     return mids, new_cnts, scales, ts_k, m_k
+
+
+def _piece_to_float(piece):
+    """Mantissa piece (mids, cnts, scales, ts, mants) -> FLOAT piece
+    (mids, cnts, ts, vals_f64), converting per block with the block
+    exponent — the exact per-(value, exponent) conversion the split
+    path's decode phase applies globally, so fused-mode pieces coming
+    from fallback sub-paths stay bit-identical to the oracle."""
+    mids, cnts, scales, ts, m = piece
+    vals = np.empty(m.size, np.float64)
+    goff = np.empty(cnts.size + 1, np.int64)
+    goff[0] = 0
+    np.cumsum(cnts, out=goff[1:])
+    from .. import native as _native
+    if _native.available():
+        _native.decimal_to_float_blocks(
+            np.ascontiguousarray(m), goff,
+            np.ascontiguousarray(scales, dtype=np.int64), vals)
+    else:
+        from ..ops import decimal as dec_ops
+        dec_ops.decimal_to_float_blocks_py(m, goff, scales, vals)
+    return mids, cnts, ts, vals
 
 
 def clip_piece(mids, cnts, scales, ts_all, m_all, min_ts, max_ts):
@@ -361,7 +386,11 @@ class Part:
         self._block_cache: "OrderedDict[tuple, Block]" = OrderedDict()
         self._block_cache_bytes = 0
         self._hdr_cols = None  # lazy columnar view of all block headers
-        self._dec = None  # memoized whole-part decode (ts, mant, goff)
+        # memoized whole-part decode, tagged by representation:
+        # ("mant", ts, mantissas, goff) from the split collect path or
+        # ("float", ts, float64 values, goff) from the fused assemble
+        # kernel; a memo only short-circuits the mode that can use it
+        self._dec = None
         self._dec_cost = 0
 
     def close(self):
@@ -511,17 +540,12 @@ class Part:
         from .. import native as _native
         if self._ts_buf is None or not _native.available():
             return None
-        hc = self.header_columns()
-        lo = -(1 << 62) if min_ts is None else min_ts
-        hi = (1 << 62) if max_ts is None else max_ts
-        mask = (hc["max_ts"] >= lo) & (hc["min_ts"] <= hi) & \
-            sorted_member_mask(mids_sorted, hc["mid"])
-        idx = np.flatnonzero(mask)
+        hc, lo, hi, idx = self._select_blocks(mids_sorted, min_ts, max_ts)
         if idx.size == 0:
             return False
         dec = self._dec
-        if dec is not None:
-            ts_full, m_full, goff_full = dec
+        if dec is not None and dec[0] == "mant":
+            _, ts_full, m_full, goff_full = dec
             piece = _clip_gather(
                 np.ascontiguousarray(hc["mid"][idx]),
                 np.ascontiguousarray(hc["scale"][idx]),
@@ -530,9 +554,8 @@ class Part:
             return piece if piece[3].size else False
         ts_mt = np.ascontiguousarray(hc["ts_mt"][idx])
         val_mt = np.ascontiguousarray(hc["val_mt"][idx])
-        if not _native.has_zstd() and \
-                (bool((ts_mt >= 5).any()) or bool((val_mt >= 5).any())):
-            return None  # zstd blocks need the Python per-block decoder
+        if not self._compressed_decodable(idx, ts_mt, val_mt):
+            return None  # compressed payloads need a codec this build lacks
         cnt = np.ascontiguousarray(hc["rows"][idx])
         total = int(cnt.sum())
         ts_out = np.empty(total, np.int64)
@@ -547,22 +570,164 @@ class Part:
             np.ascontiguousarray(hc["val_size"][idx]), val_mt,
             np.ascontiguousarray(hc["val_first"][idx]), cnt, m_out,
             validate_ts=False)
-        if idx.size == hc["mid"].size and self._dec is None and \
-                _dec_budget_take(16 * total):
-            goff_full = np.empty(idx.size + 1, np.int64)
-            goff_full[0] = 0
-            np.cumsum(cnt, out=goff_full[1:])
-            ts_out.setflags(write=False)
-            m_out.setflags(write=False)
-            with self._lock:
-                if self._dec is None:
-                    self._dec = (ts_out, m_out, goff_full)
-                    self._dec_cost = 16 * total
-                else:
-                    _dec_budget_release(16 * total)
+        if idx.size == hc["mid"].size:
+            self._maybe_memoize("mant", ts_out, m_out, cnt, idx.size, total)
         return clip_piece(np.ascontiguousarray(hc["mid"][idx]), cnt,
                           np.ascontiguousarray(hc["scale"][idx]),
                           ts_out, m_out, min_ts, max_ts)
+
+    def _select_blocks(self, mids_sorted, min_ts, max_ts):
+        """Shared header selection of the batched read paths: returns
+        (hc, lo, hi, idx) where idx lists the blocks overlapping
+        [min_ts, max_ts] for the wanted metric ids."""
+        hc = self.header_columns()
+        lo = -(1 << 62) if min_ts is None else min_ts
+        hi = (1 << 62) if max_ts is None else max_ts
+        mask = (hc["max_ts"] >= lo) & (hc["min_ts"] <= hi) & \
+            sorted_member_mask(mids_sorted, hc["mid"])
+        return hc, lo, hi, np.flatnonzero(mask)
+
+    def _maybe_memoize(self, kind, ts_arr, data_arr, cnt, n_blocks,
+                       total) -> None:
+        """Publish a whole-part decode as the tagged _dec memo when the
+        global budget allows (shared by the mantissa and float paths;
+        loser of the publish race gives its budget back)."""
+        if self._dec is not None or not _dec_budget_take(16 * total):
+            return
+        goff_full = np.empty(n_blocks + 1, np.int64)
+        goff_full[0] = 0
+        np.cumsum(cnt, out=goff_full[1:])
+        ts_arr.setflags(write=False)
+        data_arr.setflags(write=False)
+        with self._lock:
+            if self._dec is None:
+                self._dec = (kind, ts_arr, data_arr, goff_full)
+                self._dec_cost = 16 * total
+            else:
+                _dec_budget_release(16 * total)
+
+    def _compressed_decodable(self, idx, ts_mt, val_mt) -> bool:
+        """Whether every compressed (MarshalType>=5) payload among the
+        selected blocks can be inflated natively: peek each one's leading
+        byte (zstd frames start 0x28, the zlib fallback streams 0x78) and
+        check the matching vm_decompress_caps bit. This replaces the old
+        all-or-nothing has_zstd() exclusion: zstd AND zlib-compressed
+        blocks now ride the native path whenever the runtime codec
+        resolved."""
+        from .. import native as _native
+        if not (bool((ts_mt >= 5).any()) or bool((val_mt >= 5).any())):
+            return True
+        caps = _native.decompress_caps()
+        if caps & 3 == 3:
+            return True
+        hc = self.header_columns()
+        for buf, off_k, mt in ((self._ts_buf, "ts_off", ts_mt),
+                               (self._val_buf, "val_off", val_mt)):
+            comp = np.flatnonzero(mt >= 5)
+            if comp.size == 0:
+                continue
+            first = buf[np.ascontiguousarray(hc[off_k][idx])[comp]]
+            is_zstd = first == 0x28
+            if bool(is_zstd.any()) and not caps & 1:
+                return False
+            if bool((~is_zstd).any()) and not caps & 2:
+                return False
+        return True
+
+    def _hdrs_compressed_decodable(self, hdrs) -> bool:
+        """Per-header twin of _compressed_decodable for the list-of-
+        BlockHeaders fallback path (read_blocks_columns)."""
+        from .. import native as _native
+        caps = _native.decompress_caps()
+        if caps & 3 == 3:
+            return True
+        for h in hdrs:
+            for mt, off, buf in (
+                    (int(h.ts_marshal_type), h.ts_offset, self._ts_buf),
+                    (int(h.val_marshal_type), h.val_offset, self._val_buf)):
+                if mt >= 5 and \
+                        not caps & (1 if buf[off] == 0x28 else 2):
+                    return False
+        return True
+
+    def assemble_columns(self, mids_sorted, min_ts, max_ts):
+        """Fused native part read (vm_assemble_part): ONE GIL-released
+        call decodes every selected block's timestamp+value streams from
+        the mmap'd part, clips rows to [min_ts, max_ts], converts kept
+        mantissas straight to float64 with the block exponents and
+        compacts into freshly allocated columns — no per-block Python, no
+        intermediate mantissa arrays, fully-clipped blocks never decode
+        their value stream. Returns a FLOAT piece (mids, cnts, ts,
+        vals_f64); None when the native fused path is unavailable (caller
+        falls back to the split path and converts); False when it RAN and
+        nothing matched.
+
+        An unclipped whole-part call memoizes the decoded float columns
+        (same budget as the mantissa memo), so warm rolling-window
+        refreshes are a native clip+gather with no decode at all."""
+        from .. import native as _native
+        if self._ts_buf is None or not _native.available():
+            return None
+        hc, lo, hi, idx = self._select_blocks(mids_sorted, min_ts, max_ts)
+        if idx.size == 0:
+            return False
+        dec = self._dec
+        if dec is not None:
+            kind, ts_full, data_full, goff_full = dec
+            mids, cnts, scales, ts_k, d_k = _clip_gather(
+                np.ascontiguousarray(hc["mid"][idx]),
+                np.ascontiguousarray(hc["scale"][idx]),
+                ts_full,
+                data_full.view(np.int64) if kind == "float" else data_full,
+                goff_full[idx], goff_full[idx + 1], min_ts, max_ts)
+            if not ts_k.size:
+                return False
+            if kind == "float":
+                return mids, cnts, ts_k, d_k.view(np.float64)
+            return _piece_to_float((mids, cnts, scales, ts_k, d_k))
+        ts_mt = np.ascontiguousarray(hc["ts_mt"][idx])
+        val_mt = np.ascontiguousarray(hc["val_mt"][idx])
+        if not self._compressed_decodable(idx, ts_mt, val_mt):
+            return None
+        cnt = np.ascontiguousarray(hc["rows"][idx])
+        total = int(cnt.sum())
+        mids = np.ascontiguousarray(hc["mid"][idx])
+        scales = np.ascontiguousarray(hc["scale"][idx])
+        # when the query touches every block of the part, decode UNCLIPPED
+        # so the whole-part float memo can build even though this query
+        # clips rows (the split path memoizes its pre-clip decode the same
+        # way) — the query is then served by clip+gather over the decode,
+        # and every later rolling refresh skips the decode entirely
+        whole = idx.size == hc["mid"].size
+        klo, khi = (-(1 << 62), 1 << 62) if whole else (lo, hi)
+        kept, ts_k, vals_k = _native.assemble_part(
+            self._ts_buf, self._val_buf,
+            np.ascontiguousarray(hc["ts_off"][idx]),
+            np.ascontiguousarray(hc["ts_size"][idx]), ts_mt,
+            np.ascontiguousarray(hc["ts_first"][idx]),
+            np.ascontiguousarray(hc["val_off"][idx]),
+            np.ascontiguousarray(hc["val_size"][idx]), val_mt,
+            np.ascontiguousarray(hc["val_first"][idx]),
+            cnt, scales, klo, khi)
+        if whole:
+            self._maybe_memoize("float", ts_k, vals_k, cnt, idx.size, total)
+            goff = np.empty(idx.size + 1, np.int64)
+            goff[0] = 0
+            np.cumsum(cnt, out=goff[1:])
+            mids, cnts, _, ts_c, d_c = _clip_gather(
+                mids, scales, ts_k, vals_k.view(np.int64), goff[:-1],
+                goff[1:], min_ts, max_ts,
+                unchanged=(mids, cnt, scales, ts_k,
+                           vals_k.view(np.int64)))
+            if not ts_c.size:
+                return False
+            return mids, cnts, ts_c, d_c.view(np.float64)
+        if ts_k.size == 0:
+            return False
+        nz = kept > 0
+        if not nz.all():
+            return mids[nz], kept[nz], ts_k, vals_k
+        return mids, kept, ts_k, vals_k
 
     def read_blocks_columns(self, hdrs: list[BlockHeader]):
         """Batched decode of many blocks in ONE native call per stream
@@ -579,7 +744,7 @@ class Part:
         zstd_blocks = any(int(h.ts_marshal_type) >= 5 or
                           int(h.val_marshal_type) >= 5 for h in hdrs)
         if self._ts_buf is None or not _native.available() or \
-                (zstd_blocks and not _native.has_zstd()):
+                (zstd_blocks and not self._hdrs_compressed_decodable(hdrs)):
             blocks = [self.read_block(h) for h in hdrs]
             ts_all = (np.concatenate([b.timestamps for b in blocks])
                       if blocks else np.zeros(0, np.int64))

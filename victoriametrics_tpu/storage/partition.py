@@ -829,7 +829,8 @@ class Partition:
                                      tsid_lo, tsid_hi)
 
     def collect_units(self, tsid_set=None, min_ts=None, max_ts=None,
-                      tsid_lo=None, tsid_hi=None, mids_sorted=None):
+                      tsid_lo=None, tsid_hi=None, mids_sorted=None,
+                      as_float=False):
         """Batched block collection, split into independent work units
         for the shared fetch pool (utils/workpool): returns a list of
         zero-arg callables, each yielding a list of (mids, cnts, scales,
@@ -837,6 +838,13 @@ class Partition:
         concatenating their outputs is bit-identical to the sequential
         collection — the pool preserves submit order, so parallel and
         sequential fetches return the same bytes.
+
+        With ``as_float=True`` (the VM_NATIVE_ASSEMBLE fused read path)
+        every unit instead yields FLOAT pieces (mids, cnts, ts_concat,
+        vals_f64): file parts run the one-call native fetch→decode→clip→
+        float kernel (Part.assemble_columns), and the in-memory /
+        fallback sub-paths convert their mantissa pieces per block so the
+        bytes match the split path exactly.
 
         Unit granularity: all in-memory parts form ONE unit (masked
         columnar views, pure numpy — cheap); each file part is its own
@@ -859,7 +867,7 @@ class Partition:
             mids_sorted.sort()
         lo = -(1 << 62) if min_ts is None else min_ts
         hi = (1 << 62) if max_ts is None else max_ts
-        from .part import clip_piece
+        from .part import _piece_to_float, clip_piece
         units = []
         mems = [src for src in mems
                 if src.max_ts >= lo and src.min_ts <= hi]
@@ -869,7 +877,9 @@ class Partition:
                 for src in mems:
                     piece = src.collect_columns(mids_sorted, min_ts, max_ts)
                     if piece is not None:
-                        pieces.append(clip_piece(*piece, min_ts, max_ts))
+                        piece = clip_piece(*piece, min_ts, max_ts)
+                        pieces.append(_piece_to_float(piece) if as_float
+                                      else piece)
                 return pieces
             units.append(mem_unit)
         for p in files:
@@ -877,7 +887,10 @@ class Partition:
                 continue
 
             def file_unit(p=p):
-                piece = p.collect_columns(mids_sorted, min_ts, max_ts)
+                if as_float:
+                    piece = p.assemble_columns(mids_sorted, min_ts, max_ts)
+                else:
+                    piece = p.collect_columns(mids_sorted, min_ts, max_ts)
                 if piece is False:
                     return []  # vectorized path ran; nothing matched
                 if piece is not None:
@@ -889,27 +902,31 @@ class Partition:
                     return []
                 K = len(hdrs)
                 ts_c, m_c = p.read_blocks_columns(hdrs)
-                return [clip_piece(
+                piece = clip_piece(
                     np.fromiter((h.tsid.metric_id for h in hdrs),
                                 np.int64, K),
                     np.fromiter((h.rows for h in hdrs), np.int64, K),
                     np.fromiter((h.scale for h in hdrs), np.int64, K),
-                    ts_c, m_c, min_ts, max_ts)]
+                    ts_c, m_c, min_ts, max_ts)
+                return [_piece_to_float(piece) if as_float else piece]
             units.append(file_unit)
         return units
 
     def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
-                        tsid_lo=None, tsid_hi=None, mids_sorted=None):
+                        tsid_lo=None, tsid_hi=None, mids_sorted=None,
+                        as_float=False):
         """Batched block collection: returns (mids, cnts, scales, ts_concat,
         mant_concat) numpy arrays over every matching block in this
-        partition. File parts decode ALL their matched blocks in one native
+        partition (float pieces under ``as_float`` — see collect_units).
+        File parts decode ALL their matched blocks in one native
         call (part.read_blocks_columns); in-memory parts are masked
         columnar views with zero per-block Python.  (Sequential execution
         of collect_units; Table.collect_columns fans the same units across
         the shared work pool.)"""
         return [piece
                 for unit in self.collect_units(tsid_set, min_ts, max_ts,
-                                               tsid_lo, tsid_hi, mids_sorted)
+                                               tsid_lo, tsid_hi, mids_sorted,
+                                               as_float)
                 for piece in unit()]
 
     @property

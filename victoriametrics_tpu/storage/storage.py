@@ -36,11 +36,16 @@ DEFAULT_RETENTION_MS = 31 * 13 * 86_400_000  # ~13 months, like the reference
 
 # per-phase fetch attribution (bench.py and /metrics read these): seconds
 # spent in each stage of the columnar read path, labeled like the
-# reference's per-stage vmselect metrics
+# reference's per-stage vmselect metrics.  The fused VM_NATIVE_ASSEMBLE
+# kernel merges collect+decode+clip into one native call per part — its
+# time reports under phase="assemble_native" so the split-path labels
+# (collect / decode) stay accurate for the fallback/oracle path instead
+# of silently absorbing fused time.
 _PHASE = {
     ph: metricslib.REGISTRY.float_counter(
         f'vm_fetch_phase_seconds_total{{phase="{ph}"}}')
-    for ph in ("index_search", "collect", "decode", "assemble")
+    for ph in ("index_search", "collect", "decode", "assemble",
+               "assemble_native")
 }
 
 # write-path twin of _PHASE: where ingest time goes (the flush/merge
@@ -1029,39 +1034,58 @@ class Storage:
         if not tsids:
             return empty
         tsid_set = {t.metric_id for t in tsids}
+        # the fused native read kernel (vm_assemble_part) merges the
+        # collect+decode+clip stages into one GIL-released call per part
+        # and hands back float pieces; VM_NATIVE_ASSEMBLE=0 (or a missing
+        # native library) runs the split Python-orchestrated path — the
+        # correctness oracle the equality tests diff against
+        from .. import native as _native
+        fused = _native.assemble_enabled()
         pieces = self.table.collect_columns(
             tsid_set, min_ts, max_ts,
-            tsid_lo=tsids[0].sort_key(), tsid_hi=tsids[-1].sort_key())
-        t_ph = _phase_lap("collect", t_ph)
+            tsid_lo=tsids[0].sort_key(), tsid_hi=tsids[-1].sort_key(),
+            as_float=fused)
+        t_ph = _phase_lap("assemble_native" if fused else "collect", t_ph)
         if not pieces:
             return empty
-        if len(pieces) == 1:
-            mids, cnts, scales, ts_all, mant_all = pieces[0]
-            piece_ids = None  # one piece: every block shares provenance
+        if fused:
+            if len(pieces) == 1:
+                mids, cnts, ts_all, vals_f = pieces[0]
+                piece_ids = None  # one piece: every block shares provenance
+            else:
+                mids = np.concatenate([p[0] for p in pieces])
+                cnts = np.concatenate([p[1] for p in pieces])
+                ts_all = np.concatenate([p[2] for p in pieces])
+                vals_f = np.concatenate([p[3] for p in pieces])
+                piece_ids = np.repeat(np.arange(len(pieces)),
+                                      [p[0].size for p in pieces])
         else:
-            mids = np.concatenate([p[0] for p in pieces])
-            cnts = np.concatenate([p[1] for p in pieces])
-            scales = np.concatenate([p[2] for p in pieces])
-            ts_all = np.concatenate([p[3] for p in pieces])
-            mant_all = np.concatenate([p[4] for p in pieces])
-            piece_ids = np.repeat(np.arange(len(pieces)),
-                                  [p[0].size for p in pieces])
-        # mantissas -> float64 with per-block exponents, one native pass
-        from .. import native as _native
-        vals_f = np.empty(mant_all.size, np.float64)
-        goff = np.empty(cnts.size + 1, np.int64)
-        goff[0] = 0
-        np.cumsum(cnts, out=goff[1:])
-        if _native.available():
-            _native.decimal_to_float_blocks(
-                np.ascontiguousarray(mant_all), goff, scales, vals_f)
-        else:
-            # one sort-by-scale pass, split across the work pool (every
-            # task writes a disjoint out region: bit-identical results)
-            from ..ops import decimal as dec_ops
-            dec_ops.decimal_to_float_blocks_py(mant_all, goff, scales,
-                                               vals_f, pool=workpool.POOL)
-        t_ph = _phase_lap("decode", t_ph)
+            if len(pieces) == 1:
+                mids, cnts, scales, ts_all, mant_all = pieces[0]
+                piece_ids = None  # one piece: every block shares provenance
+            else:
+                mids = np.concatenate([p[0] for p in pieces])
+                cnts = np.concatenate([p[1] for p in pieces])
+                scales = np.concatenate([p[2] for p in pieces])
+                ts_all = np.concatenate([p[3] for p in pieces])
+                mant_all = np.concatenate([p[4] for p in pieces])
+                piece_ids = np.repeat(np.arange(len(pieces)),
+                                      [p[0].size for p in pieces])
+            # mantissas -> float64 with per-block exponents, one native pass
+            vals_f = np.empty(mant_all.size, np.float64)
+            goff = np.empty(cnts.size + 1, np.int64)
+            goff[0] = 0
+            np.cumsum(cnts, out=goff[1:])
+            if _native.available():
+                _native.decimal_to_float_blocks(
+                    np.ascontiguousarray(mant_all), goff, scales, vals_f)
+            else:
+                # one sort-by-scale pass, split across the work pool (every
+                # task writes a disjoint out region: bit-identical results)
+                from ..ops import decimal as dec_ops
+                dec_ops.decimal_to_float_blocks_py(mant_all, goff, scales,
+                                                   vals_f, pool=workpool.POOL)
+            t_ph = _phase_lap("decode", t_ph)
         # resolve names FIRST and bake the canonical raw-name row order into
         # the assembly scatter (no post-assembly reorder pass)
         uniq = np.unique(mids)

@@ -120,16 +120,20 @@ class Table:
                                      tsid_lo, tsid_hi)
 
     def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
-                        tsid_lo=None, tsid_hi=None, mids_sorted=None):
+                        tsid_lo=None, tsid_hi=None, mids_sorted=None,
+                        as_float=False):
         """Batched per-partition block collection (see
-        Partition.collect_units); returns a flat list of pieces.
+        Partition.collect_units); returns a flat list of pieces —
+        mantissa 5-tuples, or float 4-tuples under ``as_float`` (the
+        VM_NATIVE_ASSEMBLE fused kernel).
 
         The per-partition/per-part units fan across the shared work pool
-        (utils/workpool — the netstorage unpack-worker role): zstd +
-        native decode release the GIL, so a cold multi-part fetch scales
-        with cores.  The pool returns unit results in submit order, so
-        the flattened piece list is bit-identical to sequential
-        collection; VM_SEARCH_WORKERS=1 runs the exact sequential path."""
+        (utils/workpool — the netstorage unpack-worker role): the fused
+        kernel / zstd + native decode release the GIL, so a cold
+        multi-part fetch scales with cores.  The pool returns unit
+        results in submit order, so the flattened piece list is
+        bit-identical to sequential collection; VM_SEARCH_WORKERS=1 runs
+        the exact sequential path."""
         parts = self.partitions_for_range(
             min_ts if min_ts is not None else -(1 << 62),
             max_ts if max_ts is not None else 1 << 62)
@@ -139,7 +143,8 @@ class Table:
         units = []
         for p in parts:
             units.extend(p.collect_units(tsid_set, min_ts, max_ts,
-                                         tsid_lo, tsid_hi, mids_sorted))
+                                         tsid_lo, tsid_hi, mids_sorted,
+                                         as_float))
         from ..utils import workpool
         return [piece for pieces in workpool.POOL.run(units)
                 for piece in pieces]
