@@ -33,7 +33,12 @@ rate divides that by the measured p50 refresh latency.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N,
-   "backend": ...}
+   "backend": ..., "refresh_p50_ms": N, "refresh_p99_ms": N,
+   "refresh_ms": [per-refresh latencies], "cache": {inplace/rebuild/
+   merge_seconds/merge_gate_yields}}
+The refresh-latency DISTRIBUTION (p99 + the raw list) is part of the
+artifact: the p50-vs-trace variance ROADMAP item 1 tracks is invisible
+in a single median.
 
 vs_baseline divides by 1e8 samples/sec — the order of the reference's
 single-core block-unpack + rollup scan rate (its netstorage unpack workers
@@ -90,6 +95,32 @@ def _phase_label(d0: dict, d1: dict, n: int) -> str:
     parts = [f"{short[ph]}={(d1[ph] - d0[ph]) * 1e3 / max(n, 1):.0f}"
              for ph in PHASES]
     return "/".join(parts) + "ms"
+
+
+def _cache_merge_totals() -> dict:
+    """Cumulative result-cache merge counters (see _cache_merge_delta)."""
+    from victoriametrics_tpu.utils import metrics as metricslib
+    return {
+        "inplace": metricslib.REGISTRY.counter(
+            "vm_rollup_cache_inplace_total").get(),
+        "rebuild": metricslib.REGISTRY.counter(
+            "vm_rollup_cache_rebuild_total").get(),
+        "put_reuse": metricslib.REGISTRY.counter(
+            "vm_rollup_cache_put_identity_reused_total").get(),
+        "merge_seconds": metricslib.REGISTRY.float_counter(
+            "vm_rollup_cache_merge_seconds_total").get(),
+        "merge_gate_yields": metricslib.REGISTRY.counter(
+            "vm_merge_gate_yields_total").get(),
+    }
+
+
+def _cache_merge_delta(c0: dict) -> dict:
+    """Result-cache merge handling DURING one backend's steady-state
+    loop (acceptance: inplace > 0): deltas against the pre-loop
+    snapshot, like the phase labels — absolute reads would fold the
+    other backend leg's and warm-up activity into the winner's stats."""
+    return {k: round(v - c0[k], 4) for k, v in
+            _cache_merge_totals().items()}
 
 
 def _ingest_phase_totals() -> dict:
@@ -305,6 +336,7 @@ def main() -> None:
             lat = []
             ph0 = _phase_totals()
             ing0 = _ingest_phase_totals()
+            c0 = _cache_merge_totals()
             end = end0
             for _ in range(REFRESHES):
                 end += STEP
@@ -324,6 +356,7 @@ def main() -> None:
             phase_lbl = _phase_label(ph0, _phase_totals(), REFRESHES)
             ing_lbl = _ingest_phase_label(ing0, _ingest_phase_totals(),
                                           REFRESHES)
+            cache_stats = _cache_merge_delta(c0)
             # honesty check: the served refresh must equal a cold
             # (nocache) evaluation of the same window — bit-for-bit on
             # the f64 host path, within the f32 tile bound on device
@@ -333,12 +366,16 @@ def main() -> None:
             rtol = 0.0 if engine is None else (1e-4 if f32 else 1e-12)
             _assert_rows_equal(rows, cold_rows, rtol=rtol)
             results[backend] = (float(np.median(lat)), cold_dt,
-                                phase_lbl, ing_lbl)
+                                phase_lbl, ing_lbl, list(lat), cache_stats)
             end0 = end  # the next backend continues on the grown storage
 
-        backend, (warm_dt, cold_dt, phase_lbl, ing_lbl) = min(
+        backend, (warm_dt, cold_dt, phase_lbl, ing_lbl, lat,
+                  cache_stats) = min(
             results.items(), key=lambda kv: kv[1][0])
         rate = samples / warm_dt
+        # the refresh-latency DISTRIBUTION, not just p50: ROADMAP item 1's
+        # variance hunt needs p99 and the raw list in the artifact
+        p99_dt = float(np.percentile(lat, 99))
         from victoriametrics_tpu import native as native_mod
         from victoriametrics_tpu.utils import workpool
         n_workers = workpool.POOL.workers()
@@ -356,7 +393,8 @@ def main() -> None:
                        f"{N_SERIES}x{N_SAMPLES} counters, live ingest, via "
                        f"storage+index+decode+{backend} (cold "
                        f"{samples / cold_dt / 1e6:.0f}M/s, refresh p50 "
-                       f"{warm_dt * 1e3:.0f}ms, ingest "
+                       f"{warm_dt * 1e3:.0f}ms p99 {p99_dt * 1e3:.0f}ms, "
+                       f"ingest "
                        f"{ingest_rate / 1e3:.0f}k rows/s, "
                        f"{n_workers} fetch workers, "
                        f"{workpool.configured_shards()} ingest shards, "
@@ -367,6 +405,10 @@ def main() -> None:
             "unit": "samples/sec",
             "vs_baseline": round(rate / baseline, 2),
             "backend": backend_field,
+            "refresh_p50_ms": round(warm_dt * 1e3, 2),
+            "refresh_p99_ms": round(p99_dt * 1e3, 2),
+            "refresh_ms": [round(x * 1e3, 2) for x in lat],
+            "cache": cache_stats,
             "probe": probe_info,
         }))
     finally:

@@ -1,0 +1,143 @@
+"""Fused host aggregation (aggr by(...)(rollup(selector)) computed as one
+columnar fetch -> packed rollup -> per-group reduction, no per-series
+Timeseries): results must be BIT-IDENTICAL to the unfused path
+(VM_HOST_FUSED_AGGR=0), and the (G, T) eval-level cache it feeds must
+serve repeated/rolling evaluations without rebuilding per-series state."""
+
+import hashlib
+import time
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.query import eval as eval_mod
+from victoriametrics_tpu.query.exec import exec_query
+from victoriametrics_tpu.query.rollup_result_cache import GLOBAL as rcache
+from victoriametrics_tpu.query.types import EvalConfig
+from victoriametrics_tpu.storage.storage import Storage
+
+STEP = 60_000
+NS, NN = 60, 300
+
+
+def _sha(rows) -> str:
+    h = hashlib.sha256()
+    for ts in sorted(rows, key=lambda t: t.metric_name.marshal()):
+        h.update(ts.metric_name.marshal())
+        h.update(np.ascontiguousarray(ts.values).tobytes())
+    return h.hexdigest()
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("hfa")
+    s = Storage(str(tmp / "s"))
+    rng = np.random.default_rng(11)
+    t0 = (int(time.time() * 1000) - NN * 15_000) // STEP * STEP
+    rows = []
+    for i in range(NS):
+        ts = np.sort(t0 + np.arange(NN) * 15_000 +
+                     rng.integers(-2000, 2001, NN))
+        vals = np.cumsum(rng.integers(0, 40, NN)).astype(np.float64)
+        rows.extend((({"__name__": "hfa", "i": str(i), "g": f"g{i % 7}"},
+                      int(ts[j]), float(vals[j])) for j in range(NN)))
+    s.add_rows(rows)
+    s.force_flush()
+    yield s, t0
+    s.close()
+
+
+QUERIES = [
+    "sum by (g)(rate(hfa[2m]))",
+    "sum(rate(hfa[2m]))",
+    "count by (g)(rate(hfa[2m]))",
+    "avg by (g)(increase(hfa[2m]))",
+    "min by (g)(hfa)",
+    "max without (i)(delta(hfa[2m]))",
+    # keep_name=False rollup grouped by __name__: blanked-name semantics
+    "sum by (__name__)(rate(hfa[2m]))",
+    # keep_name=True rollup grouped by __name__ keeps the group
+    "sum by (__name__)(avg_over_time(hfa[2m]))",
+]
+
+
+class TestFusedEqualsUnfused:
+    @pytest.mark.parametrize("q", QUERIES)
+    def test_bit_identical(self, store, monkeypatch, q):
+        s, t0 = store
+        start = t0 + 40 * STEP
+        end = t0 + 70 * STEP
+        kw = dict(start=start, end=end, step=STEP, storage=s,
+                  disable_cache=True)
+        monkeypatch.setenv("VM_HOST_FUSED_AGGR", "0")
+        unfused = exec_query(EvalConfig(**kw), q)
+        monkeypatch.delenv("VM_HOST_FUSED_AGGR")
+        fused = exec_query(EvalConfig(**kw), q)
+        assert len(fused) == len(unfused) > 0
+        assert _sha(fused) == _sha(unfused)
+
+    def test_declines_unsupported_shapes(self, store):
+        s, t0 = store
+        ec = EvalConfig(start=t0 + 40 * STEP, end=t0 + 50 * STEP,
+                        step=STEP, storage=s, disable_cache=True)
+        from victoriametrics_tpu.query.exec import parse_cached
+        # subquery, limit, multi-arg and non-chunk aggrs fall through
+        for q in ("sum(rate(hfa[2m:30s]))",
+                  "sum(topk(2, hfa))",
+                  "median by (g)(rate(hfa[2m]))"):
+            ae = parse_cached(q)
+            assert eval_mod._try_host_fused_aggr(ec, ae) is None
+
+
+class TestFusedCache:
+    def test_repeated_eval_hits_aggr_cache(self, store):
+        s, t0 = store
+        rcache.reset()
+        start = t0 + 40 * STEP
+        end = t0 + 70 * STEP
+        kw = dict(start=start, end=end, step=STEP, storage=s)
+        q = "sum by (g)(rate(hfa[2m]))"
+        r1 = exec_query(EvalConfig(**kw), q)
+        h0 = rcache.hits
+        r2 = exec_query(EvalConfig(**kw), q)
+        assert rcache.hits > h0
+        assert _sha(r1) == _sha(r2)
+
+    def test_rolling_eval_merges_tail(self, store):
+        s, t0 = store
+        rcache.reset()
+        q = "sum by (g)(rate(hfa[2m]))"
+        kw = dict(step=STEP, storage=s)
+        start, end = t0 + 30 * STEP, t0 + 60 * STEP
+        exec_query(EvalConfig(start=start, end=end, **kw), q)
+        from victoriametrics_tpu.utils import metrics as metricslib
+        m0 = metricslib.REGISTRY.float_counter(
+            "vm_rollup_cache_merge_seconds_total").get()
+        got = exec_query(EvalConfig(start=start + STEP, end=end + STEP,
+                                    **kw), q)
+        cold = exec_query(EvalConfig(start=start + STEP, end=end + STEP,
+                                     **kw, disable_cache=True), q)
+        assert _sha(got) == _sha(cold)
+        assert metricslib.REGISTRY.float_counter(
+            "vm_rollup_cache_merge_seconds_total").get() > m0
+
+    def test_group_memo_tracks_series_churn(self, store, tmp_path):
+        """The grouping memo must recompute when the fetched series set
+        changes (new series mid-window)."""
+        s = Storage(str(tmp_path / "churn"))
+        t0 = (int(time.time() * 1000) - 100 * 15_000) // STEP * STEP
+        s.add_rows([({"__name__": "chn", "i": str(i), "g": f"g{i % 2}"},
+                     t0 + j * 15_000, float(j))
+                    for i in range(4) for j in range(100)])
+        s.force_flush()
+        q = "sum by (g)(rate(chn[2m]))"
+        kw = dict(step=STEP, storage=s, disable_cache=True)
+        end = t0 + 20 * STEP
+        r1 = exec_query(EvalConfig(start=t0 + 5 * STEP, end=end, **kw), q)
+        assert len(r1) == 2
+        # a third group appears
+        s.add_rows([({"__name__": "chn", "i": "99", "g": "g9"},
+                     t0 + j * 15_000, float(j)) for j in range(100)])
+        r2 = exec_query(EvalConfig(start=t0 + 5 * STEP, end=end, **kw), q)
+        assert len(r2) == 3
+        s.close()

@@ -30,6 +30,14 @@
 # memo/budget seams, the VM_NATIVE_ASSEMBLE=0 leg is the split Python
 # oracle — which is also the escape hatch when bisecting a read-path
 # miscompare (flip it before reaching for VM_SEARCH_WORKERS=1).
+# The ring result cache (in-place tail merges, VM_RESULT_CACHE_RING) is
+# covered by the race-marked test in tests/test_result_cache_ring.py:
+# concurrent refreshes, live ingest and a mid-flight backfill reset over
+# one entry, asserting served==cold sha256 equality per refresh.  When
+# bisecting a cache miscompare, VM_RESULT_CACHE_RING=0 restores the
+# rebuild-every-merge oracle (and VM_HOST_FUSED_AGGR=0 the unfused
+# aggregation path).
+#
 # Extra args pass through to pytest, e.g.:
 #   tools/race.sh -k scheduler
 #   tools/race.sh tests/test_stress_race.py::TestRaceTrace
@@ -38,5 +46,6 @@ cd "$(dirname "$0")/.."
 # Scoped to the race-marked modules (not tests/) so collection errors in
 # unrelated zstandard-dependent modules can't fail a green race run.
 exec env VMT_RACETRACE=1 VMT_LOCKTRACE_MAX_HOLD_MS=60000 \
-    python -m pytest tests/test_stress_race.py -q -m race \
+    python -m pytest tests/test_stress_race.py \
+    tests/test_result_cache_ring.py -q -m race \
     -p no:cacheprovider "$@"

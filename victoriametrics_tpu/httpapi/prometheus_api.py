@@ -441,6 +441,14 @@ class PrometheusAPI:
         r"\b(?:rand|rand_normal|rand_exponential|now|time)\s*\(")
 
     def _exec_range_cached(self, ec, q: str, now_ms: int):
+        # serve-priority window: background flush/merge admission yields
+        # to in-flight serving (workpool.MergeGate) for the WHOLE refresh,
+        # not just the storage-fetch slice the SearchGate covers
+        from ..utils import workpool
+        with workpool.serving():
+            return self._exec_range_cached_serving(ec, q, now_ms)
+
+    def _exec_range_cached_serving(self, ec, q: str, now_ms: int):
         from ..query.rollup_result_cache import GLOBAL as rcache
         cacheable = (ec.n_points > 1
                      and not self._UNCACHEABLE_RE.search(q))
@@ -449,22 +457,43 @@ class PrometheusAPI:
         cached, new_start = rcache.get(ec, q, now_ms)
         if cached is not None and new_start > ec.end:
             ec.tracer.printf("rollup cache: full hit")
-            return cached.rows()
+            # same shape as the partial-hit return below: an in-place
+            # merge keeps append-ordered rows (and all-NaN churned rows)
+            # in the entry, and its stamped no-op put() skips the
+            # caller's filter+sort — re-apply both so full hits match
+            # the partial-hit rows (and the ring-off oracle) exactly
+            rows = [r for r in cached.rows()
+                    if not np.isnan(r.values).all()]
+            rows.sort(key=lambda ts: ts.raw)
+            return rows
         if cached is not None:
             ec.tracer.printf("rollup cache: partial hit, computing from %d",
                              new_start)
-            sub = ec.child(start=new_start)
+            # single-column tails widen by one leading column (dropped
+            # after the eval): a one-point grid would flip rollups into
+            # instant-query maxPrevInterval semantics (rollup.go:719-728)
+            from ..query.eval import suffix_child_bounds, trim_suffix_rows
+            sub_start, trim = suffix_child_bounds(ec, new_start)
+            sub = ec.child(start=sub_start)
             sub.tracer = ec.tracer
             # the device rolling tail-reuse must not layer under this
             # cache's own tail merge (see EvalConfig.no_device_roll)
             sub.no_device_roll = True
+            # the tail sub-eval must not read or write eval-level cache
+            # entries under its own short window: a widened single-column
+            # sub has n_points=2, and its put() would replace a
+            # full-coverage inner entry with a 2-column one (same guard
+            # as the eval-level suffix subs, eval.py "must not clobber")
+            sub.no_eval_cache = True
             fresh = exec_query(sub, q)
+            if trim:
+                fresh = trim_suffix_rows(fresh)
             # trust_raw=False: these are POST-transform rows — in-place
             # label edits (multi-output rollups, label_set, binop
             # keep_metric_names) leave Timeseries.raw stale, so identity
             # must come from a fresh marshal here
             rows = rcache.merge(cached, fresh, ec, new_start,
-                                trust_raw=False)
+                                trust_raw=False, now_ms=now_ms)
             rows = [r for r in rows
                     if not np.isnan(r.values).all()]
             # merge() just attached authoritative raws to exactly these

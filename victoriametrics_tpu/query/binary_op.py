@@ -202,7 +202,13 @@ def eval_binary_op(op: str, left: list[Timeseries], right: list[Timeseries],
                 hit = pair_idx.get(key)
                 if hit is None:
                     pair_idx[key] = len(pairs)
-                    pairs.append((mn, m_ts, o_ts.copy_shallow_labels()))
+                    # merge destination: values must be OWNED — the merge
+                    # below writes in place, and o_ts.values may be a
+                    # read-only result-cache view (or shared with other
+                    # pairs via copy_shallow_labels)
+                    pairs.append((mn, m_ts,
+                                  Timeseries(o_ts.metric_name,
+                                             o_ts.values.copy())))
                 elif not _merge_non_overlapping(pairs[hit][2], o_ts):
                     raise ValueError(
                         f"duplicate time series on the 'one' side of "

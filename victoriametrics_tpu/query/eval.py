@@ -428,11 +428,15 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
             return cached.rows()
         if cached is not None:
             ec.tracer.printf("eval rollup cache: tail from %d", new_start)
-            sub = ec.child(start=new_start)
+            sub_start, trim = suffix_child_bounds(ec, new_start)
+            sub = ec.child(start=sub_start)
             sub.no_eval_cache = True  # the suffix must not clobber ckey
             fresh = _rollup_from_storage(sub, func, re_, window, offset,
                                          args, keep_name)
-            rows = rcache.merge(cached, fresh, ec, new_start)
+            if trim:
+                fresh = trim_suffix_rows(fresh)
+            rows = rcache.merge(cached, fresh, ec, new_start,
+                                now_ms=now_ms)
             if not ec._partial[0]:
                 rcache.put(ec, ckey, rows, now_ms)
             return rows
@@ -593,6 +597,28 @@ def _eval_multi_value_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
         for s_ts, s_vals, src_mn in rows:
             out.extend(_series_rows(func, s_ts, s_vals, src_mn, cfg))
     return out
+
+
+def suffix_child_bounds(ec: EvalConfig, new_start: int) -> tuple[int, bool]:
+    """Grid start for evaluating the uncovered tail [new_start, ec.end] of
+    a result-cache partial hit, plus whether the leading column must be
+    dropped.  A single-column tail is evaluated on a TWO-column grid and
+    the extra leading column discarded: a one-point grid flips rollups
+    into instant-query maxPrevInterval semantics (rollup.go:719-728 —
+    prevValue gated by step instead of the estimated scrape interval),
+    which would diverge from the full-grid eval the cache stitches
+    against.  The recomputed leading column is thrown away, never merged,
+    so cached (final) columns are still never overwritten."""
+    if new_start == ec.end and ec.end - ec.step >= ec.start:
+        return new_start - ec.step, True
+    return new_start, False
+
+
+def trim_suffix_rows(rows: list[Timeseries]) -> list[Timeseries]:
+    """Drop the extra leading column of a widened single-column tail eval
+    (see suffix_child_bounds); zero-copy views."""
+    return [Timeseries(ts.metric_name, ts.values[1:], raw=ts.raw)
+            for ts in rows]
 
 
 def _cache_rollup(ec, ckey, rows):
@@ -1097,21 +1123,10 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
 _CHUNK_AGGRS = frozenset({"sum", "count", "avg", "min", "max"})
 
 
-def _try_host_chunked_aggr(ec: EvalConfig, ae) -> list[Timeseries] | None:
-    """Bounded-memory host incremental aggregation for BIG
-    aggr by(...)(rollup(selector)) queries: chunked columnar fetch ->
-    batched rollup per chunk -> running [G, T] accumulators, so the full
-    padded (S, N) sample matrix never exists (the reference's
-    tmp-blocks-spool + incremental-aggregation pairing,
-    netstorage/tmp_blocks_file.go + eval.go:1055). Engages only when the
-    estimated fetch would overflow half the rollup memory budget — the
-    small/medium case keeps the cached full-fetch path. None = not
-    applicable, use the normal path."""
-    if ec.tpu is not None or ae.name not in _CHUNK_AGGRS:
-        return None
-    if len(ae.args) != 1 or ae.limit:
-        return None
-    arg = ae.args[0]
+def _aggr_rollup_shape(arg):
+    """aggr(func(selector[d])) shape shared by the host fused and chunked
+    aggregation paths: returns (func, RollupExpr over a non-empty
+    MetricExpr) or None when the argument is not a plain storage rollup."""
     if isinstance(arg, FuncExpr):
         if len(arg.args) != 1 or arg.keep_metric_names:
             return None
@@ -1126,6 +1141,27 @@ def _try_host_chunked_aggr(ec: EvalConfig, ae) -> list[Timeseries] | None:
             not isinstance(rarg.expr, MetricExpr) or rarg.expr.is_empty() or \
             rarg.needs_subquery() or rarg.at is not None:
         return None
+    return func, rarg
+
+
+def _try_host_chunked_aggr(ec: EvalConfig, ae) -> list[Timeseries] | None:
+    """Bounded-memory host incremental aggregation for BIG
+    aggr by(...)(rollup(selector)) queries: chunked columnar fetch ->
+    batched rollup per chunk -> running [G, T] accumulators, so the full
+    padded (S, N) sample matrix never exists (the reference's
+    tmp-blocks-spool + incremental-aggregation pairing,
+    netstorage/tmp_blocks_file.go + eval.go:1055). Engages only when the
+    estimated fetch would overflow half the rollup memory budget — the
+    small/medium case keeps the cached full-fetch path. None = not
+    applicable, use the normal path."""
+    if ec.tpu is not None or ae.name not in _CHUNK_AGGRS:
+        return None
+    if len(ae.args) != 1 or ae.limit:
+        return None
+    shape = _aggr_rollup_shape(ae.args[0])
+    if shape is None:
+        return None
+    func, rarg = shape
     from ..ops import rollup_np
     if not rollup_np.batch_supported(func, ()):
         return None
@@ -1306,6 +1342,203 @@ def _try_host_chunked_aggr(ec: EvalConfig, ae) -> list[Timeseries] | None:
     return out
 
 
+# (storage token, tenant, grouping, without, keep_name) -> (raw-name
+# tuple, gids, group_keys, sorted emit order): a steady-state dashboard
+# groups the SAME series set every refresh, so the per-series group-key
+# scan collapses to one tuple comparison. Invalidated automatically when
+# the fetched series set changes (new/vanished series); bounded clear-all.
+_FUSED_GIDS_MEMO: dict = {}
+_FUSED_GIDS_MEMO_MAX = 64
+_EMPTY_NAME_KEY = MetricName(b"", []).marshal()
+
+
+def _fused_group_ids(ec: EvalConfig, ae, cols, keep_name: bool,
+                     sel_id: str):
+    """Group assignment for the fused host aggregation: group keys,
+    sorted output order and per-group row-index arrays
+    (rows in input order, matching _group_series's vstack order),
+    memoized on the fetched raw-name tuple (the hot steady-state case is
+    an identical series set).  sel_id (the rollup argument's source
+    text) keeps same-grouping panels over DIFFERENT selectors in
+    separate slots — without it two such panels evict each other's memo
+    every refresh."""
+    gb = tuple(g.encode() for g in ae.grouping)
+    token = getattr(ec.storage, "cache_token", None)
+    sig = (token if token is not None else id(ec.storage), ec.tenant, gb,
+           ae.without, keep_name, sel_id)
+    raws_t = tuple(cols.raw_names)
+    memo = _FUSED_GIDS_MEMO.get(sig)
+    if memo is not None and memo[0] == raws_t:
+        return memo[1], memo[2], memo[3]
+    gbl = list(gb)
+    key_to_gid: dict[bytes, int] = {}
+    group_keys: list[bytes] = []
+    rows_of: list[list[int]] = []
+    for i, mn in enumerate(cols.metric_names):
+        if i % 256 == 0:
+            ec.check_deadline()
+        if gbl or ae.without:
+            # rollups that drop the metric name group on the BLANKED name,
+            # exactly like _finish_rollup_names(keep_name=False) before
+            # _group_key on the normal path
+            gmn = mn if keep_name else MetricName(b"", mn.labels)
+            key = _group_key(gmn, gbl, ae.without)
+        else:
+            key = _EMPTY_NAME_KEY
+        gid = key_to_gid.get(key)
+        if gid is None:
+            gid = len(group_keys)
+            key_to_gid[key] = gid
+            group_keys.append(key)
+            rows_of.append([])
+        rows_of[gid].append(i)
+    order = sorted(range(len(group_keys)), key=lambda g: group_keys[g])
+    group_rows = [np.asarray(r, np.int64) for r in rows_of]
+    if len(_FUSED_GIDS_MEMO) >= _FUSED_GIDS_MEMO_MAX:
+        _FUSED_GIDS_MEMO.clear()
+    _FUSED_GIDS_MEMO[sig] = (raws_t, group_keys, order, group_rows)
+    return group_keys, order, group_rows
+
+
+def _host_fused_aggr_compute(ec: EvalConfig, ae, func: str, rarg,
+                             window: int, offset: int, keep_name: bool
+                             ) -> list[Timeseries]:
+    """One fused columnar pass: fetch -> packed rollup -> reduceat group
+    aggregation -> (G, T) rows. No per-series Timeseries ever exists, so
+    a tail suffix eval costs O(new samples) instead of O(S) Python."""
+    from ..ops import rollup_np
+    cols, cfg, admission, _ = _fetch_columns_for_rollup(
+        ec, func, rarg, window, offset)
+    T = ec.n_points
+    aggr = ae.name
+    qt = ec.tracer.new_child("host fused rollup %s(%s) (columns)", aggr,
+                             func)
+    try:
+        with admission:
+            if cols.n_series == 0:
+                qt.donef("0 series")
+                return []
+            per_series_cfg = None
+            adj = adjusted_windows(func, window, ec.step, cols.ts_list())
+            if adj:
+                if all(a == adj[0] for a in adj):
+                    cfg = RollupConfig(start=cfg.start, end=cfg.end,
+                                       step=cfg.step, window=adj[0])
+                else:
+                    per_series_cfg = [
+                        RollupConfig(start=cfg.start, end=cfg.end,
+                                     step=cfg.step, window=a)
+                        for a in adj]
+            import time as _time
+            t0r = _time.perf_counter()
+            rows = None
+            if per_series_cfg is None:
+                rows = rollup_np.rollup_batch_packed(
+                    func, cols.ts, cols.vals, cols.counts, cfg, ())
+            if rows is None:  # non-finite values / per-series windows
+                counts = cols.counts
+                rows = np.empty((cols.n_series, T))
+                for i in range(cols.n_series):
+                    if i % 256 == 0:
+                        ec.check_deadline()
+                    c = (per_series_cfg[i]
+                         if per_series_cfg is not None else cfg)
+                    rows[i] = rollup_series(func, cols.ts[i, :counts[i]],
+                                            cols.vals[i, :counts[i]], c,
+                                            ())
+            rows = np.asarray(rows, dtype=np.float64)
+            _rollup_phase_lap(t0r)
+            group_keys, order, group_rows = _fused_group_ids(
+                ec, ae, cols, keep_name, f"{func}|{rarg}")
+            G = len(group_keys)
+            # per-group reduction with the SAME aggregate kernels
+            # _simple_aggr applies to its vstacked groups (rows gathered
+            # in input order): bit-identical to the unfused path by
+            # construction — reduceat would sum in a different order and
+            # drift by ulps, breaking the served==cold rtol=0 invariant
+            fn = SIMPLE[aggr]
+            vals = np.empty((G, T))
+            for g in range(G):
+                vals[g] = fn(rows[group_rows[g]])
+        qt.donef("%d series -> %d groups", cols.n_series, G)
+    except BaseException as e:
+        qt.donef("error: %s", e)  # close the span on deadline/limit aborts
+        raise
+    return [Timeseries(MetricName.unmarshal(group_keys[g]), vals[g],
+                       raw=group_keys[g])
+            for g in order]
+
+
+def _try_host_fused_aggr(ec: EvalConfig, ae) -> list[Timeseries] | None:
+    """aggr by (...)(rollup(selector)) fused on host: columnar fetch ->
+    packed rollup -> reduceat group reduction, materializing only the
+    (G, T) aggregated block — the host twin of the device fused path and
+    the steady-state lever of ROADMAP item 2: a dashboard-suffix eval
+    never rebuilds S per-series Timeseries or the S-row eval cache entry.
+    The (G, T) result is cached in the rollup result cache keyed by the
+    FULL aggregation (ring entries make the rolling merge in-place), so
+    repeated/rolling evals of the same shape cost O(new samples).
+    VM_HOST_FUSED_AGGR=0 restores the unfused path (equality oracle).
+    None -> not applicable, use the normal path."""
+    if ec.tpu is not None or ae.name not in _CHUNK_AGGRS:
+        return None
+    if len(ae.args) != 1 or ae.limit:
+        return None
+    import os as _os
+    if _os.environ.get("VM_HOST_FUSED_AGGR", "1") == "0":
+        return None
+    shape = _aggr_rollup_shape(ae.args[0])
+    if shape is None:
+        return None
+    func, rarg = shape
+    from ..ops import rollup_np
+    if not rollup_np.batch_supported(func, ()):
+        return None
+    if ec.storage is None or \
+            getattr(ec.storage, "search_columns", None) is None:
+        return None
+    offset = rarg.offset.value_ms(ec.step) if rarg.offset is not None else 0
+    window = rarg.window.value_ms(ec.step) if rarg.window is not None else 0
+    keep_name = func == "default_rollup" or func in KEEP_METRIC_NAMES
+    # mirror _rollup_from_storage's eval-cache gating (default_rollup's
+    # lookback depends on ec state; negative offsets touch the volatile
+    # now-edge)
+    use_cache = (ec.n_points > 1 and func != "default_rollup"
+                 and offset >= 0 and not ec.disable_cache
+                 and not ec.no_eval_cache)
+    if not use_cache:
+        return _host_fused_aggr_compute(ec, ae, func, rarg, window, offset,
+                                        keep_name)
+    import time as _t
+
+    from .rollup_result_cache import GLOBAL as rcache
+    now_ms = int(_t.time() * 1000)
+    ckey = (f"fusedaggr|{ae.name}|{','.join(ae.grouping)}|{ae.without}|"
+            f"{func}|{rarg.expr}|{window}|{offset}|{keep_name}")
+    cached, new_start = rcache.get(ec, ckey, now_ms)
+    if cached is not None and new_start > ec.end:
+        ec.tracer.printf("host fused aggr cache: full hit %s", ckey)
+        return cached.rows()
+    if cached is not None:
+        ec.tracer.printf("host fused aggr cache: tail from %d", new_start)
+        sub_start, trim = suffix_child_bounds(ec, new_start)
+        sub = ec.child(start=sub_start)
+        sub.no_eval_cache = True  # the suffix must not clobber ckey
+        fresh = _host_fused_aggr_compute(sub, ae, func, rarg, window,
+                                         offset, keep_name)
+        if trim:
+            fresh = trim_suffix_rows(fresh)
+        rows = rcache.merge(cached, fresh, ec, new_start, now_ms=now_ms)
+        if not ec._partial[0]:
+            rcache.put(ec, ckey, rows, now_ms)
+        return rows
+    rows = _host_fused_aggr_compute(ec, ae, func, rarg, window, offset,
+                                    keep_name)
+    if not ec._partial[0]:
+        rcache.put(ec, ckey, rows, now_ms)
+    return rows
+
+
 def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
     name = ae.name
 
@@ -1315,6 +1548,9 @@ def _eval_aggr(ec: EvalConfig, ae: AggrFuncExpr) -> list[Timeseries]:
     chunked = _try_host_chunked_aggr(ec, ae)
     if chunked is not None:
         return chunked
+    hfused = _try_host_fused_aggr(ec, ae)
+    if hfused is not None:
+        return hfused
 
     # arg layouts
     if name in ("topk", "bottomk", "limitk", "outliersk") or \

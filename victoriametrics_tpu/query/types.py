@@ -118,6 +118,13 @@ class EvalConfig:
                     "query exceeds -search.maxQueryDuration; increase the "
                     "flag or reduce the query scope")
 
+    @property
+    def samples_scanned(self) -> int:
+        """Samples fetched so far across all selectors of this query
+        (shared accumulator — children report into the parent). The
+        O(new-samples) serving regression guard asserts on this."""
+        return int(self._samples_scanned[0])
+
     def count_samples(self, n: int):
         """Accumulate scanned samples across all selectors of one query
         (the -search.maxSamplesPerQuery scope, eval.go seriesFetched).

@@ -62,9 +62,43 @@ from . import metrics as metricslib
 __all__ = ["WorkPool", "Future", "SearchGate", "SearchLimitError",
            "MergeGate", "POOL", "SEARCH_GATE", "MERGE_GATE",
            "configured_workers", "configured_shards",
-           "ingest_parallel_enabled"]
+           "ingest_parallel_enabled", "serving", "serving_busy"]
 
 _TASKS_TOTAL = metricslib.REGISTRY.counter("vm_workpool_tasks_total")
+
+# whole-refresh serve sections (the HTTP cached range executor wraps each
+# refresh): together with the SearchGate occupancy below this is the
+# "someone is being served right now" signal the MergeGate yields to
+_SERVING = metricslib.REGISTRY.gauge("vm_serving_current")
+# per-thread context for the MergeGate serve-priority yield: a thread
+# that is itself serving (or a pool worker holding a shared-POOL slot)
+# must never sleep in the yield — see MergeGate._maybe_yield
+_yield_tls = threading.local()
+
+
+class _ServingSection:
+    def __enter__(self):
+        _SERVING.inc()
+        _yield_tls.serving = getattr(_yield_tls, "serving", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _SERVING.dec()
+        _yield_tls.serving -= 1
+        return False
+
+
+def serving() -> _ServingSection:
+    """Context manager marking an in-flight serve (query refresh); merge
+    admission defers to these sections (MergeGate serve priority)."""
+    return _ServingSection()
+
+
+def serving_busy() -> bool:
+    """True while any search or serve section is in flight (the gauges
+    are process-global: every SearchGate instance shares them)."""
+    return _SERVING.get() > 0 or \
+        metricslib.REGISTRY.gauge("vm_search_concurrent_current").get() > 0
 
 
 def configured_workers() -> int:
@@ -171,6 +205,7 @@ class WorkPool:
 
     def _worker(self) -> None:
         me = threading.current_thread()
+        _yield_tls.pool_worker = True
         while True:
             item = self._q.get()
             if item is None:        # shutdown sentinel (tests only)
@@ -367,7 +402,18 @@ class MergeGate:
 
     ``VM_MERGE_WORKERS`` (default ``cpu_count``) sizes the gate; the
     gate only *bounds* concurrency — the work itself is fanned by
-    ``Table.flush_to_disk``/``force_merge`` over :data:`POOL`."""
+    ``Table.flush_to_disk``/``force_merge`` over :data:`POOL`.
+
+    Serve priority: on entry the gate YIELDS to in-flight serving — while
+    any search/serve section is active (``serving_busy``), merge
+    admission defers for up to ``VM_MERGE_YIELD_MS`` (default 250; 0
+    disables) and resumes as soon as serving drains.  This keeps a
+    background flush/merge storm from sitting on every core exactly while
+    a dashboard refresh is being served (the measured source of
+    steady-state refresh-latency variance).  Bounded: merges always
+    proceed after the budget, so ingest pressure cannot starve them;
+    counted by ``vm_merge_gate_yields_total``.  Skipped under the
+    deterministic scheduler (wall-clock waits would break replay)."""
 
     def __init__(self, limit: int | None = None):
         if limit is None:
@@ -381,6 +427,36 @@ class MergeGate:
         self._sem = threading.Semaphore(limit)
         self._pending = metricslib.Gauge("pending")
         self._active = metricslib.Gauge("active")
+        self._yields = metricslib.REGISTRY.counter(
+            "vm_merge_gate_yields_total")
+
+    @property
+    def yields(self) -> int:
+        """Merge admissions that deferred to in-flight serving."""
+        return self._yields.get()
+
+    def _maybe_yield(self) -> None:
+        # Never yield on a thread that would invert the priority it
+        # exists to protect: a shared-POOL worker sleeping here holds a
+        # pool slot the serve's own fetch tasks are queued behind, and a
+        # serving thread that picked up a queued flush task while helping
+        # the pool (WorkPool._collect) would block on its OWN serving
+        # gauge for the whole budget.  The yield therefore applies only
+        # on dedicated flusher/merger threads (and direct callers).
+        if getattr(_yield_tls, "pool_worker", False) or \
+                getattr(_yield_tls, "serving", 0):
+            return
+        try:
+            budget_ms = float(os.environ.get("VM_MERGE_YIELD_MS", "250"))
+        except ValueError:
+            budget_ms = 250.0
+        if budget_ms <= 0 or _sched_active() or not serving_busy():
+            return
+        self._yields.inc()
+        import time as _t
+        deadline = _t.monotonic() + budget_ms / 1e3
+        while _t.monotonic() < deadline and serving_busy():
+            _t.sleep(0.002)
 
     @property
     def pending(self) -> int:
@@ -393,6 +469,7 @@ class MergeGate:
         return int(self._active.get())
 
     def __enter__(self):
+        self._maybe_yield()
         self._pending.inc()
         try:
             self._sem.acquire()
