@@ -35,7 +35,9 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N,
    "backend": ..., "refresh_p50_ms": N, "refresh_p99_ms": N,
    "refresh_ms": [per-refresh latencies], "cache": {inplace/rebuild/
-   merge_seconds/merge_gate_yields}}
+   merge_seconds/merge_gate_yields}, "flight": {per-leg flight-recorder
+   attribution: slow-refresh captures + the slowest one's overlap
+   summary}}
 The refresh-latency DISTRIBUTION (p99 + the raw list) is part of the
 artifact: the p50-vs-trace variance ROADMAP item 1 tracks is invisible
 in a single median.
@@ -73,8 +75,8 @@ JITTER_MS = 2_000  # scrape-time jitter; the end0 ceil below depends on it
 # "assemble_native" is the fused VM_NATIVE_ASSEMBLE kernel (one native
 # fetch→decode→clip→float call per part); collect/decode only tick on the
 # split fallback path.
-PHASES = ("index_search", "collect", "decode", "assemble_native",
-          "assemble", "rollup")
+PHASES = ("queue_wait", "index_search", "collect", "decode",
+          "assemble_native", "assemble", "rollup")
 # the write-path twin (vm_ingest_phase_seconds_total): where the live
 # steady-state ingest spends its time, per refresh
 ING_PHASES = ("resolve", "register", "append")
@@ -88,8 +90,9 @@ def _phase_totals() -> dict:
 
 
 def _phase_label(d0: dict, d1: dict, n: int) -> str:
-    """'idx=2/collect=0/decode=0/native=25/assemble=9/rollup=12ms'."""
-    short = {"index_search": "idx", "collect": "collect", "decode": "decode",
+    """'qwait=0/idx=2/collect=0/decode=0/native=25/assemble=9/rollup=12ms'."""
+    short = {"queue_wait": "qwait", "index_search": "idx",
+             "collect": "collect", "decode": "decode",
              "assemble_native": "native", "assemble": "assemble",
              "rollup": "rollup"}
     parts = [f"{short[ph]}={(d1[ph] - d0[ph]) * 1e3 / max(n, 1):.0f}"
@@ -133,6 +136,42 @@ def _ingest_phase_label(d0: dict, d1: dict, n: int) -> str:
     parts = [f"{ph}={(d1[ph] - d0[ph]) * 1e3 / max(n, 1):.0f}"
              for ph in ING_PHASES]
     return "/".join(parts) + "ms"
+
+
+def _leg_flight_summary(id0: int, threshold_ms: float) -> dict:
+    """Flight-recorder outcome of one backend leg: how many slow-refresh
+    captures fired past `id0`, and the attribution summary of the
+    slowest one.  When the whole loop stayed under the threshold, an
+    on-demand capture of the still-live ring window stands in — the
+    artifact always ships a timeline (ROADMAP item 1's open question is
+    exactly "what overlapped the slow refresh", and the answer must not
+    depend on the slow refresh happening to recur)."""
+    from victoriametrics_tpu.utils import flightrec
+    if not flightrec.enabled():
+        return {"enabled": False}
+    # fired counts every capture of the leg; the retention ring
+    # (VM_FLIGHT_CAPTURES) bounds how many are still inspectable, so
+    # the slowest RETAINED capture may not be the slowest fired —
+    # "evicted" makes that truncation visible in the artifact
+    fired = flightrec.RECORDER.total() - id0
+    caps = [c for c in flightrec.RECORDER.list() if c["id"] > id0]
+    source = "slow_refresh"
+    if not caps:
+        cap = flightrec.RECORDER.capture("bench_on_demand")
+        caps = [c for c in flightrec.RECORDER.list()
+                if c["id"] == cap["id"]]
+        source = "on_demand"
+    slowest = max(caps,
+                  key=lambda c: (c.get("refresh_ms", 0.0), c["id"]))
+    out = {"enabled": True, "threshold_ms": round(threshold_ms, 1),
+           "captures": fired, "source": source,
+           "capture_id": slowest["id"],
+           "summary": slowest.get("summary", {})}
+    if fired > len(caps):
+        out["evicted"] = fired - len(caps)
+    if "refresh_ms" in slowest:
+        out["refresh_ms"] = slowest["refresh_ms"]
+    return out
 
 
 def _finish_provision(probe_handle, probe_timeout: float):
@@ -296,6 +335,14 @@ def main() -> None:
 
         results = {}
         traces = {}
+        flights = {}
+        # an operator-set VM_SLOW_REFRESH_MS wins over the per-leg
+        # calibration below (the env var is rewritten per leg otherwise)
+        try:
+            user_slow_refresh_ms = float(
+                os.environ["VM_SLOW_REFRESH_MS"])
+        except (KeyError, ValueError):
+            user_slow_refresh_ms = None
         # first refresh window must start BEYOND every initial sample
         # (incl. jitter): rounding down would interleave the first fresh
         # scrapes with the initial batch's tail, fabricating counter
@@ -332,12 +379,31 @@ def main() -> None:
             # result + eval caches
             api._exec_range_cached(EvalConfig(start=start, end=end0, **kw),
                                    q, end0)
+            # preflight: two uncounted steady refreshes calibrate the
+            # slow-refresh flight trigger for THIS host/leg — refreshes
+            # >1.25x the calibrated floor freeze a cross-thread capture
+            # mid-loop (an operator-set VM_SLOW_REFRESH_MS wins)
+            from victoriametrics_tpu.utils import flightrec
+            end = end0
+            pre = []
+            for _ in range(2):
+                end += STEP
+                ingest_fresh(end)
+                t0 = time.perf_counter()
+                api._exec_range_cached(
+                    EvalConfig(start=end - duration, end=end, **kw), q, end)
+                pre.append(time.perf_counter() - t0)
+            if user_slow_refresh_ms is None:
+                thresh_ms = max(min(pre) * 1.25e3, 25.0)
+                os.environ["VM_SLOW_REFRESH_MS"] = str(thresh_ms)
+            else:
+                thresh_ms = user_slow_refresh_ms
+            flight_id0 = flightrec.RECORDER.total()
             # steady-state: live ingest + window advance per refresh
             lat = []
             ph0 = _phase_totals()
             ing0 = _ingest_phase_totals()
             c0 = _cache_merge_totals()
-            end = end0
             for _ in range(REFRESHES):
                 end += STEP
                 start = end - duration
@@ -357,6 +423,9 @@ def main() -> None:
             ing_lbl = _ingest_phase_label(ing0, _ingest_phase_totals(),
                                           REFRESHES)
             cache_stats = _cache_merge_delta(c0)
+            # flight attribution BEFORE the honesty check: its cold eval
+            # would flood the rings with full-window fetch spans
+            flights[backend] = _leg_flight_summary(flight_id0, thresh_ms)
             # honesty check: the served refresh must equal a cold
             # (nocache) evaluation of the same window — bit-for-bit on
             # the f64 host path, within the f32 tile bound on device
@@ -409,6 +478,7 @@ def main() -> None:
             "refresh_p99_ms": round(p99_dt * 1e3, 2),
             "refresh_ms": [round(x * 1e3, 2) for x in lat],
             "cache": cache_stats,
+            "flight": flights,
             "probe": probe_info,
         }))
     finally:

@@ -1,0 +1,61 @@
+#!/bin/sh
+# Flight-recorder helper: pull cross-thread latency captures and the
+# slow-query log from a running vmsingle/vmselect.
+#
+# Usage:
+#   tools/flight.sh [-a host:port] list              # capture metadata
+#   tools/flight.sh [-a host:port] capture           # trigger on-demand
+#   tools/flight.sh [-a host:port] get ID [out.json] # Perfetto-loadable
+#   tools/flight.sh [-a host:port] slow              # slow-query log
+#
+# `get` writes the bare Chrome trace-event JSON — open it at
+# https://ui.perfetto.dev (or chrome://tracing).  Captures fire
+# automatically when a refresh exceeds VM_SLOW_REFRESH_MS; `capture`
+# freezes the live ring window on demand.  VM_FLIGHTREC=0 disables the
+# recorder (the endpoint answers 503).
+set -eu
+ADDR="127.0.0.1:8428"
+if [ "${1:-}" = "-a" ]; then
+    ADDR="$2"
+    shift 2
+fi
+CMD="${1:-list}"
+BASE="http://$ADDR/api/v1/status"
+
+fetch() {
+    # stdlib only: curl is not guaranteed in the dev containers
+    python - "$1" "${2:-}" <<'EOF'
+import json, sys, urllib.request
+url, out = sys.argv[1], sys.argv[2]
+body = urllib.request.urlopen(url, timeout=30).read()
+if out:
+    with open(out, "wb") as f:
+        f.write(body)
+    print(f"wrote {len(body)} bytes to {out}")
+else:
+    try:
+        print(json.dumps(json.loads(body), indent=2))
+    except ValueError:
+        sys.stdout.buffer.write(body)
+EOF
+}
+
+case "$CMD" in
+list)
+    fetch "$BASE/flight"
+    ;;
+capture)
+    fetch "$BASE/flight?capture=1"
+    ;;
+get)
+    ID="${2:?usage: tools/flight.sh get ID [out.json]}"
+    fetch "$BASE/flight?id=$ID" "${3:-flight_$ID.json}"
+    ;;
+slow)
+    fetch "$BASE/slow_queries"
+    ;;
+*)
+    echo "unknown command: $CMD (list|capture|get|slow)" >&2
+    exit 2
+    ;;
+esac

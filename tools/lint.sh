@@ -8,9 +8,19 @@
 # Extra args are passed through, e.g.:
 #   tools/lint.sh --update-baseline
 #   tools/lint.sh --no-baseline victoriametrics_tpu/storage/
+#
+# After a clean lint, the flight-recorder overhead smoke check runs
+# (devtools/flight_overhead.py): the always-on record path must stay
+# under a per-event ns budget AND within VM_FLIGHT_SMOKE_PCT (default
+# 2%) of VM_FLIGHTREC=0 on a serving-shaped workload — exit 1 on an
+# overhead regression.  VMT_NO_FLIGHT_SMOKE=1 skips it (e.g. when
+# iterating on lint findings only).
 set -eu
 cd "$(dirname "$0")/.."
 if [ "$#" -eq 0 ]; then
     set -- victoriametrics_tpu/
 fi
-exec python -m victoriametrics_tpu.devtools.lint "$@"
+python -m victoriametrics_tpu.devtools.lint "$@"
+if [ "${VMT_NO_FLIGHT_SMOKE:-0}" != "1" ]; then
+    exec python -m victoriametrics_tpu.devtools.flight_overhead
+fi

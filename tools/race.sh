@@ -38,6 +38,13 @@
 # rebuild-every-merge oracle (and VM_HOST_FUSED_AGGR=0 the unfused
 # aggregation path).
 #
+# The flight recorder (utils/flightrec) is covered by the race-marked
+# stress in tests/test_flightrec.py: concurrent per-thread ring writers
+# hammered while captures walk the rings, asserting the seqlock-reader
+# discipline never yields a torn event or an unserializable trace.
+# VM_FLIGHTREC=0 is the escape hatch when bisecting (also disables the
+# pool's ctx-propagation records around each task).
+#
 # Extra args pass through to pytest, e.g.:
 #   tools/race.sh -k scheduler
 #   tools/race.sh tests/test_stress_race.py::TestRaceTrace
@@ -47,5 +54,5 @@ cd "$(dirname "$0")/.."
 # unrelated zstandard-dependent modules can't fail a green race run.
 exec env VMT_RACETRACE=1 VMT_LOCKTRACE_MAX_HOLD_MS=60000 \
     python -m pytest tests/test_stress_race.py \
-    tests/test_result_cache_ring.py -q -m race \
+    tests/test_result_cache_ring.py tests/test_flightrec.py -q -m race \
     -p no:cacheprovider "$@"

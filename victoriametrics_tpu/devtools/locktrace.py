@@ -138,6 +138,16 @@ def _default_max_hold_ms() -> float:
         return 500.0
 
 
+def _flight_wait_ms() -> float:
+    """Blocking waits on a traced lock longer than this land on the
+    flight-recorder timeline as ``lock:<role>`` spans (only meaningful
+    under VMT_LOCKTRACE — production locks are untraced plain locks)."""
+    try:
+        return float(os.environ.get("VM_FLIGHT_LOCK_WAIT_MS", "5"))
+    except ValueError:
+        return 5.0
+
+
 class TracedLock:
     """Instrumented drop-in for ``threading.Lock``/``RLock``.
 
@@ -205,10 +215,18 @@ class TracedLock:
                 f"non-reentrant lock '{self.name}' re-acquired by the "
                 f"same thread (self-deadlock)")
         hooks = _race_hooks
+        t_wait = time.perf_counter()
         if hooks is not None:
             ok = hooks.acquire_inner(self._inner, blocking, timeout)
         else:
             ok = self._inner.acquire(blocking, timeout)
+        waited = time.perf_counter() - t_wait
+        if waited * 1e3 > _flight_wait_ms():
+            # contended-lock visibility on the flight timeline: WHO was
+            # stalled on WHAT while a refresh ran (import deferred — the
+            # slow path only; devtools must stay import-light)
+            from ..utils import flightrec
+            flightrec.rec("lock:" + self.name, t_wait, waited)
         if ok:
             if hooks is not None:
                 hooks.acquired(self)

@@ -12,6 +12,7 @@ status/{tsdb,active_queries,top_queries}}, /write (influx), /api/put
 
 from __future__ import annotations
 
+import contextlib
 import datetime
 import json
 import math
@@ -30,10 +31,10 @@ from ..query.eval import QueryError, filters_from_metric_expr
 from ..query.metricsql import parse as mql_parse
 from ..query.metricsql.ast import MetricExpr
 from ..query.metricsql.parser import ParseError, parse_duration_ms
-from ..query.querystats import ActiveQueries, QueryStats
+from ..query.querystats import ActiveQueries, QueryStats, SlowQueryLog
 from ..query.types import EvalConfig
 from ..storage.metric_name import MetricName
-from ..utils import fasttime, logger
+from ..utils import fasttime, flightrec, logger
 from ..utils import metrics as metricslib
 from .server import HTTPServer, Request, Response
 
@@ -137,6 +138,7 @@ class PrometheusAPI:
         self.columnar_drop_stats: dict = {}
         self.active = ActiveQueries()
         self.qstats = QueryStats()
+        self.slowlog = SlowQueryLog()
         self.gate = ConcurrencyGate(max_concurrent_queries)
         self.started_at = fasttime.unix_seconds()
         self.rows_inserted = 0
@@ -234,6 +236,8 @@ class PrometheusAPI:
         r("/api/v1/status/tsdb", self.h_status_tsdb)
         r("/api/v1/status/active_queries", self.h_active_queries)
         r("/api/v1/status/top_queries", self.h_top_queries)
+        r("/api/v1/status/slow_queries", self.h_slow_queries)
+        r("/api/v1/status/flight", self.h_flight)
         r("/metric-relabel-debug", self.h_relabel_debug)
         r("/prettify-query", self.h_prettify_query)
         r("/expand-with-exprs", self.h_prettify_query)  # WITH folding is
@@ -329,6 +333,33 @@ class PrometheusAPI:
                           max_memory_per_query=self.max_memory_per_query,
                           deadline=deadline, tenant=tenant)
 
+    @contextlib.contextmanager
+    def _query_observability(self, req: Request, q: str, qt, qid: int,
+                             start: int, end: int, step: int):
+        """One query's observability bracket, shared by h_query and
+        h_query_range: install the tracer + a fresh flight context (so
+        spans recorded anywhere — this thread or pool workers — carry
+        the query's ctx and the slow-query log can reassemble the
+        per-phase split); on exit restore both, unregister the active
+        query and feed qstats + the slow-query log, attaching any flight
+        capture the eval noted."""
+        from ..utils import querytracer
+        fctx = flightrec.new_ctx()
+        prev_ctx = flightrec.set_ctx(fctx)
+        prev_tr = querytracer.set_current(qt)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            querytracer.set_current(prev_tr)
+            flightrec.set_ctx(prev_ctx)
+            self.active.unregister(qid)
+            dur = time.perf_counter() - t0
+            self.qstats.record(q, (end - start) / 1e3, dur)
+            self.slowlog.maybe_record(
+                q, start, end, step, self._tenant(req), dur, ctx=fctx,
+                capture_id=flightrec.take_noted_capture())
+
     def h_query(self, req: Request) -> Response:
         q = req.arg("query")
         if not q:
@@ -337,27 +368,24 @@ class PrometheusAPI:
         ts = parse_time(req.arg("time"), now)
         step = parse_step(req.arg("step"), 300_000)
         qid = self.active.register(q, ts, ts, step)
-        t0 = time.perf_counter()
         if hasattr(self.storage, "reset_partial"):
             self.storage.reset_partial()
         from ..utils import querytracer
         qt = querytracer.new(req.arg("trace") == "1", "query %s time=%d",
                              q, ts)
         try:
-            ec = self._ec(ts, ts, step, self._tenant(req))
-            ec.tracer = qt
-            with self.gate:
-                rows = exec_query(ec, q)
-            self._track_usage(rows)
+            with self._query_observability(req, q, qt, qid, ts, ts, step):
+                ec = self._ec(ts, ts, step, self._tenant(req))
+                ec.tracer = qt
+                with self.gate:
+                    rows = exec_query(ec, q)
+                self._track_usage(rows)
         except TimeoutError as e:
             resp = Response.error(str(e), 429, "too_many_requests")
             resp.headers["Retry-After"] = "10"
             return resp
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
-        finally:
-            self.active.unregister(qid)
-            self.qstats.record(q, 0, time.perf_counter() - t0)
         result = []
         for r in rows:
             v = r.values[-1]
@@ -390,7 +418,6 @@ class PrometheusAPI:
         start -= start % step
         end = start + -(-(end - start) // step) * step
         qid = self.active.register(q, start, end, step)
-        t0 = time.perf_counter()
         if hasattr(self.storage, "reset_partial"):
             self.storage.reset_partial()
         from ..utils import querytracer
@@ -398,26 +425,24 @@ class PrometheusAPI:
                              "query_range %s start=%d end=%d step=%d",
                              q, start, end, step)
         try:
-            ec = self._ec(start, end, step, self._tenant(req))
-            ec.tracer = qt
-            with self.gate:
-                if req.arg("nocache") == "1":
-                    # reference -search.disableCache / nocache=1 query arg
-                    ec.disable_cache = True
-                    rows = exec_query(ec, q)
-                else:
-                    rows = self._exec_range_cached(ec, q, now)
-            self._track_usage(rows)
+            with self._query_observability(req, q, qt, qid,
+                                           start, end, step):
+                ec = self._ec(start, end, step, self._tenant(req))
+                ec.tracer = qt
+                with self.gate:
+                    if req.arg("nocache") == "1":
+                        # reference -search.disableCache / nocache=1 arg
+                        ec.disable_cache = True
+                        rows = exec_query(ec, q)
+                    else:
+                        rows = self._exec_range_cached(ec, q, now)
+                self._track_usage(rows)
         except TimeoutError as e:
             resp = Response.error(str(e), 429, "too_many_requests")
             resp.headers["Retry-After"] = "10"
             return resp
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
-        finally:
-            self.active.unregister(qid)
-            self.qstats.record(q, (end - start) / 1e3,
-                               time.perf_counter() - t0)
         grid = ec.timestamps() / 1e3
         result = []
         for r in rows:
@@ -445,8 +470,41 @@ class PrometheusAPI:
         # to in-flight serving (workpool.MergeGate) for the WHOLE refresh,
         # not just the storage-fetch slice the SearchGate covers
         from ..utils import workpool
-        with workpool.serving():
-            return self._exec_range_cached_serving(ec, q, now_ms)
+        # a flight context per refresh (reuse the HTTP handler's when one
+        # is installed — bench and tests call this directly)
+        fctx = flightrec.get_ctx()
+        fresh_ctx = fctx == 0
+        if fresh_ctx:
+            fctx = flightrec.new_ctx()
+            flightrec.set_ctx(fctx)
+        t0 = time.perf_counter()
+        try:
+            with workpool.serving():
+                return self._exec_range_cached_serving(ec, q, now_ms)
+        finally:
+            dur = time.perf_counter() - t0
+            flightrec.rec("serve:refresh", t0, dur, arg=q[:200])
+            if fresh_ctx:
+                flightrec.clear_ctx()
+            # slow-refresh trigger: freeze the cross-thread timeline that
+            # explains THIS refresh while it is still in the rings
+            th = flightrec.slow_refresh_threshold_ms()
+            if th > 0 and dur * 1e3 > th:
+                cap = flightrec.RECORDER.capture(
+                    "slow_refresh",
+                    meta={"query": q[:500], "refresh_ms": round(dur * 1e3, 2),
+                          "threshold_ms": th, "ctx": fctx},
+                    # only the ring snapshot races the writers; building
+                    # the trace JSON + summary waits for first retrieval
+                    # so the capture cost is not charged to the very
+                    # refresh latency that tripped it (observer effect)
+                    defer_build=True)
+                # note the id only when an outer handler frame exists to
+                # consume it (fresh_ctx means a direct call — bench and
+                # tests — where a leftover note would misattach to the
+                # NEXT slow query this thread happens to serve)
+                if cap is not None and not fresh_ctx:
+                    flightrec.note_capture(cap["id"])
 
     def _exec_range_cached_serving(self, ec, q: str, now_ms: int):
         from ..query.rollup_result_cache import GLOBAL as rcache
@@ -1104,6 +1162,48 @@ class PrometheusAPI:
             "topBySumDuration": tops["sumDuration"],
             "topByAvgDuration": tops["avgDuration"],
         })
+
+    def h_slow_queries(self, req: Request) -> Response:
+        """The slow-query log (vmselect -search.logSlowQueryDuration
+        analog, queryable): per-record duration, per-phase split, and
+        the flight-capture id when the refresh tripped one."""
+        return Response.json({
+            "status": "ok",
+            "thresholdMs": self.slowlog.threshold_ms(),
+            "data": self.slowlog.snapshot(),
+        })
+
+    def h_flight(self, req: Request) -> Response:
+        """Flight-recorder captures.  No args: list capture metadata
+        (newest first).  ``?id=N``: that capture's Chrome trace-event
+        JSON (load it in Perfetto / chrome://tracing).  ``?capture=1``:
+        take an on-demand capture of the live window first."""
+        if not flightrec.enabled():
+            return Response.error(
+                "flight recorder disabled (VM_FLIGHTREC=0)", 503,
+                "unavailable")
+        if req.arg("capture") == "1":
+            cap = flightrec.RECORDER.capture(
+                "on_demand", meta={"source": "http"})
+            return Response.json({
+                "status": "ok", "captured": cap["id"],
+                "data": flightrec.RECORDER.list()})
+        cap_id = req.arg("id")
+        if cap_id:
+            try:
+                cap = flightrec.RECORDER.get(int(cap_id))
+            except ValueError:
+                return Response.error(f"bad capture id {cap_id!r}")
+            if cap is None:
+                return Response.error(f"no capture with id {cap_id} "
+                                      f"(captures are a bounded ring; "
+                                      f"it may have aged out)", 404,
+                                      "not_found")
+            # the bare trace object: saving the response body to a file
+            # makes it directly Perfetto-loadable
+            return Response.json(cap["trace"])
+        return Response.json({"status": "ok",
+                              "data": flightrec.RECORDER.list()})
 
     def _track_usage(self, rows):
         now = fasttime.unix_timestamp()

@@ -1,16 +1,20 @@
 """Per-query runtime stats (reference app/vmselect/promql/active_queries.go
 + lib/querystats): the in-flight query registry behind
-``/api/v1/status/active_queries`` and the last-N query-stats ring behind
-``/api/v1/status/top_queries``.
+``/api/v1/status/active_queries``, the last-N query-stats ring behind
+``/api/v1/status/top_queries``, and the slow-query log behind
+``/api/v1/status/slow_queries`` (the vmselect
+``-search.logSlowQueryDuration`` behavior, kept queryable instead of
+only logged).
 
-Both register themselves with the self-metrics registry
-(``vm_active_queries``, ``vm_search_queries_total``) so ``/metrics``
-sees them too.
+All register themselves with the self-metrics registry
+(``vm_active_queries``, ``vm_search_queries_total``,
+``vm_slow_queries_total``) so ``/metrics`` sees them too.
 """
 
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import weakref
 
@@ -114,3 +118,87 @@ class QueryStats:
         for key, sorter in self._SORTERS.items():
             out[key] = sorted(items, key=sorter)[:n]
         return out
+
+
+def slow_query_threshold_ms() -> float:
+    """``VM_SLOW_QUERY_MS``: queries slower than this are retained in
+    the slow-query log (default 5000, the reference's
+    -search.logSlowQueryDuration=5s; <=0 disables)."""
+    try:
+        return float(os.environ.get("VM_SLOW_QUERY_MS", "5000"))
+    except ValueError:
+        return 5000.0
+
+
+#: spans that CONTAIN other phase spans of the same flight ctx — the
+#: whole refresh and the pool's per-task wrapper.  Reported under
+#: ``containerSpansMs``, not ``phaseSplitMs``.
+_CONTAINER_SPANS = frozenset({"serve:refresh", "pool:task"})
+
+
+class SlowQueryLog:
+    """Bounded ring of the slowest-query evidence: each record carries
+    the query, its window, the measured duration, the PER-PHASE split
+    reassembled from the flight recorder's cross-thread events for that
+    query's context, and — when the refresh tripped a flight capture —
+    the capture id, so ``/api/v1/status/slow_queries`` links straight to
+    the timeline that explains the latency."""
+
+    def __init__(self, max_records: int = 200,
+                 threshold_ms: float | None = None):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=max_records)
+        self._threshold_ms = threshold_ms
+        self._slow_total = metricslib.REGISTRY.counter(
+            "vm_slow_queries_total")
+
+    def threshold_ms(self) -> float:
+        """Pinned at construction when given, else re-read from the env
+        per call (tests and operators flip it without a restart)."""
+        if self._threshold_ms is not None:
+            return self._threshold_ms
+        return slow_query_threshold_ms()
+
+    def maybe_record(self, query: str, start: int, end: int, step: int,
+                     tenant, duration_s: float, ctx: int = 0,
+                     capture_id: int | None = None) -> bool:
+        """Record when duration exceeds the threshold; returns whether it
+        did.  `ctx` is the query's flight context (0 = none): the
+        per-phase split is summed from the ring events carrying it —
+        including spans recorded on pool workers."""
+        th = self.threshold_ms()
+        if th <= 0 or duration_s * 1e3 < th:
+            return False
+        self._slow_total.inc()
+        phases = {}
+        containers = {}
+        if ctx:
+            from ..utils import flightrec
+            for name, sec in sorted(flightrec.phase_split(ctx).items()):
+                # container spans (the whole refresh, the pool's
+                # per-task wrapper) NEST the leaf phases for the same
+                # ctx: kept out of phaseSplitMs so the split holds
+                # disjoint phases that sum to ~wall time instead of
+                # double-counting every contained window
+                if name in _CONTAINER_SPANS:
+                    containers[name] = round(sec * 1e3, 3)
+                else:
+                    phases[name] = round(sec * 1e3, 3)
+        rec = {"query": query, "start": start, "end": end, "step": step,
+               "tenant": f"{tenant[0]}:{tenant[1]}" if tenant else "0:0",
+               "durationSeconds": round(duration_s, 6),
+               "time": fasttime.unix_seconds(),
+               "phaseSplitMs": phases}
+        if containers:
+            rec["containerSpansMs"] = containers
+        if capture_id is not None:
+            rec["flightCaptureId"] = capture_id
+        with self._lock:
+            self._ring.append(rec)
+        return True
+
+    def snapshot(self) -> list[dict]:
+        """Records, newest first."""
+        with self._lock:
+            return list(reversed(self._ring))

@@ -51,6 +51,7 @@ import weakref
 import numpy as np
 
 from ..storage.metric_name import MetricName
+from ..utils import flightrec as _flightrec
 from ..utils import metrics as metricslib
 from .types import EvalConfig, Timeseries
 
@@ -360,6 +361,7 @@ class RollupResultCache:
         cached prefix is one 2D copy; only the (small) fresh suffix is
         touched per series."""
         t0 = _time.perf_counter()
+        kind = "rebuild"
         try:
             # partial results must NEVER be committed: the in-place path
             # mutates the live entry before the caller's put() guard runs,
@@ -373,12 +375,17 @@ class RollupResultCache:
                                            trust_raw, now_ms)
                 if rows is not None:
                     _INPLACE.inc()
+                    kind = "inplace"
                     return rows
             _REBUILD.inc()
             return self._merge_rebuild(hit, fresh, ec, new_start,
                                        trust_raw)
         finally:
-            _MERGE_SECONDS.inc(_time.perf_counter() - t0)
+            now = _time.perf_counter()
+            _MERGE_SECONDS.inc(now - t0)
+            # the inplace-vs-rebuild DECISION on the flight timeline: a
+            # rebuild where inplace was expected is itself a latency clue
+            _flightrec.rec("rcache:" + kind, t0, now - t0)
 
     def _merge_inplace(self, hit: CacheHit, fresh: list[Timeseries],
                        ec: EvalConfig, new_start: int, trust_raw: bool,

@@ -29,7 +29,7 @@ from ..devtools.locktrace import make_lock, make_rlock
 from ..devtools.racetrace import traced_fields
 from ..ops import compress as zstd
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
-from ..utils import logger
+from ..utils import flightrec, logger
 from ..utils import metrics as metricslib
 from ..utils import workpool
 
@@ -328,6 +328,7 @@ class Table:
                 merge_files = len(self._file_parts) > MAX_INMEMORY_PARTS
             _FLUSH_DURATION.update(dt)
             _ING_FLUSH.inc(dt)
+            flightrec.rec("flush:index", t0, dt)
         if merge_files:
             self._merge_file_parts()
 
@@ -358,6 +359,7 @@ class Table:
                 _MERGE_DURATION.update(dt)
                 _ING_MERGE.inc(dt)
                 _MERGES_TOTAL.inc()
+                flightrec.rec("merge:index", t0, dt)
             finally:
                 _ACTIVE_MERGES.dec()
             for old in olds:

@@ -24,7 +24,7 @@ import numpy as np
 
 from ..devtools.locktrace import make_rlock
 from ..devtools.racetrace import traced_fields
-from ..utils import logger
+from ..utils import flightrec, logger
 from ..utils import metrics as metricslib
 from ..utils import workpool
 from .block import MAX_ROWS_PER_BLOCK, Block, rows_to_blocks
@@ -721,6 +721,7 @@ class Partition:
                 dt = time.perf_counter() - t0
             _FLUSH_DURATION.update(dt)
             _ING_FLUSH.inc(dt)
+            flightrec.rec("flush:part", t0, dt, arg=self.name)
             with self._lock:
                 if p is not None:
                     self._file_parts.append(p)
@@ -784,6 +785,7 @@ class Partition:
                 _MERGE_DURATION.update(dt)
                 _ING_MERGE.inc(dt)
                 _MERGES_TOTAL.inc()
+                flightrec.rec("merge:part", t0, dt, arg=self.name)
             finally:
                 _ACTIVE_MERGES.dec()
             with self._lock:

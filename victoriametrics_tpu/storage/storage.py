@@ -21,7 +21,7 @@ from contextlib import contextmanager
 import numpy as np
 
 from ..devtools.locktrace import make_lock, make_rlock
-from ..utils import logger
+from ..utils import flightrec, logger
 from ..utils import metrics as metricslib
 from ..utils import workpool
 from ..utils.workingset import WorkingSetCache
@@ -45,8 +45,11 @@ _PHASE = {
     ph: metricslib.REGISTRY.float_counter(
         f'vm_fetch_phase_seconds_total{{phase="{ph}"}}')
     for ph in ("index_search", "collect", "decode", "assemble",
-               "assemble_native")
+               "assemble_native", "queue_wait")
 }
+# phase="queue_wait" (time queued at the SearchGate before the fetch
+# starts) is INCREMENTED in utils/workpool.SearchGate — listed here so
+# the family is complete at import and the split sums to wall time
 
 # write-path twin of _PHASE: where ingest time goes (the flush/merge
 # phases are fed by partition.py / mergeset.py)
@@ -172,9 +175,11 @@ class _ColumnarSpace:
 
 
 def _phase_lap(phase: str, t0: float) -> float:
-    """Account wall time since t0 to a fetch phase; returns the new t0."""
+    """Account wall time since t0 to a fetch phase (counter + flight
+    event); returns the new t0."""
     now = time.perf_counter()
     _PHASE[phase].inc(now - t0)
+    flightrec.rec("fetch:" + phase, t0, now - t0)
     return now
 
 
@@ -182,6 +187,7 @@ def _ingest_lap(phase: str, t0: float) -> float:
     """Account wall time since t0 to an ingest phase; returns the new t0."""
     now = time.perf_counter()
     _ING_PHASE[phase].inc(now - t0)
+    flightrec.rec("ingest:" + phase, t0, now - t0)
     return now
 
 

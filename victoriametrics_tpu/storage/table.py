@@ -10,7 +10,7 @@ import numpy as np
 
 from ..devtools.locktrace import make_rlock
 from ..devtools.racetrace import traced_fields
-from ..utils import logger
+from ..utils import flightrec, logger
 from .partition import Partition
 
 
@@ -188,13 +188,18 @@ class Table:
     def flush_to_disk(self):
         with self._lock:
             parts = list(self._partitions.values())
-        self._fan_partitions(parts, lambda p: p.flush_to_disk())
+        # the fan span shows the WHOLE flush window on the flight
+        # timeline (per-partition flush:part spans nest inside it on
+        # whichever threads the pool ran them)
+        with flightrec.span("flush:table", arg=len(parts)):
+            self._fan_partitions(parts, lambda p: p.flush_to_disk())
 
     def force_merge(self, deleted_ids=None, min_valid_ts=None):
         with self._lock:
             parts = list(self._partitions.values())
-        self._fan_partitions(
-            parts, lambda p: p.force_merge(deleted_ids, min_valid_ts))
+        with flightrec.span("merge:table", arg=len(parts)):
+            self._fan_partitions(
+                parts, lambda p: p.force_merge(deleted_ids, min_valid_ts))
 
     def snapshot_to(self, dst: str):
         os.makedirs(dst, exist_ok=True)

@@ -302,7 +302,17 @@ class MetricsRegistry:
 
 
 def _process_metrics():
-    """process_* gauges (reference metrics.WriteProcessMetrics)."""
+    """process_* gauges (reference metrics.WriteProcessMetrics) + gc
+    visibility (go_gc_* analog): per-generation collection counts read
+    straight from the collector, so GC can be ruled in/out as a serving
+    latency-variance source from /metrics alone (pause seconds come from
+    the callback below — gc exposes no cumulative pause clock)."""
+    import gc
+    for gen, st in enumerate(gc.get_stats()):
+        yield (f'vm_gc_collections_total{{gen="{gen}"}}',
+               st.get("collections", 0))
+        yield (f'vm_gc_collected_objects_total{{gen="{gen}"}}',
+               st.get("collected", 0))
     yield "process_start_time_seconds", int(_started_at)
     yield ("vm_app_uptime_seconds",
            round(fasttime.unix_seconds() - _started_at, 3))
@@ -336,6 +346,49 @@ def _process_metrics():
 
 
 REGISTRY = MetricsRegistry()
+
+
+# -- gc pause accounting ------------------------------------------------------
+
+_GC_PAUSE = REGISTRY.float_counter("vm_gc_pause_seconds_total")
+_gc_pause_t0 = [0.0]
+
+#: (t0, dur_s, generation) observers invoked after each collection —
+#: utils/flightrec appends one to land gc pauses on the flight timeline
+#: without registering a SECOND gc callback that re-times the same
+#: collection
+gc_pause_hooks: list = []
+
+# bound at import, NOT imported inside the callback: gc callbacks still
+# fire during interpreter shutdown, when `import time` raises
+# "import of time halted"
+from time import perf_counter as _gc_clock  # noqa: E402
+
+
+def _gc_pause_callback(phase: str, info: dict) -> None:
+    # the collecting thread holds the GIL for the whole collection, so
+    # start/stop pair up on one thread and a plain slot is race-free
+    if phase == "start":
+        _gc_pause_t0[0] = _gc_clock()
+    elif phase == "stop" and _gc_pause_t0[0]:
+        t0 = _gc_pause_t0[0]
+        _gc_pause_t0[0] = 0.0
+        dur = _gc_clock() - t0
+        _GC_PAUSE.inc(dur)
+        for hook in gc_pause_hooks:
+            hook(t0, dur, info.get("generation", "?"))
+
+
+def install_gc_metrics() -> None:
+    """Accumulate gc collection pauses into vm_gc_pause_seconds_total
+    (idempotent; installed at import — the counter must cover the whole
+    process lifetime to be comparable with serving latency)."""
+    import gc
+    if _gc_pause_callback not in gc.callbacks:
+        gc.callbacks.append(_gc_pause_callback)
+
+
+install_gc_metrics()
 
 
 def ingest_phase(phase: str) -> FloatCounter:

@@ -9,9 +9,31 @@ timing in one tree.
 
 from __future__ import annotations
 
+import threading
 import time
 
 _enabled_globally = True
+
+# the calling thread's active tracer: set by the serving layer around a
+# query, PROPAGATED to pool workers by utils/workpool around each task —
+# so a span created on a worker attaches to the submitting query's tree
+# instead of silently vanishing (the PR-4/5 threading gap)
+_tls = threading.local()
+
+
+def set_current(tracer) -> "Tracer | _NopTracer":
+    """Install `tracer` as the calling thread's active tracer; returns
+    the previous one (callers restore it in a finally)."""
+    prev = getattr(_tls, "current", NOP)
+    _tls.current = tracer if tracer is not None else NOP
+    return prev
+
+
+def current() -> "Tracer | _NopTracer":
+    """The calling thread's active tracer (NOP when none): worker-side
+    code adds spans via ``querytracer.current().new_child(...)`` without
+    threading a tracer argument through every layer."""
+    return getattr(_tls, "current", NOP)
 
 
 def set_deny_tracing(deny: bool):

@@ -55,9 +55,12 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time as _time
 
 from ..devtools.locktrace import make_lock
+from . import flightrec
 from . import metrics as metricslib
+from . import querytracer
 
 __all__ = ["WorkPool", "Future", "SearchGate", "SearchLimitError",
            "MergeGate", "POOL", "SEARCH_GATE", "MERGE_GATE",
@@ -65,6 +68,13 @@ __all__ = ["WorkPool", "Future", "SearchGate", "SearchLimitError",
            "ingest_parallel_enabled", "serving", "serving_busy"]
 
 _TASKS_TOTAL = metricslib.REGISTRY.counter("vm_workpool_tasks_total")
+
+# time spent QUEUED at the SearchGate before a fetch starts (the fetch
+# phase family lives in storage/storage.py; this member is owned here
+# because the gate is the thing that queues) — with it the phase split
+# sums to contended wall time instead of silently losing the queue wait
+_QUEUE_WAIT = metricslib.REGISTRY.float_counter(
+    'vm_fetch_phase_seconds_total{phase="queue_wait"}')
 
 # whole-refresh serve sections (the HTTP cached range executor wraps each
 # refresh): together with the SearchGate occupancy below this is the
@@ -234,13 +244,30 @@ class WorkPool:
     # -- execution ---------------------------------------------------------
 
     def _exec(self, item) -> None:
-        fn, i, batch = item
+        fn, i, batch, ctx, tracer, t_enq = item
         err = None
+        # cross-thread attribution: the task runs under the SUBMITTING
+        # query's flight context and tracer, so spans created here attach
+        # to that query instead of an anonymous worker (t_enq is None on
+        # the inline path — same thread, context already right)
+        if t_enq is not None:
+            t_run = _time.perf_counter()
+            prev_ctx = flightrec.set_ctx(ctx)
+            prev_tr = querytracer.set_current(tracer)
+            # recorded AFTER set_ctx so the queue wait carries the
+            # submitting query's ctx (it is part of that query's latency)
+            flightrec.rec("pool:queue_wait", t_enq, t_run - t_enq)
         try:
             r = fn()
         except BaseException as e:  # noqa: BLE001 — re-raised in _collect
             err = e
             r = None
+        finally:
+            if t_enq is not None:
+                flightrec.rec("pool:task", t_run,
+                              _time.perf_counter() - t_run)
+                querytracer.set_current(prev_tr)
+                flightrec.set_ctx(prev_ctx)
         with batch.lock:
             batch.results[i] = r
             if err is not None and batch.error is None:
@@ -299,8 +326,11 @@ class WorkPool:
         self._ensure_started(min(w, n))
         batch = _Batch(n)
         _TASKS_TOTAL.inc(n)
+        ctx = flightrec.get_ctx()
+        tr = querytracer.current()
+        t_enq = _time.perf_counter()
         for i, fn in enumerate(fns):
-            self._q.put((fn, i, batch))
+            self._q.put((fn, i, batch, ctx, tr, t_enq))
         return self._collect(batch)
 
     def submit(self, fn) -> Future:
@@ -308,11 +338,12 @@ class WorkPool:
         the pool is disabled) and collect it later via Future.result()."""
         batch = _Batch(1)
         if self.workers() <= 1 or _sched_active():
-            self._exec((fn, 0, batch))
+            self._exec((fn, 0, batch, 0, None, None))
             return Future(self, batch)
         self._ensure_started(1)
         _TASKS_TOTAL.inc()
-        self._q.put((fn, 0, batch))
+        self._q.put((fn, 0, batch, flightrec.get_ctx(),
+                     querytracer.current(), _time.perf_counter()))
         return Future(self, batch)
 
 
@@ -370,7 +401,15 @@ class SearchGate:
     def __enter__(self):
         if not self._sem.acquire(blocking=False):
             self._queued.inc()
-            if not self._sem.acquire(timeout=self.max_queue_s):
+            t0 = _time.perf_counter()
+            ok = self._sem.acquire(timeout=self.max_queue_s)
+            wait = _time.perf_counter() - t0
+            # the previously invisible fetch phase: time QUEUED at the
+            # gate before the search starts — without it the per-phase
+            # split under-reports contended wall time
+            _QUEUE_WAIT.inc(wait)
+            flightrec.rec("fetch:queue_wait", t0, wait)
+            if not ok:
                 self._rejected.inc()
                 raise SearchLimitError(
                     f"couldn't start the search within "
@@ -453,10 +492,11 @@ class MergeGate:
         if budget_ms <= 0 or _sched_active() or not serving_busy():
             return
         self._yields.inc()
-        import time as _t
-        deadline = _t.monotonic() + budget_ms / 1e3
-        while _t.monotonic() < deadline and serving_busy():
-            _t.sleep(0.002)
+        t0 = _time.perf_counter()
+        deadline = _time.monotonic() + budget_ms / 1e3
+        while _time.monotonic() < deadline and serving_busy():
+            _time.sleep(0.002)
+        flightrec.rec("merge:yield", t0, _time.perf_counter() - t0)
 
     @property
     def pending(self) -> int:
@@ -470,11 +510,22 @@ class MergeGate:
 
     def __enter__(self):
         self._maybe_yield()
+        # t0 AFTER the yield: _maybe_yield records its own merge:yield
+        # span, so gate_wait covers only the slot-semaphore wait — the
+        # two flight spans partition the admission delay instead of
+        # double-reporting the same interval
+        t0 = _time.perf_counter()
         self._pending.inc()
         try:
             self._sem.acquire()
         finally:
             self._pending.dec()
+            # slot wait: the gap between a flush/merge being REQUESTED
+            # (serve-priority yield already served) and a worker slot
+            # freeing up
+            wait = _time.perf_counter() - t0
+            if wait > 0.0005:
+                flightrec.rec("merge:gate_wait", t0, wait)
         self._active.inc()
         return self
 
