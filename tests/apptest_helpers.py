@@ -85,13 +85,18 @@ class Client:
 
 
 class AppProc:
-    """Any apps/* module in a subprocess (cluster apptest processes)."""
+    """Any apps/* module in a subprocess (cluster apptest processes).
+    `env` adds/overrides environment variables for the child (chaos
+    tests use it for VM_FAULTS / VM_TENANT_QUOTAS / RPC knobs)."""
 
     def __init__(self, module: str, flags: list, health_port: int,
-                 name: str = ""):
+                 name: str = "", env: dict | None = None):
+        env_overrides = env
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if env_overrides:
+            env.update(env_overrides)
         self.name = name or module
         self.port = health_port
         self.proc = subprocess.Popen(

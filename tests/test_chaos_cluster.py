@@ -1,0 +1,448 @@
+"""Chaos scenario harness over the subprocess cluster apptests
+(ROADMAP item 3, the robustness counterpart of the perf substrate):
+real OS processes, real TCP, real faults — kill/restart a vmstorage
+mid-query, slow-node injection through devtools/faultinject, RF=2
+failover serving identical results, an ingest storm racing force_merge,
+per-tenant QoS isolation under a saturating tenant, and deadline
+propagation (a stalled node costs one query deadline, not a per-hop
+timeout).
+
+Every scenario asserts BOTH liveness (partial/rerouted results within
+the deadline, bounded latency, no wedged requests) and the correctness
+invariants the race harness checks single-node (exact counts/sums and
+result equality across failover).
+
+All tests are ``slow``-marked: tier-1 time is unaffected.  Run them via
+``tools/chaos.sh`` (or ``pytest -m slow tests/test_chaos_cluster.py``).
+"""
+
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from tests.apptest_helpers import AppProc, Client, free_ports
+
+pytestmark = pytest.mark.slow
+
+T0 = 1_753_700_000_000
+
+
+def _metric(port: int, name: str) -> float:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    total = 0.0
+    hit = False
+    for ln in text.splitlines():
+        if ln.startswith(name + " ") or ln.startswith(name + "{"):
+            total += float(ln.split()[-1])
+            hit = True
+    return total if hit else 0.0
+
+
+def _flush(port: int):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/internal/force_flush", timeout=10):
+        pass
+
+
+def _set_faults(port: int, spec: str):
+    q = urllib.parse.urlencode({"set": spec}) if spec else "clear=1"
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/internal/faults?{q}", timeout=10) as r:
+        assert r.status == 200
+
+
+def _pXX(samples, frac=0.99):
+    xs = sorted(samples)
+    return xs[min(int(frac * len(xs)), len(xs) - 1)]
+
+
+def _storage_flags(d, name, hh, ii, ss):
+    return [f"-storageDataPath={d}/{name}",
+            f"-httpListenAddr=127.0.0.1:{hh}",
+            f"-vminsertAddr=127.0.0.1:{ii}",
+            f"-vmselectAddr=127.0.0.1:{ss}"]
+
+
+def _spawn_cluster(d, ports, rf=1, select_extra=(), insert_extra=(),
+                   env=None):
+    (s1h, s1i, s1s, s2h, s2i, s2s, ih, sh) = ports
+    procs = {}
+    procs["st1"] = AppProc("vmstorage",
+                           _storage_flags(d, "s1", s1h, s1i, s1s), s1h,
+                           "vmstorage-1", env=env)
+    procs["st2"] = AppProc("vmstorage",
+                           _storage_flags(d, "s2", s2h, s2i, s2s), s2h,
+                           "vmstorage-2", env=env)
+    nodes = [f"-storageNode=127.0.0.1:{s1i}:{s1s}",
+             f"-storageNode=127.0.0.1:{s2i}:{s2s}"]
+    procs["vi"] = AppProc(
+        "vminsert",
+        nodes + [f"-httpListenAddr=127.0.0.1:{ih}",
+                 f"-replicationFactor={rf}", *insert_extra],
+        ih, "vminsert", env=env)
+    procs["vs"] = AppProc(
+        "vmselect",
+        nodes + [f"-httpListenAddr=127.0.0.1:{sh}", *select_extra],
+        sh, "vmselect", env=env)
+    return procs
+
+
+def _ingest(vi: Client, name: str, n_series: int, n_samples: int = 3,
+            tenant: str = "0"):
+    lines = [f'{name}{{series="{i}"}} {i + k} {T0 + k * 15000}'
+             for i in range(n_series) for k in range(n_samples)]
+    code, body = vi.post(
+        f"/insert/{tenant}/prometheus/api/v1/import/prometheus",
+        "\n".join(lines).encode())
+    assert code == 204, body
+    return lines
+
+
+def _query(vs: Client, q: str, t_s: float, tenant: str = "0"):
+    return vs.get(f"/select/{tenant}/prometheus/api/v1/query",
+                  query=q, time=str(t_s))
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: kill/restart a vmstorage mid-query
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def cluster(tmp_path_factory):
+    d = tmp_path_factory.mktemp("chaos")
+    ports = free_ports(8)
+    procs = _spawn_cluster(d, ports)
+    try:
+        yield {"procs": procs, "ports": ports, "dir": d}
+    finally:
+        for p in procs.values():
+            p.stop(kill=True)
+
+
+def test_kill_restart_vmstorage_mid_query(cluster):
+    """Liveness through a node death and rebirth: a continuous query
+    stream never wedges or errors while st2 is killed mid-flight (some
+    responses go partial), and after a restart the cluster serves the
+    pre-kill complete result again."""
+    procs, ports, d = (cluster["procs"], cluster["ports"], cluster["dir"])
+    vi, vs = Client(procs["vi"].port), Client(procs["vs"].port)
+    _ingest(vi, "ckm", 200)
+    for key in ("st1", "st2"):
+        _flush(procs[key].port)
+    t_s = (T0 + 30000) // 1000
+    code, body = _query(vs, "count(ckm)", t_s)
+    res = json.loads(body)
+    assert code == 200 and res["status"] == "success"
+    full = float(res["data"]["result"][0]["value"][1])
+    assert full == 200.0
+
+    results = []
+    stop = threading.Event()
+
+    def query_loop():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                code, body = _query(vs, "count(ckm)", t_s)
+                res = json.loads(body)
+                results.append((code, res.get("isPartial"),
+                                time.perf_counter() - t0, None))
+            except Exception as e:  # noqa: BLE001 — recorded, asserted below
+                results.append((0, None, time.perf_counter() - t0, e))
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=query_loop) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    procs["st2"].stop(kill=True)      # the kill, mid query-stream
+    time.sleep(2.0)
+    # rebirth on the SAME ports and data path
+    (s1h, s1i, s1s, s2h, s2i, s2s, ih, sh) = ports
+    procs["st2"] = AppProc("vmstorage",
+                           _storage_flags(d, "s2", s2h, s2i, s2s), s2h,
+                           "vmstorage-2-reborn")
+    time.sleep(2.5)                   # node-down cooldown + reconnect
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+
+    # liveness: every query completed, quickly, with an HTTP answer
+    errs = [e for *_, e in results if e is not None]
+    assert not errs, f"queries raised during chaos: {errs[:3]}"
+    assert all(code == 200 for code, *_ in results), \
+        [c for c, *_ in results if c != 200][:5]
+    worst = max(dur for _, _, dur, _ in results)
+    assert worst < 12.0, f"a query took {worst:.1f}s during the kill"
+    # the kill was actually observed (partial responses happened)
+    assert any(p for _, p, _, _ in results), "no partial results seen"
+    # recovery: the reborn node serves its shard again, result complete
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        code, body = _query(vs, "count(ckm)", t_s)
+        res = json.loads(body)
+        if code == 200 and not res.get("isPartial") and \
+                res["data"]["result"] and \
+                float(res["data"]["result"][0]["value"][1]) == full:
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail(f"cluster never recovered the complete result "
+                    f"({body!r})")
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: slow node — deadline propagation, not per-hop timeouts
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def deadline_cluster(tmp_path_factory):
+    """rpc timeout 10s (deliberately long) + 2s query deadline: only
+    deadline propagation can make a stalled node cheap."""
+    d = tmp_path_factory.mktemp("chaos_dl")
+    ports = free_ports(8)
+    procs = _spawn_cluster(
+        d, ports,
+        select_extra=["-rpc.timeout=10.0", "-search.maxQueryDuration=2s"],
+        env={"VM_FAULT_INJECT": "1"})  # opt into the live faults toggle
+    try:
+        yield procs
+    finally:
+        for p in procs.values():
+            p.stop(kill=True)
+
+
+def test_slow_node_costs_one_deadline(deadline_cluster):
+    """The acceptance property: with a stalled vmstorage (fault-injected
+    stall at the RPC seam — TCP-alive, never answers) and a 10s RPC
+    default, the query comes back PARTIAL in ~the 2s query deadline.
+    vm_rpc_deadline_exceeded_total goes loud on the vmselect."""
+    procs = deadline_cluster
+    vi, vs = Client(procs["vi"].port), Client(procs["vs"].port)
+    _ingest(vi, "slm", 120)
+    for key in ("st1", "st2"):
+        _flush(procs[key].port)
+    t_s = (T0 + 30000) // 1000
+    code, body = _query(vs, "count(slm)", t_s)
+    assert code == 200
+    assert float(json.loads(body)["data"]["result"][0]["value"][1]) == 120.0
+
+    _set_faults(procs["st2"].port, "rpc:searchColumns_v1=stall;"
+                                   "rpc:search_v1=stall")
+    try:
+        t0 = time.perf_counter()
+        code, body = _query(vs, "count(slm)", t_s)
+        took = time.perf_counter() - t0
+        res = json.loads(body)
+        assert code == 200, body
+        assert res.get("isPartial") is True
+        n = float(res["data"]["result"][0]["value"][1])
+        assert 0 < n < 120
+        # one deadline (2s) + slack, NOT the 10s per-hop rpc timeout
+        assert took < 7.0, f"stalled node cost {took:.1f}s"
+        assert _metric(procs["vs"].port,
+                       "vm_rpc_deadline_exceeded_total") >= 1
+        # injected faults are observable on the storage node
+        assert _metric(procs["st2"].port,
+                       "vm_fault_injections_total") >= 1
+    finally:
+        _set_faults(procs["st2"].port, "")
+
+
+# ---------------------------------------------------------------------------
+# scenario 3: RF=2 failover serves identical results
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def rf2_cluster(tmp_path_factory):
+    d = tmp_path_factory.mktemp("chaos_rf2")
+    procs = _spawn_cluster(d, free_ports(8), rf=2)
+    try:
+        yield procs
+    finally:
+        for p in procs.values():
+            p.stop(kill=True)
+
+
+def test_rf2_failover_identical_results(rf2_cluster):
+    """With RF=2 over 2 nodes, killing one node changes NOTHING about
+    the data returned: the full instant vector (every series, every
+    value) is byte-identical before and after the kill."""
+    procs = rf2_cluster
+    vi, vs = Client(procs["vi"].port), Client(procs["vs"].port)
+    _ingest(vi, "rfc", 80)
+    for key in ("st1", "st2"):
+        _flush(procs[key].port)
+    t_s = (T0 + 30000) // 1000
+    code, before_body = _query(vs, "rfc", t_s)
+    before = json.loads(before_body)
+    assert code == 200 and len(before["data"]["result"]) == 80
+
+    procs["st2"].stop(kill=True)
+    time.sleep(0.3)
+    t0 = time.perf_counter()
+    code, after_body = _query(vs, "rfc", t_s)
+    took = time.perf_counter() - t0
+    after = json.loads(after_body)
+    assert code == 200
+    assert took < 12.0, f"failover query took {took:.1f}s"
+    # identical results — replication, not luck (isPartial may flip,
+    # the DATA must not)
+    assert after["data"] == before["data"]
+    # also under aggregation
+    code, body = _query(vs, "sum(rfc)", t_s)
+    assert float(json.loads(body)["data"]["result"][0]["value"][1]) == \
+        float(sum(i + 2 for i in range(80)))
+
+
+# ---------------------------------------------------------------------------
+# scenario 4: ingest storm racing force_merge
+# ---------------------------------------------------------------------------
+
+def test_ingest_storm_during_force_merge(cluster):
+    """Liveness + no lost rows: a multi-writer ingest storm runs while
+    both storage nodes are repeatedly force-merged and force-flushed;
+    every write is accepted and the final counts/sums are exact."""
+    procs = cluster["procs"]
+    vi, vs = Client(procs["vi"].port), Client(procs["vs"].port)
+    n_writers, n_batches, n_series = 3, 12, 40
+    codes = []
+    stop = threading.Event()
+
+    def writer(w):
+        for b in range(n_batches):
+            lines = [f'storm{{w="{w}",series="{i}"}} {i} '
+                     f'{T0 + b * 15000}' for i in range(n_series)]
+            code, _ = vi.post(
+                "/insert/0/prometheus/api/v1/import/prometheus",
+                "\n".join(lines).encode())
+            codes.append(code)
+
+    def merger():
+        while not stop.is_set():
+            for key in ("st1", "st2"):
+                try:
+                    for ep in ("force_flush", "force_merge"):
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{procs[key].port}"
+                                f"/internal/{ep}", timeout=30):
+                            pass
+                except OSError:
+                    pass
+            time.sleep(0.05)
+
+    writers = [threading.Thread(target=writer, args=(w,))
+               for w in range(n_writers)]
+    mt = threading.Thread(target=merger)
+    mt.start()
+    t0 = time.perf_counter()
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join(timeout=120)
+    stop.set()
+    mt.join(timeout=30)
+    assert all(c == 204 for c in codes), codes
+    assert time.perf_counter() - t0 < 120
+    for key in ("st1", "st2"):
+        _flush(procs[key].port)
+    # exactness: every series from every writer present, values intact
+    t_s = (T0 + n_batches * 15000) // 1000
+    code, body = _query(vs, "count(storm)", t_s)
+    assert float(json.loads(body)["data"]["result"][0]["value"][1]) == \
+        float(n_writers * n_series)
+    code, body = _query(vs, "sum(storm)", t_s)
+    assert float(json.loads(body)["data"]["result"][0]["value"][1]) == \
+        float(n_writers * sum(range(n_series)))
+
+
+# ---------------------------------------------------------------------------
+# scenario 5: per-tenant QoS — a saturating tenant cannot starve another
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def qos_single(tmp_path_factory):
+    """One vmsingle with tenant quotas armed: tenant 1 capped at 1
+    concurrent search with a 100ms queue budget; tenant 1's searches
+    fault-delayed 250ms INSIDE the gate slot, tenant 2's delayed 60ms
+    (a stable, machine-independent baseline for the p99 ratio)."""
+    d = tmp_path_factory.mktemp("chaos_qos")
+    port = free_ports(1)[0]
+    app = AppProc(
+        "vmsingle",
+        [f"-storageDataPath={d}/data",
+         f"-httpListenAddr=127.0.0.1:{port}"],
+        port, "vmsingle-qos",
+        env={"VM_TENANT_QUOTAS": "1:0=1:100:low",
+             "VM_SEARCH_CONCURRENCY": "4",
+             "VM_FAULTS": "storage:search:1:0=delay:250;"
+                          "storage:search:2:0=delay:60"})
+    try:
+        yield app
+    finally:
+        app.stop(kill=True)
+
+
+def test_tenant_qos_saturating_tenant_sheds_other_tenant_unharmed(
+        qos_single):
+    """The acceptance property: with VM_TENANT_QUOTAS set, a tenant
+    saturating its quota gets 429s (shed load, accounted) while a
+    second tenant's p99 stays within 2x its unloaded p99."""
+    c = Client(qos_single.port)
+    for tenant, name in (("1:0", "tm1"), ("2:0", "tm2")):
+        _ingest(c, name, 8, tenant=tenant)
+    t_s = (T0 + 30000) // 1000
+
+    def one_query(tenant, name, i):
+        t0 = time.perf_counter()
+        code, body = c.get(
+            f"/select/{tenant}/prometheus/api/v1/query",
+            query=f"count({name})", time=str(t_s + i))
+        return code, time.perf_counter() - t0
+
+    # unloaded baseline for tenant 2
+    unloaded = [one_query("2:0", "tm2", i)[1] for i in range(25)]
+    p99_unloaded = _pXX(unloaded)
+
+    # tenant 1 storm: 3 threads hammering a quota of 1
+    stop = threading.Event()
+    t1_codes = []
+
+    def storm():
+        i = 1000
+        while not stop.is_set():
+            code, _ = one_query("1:0", "tm1", i)
+            t1_codes.append(code)
+            i += 1
+
+    storm_threads = [threading.Thread(target=storm) for _ in range(3)]
+    for t in storm_threads:
+        t.start()
+    time.sleep(0.5)
+    try:
+        loaded = [one_query("2:0", "tm2", 500 + i)[1] for i in range(25)]
+    finally:
+        stop.set()
+        for t in storm_threads:
+            t.join(timeout=30)
+    p99_loaded = _pXX(loaded)
+
+    # the saturating tenant was shed with 429s, and kept partial service
+    assert t1_codes.count(429) > 0, f"no shed load: {t1_codes[:20]}"
+    assert t1_codes.count(200) > 0, "tenant 1 was starved outright"
+    assert set(t1_codes) <= {200, 429}, set(t1_codes)
+    # rejection accounting is visible like the ingest limiter's
+    assert _metric(qos_single.port,
+                   'vm_tenant_search_rejected_total{tenant="1:0"}') > 0
+    assert _metric(qos_single.port,
+                   'vm_tenant_search_requests_total{tenant="2:0"}') > 0
+    # isolation: tenant 2's p99 within 2x its unloaded p99
+    assert p99_loaded <= 2 * p99_unloaded, \
+        (f"tenant 2 starved: p99 loaded {p99_loaded * 1e3:.0f}ms vs "
+         f"unloaded {p99_unloaded * 1e3:.0f}ms")

@@ -1,0 +1,707 @@
+"""Per-tenant QoS admission (utils/workpool.TenantGate), deadline-
+propagating RPC, and the shed-load / partial-result HTTP surfaces.
+
+The fast half of the robustness suite (tier-1): quota parsing, admission
+semantics, priority classes, the race-marked TenantGate stress under the
+deterministic scheduler, RPC deadline/backoff behavior against real
+in-process RPC servers, and the killed-node regression (partial=True
+with the surviving node's exact rows).  The process-level chaos
+scenarios live in tests/test_chaos_cluster.py (slow-marked).
+"""
+
+import json
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.devtools import faultinject, racetrace
+from victoriametrics_tpu.devtools.sched import DeterministicScheduler
+from victoriametrics_tpu.parallel.cluster_api import (ClusterStorage,
+                                                      make_storage_handlers)
+from victoriametrics_tpu.parallel.rpc import (HELLO_INSERT, HELLO_SELECT,
+                                              RPCClient, RPCDeadlineError,
+                                              RPCError, RPCServer, Writer)
+from victoriametrics_tpu.utils import workpool
+from victoriametrics_tpu.utils.workpool import (SearchLimitError,
+                                                TenantGate, TenantQuota,
+                                                parse_tenant_quotas)
+
+T0 = 1_753_700_000_000
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faultinject.configure("")
+
+
+# ---------------------------------------------------------------------------
+# quota parsing
+# ---------------------------------------------------------------------------
+
+class TestQuotaParsing:
+    def test_full_grammar(self):
+        q = parse_tenant_quotas("0:0=8:5000:high;7=2:100:low;*=4")
+        assert q[(0, 0)].limit == 8
+        assert q[(0, 0)].queue_ms == 5000.0
+        assert q[(0, 0)].priority == "high"
+        assert q[(7, 0)].limit == 2 and q[(7, 0)].rank == 2
+        assert q["*"].limit == 4
+        assert q["*"].queue_ms is None  # inherits the gate default
+        assert q["*"].priority == "normal"
+
+    def test_malformed_entries_dropped_not_fatal(self):
+        q = parse_tenant_quotas("1:0=2;garbage;x=y;3:0=nope;4:0=1:bad")
+        assert list(q) == [(1, 0)]
+
+    def test_negative_limit_dropped(self):
+        # a negative cap would make the tenant permanently inadmissible
+        assert parse_tenant_quotas("7=-1") == {}
+
+    def test_empty_means_no_quotas(self):
+        assert parse_tenant_quotas("") == {}
+
+    def test_gate_rereads_env(self, monkeypatch):
+        g = TenantGate(limit=4)
+        monkeypatch.setenv("VM_TENANT_QUOTAS", "5:0=3")
+        assert g.quota_for((5, 0)).limit == 3
+        monkeypatch.setenv("VM_TENANT_QUOTAS", "5:0=1")
+        assert g.quota_for((5, 0)).limit == 1
+        monkeypatch.delenv("VM_TENANT_QUOTAS")
+        assert g.quota_for((5, 0)).limit == 0  # back to global-only
+
+
+# ---------------------------------------------------------------------------
+# admission semantics
+# ---------------------------------------------------------------------------
+
+class TestTenantGate:
+    def test_default_behaves_like_global_gate(self):
+        g = TenantGate(limit=2, max_queue_ms=50, quotas={})
+        with g.admit((1, 0)), g.admit((2, 0)):
+            assert g.occupancy()[0] == 2
+            t0 = time.perf_counter()
+            with pytest.raises(SearchLimitError):
+                with g.admit((3, 0)):
+                    pass
+            assert time.perf_counter() - t0 < 2.0
+        assert g.occupancy() == (0, {})
+
+    def test_tenant_quota_isolates(self):
+        g = TenantGate(limit=4, max_queue_ms=5000,
+                       quotas={(1, 0): TenantQuota(1, queue_ms=60)})
+        with g.admit((1, 0)):
+            # tenant 1 is at ITS cap: rejected within its queue budget
+            t0 = time.perf_counter()
+            with pytest.raises(SearchLimitError) as ei:
+                with g.admit((1, 0)):
+                    pass
+            assert time.perf_counter() - t0 < 2.0
+            assert "tenant quota" in str(ei.value)
+            # other tenants sail through the remaining global capacity
+            with g.admit((2, 0)), g.admit((2, 0)), g.admit((2, 0)):
+                assert g.occupancy()[0] == 4
+
+    def test_release_grants_queued_waiter(self):
+        g = TenantGate(limit=1, max_queue_ms=5000, quotas={})
+        got = []
+
+        with g.admit((1, 0)):
+            t = threading.Thread(
+                target=lambda: got.append(g.admit((2, 0)).__enter__()))
+            t.start()
+            time.sleep(0.1)
+            assert not got  # queued behind the held slot
+        t.join(timeout=5)
+        assert got  # released slot was handed over
+        g._release((2, 0))
+        assert g.occupancy() == (0, {})
+
+    def test_priority_classes_order_grants(self):
+        """When capacity frees up, a queued high-priority request is
+        granted before an earlier-arrived low-priority one."""
+        g = TenantGate(limit=1, max_queue_ms=5000,
+                       quotas={(1, 0): TenantQuota(0, priority="low"),
+                               (2, 0): TenantQuota(0, priority="high")})
+        order = []
+        threads = []
+
+        def worker(tenant, tag):
+            with g.admit(tenant):
+                order.append(tag)
+                time.sleep(0.05)
+
+        with g.admit((9, 9)):  # hold the only slot
+            for tenant, tag in (((1, 0), "low"), ((2, 0), "high")):
+                t = threading.Thread(target=worker, args=(tenant, tag))
+                t.start()
+                threads.append(t)
+                time.sleep(0.1)  # deterministic arrival order: low first
+        for t in threads:
+            t.join(timeout=5)
+        assert order == ["high", "low"]
+
+    def test_quota_capped_waiter_does_not_block_other_tenants(self):
+        """A waiter blocked only by its OWN tenant quota must not
+        head-of-line block later waiters of other tenants."""
+        g = TenantGate(limit=2, max_queue_ms=3000,
+                       quotas={(1, 0): TenantQuota(1)})
+        passed = []
+        with g.admit((1, 0)):  # tenant 1 at quota, one global slot free
+            blocked = threading.Thread(
+                target=lambda: passed.append(("t1", g.admit(
+                    (1, 0)).__enter__())))
+            blocked.daemon = True
+            blocked.start()
+            time.sleep(0.1)
+            # tenant 2 must be admitted despite tenant 1 queued ahead
+            t0 = time.perf_counter()
+            with g.admit((2, 0)):
+                assert time.perf_counter() - t0 < 1.0
+        blocked.join(timeout=5)  # tenant 1's waiter gets the freed slot
+        assert passed, "queued tenant-1 waiter never admitted"
+        g._release((1, 0))
+        assert g.occupancy() == (0, {})
+
+    def test_tenant_metric_cardinality_bounded(self):
+        """Tenant ids come from the URL path: iterating ids must fold
+        past the cap into one shared 'other' label set without growing
+        the memo per tenant."""
+        g = TenantGate(limit=4, quotas={})
+        g._MAX_TENANT_METRICS = 3
+        for i in range(10):
+            with g.admit((i, 0)):
+                pass
+        # 3 real tenants x 2 metric names + 1 shared "other" per name
+        assert len(g._tenant_label_seen) == 3
+        names = {k for k in g._tenant_metric_memo}
+        other_keys = [k for k in names if k[1] == "other"]
+        per_tenant_keys = [k for k in names if k[1] != "other"]
+        assert {t for _, t in per_tenant_keys} == {(0, 0), (1, 0), (2, 0)}
+        assert other_keys  # folded tenants share these
+        # folding is sticky per tenant: repeat admits add no new keys
+        before = len(g._tenant_metric_memo)
+        with g.admit((9, 0)):
+            pass
+        assert len(g._tenant_metric_memo) == before
+
+    def test_concurrent_metrics_and_rejection_counters(self):
+        from victoriametrics_tpu.utils import metrics as metricslib
+        g = TenantGate(limit=1, max_queue_ms=30,
+                       quotas={(8, 1): TenantQuota(1, queue_ms=30)})
+        with g.admit((8, 1)):
+            with pytest.raises(SearchLimitError):
+                with g.admit((8, 1)):
+                    pass
+        text = metricslib.REGISTRY.write_prometheus()
+        assert 'vm_tenant_search_requests_total{tenant="8:1"}' in text
+        assert 'vm_tenant_search_rejected_total{tenant="8:1"}' in text
+
+
+# ---------------------------------------------------------------------------
+# race-marked stress: quota never exceeded, starvation-free
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def race_on():
+    was = racetrace.enabled()
+    racetrace.enable()
+    racetrace.reset()
+    yield
+    if not was:
+        racetrace.disable()
+
+
+@pytest.mark.race
+class TestTenantGateRace:
+    def _stress(self, seed):
+        racetrace.reset()
+        sched = DeterministicScheduler(seed=seed, change_prob=0.2,
+                                       step_timeout=2.0)
+        gate = TenantGate(limit=2, max_queue_ms=60_000,
+                          quotas={(1, 0): TenantQuota(1),
+                                  (2, 0): TenantQuota(1)})
+        peak = {"global": 0, (1, 0): 0, (2, 0): 0}
+        done = []
+        lk = threading.Lock()
+
+        def worker(tenant, tag):
+            for _ in range(3):
+                with gate.admit(tenant):
+                    g, per = gate.occupancy()
+                    with lk:
+                        peak["global"] = max(peak["global"], g)
+                        peak[tenant] = max(peak[tenant],
+                                           per.get(tenant, 0))
+            done.append(tag)
+
+        for i in range(2):
+            sched.spawn(f"a{i}", worker, (1, 0), f"a{i}")
+            sched.spawn(f"b{i}", worker, (2, 0), f"b{i}")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            sched.run(timeout=60)
+        return peak, sorted(done), racetrace.reports()
+
+    def test_quota_never_exceeded_and_starvation_free(self, race_on):
+        """Under seeded adversarial interleavings: the per-tenant caps
+        and the global cap hold at every observation point, every
+        worker of both tenants completes (starvation-freedom), and the
+        sanitizer sees no races on the gate's shared state."""
+        peak, done, reports = self._stress(31337)
+        assert peak["global"] <= 2
+        assert peak[(1, 0)] <= 1
+        assert peak[(2, 0)] <= 1
+        assert done == ["a0", "a1", "b0", "b1"]
+        gate_races = [r for r in reports if "TenantGate" in str(r.field)]
+        assert not gate_races, gate_races
+
+    def test_same_seed_same_outcome(self, race_on):
+        assert self._stress(99)[:2] == self._stress(99)[:2]
+
+
+# ---------------------------------------------------------------------------
+# RPC deadline propagation + killed-node regression (in-process cluster)
+# ---------------------------------------------------------------------------
+
+class _Node:
+    """In-process vmstorage: Storage + real TCP RPC servers."""
+
+    def __init__(self, path):
+        from victoriametrics_tpu.storage.storage import Storage
+        self.storage = Storage(str(path))
+        handlers = make_storage_handlers(self.storage)
+        self.insert_srv = RPCServer("127.0.0.1", 0, HELLO_INSERT, handlers)
+        self.select_srv = RPCServer("127.0.0.1", 0, HELLO_SELECT, handlers)
+        self.insert_srv.start()
+        self.select_srv.start()
+
+    def client(self, timeout=10.0):
+        from victoriametrics_tpu.parallel.cluster_api import \
+            StorageNodeClient
+        return StorageNodeClient("127.0.0.1", self.insert_srv.port,
+                                 self.select_srv.port, timeout=timeout)
+
+    def stop(self):
+        self.insert_srv.stop()
+        self.select_srv.stop()
+        self.storage.close()
+
+
+@pytest.fixture()
+def two_nodes(tmp_path):
+    nodes = [_Node(tmp_path / "n0"), _Node(tmp_path / "n1")]
+    yield nodes
+    for n in nodes:
+        try:
+            n.stop()
+        except Exception:
+            pass
+
+
+def _seed(cluster, n_series=24):
+    rows = [({"__name__": "tg", "idx": str(i)}, T0 + j * 15_000,
+             float(i * 10 + j)) for i in range(n_series) for j in range(4)]
+    cluster.add_rows(rows)
+    return rows
+
+
+def _filters():
+    from victoriametrics_tpu.storage.tag_filters import filters_from_dict
+    return filters_from_dict({"__name__": "tg"})
+
+
+class TestDeadlineRPC:
+    def test_stalled_node_costs_one_deadline_not_timeout(self, two_nodes):
+        """The acceptance property: with a 0.6s query deadline and a
+        10s RPC default timeout, a stalled storage node costs the query
+        ~its deadline — not the 10s per-hop default — and the surviving
+        node's rows come back partial."""
+        cluster = ClusterStorage([n.client(timeout=10.0)
+                                  for n in two_nodes])
+        _seed(cluster)
+        cluster.reset_partial()
+        full = cluster.search_columns(_filters(), T0, T0 + 60_000)
+        assert full.n_series == 24 and not cluster.last_partial
+        # node 1's select plane replaced by a handshake-then-hang server
+        # (the SIGSTOP shape: TCP-alive, never answers)
+        stalled = _StallWrapper(two_nodes[1])
+        try:
+            cluster2 = ClusterStorage([two_nodes[0].client(timeout=10.0),
+                                       stalled.client(timeout=10.0)])
+            cluster2.reset_partial()
+            t0 = time.perf_counter()
+            cols = cluster2.search_columns(
+                _filters(), T0, T0 + 60_000,
+                deadline=time.monotonic() + 0.6)
+            took = time.perf_counter() - t0
+            assert took < 5.0, f"stall cost {took:.1f}s (per-hop timeout?)"
+            assert cluster2.last_partial
+            assert 0 < cols.n_series < 24
+        finally:
+            stalled.stop()
+
+    def test_killed_node_partial_with_surviving_exact_rows(self,
+                                                           two_nodes):
+        """Killed node mid-life: the scatter-gather yields partial=True
+        and EXACTLY the surviving node's rows (same names, timestamps
+        and values as querying that node directly)."""
+        cluster = ClusterStorage([n.client() for n in two_nodes])
+        _seed(cluster)
+        cluster.reset_partial()
+        before = cluster.search_columns(_filters(), T0, T0 + 60_000)
+        assert before.n_series == 24
+        # the surviving node's own truth, fetched before the kill
+        survivor = two_nodes[0].storage.search_columns(
+            _filters(), T0, T0 + 60_000)
+        two_nodes[1].stop()
+        # an in-process server stop leaves established connections alive
+        # (daemon handler threads); sever them like the process death
+        # would, so the next call must re-dial the closed listener
+        cluster.nodes[1].close()
+        cluster.reset_partial()
+        cols = cluster.search_columns(_filters(), T0, T0 + 60_000)
+        assert cluster.last_partial is True
+        assert cols.raw_names == survivor.raw_names
+        np.testing.assert_array_equal(cols.counts, survivor.counts)
+        sel = np.arange(cols.ts.shape[1])[None, :] < cols.counts[:, None]
+        sel2 = np.arange(survivor.ts.shape[1])[None, :] < \
+            survivor.counts[:, None]
+        np.testing.assert_array_equal(cols.ts[sel], survivor.ts[sel2])
+        np.testing.assert_array_equal(cols.vals[sel], survivor.vals[sel2])
+
+    def test_dripping_stream_costs_one_deadline(self):
+        """A degraded node emitting each streamed frame just inside the
+        per-op timeout must still cost at most ONE deadline: the client
+        re-checks the budget between frames (and tears the connection
+        down so the half-read stream can't poison the next pooled
+        call)."""
+        def h_drip(r):
+            from victoriametrics_tpu.parallel.rpc import Writer as W
+            for i in range(50):
+                time.sleep(0.12)
+                yield W().u64(i)
+        srv = RPCServer("127.0.0.1", 0, HELLO_SELECT,
+                        {"drip_v1": h_drip})
+        srv.start()
+        try:
+            c = RPCClient("127.0.0.1", srv.port, HELLO_SELECT,
+                          timeout=10.0)
+            t0 = time.perf_counter()
+            with pytest.raises(RPCDeadlineError):
+                c.call_stream("drip_v1", Writer(),
+                              deadline=time.monotonic() + 0.4)
+            took = time.perf_counter() - t0
+            assert took < 2.0, f"dripping stream ran {took:.1f}s"
+        finally:
+            srv.stop()
+
+    def test_connect_respects_deadline_on_dead_port(self):
+        """Connection establishment against a dead/blackholed peer is
+        bounded by the caller's deadline, not the constructor timeout."""
+        import socket as _socket
+        # a bound-but-unaccepting listener: connects hang in the backlog
+        lst = _socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(0)
+        port = lst.getsockname()[1]
+        # saturate the backlog so further connects block
+        burners = []
+        for _ in range(64):
+            s = _socket.socket()
+            s.setblocking(False)
+            try:
+                s.connect_ex(("127.0.0.1", port))
+            except OSError:
+                pass
+            burners.append(s)
+        try:
+            c = RPCClient("127.0.0.1", port, HELLO_SELECT, timeout=30.0)
+            t0 = time.perf_counter()
+            with pytest.raises((RPCError, OSError)):
+                c.call("x_v1", Writer(),
+                       deadline=time.monotonic() + 0.4)
+            assert time.perf_counter() - t0 < 5.0
+        finally:
+            for s in burners:
+                s.close()
+            lst.close()
+
+    def test_select_connections_do_not_serialize(self, two_nodes):
+        """The select plane pools connections (RPCClientPool): two
+        concurrent 400ms searches against ONE node must overlap instead
+        of queueing on a single TCP connection (which would also hide
+        concurrent load from the node-side TenantGate)."""
+        client = two_nodes[0].client()
+        seeded = ClusterStorage([n.client() for n in two_nodes])
+        _seed(seeded)
+        faultinject.configure("rpc:searchColumns_v1=delay:400")
+        done = []
+
+        def one():
+            t0 = time.perf_counter()
+            client.search_columns(_filters(), T0, T0 + 60_000)
+            done.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=one) for _ in range(2)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        wall = time.perf_counter() - t0
+        faultinject.configure("")
+        assert len(done) == 2
+        # serialized would be >= 800ms; pooled overlaps in ~400ms
+        assert wall < 0.7, f"concurrent selects serialized: {wall:.2f}s"
+
+    def test_shed_load_crosses_rpc_boundary_as_itself(self, two_nodes,
+                                                      monkeypatch):
+        """A remote TenantGate rejection must arrive at the vmselect
+        side AS a SearchLimitError (→ 429 for that tenant only), not as
+        a generic node failure that would mark the healthy node down
+        and serve every other tenant partial results."""
+        monkeypatch.setenv("VM_TENANT_QUOTAS", "9:0=1:50")
+        seeded = ClusterStorage([n.client() for n in two_nodes])
+        _seed(seeded)
+        # single-node cluster: the in-process "nodes" share one
+        # process-global gate, so the holder takes tenant 9's slot
+        # directly through the storage engine and the probe goes over
+        # the wire against one node
+        cluster = ClusterStorage([two_nodes[0].client()])
+        faultinject.configure("storage:search:9:0=delay:600")
+        holder = threading.Thread(
+            target=lambda: two_nodes[0].storage.search_columns(
+                _filters(), T0, T0 + 60_000, tenant=(9, 0)))
+        holder.start()
+        time.sleep(0.2)
+        from victoriametrics_tpu.utils import metrics as metricslib
+        errs = metricslib.REGISTRY.counter(
+            'vm_rpc_server_errors_total{method="searchColumns_v1"}')
+        errs_before = errs.get()
+        with pytest.raises(SearchLimitError):
+            cluster.search_columns(_filters(), T0, T0 + 60_000,
+                                   tenant=(9, 0))
+        holder.join(timeout=10)
+        faultinject.configure("")
+        # shed load is by design: it must not read as a server ERROR
+        # (own counter vm_rpc_server_shed_total instead)
+        assert errs.get() == errs_before
+        # the node was never at fault: still healthy, and another
+        # tenant's query through it is complete, not partial
+        assert all(n.healthy for n in cluster.nodes)
+        cluster.reset_partial()
+        cols = cluster.search_columns(_filters(), T0, T0 + 60_000)
+        assert cols.n_series > 0 and not cluster.last_partial
+
+    def test_exhausted_deadline_does_not_mark_nodes_down(self,
+                                                         two_nodes):
+        """A query whose budget was gone before any I/O is the QUERY's
+        failure: it errors, but must not poison node health for the
+        next 2s of other queries."""
+        cluster = ClusterStorage([n.client() for n in two_nodes])
+        _seed(cluster)
+        with pytest.raises(RPCError):
+            cluster.search_columns(_filters(), T0, T0 + 60_000,
+                                   deadline=time.monotonic() - 1.0)
+        assert all(n.healthy for n in cluster.nodes)
+        cluster.reset_partial()
+        cols = cluster.search_columns(_filters(), T0, T0 + 60_000)
+        assert cols.n_series == 24 and not cluster.last_partial
+
+    def test_backoff_retry_recovers_from_resets(self, two_nodes,
+                                                monkeypatch):
+        """The bounded-backoff reconnect path: with injected connection
+        resets at 30%, calls still succeed (within the retry budget)
+        and vm_rpc_retries_total advances."""
+        from victoriametrics_tpu.utils import metrics as metricslib
+        monkeypatch.setenv("VM_RPC_RETRIES", "4")
+        monkeypatch.setenv("VM_RPC_BACKOFF_MS", "5")
+        cluster = ClusterStorage([n.client() for n in two_nodes])
+        _seed(cluster)
+        retries = metricslib.REGISTRY.counter("vm_rpc_retries_total")
+        before = retries.get()
+        faultinject.configure("rpc:searchColumns_v1=reset::0.3")
+        ok = 0
+        for _ in range(10):
+            cluster.reset_partial()
+            try:
+                cols = cluster.search_columns(_filters(), T0, T0 + 60_000)
+                ok += cols.n_series == 24 and not cluster.last_partial
+            except RPCError:
+                pass
+        faultinject.configure("")
+        assert ok >= 7, f"only {ok}/10 full results under 30% resets"
+        assert retries.get() > before
+
+
+class _StallWrapper:
+    """A fake storage node whose select server accepts the handshake
+    and then never answers any call (the SIGSTOP shape, in-process)."""
+
+    def __init__(self, real_node):
+        def h_stall(r):
+            time.sleep(300)
+        handlers = {m: h_stall for m in
+                    ("searchColumns_v1", "search_v1")}
+        self.select_srv = RPCServer("127.0.0.1", 0, HELLO_SELECT, handlers)
+        self.select_srv.start()
+        self.insert_port = real_node.insert_srv.port
+
+    def client(self, timeout=10.0):
+        from victoriametrics_tpu.parallel.cluster_api import \
+            StorageNodeClient
+        return StorageNodeClient("127.0.0.1", self.insert_port,
+                                 self.select_srv.port, timeout=timeout)
+
+    def stop(self):
+        self.select_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces: 429 shed load, deny_partial 503, slow-log linkage
+# ---------------------------------------------------------------------------
+
+class _ShedStorage:
+    """Stub storage whose every search is shed by the gate."""
+
+    last_partial = False
+
+    def search_columns(self, *a, **kw):
+        raise SearchLimitError("couldn't start the search: test shed")
+
+    def search_series(self, *a, **kw):
+        raise SearchLimitError("couldn't start the search: test shed")
+
+    def metrics(self):
+        return {}
+
+
+class _PartialStorage:
+    """Stub storage returning an empty-but-partial scatter-gather."""
+
+    last_partial = True
+
+    def reset_partial(self):
+        # sticky: simulates a fanout that keeps seeing a dead node
+        self.last_partial = True
+
+    def search_columns(self, *a, **kw):
+        from victoriametrics_tpu.storage.columnar import ColumnarSeries
+        return ColumnarSeries.empty()
+
+    def search_series(self, *a, **kw):
+        return []
+
+    def metrics(self):
+        return {}
+
+
+def _api(storage):
+    from victoriametrics_tpu.httpapi.prometheus_api import PrometheusAPI
+    from victoriametrics_tpu.httpapi.server import HTTPServer
+    srv = HTTPServer("127.0.0.1", 0)
+    api = PrometheusAPI(storage)
+    api.register(srv, mode="all")
+    srv.start()
+    return srv, api
+
+
+class TestShedLoadHTTP:
+    def test_gate_rejection_is_429_with_retry_after(self):
+        from tests.apptest_helpers import Client
+        srv, api = _api(_ShedStorage())
+        try:
+            c = Client(srv.port)
+            code, body = c.get("/api/v1/query", query="up",
+                               time=str(T0 // 1000))
+            assert code == 429
+            res = json.loads(body)
+            assert res["errorType"] == "too_many_requests"
+            # rejected queries are linked into the slow-query log
+            code, body = c.get("/api/v1/status/slow_queries")
+            recs = json.loads(body)["data"]
+            assert any(r.get("rejected") and r["query"] == "up"
+                       for r in recs), recs
+        finally:
+            srv.stop()
+
+    def test_faults_endpoint_is_opt_in(self, monkeypatch):
+        """/internal/faults must not let an unauthenticated client
+        stall a production process: 403 unless the process opted into
+        chaos (VM_FAULT_INJECT=1 / VM_FAULTS)."""
+        from tests.apptest_helpers import Client
+        monkeypatch.delenv("VM_FAULT_INJECT", raising=False)
+        srv, api = _api(_PartialStorage())
+        try:
+            c = Client(srv.port)
+            code, _ = c.get("/internal/faults", set="rpc:*=stall")
+            assert code == 403
+            assert not faultinject.active()
+            monkeypatch.setenv("VM_FAULT_INJECT", "1")
+            code, body = c.get("/internal/faults",
+                               set="rpc:x_v1=delay:5")
+            assert code == 200
+            assert json.loads(body)["faults"] == "rpc:x_v1=delay:5"
+            code, _ = c.get("/internal/faults", clear="1")
+            assert code == 200 and not faultinject.active()
+        finally:
+            srv.stop()
+
+    def test_rejection_visible_in_flight_capture(self):
+        """The gate:rejected instant lands in the flight ring, so an
+        on-demand capture explains shed load at /status/flight."""
+        from victoriametrics_tpu.utils import flightrec
+        if not flightrec.enabled():
+            pytest.skip("flight recorder disabled")
+        gate = TenantGate(limit=1, max_queue_ms=20, quotas={})
+        with gate.admit((0, 0)):
+            with pytest.raises(SearchLimitError):
+                with gate.admit((0, 0)):
+                    pass
+        cap = flightrec.RECORDER.capture("test_shed")
+        events = [e for e in cap["trace"]["traceEvents"]
+                  if e.get("name") == "gate:rejected"]
+        assert events, "gate:rejected instant missing from capture"
+
+
+class TestDenyPartial:
+    def test_partial_counts_and_deny_flag_503(self, monkeypatch):
+        from tests.apptest_helpers import Client
+        from victoriametrics_tpu.utils import metrics as metricslib
+        ctr = metricslib.REGISTRY.counter("vm_partial_results_total")
+        srv, api = _api(_PartialStorage())
+        try:
+            c = Client(srv.port)
+            before = ctr.get()
+            # default: partial served as isPartial=true 200
+            code, body = c.get("/api/v1/query", query="up",
+                               time=str(T0 // 1000))
+            assert code == 200
+            assert json.loads(body)["isPartial"] is True
+            assert ctr.get() == before + 1
+            # request flag: partial becomes a 503
+            code, body = c.get("/api/v1/query", query="up",
+                               time=str(T0 // 1000), deny_partial="1")
+            assert code == 503
+            assert json.loads(body)["errorType"] == "unavailable"
+            # env default, overridable per request
+            monkeypatch.setenv("VM_DENY_PARTIAL_RESPONSE", "1")
+            code, _ = c.get("/api/v1/query", query="up",
+                            time=str(T0 // 1000))
+            assert code == 503
+            code, _ = c.get("/api/v1/query", query="up",
+                            time=str(T0 // 1000), deny_partial="0")
+            assert code == 200
+            # query_range path too
+            monkeypatch.delenv("VM_DENY_PARTIAL_RESPONSE")
+            code, body = c.get("/api/v1/query_range", query="up",
+                               start=str(T0 // 1000),
+                               end=str(T0 // 1000 + 600), step="15",
+                               deny_partial="1", nocache="1")
+            assert code == 503
+        finally:
+            srv.stop()

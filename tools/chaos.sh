@@ -1,0 +1,33 @@
+#!/bin/sh
+# Chaos-suite entry point (ROADMAP item 3): runs the slow-marked
+# process-level chaos scenarios in tests/test_chaos_cluster.py —
+# kill/restart vmstorage mid-query, slow-node injection (fault-injected
+# RPC stalls), RF=2 failover byte-equality, an ingest storm racing
+# force_merge, per-tenant QoS isolation under a saturating tenant, and
+# deadline propagation (a stalled node costs one query deadline).
+#
+# The scenarios spawn real vmstorage/vminsert/vmselect/vmsingle OS
+# processes; faults are armed per node via each process's
+# /internal/faults endpoint or the VM_FAULTS env var
+# (devtools/faultinject.py — delay/stall/error/reset at the RPC server
+# and storage-search seams).
+#
+# These tests are `slow`-marked, so tier-1 (`-m 'not slow'`) never pays
+# for them; this script opts back in.  The fast halves of the same
+# machinery (TenantGate admission semantics, the race-marked stress
+# under the deterministic scheduler, in-process RPC deadline tests) run
+# in tier-1 via tests/test_tenant_gate.py and under tools/race.sh.
+#
+# Knobs (see README "Multi-tenant QoS & chaos testing"):
+#   VM_TENANT_QUOTAS   per-tenant concurrency/queue/priority quotas
+#   VM_FAULTS          fault table armed at process start
+#   VM_RPC_RETRIES / VM_RPC_BACKOFF_MS / VM_RPC_BACKOFF_MAX_MS
+#
+# Extra args pass through to pytest, e.g.:
+#   tools/chaos.sh -k qos
+#   tools/chaos.sh -k deadline -x
+set -eu
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pytest tests/test_chaos_cluster.py -q -m slow \
+    -p no:cacheprovider "$@"

@@ -45,6 +45,14 @@
 # VM_FLIGHTREC=0 is the escape hatch when bisecting (also disables the
 # pool's ctx-propagation records around each task).
 #
+# The per-tenant admission gate (utils/workpool.TenantGate) is covered
+# by the race-marked stress in tests/test_tenant_gate.py: two tenants'
+# workers under the deterministic scheduler, asserting the per-tenant
+# and global caps hold at every observation point, every worker
+# completes (starvation-freedom), and the same seed replays the same
+# outcome.  VM_TENANT_QUOTAS= (unset) restores the plain global gate
+# when bisecting an admission issue.
+#
 # Extra args pass through to pytest, e.g.:
 #   tools/race.sh -k scheduler
 #   tools/race.sh tests/test_stress_race.py::TestRaceTrace
@@ -54,5 +62,6 @@ cd "$(dirname "$0")/.."
 # unrelated zstandard-dependent modules can't fail a green race run.
 exec env VMT_RACETRACE=1 VMT_LOCKTRACE_MAX_HOLD_MS=60000 \
     python -m pytest tests/test_stress_race.py \
-    tests/test_result_cache_ring.py tests/test_flightrec.py -q -m race \
+    tests/test_result_cache_ring.py tests/test_flightrec.py \
+    tests/test_tenant_gate.py -q -m race \
     -p no:cacheprovider "$@"

@@ -61,6 +61,13 @@ def build(args):
                lambda req: (storage.force_flush(), Response.text("OK"))[1])
     http.route("/internal/force_merge",
                lambda req: (storage.force_merge(), Response.text("OK"))[1])
+
+    # chaos control seam (devtools/faultinject, shared handler): GET
+    # lists, ?set= replaces, ?clear=1 disarms; 403 unless the process
+    # opted into chaos via VM_FAULT_INJECT=1 / VM_FAULTS
+    from ..devtools import faultinject
+    http.route("/internal/faults",
+               lambda req: faultinject.handle_http(req, Response))
     return storage, insert_srv, select_srv, http
 
 

@@ -1,0 +1,218 @@
+"""Fault-injection seams for chaos testing.
+
+The chaos harness (``tests/test_chaos_cluster.py``, ``tools/chaos.sh``)
+needs the cluster to misbehave ON DEMAND: slow nodes, stalled RPCs,
+connection resets, injected error returns.  This module is the single
+seam — production code calls :func:`fire` at a handful of well-known
+points and pays one attribute read when no faults are armed.
+
+Fault points currently wired:
+
+- ``rpc:<method>`` — the RPC server dispatch (parallel/rpc.py), fired
+  after the method name is parsed and before the handler runs.  A
+  ``reset`` here closes the connection without a response frame (the
+  client sees a mid-frame close); ``delay``/``stall`` hold the
+  connection thread so the client's socket deadline trips.
+- ``storage:search:<accountID>:<projectID>`` — the storage engine's
+  search entry (storage/storage.py), fired INSIDE the TenantGate slot
+  so an injected delay occupies real admission capacity (how the QoS
+  chaos scenario saturates one tenant without touching another).
+
+Spec grammar (``VM_FAULTS`` env var at process start, or swapped live
+over HTTP via ``/internal/faults?set=...``)::
+
+    spec    := entry (';' entry)*
+    entry   := point '=' action [':' param_ms [':' probability]]
+    action  := 'delay' | 'stall' | 'error' | 'reset'
+
+``point`` may end in ``*`` for a prefix match (``rpc:*`` hits every
+RPC method; ``storage:search:*`` every tenant).  ``param_ms`` is the
+sleep for ``delay``/``stall`` (stall defaults to 300000 — "forever" at
+query timescales); probability defaults to 1.0.
+
+Examples::
+
+    VM_FAULTS='rpc:searchColumns_v1=delay:500'        # slow node
+    VM_FAULTS='rpc:*=reset::0.3'                      # flaky transport
+    VM_FAULTS='storage:search:1:0=delay:300'          # one slow tenant
+
+Injections count into ``vm_fault_injections_total{point=,action=}`` so
+a chaos run can assert its faults actually fired.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from ..utils import metrics as metricslib
+
+__all__ = ["ConnectionAbort", "InjectedError", "configure", "spec",
+           "fire", "active", "http_enabled", "handle_http"]
+
+
+class InjectedError(RuntimeError):
+    """Injected handler failure: surfaces as a normal error response."""
+
+
+class ConnectionAbort(Exception):
+    """Injected connection reset: the transport must drop the peer
+    without a response (NOT an error frame — the point is to exercise
+    the client's reconnect path, not its error path)."""
+
+
+_ACTIONS = ("delay", "stall", "error", "reset")
+
+
+class _Fault:
+    __slots__ = ("point", "action", "param_ms", "prob")
+
+    def __init__(self, point: str, action: str, param_ms: float,
+                 prob: float):
+        self.point = point
+        self.action = action
+        self.param_ms = param_ms
+        self.prob = prob
+
+    def matches(self, point: str) -> bool:
+        if self.point.endswith("*"):
+            return point.startswith(self.point[:-1])
+        return point == self.point
+
+    def __str__(self) -> str:
+        s = f"{self.point}={self.action}:{self.param_ms:g}"
+        if self.prob < 1.0:
+            s += f":{self.prob:g}"
+        return s
+
+
+_lock = threading.Lock()
+_faults: list[_Fault] = []
+#: fast-path guard: fire() reads this one attribute when nothing is armed
+_armed = False
+
+_metric_memo: dict[tuple, object] = {}
+
+
+def _injections(point: str, action: str):
+    key = (point, action)
+    m = _metric_memo.get(key)
+    if m is None:
+        m = _metric_memo[key] = metricslib.REGISTRY.counter(
+            metricslib.format_name("vm_fault_injections_total",
+                                   {"point": point, "action": action}))
+    return m
+
+
+def parse(raw: str) -> list[_Fault]:
+    """Parse a fault spec; raises ValueError with a pointed message on a
+    malformed entry (the HTTP toggle surfaces it as a 400)."""
+    out: list[_Fault] = []
+    for entry in raw.replace("\n", ";").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        point, eq, rhs = entry.partition("=")
+        point = point.strip()
+        if not eq or not point:
+            raise ValueError(f"bad fault entry {entry!r} "
+                             f"(want point=action[:ms[:prob]])")
+        parts = rhs.strip().split(":")
+        action = parts[0]
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {action!r} "
+                             f"(want one of {', '.join(_ACTIONS)})")
+        param_ms = 300_000.0 if action == "stall" else 0.0
+        prob = 1.0
+        if len(parts) > 1 and parts[1]:
+            param_ms = float(parts[1])
+        if len(parts) > 2 and parts[2]:
+            prob = float(parts[2])
+        if len(parts) > 3:
+            raise ValueError(f"bad fault entry {entry!r}: too many fields")
+        out.append(_Fault(point, action, param_ms, prob))
+    return out
+
+
+def configure(raw: str) -> None:
+    """Replace the armed fault table ('' clears everything)."""
+    global _armed
+    faults = parse(raw)
+    with _lock:
+        _faults[:] = faults
+        _armed = bool(faults)
+
+
+def spec() -> str:
+    """The armed fault table, re-serialized to the spec grammar."""
+    with _lock:
+        return ";".join(str(f) for f in _faults)
+
+
+def active() -> bool:
+    return _armed
+
+
+def fire(point: str) -> None:
+    """Trip any armed fault matching `point`.  No-op (one attribute
+    read) unless faults are configured."""
+    if not _armed:
+        return
+    with _lock:
+        matched = [f for f in _faults if f.matches(point)]
+    for f in matched:
+        if f.prob < 1.0 and random.random() >= f.prob:
+            continue
+        _injections(f.point, f.action).inc()
+        if f.action in ("delay", "stall"):
+            time.sleep(f.param_ms / 1e3)
+        elif f.action == "error":
+            raise InjectedError(
+                f"injected fault at {point} (devtools/faultinject)")
+        elif f.action == "reset":
+            raise ConnectionAbort(f"injected connection reset at {point}")
+
+
+def http_enabled() -> bool:
+    """Whether the live ``/internal/faults`` toggle may mutate the
+    table.  Opt-in only — a production process must not be stallable by
+    one unauthenticated HTTP request: enabled when ``VM_FAULT_INJECT``
+    is truthy (re-read per request) or a fault table was armed from
+    ``VM_FAULTS`` at process start (the process already consented to
+    chaos)."""
+    return os.environ.get("VM_FAULT_INJECT", "") not in ("", "0") \
+        or bool(_env_spec)
+
+
+def handle_http(req, response_cls):
+    """The shared ``/internal/faults`` handler (vmstorage's bare HTTP
+    server and PrometheusAPI both route here): GET lists the armed
+    table, ``?set=<spec>`` replaces it, ``?clear=1`` disarms; 403
+    unless :func:`http_enabled`."""
+    if not http_enabled():
+        return response_cls.error(
+            "fault injection disabled (start the process with "
+            "VM_FAULT_INJECT=1 or VM_FAULTS set to enable the live "
+            "toggle)", 403, "forbidden")
+    if req.arg("clear") == "1":
+        configure("")
+    elif "set" in req.query:
+        try:
+            configure(req.arg("set"))
+        except ValueError as e:
+            return response_cls.error(f"bad fault spec: {e}", 400)
+    return response_cls.json({"status": "ok", "faults": spec()})
+
+
+# arm from the environment at import so subprocess apptests configure
+# faults without an HTTP round trip (AppProc passes env overrides)
+_env_spec = os.environ.get("VM_FAULTS", "")
+if _env_spec:
+    try:
+        configure(_env_spec)
+    except ValueError:
+        # a typo in the env must not brick the process at import; the
+        # operator sees the empty table via /internal/faults
+        pass

@@ -31,12 +31,18 @@ from ..query.eval import QueryError, filters_from_metric_expr
 from ..query.metricsql import parse as mql_parse
 from ..query.metricsql.ast import MetricExpr
 from ..query.metricsql.parser import ParseError, parse_duration_ms
+from ..parallel.cluster_api import ClusterUnavailableError, PartialResultError
 from ..query.querystats import ActiveQueries, QueryStats, SlowQueryLog
 from ..query.types import EvalConfig
 from ..storage.metric_name import MetricName
 from ..utils import fasttime, flightrec, logger
 from ..utils import metrics as metricslib
+from ..utils.workpool import SearchLimitError
 from .server import HTTPServer, Request, Response
+
+#: scatter-gather responses that came back incomplete (a storage node
+#: was down/slow) — whether served as isPartial=true or denied as 503
+_PARTIAL_TOTAL = metricslib.REGISTRY.counter("vm_partial_results_total")
 
 
 def parse_time(s: str, default_ms: int) -> int:
@@ -194,6 +200,7 @@ class PrometheusAPI:
             srv.route("/vmui/", self.h_vmui)
         srv.route("/metrics", self.h_metrics)
         srv.route("/flags", self.h_flags)
+        srv.route("/internal/faults", self.h_faults)
         srv.route("/debug/pprof/", self.h_pprof)
         srv.route("/health", lambda req: Response.text("OK"))
         srv.route("/-/healthy", lambda req: Response.text("OK"))
@@ -263,6 +270,44 @@ class PrometheusAPI:
         """Per-request tenant: set by the multitenant path router
         (/insert|/select/<accountID[:projectID]>/..., lib/auth.Token)."""
         return getattr(req, "tenant", None) or self.default_tenant
+
+    def _deny_partial(self, req) -> bool:
+        """-search.denyPartialResponse semantics per request: the
+        ``deny_partial`` query arg wins (1/0), else the
+        ``VM_DENY_PARTIAL_RESPONSE`` env default."""
+        import os as _os
+        v = req.arg("deny_partial")
+        if v:
+            return v not in ("0", "false", "no")
+        return _os.environ.get("VM_DENY_PARTIAL_RESPONSE", "") \
+            not in ("", "0", "false", "no")
+
+    def _partial_guard(self, req) -> Response | None:
+        """Partial-result accounting + the deny_partial 503: returns the
+        error response to serve instead of a silently incomplete 200,
+        or None to proceed.  Call right after a successful exec."""
+        if not bool(getattr(self.storage, "last_partial", False)):
+            return None
+        _PARTIAL_TOTAL.inc()
+        if not self._deny_partial(req):
+            return None
+        return Response.error(
+            "partial response denied: one or more storage nodes did not "
+            "answer (deny_partial=1 / VM_DENY_PARTIAL_RESPONSE; retry or "
+            "allow partial results)", 503, "unavailable")
+
+    def _reject_query(self, e: SearchLimitError, q: str, start: int,
+                      end: int, step: int, req: Request) -> Response:
+        """Shed-load surface: a TenantGate rejection becomes a 429 +
+        Retry-After (the ingest limiter's rejection contract) AND a
+        rejected record in the slow-query log, so shed queries stay
+        visible at /api/v1/status/slow_queries and (via the gate's
+        ``gate:rejected`` flight instant) /api/v1/status/flight."""
+        self.slowlog.record_rejected(q, start, end, step,
+                                     self._tenant(req), str(e))
+        resp = Response.error(str(e), 429, "too_many_requests")
+        resp.headers["Retry-After"] = str(e.retry_after_s)
+        return resp
 
     def _mt_dispatch(self, req: Request) -> Response:
         """Cluster-style multitenant routing (lib/auth.NewToken +
@@ -384,8 +429,18 @@ class PrometheusAPI:
             resp = Response.error(str(e), 429, "too_many_requests")
             resp.headers["Retry-After"] = "10"
             return resp
+        except SearchLimitError as e:
+            return self._reject_query(e, q, ts, ts, step, req)
+        except PartialResultError as e:
+            _PARTIAL_TOTAL.inc()
+            return Response.error(str(e), 503, "unavailable")
+        except ClusterUnavailableError as e:
+            return Response.error(str(e), 503, "unavailable")
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
+        denied = self._partial_guard(req)
+        if denied is not None:
+            return denied
         result = []
         for r in rows:
             v = r.values[-1]
@@ -441,8 +496,18 @@ class PrometheusAPI:
             resp = Response.error(str(e), 429, "too_many_requests")
             resp.headers["Retry-After"] = "10"
             return resp
+        except SearchLimitError as e:
+            return self._reject_query(e, q, start, end, step, req)
+        except PartialResultError as e:
+            _PARTIAL_TOTAL.inc()
+            return Response.error(str(e), 503, "unavailable")
+        except ClusterUnavailableError as e:
+            return Response.error(str(e), 503, "unavailable")
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
+        denied = self._partial_guard(req)
+        if denied is not None:
+            return denied
         grid = ec.timestamps() / 1e3
         result = []
         for r in rows:
@@ -1148,6 +1213,15 @@ class PrometheusAPI:
                 d["children"] = kids
             return d
         return Response.json({"status": "success", "ast": node(expr)})
+
+    def h_faults(self, req: Request) -> Response:
+        """Chaos fault-injection control (devtools/faultinject; the
+        live half of the ``VM_FAULTS`` env seam).  GET lists the armed
+        table; ``?set=<spec>`` replaces it; ``?clear=1`` disarms; 403
+        unless the process opted into chaos (VM_FAULT_INJECT=1 or a
+        VM_FAULTS table armed at start)."""
+        from ..devtools import faultinject
+        return faultinject.handle_http(req, Response)
 
     def h_active_queries(self, req: Request) -> Response:
         return Response.json({"status": "ok",

@@ -15,6 +15,7 @@ from ..ingest.ratelimiter import RateLimitedError
 from ..ops import compress as zstd
 from ..utils import logger
 from ..utils import metrics as metricslib
+from ..utils.workpool import SearchLimitError
 
 
 class Request:
@@ -115,6 +116,13 @@ class HTTPServer:
                 try:
                     resp = fn(req)
                 except RateLimitedError as e:
+                    resp = Response.error(str(e), 429,
+                                          "too_many_requests")
+                    resp.headers["Retry-After"] = str(e.retry_after_s)
+                except SearchLimitError as e:
+                    # shed load from the (tenant) search gate on paths
+                    # without their own handler mapping: same 429 +
+                    # Retry-After contract as the ingest rate limiter
                     resp = Response.error(str(e), 429,
                                           "too_many_requests")
                     resp.headers["Retry-After"] = str(e.retry_after_s)

@@ -24,7 +24,8 @@ from ..storage.tag_filters import TagFilter
 from ..utils import logger, querytracer
 from ..utils import metrics as metricslib
 from .consistenthash import ConsistentHash
-from .rpc import HELLO_INSERT, HELLO_SELECT, RPCClient, RPCError, Reader, Writer
+from .rpc import (HELLO_INSERT, HELLO_SELECT, RPCClient, RPCClientPool,
+                  RPCError, Reader, Writer)
 
 SERIES_PER_FRAME = 64
 
@@ -361,8 +362,14 @@ class StorageNodeClient:
         self.name = name or f"{host}:{insert_port}"
         self.insert = RPCClient(host, insert_port, HELLO_INSERT,
                                 timeout=timeout)
-        self.select = RPCClient(host, select_port, HELLO_SELECT,
-                                timeout=timeout)
+        # select plane gets a CONNECTION POOL (VM_RPC_SELECT_CONNS,
+        # default 4): concurrent queries to one node must not serialize
+        # on a single TCP connection — head-of-line blocking there both
+        # throttles reads and hides concurrent load from the node-side
+        # TenantGate.  The insert plane stays single-connection: writes
+        # are batched and sequenced per node by the router anyway.
+        self.select = RPCClientPool(host, select_port, HELLO_SELECT,
+                                    timeout=timeout)
         self.down_until = 0.0
 
     @property
@@ -431,7 +438,7 @@ class StorageNodeClient:
         return partial
 
     def search_series(self, filters, min_ts, max_ts, tenant=(0, 0),
-                      tracer=querytracer.NOP):
+                      tracer=querytracer.NOP, deadline: float = 0.0):
         """Returns (series_list, remote_partial)."""
         w = _write_tenant(Writer(), tenant)
         _write_filters(w, filters)
@@ -439,7 +446,8 @@ class StorageNodeClient:
         w.u64(1 if tracer.enabled else 0)
         out = []
         partial = False
-        for r in self.select.call_stream("search_v1", w):
+        for r in self.select.call_stream("search_v1", w,
+                                         deadline=deadline):
             n = r.u64()
             if n == (1 << 32) - 1:  # trailing metadata frame
                 partial = self._read_meta(r, tracer)
@@ -454,17 +462,20 @@ class StorageNodeClient:
     supports_columnar_read = True  # cleared on first unknown-method error
 
     def search_columns(self, filters, min_ts, max_ts, tenant=(0, 0),
-                       tracer=querytracer.NOP):
+                       tracer=querytracer.NOP, deadline: float = 0.0):
         """Columnar read plane: returns (raw_names list, counts int64[],
         ts_cat int64[], vals_cat float64[], remote_partial). Falls back to
-        search_v1 against old nodes (same return shape)."""
+        search_v1 against old nodes (same return shape).  `deadline` is
+        the caller's time.monotonic() cutoff, enforced per socket
+        operation by the RPC client."""
         if self.supports_columnar_read:
             w = _write_tenant(Writer(), tenant)
             _write_filters(w, filters)
             w.i64(min_ts).i64(max_ts)
             w.u64(1 if tracer.enabled else 0)
             try:
-                frames = self.select.call_stream("searchColumns_v1", w)
+                frames = self.select.call_stream("searchColumns_v1", w,
+                                                 deadline=deadline)
             except RPCError as e:
                 if "unknown rpc method" not in str(e):
                     raise
@@ -494,7 +505,8 @@ class StorageNodeClient:
                         cat(ts_parts, np.int64),
                         cat(val_parts, np.float64), partial)
         series, partial = self.search_series(filters, min_ts, max_ts,
-                                             tenant, tracer=tracer)
+                                             tenant, tracer=tracer,
+                                             deadline=deadline)
         names = [mn.marshal() for mn, _, _ in series]
         counts = np.fromiter((ts.size for _, ts, _ in series), np.int64,
                              len(series))
@@ -578,6 +590,13 @@ class StorageNodeClient:
 
 class PartialResultError(RuntimeError):
     pass
+
+
+class ClusterUnavailableError(RPCError):
+    """Every storage node failed the fan-out: there is no data to serve
+    at all.  HTTP layers map this to 503 (+ the first node's error)
+    rather than a generic 500 — the cluster is degraded, the serving
+    code is not broken."""
 
 
 def start_native_server(addr: str, hello: bytes, storage,
@@ -903,7 +922,12 @@ class ClusterStorage:
                 with lock:
                     results.append(r)
             except (OSError, RPCError, ConnectionError) as e:
-                node.mark_down()
+                # a deadline that was exhausted BEFORE any I/O touched
+                # the node (waited=False) is the query's fault: count
+                # the error/partial, but don't poison the node's health
+                # for other queries' next 2s
+                if getattr(e, "waited", True):
+                    node.mark_down()
                 with lock:
                     errors.append((node.name, e))
 
@@ -920,7 +944,9 @@ class ClusterStorage:
             from ..utils import workpool
             workpool.POOL.run([partial(run, n) for n in live])
         if errors and not results:
-            raise RPCError(f"all storage nodes failed: {errors[0][1]}")
+            raise ClusterUnavailableError(
+                f"all storage nodes failed: {errors[0][0]}: "
+                f"{errors[0][1]}")
         if errors:
             self._tls.partial = True
         if errors and self.deny_partial:
@@ -931,10 +957,15 @@ class ClusterStorage:
     # eval passes ec.tracer down so storage-node spans land in the query
     # trace (the vmselect->vmstorage half of cross-RPC tracing)
     supports_search_tracer = True
+    # eval passes ec.deadline down so per-node RPC socket timeouts are
+    # derived from the query's REMAINING budget: a hung vmstorage costs
+    # one query deadline, not a fixed default timeout per hop
+    supports_search_deadline = True
 
     def search_columns(self, filters, min_ts, max_ts,
                        dedup_interval_ms=None, max_series=None,
-                       tenant=(0, 0), tracer=querytracer.NOP):
+                       tenant=(0, 0), tracer=querytracer.NOP,
+                       deadline: float = 0.0):
         """Columnar scatter-gather: every node streams (raw names,
         counts, concatenated columns) over searchColumns_v1; the merge is
         ONE vectorized assembly into the padded (S, N) layout — cluster
@@ -950,7 +981,7 @@ class ClusterStorage:
             with tracer.new_child("rpc searchColumns_v1 node %s",
                                   n.name) as nqt:
                 return n.search_columns(filters, min_ts, max_ts, tenant,
-                                        tracer=nqt)
+                                        tracer=nqt, deadline=deadline)
 
         node_results = self._fanout(query_node)
         names_all: list[bytes] = []
@@ -1002,11 +1033,11 @@ class ClusterStorage:
 
     def search_series(self, filters, min_ts, max_ts, dedup_interval_ms=None,
                       max_series=None, tenant=(0, 0),
-                      tracer=querytracer.NOP):
+                      tracer=querytracer.NOP, deadline: float = 0.0):
         return self.search_columns(
             filters, min_ts, max_ts, dedup_interval_ms=dedup_interval_ms,
             max_series=max_series, tenant=tenant,
-            tracer=tracer).to_series_list()
+            tracer=tracer, deadline=deadline).to_series_list()
 
     def search_metric_names(self, filters, min_ts, max_ts, limit=2**31,
                             tenant=(0, 0)):

@@ -11,6 +11,8 @@ names ("writeRows_v1", "search_v1", ...) for rolling-upgrade compat.
 from __future__ import annotations
 
 import io
+import os
+import random
 import socket
 import socketserver
 import struct
@@ -19,6 +21,7 @@ import time
 
 import numpy as np
 
+from ..devtools import faultinject
 from ..devtools.locktrace import make_lock
 from ..devtools.racetrace import traced_fields
 
@@ -30,6 +33,13 @@ except ImportError:  # optional native dep (zstandard): the marshal layer
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
 from ..utils import logger
 from ..utils import metrics as metricslib
+from ..utils.workpool import SearchLimitError
+
+#: wire marker for shed-load errors (TenantGate rejections): the client
+#: re-raises them as SearchLimitError so a tenant-quota 429 crosses the
+#: RPC boundary as ITSELF — not as a generic node failure that would
+#: mark the (healthy) storage node down and go partial for every tenant
+_SHED_PREFIX = "vm:shed-load: "
 
 
 # per-(family, method) handle memo: keeps the format_name + name-regex +
@@ -65,6 +75,40 @@ MAX_FRAME = 256 << 20
 
 class RPCError(RuntimeError):
     pass
+
+
+class RPCDeadlineError(RPCError):
+    """The caller's deadline expired before the call completed.  A
+    subclass of RPCError so transport layers treat it as a terminal
+    call failure (never retried — there is no budget left to retry
+    in).  ``waited`` is False when the budget was already exhausted
+    BEFORE any I/O touched the peer: the node never misbehaved, so
+    health tracking (ClusterStorage._fanout) must not mark it down for
+    one over-budget query."""
+
+    waited = True
+
+
+# cross-method aggregates: the per-method vm_rpc_client_* families stay,
+# these are the "is the cluster retrying/timing out AT ALL" alarms
+_RETRIES_TOTAL = metricslib.REGISTRY.counter("vm_rpc_retries_total")
+_DEADLINE_EXCEEDED_TOTAL = metricslib.REGISTRY.counter(
+    "vm_rpc_deadline_exceeded_total")
+
+
+def _retry_policy() -> tuple[int, float, float]:
+    """(max reconnect retries, backoff base s, backoff cap s) —
+    re-read per call so tests and operators tune live.
+    ``VM_RPC_RETRIES`` (default 2), ``VM_RPC_BACKOFF_MS`` (default 20),
+    ``VM_RPC_BACKOFF_MAX_MS`` (default 2000)."""
+    def _num(name: str, default: float) -> float:
+        try:
+            return float(os.environ.get(name, "") or default)
+        except ValueError:
+            return default
+    return (max(int(_num("VM_RPC_RETRIES", 2)), 0),
+            max(_num("VM_RPC_BACKOFF_MS", 20.0), 0.0) / 1e3,
+            max(_num("VM_RPC_BACKOFF_MAX_MS", 2000.0), 1.0) / 1e3)
 
 
 def _read_exact(sock_file, n: int) -> bytes:
@@ -194,7 +238,12 @@ class RPCServer:
                             req = read_frame(self.rfile)
                         except (ConnectionError, RPCError):
                             return
-                        outer._dispatch(req, self.wfile)
+                        try:
+                            outer._dispatch(req, self.wfile)
+                        except faultinject.ConnectionAbort:
+                            # injected reset: drop the peer mid-frame,
+                            # exercising the client's reconnect path
+                            return
                 except (BrokenPipeError, ConnectionResetError):
                     return
 
@@ -221,6 +270,10 @@ class RPCServer:
         try:
             method = r.str_()
             _rpc_counter("vm_rpc_server_calls_total", method).inc()
+            # chaos seam: injected delays/stalls/errors/resets land here,
+            # between frame parse and handler dispatch (devtools/faultinject)
+            if faultinject.active():
+                faultinject.fire("rpc:" + method)
             fn = self.handlers.get(method)
             if fn is None:
                 raise RPCError(f"unknown rpc method {method!r}")
@@ -232,6 +285,20 @@ class RPCServer:
             else:
                 body = out.payload() if isinstance(out, Writer) else b""
                 write_frame(wfile, b"\x00" + body)
+        except faultinject.ConnectionAbort:
+            raise  # handled at the connection loop (drop, no response)
+        except SearchLimitError as e:
+            # by-design shed load, NOT a handler error: it has its own
+            # accounting (vm_rpc_server_shed_total here, the gate's
+            # vm_tenant_search_rejected_total on the storage side) and
+            # must not flood the error log / error counter during a 429
+            # storm.  The wire marker keeps the type across the hop.
+            _rpc_counter("vm_rpc_server_shed_total", method).inc()
+            try:
+                write_frame(wfile,
+                            b"\x01" + (_SHED_PREFIX + str(e)).encode())
+            except OSError:
+                pass
         except Exception as e:  # noqa: BLE001 — rpc error boundary
             _rpc_counter("vm_rpc_server_errors_total", method).inc()
             logger.errorf("rpc handler error: %s", e)
@@ -259,8 +326,31 @@ class RPCClient:
         self._sock = None
         self._f = None
 
-    def _connect(self):
-        sock = socket.create_connection(self.addr, timeout=self.timeout)
+    def _op_timeout(self, deadline: float) -> float:
+        """Per-operation socket timeout: the configured ceiling, clipped
+        to the caller's remaining budget (a query with 800ms left must
+        not sit in a 10s default timeout against a hung peer)."""
+        if not deadline:
+            return self.timeout
+        return max(min(self.timeout, deadline - time.monotonic()), 0.001)
+
+    def _check_deadline(self, method: str, deadline: float,
+                        waited: bool = True) -> None:
+        if deadline and time.monotonic() >= deadline:
+            _DEADLINE_EXCEEDED_TOTAL.inc()
+            _rpc_counter("vm_rpc_client_deadline_exceeded_total",
+                         method).inc()
+            err = RPCDeadlineError(
+                f"rpc {method} to {self.addr[0]}:{self.addr[1]}: "
+                f"caller deadline exceeded")
+            err.waited = waited
+            raise err
+
+    def _connect(self, deadline: float = 0.0):
+        # connection establishment honors the caller's deadline too —
+        # the constructor timeout is only the no-deadline ceiling
+        sock = socket.create_connection(self.addr,
+                                        timeout=self._op_timeout(deadline))
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         f = sock.makefile("rwb")
         f.write(self.hello)
@@ -283,15 +373,34 @@ class RPCClient:
             finally:
                 self._sock = self._f = None
 
-    def call(self, method: str, w: Writer | None = None) -> Reader:
+    def call(self, method: str, w: Writer | None = None,
+             deadline: float = 0.0) -> Reader:
         """Unary call."""
-        frames = list(self.call_stream(method, w))
+        frames = list(self.call_stream(method, w, deadline=deadline))
         if frames:
             return frames[0]
         return Reader(b"")
 
-    def call_stream(self, method: str, w: Writer | None = None):
+    def call_stream(self, method: str, w: Writer | None = None,
+                    deadline: float = 0.0):
         """Returns an iterator of Readers, one per streamed frame.
+
+        `deadline` is a ``time.monotonic()`` cutoff (0 = none): every
+        socket operation — connect included — runs under a timeout
+        derived from the REMAINING budget (capped by the constructor
+        timeout), so a hung peer costs the caller at most its own
+        deadline, never a fixed 10s-per-hop default.  An exhausted
+        budget raises :class:`RPCDeadlineError` and counts into
+        ``vm_rpc_deadline_exceeded_total``.
+
+        Connection-level failures (peer restarted, stale kept-alive
+        connection, injected resets) are retried on a fresh connection
+        with bounded exponential backoff + full jitter (see
+        :func:`_retry_policy`), as long as no response frame has been
+        received and budget remains; each retry counts into
+        ``vm_rpc_retries_total``.  A socket TIMEOUT is not retried —
+        the peer is slow, not gone, and retrying would burn the rest of
+        the budget re-waiting on the same stall.
 
         All frames are read under the lock BEFORE returning: a lazy
         generator would keep the connection lock held while the caller
@@ -305,18 +414,41 @@ class RPCClient:
         frames: list[Reader] = []
         _rpc_counter("vm_rpc_client_calls_total", method).inc()
         t0 = time.perf_counter()
+        max_retries, backoff_base, backoff_cap = _retry_policy()
         try:
             with self._lock:
-                # A stale kept-alive connection (peer restarted) usually
-                # fails at the FIRST read, not the write (which lands in the
-                # send buffer), so retry once on a fresh connection as long
-                # as no frame has been received yet.
-                for attempt in (0, 1):
+                attempt = 0
+                while True:
+                    # waited=False on the first pre-I/O check: a budget
+                    # that was gone before we touched the peer is the
+                    # QUERY's fault, not the node's
+                    self._check_deadline(method, deadline,
+                                         waited=attempt > 0)
                     try:
                         if self._f is None:
-                            self._connect()
+                            self._connect(deadline)
+                        if self._sock is not None:
+                            # always reset: a reused connection must not
+                            # inherit the previous call's clipped timeout
+                            self._sock.settimeout(
+                                self._op_timeout(deadline))
                         write_frame(self._f, req.payload())
                         while True:
+                            if deadline:
+                                # re-check BETWEEN frames: a dripping
+                                # node emitting each frame just inside
+                                # the per-op timeout must still cost at
+                                # most one deadline, not one timeout
+                                # per streamed frame.  Tear the
+                                # connection down FIRST — aborting
+                                # mid-stream leaves unread frames that
+                                # would poison the next (pooled) call.
+                                if time.monotonic() >= deadline:
+                                    self._close_locked()
+                                    self._check_deadline(method,
+                                                         deadline)
+                                self._sock.settimeout(
+                                    self._op_timeout(deadline))
                             resp = read_frame(self._f)
                             status = resp[0]
                             if status == 0:
@@ -326,20 +458,141 @@ class RPCClient:
                             if status == 1:
                                 # server-reported error: stream is cleanly
                                 # terminated, the connection stays usable
-                                raise RPCError(resp[1:].decode())
+                                msg = resp[1:].decode()
+                                if msg.startswith(_SHED_PREFIX):
+                                    # remote TenantGate rejection: keep
+                                    # its type so the caller's 429 path
+                                    # fires instead of node-down+partial
+                                    raise SearchLimitError(
+                                        msg[len(_SHED_PREFIX):])
+                                raise RPCError(msg)
                             frames.append(Reader(resp[1:]))
                     except RPCError:
                         raise
-                    except (OSError, ConnectionError, TimeoutError):
+                    except TimeoutError:
+                        # slow peer: tear down, surface the caller's
+                        # deadline when that is what actually expired
                         self._close_locked()
-                        if attempt == 1 or frames:
+                        self._check_deadline(method, deadline)
+                        raise
+                    except (OSError, ConnectionError):
+                        self._close_locked()
+                        if frames or attempt >= max_retries:
                             raise
+                        attempt += 1
                         _rpc_counter("vm_rpc_client_retries_total",
                                      method).inc()
-            return iter(frames)
+                        _RETRIES_TOTAL.inc()
+                        # bounded exponential backoff with full jitter
+                        delay = min(backoff_base * (2 ** (attempt - 1)),
+                                    backoff_cap) * random.random()
+                        if deadline:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                self._check_deadline(method, deadline)
+                            delay = min(delay, max(remaining, 0.0))
+                        if delay > 0:
+                            # the lock IS the per-connection serializer —
+                            # socket ops (10s default timeout) already
+                            # block under it far longer than this capped
+                            # backoff, and releasing it mid-call would
+                            # interleave another caller's frames onto a
+                            # connection being re-dialed
+                            time.sleep(delay)  # vmt: disable=VMT004 — see above
         except Exception:
             _rpc_counter("vm_rpc_client_errors_total", method).inc()
             raise
         finally:
             _rpc_histogram("vm_rpc_client_call_duration_seconds",
                            method).update(time.perf_counter() - t0)
+
+
+# -- client connection pool ---------------------------------------------------
+
+class RPCClientPool:
+    """Small per-node CONNECTION pool for the select plane (the
+    netstorage connPool role): concurrent queries against one storage
+    node must not serialize on a single TCP connection — with one
+    connection, a 300ms fetch head-of-line blocks every other query to
+    that node, and the node-side TenantGate never even sees concurrent
+    load to shed.
+
+    Up to ``max_conns`` (``VM_RPC_SELECT_CONNS``, default 4) lazily
+    created :class:`RPCClient` connections; callers past the cap wait
+    for an idle one (bounded upstream by the HTTP concurrency gate).
+    Waiting for LOCAL pool capacity is never the node's fault: a
+    deadline expiring here raises ``waited=False`` so the fan-out does
+    not mark the node down.  Same call/call_stream surface as
+    RPCClient."""
+
+    def __init__(self, host: str, port: int, hello: bytes,
+                 timeout: float = 10.0, max_conns: int | None = None):
+        if max_conns is None:
+            try:
+                max_conns = int(os.environ.get("VM_RPC_SELECT_CONNS",
+                                               "0"))
+            except ValueError:
+                max_conns = 0
+        if max_conns <= 0:
+            max_conns = 4
+        self.addr = (host, port)
+        self.hello = hello
+        self.timeout = timeout
+        self.max_conns = max_conns
+        self._lock = make_lock("rpc.RPCClientPool._lock")
+        self._sem = threading.Semaphore(max_conns)
+        self._idle: list[RPCClient] = []
+        self._all: list[RPCClient] = []
+
+    def _acquire(self, method: str, deadline: float) -> RPCClient:
+        if deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not self._sem.acquire(
+                    timeout=max(remaining, 0.001)):
+                _DEADLINE_EXCEEDED_TOTAL.inc()
+                _rpc_counter("vm_rpc_client_deadline_exceeded_total",
+                             method).inc()
+                err = RPCDeadlineError(
+                    f"rpc {method} to {self.addr[0]}:{self.addr[1]}: "
+                    f"deadline exceeded waiting for a pooled connection")
+                err.waited = False  # local capacity, not the node
+                raise err
+        else:
+            self._sem.acquire()
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+            c = RPCClient(self.addr[0], self.addr[1], self.hello,
+                          timeout=self.timeout)
+            self._all.append(c)
+            return c
+
+    def _release(self, c: RPCClient) -> None:
+        with self._lock:
+            self._idle.append(c)
+        self._sem.release()
+
+    def call(self, method: str, w: Writer | None = None,
+             deadline: float = 0.0) -> Reader:
+        c = self._acquire(method, deadline)
+        try:
+            return c.call(method, w, deadline=deadline)
+        finally:
+            self._release(c)
+
+    def call_stream(self, method: str, w: Writer | None = None,
+                    deadline: float = 0.0):
+        c = self._acquire(method, deadline)
+        try:
+            # RPCClient reads the whole stream before returning, so the
+            # connection is quiescent by the time it goes back to idle
+            return c.call_stream(method, w, deadline=deadline)
+        finally:
+            self._release(c)
+
+    def close(self) -> None:
+        with self._lock:
+            clients, self._idle = list(self._all), []
+            self._all = []
+        for c in clients:
+            c.close()

@@ -310,11 +310,28 @@ def _fetch_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
 
 
 def _tracer_kw(ec: EvalConfig, qt) -> dict:
-    """Thread the fetch span through storages that can propagate it over
-    RPC (ClusterStorage); plain storages take no tracer kwarg."""
+    """Thread the fetch span AND the query deadline through storages
+    that can propagate them over RPC (ClusterStorage); plain storages
+    take neither kwarg.  The deadline makes every per-node socket
+    timeout a function of the query's REMAINING budget — a hung
+    vmstorage costs one deadline, not a fixed timeout per hop."""
+    kw = {}
     if qt.enabled and getattr(ec.storage, "supports_search_tracer", False):
-        return {"tracer": qt}
-    return {}
+        kw["tracer"] = qt
+    if ec.deadline and getattr(ec.storage, "supports_search_deadline",
+                               False):
+        import time as _t
+        remaining = ec.deadline - _t.monotonic()
+        if remaining > 0:
+            # reserve 20% of the remaining budget for the rollup/merge
+            # tail: a stalled node then costs ~0.8 deadlines and the
+            # surviving nodes' PARTIAL result still computes and serves
+            # inside the query deadline, instead of the fetch eating the
+            # whole budget and the post-fetch check failing the query
+            kw["deadline"] = ec.deadline - 0.2 * remaining
+        else:
+            kw["deadline"] = ec.deadline  # exhausted: fail fast in rpc
+    return kw
 
 
 def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,

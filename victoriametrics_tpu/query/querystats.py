@@ -152,6 +152,8 @@ class SlowQueryLog:
         self._threshold_ms = threshold_ms
         self._slow_total = metricslib.REGISTRY.counter(
             "vm_slow_queries_total")
+        self._rejected_total = metricslib.REGISTRY.counter(
+            "vm_rejected_queries_total")
 
     def threshold_ms(self) -> float:
         """Pinned at construction when given, else re-read from the env
@@ -197,6 +199,28 @@ class SlowQueryLog:
         with self._lock:
             self._ring.append(rec)
         return True
+
+    def record_rejected(self, query: str, start: int, end: int, step: int,
+                        tenant, reason: str = "") -> None:
+        """Shed-load visibility: a query REJECTED by admission control
+        (TenantGate 429) enters the ring unconditionally — it never ran,
+        so the duration threshold does not apply — marked
+        ``rejected: true`` with the gate's reason.  Keeps shed load from
+        vanishing out of the slow-query evidence trail (the gate's
+        ``gate:rejected`` flight instant is the capture-side half).
+        Counts ``vm_rejected_queries_total`` — NOT the slow counter: a
+        shed query never ran, and a 429 storm must not trip alerts on
+        ``vm_slow_queries_total``."""
+        self._rejected_total.inc()
+        rec = {"query": query, "start": start, "end": end, "step": step,
+               "tenant": f"{tenant[0]}:{tenant[1]}" if tenant else "0:0",
+               "durationSeconds": 0.0,
+               "time": fasttime.unix_seconds(),
+               "rejected": True,
+               "reason": reason,
+               "phaseSplitMs": {}}
+        with self._lock:
+            self._ring.append(rec)
 
     def snapshot(self) -> list[dict]:
         """Records, newest first."""

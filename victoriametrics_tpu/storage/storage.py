@@ -20,6 +20,7 @@ from contextlib import contextmanager
 
 import numpy as np
 
+from ..devtools import faultinject
 from ..devtools.locktrace import make_lock, make_rlock
 from ..utils import flightrec, logger
 from ..utils import metrics as metricslib
@@ -1024,7 +1025,15 @@ class Storage:
         from .columnar import ColumnarSeries, assemble
         interval = (self.dedup_interval_ms if dedup_interval_ms is None
                     else dedup_interval_ms)
-        with workpool.SEARCH_GATE:
+        # per-tenant QoS admission: a tenant at its VM_TENANT_QUOTAS cap
+        # queues (and sheds) against itself instead of starving others
+        with workpool.SEARCH_GATE.admit(tenant):
+            # chaos seam, INSIDE the admission slot: an injected delay
+            # occupies real gate capacity, which is how the chaos suite
+            # saturates one tenant's quota without touching another's
+            if faultinject.active():
+                faultinject.fire(
+                    f"storage:search:{tenant[0]}:{tenant[1]}")
             return self._search_columns_gated(
                 filters, min_ts, max_ts, interval, max_series, tenant,
                 _tsids, ColumnarSeries, assemble)
