@@ -28,6 +28,9 @@ def pytest_configure(config):
         "(tools/race.sh runs these under VMT_RACETRACE=1)")
     config.addinivalue_line("markers", "slow: excluded from tier-1 (-m 'not slow')")
     config.addinivalue_line(
+        "markers", "crash: kill -9 crash-recovery matrix "
+        "(tools/chaos.sh runs these; the full matrix is also slow-marked)")
+    config.addinivalue_line(
         "markers", "requires_native: needs the native codec library "
         "(libvmcodec.so); skipped cleanly on minimal containers without "
         "a C++ toolchain instead of erroring")

@@ -88,7 +88,8 @@ def _spawn_cluster(d, ports, rf=1, select_extra=(), insert_extra=(),
         ih, "vminsert", env=env)
     procs["vs"] = AppProc(
         "vmselect",
-        nodes + [f"-httpListenAddr=127.0.0.1:{sh}", *select_extra],
+        nodes + [f"-httpListenAddr=127.0.0.1:{sh}",
+                 f"-replicationFactor={rf}", *select_extra],
         sh, "vmselect", env=env)
     return procs
 
@@ -255,6 +256,59 @@ def test_slow_node_costs_one_deadline(deadline_cluster):
         _set_faults(procs["st2"].port, "")
 
 
+def test_storage_side_deadline_abort(deadline_cluster):
+    """ROADMAP item 3's named leftover, measured e2e: the remaining
+    budget ships INSIDE the search request, so a vmstorage whose scan
+    outlives the budget aborts mid-flight (vm_storage_deadline_aborts_
+    total ticks within ~one check interval) and the vmselect receives
+    the TYPED deadline error — partial result, node NOT marked down."""
+    procs = deadline_cluster
+    vi, vs = Client(procs["vi"].port), Client(procs["vs"].port)
+    _ingest(vi, "sda", 120)
+    for key in ("st1", "st2"):
+        _flush(procs[key].port)
+    t_s = (T0 + 30000) // 1000
+    code, body = _query(vs, "count(sda)", t_s)
+    assert code == 200
+    assert float(json.loads(body)["data"]["result"][0]["value"][1]) == 120.0
+
+    # burn most of the shipped budget inside the admission slot, then
+    # dilate every budget check: the abort lands at the FIRST check
+    # after expiry, and its typed error beats the socket cutoff (the
+    # client allows bounded slack past the shipped budget exactly so a
+    # budget-honoring node can answer instead of being marked down)
+    _set_faults(procs["st2"].port,
+                "storage:search:*=delay:1500;storage:scan=delay:200")
+    try:
+        t0 = time.perf_counter()
+        code, body = _query(vs, "count(sda)", t_s)
+        took = time.perf_counter() - t0
+        res = json.loads(body)
+        assert code == 200, body
+        assert res.get("isPartial") is True
+        n = float(res["data"]["result"][0]["value"][1])
+        assert 0 < n < 120          # the surviving node's shard
+        assert took < 7.0, f"aborted query cost {took:.1f}s"
+        # the storage-side abort is loud on the aborting node
+        assert _metric(procs["st2"].port,
+                       "vm_storage_deadline_aborts_total") >= 1
+        assert _metric(procs["st2"].port,
+                       "vm_rpc_server_deadline_total") >= 1
+        # ...and typed on the vmselect (deadline, not node failure)
+        assert _metric(procs["vs"].port,
+                       "vm_rpc_deadline_exceeded_total") >= 1
+    finally:
+        _set_faults(procs["st2"].port, "")
+    # the node was NEVER marked down: with faults cleared, the very next
+    # query (inside what would be the 2s down-cooldown) is complete
+    code, body = _query(vs, "count(sda)", t_s)
+    res = json.loads(body)
+    assert code == 200
+    assert not res.get("isPartial"), \
+        "deadline-aborting node was wrongly marked down"
+    assert float(res["data"]["result"][0]["value"][1]) == 120.0
+
+
 # ---------------------------------------------------------------------------
 # scenario 3: RF=2 failover serves identical results
 # ---------------------------------------------------------------------------
@@ -292,13 +346,20 @@ def test_rf2_failover_identical_results(rf2_cluster):
     after = json.loads(after_body)
     assert code == 200
     assert took < 12.0, f"failover query took {took:.1f}s"
-    # identical results — replication, not luck (isPartial may flip,
-    # the DATA must not)
+    # identical results — replication, not luck
     assert after["data"] == before["data"]
+    # replica-aware partial accounting: every hash range of the dead
+    # node is RF-covered by the surviving responder, so the result is
+    # NOT flagged partial; vm_partial_avoided_total ticks instead
+    assert not after.get("isPartial"), \
+        "RF-covered failover wrongly flagged partial"
+    assert _metric(procs["vs"].port, "vm_partial_avoided_total") >= 1
     # also under aggregation
     code, body = _query(vs, "sum(rfc)", t_s)
-    assert float(json.loads(body)["data"]["result"][0]["value"][1]) == \
+    res = json.loads(body)
+    assert float(res["data"]["result"][0]["value"][1]) == \
         float(sum(i + 2 for i in range(80)))
+    assert not res.get("isPartial")
 
 
 # ---------------------------------------------------------------------------

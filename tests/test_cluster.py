@@ -120,14 +120,21 @@ class TestClusterWriteRead:
         cluster.close()
 
     def test_node_failure_rf2_full_results(self, nodes3):
+        from victoriametrics_tpu.parallel.cluster_api import \
+            _PARTIAL_AVOIDED
         cluster = ClusterStorage([n.client() for n in nodes3],
                                  replication_factor=2)
         cluster.add_rows(seed_rows())
         nodes3[0].stop()
+        before = _PARTIAL_AVOIDED.get()
         res = cluster.search_series(
             filters_from_dict({"__name__": "cm"}), T0, T0 + 10_000_000)
-        assert cluster.last_partial      # a node failed...
-        assert len(res) == 30            # ...but RF=2 kept every series
+        # one failed node out of RF=2: every hash range is covered by a
+        # surviving responder, so the COMPLETE result is not partial —
+        # the failure is accounted in vm_partial_avoided_total instead
+        assert not cluster.last_partial
+        assert _PARTIAL_AVOIDED.get() > before
+        assert len(res) == 30            # RF=2 kept every series
         cluster.close()
 
     def test_node_failure_rf1_partial(self, nodes3):

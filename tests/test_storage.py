@@ -363,24 +363,44 @@ class TestReviewRegressions:
         assert "gen" in s.label_names(T0 - 3600_000, T0)
         s.close()
 
-    def test_listed_unopenable_part_kept(self, tmp_path):
+    def test_listed_unopenable_part_quarantined_and_restorable(
+            self, tmp_path):
+        """A listed part that fails to open is QUARANTINED (moved aside,
+        bytes preserved, results flagged partial) — never rmtree'd and
+        never silently dropped; the operator can restore it by moving it
+        back and re-listing it in parts.json."""
         s = mk_storage(tmp_path)
         write_sample_data(s, n_series=2, n_samples=3)
         s.force_flush()
         s.close()
-        # corrupt a listed part's metadata -> open fails but dir must survive
         import glob, json
         parts = glob.glob(str(tmp_path / "s" / "data" / "*" / "p_*"))
         assert parts
         victim = parts[0]
+        pdir = os.path.dirname(victim)
+        name = os.path.basename(victim)
         meta = os.path.join(victim, "metadata.json")
         orig = open(meta).read()
         open(meta, "w").write("{broken")
         s2 = mk_storage(tmp_path)
-        assert os.path.isdir(victim)  # not rmtree'd
+        # moved to quarantine/, bytes intact, served loudly partial
+        qpath = os.path.join(pdir, "quarantine", name)
+        assert not os.path.isdir(victim)
+        assert os.path.isdir(qpath)
+        assert s2.last_partial is True
+        rep = s2.quarantine_report()
+        assert len(rep) == 1 and rep[0]["part"] == name
         s2.close()
-        open(meta, "w").write(orig)  # heal; data readable again
+        # operator restore: heal metadata, move back, re-list
+        open(os.path.join(qpath, "metadata.json"), "w").write(orig)
+        os.rename(qpath, victim)
+        os.rmdir(os.path.join(pdir, "quarantine"))
+        manifest = os.path.join(pdir, "parts.json")
+        listed = json.load(open(manifest))["parts"]
+        json.dump({"parts": sorted(set(listed) | {name})},
+                  open(manifest, "w"))
         s3 = mk_storage(tmp_path)
+        assert s3.last_partial is False
         assert len(s3.search_series(filters_from_dict({"__name__": "cpu_usage"}),
                                     T0, T0 + 10_000_000)) == 1
         s3.close()

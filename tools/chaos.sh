@@ -1,33 +1,46 @@
 #!/bin/sh
-# Chaos-suite entry point (ROADMAP item 3): runs the slow-marked
-# process-level chaos scenarios in tests/test_chaos_cluster.py —
-# kill/restart vmstorage mid-query, slow-node injection (fault-injected
-# RPC stalls), RF=2 failover byte-equality, an ingest storm racing
-# force_merge, per-tenant QoS isolation under a saturating tenant, and
-# deadline propagation (a stalled node costs one query deadline).
+# Chaos-suite entry point (ROADMAP item 3): two slow-marked families.
 #
-# The scenarios spawn real vmstorage/vminsert/vmselect/vmsingle OS
-# processes; faults are armed per node via each process's
+# 1. Cluster liveness (tests/test_chaos_cluster.py, PR 9): kill/restart
+#    vmstorage mid-query, slow-node injection (fault-injected RPC
+#    stalls), storage-side deadline aborts (budget shipped in the
+#    search request, typed error, no node-down marking), RF=2 failover
+#    byte-equality with replica-covered (non-partial) accounting, an
+#    ingest storm racing force_merge, per-tenant QoS isolation.
+#
+# 2. Crash recovery (tests/test_crash_recovery.py): the kill -9 matrix —
+#    a subprocess ingest storm racing flush/force_merge/snapshot is
+#    SIGKILLed at >= 20 randomized instants against one accumulating
+#    store, reopened, and checked against the recovery invariants
+#    (acked-before-flush data byte-exact, no orphan tmp dirs, no silent
+#    part loss, quarantine only when bytes actually tore).  The per-seam
+#    crashpoint matrix (part:finalize:{pre,post}_rename,
+#    partition:parts_json:pre_replace, merge:post_rename_pre_manifest,
+#    mergeset:flush, indexdb:rotate, snapshot:mid — armed via
+#    VM_FAULTS='<seam>=crash') and the torn-part quarantine matrix run
+#    in tier-1 and are NOT repeated here.
+#
+# The cluster scenarios spawn real vmstorage/vminsert/vmselect/vmsingle
+# OS processes; faults are armed per node via each process's
 # /internal/faults endpoint or the VM_FAULTS env var
-# (devtools/faultinject.py — delay/stall/error/reset at the RPC server
-# and storage-search seams).
+# (devtools/faultinject.py — delay/stall/error/reset/crash at the RPC
+# server, storage-search/scan, and part-lifecycle seams).
 #
 # These tests are `slow`-marked, so tier-1 (`-m 'not slow'`) never pays
-# for them; this script opts back in.  The fast halves of the same
-# machinery (TenantGate admission semantics, the race-marked stress
-# under the deterministic scheduler, in-process RPC deadline tests) run
-# in tier-1 via tests/test_tenant_gate.py and under tools/race.sh.
+# for them; this script opts back in.  Whole run is bounded ~90s on the
+# 2-core box (~35s cluster + ~45s crash matrix).
 #
-# Knobs (see README "Multi-tenant QoS & chaos testing"):
+# Knobs (see README "Multi-tenant QoS & chaos testing" and "Crash
+# recovery & durability"):
 #   VM_TENANT_QUOTAS   per-tenant concurrency/queue/priority quotas
 #   VM_FAULTS          fault table armed at process start
 #   VM_RPC_RETRIES / VM_RPC_BACKOFF_MS / VM_RPC_BACKOFF_MAX_MS
 #
 # Extra args pass through to pytest, e.g.:
 #   tools/chaos.sh -k qos
-#   tools/chaos.sh -k deadline -x
+#   tools/chaos.sh -k kill9 -x
 set -eu
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m pytest tests/test_chaos_cluster.py -q -m slow \
-    -p no:cacheprovider "$@"
+    python -m pytest tests/test_chaos_cluster.py tests/test_crash_recovery.py \
+    -q -m slow -p no:cacheprovider "$@"

@@ -15,6 +15,10 @@
 # 2%) of VM_FLIGHTREC=0 on a serving-shaped workload — exit 1 on an
 # overhead regression.  VMT_NO_FLIGHT_SMOKE=1 skips it (e.g. when
 # iterating on lint findings only).
+#
+# Then a single-crashpoint smoke (one armed kill -9 seam + clean-reopen
+# check, ~3s): the crash-injection harness itself must not rot between
+# full tools/chaos.sh runs.  VMT_NO_CRASH_SMOKE=1 skips it.
 set -eu
 cd "$(dirname "$0")/.."
 if [ "$#" -eq 0 ]; then
@@ -22,5 +26,10 @@ if [ "$#" -eq 0 ]; then
 fi
 python -m victoriametrics_tpu.devtools.lint "$@"
 if [ "${VMT_NO_FLIGHT_SMOKE:-0}" != "1" ]; then
-    exec python -m victoriametrics_tpu.devtools.flight_overhead
+    python -m victoriametrics_tpu.devtools.flight_overhead
+fi
+if [ "${VMT_NO_CRASH_SMOKE:-0}" != "1" ]; then
+    exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+        "tests/test_crash_recovery.py::test_crashpoint_seam[part:finalize:pre_rename]" \
+        -q -p no:cacheprovider
 fi

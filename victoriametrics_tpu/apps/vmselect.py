@@ -22,6 +22,12 @@ def parse_flags(argv=None):
                    action="store_true")
     p.add_argument("-rpc.timeout", dest="rpc_timeout", type=float,
                    default=10.0)
+    p.add_argument("-replicationFactor", dest="replication_factor",
+                   type=int, default=1,
+                   help="how many storage nodes hold each series (must "
+                        "match vminsert): with RF=N, up to N-1 failed "
+                        "nodes keep results complete (replica-covered) "
+                        "instead of partial")
     p.add_argument("-search.tpuBackend", dest="tpu", action="store_true")
     p.add_argument("-search.maxUniqueTimeseries", dest="max_series",
                    type=int, default=300_000)
@@ -52,6 +58,7 @@ def build(args):
         raise SystemExit("vmselect: at least one -storageNode is required")
     cluster = ClusterStorage(
         make_nodes(args.storageNode, getattr(args, "rpc_timeout", 10.0)),
+        replication_factor=getattr(args, "replication_factor", 1),
         deny_partial_response=args.deny_partial)
     hh, _, hp = args.httpListenAddr.rpartition(":")
     srv = HTTPServer(hh or "0.0.0.0", int(hp))

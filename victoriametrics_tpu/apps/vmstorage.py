@@ -61,6 +61,15 @@ def build(args):
                lambda req: (storage.force_flush(), Response.text("OK"))[1])
     http.route("/internal/force_merge",
                lambda req: (storage.force_merge(), Response.text("OK"))[1])
+    # integrity quarantine listing (parts moved aside by the open-time
+    # checksum verification; non-empty => this node serves partial)
+    def h_quarantine(req):
+        rep = storage.quarantine_report()
+        return Response.json(
+            {"status": "success",
+             "data": {"quarantined": rep, "count": len(rep),
+                      "partial": bool(rep)}})
+    http.route("/api/v1/status/quarantine", h_quarantine)
 
     # chaos control seam (devtools/faultinject, shared handler): GET
     # lists, ?set= replaces, ?clear=1 disarms; 403 unless the process

@@ -17,24 +17,40 @@ Fault points currently wired:
   search entry (storage/storage.py), fired INSIDE the TenantGate slot
   so an injected delay occupies real admission capacity (how the QoS
   chaos scenario saturates one tenant without touching another).
+- ``storage:scan`` — the storage-side deadline budget check (fired at
+  every Budget check while a deadline-carrying search runs): a
+  ``delay`` here dilates the scan so a chaos run can prove a query
+  aborts within ~one check interval of its budget expiring.
+- Crashpoints in the part lifecycle (the kill -9 recovery matrix,
+  tools/chaos.sh): ``part:finalize:pre_rename``,
+  ``part:finalize:post_rename``, ``partition:parts_json:pre_replace``,
+  ``merge:post_rename_pre_manifest``, ``mergeset:flush``,
+  ``indexdb:rotate``, ``snapshot:mid``.  Armed with the ``crash``
+  action they hard-kill the process (``os._exit``) at that instant, so
+  a subprocess harness can die at every interesting point of the
+  write-to-tmp -> fsync -> rename discipline and assert clean reopen.
 
 Spec grammar (``VM_FAULTS`` env var at process start, or swapped live
 over HTTP via ``/internal/faults?set=...``)::
 
     spec    := entry (';' entry)*
-    entry   := point '=' action [':' param_ms [':' probability]]
-    action  := 'delay' | 'stall' | 'error' | 'reset'
+    entry   := point '=' action [':' param [':' probability]]
+    action  := 'delay' | 'stall' | 'error' | 'reset' | 'crash'
 
 ``point`` may end in ``*`` for a prefix match (``rpc:*`` hits every
-RPC method; ``storage:search:*`` every tenant).  ``param_ms`` is the
-sleep for ``delay``/``stall`` (stall defaults to 300000 — "forever" at
-query timescales); probability defaults to 1.0.
+RPC method; ``storage:search:*`` every tenant).  ``param`` is the
+sleep in ms for ``delay``/``stall`` (stall defaults to 300000 —
+"forever" at query timescales) and the exit code for ``crash``
+(default 86, the harness's "died at an armed crashpoint" signature);
+probability defaults to 1.0.
 
 Examples::
 
     VM_FAULTS='rpc:searchColumns_v1=delay:500'        # slow node
     VM_FAULTS='rpc:*=reset::0.3'                      # flaky transport
     VM_FAULTS='storage:search:1:0=delay:300'          # one slow tenant
+    VM_FAULTS='part:finalize:pre_rename=crash'        # kill -9 mid-flush
+    VM_FAULTS='merge:*=crash::0.25'                   # randomized crash
 
 Injections count into ``vm_fault_injections_total{point=,action=}`` so
 a chaos run can assert its faults actually fired.
@@ -63,7 +79,12 @@ class ConnectionAbort(Exception):
     the client's reconnect path, not its error path)."""
 
 
-_ACTIONS = ("delay", "stall", "error", "reset")
+_ACTIONS = ("delay", "stall", "error", "reset", "crash")
+
+#: exit code for an armed ``crash`` action (overridable per entry via the
+#: param field): distinctive enough that the recovery harness can tell
+#: "died at the crashpoint" from an ordinary failure
+CRASH_EXIT_CODE = 86
 
 
 class _Fault:
@@ -124,7 +145,8 @@ def parse(raw: str) -> list[_Fault]:
         if action not in _ACTIONS:
             raise ValueError(f"unknown fault action {action!r} "
                              f"(want one of {', '.join(_ACTIONS)})")
-        param_ms = 300_000.0 if action == "stall" else 0.0
+        param_ms = 300_000.0 if action == "stall" else \
+            float(CRASH_EXIT_CODE) if action == "crash" else 0.0
         prob = 1.0
         if len(parts) > 1 and parts[1]:
             param_ms = float(parts[1])
@@ -173,6 +195,16 @@ def fire(point: str) -> None:
                 f"injected fault at {point} (devtools/faultinject)")
         elif f.action == "reset":
             raise ConnectionAbort(f"injected connection reset at {point}")
+        elif f.action == "crash":
+            # hard kill, NOW: no atexit, no finally blocks, no flusher
+            # shutdown — the whole point is to model kill -9 at this
+            # exact instant.  Write the marker line unbuffered so the
+            # recovery harness can attribute the death.
+            try:
+                os.write(2, f"faultinject: CRASH at {point}\n".encode())
+            except OSError:
+                pass
+            os._exit(int(f.param_ms) or CRASH_EXIT_CODE)
 
 
 def http_enabled() -> bool:

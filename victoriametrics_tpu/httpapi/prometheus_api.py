@@ -245,6 +245,7 @@ class PrometheusAPI:
         r("/api/v1/status/top_queries", self.h_top_queries)
         r("/api/v1/status/slow_queries", self.h_slow_queries)
         r("/api/v1/status/flight", self.h_flight)
+        r("/api/v1/status/quarantine", self.h_quarantine)
         r("/metric-relabel-debug", self.h_relabel_debug)
         r("/prettify-query", self.h_prettify_query)
         r("/expand-with-exprs", self.h_prettify_query)  # WITH folding is
@@ -1245,6 +1246,29 @@ class PrometheusAPI:
             "status": "ok",
             "thresholdMs": self.slowlog.threshold_ms(),
             "data": self.slowlog.snapshot(),
+        })
+
+    def h_quarantine(self, req: Request) -> Response:
+        """Parts moved aside by the open-time integrity check (torn or
+        bit-flipped files): the store serves WITHOUT them, every result
+        is flagged partial, and this listing is the operator's recovery
+        worksheet (restore from a replica/snapshot, or delete the
+        quarantine dir to accept the loss)."""
+        if getattr(self.storage, "reset_partial", None) is not None:
+            self.storage.reset_partial()
+        rep = (self.storage.quarantine_report()
+               if getattr(self.storage, "quarantine_report", None)
+               is not None else [])
+        # partial covers BOTH quarantined parts and nodes whose report
+        # could not be fetched — an unreachable node may be the one
+        # holding torn parts, and this worksheet must never read clean
+        # while that is possible
+        partial = bool(rep) or \
+            bool(getattr(self.storage, "last_partial", False))
+        return Response.json({
+            "status": "success",
+            "data": {"quarantined": rep, "count": len(rep),
+                     "partial": partial},
         })
 
     def h_flight(self, req: Request) -> Response:

@@ -29,6 +29,10 @@ from .rpc import (HELLO_INSERT, HELLO_SELECT, RPCClient, RPCClientPool,
 
 SERIES_PER_FRAME = 64
 
+# fan-out failures whose data was provably still served by surviving
+# replicas (RF coverage): NOT marked partial, counted here instead
+_PARTIAL_AVOIDED = metricslib.REGISTRY.counter("vm_partial_avoided_total")
+
 
 # ---------------------------------------------------------------------------
 # vmstorage-side handlers
@@ -122,6 +126,19 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         rolling-upgrade compat both ways."""
         return bool(r.u64()) if r.remaining else False
 
+    def _read_deadline(r: Reader) -> float:
+        """Optional trailing remaining-budget field (ms; second
+        search_v1 extension, after the trace flag): converts to a local
+        monotonic cutoff so this vmstorage aborts index scans and
+        fetches mid-flight when the caller's budget expires, instead of
+        burning a dead query's full cost.  Old clients don't send it
+        (remaining==0 -> no deadline)."""
+        budget_ms = r.u64() if r.remaining else 0
+        if not budget_ms or not getattr(storage,
+                                        "supports_search_deadline", False):
+            return 0.0
+        return time.monotonic() + budget_ms / 1e3
+
     def _meta_frame(qt) -> Writer:
         """Trailing metadata frame: partial-result flag + (when tracing)
         the storage-side span tree, grafted into the caller's trace."""
@@ -141,11 +158,14 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                              "vmstorage search_v1: %d filters, "
                              "timeRange=[%d..%d]", len(filters), min_ts,
                              max_ts)
+        deadline = _read_deadline(r)
         if hasattr(storage, "reset_partial"):
             storage.reset_partial()
         with qt.new_child("search_series") as sq:
             series = storage.search_series(filters, min_ts, max_ts,
-                                           tenant=tenant)
+                                           tenant=tenant,
+                                           **({"deadline": deadline}
+                                              if deadline else {}))
             sq.donef("%d series", len(series))
 
         def frames():
@@ -174,12 +194,15 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                              "vmstorage searchColumns_v1: %d filters, "
                              "timeRange=[%d..%d]", len(filters), min_ts,
                              max_ts)
+        deadline = _read_deadline(r)
         if hasattr(storage, "reset_partial"):
             storage.reset_partial()
         if getattr(storage, "search_columns", None) is not None:
             with qt.new_child("search_columns") as sq:
                 cols = storage.search_columns(filters, min_ts, max_ts,
-                                              tenant=tenant)
+                                              tenant=tenant,
+                                              **({"deadline": deadline}
+                                                 if deadline else {}))
                 sq.donef("%d series, %d samples", cols.n_series,
                          cols.n_samples)
             raw_names = cols.raw_names
@@ -331,6 +354,13 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
             if hasattr(storage, "search_metadata") else {}
         return Writer().bytes_(json.dumps(md).encode())
 
+    def h_quarantine_report(r: Reader):
+        import json
+        rep = storage.quarantine_report() \
+            if getattr(storage, "quarantine_report", None) is not None \
+            else []
+        return Writer().bytes_(json.dumps(rep).encode())
+
     return {
         "writeRows_v1": h_write_rows,
         "writeRowsColumnar_v1": h_write_rows_columnar,
@@ -349,6 +379,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         "metricNamesUsageStats_v1": h_metric_names_usage_stats,
         "resetMetricNamesStats_v1": h_reset_metric_names_stats,
         "searchMetadata_v1": h_search_metadata,
+        "quarantineReport_v1": h_quarantine_report,
     }
 
 
@@ -424,6 +455,31 @@ class StorageNodeClient:
         return len(rows)
 
     @staticmethod
+    def _budget_ms(deadline: float) -> int:
+        """Remaining budget to SHIP inside the request (storage-side
+        deadline enforcement): the receiving vmstorage re-anchors it on
+        its own monotonic clock, so wall-clock skew between nodes never
+        matters.  0 = no deadline; an already-exhausted budget ships as
+        1ms so the node aborts at its first check instead of scanning."""
+        if not deadline:
+            return 0
+        return max(int((deadline - time.monotonic()) * 1e3), 1)
+
+    @staticmethod
+    def _wire_deadline(deadline: float) -> float:
+        """Socket-level cutoff: the shipped budget plus bounded slack
+        (20% of remaining, clamped to [0.1s, 2s]).  A budget-honoring
+        vmstorage aborts server-side within ~one check interval of the
+        SHIPPED cutoff, so its typed deadline error arrives before the
+        socket gives up (no node-down marking, loud abort accounting);
+        a dead/stalled node still costs at most ~1.2 deadlines, never a
+        fixed per-hop timeout (the PR-9 property, slightly relaxed)."""
+        if not deadline:
+            return 0.0
+        remaining = deadline - time.monotonic()
+        return deadline + min(max(0.2 * remaining, 0.1), 2.0)
+
+    @staticmethod
     def _read_meta(r: Reader, tracer) -> bool:
         """Parse the trailing metadata frame: partial flag + (when the
         server traced) the storage-side span tree, grafted under
@@ -444,10 +500,12 @@ class StorageNodeClient:
         _write_filters(w, filters)
         w.i64(min_ts).i64(max_ts)
         w.u64(1 if tracer.enabled else 0)
+        w.u64(self._budget_ms(deadline))
         out = []
         partial = False
         for r in self.select.call_stream("search_v1", w,
-                                         deadline=deadline):
+                                         deadline=self._wire_deadline(
+                                             deadline)):
             n = r.u64()
             if n == (1 << 32) - 1:  # trailing metadata frame
                 partial = self._read_meta(r, tracer)
@@ -473,9 +531,11 @@ class StorageNodeClient:
             _write_filters(w, filters)
             w.i64(min_ts).i64(max_ts)
             w.u64(1 if tracer.enabled else 0)
+            w.u64(self._budget_ms(deadline))
             try:
-                frames = self.select.call_stream("searchColumns_v1", w,
-                                                 deadline=deadline)
+                frames = self.select.call_stream(
+                    "searchColumns_v1", w,
+                    deadline=self._wire_deadline(deadline))
             except RPCError as e:
                 if "unknown rpc method" not in str(e):
                     raise
@@ -577,6 +637,16 @@ class StorageNodeClient:
         import json
         w = Writer().u64(limit).str_(metric)
         r = self.select.call("searchMetadata_v1", w)
+        return json.loads(r.bytes_())
+
+    def quarantine_report(self):
+        import json
+        try:
+            r = self.select.call("quarantineReport_v1", Writer())
+        except RPCError as e:
+            if "unknown rpc method" in str(e):
+                return []  # pre-quarantine storage node
+            raise
         return json.loads(r.bytes_())
 
     def close(self):
@@ -899,7 +969,7 @@ class ClusterStorage:
 
     # -- read path (vmselect) -------------------------------------------
 
-    def _fanout(self, fn):
+    def _fanout(self, fn, replica_covered_ok: bool = True):
         """Run fn(node) on every healthy node concurrently (scatter-gather;
         the reference fans out to all vmstorage nodes in parallel) via the
         shared work pool (utils/workpool) instead of spawning fresh
@@ -911,7 +981,17 @@ class ClusterStorage:
         (nodes >> cores) serialize some per-node waits; at this port's
         node counts that is cheaper than a thread per node per query,
         and the helping caller always makes progress. Known-down nodes
-        are skipped but still count toward the partial flag."""
+        are skipped but still count toward the partial flag.
+
+        Replica-aware partial accounting (the vm_deny_partial-style key
+        coverage): with rendezvous placement every key's RF-target set
+        holds RF DISTINCT nodes, so when fewer than RF distinct nodes
+        failed AND every survivor responded, each of the failed nodes'
+        hash ranges is provably served by a surviving responder — the
+        result is complete, not partial; ``vm_partial_avoided_total``
+        ticks instead.  ``replica_covered_ok=False`` (mutating fanouts
+        like deleteSeries, where a missed node means a missed tombstone
+        regardless of read coverage) keeps the strict accounting."""
         results: list = []
         errors: list = []
         lock = make_lock("parallel.cluster_api.fanout_lock")
@@ -948,10 +1028,19 @@ class ClusterStorage:
                 f"all storage nodes failed: {errors[0][0]}: "
                 f"{errors[0][1]}")
         if errors:
-            self._tls.partial = True
-        if errors and self.deny_partial:
-            raise PartialResultError(
-                f"partial response denied: {errors[0][0]}: {errors[0][1]}")
+            failed = {name for name, _ in errors}
+            if replica_covered_ok and self.rf > 1 and \
+                    len(failed) < self.rf:
+                # every hash range of every failed node is RF-covered by
+                # a surviving responder (all non-failed nodes produced a
+                # result above): the merged answer is complete
+                _PARTIAL_AVOIDED.inc()
+            else:
+                self._tls.partial = True
+                if self.deny_partial:
+                    raise PartialResultError(
+                        f"partial response denied: {errors[0][0]}: "
+                        f"{errors[0][1]}")
         return results
 
     # eval passes ec.tracer down so storage-node spans land in the query
@@ -1068,9 +1157,12 @@ class ClusterStorage:
             if res else []
 
     def metric_names_usage_stats(self, limit=1000, le=None):
+        # per-node counters: a missing node's counts change the answer
+        # regardless of data replication — strict partial accounting
         merged: dict[str, list] = {}
         for items in self._fanout(
-                lambda n: n.metric_names_usage_stats(limit, le)):
+                lambda n: n.metric_names_usage_stats(limit, le),
+                replica_covered_ok=False):
             for x in items:
                 e = merged.setdefault(x["metricName"], [0, 0])
                 e[0] += x["requestsCount"]
@@ -1084,28 +1176,59 @@ class ClusterStorage:
         return items[:limit]
 
     def reset_metric_names_stats(self):
-        self._fanout(lambda n: n.reset_metric_names_stats())
+        # mutation: a missed node keeps its stats — never claim coverage
+        self._fanout(lambda n: n.reset_metric_names_stats(),
+                     replica_covered_ok=False)
 
     def search_metadata(self, limit=1000, metric=""):
+        # TYPE/HELP metadata is node-local state, not RF-replicated data
         out: dict = {}
         for md in self._fanout(
-                lambda n: n.search_metadata(limit, metric)):
+                lambda n: n.search_metadata(limit, metric),
+                replica_covered_ok=False):
             for k, v in md.items():
                 out.setdefault(k, v)
         return dict(list(out.items())[:limit])
 
+    def quarantine_report(self) -> list[dict]:
+        """Cluster-wide quarantine listing: fan the storage nodes'
+        reports together (tagged per node) so the vmselect's
+        /api/v1/status/quarantine is the operator's single worksheet."""
+        out: list[dict] = []
+
+        def one(n):
+            return [dict(q, node=n.name) for q in n.quarantine_report()]
+
+        # strict accounting: a node whose report is missing may be the
+        # one HOLDING quarantined parts — replica coverage can cover its
+        # data, never its per-node quarantine state
+        for rep in self._fanout(one, replica_covered_ok=False):
+            out.extend(rep)
+        return out
+
     def delete_series(self, filters, tenant=(0, 0)):
-        return sum(self._fanout(lambda n: n.delete_series(filters, tenant)))
+        # a node that missed the fan-out missed its TOMBSTONES: replica
+        # coverage cannot make that complete (the down node's copy will
+        # resurrect), so deletes keep strict partial accounting
+        return sum(self._fanout(lambda n: n.delete_series(filters, tenant),
+                                replica_covered_ok=False))
 
     def series_count(self, tenant=(0, 0)):
-        return sum(self._fanout(lambda n: n.series_count(tenant)))
+        # summed per-node counts change value when a node is missing —
+        # RF coverage proves its DATA is served elsewhere, not that the
+        # sum is unchanged (with RF>1 replicas are double-counted when
+        # healthy): strict partial accounting
+        return sum(self._fanout(lambda n: n.series_count(tenant),
+                                replica_covered_ok=False))
 
     def tenants(self):
         res = self._fanout(lambda n: n.tenants())
         return sorted(set().union(*map(set, res))) if res else []
 
     def tsdb_status(self, date=None, topn=10, tenant=(0, 0)):
-        results = self._fanout(lambda n: n.tsdb_status(topn, date, tenant))
+        # per-node top-N counts, same reasoning as series_count
+        results = self._fanout(lambda n: n.tsdb_status(topn, date, tenant),
+                               replica_covered_ok=False)
         total = sum(r["totalSeries"] for r in results)
 
         def merge_top(key):

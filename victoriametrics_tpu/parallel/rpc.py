@@ -33,6 +33,7 @@ except ImportError:  # optional native dep (zstandard): the marshal layer
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
 from ..utils import logger
 from ..utils import metrics as metricslib
+from ..utils.deadline import DeadlineExceededError
 from ..utils.workpool import SearchLimitError
 
 #: wire marker for shed-load errors (TenantGate rejections): the client
@@ -40,6 +41,12 @@ from ..utils.workpool import SearchLimitError
 #: RPC boundary as ITSELF — not as a generic node failure that would
 #: mark the (healthy) storage node down and go partial for every tenant
 _SHED_PREFIX = "vm:shed-load: "
+
+#: wire marker for storage-side deadline aborts: the vmstorage stopped
+#: a scan/fetch because the SHIPPED budget expired — by-design behavior
+#: requested by the caller, so the client re-raises a deadline error
+#: with waited=False and the fan-out never marks the healthy node down
+_DEADLINE_PREFIX = "vm:deadline: "
 
 
 # per-(family, method) handle memo: keeps the format_name + name-regex +
@@ -287,6 +294,18 @@ class RPCServer:
                 write_frame(wfile, b"\x00" + body)
         except faultinject.ConnectionAbort:
             raise  # handled at the connection loop (drop, no response)
+        except DeadlineExceededError as e:
+            # the handler aborted because the query's SHIPPED budget
+            # expired — by-design (vm_storage_deadline_aborts_total on
+            # this node already counted it), not a handler error: no
+            # error-log line, no vm_rpc_server_errors_total, and the
+            # typed wire marker keeps it a deadline on the caller's side
+            _rpc_counter("vm_rpc_server_deadline_total", method).inc()
+            try:
+                write_frame(wfile,
+                            b"\x01" + (_DEADLINE_PREFIX + str(e)).encode())
+            except OSError:
+                pass
         except SearchLimitError as e:
             # by-design shed load, NOT a handler error: it has its own
             # accounting (vm_rpc_server_shed_total here, the gate's
@@ -465,6 +484,18 @@ class RPCClient:
                                     # fires instead of node-down+partial
                                     raise SearchLimitError(
                                         msg[len(_SHED_PREFIX):])
+                                if msg.startswith(_DEADLINE_PREFIX):
+                                    # storage-side deadline abort: the
+                                    # node did exactly what the shipped
+                                    # budget asked — surface a typed
+                                    # deadline, never mark it down
+                                    _DEADLINE_EXCEEDED_TOTAL.inc()
+                                    err = RPCDeadlineError(
+                                        f"rpc {method} to "
+                                        f"{self.addr[0]}:{self.addr[1]}: "
+                                        f"{msg[len(_DEADLINE_PREFIX):]}")
+                                    err.waited = False
+                                    raise err
                                 raise RPCError(msg)
                             frames.append(Reader(resp[1:]))
                     except RPCError:

@@ -30,6 +30,7 @@ import struct
 
 import numpy as np
 
+from ..devtools import faultinject
 from ..devtools.locktrace import make_lock
 from ..devtools.racetrace import traced_fields
 from ..utils import metrics as metricslib
@@ -174,6 +175,14 @@ class IndexDB:
         with self._lock:
             return list(self._month_tables.items())
 
+    def quarantined(self) -> list[dict]:
+        """Open-time integrity quarantines across the global table and
+        every month table (recovery parity: the indexdb stores get the
+        same loud torn-part handling as data parts)."""
+        with self._lock:
+            tables = [self.table] + list(self._month_tables.values())
+        return [q for t in tables for q in t.quarantined]
+
     def drop_months_before(self, min_valid_ts: int) -> int:
         """Drop whole month index tables older than retention (the
         per-partition indexDB rotation; returns count)."""
@@ -185,6 +194,11 @@ class IndexDB:
                 if name < min_month:
                     t = self._month_tables.pop(name)
                     t.close()
+                    # crashpoint: dying between unlist and rmtree leaves
+                    # the month dir on disk — it is rediscovered (and
+                    # re-dropped) at the next open, never half-deleted
+                    # under a live table object
+                    faultinject.fire("indexdb:rotate")
                     shutil.rmtree(t.path, ignore_errors=True)
                     dropped += 1
                     self._gen += 1
@@ -404,10 +418,19 @@ class IndexDB:
     def search_metric_ids(self, filters: list[TagFilter],
                           min_ts: int | None = None,
                           max_ts: int | None = None,
-                          tenant=(0, 0)) -> np.ndarray:
+                          tenant=(0, 0), check=None) -> np.ndarray:
         """Resolve tag filters to a sorted metricID array
         (searchMetricIDs, index_db.go:1685 analog), memoized in the
-        tagFilters->metricIDs cache (index_db.go:336-361 analog)."""
+        tagFilters->metricIDs cache (index_db.go:336-361 analog).
+
+        ``check`` (optional zero-arg callable) is the storage-side
+        deadline budget's UNCONDITIONAL clock check: invoked between
+        posting scans — each one a whole mergeset prefix iteration, so
+        the per-call clock read is noise — so an expired query aborts
+        mid-index-scan instead of completing the whole resolution for a
+        dead caller.  (The cheap amortized tick belongs to per-item
+        loops like search_tsids' resolution, not here: a filter x day
+        matrix rarely reaches the tick's every-Nth threshold.)"""
         ckey = (tenant,
                 tuple((tf.key, tf.value, tf.negate, tf.regex)
                       for tf in filters),
@@ -433,7 +456,7 @@ class IndexDB:
             # write during the scan must invalidate what we store
         _FILTER_CACHE_MISSES.inc()
         result = self._search_metric_ids_uncached(filters, min_ts, max_ts,
-                                                  tenant)
+                                                  tenant, check)
         with self._lock:
             # rotate only when inserting a NEW key into a full current
             # generation (refreshing a resident stale entry must not
@@ -448,7 +471,11 @@ class IndexDB:
     def _search_metric_ids_uncached(self, filters: list[TagFilter],
                                     min_ts: int | None = None,
                                     max_ts: int | None = None,
-                                    tenant=(0, 0)) -> np.ndarray:
+                                    tenant=(0, 0),
+                                    check=None) -> np.ndarray:
+        if check is None:
+            def check():
+                pass
         use_dates: list[int] | None = None
         if min_ts is not None and max_ts is not None:
             d0, d1 = date_of_ms(min_ts), date_of_ms(max_ts)
@@ -457,11 +484,14 @@ class IndexDB:
 
         def filter_set(tf: TagFilter) -> np.ndarray:
             if use_dates is not None:
-                sets = [self._metric_ids_for_filter(tf, d, tenant)
-                        for d in use_dates]
+                sets = []
+                for d in use_dates:
+                    check()  # budget: one check per per-day posting scan
+                    sets.append(self._metric_ids_for_filter(tf, d, tenant))
                 sets = [s for s in sets if s.size]
                 return (np.unique(np.concatenate(sets)) if sets
                         else np.array([], dtype=np.uint64))
+            check()
             return self._metric_ids_for_filter(tf, None, tenant)
 
         # Strong positives (don't match a missing label) seed the result via
@@ -533,7 +563,8 @@ class IndexDB:
 
     def search_tsids(self, filters: list[TagFilter],
                      min_ts: int | None = None,
-                     max_ts: int | None = None, tenant=(0, 0)) -> list[TSID]:
+                     max_ts: int | None = None, tenant=(0, 0),
+                     check=None, scan_check=None) -> list[TSID]:
         # gen-validated result memo: a rolling dashboard repeats the same
         # selector every refresh; the id->TSID resolution + sort (~ms per
         # 10k series) would otherwise run every time
@@ -547,9 +578,16 @@ class IndexDB:
             if got is not None and got[0] == self._gen:
                 return got[1]
             gen = self._gen
-        mids = self.search_metric_ids(filters, min_ts, max_ts, tenant)
+        # the posting scans get the UNCONDITIONAL clock check (coarse,
+        # expensive units); the per-series loop below gets the amortized
+        # tick (cheap, every Nth call reads the clock)
+        mids = self.search_metric_ids(filters, min_ts, max_ts, tenant,
+                                      scan_check if scan_check is not None
+                                      else check)
         out = []
         for mid in mids:
+            if check is not None:
+                check()
             t = self.get_tsid_by_id(int(mid))
             if t is not None:
                 out.append(t)

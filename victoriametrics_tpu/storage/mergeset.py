@@ -19,17 +19,19 @@ from __future__ import annotations
 import bisect
 import heapq
 import itertools
-import json
 import os
 import struct
 import time
+import zlib
 from collections import OrderedDict
 
+from ..devtools import faultinject
 from ..devtools.locktrace import make_lock, make_rlock
 from ..devtools.racetrace import traced_fields
 from ..ops import compress as zstd
 from ..ops.varint import marshal_varuint64, unmarshal_varuint64
 from ..utils import flightrec, logger
+from ..utils import fs as fslib
 from ..utils import metrics as metricslib
 from ..utils import workpool
 
@@ -43,6 +45,10 @@ _ACTIVE_MERGES = metricslib.REGISTRY.gauge(
     'vm_active_merges{type="indexdb/mergeset"}')
 _ING_FLUSH = metricslib.ingest_phase("flush")
 _ING_MERGE = metricslib.ingest_phase("merge")
+_PARTS_QUARANTINED = metricslib.REGISTRY.counter(
+    'vm_parts_quarantined_total{store="mergeset"}')
+_PARTS_OPEN_ERRORS = metricslib.REGISTRY.counter(
+    'vm_parts_open_errors_total{store="mergeset"}')
 
 MAX_BLOCK_BYTES = 64 << 10
 MAX_INMEMORY_PARTS = 15
@@ -87,10 +93,16 @@ def _decode_block(data: bytes, count: int) -> list[bytes]:
 class _FilePart:
     """Immutable on-disk sorted run."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, trusted: bool = False):
         self.path = path
-        with open(os.path.join(path, "metadata.json")) as f:
-            meta = json.load(f)
+        # integrity gate first: torn/bit-flipped parts must fail loudly
+        # here (IntegrityError) so the table opener quarantines them.
+        # `trusted` skips the payload re-read for parts THIS process just
+        # finalized (it computed the checksums moments ago) — cold opens
+        # always verify.
+        meta = fslib.load_meta_json(os.path.join(path, "metadata.json"))
+        if not trusted:
+            fslib.verify_checksums(path, meta)
         self.item_count = meta["item_count"]
         idx_raw = zstd.decompress(
             open(os.path.join(path, "index.bin"), "rb").read())
@@ -162,12 +174,13 @@ class _FilePart:
         os.makedirs(tmp, exist_ok=True)
         index = bytearray()
         count = 0
+        items_crc = 0
         with open(os.path.join(tmp, "items.bin"), "wb") as f:
             block: list[bytes] = []
             bbytes = 0
 
             def flush_block():
-                nonlocal block, bbytes
+                nonlocal block, bbytes, items_crc
                 if not block:
                     return
                 data = _encode_block(block)
@@ -178,6 +191,7 @@ class _FilePart:
                 index.extend(marshal_varuint64(len(data)))
                 index.extend(marshal_varuint64(len(block)))
                 f.write(data)
+                items_crc = zlib.crc32(data, items_crc)
                 block = []
                 bbytes = 0
 
@@ -190,15 +204,19 @@ class _FilePart:
             flush_block()
             f.flush()
             os.fsync(f.fileno())
+        idx_data = zstd.compress(bytes(index))
         with open(os.path.join(tmp, "index.bin"), "wb") as f:
-            f.write(zstd.compress(bytes(index)))
+            f.write(idx_data)
             f.flush()
             os.fsync(f.fileno())
-        with open(os.path.join(tmp, "metadata.json"), "w") as f:
-            json.dump({"item_count": count}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.rename(tmp, path)
+        fslib.write_meta_json(
+            os.path.join(tmp, "metadata.json"),
+            {"item_count": count,
+             "checksums": {"items.bin": items_crc,
+                           "index.bin": zlib.crc32(idx_data)}})
+        faultinject.fire("mergeset:flush")
+        # atomic AND durable publish: rename + parent-dir fsync
+        fslib.rename_durable(tmp, path)
         return count
 
 
@@ -228,19 +246,52 @@ class Table:
         self._mem_parts: list[list[bytes]] = []
         self._file_parts: list[_FilePart] = []
         self._part_seq = itertools.count()
+        #: parts moved aside by the open-time integrity check (same
+        #: quarantine semantics as storage data parts — loud, partial)
+        self.quarantined: list[dict] = []
         self._open_existing()
 
     # -- lifecycle ---------------------------------------------------------
 
     def _open_existing(self):
+        # previously quarantined parts keep the store loudly partial
+        # across restarts (same persistence rule as data partitions)
+        where = os.path.basename(self.path)
+        self.quarantined.extend(fslib.resident_quarantine_entries(
+            self.path, "mergeset", where))
         names = sorted(n for n in os.listdir(self.path)
                        if not n.endswith(".tmp") and
+                       n != fslib.QUARANTINE_DIR and
                        os.path.isdir(os.path.join(self.path, n)))
         for n in names:
             try:
                 self._file_parts.append(_FilePart(os.path.join(self.path, n)))
-            except (OSError, ValueError) as e:
-                logger.warnf("mergeset: dropping broken part %s: %s", n, e)
+            except (fslib.IntegrityError, ValueError, KeyError) as e:
+                # torn/corrupt part: quarantine it LOUDLY (counter +
+                # partial flag + status listing) instead of the old
+                # warn-and-drop that silently lost index entries
+                try:
+                    self.quarantined.append(fslib.quarantine_dir_entry(
+                        self.path, n, e, "mergeset", where))
+                    _PARTS_QUARANTINED.inc()
+                except OSError as move_err:
+                    logger.errorf("mergeset: cannot quarantine part "
+                                  "%s: %s", n, move_err)
+                    self.quarantined.append(
+                        {"store": "mergeset", "in": where, "part": n,
+                         "path": os.path.join(self.path, n),
+                         "error": str(e)})
+                    _PARTS_OPEN_ERRORS.inc()
+            except OSError as e:
+                # transient open failure (fd exhaustion, permissions):
+                # keep the part in place — a fixed environment serves it
+                # again — but report it loudly meanwhile
+                logger.errorf("mergeset %s: cannot open part %s (kept in "
+                              "place, serving partial): %s", where, n, e)
+                self.quarantined.append(
+                    {"store": "mergeset", "in": where, "part": n,
+                     "path": os.path.join(self.path, n), "error": str(e)})
+                _PARTS_OPEN_ERRORS.inc()
         # tmp dirs are leftovers from a crash mid-write
         for n in os.listdir(self.path):
             if n.endswith(".tmp"):
@@ -324,7 +375,7 @@ class Table:
                 flushed = {id(m) for m in mems}
                 self._mem_parts = [m for m in self._mem_parts
                                    if id(m) not in flushed]
-                self._file_parts.append(_FilePart(p))
+                self._file_parts.append(_FilePart(p, trusted=True))
                 merge_files = len(self._file_parts) > MAX_INMEMORY_PARTS
             _FLUSH_DURATION.update(dt)
             _ING_FLUSH.inc(dt)
@@ -351,7 +402,7 @@ class Table:
                     p = os.path.join(self.path, name)
                     _FilePart.write(p, merged)
                     dt = time.perf_counter() - t0
-                new_part = _FilePart(p)
+                new_part = _FilePart(p, trusted=True)
                 with self._lock:
                     keep = [q for q in self._file_parts if q not in olds]
                     self._file_parts = [new_part] + keep
@@ -456,3 +507,4 @@ class Table:
                 os.makedirs(pdst, exist_ok=True)
                 for fn in os.listdir(fp.path):
                     os.link(os.path.join(fp.path, fn), os.path.join(pdst, fn))
+        fslib.fsync_dir(dst)  # snapshot dir entries durable, like parts

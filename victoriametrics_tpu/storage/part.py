@@ -15,16 +15,21 @@ immutable-part property that makes snapshots hardlinks (fs.go:71,182).
 
 from __future__ import annotations
 
-import json
 import os
 import struct
+import zlib
 from collections import OrderedDict
 
 import numpy as np
 
+from ..devtools import faultinject
 from ..ops import compress as zstd
+from ..utils import fs as fslib
 from .block import Block, BlockHeader
 from .tsid import TSID
+
+#: re-exported: callers catch this to quarantine torn/corrupt parts
+PartIntegrityError = fslib.IntegrityError
 
 HEADERS_PER_INDEX_BLOCK = 256
 _META_ROW = struct.Struct(">32sIQIqq")
@@ -193,6 +198,9 @@ class PartWriter:
         self.min_ts = 1 << 62
         self.max_ts = -(1 << 62)
         self._prev_key = None
+        # incremental per-file crc32, folded as bytes stream out: the
+        # finalize checksum costs no re-read of the part
+        self._crc = {"timestamps.bin": 0, "values.bin": 0, "index.bin": 0}
 
     def write_block(self, blk: Block) -> None:
         h, ts_data, val_data = blk.marshal()
@@ -275,6 +283,10 @@ class PartWriter:
         h.val_offset = self._val_f.tell()
         self._ts_f.write(ts_data)
         self._val_f.write(val_data)
+        self._crc["timestamps.bin"] = zlib.crc32(ts_data,
+                                                 self._crc["timestamps.bin"])
+        self._crc["values.bin"] = zlib.crc32(val_data,
+                                             self._crc["values.bin"])
         if self._hdr_block_first is None:
             self._hdr_block_first = tsid
         self._hdrs.append(h.marshal())
@@ -296,28 +308,38 @@ class PartWriter:
             self._hdr_block_first.marshal(), len(self._hdrs), off, len(data),
             self._hdr_min_ts, self._hdr_max_ts)
         self._idx_f.write(data)
+        self._crc["index.bin"] = zlib.crc32(data, self._crc["index.bin"])
         self._hdrs = []
         self._hdr_block_first = None
         self._hdr_min_ts = 1 << 62
         self._hdr_max_ts = -(1 << 62)
 
     def close(self) -> str:
-        """Finalize: fsync everything, rename into place."""
+        """Finalize: fsync everything, record per-file checksums in
+        metadata.json, rename into place, fsync the parent dir (the
+        rename alone is atomic but not durable).  Crashpoints bracket
+        the rename so the kill -9 matrix can die on either side of the
+        publish instant."""
         self._flush_index_block()
         for f in (self._ts_f, self._val_f, self._idx_f):
             f.flush()
             os.fsync(f.fileno())
             f.close()
+        mi_data = zstd.compress(bytes(self._meta_rows))
         with open(os.path.join(self.tmp, "metaindex.bin"), "wb") as f:
-            f.write(zstd.compress(bytes(self._meta_rows)))
+            f.write(mi_data)
             f.flush()
             os.fsync(f.fileno())
-        with open(os.path.join(self.tmp, "metadata.json"), "w") as f:
-            json.dump({"rows": self.rows, "blocks": self.blocks,
-                       "min_ts": self.min_ts, "max_ts": self.max_ts}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.rename(self.tmp, self.path)
+        sums = dict(self._crc)
+        sums["metaindex.bin"] = zlib.crc32(mi_data)
+        fslib.write_meta_json(
+            os.path.join(self.tmp, "metadata.json"),
+            {"rows": self.rows, "blocks": self.blocks,
+             "min_ts": self.min_ts, "max_ts": self.max_ts,
+             "checksums": sums})
+        faultinject.fire("part:finalize:pre_rename")
+        fslib.rename_durable(self.tmp, self.path)
+        faultinject.fire("part:finalize:post_rename")
         return self.path
 
     def abort(self):
@@ -333,10 +355,19 @@ class PartWriter:
 class Part:
     """Open immutable part: metaindex in RAM, payloads read on demand."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, trusted: bool = False):
         self.path = path
-        with open(os.path.join(path, "metadata.json")) as f:
-            meta = json.load(f)
+        # integrity gate BEFORE any parsing: a torn/bit-flipped part must
+        # fail here with PartIntegrityError (the opener quarantines it),
+        # never misparse into wrong data.  metadata.json self-verifies
+        # via meta_crc; the four payload files verify against the crc32s
+        # recorded at finalize.  `trusted` skips the payload re-read for
+        # parts THIS process just finalized (it computed the checksums
+        # moments ago; re-reading would double flush/merge I/O) — cold
+        # opens always verify.
+        meta = fslib.load_meta_json(os.path.join(path, "metadata.json"))
+        if not trusted:
+            fslib.verify_checksums(path, meta)
         self.rows = meta["rows"]
         self.blocks = meta["blocks"]
         self.min_ts = meta["min_ts"]

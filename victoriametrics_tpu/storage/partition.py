@@ -22,9 +22,11 @@ import time
 
 import numpy as np
 
+from ..devtools import faultinject
 from ..devtools.locktrace import make_rlock
 from ..devtools.racetrace import traced_fields
 from ..utils import flightrec, logger
+from ..utils import fs as fslib
 from ..utils import metrics as metricslib
 from ..utils import workpool
 from .block import MAX_ROWS_PER_BLOCK, Block, rows_to_blocks
@@ -45,6 +47,18 @@ _ACTIVE_MERGES = metricslib.REGISTRY.gauge(
 _ING_FLUSH = metricslib.ingest_phase("flush")
 _ING_MERGE = metricslib.ingest_phase("merge")
 _SPILL_ERRORS = metricslib.REGISTRY.counter("vm_ingest_spill_errors_total")
+# torn/corrupt parts moved aside at open instead of being served or
+# silently dropped (one series per store kind; mergeset ticks its own)
+_PARTS_QUARANTINED = metricslib.REGISTRY.counter(
+    'vm_parts_quarantined_total{store="storage"}')
+# listed parts that failed to open but were KEPT IN PLACE (transient
+# OSError / failed quarantine move): loud and partial, but NOT moved —
+# the quarantined counter must mean what its name says
+_PARTS_OPEN_ERRORS = metricslib.REGISTRY.counter(
+    'vm_parts_open_errors_total{store="storage"}')
+
+QUARANTINE_DIR = fslib.QUARANTINE_DIR
+quarantine_dir_entry = fslib.quarantine_dir_entry
 
 MAX_PENDING_ROWS = 256 << 10
 MAX_SMALL_PARTS = 15
@@ -481,6 +495,14 @@ class Partition:
         self._mem_parts: list[InmemoryPart] = []
         self._file_parts: list[Part] = []
         self._seq = itertools.count()
+        #: parts moved aside by the open-time integrity check (report
+        #: entries; a non-empty list marks every result partial)
+        self.quarantined: list[dict] = []
+        #: listed parts that failed to open but were NOT moved (transient
+        #: OSError, or the quarantine move itself failed): they must stay
+        #: in parts.json — delisting them would hand the bytes to the
+        #: next open's unlisted-dir sweep
+        self._keep_listed: list[str] = []
         os.makedirs(path, exist_ok=True)
         self._open_existing()
 
@@ -491,14 +513,26 @@ class Partition:
 
     def _write_parts_json_locked(self):
         names = [os.path.basename(p.path) for p in self._file_parts]
+        # broken-but-unmoved parts stay listed: the manifest is the only
+        # thing standing between their bytes and the unlisted-dir sweep
+        names += [n for n in self._keep_listed if n not in names]
         tmp = self._parts_json() + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"parts": names}, f)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, self._parts_json())
+        faultinject.fire("partition:parts_json:pre_replace")
+        # replace + parent fsync: the manifest swap must be durable, not
+        # just atomic — a crash after the rename but before the dir entry
+        # hits disk could resurrect the OLD part list
+        fslib.rename_durable(tmp, self._parts_json())
 
     def _open_existing(self):
+        # parts quarantined by a PREVIOUS open still poison completeness:
+        # report them (and serve partial) until the operator restores or
+        # deletes them — a restart must not silently un-flag the loss
+        self.quarantined.extend(fslib.resident_quarantine_entries(
+            self.path, "storage", self.name))
         listed = []
         if os.path.exists(self._parts_json()):
             with open(self._parts_json()) as f:
@@ -507,18 +541,54 @@ class Partition:
             p = os.path.join(self.path, name)
             try:
                 self._file_parts.append(Part(p))
-            except (OSError, ValueError, KeyError) as e:
-                # keep the dir: the error may be transient (fd exhaustion,
-                # permissions); deleting listed parts would be data loss
-                logger.errorf("partition %s: cannot open part %s "
-                              "(kept on disk): %s", self.name, name, e)
+            except (fslib.IntegrityError, ValueError, KeyError) as e:
+                # torn/corrupt/unparsable LISTED part: move it to the
+                # quarantine dir and serve LOUDLY PARTIAL — never the old
+                # behavior of logging once and silently dropping the data
+                # from every future result
+                try:
+                    self.quarantined.append(quarantine_dir_entry(
+                        self.path, name, e, "storage", self.name))
+                    _PARTS_QUARANTINED.inc()
+                except OSError as move_err:
+                    # cannot even move it (permissions?): keep the dir in
+                    # place AND LISTED (delisting would hand its bytes to
+                    # the next open's unlisted-dir sweep) — still loud
+                    logger.errorf("partition %s: cannot quarantine part "
+                                  "%s: %s", self.name, name, move_err)
+                    self.quarantined.append(
+                        {"store": "storage", "in": self.name, "part": name,
+                         "path": p, "error": str(e)})
+                    self._keep_listed.append(name)
+                    _PARTS_OPEN_ERRORS.inc()
+            except OSError as e:
+                # transient open failure (fd exhaustion, permissions) is
+                # NOT evidence of torn bytes: keep the part in place and
+                # listed so a fixed environment serves it again, but
+                # report it — the data is missing from results NOW, and
+                # that must be loud, not silent
+                logger.errorf("partition %s: cannot open part %s (kept "
+                              "listed, serving partial): %s",
+                              self.name, name, e)
+                self.quarantined.append(
+                    {"store": "storage", "in": self.name, "part": name,
+                     "path": p, "error": str(e)})
+                self._keep_listed.append(name)
+                _PARTS_OPEN_ERRORS.inc()
         # remove crash leftovers: only dirs NOT listed in parts.json
+        # (the quarantine dir is bookkeeping, never a leftover)
         for name in os.listdir(self.path):
             full = os.path.join(self.path, name)
-            if name == "parts.json" or not os.path.isdir(full):
+            if name == "parts.json" or name == QUARANTINE_DIR or \
+                    not os.path.isdir(full):
                 continue
             if name not in listed:
                 shutil.rmtree(full, ignore_errors=True)
+        if self.quarantined:
+            # drop MOVED names from the manifest (kept-in-place failures
+            # stay listed via _keep_listed) so a later restart doesn't
+            # re-sweep or re-report healed state
+            self._write_parts_json_locked()
         if self._file_parts:
             seqs = [int(os.path.basename(p.path).split("_")[1])
                     for p in self._file_parts]
@@ -761,7 +831,9 @@ class Partition:
         except BaseException:
             w.abort()
             raise
-        return Part(os.path.join(self.path, name))
+        # trusted: this process computed the checksums moments ago;
+        # re-verifying would re-read the whole part per flush/merge
+        return Part(os.path.join(self.path, name), trusted=True)
 
     def _merge_file_parts(self, parts, deleted_ids=None,
                           min_valid_ts=None):
@@ -788,6 +860,10 @@ class Partition:
                 flightrec.rec("merge:part", t0, dt, arg=self.name)
             finally:
                 _ACTIVE_MERGES.dec()
+            # the merged part dir is renamed into place but NOT yet in
+            # parts.json: a crash here must recover to the OLD part set
+            # (the unlisted merged dir is swept at reopen)
+            faultinject.fire("merge:post_rename_pre_manifest")
             with self._lock:
                 survivors = [p for p in self._file_parts if p not in olds]
                 self._file_parts = survivors + (
@@ -955,3 +1031,6 @@ class Partition:
             names = [os.path.basename(p.path) for p in self._file_parts]
         with open(os.path.join(dst, "parts.json"), "w") as f:
             json.dump({"parts": names}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        fslib.fsync_dir(dst)

@@ -25,6 +25,9 @@ from ..devtools.locktrace import make_lock, make_rlock
 from ..utils import flightrec, logger
 from ..utils import metrics as metricslib
 from ..utils import workpool
+from ..utils.deadline import Budget, DeadlineExceededError  # noqa: F401 —
+# DeadlineExceededError re-exported: RPC handlers and tests catch the
+# storage-side abort through the storage module's public surface
 from ..utils.workingset import WorkingSetCache
 from .dedup import deduplicate
 from .index_db import IndexDB, date_of_ms
@@ -63,6 +66,25 @@ _SHARD_WAIT = metricslib.REGISTRY.float_counter(
 #: fan per-day registrations across the pool only past this size (small
 #: batches lose more to task handoff than they gain)
 _FANOUT_MIN_REGS = 64
+
+# storage-side deadline aborts (ROADMAP item 3): a search whose shipped
+# budget expires mid-index-scan/mid-fetch stops HERE instead of burning
+# the dead query's full server-side cost
+_DEADLINE_ABORTS = metricslib.REGISTRY.counter(
+    "vm_storage_deadline_aborts_total")
+
+
+class _ScanBudget(Budget):
+    """Budget whose clock checks double as the ``storage:scan`` chaos
+    seam: an injected delay there dilates the scan so the chaos suite
+    can prove a query aborts within ~one check interval of expiry."""
+
+    __slots__ = ()
+
+    def check(self) -> None:
+        if faultinject.active():
+            faultinject.fire("storage:scan")
+        super().check()
 
 
 class _IngestShard:
@@ -246,6 +268,11 @@ class Storage:
         self._check_format()
         self.idb = IndexDB(os.path.join(path, "indexdb"))
         self.table = Table(os.path.join(path, "data"), dedup_interval_ms)
+        # open-time integrity verdict, frozen for the process lifetime:
+        # quarantine/open-error state only changes at open (see
+        # last_partial) and the flag is read per query
+        self._has_quarantine = bool(self.table.quarantined() or
+                                    self.idb.quarantined())
         self._tsid_cache: dict[bytes, TSID] = {}
         # fast-path cache keyed by the UNMARSHALED label identity (the
         # reference's MetricNameRaw-keyed tsidCache, storage.go:1874): rows
@@ -947,7 +974,8 @@ class Storage:
                                max_ts: int,
                                dedup_interval_ms: int | None = None,
                                max_series: int | None = None, tenant=(0, 0),
-                               max_chunk_samples: int = 50_000_000):
+                               max_chunk_samples: int = 50_000_000,
+                               deadline: float = 0.0):
         """Bounded-memory fetch: yields ColumnarSeries chunks over
         disjoint series subsets, each holding at most ~max_chunk_samples
         resident samples (the tmp-blocks-spool role,
@@ -965,7 +993,8 @@ class Storage:
         def fetch(lo: int, k: int):
             return self.search_columns(filters, min_ts, max_ts,
                                        dedup_interval_ms, None, tenant,
-                                       _tsids=tsids[lo:lo + k])
+                                       _tsids=tsids[lo:lo + k],
+                                       deadline=deadline)
 
         # pipelined prefetch: chunk i+1's fetch/decode runs on the shared
         # work pool while the consumer rolls chunk i up (the netstorage
@@ -1013,18 +1042,32 @@ class Storage:
                 #                        GeneratorExit being re-raised
             raise
 
+    #: eval threads the query deadline down (see ClusterStorage): an
+    #: expired budget aborts the scan/fetch mid-flight with the typed
+    #: DeadlineExceededError instead of completing for a dead caller
+    supports_search_deadline = True
+
     def search_columns(self, filters: list[TagFilter], min_ts: int,
                        max_ts: int, dedup_interval_ms: int | None = None,
                        max_series: int | None = None, tenant=(0, 0),
-                       _tsids=None):
+                       _tsids=None, deadline: float = 0.0):
         """Batched columnar search: one native decode pass per part, one
         vectorized assembly into padded (S, N) columns — no per-series
         Python on the fetch path (the netstorage.go:374-421 unpack-worker
         role, done as array passes). Returns a ColumnarSeries with rows
-        ordered by raw metric name (same order as search_series)."""
+        ordered by raw metric name (same order as search_series).
+
+        ``deadline`` (time.monotonic cutoff, 0 = none) is the storage-
+        side half of deadline propagation: the budget is checked every
+        N series during the index scan and once per fetch unit, and an
+        expired query raises :class:`DeadlineExceededError` (counted in
+        ``vm_storage_deadline_aborts_total``) instead of burning the
+        dead query's full server-side cost."""
         from .columnar import ColumnarSeries, assemble
         interval = (self.dedup_interval_ms if dedup_interval_ms is None
                     else dedup_interval_ms)
+        budget = (_ScanBudget(deadline, on_abort=_DEADLINE_ABORTS.inc)
+                  if deadline else None)
         # per-tenant QoS admission: a tenant at its VM_TENANT_QUOTAS cap
         # queues (and sheds) against itself instead of starving others
         with workpool.SEARCH_GATE.admit(tenant):
@@ -1036,13 +1079,19 @@ class Storage:
                     f"storage:search:{tenant[0]}:{tenant[1]}")
             return self._search_columns_gated(
                 filters, min_ts, max_ts, interval, max_series, tenant,
-                _tsids, ColumnarSeries, assemble)
+                _tsids, ColumnarSeries, assemble, budget)
 
     def _search_columns_gated(self, filters, min_ts, max_ts, interval,
                               max_series, tenant, _tsids, ColumnarSeries,
-                              assemble):
+                              assemble, budget=None):
         t_ph = time.perf_counter()
-        tsids = (self.idb.search_tsids(filters, min_ts, max_ts, tenant)
+        if budget is not None:
+            budget.check()  # gate queue wait burned the budget already?
+        tsids = (self.idb.search_tsids(
+                     filters, min_ts, max_ts, tenant,
+                     check=budget.tick if budget is not None else None,
+                     scan_check=budget.check if budget is not None
+                     else None)
                  if _tsids is None else _tsids)
         t_ph = _phase_lap("index_search", t_ph)
         empty = ColumnarSeries.empty()
@@ -1059,8 +1108,11 @@ class Storage:
         pieces = self.table.collect_columns(
             tsid_set, min_ts, max_ts,
             tsid_lo=tsids[0].sort_key(), tsid_hi=tsids[-1].sort_key(),
-            as_float=fused)
+            as_float=fused,
+            check=budget.check if budget is not None else None)
         t_ph = _phase_lap("assemble_native" if fused else "collect", t_ph)
+        if budget is not None:
+            budget.check()  # before the decode/assembly tail
         if not pieces:
             return empty
         if fused:
@@ -1193,11 +1245,13 @@ class Storage:
     def search_series(self, filters: list[TagFilter], min_ts: int,
                       max_ts: int, dedup_interval_ms: int | None = None,
                       max_series: int | None = None,
-                      tenant=(0, 0)) -> list[SeriesData]:
+                      tenant=(0, 0),
+                      deadline: float = 0.0) -> list[SeriesData]:
         """Decoded per-series rows, cross-part merged, deduped, clipped —
         thin per-series view over search_columns."""
         cols = self.search_columns(filters, min_ts, max_ts,
-                                   dedup_interval_ms, max_series, tenant)
+                                   dedup_interval_ms, max_series, tenant,
+                                   deadline=deadline)
         return cols.to_series_list()
 
     def _search_series_blocks(self, filters: list[TagFilter], min_ts: int,
@@ -1251,6 +1305,30 @@ class Storage:
                                         stale_blocks=blocks)))
         out.sort(key=lambda rs: rs[0])
         return [sd for _, sd in out]
+
+    # -- integrity / partial-result surface ------------------------------
+
+    def quarantine_report(self) -> list[dict]:
+        """Every part moved aside by the open-time integrity check,
+        across all three stores (data partitions, the global mergeset,
+        indexdb month tables) — the /api/v1/status/quarantine payload."""
+        return self.table.quarantined() + self.idb.quarantined()
+
+    @property
+    def last_partial(self) -> bool:
+        """A store that quarantined anything serves LOUDLY partial:
+        every result carries isPartial=True until the operator restores
+        or discards the quarantined parts (the opposite of the old
+        silent-drop behavior).  Cached at open — quarantine only happens
+        at open time (partitions/tables created later start empty), and
+        this property sits on the serving hot path (meta frames, eval
+        partial capture, result-cache puts)."""
+        return self._has_quarantine
+
+    def reset_partial(self) -> None:
+        """Per-request reset hook (ClusterStorage protocol): quarantine
+        partiality is persistent state, not per-query, so nothing to
+        clear."""
 
     def label_names(self, min_ts=None, max_ts=None,
                     tenant=(0, 0)) -> list[str]:
@@ -1456,6 +1534,10 @@ class Storage:
         name = time.strftime("%Y%m%d%H%M%S") + f"-{int(time.time_ns()) % 10000:04d}"
         dst = os.path.join(self.snapshots_dir(), name)
         self.table.snapshot_to(os.path.join(dst, "data"))
+        # crashpoint: dying here leaves a half-built snapshot dir — the
+        # live store is untouched (hardlinks only) and the partial
+        # snapshot is inert, never auto-restored
+        faultinject.fire("snapshot:mid")
         self.idb.table.create_snapshot_at(
             os.path.join(dst, "indexdb", "global"))
         for mname, t in self.idb.snapshot_month_tables():

@@ -121,11 +121,16 @@ class Table:
 
     def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
                         tsid_lo=None, tsid_hi=None, mids_sorted=None,
-                        as_float=False):
+                        as_float=False, check=None):
         """Batched per-partition block collection (see
         Partition.collect_units); returns a flat list of pieces —
         mantissa 5-tuples, or float 4-tuples under ``as_float`` (the
         VM_NATIVE_ASSEMBLE fused kernel).
+
+        ``check`` (optional zero-arg callable, the storage-side deadline
+        budget) runs before each fetch unit: an expired query aborts
+        between part decodes instead of fetching every remaining part
+        for a dead caller (the exception propagates through the pool).
 
         The per-partition/per-part units fan across the shared work pool
         (utils/workpool — the netstorage unpack-worker role): the fused
@@ -145,6 +150,8 @@ class Table:
             units.extend(p.collect_units(tsid_set, min_ts, max_ts,
                                          tsid_lo, tsid_hi, mids_sorted,
                                          as_float))
+        if check is not None:
+            units = [(lambda u=u: (check(), u())[1]) for u in units]
         from ..utils import workpool
         return [piece for pieces in workpool.POOL.run(units)
                 for piece in pieces]
@@ -207,6 +214,13 @@ class Table:
             parts = list(self._partitions.values())
         for p in parts:
             p.snapshot_to(os.path.join(dst, p.name))
+
+    def quarantined(self) -> list[dict]:
+        """Open-time integrity quarantines across every partition (the
+        loud replacement for silently dropping unopenable parts)."""
+        with self._lock:
+            parts = list(self._partitions.values())
+        return [q for p in parts for q in p.quarantined]
 
     @property
     def rows(self) -> int:
