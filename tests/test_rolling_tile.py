@@ -59,9 +59,11 @@ def _run(store, q, engine, start, end):
 
 
 def _rolling_tiles(engine):
+    # resident rolling windows live in the DeviceWindowCache now
     from victoriametrics_tpu.query.tpu_engine import RollingTile
-    return [v for v in (engine._aux or {}).values()
-            if isinstance(v, RollingTile)]
+    wc = engine._wcache
+    vals = list(wc._entries.values()) if wc is not None else []
+    return [v for v in vals if isinstance(v, RollingTile)]
 
 
 def _check(host, dev, q=""):

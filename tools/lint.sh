@@ -19,6 +19,11 @@
 # Then a single-crashpoint smoke (one armed kill -9 seam + clean-reopen
 # check, ~3s): the crash-injection harness itself must not rot between
 # full tools/chaos.sh runs.  VMT_NO_CRASH_SMOKE=1 skips it.
+#
+# And a device-residency smoke (tools/device.sh with the tier-1 guard
+# test): the virtual 8-device mesh + resident-window upload guard must
+# not rot between full device.sh runs; probe hang -> loud skip.
+# VMT_NO_DEVICE_SMOKE=1 skips it.
 set -eu
 cd "$(dirname "$0")/.."
 if [ "$#" -eq 0 ]; then
@@ -27,6 +32,10 @@ fi
 python -m victoriametrics_tpu.devtools.lint "$@"
 if [ "${VMT_NO_FLIGHT_SMOKE:-0}" != "1" ]; then
     python -m victoriametrics_tpu.devtools.flight_overhead
+fi
+if [ "${VMT_NO_DEVICE_SMOKE:-0}" != "1" ]; then
+    sh tools/device.sh \
+        "tests/test_device_residency.py::test_refresh_uploads_only_tail_on_mesh"
 fi
 if [ "${VMT_NO_CRASH_SMOKE:-0}" != "1" ]; then
     exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \

@@ -26,8 +26,8 @@ import struct
 
 from ..ingest import parsers, remote_write
 from ..ingest.otlp import parse_otlp
-from ..query.exec import exec_instant, exec_query
-from ..query.eval import QueryError, filters_from_metric_expr
+from ..query.exec import exec_instant, exec_query, parse_cached
+from ..query.eval import QueryError, filter_sets_from_metric_expr
 from ..query.metricsql import parse as mql_parse
 from ..query.metricsql.ast import MetricExpr
 from ..query.metricsql.parser import ParseError, parse_duration_ms
@@ -117,6 +117,17 @@ class ConcurrencyGate:
 
     def __exit__(self, *exc):
         self._sem.release()
+
+
+def _device_window_ready(ec, q: str) -> bool:
+    """Does the device plane hold a resident rolling window able to serve
+    this query O(new samples)?  Parse failures answer False (the normal
+    path will surface the error with its usual handling)."""
+    from ..query.eval import device_window_ready
+    try:
+        return device_window_ready(ec, parse_cached(q))
+    except Exception:
+        return False
 
 
 class PrometheusAPI:
@@ -578,6 +589,19 @@ class PrometheusAPI:
                      and not self._UNCACHEABLE_RE.search(q))
         if not cacheable:
             return exec_query(ec, q)
+        if ec.tpu is not None and _device_window_ready(ec, q):
+            # device-resident serving: the device plane holds a rolling
+            # window for this query shape, so the FULL eval is O(new
+            # samples) — advance_rolling fetches/uploads only the tail
+            # columns and the [G, T] ring reuses every covered column.
+            # The host ring cache still gets the put() below, so a later
+            # device decline falls back to the host suffix path with a
+            # warm prefix instead of a cold rebuild.
+            ec.tracer.printf("device window resident: full eval")
+            rows = exec_query(ec, q)
+            if not getattr(self.storage, "last_partial", False):
+                rcache.put(ec, q, rows, now_ms, trust_raw=False)
+            return rows
         cached, new_start = rcache.get(ec, q, now_ms)
         if cached is not None and new_start > ec.end:
             ec.tracer.printf("rollup cache: full hit")
@@ -642,7 +666,9 @@ class PrometheusAPI:
             e = mql_parse(m)
             if not isinstance(e, MetricExpr):
                 raise QueryError(f"match[] must be a series selector: {m}")
-            out.append(filters_from_metric_expr(e))
+            # multiple match[] values are already a union, so a selector's
+            # OR'd filter sets expand into extra entries
+            out.extend(filter_sets_from_metric_expr(e))
         return out
 
     def _time_range(self, req: Request, full_default: bool = False):

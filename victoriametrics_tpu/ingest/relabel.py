@@ -49,13 +49,12 @@ class RelabelConfig:
             ast = mql_parse(str(e))
             if not isinstance(ast, MetricExpr):
                 raise ValueError(f"relabel if must be a series selector: {e}")
-            filters = []
-            for f in ast.label_filters:
-                key = b"" if f.label == "__name__" else f.label.encode()
-                filters.append(TagFilter(key, f.value.encode(),
-                                         negate=f.is_negative,
-                                         regex=f.is_regexp))
-            out.append(filters)
+            # `if` selectors OR across entries already; OR'd filter sets
+            # ({a="b" or c="d"}) expand into extra entries; one shared
+            # lowering (query/eval) keeps `if` semantics identical to
+            # query-side selectors
+            from ..query.eval import filter_sets_from_metric_expr
+            out.extend(filter_sets_from_metric_expr(ast))
         return out
 
     def _if_matches(self, labels: dict) -> bool:

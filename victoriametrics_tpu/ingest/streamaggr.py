@@ -69,12 +69,12 @@ def _match_selectors(expr):
         ast = mql_parse(str(e))
         if not isinstance(ast, MetricExpr):
             raise ValueError(f"streamaggr match must be a selector: {e}")
-        filters = []
-        for f in ast.label_filters:
-            key = b"" if f.label == "__name__" else f.label.encode()
-            filters.append(TagFilter(key, f.value.encode(),
-                                     negate=f.is_negative, regex=f.is_regexp))
-        out.append(filters)
+        # the match list is already a union, so a selector's OR'd filter
+        # sets ({a="b" or c="d"}) expand into extra entries; one shared
+        # lowering (query/eval) keeps ingest- and query-side semantics
+        # identical
+        from ..query.eval import filter_sets_from_metric_expr
+        out.extend(filter_sets_from_metric_expr(ast))
     return out
 
 

@@ -15,6 +15,8 @@ device_put goes up in <=8MB slices re-assembled on device.
 from __future__ import annotations
 
 import collections
+import os
+import time as _time
 import weakref
 
 import jax
@@ -23,9 +25,51 @@ import numpy as np
 
 from ..devtools.locktrace import make_lock
 from ..devtools.racetrace import traced_fields
+from ..utils import flightrec as _flightrec
 from ..utils import metrics as metricslib
 
 UPLOAD_CHUNK_BYTES = 8 << 20
+
+# device-plane link accounting: EVERY host->device and device->host byte
+# of the query engine funnels through count_upload/count_download (the
+# residency guard test asserts a rolling refresh uploads only tail
+# columns, and a bench leg divides link traffic by refresh)
+_BYTES_UPLOADED = metricslib.REGISTRY.counter(
+    "vm_device_bytes_uploaded_total")
+_BYTES_DOWNLOADED = metricslib.REGISTRY.counter(
+    "vm_device_bytes_downloaded_total")
+
+
+def count_upload(nbytes: int) -> None:
+    _BYTES_UPLOADED.inc(int(nbytes))
+
+
+def count_download(nbytes: int) -> None:
+    _BYTES_DOWNLOADED.inc(int(nbytes))
+
+
+def bytes_uploaded() -> int:
+    return _BYTES_UPLOADED.get()
+
+
+def bytes_downloaded() -> int:
+    return _BYTES_DOWNLOADED.get()
+
+
+def timed_transfer(span: str, nbytes: int, fn):
+    """Run one H2D/D2H transfer `fn`, counting its bytes and recording a
+    flight span for transfers big enough to matter — the ONE place the
+    device:upload/device:download span shape is defined (shard_put,
+    chunked_device_put and the kernel-result pull all funnel here)."""
+    (count_upload if span == "device:upload" else count_download)(nbytes)
+    if nbytes < (1 << 20):
+        return fn()
+    t0 = _time.perf_counter()
+    try:
+        return fn()
+    finally:
+        _flightrec.rec(span, t0, _time.perf_counter() - t0, arg=nbytes)
+
 
 # cache self-metrics (reference vm_cache_{requests,misses}_total +
 # vm_cache_{size_bytes,entries}{type=...}); gauges sum over every live
@@ -46,6 +90,11 @@ metricslib.REGISTRY.gauge(
 def chunked_device_put(x: np.ndarray, device=None) -> jax.Array:
     """device_put in <=8MB row-slices, concatenated on device."""
     device = device or jax.devices()[0]
+    return timed_transfer("device:upload", x.nbytes,
+                          lambda: _chunked_device_put(x, device))
+
+
+def _chunked_device_put(x: np.ndarray, device) -> jax.Array:
     nbytes = x.nbytes
     if nbytes <= UPLOAD_CHUNK_BYTES or x.ndim == 0 or x.shape[0] <= 1:
         return jax.device_put(x, device)
@@ -53,6 +102,94 @@ def chunked_device_put(x: np.ndarray, device=None) -> jax.Array:
     parts = [jax.device_put(x[i:i + rows_per_chunk], device)
              for i in range(0, x.shape[0], rows_per_chunk)]
     return jnp.concatenate(parts, axis=0)
+
+
+# device-resident window cache health: hits = refreshes served from an
+# HBM-resident window (rolling advance or warm exact-key reuse) without
+# re-uploading the window; evictions = resident windows dropped by the
+# LRU bound; compactions = on-device window slides (samples older than
+# the fetch bound dropped + tile origin rebased, instead of a full
+# re-upload when headroom/int32 run out)
+_WINDOW_HITS = metricslib.REGISTRY.counter(
+    "vm_device_window_cache_hits_total")
+_WINDOW_EVICTIONS = metricslib.REGISTRY.counter(
+    "vm_device_window_cache_evictions_total")
+_WINDOW_COMPACTIONS = metricslib.REGISTRY.counter(
+    "vm_device_window_compactions_total")
+
+
+def device_resident_enabled() -> bool:
+    """Device data residency on?  VM_DEVICE_RESIDENT=0 disables every
+    resident-window reuse path (rolling advance, warm exact-key tile
+    reuse) so each query re-uploads its full window — the loud full-upload
+    escape hatch AND the equality oracle the residency tests diff
+    against."""
+    return os.environ.get("VM_DEVICE_RESIDENT", "1") != "0"
+
+
+def count_window_hit() -> None:
+    _WINDOW_HITS.inc()
+
+
+def count_window_compaction() -> None:
+    _WINDOW_COMPACTIONS.inc()
+
+
+class DeviceWindowCache:
+    """Host-side registry of device-RESIDENT rolling windows (the
+    DeviceWindowCache of ISSUE 12): each entry pins the device buffers of
+    one query shape's packed (S, T) window (RollingTile) plus its group
+    assignment and the host-side ring copy of the [G, T] aggregate, so a
+    rolling refresh uploads only the suffix tail columns and the rollup
+    never re-crosses the host boundary until the final [G, T] pull.
+
+    Entry-count LRU (VM_DEVICE_WINDOWS, default 256): each window's HBM
+    cost is bounded by the tile shapes, and the entries that matter (live
+    dashboards) are re-touched every refresh.  Evictions tick
+    vm_device_window_cache_evictions_total — a steadily climbing eviction
+    counter on a stable dashboard fleet means the cap is too small."""
+
+    def __init__(self, cap: int | None = None):
+        if cap is None:
+            try:
+                cap = int(os.environ.get("VM_DEVICE_WINDOWS", "256"))
+            except ValueError:
+                cap = 256
+        self.cap = max(cap, 1)
+        self._lock = make_lock("models.DeviceWindowCache._lock")
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+
+    def get(self, key):
+        with self._lock:
+            v = self._entries.get(key)
+            if v is not None:
+                self._entries.move_to_end(key)
+            return v
+
+    def peek(self, key):
+        """get() without the LRU touch (readiness probes must not keep an
+        otherwise-dead entry alive)."""
+        with self._lock:
+            return self._entries.get(key)
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.cap:
+                self._entries.popitem(last=False)
+                _WINDOW_EVICTIONS.inc()
+
+    def invalidate(self, key=None) -> None:
+        with self._lock:
+            if key is None:
+                self._entries.clear()
+            else:
+                self._entries.pop(key, None)
+
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
 
 
 @traced_fields("_entries", "_sizes", "_bytes")

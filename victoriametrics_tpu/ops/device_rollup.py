@@ -670,6 +670,40 @@ def append_tile(ts: jnp.ndarray, values: jnp.ndarray, counts: jnp.ndarray,
     return ts2, v2, counts + new_counts.astype(counts.dtype)
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def compact_tile(ts: jnp.ndarray, values: jnp.ndarray, counts: jnp.ndarray,
+                 cutoff_rel, delta):
+    """Window-slide compaction of a rolling tile: drop each row's samples
+    older than `cutoff_rel` (tile-relative ms, exclusive — samples AT the
+    cutoff survive, matching the inclusive fetch lower bound), shift the
+    survivors to the row front and rebase timestamps by `delta`
+    (= new_base - old_base; both traced int32, so sliding windows never
+    recompile).  The sample buffers are donated like append_tile's — the
+    caller replaces its references with the returned arrays.  Freed tail
+    positions are restored to TS_PAD so every kernel's masks stay valid.
+
+    Correctness: rows are time-sorted, so dropped samples form a prefix.
+    Samples older than the query fetch bound contribute nothing to any
+    rollup (window masks exclude them; prev-sample accesses are gated by
+    min_ts — see rollup_tile), so compacting at the CURRENT fetch_lo is
+    invisible to this and every later query whose fetch bound is >= it;
+    older-reaching queries decline via RollingTile.lo_ms and rebuild."""
+    S, N = ts.shape
+    k = jnp.arange(N, dtype=jnp.int32)[None, :]
+    valid = k < counts[:, None]
+    drop = jnp.sum(valid & (ts < jnp.int32(cutoff_rel)), axis=1,
+                   dtype=jnp.int32)
+    new_counts = counts - drop
+    idx = jnp.clip(drop[:, None] + k, 0, N - 1)
+    live = k < new_counts[:, None]
+    ts2 = jnp.where(live,
+                    jnp.take_along_axis(ts, idx, axis=1) - jnp.int32(delta),
+                    TS_PAD)
+    v2 = jnp.where(live, jnp.take_along_axis(values, idx, axis=1),
+                   jnp.zeros((), values.dtype))
+    return ts2, v2, new_counts
+
+
 def pack_series(series: list[tuple[np.ndarray, np.ndarray]], start_ms: int,
                 n_pad: int | None = None, dtype=np.float64
                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

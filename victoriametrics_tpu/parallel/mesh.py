@@ -3,19 +3,24 @@
 The reference scales reads by fanning a query out to every vmstorage node and
 merging per-node partial aggregates (lib/vmselectapi scatter-gather +
 aggr_incremental.go map-reduce). On TPU the same shape becomes: shard the
-series axis over a `jax.sharding.Mesh`, compute per-shard segment-reductions,
-and psum partials over ICI — replacing the per-worker merge loop with one XLA
-collective.
+series axis over a `jax.sharding.Mesh` and let GSPMD partition the
+segment-reduction — the cross-shard merge is the XLA-inserted all-reduce,
+not a hand-written psum loop.
 
 Two parallel axes are first-class:
 
-- AXIS_SERIES ("series"): data-parallel over series. Each device rolls up its
-  series shard and psums the [G, T] group partials.
+- AXIS_SERIES ("series"): data-parallel over series. The single-device
+  fused kernel (ops.device_rollup.rollup_aggregate_tile) is jit'd with
+  declarative in/out shardings from the partition-rule table
+  (parallel/partition.py); each device rolls up its series shard and XLA
+  reduces the [G, T] group moments across shards.
 - AXIS_TIME ("time"): sequence-parallel over the *sample* axis (the
   long-context analog). Each device holds a contiguous time-slice of every
   series' samples; rollup windows crossing the slice boundary need the tail
   of the left neighbor, exchanged with `lax.ppermute` (ring halo exchange,
-  like ring attention passes KV blocks).
+  like ring attention passes KV blocks). This path keeps an explicit
+  shard_map: the halo exchange is a genuinely manual collective that has
+  no declarative spelling.
 """
 
 from __future__ import annotations
@@ -29,14 +34,12 @@ try:
     from jax import shard_map
 except ImportError:  # pre-0.5 jax exposes it under experimental only
     from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.device_rollup import (finalize_group_moments,
-                                 partial_group_moments, rollup_tile)
+from ..ops.device_rollup import rollup_tile
 from ..ops.rollup_np import RollupConfig
-
-AXIS_SERIES = "series"
-AXIS_TIME = "time"
+from .partition import (AXIS_SERIES, AXIS_TIME, input_shardings,
+                        replicated)
 
 
 def make_mesh(n_series: int | None = None, n_time: int = 1,
@@ -65,39 +68,36 @@ def sharded_rollup_aggregate(mesh: Mesh, rollup_func: str, aggr: str,
                              cfg: RollupConfig, num_groups: int):
     """Build a jitted aggr(rollup(...)) running series-sharded on the mesh.
 
+    Declarative GSPMD partitioning: the SAME fused kernel the single-device
+    engine runs (ops.device_rollup.rollup_aggregate_tile) is jit'd with
+    in/out shardings derived from the partition-rule table — the
+    per-shard segment moments and the cross-shard reduction are one XLA
+    program, with the all-reduce inserted by the partitioner instead of a
+    hand-rolled shard_map closure + psum.
+
     Inputs: ts [S, N] int32, values [S, N], counts [S] int32,
     group_ids [S] int32, shift int32 scalar (rolling-tile grid rebase, 0
     for freshly built tiles), min_ts int32 scalar, v0 [S] (per-series
     rebase offsets of f32 tiles; zeros otherwise); S must be divisible by
     the series-axis size. Output: [G, T] fully replicated.
     """
+    from ..ops.device_rollup import rollup_aggregate_tile
+    in_sh = input_shardings(mesh, (("ts", 2), ("values", 2), ("counts", 1),
+                                   ("group_ids", 1), ("shift", 0),
+                                   ("min_ts", 0), ("v0", 1)))
 
-    _CROSS_REDUCE = {"sum": jax.lax.psum, "min": jax.lax.pmin,
-                     "max": jax.lax.pmax}
-
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P(AXIS_SERIES, None), P(AXIS_SERIES, None),
-                  P(AXIS_SERIES), P(AXIS_SERIES), P(), P(),
-                  P(AXIS_SERIES)),
-        out_specs=P())
-    def step_moments(ts, values, counts, group_ids, shift, min_ts, v0):
-        rolled = rollup_tile(rollup_func, ts - jnp.int32(shift), values,
-                             counts, cfg, min_ts, v0)
-        # psum/pmin/pmax the raw moments across shards, then finalize —
-        # the moment split lives in ops.device_rollup so the single-device
-        # and sharded paths share one aggregation definition.
-        moments = partial_group_moments(aggr, rolled, group_ids, num_groups)
-        reduced = {k: (_CROSS_REDUCE[kind](arr, AXIS_SERIES), kind)
-                   for k, (arr, kind) in moments.items()}
-        return finalize_group_moments(aggr, reduced)
-
-    jitted = jax.jit(step_moments)
+    @functools.partial(jax.jit, in_shardings=in_sh,
+                       out_shardings=replicated(mesh))
+    def step(ts, values, counts, group_ids, shift, min_ts, v0):
+        return rollup_aggregate_tile(rollup_func, aggr, ts, values, counts,
+                                     group_ids, cfg, num_groups, shift,
+                                     min_ts, v0)
 
     def call(ts, values, counts, group_ids, shift, min_ts, v0=None):
         if v0 is None:
             v0 = jnp.zeros(ts.shape[0], values.dtype)
-        return jitted(ts, values, counts, group_ids, shift, min_ts, v0)
+        return step(ts, values, counts, group_ids, jnp.int32(shift),
+                    jnp.int32(min_ts), v0)
 
     return call
 

@@ -45,13 +45,35 @@ class QueryError(ValueError):
     pass
 
 
-def filters_from_metric_expr(me: MetricExpr) -> list[TagFilter]:
+def _tag_filters(fs) -> list[TagFilter]:
     out = []
-    for f in me.label_filters:
+    for f in fs:
         key = b"" if f.label == "__name__" else f.label.encode()
         out.append(TagFilter(key, f.value.encode(), negate=f.is_negative,
                              regex=f.is_regexp))
     return out
+
+
+def filter_sets_from_metric_expr(me: MetricExpr) -> list[list[TagFilter]]:
+    """All OR'd filter sets of a selector as storage TagFilter lists."""
+    return [_tag_filters(fs) for fs in me.filter_sets()]
+
+
+def filters_from_metric_expr(me: MetricExpr, storage=None):
+    """Storage-facing filters for a selector: a plain list[TagFilter] for
+    the common single-set case; a list of filter SETS for `{a="b" or
+    c="d"}` selectors (plain Storage unions them at the tsid level —
+    supports_filter_union).  Backends without union support fail loudly
+    instead of silently matching only the first set."""
+    sets = filter_sets_from_metric_expr(me)
+    if len(sets) == 1:
+        return sets[0]
+    if storage is not None and \
+            not getattr(storage, "supports_filter_union", False):
+        raise QueryError(
+            "selector-level `or` filters are not supported by this "
+            "storage backend yet; rewrite the query as `expr_a or expr_b`")
+    return sets
 
 
 def eval_expr(ec: EvalConfig, e: Expr) -> list[Timeseries]:
@@ -286,7 +308,7 @@ def _fetch_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
     # current)
     fetch_info = (fetch_lo, end,
                   getattr(ec.storage, "data_version", None))
-    filters = filters_from_metric_expr(me)
+    filters = filters_from_metric_expr(me, ec.storage)
     with ec.tracer.new_child(trace_label + " %s window=%dms", me,
                              lookback) as qt:
         try:
@@ -540,7 +562,10 @@ def _aggregate_absent_over_time(ec: EvalConfig, expr,
     matching series has a sample (eval.go:990 aggregateAbsentOverTime);
     labels come from the selector's literal equality filters."""
     labels = []
-    if isinstance(expr, MetricExpr):
+    # selector labels apply only for a SINGLE filter set: with OR'd sets
+    # there is no one label combination that "was absent" (the reference
+    # applies them only when len(labelFilterss) == 1)
+    if isinstance(expr, MetricExpr) and not expr.or_sets:
         for f in expr.label_filters:
             if not f.is_negative and not f.is_regexp and \
                     f.label != "__name__":
@@ -840,13 +865,10 @@ def _tile_cache_key(ec: EvalConfig, expr, cfg: RollupConfig, fetch_info):
             dedup, ver)
 
 
-def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
-                           ) -> list[Timeseries] | None:
-    """aggr by (...)(rollup(selector)) fused on device: rollup + segment
-    aggregation in one kernel so only [G, T] crosses the link (the
-    incremental-aggregation pushdown; None -> host path)."""
-    if ec.tpu is None:
-        return None
+def _device_aggr_shape(ae: AggrFuncExpr):
+    """(phi, func, rollup-arg) of a device-fusable aggr(rollup(selector))
+    expression, or None when the shape can't fuse (shared by the fused
+    dispatch and the serving layer's residency-readiness probe)."""
     phi = None
     if ae.name in ("quantile", "median"):
         # quantile(phi, q) fuses when phi is a literal; median = 0.5
@@ -878,7 +900,90 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
             not isinstance(rarg.expr, MetricExpr) or rarg.expr.is_empty() or \
             rarg.needs_subquery() or rarg.at is not None:
         return None
+    return phi, func, rarg
+
+
+def _device_roll_keys(ec: EvalConfig, ae: AggrFuncExpr, func: str, rarg,
+                      phi, window: int):
+    """(roll_state_key, roll_tile_key) of the device-resident rolling
+    window that serves this query shape, or (None, None) when the shape
+    cannot roll (time-valued funcs read absolute grids; adjustable
+    windows depend on per-fetch data)."""
+    from ..ops.device_rollup import TIME_VALUED_FUNCS
+    from .rollup_funcs import ADJUSTABLE_WINDOW_FUNCS
+    if func in TIME_VALUED_FUNCS or func == "lifetime" or \
+            (window <= 0 and (func in ADJUSTABLE_WINDOW_FUNCS
+                              or func == "default_rollup")):
+        return None, None
+    roll_state_key = ("roll-aggr", str(rarg.expr), ec.tenant, func,
+                      ae.name, phi, tuple(ae.grouping), ae.without,
+                      ec.max_series)
+    roll_tile_key = ("roll-tile", str(rarg.expr), ec.tenant, ec.max_series)
+    return roll_state_key, roll_tile_key
+
+
+def device_window_ready(ec: EvalConfig, e: Expr) -> bool:
+    """True when the device plane holds a RESIDENT rolling window able to
+    serve expression `e` O(new samples): the serving layer then runs the
+    full-window eval (device rolling advance + [G, T] ring reuse) instead
+    of the host ring-cache suffix path, so the refresh uploads only tail
+    columns and the rollup never re-crosses the host boundary."""
+    if ec.tpu is None or ec.disable_cache or ec.no_device_roll:
+        return False
+    from ..models.tile_cache import device_resident_enabled
+    if not device_resident_enabled():
+        return False
+    if not isinstance(e, AggrFuncExpr):
+        return False
+    shape = _device_aggr_shape(e)
+    if shape is None:
+        return False
+    phi, func, rarg = shape
     from ..ops import rollup_np
+    from .tpu_engine import FUSED_AGGRS
+    if func not in rollup_np.CORE_SUPPORTED or \
+            (phi is None and e.name not in FUSED_AGGRS):
+        return False
+    if getattr(ec.storage, "data_version", None) is None or \
+            getattr(ec.storage, "structural_version", None) is None:
+        return False
+    window = rarg.window.value_ms(ec.step) if rarg.window is not None else 0
+    roll_state_key, _ = _device_roll_keys(ec, e, func, rarg, phi, window)
+    if roll_state_key is None:
+        return False
+    wc = ec.tpu.window_cache()
+    if wc.peek(roll_state_key) is None:
+        return False
+    # persistent-churn backoff: consecutive rolling declines mean this
+    # shape keeps rebuilding FULL windows on device (each rebuild
+    # re-registers the window, so entry existence alone would route the
+    # next refresh right back).  Send it to the host suffix path (O(new
+    # samples)) instead, retrying the device window every 16 refreshes
+    # so shapes whose churn stopped come back to residency.
+    st = wc.peek(("roll-declines",) + roll_state_key)
+    if st is not None and st.get("streak", 0) >= 2:
+        st["skipped"] = st.get("skipped", 0) + 1
+        if st["skipped"] < 16:
+            return False
+        st["streak"] = 0
+        st["skipped"] = 0
+    return True
+
+
+def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
+                           ) -> list[Timeseries] | None:
+    """aggr by (...)(rollup(selector)) fused on device: rollup + segment
+    aggregation in one kernel so only [G, T] crosses the link (the
+    incremental-aggregation pushdown; None -> host path)."""
+    if ec.tpu is None:
+        return None
+    shape = _device_aggr_shape(ae)
+    if shape is None:
+        return None
+    phi, func, rarg = shape
+    from ..models.tile_cache import count_window_hit, device_resident_enabled
+    from ..ops import rollup_np
+    from .rollup_result_cache import RingBlock
     from .tpu_engine import (FUSED_AGGRS, RollingTile, advance_rolling,
                              aux_get, aux_put, group_slots,
                              run_fused_on_tiles, run_quantile_on_tiles,
@@ -908,6 +1013,9 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
         ver = None         # (see EvalConfig.no_device_roll)
     if ec.disable_cache:  # nocache=1 / -search.disableCache bypasses every
         ver = None        # resident-tile reuse path (aux, rolling) too
+    if not device_resident_enabled():
+        ver = None  # VM_DEVICE_RESIDENT=0: full upload every query — the
+        #             loud escape hatch and the residency equality oracle
     if ver is not None:
         aux_key = ("fused-aux", str(rarg.expr), ec.tenant, ec.start, ec.end,
                    ec.step, window, offset, func, ae.name, phi,
@@ -933,6 +1041,7 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                                                  tiles, gids_dev,
                                                  len(group_keys), cfg2)
                     qt.donef("resident tile, %d groups", len(group_keys))
+                count_window_hit()
                 return _emit(out, group_keys)
 
     # rolling shortcut: the same query SHAPE with advanced bounds and/or
@@ -941,30 +1050,22 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
     # guarded) and answers with a traced grid shift — no host fetch, no
     # re-upload, no recompile. The tail-reuse role of the reference's
     # rollupResultCache (rollup_result_cache.go:283) done at tile level.
+    lookback = window if window > 0 else (
+        ec.lookback_delta if func == "default_rollup" else ec.step)
     roll_state_key = roll_tile_key = None
     if ver is not None and \
             getattr(ec.storage, "structural_version", None) is not None:
-        from ..ops.device_rollup import TIME_VALUED_FUNCS
-        from .rollup_funcs import ADJUSTABLE_WINDOW_FUNCS
-        lookback = window if window > 0 else (
-            ec.lookback_delta if func == "default_rollup" else ec.step)
-        if func not in TIME_VALUED_FUNCS and func != "lifetime" and \
-                (window > 0 or (func not in ADJUSTABLE_WINDOW_FUNCS
-                                and func != "default_rollup")):
-            roll_state_key = ("roll-aggr", str(rarg.expr), ec.tenant, func,
-                              ae.name, phi, tuple(ae.grouping), ae.without,
-                              ec.max_series)
-            roll_tile_key = ("roll-tile", str(rarg.expr), ec.tenant,
-                             ec.max_series)
+        roll_state_key, roll_tile_key = _device_roll_keys(
+            ec, ae, func, rarg, phi, window)
     if roll_state_key is not None:
-        stv = aux_get(ec.tpu, roll_state_key)
+        wcache = ec.tpu.window_cache()
+        stv = wcache.get(roll_state_key)
         if stv is not None:
-            rt, gids_dev, group_keys, qx = stv[:4]
-            oc = stv[4] if len(stv) > 4 else None
+            rt, gids_dev, group_keys, qx, rb = stv
             start = ec.start - offset
             end = ec.end - offset
             fetch_lo = start - lookback - ec.lookback_delta
-            filters = filters_from_metric_expr(rarg.expr)
+            filters = filters_from_metric_expr(rarg.expr, ec.storage)
             drop_stale = func not in ("default_rollup",
                                       "stale_samples_over_time")
             qt = ec.tracer.new_child("tpu fused %s(%s) rolling", ae.name,
@@ -998,55 +1099,57 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                 # windows ending there, so only the columns past the
                 # previous end run on device (the rollupResultCache
                 # tail-merge contract, rollup_result_cache.go:283, done at
-                # the [G, T] level; like the reference cache, re-used
-                # columns keep the scrape-interval estimates they were
-                # computed under).
-                T_cols = (end - start) // ec.step + 1
-                out = None
-                if (oc is not None and oc.get("out") is not None
-                        and oc["step"] == ec.step
-                        and oc["window"] == lookback
-                        and start >= oc["start"] and end >= oc["end"]
-                        and (start - oc["start"]) % ec.step == 0
-                        # constant grid shape only: the designed sliding-
-                        # dashboard advance. Variable-length grids (e.g.
-                        # suffix evals, narrowed ranges) recompute fresh
-                        and (start - oc["start"]) == (end - oc["end"])):
-                    shift_cols = (start - oc["start"]) // ec.step
-                    keep = oc["out"].shape[1] - shift_cols
-                    n_new = T_cols - keep
-                    if 0 < keep <= T_cols and n_new >= 0:
-                        if n_new == 0:
-                            out = oc["out"][:, shift_cols:
-                                            shift_cols + T_cols]
-                            qt.printf("pure shift: %d columns reused",
-                                      T_cols)
-                        else:
-                            qk = qt.new_child("fused tail kernel + D2H")
-                            # one extra leading column keeps start < end:
-                            # a single-column sub-grid would hit the
-                            # instant-query maxPrevInterval rule
-                            # (rollup.go:719-728) and flip prev gating
-                            tail = kernel(RollupConfig(
-                                start=end - n_new * ec.step, end=end,
-                                step=ec.step, window=lookback))[:, 1:]
-                            out = np.concatenate(
-                                [oc["out"][:, shift_cols:], tail], axis=1)
-                            qk.donef("[%d, %d] tail, %d columns reused",
-                                     len(group_keys), n_new, keep)
-                if out is None:
+                # the [G, T] level by a RingBlock: the ring-cache entry
+                # machinery with fixed group rows.  Like the reference
+                # cache, re-used columns keep the scrape-interval
+                # estimates they were computed under — the constant-shape
+                # sliding advance only; anything else recomputes fresh.)
+                n_new = rb.try_advance(start, end, ec.step, lookback) \
+                    if rb is not None else None
+                if n_new == 0:
+                    rows_out = rb.commit(start, end, None)
+                    qt.printf("pure shift: %d columns reused", rb.T)
+                elif n_new is not None:
+                    qk = qt.new_child("fused tail kernel + D2H")
+                    # the tail sub-grid must sit ON the eval grid's phase:
+                    # the grid's last column is start + (T-1)*step, which
+                    # is NOT `end` when (end - start) % step != 0 —
+                    # anchoring the sub-grid at `end` would compute
+                    # off-phase columns (a few-percent rate error that
+                    # used to hide inside the documented drift bound).
+                    # One extra leading column keeps start < end: a
+                    # single-column sub-grid would hit the instant-query
+                    # maxPrevInterval rule (rollup.go:719-728) and flip
+                    # prev gating
+                    grid_end = start + ((end - start) // ec.step) * ec.step
+                    tail = kernel(RollupConfig(
+                        start=grid_end - n_new * ec.step, end=grid_end,
+                        step=ec.step, window=lookback))[:, 1:]
+                    rows_out = rb.commit(start, end, tail)
+                    qk.donef("[%d, %d] tail, %d columns reused",
+                             len(group_keys), n_new, rb.T - n_new)
+                else:
                     qk = qt.new_child("fused kernel + D2H")
                     out = kernel(cfg2)
                     qk.donef("[%d, %d] float64 out", len(group_keys),
                              out.shape[1] if out.ndim > 1 else 0)
-                if oc is not None:
-                    oc.update(out=out, start=start, end=end, step=ec.step,
-                              window=lookback)
+                    if rb is not None:
+                        rb.reset(out, start, end, ec.step, lookback)
+                        rows_out = rb.rows()
+                    else:
+                        rows_out = list(out)
                 qt.donef("advanced tile (%d appends), %d groups",
                          rt.appends, len(group_keys))
-                return _emit(out, group_keys)
+                count_window_hit()
+                wcache.invalidate(("roll-declines",) + roll_state_key)
+                return _emit(rows_out, group_keys)
             qt.donef("not advanceable (%s); rebuilding",
                      ec.tpu.last_roll_decline)
+            # feed the serving layer's churn backoff (device_window_ready)
+            dk = ("roll-declines",) + roll_state_key
+            dst = wcache.peek(dk) or {}
+            wcache.put(dk, {"streak": dst.get("streak", 0) + 1,
+                            "skipped": 0})
 
     series, cfg, admission, fetch_info = _fetch_series_for_rollup(
         ec, func, rarg, window, offset)
@@ -1116,7 +1219,8 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                 all(sd.raw_name is not None for sd in series):
             tiles_now = ec.tpu.cache().get(tile_key)
             if tiles_now is not None:
-                rt = aux_get(ec.tpu, roll_tile_key)
+                wcache = ec.tpu.window_cache()
+                rt = wcache.get(roll_tile_key)
                 if not isinstance(rt, RollingTile) or \
                         rt.adopted_key != tile_key:
                     rt = RollingTile(
@@ -1131,11 +1235,11 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
                         row_of_raw={sd.raw_name: i
                                     for i, sd in enumerate(series)},
                         n_samples=n_fetched, adopted_key=tile_key)
-                    aux_put(ec.tpu, roll_tile_key, rt)
-                aux_put(ec.tpu, roll_state_key,
-                        (rt, jnp.asarray(gids), list(group_keys), qx,
-                         {"out": out, "start": cfg.start, "end": cfg.end,
-                          "step": cfg.step, "window": cfg.lookback}))
+                    wcache.put(roll_tile_key, rt)
+                wcache.put(roll_state_key,
+                           (rt, jnp.asarray(gids), list(group_keys), qx,
+                            RingBlock(out, cfg.start, cfg.end, cfg.step,
+                                      cfg.lookback)))
     return _emit(out, group_keys)
 
 
@@ -1195,7 +1299,7 @@ def _try_host_chunked_aggr(ec: EvalConfig, ae) -> list[Timeseries] | None:
     start = ec.start - offset
     end = ec.end - offset
     fetch_lo = start - lookback - ec.lookback_delta
-    filters = filters_from_metric_expr(rarg.expr)
+    filters = filters_from_metric_expr(rarg.expr, ec.storage)
     from .limits import admit_rollup, rollup_memory_limiter
     try:
         n_series_est = st.estimate_series(filters, fetch_lo, end,

@@ -72,6 +72,11 @@ class LabelFilter:
 @dataclasses.dataclass
 class MetricExpr(Expr):
     label_filters: list[LabelFilter] = dataclasses.field(default_factory=list)
+    # additional OR'd filter sets: `{a="b" or c="d"}` parses into
+    # label_filters=[a="b"], or_sets=[[c="d"]] — the reference metricsql's
+    # labelFilterss union (selectors match series satisfying ANY set)
+    or_sets: list[list[LabelFilter]] = dataclasses.field(
+        default_factory=list)
 
     @property
     def metric_name(self) -> str | None:
@@ -80,10 +85,39 @@ class MetricExpr(Expr):
                 return f.value
         return None
 
+    def filter_sets(self) -> list[list[LabelFilter]]:
+        """All OR'd filter sets (always >= 1; single-set selectors return
+        [label_filters])."""
+        if not self.or_sets:
+            return [self.label_filters]
+        return [self.label_filters] + self.or_sets
+
     def is_empty(self) -> bool:
-        return not self.label_filters
+        return not self.label_filters and not self.or_sets
+
+    @staticmethod
+    def _literal_name(fs: list[LabelFilter]) -> str | None:
+        if fs and fs[0].label == "__name__" and not fs[0].is_negative \
+                and not fs[0].is_regexp:
+            return fs[0].value
+        return None
 
     def __str__(self):
+        sets = self.filter_sets()
+        if len(sets) > 1:
+            # shared leading literal name renders once: foo{a="b" or c="d"}
+            # — but only when every set keeps at least one more filter (a
+            # name-only set would render a dangling ` or ` that can't
+            # re-parse; such selectors take the general form below)
+            name = self._literal_name(sets[0])
+            if name is not None and all(
+                    self._literal_name(fs) == name and len(fs) > 1
+                    for fs in sets):
+                body = " or ".join(
+                    ", ".join(str(f) for f in fs[1:]) for fs in sets)
+                return name + "{" + body + "}"
+            return "{" + " or ".join(
+                ", ".join(str(f) for f in fs) for fs in sets) + "}"
         name = self.metric_name
         rest = [f for f in self.label_filters
                 if not (f.label == "__name__" and not f.is_negative

@@ -400,7 +400,8 @@ class Parser:
             e._parens = True
             return e
         if t.kind == "op" and t.text == "{":
-            return MetricExpr(label_filters=self.parse_label_filters())
+            sets = self.parse_label_filters()
+            return MetricExpr(label_filters=sets[0], or_sets=sets[1:])
         if t.kind == "ident":
             return self.parse_ident_expr()
         raise ParseError(f"unexpected token {t.text!r} at {t.pos}")
@@ -447,11 +448,16 @@ class Parser:
             # allow trailing modifiers too (limit)
             self.parse_aggr_modifiers(ae, allow_grouping=False)
             return ae
-        # plain metric selector
-        filters = [LabelFilter("__name__", name)]
+        # plain metric selector; the name distributes over every OR'd
+        # filter set: foo{a="b" or c="d"} == {__name__="foo",a="b"} union
+        # {__name__="foo",c="d"} (metricsql parser.go)
         if self.at_op("{"):
-            filters += self.parse_label_filters()
-        return MetricExpr(label_filters=filters)
+            sets = self.parse_label_filters()
+            return MetricExpr(
+                label_filters=[LabelFilter("__name__", name)] + sets[0],
+                or_sets=[[LabelFilter("__name__", name)] + fs
+                         for fs in sets[1:]])
+        return MetricExpr(label_filters=[LabelFilter("__name__", name)])
 
     def parse_arg_list(self) -> list[Expr]:
         self.expect_op("(")
@@ -503,9 +509,14 @@ class Parser:
         self.expect_op(")")
         return out
 
-    def parse_label_filters(self) -> list[LabelFilter]:
+    def parse_label_filters(self) -> list[list[LabelFilter]]:
+        """{f, f or f, f} -> list of OR'd filter sets (>= 1): the
+        selector-level `or` (reference metricsql parser.go labelFilterss)
+        separates complete filter sets; a series matches when ANY set
+        matches.  A label literally named `or` still parses ({or="x"}):
+        the keyword is only a separator BETWEEN filters."""
         self.expect_op("{")
-        out: list[LabelFilter] = []
+        sets: list[list[LabelFilter]] = [[]]
         while not self.at_op("}"):
             t = self.next()
             if t.kind not in ("ident", "string"):
@@ -525,13 +536,19 @@ class Parser:
                         raise ParseError(f"expected string at {v.pos}")
                 else:
                     raise ParseError(f"expected string at {v.pos}")
-            out.append(LabelFilter(label, v.text,
-                                   is_negative=op_t.text in ("!=", "!~"),
-                                   is_regexp=op_t.text in ("=~", "!~")))
+            sets[-1].append(LabelFilter(label, v.text,
+                                        is_negative=op_t.text in ("!=", "!~"),
+                                        is_regexp=op_t.text in ("=~", "!~")))
             if self.at_op(","):
                 self.next()
+            elif self.at_keyword("or"):
+                kw = self.next()
+                if self.at_op("}"):
+                    raise ParseError(
+                        f"missing label filters after `or` at {kw.pos}")
+                sets.append([])
         self.expect_op("}")
-        return out
+        return sets
 
     # -- WITH templates ----------------------------------------------------
 

@@ -219,6 +219,102 @@ class CacheHit:
                 for s in range(len(self.raws))]
 
 
+class RingBlock:
+    """Fixed-row rolling (G, T) block with column headroom — the ring-cache
+    entry machinery (headroom buffer + in-place column scatter + offset
+    advance + weakref-guarded compaction) reused by the device plane for
+    the host-side copy of the device-resident [G, T] aggregate.  Rows are
+    groups (fixed identity, no churn), so this is `_Entry`/`merge()`
+    stripped to its column mechanics: a rolling refresh writes only the
+    freshly computed tail columns, re-serves the rest as zero-copy
+    read-only row views, and compacts into a fresh buffer when headroom
+    runs out or a still-alive earlier response aliases the buffer (the
+    views-stable contract)."""
+
+    __slots__ = ("buf", "G", "T", "col_off", "start", "end", "step",
+                 "window", "out_refs")
+
+    def __init__(self, out, start: int, end: int, step: int, window: int):
+        out = np.asarray(out, dtype=np.float64)
+        self.G, self.T = out.shape
+        self.step = step
+        self.window = window
+        self.start = start
+        self.end = end
+        self.col_off = 0
+        self.buf = np.empty((self.G, self.T + COL_HEADROOM))
+        self.buf[:, :self.T] = out
+        self.out_refs: tuple = ()
+
+    def reset(self, out, start: int, end: int, step: int,
+              window: int) -> None:
+        """Reinitialize around a freshly computed full block (shape
+        change, or an advance the sliding pattern doesn't cover).  The
+        old buffer is left intact for any still-alive views."""
+        self.__init__(out, start, end, step, window)
+
+    def rows(self) -> list[np.ndarray]:
+        """Read-only per-row views of the live window, remembered (by
+        weakref) so a later in-place advance never writes through a row
+        still held by an in-flight response."""
+        win = self.buf[:, self.col_off:self.col_off + self.T].view()
+        win.setflags(write=False)
+        rows = [win[g] for g in range(self.G)]
+        refs = [r for r in self.out_refs if r() is not None]
+        refs.extend(weakref.ref(v) for v in rows)
+        self.out_refs = tuple(refs)
+        return rows
+
+    def try_advance(self, start: int, end: int, step: int,
+                    window: int) -> int | None:
+        """Number of fresh tail columns needed to advance the window to
+        [start, end] in the designed constant-shape sliding pattern
+        (0 = pure re-serve), or None when the shape doesn't fit and the
+        caller must recompute + reset().  Variable-length grids (suffix
+        evals, narrowed ranges) deliberately don't fit — reused columns
+        keep the estimates they were computed under, which is only the
+        documented contract for the sliding-dashboard advance."""
+        if step != self.step or window != self.window:
+            return None
+        if start < self.start or end < self.end:
+            return None
+        if (start - self.start) % step or \
+                (start - self.start) != (end - self.end):
+            return None
+        if (end - start) // step + 1 != self.T:
+            return None
+        n_new = (end - self.end) // step
+        if n_new >= self.T:
+            return None  # disjoint windows: nothing reusable
+        return n_new
+
+    def commit(self, start: int, end: int, tail) -> list[np.ndarray]:
+        """Advance in place per a successful try_advance: scatter the
+        (G, n_new) tail columns, move the window offset, return fresh
+        read-only row views.  When handed-out rows are still alive or the
+        headroom is exhausted, the live columns compact into a FRESH
+        buffer so earlier responses' views stay intact."""
+        n_new = (end - self.end) // self.step
+        shift = (start - self.start) // self.step
+        col_off = self.col_off + shift
+        alive = any(r() is not None for r in self.out_refs)
+        if alive or col_off + self.T > self.buf.shape[1]:
+            nb = np.empty((self.G, self.T + COL_HEADROOM))
+            keep = self.T - n_new
+            if keep:
+                nb[:, :keep] = self.buf[
+                    :, self.col_off + shift:self.col_off + self.T]
+            self.buf = nb
+            col_off = 0
+            self.out_refs = ()
+        if n_new:
+            self.buf[:, col_off + self.T - n_new:col_off + self.T] = tail
+        self.col_off = col_off
+        self.start = start
+        self.end = end
+        return self.rows()
+
+
 class RollupResultCache:
     def __init__(self, max_entries: int = 4096,
                  max_bytes: int | None = None):

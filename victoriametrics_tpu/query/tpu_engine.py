@@ -42,6 +42,8 @@ def timed_kernel_call(kernel: str, jit_fn, *args, **kw):
     thing to look at when p99 spikes — a 'compile' sample on a steady
     workload means a shape/dtype churned a cached kernel."""
     import jax
+
+    from ..utils import flightrec as _flightrec
     cache_size = getattr(jit_fn, "_cache_size", None)
     before = cache_size() if callable(cache_size) else None
     t0 = time.perf_counter()
@@ -55,7 +57,19 @@ def timed_kernel_call(kernel: str, jit_fn, *args, **kw):
     if before is not None and cache_size() > before:
         phase = "compile"
     _kernel_histogram(kernel, phase).update(dt)
+    # a device-leg flight capture attributes transfer vs compile vs
+    # execute: uploads are spanned at the put seams, this is the rest
+    _flightrec.rec(f"device:{phase}", t0, dt, arg=kernel)
     return out
+
+
+def _pull_host(out, dtype=np.float64) -> np.ndarray:
+    """D2H pull of a kernel result with byte accounting + flight span —
+    the one seam where device results cross back to the host."""
+    from ..models.tile_cache import timed_transfer
+    nbytes = int(np.prod(out.shape)) * np.dtype(dtype).itemsize
+    return timed_transfer("device:download", nbytes,
+                          lambda: np.asarray(out, dtype=dtype))
 
 # -- the f32 tile design ------------------------------------------------
 # Real TPUs have no native float64 (it is emulated, or silently truncated
@@ -181,6 +195,7 @@ class TPUEngine:
     last_roll_decline: str = ""  # why the last rolling advance fell back
     _cache: object = None
     _aux: object = None
+    _wcache: object = None      # DeviceWindowCache (resident windows)
 
     def __post_init__(self):
         enable_compilation_cache()
@@ -206,6 +221,15 @@ class TPUEngine:
             from ..models.tile_cache import TileCache
             self._cache = TileCache(self.cache_bytes)
         return self._cache
+
+    def window_cache(self):
+        """Device-resident rolling windows (models.tile_cache
+        .DeviceWindowCache): the state that makes a rolling refresh
+        upload only its tail columns."""
+        if self._wcache is None:
+            from ..models.tile_cache import DeviceWindowCache
+            self._wcache = DeviceWindowCache()
+        return self._wcache
 
     def series_shards(self) -> int:
         """Size of the mesh's series axis (1 = single-device engine)."""
@@ -283,7 +307,7 @@ def try_rollup_tpu(engine: TPUEngine, func: str, series, cfg: RollupConfig,
                             counts, normalized_cfg(func, cfg), MIN_TS_NONE,
                             _v0_dev(engine, v0))
     # mesh tiles are row-padded; only the live rows come back
-    rows = np.asarray(out, dtype=np.float64)[:len(series)]
+    rows = _pull_host(out)[:len(series)]
     if mode == "addback":
         rows = rows + v0[:len(series), None]  # NaN gaps stay NaN
     return list(rows)
@@ -352,8 +376,7 @@ def try_topk_rollup_tpu(engine: TPUEngine, name: str, k: float, func: str,
         sel = sel[sel < len(series)]
         if sel.size == 0:
             return []
-        rows_sel = np.asarray(take_rows(rolled, jnp.asarray(sel)),
-                              dtype=np.float64)
+        rows_sel = _pull_host(take_rows(rolled, jnp.asarray(sel)))
         # rebuild the kept-sample mask for the selected rows
         t_pos, j_pos = np.nonzero(valid)
         s_pos = idx_h[t_pos, j_pos]
@@ -378,8 +401,7 @@ def try_topk_rollup_tpu(engine: TPUEngine, name: str, k: float, func: str,
     else:
         order = np.argsort(rank_h, kind="stable")
     sel = order[-min(k_i, len(series)):]  # rank order, ties favor later
-    rows_sel = np.asarray(take_rows(rolled, jnp.asarray(sel)),
-                          dtype=np.float64)
+    rows_sel = _pull_host(take_rows(rolled, jnp.asarray(sel)))
     return [(int(i), rows_sel[j]) for j, i in enumerate(sel)]
 
 
@@ -525,7 +547,7 @@ def _dispatch_fused(engine: TPUEngine, aggr: str, func: str, tiles,
                                 v_t, counts, gids_dev, cfg, num_groups,
                                 np.int32(shift), np.int32(min_ts),
                                 _v0_dev(engine, v0))
-    return np.asarray(out, dtype=np.float64)
+    return _pull_host(out)
 
 
 def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
@@ -534,10 +556,11 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
     boundary'); fall back to dense tiles when the data needs >int32.
 
     With a multi-device mesh the rows (series axis) are padded to a multiple
-    of the mesh's series axis and placed with a NamedSharding over it — the
-    delta-plane decode is per-row, so under GSPMD each device decodes only
-    its shard and the decoded tile never leaves its device (the scatter half
-    of the reference's scatter-gather)."""
+    of the mesh's series axis and placed per the partition-rule table
+    (parallel/partition.py) — the delta-plane decode is per-row, so under
+    GSPMD each device decodes only its shard and the decoded tile never
+    leaves its device (the scatter half of the reference's
+    scatter-gather)."""
     import dataclasses
 
     import jax.numpy as jnp
@@ -546,26 +569,14 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
     from ..ops import device_decode as dd
     from ..ops.device_rollup import TS_PAD, pack_series
     from ..models.tile_cache import chunked_device_put
+    from ..parallel.partition import shard_put
 
     n_sh = engine.series_shards()
-    row_sh = vec_sh = None
-    if n_sh > 1:
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ..parallel.mesh import AXIS_SERIES
-        row_sh = NamedSharding(engine.mesh, P(AXIS_SERIES, None))
-        vec_sh = NamedSharding(engine.mesh, P(AXIS_SERIES))
-
-    def _put(a: np.ndarray, pad_value=0):
+    def _put(a: np.ndarray, pad_value=0, name="ts"):
         if n_sh > 1:
-            import jax
-            S = a.shape[0]
-            S_pad = -(-S // n_sh) * n_sh
-            if S_pad != S:
-                widths = ((0, S_pad - S),) + ((0, 0),) * (a.ndim - 1)
-                a = np.pad(a, widths, constant_values=pad_value)
-            return jax.device_put(a, row_sh if a.ndim > 1 else vec_sh)
-        return chunked_device_put(a)
+            return shard_put(engine.mesh, name, a, pad_value)
+        return chunked_device_put(np.asarray(a))
 
     f32 = engine.is_f32()
     v0 = risky = None
@@ -630,7 +641,8 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
             v0[~np.isfinite(v0)] = 0.0
         # padded rows get count=0 and scale=1: decode masks them to TS_PAD
         pad_vals = {"scale": 1}
-        dev = [_put(getattr(planes, f.name), pad_vals.get(f.name, 0))
+        dev = [_put(getattr(planes, f.name), pad_vals.get(f.name, 0),
+                    name=f.name)
                for f in dataclasses.fields(planes)]
         ts_t, v_t = dd.decode_tiles(*dev[:6], dev[6], dev[7], n_cap,
                                     engine.value_dtype, rebase=f32)
@@ -646,8 +658,8 @@ def _upload_tiles(engine: TPUEngine, series, cfg: RollupConfig):
         n_pad=tile_capacity(
             max((sd.timestamps.size for sd in series), default=1)),
         dtype=engine.value_dtype)
-    ts_d = _put(ts, TS_PAD)
-    return (ts_d, _put(vals), _put(counts),
+    ts_d = _put(ts, TS_PAD, name="ts")
+    return (ts_d, _put(vals, name="values"), _put(counts, name="counts"),
             _pad_v0(v0, int(ts_d.shape[0]), risky))
 
 
@@ -741,7 +753,13 @@ def advance_rolling(engine: TPUEngine, rt: RollingTile, storage, filters,
         # break row sortedness
         return no("query starts before the tile's rebase origin")
     if end - rt.base_ms >= 2**31 - 1:
-        return no("int32 rebase exhausted")
+        # window-slide compaction instead of a decline: drop samples
+        # older than this query's fetch bound on device and move the
+        # rebase origin there (compact_tile, donated) — the resident
+        # window then rolls indefinitely instead of dying of int32
+        if not compact_window(engine, rt, fetch_lo) or \
+                end - rt.base_ms >= 2**31 - 1:
+            return no("int32 rebase exhausted")
     if ver != rt.version:
         try:
             lo_new = storage.min_appended_since(rt.version)
@@ -773,7 +791,7 @@ def advance_rolling(engine: TPUEngine, rt: RollingTile, storage, filters,
         if cols.n_series:
             qa = tracer.new_child("device append") if tracer is not None \
                 else None
-            ok = _append_cols(engine, rt, cols)
+            ok = _append_cols(engine, rt, cols, fetch_lo)
             if qa is not None:
                 qa.donef("%d samples -> row tails", cols.n_samples)
             if not ok:
@@ -784,7 +802,51 @@ def advance_rolling(engine: TPUEngine, rt: RollingTile, storage, filters,
     return True
 
 
-def _append_cols(engine: TPUEngine, rt: RollingTile, cols) -> bool:
+def compact_window(engine: TPUEngine, rt: RollingTile,
+                   cutoff_abs: int) -> bool:
+    """Slide the resident window on device: drop every sample older than
+    `cutoff_abs` (this query's fetch lower bound — nothing at or past it
+    can contribute to this or any later rolling query) and rebase the
+    tile origin there, freeing column headroom and int32 range WITHOUT a
+    re-upload (ops.device_rollup.compact_tile, donated buffers).  Queries
+    reaching further back than the new origin decline via rt.lo_ms and
+    rebuild — the loud fallback.  Returns False when nothing would move
+    (cutoff at/behind the current origin)."""
+    cutoff_rel = cutoff_abs - rt.base_ms
+    if cutoff_rel <= 0 or cutoff_rel >= 2**31 - 1:
+        # nothing to drop, or the tile is so stale (paused dashboard
+        # resumed much later) that even the cutoff overflows the int32
+        # frame: decline BEFORE mutating any state — np.int32() below
+        # would raise OverflowError instead of the loud rebuild
+        return False
+    from ..models.tile_cache import count_window_compaction
+    from ..ops.device_rollup import compact_tile
+    # the old buffers are donated: drop the TileCache reference first so
+    # no reachable entry keeps deleted arrays
+    if rt.adopted_key is not None:
+        engine.cache().invalidate(rt.adopted_key)
+        rt.adopted_key = None
+    ts_t, v_t, counts_t, v0 = rt.tiles
+    new_ts, new_vals, new_counts = timed_kernel_call(
+        "compact_tile", compact_tile, ts_t, v_t, counts_t,
+        np.int32(cutoff_rel), np.int32(cutoff_rel))
+    counts_host = np.asarray(new_counts).astype(np.int64)
+    rt.tiles = (new_ts, new_vals, new_counts, v0)
+    rt.counts_host = counts_host
+    rt.n_samples = int(counts_host.sum())
+    rt.base_ms = cutoff_abs
+    rt.lo_ms = max(rt.lo_ms, cutoff_abs)
+    # clamp the sample-accounting segments to the new history start;
+    # partially clipped segments keep their full n (a conservative
+    # overcount for -search.maxSamplesPerQuery accounting)
+    rt.segments = [(max(lo, cutoff_abs), hi, n)
+                   for lo, hi, n in rt.segments if hi >= cutoff_abs]
+    count_window_compaction()
+    return True
+
+
+def _append_cols(engine: TPUEngine, rt: RollingTile, cols,
+                 fetch_lo: int) -> bool:
     """Scatter a fetched slice (ColumnarSeries) onto the tile tails."""
     from ..ops.device_rollup import append_tile
     rows_idx = np.empty(cols.n_series, dtype=np.int64)
@@ -796,8 +858,15 @@ def _append_cols(engine: TPUEngine, rt: RollingTile, cols) -> bool:
         rows_idx[i] = r
     new_n = rt.counts_host[rows_idx] + cols.counts
     if int(new_n.max()) > rt.n_cap:
-        engine.last_roll_decline = "column headroom exhausted"
-        return False
+        # window-slide compaction before giving up: free the columns
+        # holding samples older than this query's fetch bound
+        if not compact_window(engine, rt, fetch_lo):
+            engine.last_roll_decline = "column headroom exhausted"
+            return False
+        new_n = rt.counts_host[rows_idx] + cols.counts
+        if int(new_n.max()) > rt.n_cap:
+            engine.last_roll_decline = "column headroom exhausted"
+            return False
     ts_t0, v_t0, counts_t0, v0 = rt.tiles
     S_tile = int(ts_t0.shape[0])
     K = int(cols.ts.shape[1])
@@ -831,16 +900,15 @@ def _append_cols(engine: TPUEngine, rt: RollingTile, cols) -> bool:
         rt.adopted_key = None
     ts_t, v_t, counts_t = ts_t0, v_t0, counts_t0
     if engine.series_shards() > 1:
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from ..parallel.mesh import AXIS_SERIES
-        row_sh = NamedSharding(engine.mesh, P(AXIS_SERIES, None))
-        vec_sh = NamedSharding(engine.mesh, P(AXIS_SERIES))
-        new_ts_d = jax.device_put(new_ts, row_sh)
-        new_vals_d = jax.device_put(new_vals, row_sh)
-        new_counts_d = jax.device_put(new_counts, vec_sh)
+        # the tile rows are already padded to the mesh multiple, so these
+        # shard_puts never re-pad — they just place per the rule table
+        from ..parallel.partition import shard_put
+        new_ts_d = shard_put(engine.mesh, "ts", new_ts)
+        new_vals_d = shard_put(engine.mesh, "values", new_vals)
+        new_counts_d = shard_put(engine.mesh, "counts", new_counts)
     else:
+        from ..models.tile_cache import count_upload
+        count_upload(new_ts.nbytes + new_vals.nbytes + new_counts.nbytes)
         new_ts_d, new_vals_d, new_counts_d = new_ts, new_vals, new_counts
     rt.tiles = append_tile(ts_t, v_t, counts_t, new_ts_d, new_vals_d,
                            new_counts_d) + (v0,)
@@ -970,4 +1038,4 @@ def run_quantile_on_tiles(engine: TPUEngine, phi: float, func: str, tiles,
                                slots_dev, normalized_cfg(func, cfg),
                                num_groups, max_group, np.int32(shift),
                                np.int32(min_ts), _v0_dev(engine, v0))
-    return np.asarray(out, dtype=np.float64)
+    return _pull_host(out)

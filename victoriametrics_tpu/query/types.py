@@ -51,12 +51,14 @@ class EvalConfig:
     no_eval_cache: bool = False
     # internal: disable the device ROLLING/aux tile-reuse shortcuts while
     # keeping fresh device compute. Set by the HTTP result cache's suffix
-    # eval: its VARIABLE-LENGTH suffix grids confuse the rolling tail
-    # reuse (observed ~35% rate error on reused columns), while the
-    # constant-shape advance direct dashboards produce is correct (both
-    # patterns are pinned by tests/test_served_device_path.py). Cost of
-    # the flag: the first full eval's rolling tile stays resident in the
-    # (bounded, LRU) device caches unused once the suffix path takes over.
+    # eval: its VARIABLE-LENGTH suffix grids don't fit the constant-shape
+    # sliding advance the resident-window reuse is designed for (the
+    # RingBlock declines them), and layering the two tail-merges would
+    # double-count coverage. Device engines normally never reach the
+    # suffix path for rolling-capable shapes — the serving layer routes
+    # them through the resident window first (device_window_ready);
+    # this flag covers the remaining fallback evals. Both patterns are
+    # pinned by tests/test_served_device_path.py.
     no_device_roll: bool = False
     tracer: object = None      # querytracer.Tracer | NOP (set in __post_init__)
     tpu: object = None         # TPUEngine when the device path is enabled

@@ -423,6 +423,11 @@ class Part:
         # kernel; a memo only short-circuits the mode that can use it
         self._dec = None
         self._dec_cost = 0
+        # memoized block-membership masks keyed by the wanted-id set:
+        # a rolling refresh selects the SAME series every step, so the
+        # O(#blocks) membership scan runs once per id set and only the
+        # (cheap, vectorized) time clip reruns per refresh
+        self._member_memo: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
 
     def close(self):
         self._release_dec()
@@ -571,6 +576,11 @@ class Part:
         from .. import native as _native
         if self._ts_buf is None or not _native.available():
             return None
+        if (min_ts is not None and self.max_ts < min_ts) or \
+                (max_ts is not None and self.min_ts > max_ts):
+            # suffix-aware early-out: a part wholly outside the tail
+            # window never builds header columns or scans membership
+            return False
         hc, lo, hi, idx = self._select_blocks(mids_sorted, min_ts, max_ts)
         if idx.size == 0:
             return False
@@ -610,13 +620,34 @@ class Part:
     def _select_blocks(self, mids_sorted, min_ts, max_ts):
         """Shared header selection of the batched read paths: returns
         (hc, lo, hi, idx) where idx lists the blocks overlapping
-        [min_ts, max_ts] for the wanted metric ids."""
+        [min_ts, max_ts] for the wanted metric ids.  The membership mask
+        is memoized per id set (suffix-aware fetch: a rolling refresh's
+        repeated identical series set pays only the time clip)."""
         hc = self.header_columns()
         lo = -(1 << 62) if min_ts is None else min_ts
         hi = (1 << 62) if max_ts is None else max_ts
-        mask = (hc["max_ts"] >= lo) & (hc["min_ts"] <= hi) & \
-            sorted_member_mask(mids_sorted, hc["mid"])
+        mm = self._member_mask(mids_sorted, hc)
+        mask = (hc["max_ts"] >= lo) & (hc["min_ts"] <= hi) & mm
         return hc, lo, hi, np.flatnonzero(mask)
+
+    def _member_mask(self, mids_sorted, hc) -> np.ndarray:
+        if mids_sorted is None:
+            return sorted_member_mask(mids_sorted, hc["mid"])
+        import xxhash
+        key = (xxhash.xxh64_intdigest(np.ascontiguousarray(
+            mids_sorted).tobytes()), int(mids_sorted.size))
+        with self._lock:
+            mm = self._member_memo.get(key)
+            if mm is not None:
+                self._member_memo.move_to_end(key)
+                return mm
+        mm = sorted_member_mask(mids_sorted, hc["mid"])
+        mm.setflags(write=False)
+        with self._lock:
+            self._member_memo[key] = mm
+            while len(self._member_memo) > 4:
+                self._member_memo.popitem(last=False)
+        return mm
 
     def _maybe_memoize(self, kind, ts_arr, data_arr, cnt, n_blocks,
                        total) -> None:
@@ -699,6 +730,11 @@ class Part:
         from .. import native as _native
         if self._ts_buf is None or not _native.available():
             return None
+        if (min_ts is not None and self.max_ts < min_ts) or \
+                (max_ts is not None and self.min_ts > max_ts):
+            # suffix-aware early-out: a part wholly outside the tail
+            # window never builds header columns or scans membership
+            return False
         hc, lo, hi, idx = self._select_blocks(mids_sorted, min_ts, max_ts)
         if idx.size == 0:
             return False

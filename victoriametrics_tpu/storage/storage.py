@@ -307,6 +307,11 @@ class Storage:
         self._append_log: deque = deque(maxlen=4096)
         self._append_log_floor = 0  # appends at versions <= floor may be
         #                             missing from the bounded log
+        # memoized name-resolution/row-order products per fetched id set
+        # (suffix-aware fetch; see _resolve_ordered_names)
+        from collections import OrderedDict
+        self._name_memo: OrderedDict = OrderedDict()
+        self._name_memo_lock = make_lock("storage.Storage._name_memo")
         self.slow_row_inserts = 0
         self.new_series_created = 0
         # metric-name usage stats + TYPE/HELP metadata (storage-resident
@@ -941,10 +946,41 @@ class Storage:
 
     # -- reads -------------------------------------------------------------
 
+    # selector-level `or` filters ({a="b" or c="d"}) arrive as a list of
+    # filter SETS; this store unions them at the tsid level (one assemble
+    # pass over the merged id set — the reference's index union)
+    supports_filter_union = True
+
+    @staticmethod
+    def _filter_sets(filters):
+        """Normalize filters into a list of filter sets: a plain
+        list[TagFilter] is one set; a list of lists is an OR union."""
+        if filters and isinstance(filters[0], (list, tuple)):
+            return list(filters)
+        return [filters]
+
+    def _search_tsids_union(self, filters, min_ts, max_ts, tenant,
+                            check=None, scan_check=None):
+        """search_tsids over one or many OR'd filter sets, deduped by
+        metric id and returned in sort_key order (the invariant every
+        caller's tsid_lo/tsid_hi clamping relies on)."""
+        sets = self._filter_sets(filters)
+        if len(sets) == 1:
+            return self.idb.search_tsids(sets[0], min_ts, max_ts, tenant,
+                                         check=check,
+                                         scan_check=scan_check)
+        seen: dict = {}
+        for fs in sets:
+            for t in self.idb.search_tsids(fs, min_ts, max_ts, tenant,
+                                           check=check,
+                                           scan_check=scan_check):
+                seen.setdefault(t.metric_id, t)
+        return sorted(seen.values(), key=lambda t: t.sort_key())
+
     def search_metric_names(self, filters: list[TagFilter], min_ts: int,
                             max_ts: int, limit: int = 2**31,
                             tenant=(0, 0)) -> list[MetricName]:
-        mids = self.idb.search_metric_ids(filters, min_ts, max_ts, tenant)
+        mids = self._search_mids_union(filters, min_ts, max_ts, tenant)
         out = []
         for mid in mids[:limit]:
             mn = self.idb.get_metric_name_by_id(int(mid))
@@ -952,11 +988,22 @@ class Storage:
                 out.append(mn)
         return out
 
+    def _search_mids_union(self, filters, min_ts, max_ts, tenant):
+        sets = self._filter_sets(filters)
+        if len(sets) == 1:
+            return self.idb.search_metric_ids(sets[0], min_ts, max_ts,
+                                              tenant)
+        out: set = set()
+        for fs in sets:
+            out.update(self.idb.search_metric_ids(fs, min_ts, max_ts,
+                                                  tenant))
+        return sorted(out)
+
     def iter_series_blocks(self, filters: list[TagFilter], min_ts: int,
                            max_ts: int, tenant=(0, 0)):
         """Raw matching blocks in (tsid, min_ts) order — the input to the
         TPU tile packer (Search.NextMetricBlock analog, search.go:275)."""
-        tsids = self.idb.search_tsids(filters, min_ts, max_ts, tenant)
+        tsids = self._search_tsids_union(filters, min_ts, max_ts, tenant)
         tsid_set = {t.metric_id for t in tsids}
         if not tsid_set:
             return
@@ -968,7 +1015,8 @@ class Storage:
                         max_ts: int, tenant=(0, 0)) -> int:
         """Matching-series count without fetching samples (the tsid
         search is cached, so a following search_columns* reuses it)."""
-        return len(self.idb.search_tsids(filters, min_ts, max_ts, tenant))
+        return len(self._search_tsids_union(filters, min_ts, max_ts,
+                                            tenant))
 
     def search_columns_chunked(self, filters: list[TagFilter], min_ts: int,
                                max_ts: int,
@@ -983,7 +1031,7 @@ class Storage:
         the on-disk part itself and each chunk decodes only its own
         blocks). The per-series density estimate starts at the 15s scrape
         grid and adapts to what the first chunk actually returned."""
-        tsids = self.idb.search_tsids(filters, min_ts, max_ts, tenant)
+        tsids = self._search_tsids_union(filters, min_ts, max_ts, tenant)
         if not tsids:
             return
         est = max((max_ts - min_ts) // 15_000 + 2, 1)
@@ -1081,13 +1129,57 @@ class Storage:
                 filters, min_ts, max_ts, interval, max_series, tenant,
                 _tsids, ColumnarSeries, assemble, budget)
 
+    def _resolve_ordered_names(self, uniq: np.ndarray):
+        """Raw-name resolution + canonical (raw-sorted) row order for a
+        fetched metric-id set: (have, kept, rank, ordered_mids,
+        raws_in_row_order, names_in_row_order).  Memoized on the id set +
+        structural version (metric id -> name is immutable; deletes and
+        retention bump structural_version), LRU-bounded — the
+        suffix-aware fetch's answer to per-refresh O(S) resolution."""
+        import xxhash
+        key = (xxhash.xxh64_intdigest(np.ascontiguousarray(uniq).tobytes()),
+               int(uniq.size), self.structural_version)
+        with self._name_memo_lock:
+            got = self._name_memo.get(key)
+            if got is not None:
+                self._name_memo.move_to_end(key)
+                return got
+        names = self.idb.get_metric_names_by_ids([int(m) for m in uniq])
+        have = np.array([int(m) in names for m in uniq], bool)
+        kept = uniq[have]
+        raws = [names[int(m)][1] for m in kept]
+        if len(raws) > 1:
+            # fixed-width bytes argsort (C memcmp) instead of a Python-object
+            # compare per element; numpy's S dtype strips trailing NULs, so
+            # names ending in \0 (never produced by MetricName.marshal, but
+            # cheap to guard) take the object path
+            if any(r[-1:] == b"\x00" for r in raws):
+                arr = np.array(raws, dtype=object)
+            else:
+                arr = np.array(raws)
+            perm = np.argsort(arr, kind="stable")
+        else:
+            perm = np.arange(len(raws), dtype=np.int64)
+        ordered_mids = kept[perm]
+        # rank[j] = final row of kept[j]
+        rank = np.empty(perm.size, np.int64)
+        rank[perm] = np.arange(perm.size)
+        raws_final = [raws[i] for i in perm]
+        names_final = [names[int(m)][0] for m in ordered_mids]
+        val = (have, kept, rank, ordered_mids, raws_final, names_final)
+        with self._name_memo_lock:
+            self._name_memo[key] = val
+            while len(self._name_memo) > 64:
+                self._name_memo.popitem(last=False)
+        return val
+
     def _search_columns_gated(self, filters, min_ts, max_ts, interval,
                               max_series, tenant, _tsids, ColumnarSeries,
                               assemble, budget=None):
         t_ph = time.perf_counter()
         if budget is not None:
             budget.check()  # gate queue wait burned the budget already?
-        tsids = (self.idb.search_tsids(
+        tsids = (self._search_tsids_union(
                      filters, min_ts, max_ts, tenant,
                      check=budget.tick if budget is not None else None,
                      scan_check=budget.check if budget is not None
@@ -1154,31 +1246,15 @@ class Storage:
                                                    vals_f, pool=workpool.POOL)
             t_ph = _phase_lap("decode", t_ph)
         # resolve names FIRST and bake the canonical raw-name row order into
-        # the assembly scatter (no post-assembly reorder pass)
+        # the assembly scatter (no post-assembly reorder pass); memoized
+        # on the fetched id set — a rolling refresh's per-step cost stays
+        # O(new samples), not O(S) name lookups + argsort
         uniq = np.unique(mids)
         if max_series is not None and uniq.size > max_series:
             raise ResourceWarning(
                 f"query matches {uniq.size} series, limit {max_series}")
-        names = self.idb.get_metric_names_by_ids([int(m) for m in uniq])
-        have = np.array([int(m) in names for m in uniq], bool)
-        kept = uniq[have]
-        raws = [names[int(m)][1] for m in kept]
-        if len(raws) > 1:
-            # fixed-width bytes argsort (C memcmp) instead of a Python-object
-            # compare per element; numpy's S dtype strips trailing NULs, so
-            # names ending in \0 (never produced by MetricName.marshal, but
-            # cheap to guard) take the object path
-            if any(r[-1:] == b"\x00" for r in raws):
-                arr = np.array(raws, dtype=object)
-            else:
-                arr = np.array(raws)
-            perm = np.argsort(arr, kind="stable")
-        else:
-            perm = np.arange(len(raws), dtype=np.int64)
-        ordered_mids = kept[perm]
-        # rank[j] = final row of kept[j]
-        rank = np.empty(perm.size, np.int64)
-        rank[perm] = np.arange(perm.size)
+        have, kept, rank, ordered_mids, raws_final, names_final = \
+            self._resolve_ordered_names(uniq)
         # per-block target row; blocks of name-less series are dropped
         pos_in_uniq = np.searchsorted(uniq, mids)
         if not have.all():
@@ -1229,12 +1305,13 @@ class Storage:
         if cols.dropped_rows is not None:
             live = np.delete(np.arange(ordered_mids.size),
                              cols.dropped_rows)
-            cols.raw_names = [raws[perm[i]] for i in live]
-            cols.metric_names = [names[int(ordered_mids[i])][0]
-                                 for i in live]
+            cols.raw_names = [raws_final[i] for i in live]
+            cols.metric_names = [names_final[i] for i in live]
         else:
-            cols.raw_names = [raws[i] for i in perm]
-            cols.metric_names = [names[int(m)][0] for m in ordered_mids]
+            # fresh list objects: the memoized products must never alias
+            # a caller-mutable ColumnarSeries field
+            cols.raw_names = list(raws_final)
+            cols.metric_names = list(names_final)
         cols.compute_stale_rows()
         if cols.metric_names:
             self.track_name_usage(

@@ -323,6 +323,11 @@ class WorkPool:
             return []
         w = self.workers()
         if n == 1 or w <= 1 or _sched_active():
+            # inline degraded mode still EXECUTES the tasks: count them,
+            # so vm_workpool_tasks_total means "tasks run through the
+            # pool seam" on 1-core boxes too (was 0 there, which read as
+            # a dead pool on the dashboard and flaked the metric test)
+            _TASKS_TOTAL.inc(n)
             return [fn() for fn in fns]
         self._ensure_started(min(w, n))
         batch = _Batch(n)
@@ -339,6 +344,7 @@ class WorkPool:
         the pool is disabled) and collect it later via Future.result()."""
         batch = _Batch(1)
         if self.workers() <= 1 or _sched_active():
+            _TASKS_TOTAL.inc()
             self._exec((fn, 0, batch, 0, None, None))
             return Future(self, batch)
         self._ensure_started(1)
