@@ -37,7 +37,10 @@ Prints ONE JSON line:
    "refresh_ms": [per-refresh latencies], "cache": {inplace/rebuild/
    merge_seconds/merge_gate_yields}, "flight": {per-leg flight-recorder
    attribution: slow-refresh captures + the slowest one's overlap
-   summary}}
+   summary}, "cost": {per-refresh CostTracker split: samples/bytes/
+   cpu-ms + wall/cpu by phase + wall_accounted_pct >= 90}, "profiler":
+   {sample count at VM_PROFILE_HZ — the run is measured with the
+   continuous profiler AND cost accounting ON}}
 The refresh-latency DISTRIBUTION (p99 + the raw list) is part of the
 artifact: the p50-vs-trace variance ROADMAP item 1 tracks is invisible
 in a single median.
@@ -156,6 +159,43 @@ def _ingest_phase_label(d0: dict, d1: dict, n: int) -> str:
     parts = [f"{ph}={(d1[ph] - d0[ph]) * 1e3 / max(n, 1):.0f}"
              for ph in ING_PHASES]
     return "/".join(parts) + "ms"
+
+
+def _cost_leg_summary(costs, lat) -> dict:
+    """Per-leg cost attribution from the refreshes' CostTrackers (the
+    per-query accounting plane, utils/costacc): what one steady refresh
+    scans/reads/burns, plus how much of the measured refresh wall time
+    the named cost buckets account for (the honesty ratio — anything
+    below ~90% means an unnamed phase is eating serving time)."""
+    n = max(len(costs), 1)
+    wall: dict = {}
+    cpu: dict = {}
+    samples = bytes_read = dev_up = dev_down = rpc = 0
+    for c in costs:
+        samples += c.samples
+        bytes_read += c.part_bytes
+        dev_up += c.device_up
+        dev_down += c.device_down
+        rpc += c.rpc_bytes
+        for k, v in c.wall_ms.items():
+            wall[k] = wall.get(k, 0.0) + v
+        for k, v in c.cpu_ms.items():
+            cpu[k] = cpu.get(k, 0.0) + v
+    refresh_wall_ms = sum(lat) * 1e3
+    return {
+        "samples_scanned_per_refresh": samples // n,
+        "bytes_read_per_refresh": bytes_read // n,
+        "cpu_ms_per_refresh": round(sum(cpu.values()) / n, 2),
+        "device_bytes_per_refresh": (dev_up + dev_down) // n,
+        "rpc_bytes_per_refresh": rpc // n,
+        "wall_ms_by_phase": {k: round(v / n, 2)
+                             for k, v in sorted(wall.items())},
+        "cpu_ms_by_phase": {k: round(v / n, 2)
+                            for k, v in sorted(cpu.items())},
+        "wall_accounted_pct": round(
+            sum(wall.values()) / refresh_wall_ms * 100, 1)
+        if refresh_wall_ms > 0 else 0.0,
+    }
 
 
 def _leg_flight_summary(id0: int, threshold_ms: float) -> dict:
@@ -282,6 +322,11 @@ def main() -> None:
     from victoriametrics_tpu.query.types import EvalConfig
     from victoriametrics_tpu.storage.storage import Storage
     from victoriametrics_tpu.utils.querytracer import Tracer
+
+    # the continuous profiler runs for the WHOLE bench (acceptance: the
+    # headline is measured with profiler + cost accounting ON)
+    from victoriametrics_tpu.utils import profiler
+    profiler.ensure_started()
 
     tmp = tempfile.mkdtemp(prefix="vmtpu-bench-")
     # anchor to wall clock so steady-state ingest is "live" data (the
@@ -431,16 +476,17 @@ def main() -> None:
             ph0 = _phase_totals()
             ing0 = _ingest_phase_totals()
             c0 = _cache_merge_totals()
+            leg_costs = []
             for _ in range(REFRESHES):
                 end += STEP
                 start = end - duration
                 ingest_fresh(end)
                 tr = Tracer(True)
+                ec_r = EvalConfig(start=start, end=end, **kw, tracer=tr)
                 t0 = time.perf_counter()
-                rows = api._exec_range_cached(
-                    EvalConfig(start=start, end=end, **kw, tracer=tr), q,
-                    end)
+                rows = api._exec_range_cached(ec_r, q, end)
                 lat.append(time.perf_counter() - t0)
+                leg_costs.append(ec_r.cost)
                 assert len(rows) == N_INSTANCES, len(rows)
             traces[backend + "-steady"] = tr.to_dict()
             # snapshot the per-refresh phase split BEFORE the honesty
@@ -456,6 +502,7 @@ def main() -> None:
             # flight attribution BEFORE the honesty check: its cold eval
             # would flood the rings with full-window fetch spans
             flights[backend] = _leg_flight_summary(flight_id0, thresh_ms)
+            cost_summary = _cost_leg_summary(leg_costs, lat)
             # honesty check: the served refresh must equal a cold
             # (nocache) evaluation of the same window — bit-for-bit on
             # the f64 host path, within the f32 tile bound on device
@@ -465,7 +512,8 @@ def main() -> None:
             rtol = 0.0 if engine is None else (1e-4 if f32 else 1e-12)
             _assert_rows_equal(rows, cold_rows, rtol=rtol)
             results[backend] = (float(np.median(lat)), cold_dt,
-                                phase_lbl, ing_lbl, list(lat), cache_stats)
+                                phase_lbl, ing_lbl, list(lat), cache_stats,
+                                cost_summary)
             if backend == "device":
                 # the residency story in the artifact: a steady refresh
                 # must ship tail columns, not the window (ISSUE 12)
@@ -485,7 +533,7 @@ def main() -> None:
             end0 = end  # the next backend continues on the grown storage
 
         backend, (warm_dt, cold_dt, phase_lbl, ing_lbl, lat,
-                  cache_stats) = min(
+                  cache_stats, cost_summary) = min(
             results.items(), key=lambda kv: kv[1][0])
         rate = samples / warm_dt
         # the refresh-latency DISTRIBUTION, not just p50: ROADMAP item 1's
@@ -524,10 +572,18 @@ def main() -> None:
             "refresh_p99_ms": round(p99_dt * 1e3, 2),
             "refresh_ms": [round(x * 1e3, 2) for x in lat],
             "cache": cache_stats,
+            # per-refresh cost attribution from the CostTracker plane
+            # (profiler + accounting were ON for the whole run)
+            "cost": cost_summary,
+            "profiler": {
+                "samples": profiler.PROFILER.snapshot()["samples"],
+                "hz": profiler.configured_hz(),
+            },
             # per-leg cold/steady timings: the device leg's numbers stay
             # visible even when the host leg wins the headline
             "legs": {b: {"refresh_p50_ms": round(r[0] * 1e3, 2),
-                         "cold_s": round(r[1], 2)}
+                         "cold_s": round(r[1], 2),
+                         "cost": r[6]}
                      for b, r in results.items()},
             "device_plane": device_plane,
             "flight": flights,

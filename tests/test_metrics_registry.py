@@ -338,16 +338,26 @@ class TestTracePropagationProtocol:
         assert tree["message"].startswith("vmstorage search_v1")
         assert tree["children"][0]["message"].startswith("search_series")
 
-    def test_no_trace_flag_means_no_trace_bytes(self):
+    def test_no_trace_flag_means_empty_trace_slot(self):
+        """Without the trace flag the meta frame carries an EMPTY trace
+        slot followed by the extras dict (cost frame + union ack) — an
+        old client's json parse of b"" fails into its existing
+        malformed-trace guard, so the old-client behavior is
+        unchanged."""
         from victoriametrics_tpu.parallel.rpc import Reader
         frames = self._search_frames(trace_flag=0)
         meta = Reader(frames[-1].payload())
         meta.u64(), meta.u64()
-        assert meta.remaining == 0  # old-client shape preserved
+        assert meta.bytes_() == b""  # the empty trace slot
+        extras = json.loads(meta.bytes_())
+        assert extras["filterUnion"] is True
+        assert "samples" in extras["cost"]
+        assert meta.remaining == 0
 
     def test_old_client_without_flag_still_served(self):
         """A request WITHOUT the trailing trace flag (pre-extension
-        client) is parsed identically."""
+        client) is parsed identically; the response's trace slot stays
+        empty."""
         from victoriametrics_tpu.parallel.cluster_api import \
             make_storage_handlers
         from victoriametrics_tpu.parallel.rpc import Reader, Writer
@@ -358,7 +368,8 @@ class TestTracePropagationProtocol:
         frames = list(handlers["search_v1"](Reader(w.payload())))
         meta = Reader(frames[-1].payload())
         meta.u64(), meta.u64()
-        assert meta.remaining == 0
+        assert meta.bytes_() == b""
+        assert "filterUnion" in json.loads(meta.bytes_())
 
     def test_client_grafts_remote_tree(self):
         from victoriametrics_tpu.parallel.cluster_api import \
@@ -367,11 +378,15 @@ class TestTracePropagationProtocol:
         remote = {"duration_msec": 4.2, "message": "vmstorage search_v1",
                   "children": [{"duration_msec": 1.0,
                                 "message": "search_series: 5 series"}]}
+        # OLD-server frame shape: [partial][trace], no extras — the new
+        # client must parse it and answer extras=None (degraded cost)
         meta = Writer().u64(1)  # partial flag (count already consumed)
         meta.bytes_(json.dumps(remote).encode())
         qt = querytracer.Tracer("rpc node n1")
-        partial = StorageNodeClient._read_meta(Reader(meta.payload()), qt)
+        partial, extras = StorageNodeClient._read_meta(
+            Reader(meta.payload()), qt)
         assert partial is True
+        assert extras is None
         d = qt.to_dict()
         assert d["children"][0]["message"] == "vmstorage search_v1"
         assert d["children"][0]["children"][0]["message"] == \

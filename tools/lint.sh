@@ -33,6 +33,13 @@ python -m victoriametrics_tpu.devtools.lint "$@"
 if [ "${VMT_NO_FLIGHT_SMOKE:-0}" != "1" ]; then
     python -m victoriametrics_tpu.devtools.flight_overhead
 fi
+# Continuous-profiler overhead smoke (devtools/profile_overhead.py):
+# the default-on sampling thread must stay within VM_PROFILE_SMOKE_PCT
+# (default 2%) of profiler-stopped on a serving-shaped workload.
+# VMT_NO_PROFILE_SMOKE=1 skips it.
+if [ "${VMT_NO_PROFILE_SMOKE:-0}" != "1" ]; then
+    python -m victoriametrics_tpu.devtools.profile_overhead
+fi
 if [ "${VMT_NO_DEVICE_SMOKE:-0}" != "1" ]; then
     sh tools/device.sh \
         "tests/test_device_residency.py::test_refresh_uploads_only_tail_on_mesh"

@@ -1,0 +1,111 @@
+#!/bin/sh
+# Continuous-profiler helper: pull folded-stack / speedscope profiles
+# from running vmsingle/vmselect/vmstorage processes and merge several
+# nodes' raw snapshots into one speedscope file.  Stdlib-only (no curl,
+# no package imports) — works in minimal containers.
+#
+# Usage:
+#   tools/profile.sh [-a host:port] collapsed            # folded stacks
+#   tools/profile.sh [-a host:port] speedscope [out.json]
+#   tools/profile.sh [-a host:port] raw                  # snapshot JSON
+#   tools/profile.sh [-a host:port] usage                # per-tenant cost
+#   tools/profile.sh merge out.json host1:port1 [host2:port2 ...]
+#
+# `speedscope` output loads at https://www.speedscope.app.  A vmselect
+# answers with its storage nodes' profiles merged in (profile_v1
+# fan-out, node-tagged); `merge` does the same client-side across any
+# set of nodes.  VM_PROFILE_HZ=0 disables the profiler (503).
+set -eu
+ADDR="127.0.0.1:8428"
+if [ "${1:-}" = "-a" ]; then
+    ADDR="$2"
+    shift 2
+fi
+CMD="${1:-collapsed}"
+
+fetch() {
+    # stdlib only: curl is not guaranteed in the dev containers
+    python - "$1" "${2:-}" <<'EOF'
+import json, sys, urllib.request
+url, out = sys.argv[1], sys.argv[2]
+body = urllib.request.urlopen(url, timeout=30).read()
+if out:
+    with open(out, "wb") as f:
+        f.write(body)
+    print(f"wrote {len(body)} bytes to {out}")
+else:
+    try:
+        print(json.dumps(json.loads(body), indent=2))
+    except ValueError:
+        sys.stdout.buffer.write(body)
+EOF
+}
+
+case "$CMD" in
+collapsed)
+    fetch "http://$ADDR/api/v1/status/profile"
+    ;;
+speedscope)
+    fetch "http://$ADDR/api/v1/status/profile?format=speedscope" \
+        "${2:-profile_speedscope.json}"
+    ;;
+raw)
+    fetch "http://$ADDR/api/v1/status/profile?format=raw"
+    ;;
+usage)
+    fetch "http://$ADDR/api/v1/status/usage"
+    ;;
+merge)
+    OUT="${2:?usage: tools/profile.sh merge out.json host:port [...]}"
+    shift 2
+    [ "$#" -ge 1 ] || { echo "merge: need at least one host:port" >&2; exit 2; }
+    python - "$OUT" "$@" <<'EOF'
+import json, sys, urllib.request
+out, addrs = sys.argv[1], sys.argv[2:]
+# fetch every node's raw snapshots, tag untagged ones with the address,
+# and fold everything into one speedscope file (sampled profiles, one
+# per node/role) — the same shape utils/profiler.speedscope builds,
+# kept stdlib-only here so the helper runs anywhere
+snaps = []
+for addr in addrs:
+    url = f"http://{addr}/api/v1/status/profile?format=raw"
+    body = json.loads(urllib.request.urlopen(url, timeout=30).read())
+    for snap in body.get("data", []):
+        snap.setdefault("node", None)
+        if snap["node"] is None:
+            snap["node"] = addr
+        snaps.append(snap)
+frames, fidx = [], {}
+def fi(label):
+    if label not in fidx:
+        fidx[label] = len(frames)
+        frames.append({"name": label})
+    return fidx[label]
+groups = {}
+for snap in snaps:
+    for row in snap.get("stacks", []):
+        g = f"{snap['node']}/{row['role']}"
+        s, w = groups.setdefault(g, ([], []))
+        s.append([fi(f) for f in row["stack"]])
+        w.append(int(row["count"]))
+profiles = []
+for g in sorted(groups):
+    s, w = groups[g]
+    profiles.append({"type": "sampled", "name": g, "unit": "none",
+                     "startValue": 0, "endValue": sum(w),
+                     "samples": s, "weights": w})
+doc = {"$schema": "https://www.speedscope.app/file-format-schema.json",
+       "shared": {"frames": frames}, "profiles": profiles,
+       "name": "merged cluster profile", "activeProfileIndex": 0,
+       "exporter": "tools/profile.sh merge"}
+with open(out, "w") as f:
+    json.dump(doc, f)
+print(f"merged {len(snaps)} snapshot(s) from {len(addrs)} node(s) "
+      f"into {out} ({len(profiles)} profiles)")
+EOF
+    ;;
+*)
+    echo "unknown command: $CMD (collapsed|speedscope|raw|usage|merge)" >&2
+    exit 2
+    ;;
+esac

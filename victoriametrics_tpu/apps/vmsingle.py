@@ -194,6 +194,8 @@ def build(args):
     _attach_tpu_engine(api, args.tpu)
     api.flags_map = {k: v for k, v in vars(args).items()}
     api.register(srv)
+    from ..utils import profiler
+    profiler.ensure_started()
     from ..httpapi.graphite_api import GraphiteAPI
     GraphiteAPI(storage).register(srv)
     if args.pushmetrics_urls:

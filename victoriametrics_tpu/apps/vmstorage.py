@@ -77,6 +77,17 @@ def build(args):
     from ..devtools import faultinject
     http.route("/internal/faults",
                lambda req: faultinject.handle_http(req, Response))
+    # cost-and-profile plane: the node's continuous profiler (also
+    # served over profile_v1 to vmselects) + its node-local per-tenant
+    # usage table (search RPCs account into it)
+    from ..utils import costacc, profiler
+    profiler.ensure_started()
+    http.route("/api/v1/status/profile",
+               lambda req: profiler.handle_http(req, Response))
+    http.route("/api/v1/status/usage", lambda req: Response.json(
+        {"status": "success",
+         "data": {"tenants": costacc.TENANT_USAGE.snapshot(
+             reset=req.arg("reset") == "1")}}))
     return storage, insert_srv, select_srv, http
 
 

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..devtools.locktrace import make_lock
 from ..devtools.racetrace import traced_fields
+from ..utils import costacc as _costacc
 from ..utils import flightrec as _flightrec
 from ..utils import metrics as metricslib
 
@@ -42,10 +43,12 @@ _BYTES_DOWNLOADED = metricslib.REGISTRY.counter(
 
 def count_upload(nbytes: int) -> None:
     _BYTES_UPLOADED.inc(int(nbytes))
+    _costacc.add_device(up=int(nbytes))
 
 
 def count_download(nbytes: int) -> None:
     _BYTES_DOWNLOADED.inc(int(nbytes))
+    _costacc.add_device(down=int(nbytes))
 
 
 def bytes_uploaded() -> int:
@@ -68,7 +71,12 @@ def timed_transfer(span: str, nbytes: int, fn):
     try:
         return fn()
     finally:
-        _flightrec.rec(span, t0, _time.perf_counter() - t0, arg=nbytes)
+        dt = _time.perf_counter() - t0
+        _flightrec.rec(span, t0, dt, arg=nbytes)
+        # cost plane: transfer wall is link time, not this thread's CPU
+        tr = _costacc.current()
+        if tr is not None:
+            tr.lap(span, dt, 0.0)
 
 
 # cache self-metrics (reference vm_cache_{requests,misses}_total +

@@ -21,7 +21,7 @@ import numpy as np
 from ..devtools.locktrace import make_lock
 from ..storage.metric_name import MetricName
 from ..storage.tag_filters import TagFilter
-from ..utils import logger, querytracer
+from ..utils import costacc, logger, querytracer
 from ..utils import metrics as metricslib
 from .consistenthash import ConsistentHash
 from .rpc import (HELLO_INSERT, HELLO_SELECT, RPCClient, RPCClientPool,
@@ -64,6 +64,26 @@ def _read_tenant(r: Reader) -> tuple:
 
 def _write_tenant(w: Writer, tenant) -> Writer:
     return w.u64(tenant[0]).u64(tenant[1])
+
+
+def _split_filter_sets(filters):
+    """Normalize a search's filters into (first_set, extra_sets): a
+    plain list[TagFilter] has no extras; a selector-level `or` union
+    (list of filter sets, see MetricExpr.or_sets) splits into the
+    wire-legacy first set plus the trailing extras field."""
+    if filters and isinstance(filters[0], (list, tuple)):
+        sets = [list(fs) for fs in filters]
+        return sets[0], sets[1:]
+    return list(filters), []
+
+
+def _legacy_meta() -> bool:
+    """``VM_RPC_LEGACY_META=1`` makes this process speak the PRE-cost
+    search_v1 dialect (no empty-trace slot, no extras frame, or_sets
+    ignored) — the rolling-upgrade emulation knob the old<->new
+    tolerance tests and canary drills use."""
+    import os
+    return os.environ.get("VM_RPC_LEGACY_META", "") == "1"
 
 
 def make_storage_handlers(storage, rate_limiter=None) -> dict:
@@ -139,15 +159,60 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
             return 0.0
         return time.monotonic() + budget_ms / 1e3
 
-    def _meta_frame(qt) -> Writer:
-        """Trailing metadata frame: partial-result flag + (when tracing)
-        the storage-side span tree, grafted into the caller's trace."""
+    def _read_or_sets(r: Reader) -> list:
+        """Optional trailing OR'd-filter-set field (third search_v1
+        extension, after the budget): a selector-level `or` union ships
+        its first set in the legacy position and the remaining sets
+        here.  Old clients don't send it; a legacy-dialect server
+        (VM_RPC_LEGACY_META=1) ignores it — the client detects the
+        missing union ack in the metadata frame and falls back to one
+        legacy call per set."""
+        if not r.remaining or _legacy_meta():
+            return []
+        n = r.u64()
+        return [_read_filters(r) for _ in range(n)]
+
+    def _union_filters(filters, or_sets):
+        """(effective_filters, union_applied): apply the shipped extra
+        sets when the storage can union them at the tsid level."""
+        if not or_sets:
+            return filters, True
+        if getattr(storage, "supports_filter_union", False):
+            return [filters] + or_sets, True
+        # union-less duck-typed storage: serve the first set only and
+        # DON'T ack — the client re-issues per-set legacy calls
+        return filters, False
+
+    def _meta_frame(qt, cost=None, union_ok=True) -> Writer:
+        """Trailing metadata frame: partial-result flag + the
+        storage-side span tree (when tracing) + the extras dict (cost
+        frame + filter-union ack).  Wire layout, Reader-tolerant both
+        ways across versions:
+
+        - old server: [partial u64] [trace bytes, only when tracing]
+        - new server: [partial u64] [trace bytes, b"" when not tracing]
+          [extras json bytes]
+
+        An old CLIENT reading a new frame parses the trace slot (b""
+        fails its json parse and is ignored by its existing malformed-
+        trace guard) and never reads the extras.  A new client
+        disambiguates by position: a second bytes field present means
+        slot one was the (possibly empty) trace and slot two the
+        extras; absent means an old server's trace-only frame."""
         import json
         meta = Writer().u64(META_FRAME)
         meta.u64(1 if getattr(storage, "last_partial", False) else 0)
         if qt.enabled:
             qt.donef("")
             meta.bytes_(json.dumps(qt.to_dict()).encode())
+        elif not _legacy_meta():
+            meta.bytes_(b"")  # empty trace slot pins the extras position
+        if _legacy_meta():
+            return meta
+        extras = {"filterUnion": bool(union_ok)}
+        if cost is not None:
+            extras["cost"] = cost.remote_dict()
+        meta.bytes_(json.dumps(extras).encode())
         return meta
 
     def h_search(r: Reader):
@@ -159,14 +224,25 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                              "timeRange=[%d..%d]", len(filters), min_ts,
                              max_ts)
         deadline = _read_deadline(r)
+        or_sets = _read_or_sets(r)
+        filters, union_ok = _union_filters(filters, or_sets)
         if hasattr(storage, "reset_partial"):
             storage.reset_partial()
-        with qt.new_child("search_series") as sq:
-            series = storage.search_series(filters, min_ts, max_ts,
-                                           tenant=tenant,
-                                           **({"deadline": deadline}
-                                              if deadline else {}))
-            sq.donef("%d series", len(series))
+        # node-side cost accounting: every fetch seam under this search
+        # reports into `cost`, shipped back in the metadata frame
+        cost = costacc.CostTracker()
+        prev_cost = costacc.set_current(cost)
+        try:
+            with qt.new_child("search_series") as sq:
+                series = storage.search_series(filters, min_ts, max_ts,
+                                               tenant=tenant,
+                                               **({"deadline": deadline}
+                                                  if deadline else {}))
+                sq.donef("%d series", len(series))
+            cost.add_samples(sum(sd.timestamps.size for sd in series))
+        finally:
+            costacc.set_current(prev_cost)
+        costacc.record_usage(tenant, cost)
 
         def frames():
             for i in range(0, len(series), SERIES_PER_FRAME):
@@ -178,7 +254,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                     w.array(sd.timestamps)
                     w.array(sd.values)
                 yield w
-            yield _meta_frame(qt)
+            yield _meta_frame(qt, cost, union_ok)
         return frames()
 
     def h_search_columns(r: Reader):
@@ -195,43 +271,53 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                              "timeRange=[%d..%d]", len(filters), min_ts,
                              max_ts)
         deadline = _read_deadline(r)
+        or_sets = _read_or_sets(r)
+        filters, union_ok = _union_filters(filters, or_sets)
         if hasattr(storage, "reset_partial"):
             storage.reset_partial()
-        if getattr(storage, "search_columns", None) is not None:
-            with qt.new_child("search_columns") as sq:
-                cols = storage.search_columns(filters, min_ts, max_ts,
-                                              tenant=tenant,
-                                              **({"deadline": deadline}
-                                                 if deadline else {}))
-                sq.donef("%d series, %d samples", cols.n_series,
-                         cols.n_samples)
-            raw_names = cols.raw_names
-            counts = cols.counts
-            ts2, v2 = cols.ts, cols.vals
-            S = cols.n_series
+        cost = costacc.CostTracker()
+        prev_cost = costacc.set_current(cost)
+        try:
+            if getattr(storage, "search_columns", None) is not None:
+                with qt.new_child("search_columns") as sq:
+                    cols = storage.search_columns(
+                        filters, min_ts, max_ts, tenant=tenant,
+                        **({"deadline": deadline} if deadline else {}))
+                    sq.donef("%d series, %d samples", cols.n_series,
+                             cols.n_samples)
+                cost.add_samples(cols.n_samples)
+                raw_names = cols.raw_names
+                counts = cols.counts
+                ts2, v2 = cols.ts, cols.vals
+                S = cols.n_series
 
-            def series_arrays(a, b):
-                sel = np.arange(ts2.shape[1])[None, :] < \
-                    counts[a:b, None]
-                return ts2[a:b][sel], v2[a:b][sel]
-        else:  # per-series storage: adapt
-            with qt.new_child("search_series (columnar adapt)") as sq:
-                series = storage.search_series(filters, min_ts, max_ts,
-                                               tenant=tenant)
-                sq.donef("%d series", len(series))
-            raw_names = [getattr(sd, "raw_name", None) or
-                         sd.metric_name.marshal() for sd in series]
-            counts = np.fromiter((sd.timestamps.size for sd in series),
-                                 np.int64, len(series))
-            S = len(series)
+                def series_arrays(a, b):
+                    sel = np.arange(ts2.shape[1])[None, :] < \
+                        counts[a:b, None]
+                    return ts2[a:b][sel], v2[a:b][sel]
+            else:  # per-series storage: adapt
+                with qt.new_child("search_series (columnar adapt)") as sq:
+                    series = storage.search_series(filters, min_ts, max_ts,
+                                                   tenant=tenant)
+                    sq.donef("%d series", len(series))
+                cost.add_samples(sum(sd.timestamps.size for sd in series))
+                raw_names = [getattr(sd, "raw_name", None) or
+                             sd.metric_name.marshal() for sd in series]
+                counts = np.fromiter((sd.timestamps.size for sd in series),
+                                     np.int64, len(series))
+                S = len(series)
 
-            def series_arrays(a, b):
-                ts_cat = (np.concatenate(
-                    [sd.timestamps for sd in series[a:b]])
-                    if b > a else np.zeros(0, np.int64))
-                v_cat = (np.concatenate([sd.values for sd in series[a:b]])
-                         if b > a else np.zeros(0, np.float64))
-                return ts_cat, v_cat
+                def series_arrays(a, b):
+                    ts_cat = (np.concatenate(
+                        [sd.timestamps for sd in series[a:b]])
+                        if b > a else np.zeros(0, np.int64))
+                    v_cat = (np.concatenate(
+                        [sd.values for sd in series[a:b]])
+                        if b > a else np.zeros(0, np.float64))
+                    return ts_cat, v_cat
+        finally:
+            costacc.set_current(prev_cost)
+        costacc.record_usage(tenant, cost)
 
         def frames():
             for a in range(0, S, SERIES_PER_FRAME):
@@ -247,7 +333,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                 w.array(np.asarray(ts_cat, np.int64))
                 w.array(np.asarray(v_cat, np.float64))
                 yield w
-            yield _meta_frame(qt)
+            yield _meta_frame(qt, cost, union_ok)
         return frames()
 
     def h_search_metric_names(r: Reader):
@@ -361,6 +447,25 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
             else []
         return Writer().bytes_(json.dumps(rep).encode())
 
+    def h_profile(r: Reader):
+        """profile_v1: this node's continuous-profiler snapshot (folded
+        stacks + sampling meta) so a vmselect can merge the cluster's
+        CPU picture with node tags (the quarantineReport_v1 pattern).
+        Optional trailing reset flag (old clients don't send it) clears
+        this node's aggregates with the read, so a vmselect ?reset=1
+        starts a fresh window CLUSTER-wide.  Disabled profiler answers
+        an empty snapshot, never an error."""
+        import json
+
+        from ..utils import profiler
+        reset = bool(r.u64()) if r.remaining else False
+        if profiler.configured_hz() > 0:
+            profiler.ensure_started()
+            snap = profiler.PROFILER.snapshot(reset=reset)
+        else:
+            snap = {"disabled": True, "stacks": [], "samples": 0}
+        return Writer().bytes_(json.dumps(snap).encode())
+
     return {
         "writeRows_v1": h_write_rows,
         "writeRowsColumnar_v1": h_write_rows_columnar,
@@ -380,6 +485,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         "resetMetricNamesStats_v1": h_reset_metric_names_stats,
         "searchMetadata_v1": h_search_metadata,
         "quarantineReport_v1": h_quarantine_report,
+        "profile_v1": h_profile,
     }
 
 
@@ -480,41 +586,90 @@ class StorageNodeClient:
         return deadline + min(max(0.2 * remaining, 0.1), 2.0)
 
     @staticmethod
-    def _read_meta(r: Reader, tracer) -> bool:
-        """Parse the trailing metadata frame: partial flag + (when the
-        server traced) the storage-side span tree, grafted under
-        `tracer`.  Old servers send no trace bytes — remaining==0."""
+    def _read_meta(r: Reader, tracer) -> tuple[bool, dict | None]:
+        """Parse the trailing metadata frame: (partial, extras).  Old
+        servers send [partial][trace-when-tracing] — extras comes back
+        None (degraded cost accounting, no union ack).  New servers
+        always send [partial][trace-or-empty][extras-json]; the second
+        bytes field present is what disambiguates the dialects."""
         partial = bool(r.u64())
+        extras = None
         if r.remaining:
             import json
-            try:
-                tracer.add_remote(json.loads(r.bytes_()))
-            except (ValueError, RPCError):
-                pass  # malformed remote trace must never fail the search
-        return partial
+            b1 = r.bytes_()
+            if r.remaining:
+                # new dialect: b1 was the (possibly empty) trace slot
+                try:
+                    extras = json.loads(r.bytes_())
+                except (ValueError, RPCError):
+                    extras = None
+            if b1:
+                try:
+                    tracer.add_remote(json.loads(b1))
+                except (ValueError, RPCError):
+                    pass  # malformed remote trace never fails the search
+        return partial, extras
+
+    @staticmethod
+    def _finish_meta(extras: dict | None, or_sets) -> bool:
+        """Common metadata-frame epilogue: merge the node's shipped cost
+        frame into the current query's CostTracker (None degrades to
+        partial cost accounting, never an error) and answer whether the
+        shipped or_sets were ACKed as applied — False means the peer is
+        an old/union-less node and the caller must fall back to one
+        legacy call per set."""
+        tr = costacc.current()
+        if tr is not None:
+            tr.merge_remote((extras or {}).get("cost"))
+        if not or_sets:
+            return True
+        return bool((extras or {}).get("filterUnion"))
 
     def search_series(self, filters, min_ts, max_ts, tenant=(0, 0),
                       tracer=querytracer.NOP, deadline: float = 0.0):
-        """Returns (series_list, remote_partial)."""
+        """Returns (series_list, remote_partial).  Selector-level `or`
+        unions (filters = list of sets) ship the extra sets as the
+        trailing or_sets field; a peer that doesn't ack the union gets
+        one legacy call per remaining set instead (duplicate series
+        across sets collapse in the caller's assemble, the same way
+        replica overlap does)."""
+        first, extra_sets = _split_filter_sets(filters)
         w = _write_tenant(Writer(), tenant)
-        _write_filters(w, filters)
+        _write_filters(w, first)
         w.i64(min_ts).i64(max_ts)
         w.u64(1 if tracer.enabled else 0)
         w.u64(self._budget_ms(deadline))
+        if extra_sets:
+            w.u64(len(extra_sets))
+            for fs in extra_sets:
+                _write_filters(w, fs)
         out = []
         partial = False
+        extras = None
+        rpc_bytes = 0
         for r in self.select.call_stream("search_v1", w,
                                          deadline=self._wire_deadline(
                                              deadline)):
+            rpc_bytes += len(r.data)
             n = r.u64()
             if n == (1 << 32) - 1:  # trailing metadata frame
-                partial = self._read_meta(r, tracer)
+                partial, extras = self._read_meta(r, tracer)
                 continue
             for _ in range(n):
                 mn = MetricName.unmarshal(r.bytes_())
                 ts = r.array()
                 vals = r.array()
                 out.append((mn, ts, vals))
+        costacc.add_rpc_bytes(rpc_bytes)
+        if not self._finish_meta(extras, extra_sets):
+            # union-less peer: it served only the first set — fetch the
+            # remaining sets one legacy call at a time and concatenate
+            for fs in extra_sets:
+                more, p2 = self.search_series(fs, min_ts, max_ts, tenant,
+                                              tracer=tracer,
+                                              deadline=deadline)
+                out.extend(more)
+                partial = partial or p2
         return out, partial
 
     supports_columnar_read = True  # cleared on first unknown-method error
@@ -527,11 +682,16 @@ class StorageNodeClient:
         the caller's time.monotonic() cutoff, enforced per socket
         operation by the RPC client."""
         if self.supports_columnar_read:
+            first, extra_sets = _split_filter_sets(filters)
             w = _write_tenant(Writer(), tenant)
-            _write_filters(w, filters)
+            _write_filters(w, first)
             w.i64(min_ts).i64(max_ts)
             w.u64(1 if tracer.enabled else 0)
             w.u64(self._budget_ms(deadline))
+            if extra_sets:
+                w.u64(len(extra_sets))
+                for fs in extra_sets:
+                    _write_filters(w, fs)
             try:
                 frames = self.select.call_stream(
                     "searchColumns_v1", w,
@@ -545,10 +705,13 @@ class StorageNodeClient:
                 names: list[bytes] = []
                 cnt_parts, ts_parts, val_parts = [], [], []
                 partial = False
+                extras = None
+                rpc_bytes = 0
                 for r in frames:
+                    rpc_bytes += len(r.data)
                     sf = r.u64()
                     if sf == (1 << 32) - 1:  # trailing metadata frame
-                        partial = self._read_meta(r, tracer)
+                        partial, extras = self._read_meta(r, tracer)
                         continue
                     lens = r.array()
                     namebuf = r.bytes_()
@@ -559,6 +722,21 @@ class StorageNodeClient:
                     cnt_parts.append(r.array())
                     ts_parts.append(r.array())
                     val_parts.append(r.array())
+                costacc.add_rpc_bytes(rpc_bytes)
+                if not self._finish_meta(extras, extra_sets):
+                    # union-less peer served only the first set: pull
+                    # the remaining sets legacy-style and concatenate —
+                    # duplicate series collapse in the caller's
+                    # assemble exactly like replica overlap
+                    for fs in extra_sets:
+                        n2, c2, t2, v2, p2 = self.search_columns(
+                            fs, min_ts, max_ts, tenant, tracer=tracer,
+                            deadline=deadline)
+                        names.extend(n2)
+                        cnt_parts.append(c2)
+                        ts_parts.append(t2)
+                        val_parts.append(v2)
+                        partial = partial or p2
                 cat = (lambda ps, dt: np.concatenate(ps) if ps
                        else np.zeros(0, dt))
                 return (names, cat(cnt_parts, np.int64),
@@ -646,6 +824,22 @@ class StorageNodeClient:
         except RPCError as e:
             if "unknown rpc method" in str(e):
                 return []  # pre-quarantine storage node
+            raise
+        return json.loads(r.bytes_())
+
+    def profile(self, reset: bool = False) -> dict | None:
+        """This node's continuous-profiler snapshot; None from an
+        old node without profile_v1 (tolerated, the merge just lacks
+        that node's stacks).  `reset` clears the node's aggregates
+        atomically with the read (old nodes ignore the trailing flag —
+        their window simply doesn't reset)."""
+        import json
+        try:
+            r = self.select.call("profile_v1",
+                                 Writer().u64(1 if reset else 0))
+        except RPCError as e:
+            if "unknown rpc method" in str(e):
+                return None  # pre-profiler storage node
             raise
         return json.loads(r.bytes_())
 
@@ -1046,6 +1240,10 @@ class ClusterStorage:
     # eval passes ec.tracer down so storage-node spans land in the query
     # trace (the vmselect->vmstorage half of cross-RPC tracing)
     supports_search_tracer = True
+    # selector-level `or` filters ({a="b" or c="d"}) are shipped through
+    # search_v1/searchColumns_v1 as a trailing or_sets field; union-less
+    # peers degrade to one legacy call per set (see StorageNodeClient)
+    supports_filter_union = True
     # eval passes ec.deadline down so per-node RPC socket timeouts are
     # derived from the query's REMAINING budget: a hung vmstorage costs
     # one query deadline, not a fixed default timeout per hop
@@ -1202,6 +1400,25 @@ class ClusterStorage:
         # strict accounting: a node whose report is missing may be the
         # one HOLDING quarantined parts — replica coverage can cover its
         # data, never its per-node quarantine state
+        for rep in self._fanout(one, replica_covered_ok=False):
+            out.extend(rep)
+        return out
+
+    def profile_report(self, reset: bool = False) -> list[dict]:
+        """Cluster-wide profiler fan-out: every node's folded-stack
+        snapshot tagged with its node name, so the vmselect's
+        ``/api/v1/status/profile`` answers for the whole cluster.
+        ``reset`` propagates so ?reset=1 opens a fresh measurement
+        window on every node, not just the vmselect.  Node-local
+        state — strict partial accounting, like quarantine."""
+        def one(n):
+            snap = n.profile(reset=reset)
+            if snap is None or snap.get("disabled"):
+                return []
+            snap["node"] = n.name
+            return [snap]
+
+        out: list[dict] = []
         for rep in self._fanout(one, replica_covered_ok=False):
             out.extend(rep)
         return out

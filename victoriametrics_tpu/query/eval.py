@@ -33,12 +33,14 @@ nan = np.nan
 def _rollup_phase_lap(t0: float) -> None:
     import time as _t
 
+    from ..utils import costacc as _costacc
     from ..utils import flightrec as _flightrec
     from ..utils import metrics as _metricslib
     now = _t.perf_counter()
     _metricslib.REGISTRY.float_counter(
         'vm_fetch_phase_seconds_total{phase="rollup"}').inc(now - t0)
     _flightrec.rec("fetch:rollup", t0, now - t0)
+    _costacc.lap("fetch:rollup", now - t0)
 
 
 class QueryError(ValueError):

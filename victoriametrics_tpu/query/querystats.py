@@ -67,43 +67,68 @@ class QueryStats:
     ``max_lifetime_s``).  A bounded deque — old entries age out instead of
     freezing the table once an entry cap is hit."""
 
+    #: per-record cost columns carried into the aggregation (the
+    #: utils/costacc CostTracker summary keys), summed per (query,
+    #: time-range) group — the most EXPENSIVE queries, not just the
+    #: slowest, become findable
+    _COST_FIELDS = ("samplesScanned", "bytesRead", "cpuMs",
+                    "deviceBytes", "rpcBytes")
+
     def __init__(self, max_records: int = 20_000,
                  max_lifetime_s: float = 300.0):
         self._lock = threading.Lock()
-        # ring of (query, time_range_s rounded, duration_s, unix_s)
+        # ring of (query, time_range_s rounded, duration_s, cost dict,
+        # unix_s)
         self._ring: collections.deque = collections.deque(
             maxlen=max_records)
         self.max_lifetime_s = max_lifetime_s
         self._queries_total = metricslib.REGISTRY.counter(
             "vm_search_queries_total")
 
-    def record(self, query: str, time_range_s: float, duration_s: float):
+    def record(self, query: str, time_range_s: float, duration_s: float,
+               cost: dict | None = None):
         self._queries_total.inc()
         with self._lock:
             self._ring.append((query, round(time_range_s), duration_s,
-                               fasttime.unix_seconds()))
+                               cost, fasttime.unix_seconds()))
 
     def _aggregate(self) -> list[dict]:
         cutoff = fasttime.unix_seconds() - self.max_lifetime_s
         acc: dict[tuple, list] = {}
         with self._lock:
             records = list(self._ring)
-        for q, tr, d, at in records:
+        nf = len(self._COST_FIELDS)
+        for q, tr, d, cost, at in records:
             if at < cutoff:
                 continue
             e = acc.get((q, tr))
             if e is None:
-                e = acc[(q, tr)] = [0, 0.0]
+                e = acc[(q, tr)] = [0, 0.0] + [0] * nf
             e[0] += 1
             e[1] += d
-        return [{"query": q, "timeRangeSeconds": tr, "count": c,
-                 "sumDurationSeconds": round(d, 6),
-                 "avgDurationSeconds": round(d / c, 6)}
-                for (q, tr), (c, d) in acc.items()]
+            if cost:
+                for i, f in enumerate(self._COST_FIELDS):
+                    e[2 + i] += cost.get(f, 0)
+        out = []
+        for (q, tr), e in acc.items():
+            c, d = e[0], e[1]
+            rec = {"query": q, "timeRangeSeconds": tr, "count": c,
+                   "sumDurationSeconds": round(d, 6),
+                   "avgDurationSeconds": round(d / c, 6)}
+            for i, f in enumerate(self._COST_FIELDS):
+                key = "sum" + f[0].upper() + f[1:]
+                rec[key] = round(e[2 + i], 3) if f == "cpuMs" \
+                    else int(e[2 + i])
+            out.append(rec)
+        return out
 
     _SORTERS = {"count": lambda x: -x["count"],
                 "sumDuration": lambda x: -x["sumDurationSeconds"],
-                "avgDuration": lambda x: -x["avgDurationSeconds"]}
+                "avgDuration": lambda x: -x["avgDurationSeconds"],
+                # cumulative-cost orderings: CPU burned and samples
+                # scanned are the two cluster-cost currencies
+                "sumCpuMs": lambda x: -x["sumCpuMs"],
+                "sumSamplesScanned": lambda x: -x["sumSamplesScanned"]}
 
     def top(self, n: int, key: str) -> list[dict]:
         items = self._aggregate()
@@ -164,11 +189,15 @@ class SlowQueryLog:
 
     def maybe_record(self, query: str, start: int, end: int, step: int,
                      tenant, duration_s: float, ctx: int = 0,
-                     capture_id: int | None = None) -> bool:
+                     capture_id: int | None = None,
+                     cost: dict | None = None) -> bool:
         """Record when duration exceeds the threshold; returns whether it
         did.  `ctx` is the query's flight context (0 = none): the
         per-phase split is summed from the ring events carrying it —
-        including spans recorded on pool workers."""
+        including spans recorded on pool workers.  `cost` is the query's
+        CostTracker summary (samplesScanned/bytesRead/cpuMs/...), so a
+        slow record says what the query COST, not just how long it
+        took."""
         th = self.threshold_ms()
         if th <= 0 or duration_s * 1e3 < th:
             return False
@@ -196,6 +225,8 @@ class SlowQueryLog:
             rec["containerSpansMs"] = containers
         if capture_id is not None:
             rec["flightCaptureId"] = capture_id
+        if cost is not None:
+            rec["cost"] = cost
         with self._lock:
             self._ring.append(rec)
         return True

@@ -51,6 +51,7 @@ import weakref
 import numpy as np
 
 from ..storage.metric_name import MetricName
+from ..utils import costacc as _costacc
 from ..utils import flightrec as _flightrec
 from ..utils import metrics as metricslib
 from .types import EvalConfig, Timeseries
@@ -385,6 +386,15 @@ class RollupResultCache:
 
     def put(self, ec: EvalConfig, q: str, rows: list[Timeseries],
             now_ms: int, trust_raw: bool = True) -> None:
+        t0 = _time.perf_counter()
+        _costacc.restamp()
+        try:
+            self._put(ec, q, rows, now_ms, trust_raw)
+        finally:
+            _costacc.lap("cache:put", _time.perf_counter() - t0)
+
+    def _put(self, ec: EvalConfig, q: str, rows: list[Timeseries],
+             now_ms: int, trust_raw: bool = True) -> None:
         # don't cache the volatile tail
         cov_end_limit = now_ms - OFFSET_MS
         cov_end = ec.start + (
@@ -482,6 +492,7 @@ class RollupResultCache:
             # the inplace-vs-rebuild DECISION on the flight timeline: a
             # rebuild where inplace was expected is itself a latency clue
             _flightrec.rec("rcache:" + kind, t0, now - t0)
+            _costacc.lap("cache:merge", now - t0)
 
     def _merge_inplace(self, hit: CacheHit, fresh: list[Timeseries],
                        ec: EvalConfig, new_start: int, trust_raw: bool,

@@ -60,6 +60,12 @@ def timed_kernel_call(kernel: str, jit_fn, *args, **kw):
     # a device-leg flight capture attributes transfer vs compile vs
     # execute: uploads are spanned at the put seams, this is the rest
     _flightrec.rec(f"device:{phase}", t0, dt, arg=kernel)
+    # cost plane: device kernel wall into the query's tracker (CPU 0 —
+    # the work ran on the accelerator, not this thread)
+    from ..utils import costacc as _costacc
+    _tr = _costacc.current()
+    if _tr is not None:
+        _tr.lap(f"device:{phase}", dt, 0.0)
     return out
 
 

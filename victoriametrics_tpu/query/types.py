@@ -65,6 +65,7 @@ class EvalConfig:
     _grid: np.ndarray | None = None
     _samples_scanned: list | None = None  # shared per-query accumulator
     _partial: list | None = None          # per-query partial-result flag
+    _cost: object | None = None  # shared per-query CostTracker
 
     def __post_init__(self):
         if self.tracer is None:
@@ -76,6 +77,12 @@ class EvalConfig:
             self._samples_scanned = [0]
         if self._partial is None:
             self._partial = [False]
+        if self._cost is None:
+            # one CostTracker per query, shared by children exactly like
+            # the samples accumulator (utils/costacc: the per-query
+            # resource-cost plane behind /api/v1/status/usage)
+            from ..utils.costacc import CostTracker
+            self._cost = CostTracker()
         if self.step <= 0:
             raise ValueError("step must be positive")
         if self.end < self.start:
@@ -107,7 +114,7 @@ class EvalConfig:
                  no_device_roll=self.no_device_roll,
                  tracer=self.tracer, tpu=self.tpu,
                  _samples_scanned=self._samples_scanned,
-                 _partial=self._partial)
+                 _partial=self._partial, _cost=self._cost)
         d.update(kw)
         return EvalConfig(**d)
 
@@ -127,6 +134,11 @@ class EvalConfig:
         O(new-samples) serving regression guard asserts on this."""
         return int(self._samples_scanned[0])
 
+    @property
+    def cost(self):
+        """The query's shared CostTracker (utils/costacc)."""
+        return self._cost
+
     def count_samples(self, n: int):
         """Accumulate scanned samples across all selectors of one query
         (the -search.maxSamplesPerQuery scope, eval.go seriesFetched).
@@ -134,6 +146,7 @@ class EvalConfig:
         fused device path declining after its fetch)."""
         acc = self._samples_scanned
         acc[0] += n
+        self._cost.add_samples(n)
         if acc[0] > self.max_samples_per_query:
             from .limits import QueryLimitError
             raise QueryLimitError(

@@ -22,7 +22,7 @@ import numpy as np
 
 from ..devtools import faultinject
 from ..devtools.locktrace import make_lock, make_rlock
-from ..utils import flightrec, logger
+from ..utils import costacc, flightrec, logger
 from ..utils import metrics as metricslib
 from ..utils import workpool
 from ..utils.deadline import Budget, DeadlineExceededError  # noqa: F401 —
@@ -199,10 +199,11 @@ class _ColumnarSpace:
 
 def _phase_lap(phase: str, t0: float) -> float:
     """Account wall time since t0 to a fetch phase (counter + flight
-    event); returns the new t0."""
+    event + the current query's CostTracker); returns the new t0."""
     now = time.perf_counter()
     _PHASE[phase].inc(now - t0)
     flightrec.rec("fetch:" + phase, t0, now - t0)
+    costacc.lap("fetch:" + phase, now - t0)
     return now
 
 
@@ -1177,6 +1178,7 @@ class Storage:
                               max_series, tenant, _tsids, ColumnarSeries,
                               assemble, budget=None):
         t_ph = time.perf_counter()
+        costacc.restamp()  # start of this thread's phase-lap chain
         if budget is not None:
             budget.check()  # gate queue wait burned the budget already?
         tsids = (self._search_tsids_union(
@@ -1245,6 +1247,10 @@ class Storage:
                 dec_ops.decimal_to_float_blocks_py(mant_all, goff, scales,
                                                    vals_f, pool=workpool.POOL)
             t_ph = _phase_lap("decode", t_ph)
+        # cost accounting: the raw column bytes this fetch pulled out of
+        # parts (timestamps + decoded values) — the "bytesRead" column
+        # of top_queries/usage
+        costacc.add_part_bytes(int(ts_all.nbytes) + int(vals_f.nbytes))
         # resolve names FIRST and bake the canonical raw-name row order into
         # the assembly scatter (no post-assembly reorder pass); memoized
         # on the fetched id set — a rolling refresh's per-step cost stays

@@ -58,6 +58,7 @@ import threading
 import time as _time
 
 from ..devtools.locktrace import make_lock
+from . import costacc
 from . import flightrec
 from . import metrics as metricslib
 from . import querytracer
@@ -245,16 +246,18 @@ class WorkPool:
     # -- execution ---------------------------------------------------------
 
     def _exec(self, item) -> None:
-        fn, i, batch, ctx, tracer, t_enq = item
+        fn, i, batch, ctx, tracer, cost, t_enq = item
         err = None
         # cross-thread attribution: the task runs under the SUBMITTING
-        # query's flight context and tracer, so spans created here attach
-        # to that query instead of an anonymous worker (t_enq is None on
-        # the inline path — same thread, context already right)
+        # query's flight context, tracer and cost tracker, so spans and
+        # cost laps created here attach to that query instead of an
+        # anonymous worker (t_enq is None on the inline path — same
+        # thread, context already right)
         if t_enq is not None:
             t_run = _time.perf_counter()
             prev_ctx = flightrec.set_ctx(ctx)
             prev_tr = querytracer.set_current(tracer)
+            prev_cost = costacc.set_current(cost)
             # recorded AFTER set_ctx so the queue wait carries the
             # submitting query's ctx (it is part of that query's latency)
             flightrec.rec("pool:queue_wait", t_enq, t_run - t_enq)
@@ -267,6 +270,7 @@ class WorkPool:
             if t_enq is not None:
                 flightrec.rec("pool:task", t_run,
                               _time.perf_counter() - t_run)
+                costacc.set_current(prev_cost)
                 querytracer.set_current(prev_tr)
                 flightrec.set_ctx(prev_ctx)
         with batch.lock:
@@ -334,9 +338,10 @@ class WorkPool:
         _TASKS_TOTAL.inc(n)
         ctx = flightrec.get_ctx()
         tr = querytracer.current()
+        cost = costacc.current()
         t_enq = _time.perf_counter()
         for i, fn in enumerate(fns):
-            self._q.put((fn, i, batch, ctx, tr, t_enq))
+            self._q.put((fn, i, batch, ctx, tr, cost, t_enq))
         return self._collect(batch)
 
     def submit(self, fn) -> Future:
@@ -345,12 +350,13 @@ class WorkPool:
         batch = _Batch(1)
         if self.workers() <= 1 or _sched_active():
             _TASKS_TOTAL.inc()
-            self._exec((fn, 0, batch, 0, None, None))
+            self._exec((fn, 0, batch, 0, None, None, None))
             return Future(self, batch)
         self._ensure_started(1)
         _TASKS_TOTAL.inc()
         self._q.put((fn, 0, batch, flightrec.get_ctx(),
-                     querytracer.current(), _time.perf_counter()))
+                     querytracer.current(), costacc.current(),
+                     _time.perf_counter()))
         return Future(self, batch)
 
 
