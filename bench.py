@@ -603,5 +603,284 @@ def main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+FLEET_PANELS = (
+    "sum by (instance)(rate(http_requests_total[5m]))",
+    "sum by (job)(rate(http_requests_total[5m]))",
+    "max by (instance)(rate(http_requests_total[5m]))",
+    "count by (job)(rate(http_requests_total[5m]))",
+)
+FLEET_SUBS = 10          # subscribers PER PANEL (dashboards watching it)
+FLEET_INTERVALS = 6
+
+
+def fleet_main() -> None:
+    """``--scenario=fleet``: N subscribers x M shared-selector panels
+    served through the materialized-stream plane (query/matstream) —
+    the first entry of ROADMAP item 5's bench matrix and ISSUE 14's
+    acceptance artifact (BENCH_r11).
+
+    Ingest the dashboard scenario's store (8192 counters x 1440
+    samples, columnar write path), then:
+
+    - FLAT-SCAN PROOF: per-interval ``samples_scanned`` with 1 vs
+      ``FLEET_SUBS`` subscribers per panel — storage reads per interval
+      must be independent of subscriber count (the tier-1 guard's
+      number, measured at bench scale);
+    - THROUGHPUT: ``FLEET_INTERVALS`` live-ingest intervals serving
+      ``FLEET_SUBS x len(FLEET_PANELS)`` subscriptions; aggregate rate
+      counts the window every SUBSCRIBER's dashboard logically renders
+      per interval (the fleet accounting: N dashboards served, one
+      evaluation each per distinct expression) over the measured
+      advance+fan-out wall time;
+    - POLL BASELINE: the same interval served by one
+      ``_exec_range_cached`` poll per subscription (the PR-7 sharing
+      story without push) — the artifact reports both, so the push
+      win is not conflated with the ring cache's;
+    - ORACLE: each panel's reassembled client state equals a cold
+      nocache evaluation, bit for bit.
+
+    Host-only by design (the acceptance target names host-only
+    aggregate throughput); profiler + cost accounting stay ON."""
+    from victoriametrics_tpu import native
+    from victoriametrics_tpu.httpapi.prometheus_api import PrometheusAPI
+    from victoriametrics_tpu.query import rollup_result_cache as rrc
+    from victoriametrics_tpu.query.exec import exec_query
+    from victoriametrics_tpu.query.matstream import StreamClient
+    from victoriametrics_tpu.query.types import EvalConfig
+    from victoriametrics_tpu.storage.storage import Storage
+    from victoriametrics_tpu.utils import profiler
+
+    profiler.ensure_started()
+    tmp = tempfile.mkdtemp(prefix="vmtpu-fleet-")
+    now_ms = int(time.time() * 1000)
+    t_start = (now_ms - (N_SAMPLES - 1) * 15_000) // STEP * STEP
+    rng = np.random.default_rng(0)
+    try:
+        s = Storage(tmp)
+        base = np.arange(N_SAMPLES, dtype=np.int64) * 15_000 + t_start
+        keys = [(f'http_requests_total{{idx="{i}",'
+                 f'instance="host-{i % N_INSTANCES}",'
+                 f'job="job-{i % 17}"}}').encode()
+                for i in range(N_SERIES)]
+        keybuf = b"".join(keys)
+        klens = np.fromiter((len(k) for k in keys), np.int64, N_SERIES)
+        koffs = np.concatenate([[0], np.cumsum(klens)[:-1]])
+        last_val = np.zeros(N_SERIES)
+        t0 = time.perf_counter()
+        chunk = 256
+        for i0 in range(0, N_SERIES, chunk):
+            i1 = min(i0 + chunk, N_SERIES)
+            ts2 = np.sort(base[None, :] + rng.integers(
+                -JITTER_MS, JITTER_MS + 1, (i1 - i0, N_SAMPLES)), axis=1)
+            vals2 = np.cumsum(rng.integers(0, 50, (i1 - i0, N_SAMPLES)),
+                              axis=1).astype(np.float64)
+            last_val[i0:i1] = vals2[:, -1]
+            s.add_rows_columnar(native.ColumnarRows(
+                keybuf, np.repeat(koffs[i0:i1], N_SAMPLES),
+                np.repeat(klens[i0:i1], N_SAMPLES),
+                ts2.reshape(-1), vals2.reshape(-1)))
+        ingest_rate = N_SERIES * N_SAMPLES / (time.perf_counter() - t0)
+        s.force_flush()
+        s.force_merge()
+
+        # step-aligned: subscribe() rounds the window up to a step
+        # multiple, and the end-of-run oracle must evaluate the exact
+        # grid the stream serves
+        duration = ((N_SAMPLES - 1) * 15_000 - 300_000) // STEP * STEP
+        window_samples = N_SERIES * ((duration + 600_000) // 15_000)
+        end = t_start + -(-((N_SAMPLES - 1) * 15_000 + JITTER_MS)
+                          // STEP) * STEP
+
+        def ingest_fresh(end_ms: int) -> None:
+            incr = rng.integers(0, 50, (N_SERIES, 4))
+            vals2 = last_val[:, None] + np.cumsum(incr, axis=1)
+            last_val[:] = vals2[:, -1]
+            ts2 = (end_ms - STEP +
+                   (np.arange(4, dtype=np.int64) + 1)[None, :] * 15_000 +
+                   rng.integers(-JITTER_MS, JITTER_MS + 1, (N_SERIES, 4)))
+            ts2.sort(axis=1)
+            s.add_rows_columnar(native.ColumnarRows(
+                keybuf, np.repeat(koffs, 4), np.repeat(klens, 4),
+                ts2.reshape(-1),
+                vals2.reshape(-1).astype(np.float64)))
+
+        rrc.GLOBAL.reset()
+        api = PrometheusAPI(s)
+
+        def drain(subs_by_panel, now):
+            """Every subscriber consumes frames until its reassembled
+            window reaches the current interval (no-op for subscribers
+            already there)."""
+            target = (now // STEP) * STEP
+            for subs in subs_by_panel:
+                for sub, cli in subs:
+                    while not (cli.window and cli.window[1] >= target):
+                        f = sub.next_frame(timeout_s=5.0, now_ms=now)
+                        if f is None:
+                            raise RuntimeError("subscriber starved")
+                        cli.apply(f)
+
+        def new_subs(n_per_panel):
+            return [[(api.matstreams.subscribe(q, STEP, duration),
+                      StreamClient()) for _ in range(n_per_panel)]
+                    for q in FLEET_PANELS]
+
+        # ---- flat-scan proof: 1 subscriber per panel ----
+        subs = new_subs(1)
+        drain(subs, end)           # cold: one eval per panel
+        streams = [subs[p][0][0].stream for p in range(len(FLEET_PANELS))]
+        samples_1sub = []
+        for r in range(2):
+            end += STEP
+            ingest_fresh(end)
+            api.matstreams.advance_due(end)
+            drain(subs, end)
+            samples_1sub.append(sum(st.last_samples_scanned
+                                    for st in streams))
+        # fan out to FLEET_SUBS per panel (cold replays, no eval)
+        evals0 = sum(st.evals for st in streams)
+        for p, q in enumerate(FLEET_PANELS):
+            subs[p].extend(
+                (api.matstreams.subscribe(q, STEP, duration),
+                 StreamClient()) for _ in range(FLEET_SUBS - 1))
+        drain(subs, end)
+        assert sum(st.evals for st in streams) == evals0, \
+            "cold subscribes re-evaluated"
+        samples_nsub = []
+        for r in range(2):
+            end += STEP
+            ingest_fresh(end)
+            api.matstreams.advance_due(end)
+            drain(subs, end)
+            samples_nsub.append(sum(st.last_samples_scanned
+                                    for st in streams))
+
+        # ---- throughput: FLEET_INTERVALS pushed intervals ----
+        n_subscriptions = FLEET_SUBS * len(FLEET_PANELS)
+        push_wall = []
+        interval_samples = []
+        for r in range(FLEET_INTERVALS):
+            end += STEP
+            ingest_fresh(end)
+            t0 = time.perf_counter()
+            api.matstreams.advance_due(end)
+            drain(subs, end)
+            push_wall.append(time.perf_counter() - t0)
+            interval_samples.append(sum(st.last_samples_scanned
+                                        for st in streams))
+        # ---- poll baseline: the same interval, one cached poll per
+        # subscription — canonical text, so the polls share the
+        # STREAMS' warm ring entries (the strongest PR-7 baseline:
+        # suffix merge once per panel, then pure full hits) ----
+        canon = [api.matstreams.canonical(q) for q in FLEET_PANELS]
+        poll_wall = []
+        for r in range(3):
+            end += STEP
+            ingest_fresh(end)
+            t0 = time.perf_counter()
+            for q in canon:
+                for _ in range(FLEET_SUBS):
+                    api._exec_range_cached(
+                        EvalConfig(start=end - duration, end=end,
+                                   step=STEP, storage=s), q, end)
+            if r > 0:  # first interval warms the poll path's entries
+                poll_wall.append(time.perf_counter() - t0)
+
+        # ---- oracle: every panel's pushed state == cold eval ----
+        # (polls above advanced the shared ring entries past the last
+        # pushed frame, so push one final interval first)
+        end += STEP
+        ingest_fresh(end)
+        api.matstreams.advance_due(end)
+        drain(subs, end)
+        import math as _math
+        for p, q in enumerate(FLEET_PANELS):
+            ec = EvalConfig(start=end - duration, end=end, step=STEP,
+                            storage=s, disable_cache=True)
+            cold = exec_query(ec, q)
+            grid = ec.timestamps() / 1e3
+            from victoriametrics_tpu.query.format_value import fmt_value
+            want = []
+            for rr in cold:
+                vals = [[float(t), fmt_value(v)]
+                        for t, v in zip(grid, rr.values)
+                        if not _math.isnan(v)]
+                if vals:
+                    want.append({"metric": rr.metric_name.to_dict(),
+                                 "values": vals})
+            want.sort(key=lambda e: json.dumps(e["metric"],
+                                               sort_keys=True))
+            for sub, cli in subs[p]:
+                assert cli.result() == want, \
+                    f"panel {p} pushed state diverged from cold eval"
+
+        usage = api.matstreams.usage_rows()
+        p50_push = float(np.median(push_wall))
+        p50_poll = float(np.median(poll_wall))
+        agg_rate = n_subscriptions * window_samples / p50_push
+        baseline = 1e8
+        med_1 = int(np.median(samples_1sub))
+        med_n = int(np.median(samples_nsub))
+        for subs_p in subs:
+            for sub, _ in subs_p:
+                sub.close()
+        print(json.dumps({
+            "metric": (
+                f"fleet subscription push: {n_subscriptions} "
+                f"subscriptions ({FLEET_SUBS} dashboards x "
+                f"{len(FLEET_PANELS)} shared-selector panels), "
+                f"{N_SERIES}x{N_SAMPLES} counters, live ingest, "
+                f"served via materialized streams (one eval per "
+                f"distinct expression per interval; aggregate rate "
+                f"counts each subscriber's rendered window; ingest "
+                f"{ingest_rate / 1e3:.0f}k rows/s; poll-loop baseline "
+                f"= {FLEET_SUBS} cached query_range polls per panel)"),
+            "value": round(agg_rate),
+            "unit": "samples/sec",
+            "vs_baseline": round(agg_rate / baseline, 2),
+            "backend": "host-batch",
+            "scenario": "fleet",
+            "subscribers_per_panel": FLEET_SUBS,
+            "panels": len(FLEET_PANELS),
+            "streams": api.matstreams.stream_count(),
+            "push_interval_ms": [round(x * 1e3, 2) for x in push_wall],
+            "push_interval_p50_ms": round(p50_push * 1e3, 2),
+            "poll_interval_ms": [round(x * 1e3, 2) for x in poll_wall],
+            "poll_interval_p50_ms": round(p50_poll * 1e3, 2),
+            "push_vs_poll_speedup": round(p50_poll / p50_push, 2),
+            "storage_reads_flat": {
+                "samples_per_interval_1sub": med_1,
+                f"samples_per_interval_{FLEET_SUBS}sub": med_n,
+                "flat": bool(med_n <= med_1 * 1.2),
+            },
+            "samples_scanned_per_interval": interval_samples,
+            "per_stream_usage": usage,
+            "profiler": {
+                "samples": profiler.PROFILER.snapshot()["samples"],
+                "hz": profiler.configured_hz(),
+            },
+        }))
+        assert med_n <= med_1 * 1.2, (
+            "storage reads per interval grew with subscribers")
+    finally:
+        try:
+            s.close()
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+    _p = argparse.ArgumentParser(prog="bench.py")
+    _p.add_argument("--scenario", default="dashboard",
+                    choices=["dashboard", "fleet"],
+                    help="dashboard: the classic rolling-window loop "
+                         "(default, the BENCH_r* headline); fleet: N "
+                         "subscribers x M shared-selector panels via "
+                         "materialized streams (BENCH_r11)")
+    _args = _p.parse_args()
+    if _args.scenario == "fleet":
+        fleet_main()
+    else:
+        main()

@@ -1,8 +1,12 @@
 """Golden conformance corpus: 579 query cases transcribed mechanically from
 the reference's app/vmselect/promql/exec_test.go (TestExecSuccess harness:
-start=1000e3 end=2000e3 step=200e3, 6 output points per series).
+start=1000e3 end=2000e3 step=200e3, 6 output points per series), plus 10
+binary-op label-matching pins added with the common-filter pushdown
+optimizer (the optimizer runs by default in exec, so every case here also
+conforms THROUGH it; the pushdown-specific table lives in
+tests/test_optimizer.py).
 
-tests/golden_known_gaps.json is EMPTY: all 579 extracted cases pass,
+tests/golden_known_gaps.json is EMPTY: all extracted cases pass,
 including the Go-PRNG rand() family (bit-exact math/rand replica in
 query/gorand.py). Keep it empty.
 """

@@ -40,6 +40,14 @@ fi
 if [ "${VMT_NO_PROFILE_SMOKE:-0}" != "1" ]; then
     python -m victoriametrics_tpu.devtools.profile_overhead
 fi
+# Materialized-stream fan-out smoke (devtools/matstream_overhead.py):
+# one interval with N subscribers must cost ONE evaluation with flat
+# samples-scanned and near-zero per-subscriber fan-out cost.
+# VMT_NO_MATSTREAM_SMOKE=1 skips it.
+if [ "${VMT_NO_MATSTREAM_SMOKE:-0}" != "1" ]; then
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python -m victoriametrics_tpu.devtools.matstream_overhead
+fi
 if [ "${VMT_NO_DEVICE_SMOKE:-0}" != "1" ]; then
     sh tools/device.sh \
         "tests/test_device_residency.py::test_refresh_uploads_only_tail_on_mesh"

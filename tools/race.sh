@@ -45,6 +45,13 @@
 # VM_FLIGHTREC=0 is the escape hatch when bisecting (also disables the
 # pool's ctx-propagation records around each task).
 #
+# The materialized-stream plane (query/matstream) is covered by the
+# race-marked stress in tests/test_matstream.py: subscriber churn +
+# live ingest + concurrent cooperative pumps over one stream, asserting
+# the steady subscriber's reassembled state equals the polled oracle,
+# queues stay bounded, and no exception escapes.  VM_MATSTREAM=0 is the
+# escape hatch (watch subscribers fall back to polling query_range).
+#
 # The per-tenant admission gate (utils/workpool.TenantGate) is covered
 # by the race-marked stress in tests/test_tenant_gate.py: two tenants'
 # workers under the deterministic scheduler, asserting the per-tenant
@@ -63,5 +70,5 @@ cd "$(dirname "$0")/.."
 exec env VMT_RACETRACE=1 VMT_LOCKTRACE_MAX_HOLD_MS=60000 \
     python -m pytest tests/test_stress_race.py \
     tests/test_result_cache_ring.py tests/test_flightrec.py \
-    tests/test_tenant_gate.py -q -m race \
+    tests/test_tenant_gate.py tests/test_matstream.py -q -m race \
     -p no:cacheprovider "$@"

@@ -1,0 +1,74 @@
+#!/bin/sh
+# Stdlib-only SSE client for the materialized-stream push surface
+# (/api/v1/watch): hold one subscription and print suffix frames as they
+# arrive — the dashboard-side half of the cross-query amortization plane.
+#
+# Usage:
+#   tools/watch.sh 'sum by (g)(rate(m[5m]))'                # defaults
+#   tools/watch.sh 'rate(m[1m])' -step 15s -range 30m -n 10
+#   tools/watch.sh 'rate(m[1m])' -url http://host:8428 -assemble
+#
+# Flags:
+#   -url U       serving base URL        (default http://127.0.0.1:8428)
+#   -step S      grid step               (default 1m)
+#   -range R     rolling window length   (default 30m)
+#   -n N         stop after N frames     (default 0 = until ^C)
+#   -assemble    maintain client-side state and print the REASSEMBLED
+#                query_range-shaped result after each frame (the
+#                StreamClient the bit-equality oracle uses) instead of
+#                the raw frames
+set -eu
+cd "$(dirname "$0")/.."
+[ "$#" -ge 1 ] || { echo "usage: tools/watch.sh QUERY [flags]" >&2; exit 2; }
+QUERY=$1; shift
+URL=http://127.0.0.1:8428 STEP=1m RANGE=30m N=0 ASSEMBLE=0
+while [ "$#" -gt 0 ]; do
+    case "$1" in
+        -url) URL=$2; shift 2;;
+        -step) STEP=$2; shift 2;;
+        -range) RANGE=$2; shift 2;;
+        -n) N=$2; shift 2;;
+        -assemble) ASSEMBLE=1; shift;;
+        *) echo "unknown flag $1" >&2; exit 2;;
+    esac
+done
+exec env WATCH_QUERY="$QUERY" WATCH_URL="$URL" WATCH_STEP="$STEP" \
+    WATCH_RANGE="$RANGE" WATCH_N="$N" WATCH_ASSEMBLE="$ASSEMBLE" \
+    python - <<'EOF'
+import json, os, sys, urllib.parse, urllib.request
+
+from victoriametrics_tpu.query.matstream import StreamClient
+
+params = {"query": os.environ["WATCH_QUERY"],
+          "step": os.environ["WATCH_STEP"],
+          "range": os.environ["WATCH_RANGE"]}
+n = int(os.environ["WATCH_N"])
+if n:
+    params["max_frames"] = str(n)
+url = (os.environ["WATCH_URL"].rstrip("/") + "/api/v1/watch?"
+       + urllib.parse.urlencode(params))
+assemble = os.environ["WATCH_ASSEMBLE"] == "1"
+cli = StreamClient()
+try:
+    with urllib.request.urlopen(url) as r:
+        for raw in r:
+            line = raw.decode("utf-8", "replace").rstrip("\n")
+            if not line.startswith("data: "):
+                continue
+            frame = json.loads(line[len("data: "):])
+            if not assemble:
+                print(json.dumps(frame), flush=True)
+                continue
+            cli.apply(frame)
+            print(json.dumps({
+                "frame": {k: frame.get(k) for k in
+                          ("type", "seq", "newStartMs", "partial",
+                           "resync", "error") if k in frame},
+                "window": cli.window,
+                "result": cli.result()}), flush=True)
+except KeyboardInterrupt:
+    pass
+except urllib.error.HTTPError as e:
+    sys.stderr.write(f"watch: HTTP {e.code}: {e.read().decode()}\n")
+    sys.exit(1)
+EOF

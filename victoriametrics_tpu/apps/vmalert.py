@@ -106,6 +106,42 @@ class RemoteWriter:
             logger.throttled_warnf("rw", 10, "vmalert remote write: %s", e)
 
 
+class EngineDatasource:
+    """In-process datasource for rule groups colocated with a serving
+    instance (vmsingle ``-rule`` / embedded tests): expressions evaluate
+    through the engine's materialized-stream registry
+    (``query/matstream.instant_vector``) — ONE evaluation per distinct
+    (expression, timestamp) shared by every rule and counted once in
+    the per-tenant cost plane, instead of one HTTP poll per rule.
+    Returns the same row shape as :class:`Datasource` with the same
+    value formatting (``float(fmt_value(v))``), so rule results are
+    identical to the legacy poll path by construction; with
+    ``VM_MATSTREAM=0`` the memo is bypassed and every rule evaluates
+    itself — exactly the legacy behavior (the equality oracle)."""
+
+    def __init__(self, api, tenant: tuple = (0, 0)):
+        self.api = api          # httpapi.prometheus_api.PrometheusAPI
+        self.tenant = tenant
+
+    def query(self, expr: str, ts: float | None = None) -> list[dict]:
+        from ..utils import fasttime
+        ts_ms = fasttime.unix_ms() if ts is None else int(float(ts) * 1000)
+        return self.api.matstreams.instant_vector(expr, ts_ms, self.tenant)
+
+
+class LocalWriter:
+    """RemoteWriter twin for embedded rule groups: recording results and
+    alert state land directly in the colocated storage, no HTTP hop."""
+
+    def __init__(self, api, tenant: tuple = (0, 0)):
+        self.api = api
+        self.tenant = tenant
+
+    def write(self, rows: list[tuple[dict, int, float]]) -> None:
+        self.api._ingest([(dict(labels), int(ts), float(v))
+                          for labels, ts, v in rows], self.tenant)
+
+
 def _dur_s(s, default=0.0) -> float:
     if s in (None, ""):
         return default

@@ -68,6 +68,13 @@ def parse_flags(argv=None):
                    default="10s")
     p.add_argument("-pushmetrics.extraLabel", dest="pushmetrics_extra",
                    default="")
+    p.add_argument("-rule", action="append", default=[],
+                   help="vmalert-format rule file evaluated SERVER-SIDE "
+                        "through the materialized-stream engine (rules "
+                        "sharing an expression share one fetch+rollup "
+                        "per interval); repeatable")
+    p.add_argument("-evaluationInterval", dest="eval_interval",
+                   default="1m")
     p.add_argument("-loggerLevel", default="INFO")
     p.add_argument("-tls", action="store_true")
     p.add_argument("-tlsCertFile", default="")
@@ -206,6 +213,33 @@ def build(args):
             interval_s=_dur_ms(args.pushmetrics_interval) / 1e3,
             extra_labels=args.pushmetrics_extra)
         api.pusher.start()
+    api.rule_groups = []
+    if getattr(args, "rule", None):
+        # server-side recording/alerting rules (the reference evaluates
+        # recording rules in vmalert against vmselect; here they run
+        # in-process through the shared materialized-stream engine, so
+        # rules and watch subscribers amortize one evaluation per
+        # distinct expression)
+        import yaml
+
+        from ..httpapi.server import Response as _Resp
+        from . import vmalert as vmalert_mod
+        ds = vmalert_mod.EngineDatasource(api)
+        rw = vmalert_mod.LocalWriter(api)
+        for path in args.rule:
+            cfg = yaml.safe_load(open(path).read()) or {}
+            for g in cfg.get("groups", []):
+                api.rule_groups.append(vmalert_mod.Group(
+                    g, ds, [], rw,
+                    vmalert_mod._dur_s(args.eval_interval, 60.0)))
+        for g in api.rule_groups:
+            g.start()
+        srv.route("/api/v1/rules", lambda req: _Resp.json(
+            {"status": "success",
+             "data": {"groups": [g.api_dict()
+                                 for g in api.rule_groups]}}))
+        logger.infof("vmsingle: %d server-side rule group(s) armed",
+                     len(api.rule_groups))
     api.ingest_servers = []
     for proto, addr in (("graphite", args.graphite_addr),
                         ("influx", args.influx_addr),
@@ -266,6 +300,8 @@ def main(argv=None):
             pass
     finally:
         logger.infof("vmsingle: shutting down")
+        for g in getattr(_api, "rule_groups", []):
+            g.stop()
         srv.stop()
         for isrv in getattr(_api, "ingest_servers", []):
             isrv.stop()

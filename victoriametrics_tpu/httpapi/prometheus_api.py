@@ -38,7 +38,7 @@ from ..storage.metric_name import MetricName
 from ..utils import fasttime, flightrec, logger
 from ..utils import metrics as metricslib
 from ..utils.workpool import SearchLimitError
-from .server import HTTPServer, Request, Response
+from .server import HTTPServer, Request, Response, StreamingResponse
 
 #: scatter-gather responses that came back incomplete (a storage node
 #: was down/slow) — whether served as isPartial=true or denied as 503
@@ -157,6 +157,11 @@ class PrometheusAPI:
         self.qstats = QueryStats()
         self.slowlog = SlowQueryLog()
         self.gate = ConcurrencyGate(max_concurrent_queries)
+        # materialized streams + subscription push (query/matstream):
+        # one evaluator per distinct expression, suffix deltas fanned to
+        # every /api/v1/watch subscriber and vmalert rule group
+        from ..query.matstream import MatStreamRegistry
+        self.matstreams = MatStreamRegistry(self)
         self.started_at = fasttime.unix_seconds()
         self.rows_inserted = 0
         self.rows_relabel_dropped = 0
@@ -244,6 +249,7 @@ class PrometheusAPI:
         r = srv.route
         r("/api/v1/query", self.h_query)
         r("/api/v1/query_range", self.h_query_range)
+        r("/api/v1/watch", self.h_watch)
         r("/api/v1/series", self.h_series)
         r("/api/v1/labels", self.h_labels)
         r("/api/v1/label/", self.h_label_values)
@@ -549,6 +555,84 @@ class PrometheusAPI:
         if qt.enabled:
             body["trace"] = qt.to_dict()
         return Response.json(body)
+
+    def h_watch(self, req: Request) -> Response:
+        """Materialized-stream subscription push (``/api/v1/watch?query=
+        ...&step=...&range=...``): the dashboard holds ONE subscription
+        and receives SSE suffix frames instead of re-issuing
+        ``query_range`` — the per-interval evaluation is shared by every
+        subscriber of the same canonical expression (storage reads per
+        interval are O(distinct expressions), not O(subscribers)).
+
+        Args: ``query`` (range expression), ``step`` (grid step,
+        default 1m), ``range`` (rolling window length, e.g. ``30m``) or
+        a ``start``/``end`` pair whose span defines it, ``max_frames``
+        (close after N frames — test/CLI hygiene; 0 = until
+        disconnect), ``heartbeat`` (idle keepalive seconds, default 15).
+        First frame is a full snapshot (replayed from the warm stream
+        when one exists), then deltas.  503 when VM_MATSTREAM=0."""
+        from ..query import matstream
+        if not matstream.enabled():
+            return Response.error(
+                "materialized streams disabled (VM_MATSTREAM=0)", 503,
+                "unavailable")
+        q = req.arg("query")
+        if not q:
+            return Response.error("missing 'query' arg")
+        try:
+            step = parse_step(req.arg("step"))
+            rng = req.arg("range")
+            if rng:
+                duration = parse_step(rng, 0)
+            else:
+                now = fasttime.unix_ms()
+                start = parse_time(req.arg("start"), now - 300_000)
+                end = parse_time(req.arg("end"), now)
+                duration = max(end - start, step)
+            max_frames = int(req.arg("max_frames", "0") or 0)
+            # floor 0.2s: heartbeat=0 would turn the frame loop into a
+            # hot keepalive spin (one queue poll + socket write per
+            # iteration) — a one-request CPU DoS
+            heartbeat = min(max(
+                float(req.arg("heartbeat", "15") or 15), 0.2), 3600.0)
+        except (QueryError, ValueError) as e:
+            return Response.error(str(e))
+        try:
+            sub = self.matstreams.subscribe(q, step, duration,
+                                            self._tenant(req))
+        except matstream.MatStreamLimitError as e:
+            resp = Response.error(str(e), 429, "too_many_requests")
+            resp.headers["Retry-After"] = "10"
+            return resp
+        except (QueryError, ParseError, ValueError) as e:
+            return Response.error(str(e))
+
+        def frames():
+            sent = 0
+            try:
+                while True:
+                    f = sub.next_frame(timeout_s=heartbeat)
+                    if f is None:
+                        if sub.closed:
+                            return
+                        yield b": keepalive\n\n"
+                        continue
+                    # frames are SHARED dicts (one per advance, fanned
+                    # to every subscriber): encode once process-wide,
+                    # not once per subscriber
+                    yield (b"event: frame\ndata: " +
+                           matstream.encode_frame(f) + b"\n\n")
+                    sent += 1
+                    if max_frames and sent >= max_frames:
+                        return
+            finally:
+                sub.close()
+        # on_close covers the never-started-generator disconnect (the
+        # generator's own finally can't run then) — close() is
+        # idempotent, so the normal path closing twice is harmless
+        return StreamingResponse(frames(),
+                                 content_type="text/event-stream",
+                                 on_close=sub.close)
 
     # queries calling non-deterministic / wall-clock functions bypass the
     # rollup-result cache; \b keeps avg_over_time( from matching time(
@@ -1308,9 +1392,18 @@ class PrometheusAPI:
         from ..utils import costacc
         rows = costacc.TENANT_USAGE.snapshot(
             reset=req.arg("reset") == "1")
+        data = {"tenants": rows}
+        ms = getattr(self, "matstreams", None)
+        if ms is not None:
+            # per-stream attribution: each row's totals are the SHARED
+            # evaluations, counted once per interval — not multiplied by
+            # the stream's subscriber count
+            data["matstreams"] = ms.usage_rows()
+            data["matstreamInstant"] = {"evals": ms.instant_evals,
+                                        "reuse": ms.instant_reuse}
         return Response.json({
             "status": "success",
-            "data": {"tenants": rows},
+            "data": data,
         })
 
     def h_profile(self, req: Request) -> Response:

@@ -62,6 +62,28 @@ class Response:
         return cls(status, s.encode(), "text/plain; charset=utf-8")
 
 
+class StreamingResponse:
+    """A chunked/streaming response (SSE push, long exports): `chunks`
+    is an iterator of byte chunks written (and flushed) one at a time.
+    No Content-Length; the connection closes when the iterator ends, so
+    clients see a clean EOF.  Closing the generator (client disconnect)
+    runs its ``finally`` blocks — handlers unsubscribe there."""
+
+    def __init__(self, chunks, status: int = 200,
+                 content_type: str = "text/event-stream",
+                 headers: dict | None = None, on_close=None):
+        self.chunks = chunks
+        self.status = status
+        self.content_type = content_type
+        self.headers = dict(headers or {})
+        #: cleanup invoked when the stream ends for ANY reason.  The
+        #: generator's own finally blocks only run once it has STARTED —
+        #: a client that disconnects before the first chunk (headers
+        #: write raises) would otherwise leak whatever the handler
+        #: registered (e.g. a watch subscription).
+        self.on_close = on_close
+
+
 class HTTPServer:
     """Route-dispatching server. Routes: exact path or prefix (trailing /)."""
 
@@ -137,6 +159,9 @@ class HTTPServer:
                 self._send(resp)
 
             def _send(self, resp: Response):
+                if isinstance(resp, StreamingResponse):
+                    self._send_stream(resp)
+                    return
                 body = resp.body
                 accept = (self.headers.get("Accept-Encoding") or "")
                 headers = dict(resp.headers)
@@ -153,6 +178,43 @@ class HTTPServer:
                     self.wfile.write(body)
                 except (BrokenPipeError, ConnectionResetError):
                     pass
+
+            def _send_stream(self, resp: "StreamingResponse"):
+                # no Content-Length: the response ends when the chunk
+                # iterator does, and the connection closes (HTTP/1.1
+                # clients see Connection: close + EOF framing)
+                self.close_connection = True
+                chunks = resp.chunks
+                try:
+                    self.send_response(resp.status)
+                    self.send_header("Content-Type", resp.content_type)
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    for k, v in resp.headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    for chunk in chunks:
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                except Exception as e:  # noqa: BLE001 — mid-stream error
+                    # headers are long gone: all we can do is log and
+                    # close so the client sees the stream end
+                    logger.errorf("streaming handler %s: %s",
+                                  self.path, e)
+                finally:
+                    close = getattr(chunks, "close", None)
+                    if close is not None:
+                        close()  # runs a STARTED generator's finally
+                    if resp.on_close is not None:
+                        # runs even when the generator never started
+                        # (close() skips finally blocks then)
+                        try:
+                            resp.on_close()
+                        except Exception as e:  # noqa: BLE001
+                            logger.errorf("stream on_close %s: %s",
+                                          self.path, e)
 
             do_GET = do_POST = do_PUT = do_DELETE = _handle
 

@@ -902,6 +902,13 @@ class ClusterStorage:
             "vm_rpc_rows_sent_total")
         self._reroutes_counter = metricslib.REGISTRY.counter(
             "vm_rpc_rows_rerouted_total")
+        # read fan-outs launched (one per search, NOT one per node): the
+        # matstream fleet guard asserts this stays flat as subscribers
+        # grow — N watchers of one expression must cost ONE fan-out per
+        # interval
+        self._search_fanouts = metricslib.Counter("search_fanouts")
+        self._search_fanouts_counter = metricslib.REGISTRY.counter(
+            "vm_cluster_search_fanouts_total")
         self._lock = make_lock("parallel.VMSelect._lock")
         # partial-result tracking is per handler thread and STICKY across
         # the fanouts of one query (a shared flag would race between
@@ -1261,6 +1268,8 @@ class ClusterStorage:
         per-row sort fix + exact-duplicate-timestamp dedup (keep last),
         identical to the old per-series merge semantics."""
         from ..storage.columnar import ColumnarSeries, assemble
+        self._search_fanouts.inc()
+        self._search_fanouts_counter.inc()
 
         def query_node(n):
             # one child span per storage node; children.append is
@@ -1462,10 +1471,18 @@ class ClusterStorage:
                 "seriesCountByLabelValuePair":
                     merge_top("seriesCountByLabelValuePair")}
 
+    @property
+    def search_fanouts(self) -> int:
+        """Read fan-outs launched by this vmselect (one per scatter-
+        gather, regardless of node count) — the O(distinct expressions)
+        fleet guard's observable."""
+        return self._search_fanouts.get()
+
     def metrics(self):
         return {"vm_cluster_nodes": len(self.nodes),
                 "vm_cluster_rows_sent_total": self.rows_sent,
                 "vm_cluster_reroutes_total": self.reroutes,
+                "vm_cluster_search_fanouts_total": self.search_fanouts,
                 "vm_cluster_healthy_nodes":
                     sum(1 for n in self.nodes if n.healthy)}
 

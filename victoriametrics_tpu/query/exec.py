@@ -3,6 +3,7 @@ cache -> eval -> sorted results."""
 
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
@@ -12,21 +13,36 @@ from .metricsql import parse
 from .metricsql.ast import Expr
 from .types import EvalConfig, Timeseries
 
-_parse_cache: dict[str, Expr] = {}
+_parse_cache: dict[tuple, Expr] = {}
 _parse_lock = threading.Lock()
 _PARSE_CACHE_MAX = 10_000
 
 
+def optimize_enabled() -> bool:
+    """Common-filter pushdown (metricsql Optimize analog) on?
+    ``VM_MQL_OPTIMIZE=0`` restores raw-parse evaluation exactly — the
+    escape hatch AND the equality oracle."""
+    return os.environ.get("VM_MQL_OPTIMIZE", "1") != "0"
+
+
 def parse_cached(q: str) -> Expr:
+    """Parse (and, by default, optimize) one query; the cache key
+    includes the optimizer flag so flipping VM_MQL_OPTIMIZE never serves
+    a stale AST from the other mode."""
+    opt = optimize_enabled()
+    key = (q, opt)
     with _parse_lock:
-        e = _parse_cache.get(q)
+        e = _parse_cache.get(key)
     if e is not None:
         return e
     e = parse(q)
+    if opt:
+        from .metricsql.optimizer import optimize
+        e = optimize(e)
     with _parse_lock:
         if len(_parse_cache) >= _PARSE_CACHE_MAX:
             _parse_cache.clear()
-        _parse_cache[q] = e
+        _parse_cache[key] = e
     return e
 
 
