@@ -870,17 +870,372 @@ def fleet_main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+CL_SERIES = int(os.environ.get("VM_BENCH_CLUSTER_SERIES", "4096"))
+CL_SAMPLES = int(os.environ.get("VM_BENCH_CLUSTER_SAMPLES", "360"))
+CL_READS = 5
+
+
+def _spawn_vmstorage(base_dir: str, tag: str):
+    """One real vmstorage OS process on loopback ports; returns
+    (Popen, http_port, node_spec)."""
+    import socket
+    import subprocess
+    import urllib.request
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    hp, ip_, sp = free_port(), free_port(), free_port()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "victoriametrics_tpu.apps.vmstorage",
+         f"-storageDataPath={base_dir}/{tag}",
+         f"-httpListenAddr=127.0.0.1:{hp}",
+         f"-vminsertAddr=127.0.0.1:{ip_}",
+         f"-vmselectAddr=127.0.0.1:{sp}"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{hp}/health", timeout=1):
+                break
+        except OSError:
+            if proc.poll() is not None:
+                raise RuntimeError(f"vmstorage {tag} died at startup")
+            time.sleep(0.1)
+    else:
+        raise TimeoutError(f"vmstorage {tag} never became ready")
+    return proc, hp, f"127.0.0.1:{ip_}:{sp}"
+
+
+def _cluster_corpus():
+    """(keybuf, koffs, klens, per-chunk ingest fn inputs) for the
+    cluster corpus: CL_SERIES counters x CL_SAMPLES scrapes."""
+    rng = np.random.default_rng(12)
+    t0 = 1_753_700_000_000
+    keys = [(f'cbench{{idx="{i}",instance="h{i % 64}",'
+             f'job="j{i % 7}"}}').encode() for i in range(CL_SERIES)]
+    klens = np.fromiter((len(k) for k in keys), np.int64, CL_SERIES)
+    koffs = np.concatenate([[0], np.cumsum(klens)[:-1]])
+    base = np.arange(CL_SAMPLES, dtype=np.int64) * 15_000 + t0
+    vals = np.cumsum(rng.integers(0, 40, (CL_SERIES, CL_SAMPLES)),
+                     axis=1).astype(np.float64)
+    return b"".join(keys), koffs, klens, base, vals, t0
+
+
+def _cluster_ingest(cluster, keybuf, koffs, klens, base, vals,
+                    chunk=512):
+    from victoriametrics_tpu import native
+    t0 = time.perf_counter()
+    for i0 in range(0, CL_SERIES, chunk):
+        i1 = min(i0 + chunk, CL_SERIES)
+        n = i1 - i0
+        cluster.add_rows_columnar(native.ColumnarRows(
+            keybuf, np.repeat(koffs[i0:i1], CL_SAMPLES),
+            np.repeat(klens[i0:i1], CL_SAMPLES),
+            np.tile(base, n),
+            vals[i0:i1].reshape(-1)))
+    return CL_SERIES * CL_SAMPLES / (time.perf_counter() - t0)
+
+
+def cluster_main() -> None:
+    """``--scenario=cluster`` (ISSUE 15 / ROADMAP item 3 acceptance
+    artifact, CLUSTER_r12): real vmstorage OS processes behind the
+    in-process ClusterStorage router (the vmselect/vminsert role).
+
+    Sections, each with its invariant asserted in-run:
+
+    - SCALING 1 -> 4 nodes: the same corpus served by 1 and by 4
+      vmstorage processes.  ``work_efficiency`` (how evenly the ring
+      spreads per-node scan work: total/(N x max-node share)) is the
+      scaling claim on an adequately-cored box; measured wall times on
+      THIS box ship alongside (on 1 shared core, wall cannot improve).
+    - RF=2 RING FILTERING: bytes over the read fan-out with
+      ring-ownership filtering on vs off (off reads every replica
+      twice), plus bit-equality of both results.
+    - REROUTE: with one of the RF=2 nodes down, the full vector is
+      byte-identical to the healthy read (vm_reroute_reads_total
+      ticking, not partial).
+    - REBALANCE UNDER LIVE INGEST: a node joins mid-ingest and
+      rebalance_to moves real parts while writes continue — zero write
+      errors, exact final counts/sums, byte-exact reads.
+    - TENANT QoS THROUGH REROUTE: a quota-capped tenant storms while a
+      node is down; the other tenant's p99 stays within 3x unloaded.
+    """
+    import threading
+    import urllib.request
+
+    from victoriametrics_tpu import native
+    from victoriametrics_tpu.parallel import ringfilter
+    from victoriametrics_tpu.parallel.cluster_api import (
+        ClusterStorage, StorageNodeClient, parse_node_spec)
+    from victoriametrics_tpu.storage.tag_filters import TagFilter
+    from victoriametrics_tpu.utils import costacc
+    from victoriametrics_tpu.utils import metrics as metricslib
+
+    os.environ.setdefault("VM_MIGRATE_GRACE_MS", "300")
+    tmp = tempfile.mkdtemp(prefix="vmtpu-cluster-")
+    procs = []
+    out: dict = {"scenario": "cluster", "series": CL_SERIES,
+                 "samples_per_series": CL_SAMPLES,
+                 "cores": os.cpu_count()}
+    keybuf, koffs, klens, base, vals, t0 = _cluster_corpus()
+    t_lo, t_hi = int(base[0]), int(base[-1]) + 1
+    f = [TagFilter(b"", b"cbench")]
+
+    def spawn(tag):
+        p, hp, spec = _spawn_vmstorage(tmp, tag)
+        procs.append(p)
+        return hp, spec
+
+    def read_wall(cluster):
+        walls = []
+        cols = None
+        for _ in range(CL_READS):
+            w0 = time.perf_counter()
+            cols = cluster.search_columns(f, t_lo, t_hi)
+            walls.append(time.perf_counter() - w0)
+        assert cols.n_series == CL_SERIES
+        assert cols.n_samples == CL_SERIES * CL_SAMPLES
+        return float(np.median(walls)), cols
+
+    try:
+        # ---- scaling: 1 node vs 4 nodes -------------------------------
+        _, spec1 = spawn("n1")
+        c1 = ClusterStorage([StorageNodeClient(*parse_node_spec(spec1))])
+        rate1 = _cluster_ingest(c1, keybuf, koffs, klens, base, vals)
+        wall1, cols1 = read_wall(c1)
+
+        specs4 = [spawn(f"m{i}")[1] for i in range(4)]
+        c4 = ClusterStorage([StorageNodeClient(*parse_node_spec(s))
+                             for s in specs4])
+        rate4 = _cluster_ingest(c4, keybuf, koffs, klens, base, vals)
+        wall4, cols4 = read_wall(c4)
+        assert cols4.raw_names == cols1.raw_names
+        assert np.array_equal(cols4.vals, cols1.vals), \
+            "4-node read diverged from 1-node read"
+        shares = [n.series_count() for n in c4.nodes]
+        total = sum(shares)
+        work_eff = total / (len(shares) * max(shares))
+        out["scaling"] = {
+            "read_wall_1node_ms": round(wall1 * 1e3, 1),
+            "read_wall_4node_ms": round(wall4 * 1e3, 1),
+            "wall_speedup_1_to_4": round(wall1 / wall4, 2),
+            "ingest_rows_per_s_1node": round(rate1),
+            "ingest_rows_per_s_4node": round(rate4),
+            "per_node_series": shares,
+            "work_efficiency_1_to_4": round(work_eff, 3),
+            "note": ("work_efficiency = total/(N*max node share): the "
+                     "ring's per-node scan-work split, i.e. read "
+                     "scaling on a box with >= N cores; this box has "
+                     f"{os.cpu_count()} core(s), so wall times are "
+                     "CPU-serialized"),
+        }
+        assert work_eff >= 0.7, f"scaling efficiency {work_eff} < 0.7"
+        c1.close()
+
+        # ---- rf=2 ring filtering: read amplification ------------------
+        # (these nodes also host the QoS-through-reroute section, so
+        # tenant 1 is quota-capped on the storage side)
+        os.environ["VM_TENANT_QUOTAS"] = "1:0=1:100:low"
+        try:
+            specs2 = [spawn(f"r{i}")[1] for i in range(2)]
+        finally:
+            del os.environ["VM_TENANT_QUOTAS"]
+        c2 = ClusterStorage([StorageNodeClient(*parse_node_spec(s))
+                             for s in specs2], replication_factor=2)
+        _cluster_ingest(c2, keybuf, koffs, klens, base, vals)
+
+        def fanout_bytes():
+            tr = costacc.CostTracker()
+            prev = costacc.set_current(tr)
+            try:
+                cols = c2.search_columns(f, t_lo, t_hi)
+            finally:
+                costacc.set_current(prev)
+            return tr.rpc_bytes, cols
+
+        by_on, cols_on = fanout_bytes()
+        os.environ["VM_RING_FILTER"] = "0"
+        try:
+            by_off, cols_off = fanout_bytes()
+        finally:
+            del os.environ["VM_RING_FILTER"]
+        assert cols_on.raw_names == cols_off.raw_names
+        assert np.array_equal(cols_on.vals, cols_off.vals)
+        out["rf2_ring_filter"] = {
+            "fanout_rpc_bytes_ring_on": int(by_on),
+            "fanout_rpc_bytes_ring_off": int(by_off),
+            "read_amplification_saved": round(by_off / by_on, 2),
+        }
+        assert by_off > by_on * 1.6, \
+            "ring filtering did not cut replica read amplification"
+
+        # ---- reroute: down node, complete results ---------------------
+        rr = metricslib.REGISTRY.counter("vm_reroute_reads_total")
+        r0 = rr.get()
+        c2.nodes[0].mark_down(3600.0)
+        c2.reset_partial()
+        w0 = time.perf_counter()
+        cols_rr = c2.search_columns(f, t_lo, t_hi)
+        reroute_wall = time.perf_counter() - w0
+        assert cols_rr.raw_names == cols_on.raw_names
+        assert np.array_equal(cols_rr.vals, cols_on.vals), \
+            "rerouted read not byte-identical"
+        assert not c2.last_partial, "rerouted read flagged partial"
+        out["reroute"] = {
+            "complete": True,
+            "partial": bool(c2.last_partial),
+            "read_wall_ms": round(reroute_wall * 1e3, 1),
+            "vm_reroute_reads_total_delta": int(rr.get() - r0),
+        }
+        assert rr.get() > r0
+
+        # ---- tenant QoS through the reroute path ----------------------
+        def q(tenant, i):
+            w0 = time.perf_counter()
+            c2.search_columns(f, t_lo, t_lo + 90_000, tenant=tenant)
+            return time.perf_counter() - w0
+
+        unloaded = sorted(q((2, 0), i) for i in range(15))
+        stop = threading.Event()
+        sheds = [0]
+        t1_served = [0]
+
+        def storm():
+            while not stop.is_set():
+                try:
+                    q((1, 0), 0)
+                    t1_served[0] += 1
+                except Exception:
+                    sheds[0] += 1  # quota shed (429-equivalent)
+
+        storms = [threading.Thread(target=storm) for _ in range(2)]
+        for th in storms:
+            th.start()
+        time.sleep(0.2)
+        try:
+            loaded = sorted(q((2, 0), i) for i in range(15))
+        finally:
+            stop.set()
+            for th in storms:
+                th.join(timeout=10)
+        p99u = unloaded[-1]
+        p99l = loaded[-1]
+        out["tenant_qos_through_reroute"] = {
+            "tenant1_quota": "1 concurrent / 100ms queue (low prio)",
+            "tenant1_served": t1_served[0],
+            "tenant1_shed": sheds[0],
+            "tenant2_p99_unloaded_ms": round(p99u * 1e3, 1),
+            "tenant2_p99_loaded_ms": round(p99l * 1e3, 1),
+            "isolation_ratio": round(p99l / p99u, 2),
+        }
+        assert p99l <= 3 * p99u, \
+            f"tenant-2 isolation broke through reroute: {p99l / p99u:.1f}x"
+        c2.nodes[0].down_until = 0.0
+        c2.close()
+
+        # ---- rebalance under live ingest ------------------------------
+        c4b = c4
+        write_errors = []
+        stop = threading.Event()
+        wrote = [0]
+
+        def writer():
+            b = 0
+            while not stop.is_set():
+                rows = [({"__name__": "live", "series": str(i)},
+                         t_hi + b * 15_000, float(i + b))
+                        for i in range(128)]
+                try:
+                    c4b.add_rows(rows)
+                    wrote[0] = b + 1
+                except Exception as e:
+                    write_errors.append(str(e))
+                b += 1
+                time.sleep(0.01)
+
+        wt = threading.Thread(target=writer)
+        wt.start()
+        time.sleep(0.3)
+        _, spec5 = spawn("n5")
+        mig0 = metricslib.REGISTRY.counter(
+            "vm_parts_migrated_total").get()
+        c4b.add_node(spec5)
+        stat = c4b.rebalance_to(parse_node_spec(spec5)[0] + ":" +
+                                str(parse_node_spec(spec5)[1]))
+        time.sleep(0.3)
+        stop.set()
+        wt.join(timeout=30)
+        n_batches = wrote[0]
+        got = c4b.search_columns(
+            [TagFilter(b"", b"live")], t_hi,
+            t_hi + (n_batches + 1) * 15_000)
+        assert not write_errors, write_errors[:3]
+        assert got.n_series == 128
+        # zero dropped acked writes: every acked batch's samples present
+        assert int(got.counts.sum()) == 128 * n_batches, \
+            (int(got.counts.sum()), 128 * n_batches)
+        # the original corpus still reads byte-exact post-rebalance
+        wall5, cols5 = read_wall(c4b)
+        assert cols5.raw_names == cols1.raw_names
+        assert np.array_equal(cols5.vals, cols1.vals), \
+            "post-rebalance read diverged"
+        out["rebalance_under_ingest"] = {
+            "parts_moved": stat["parts"],
+            "bytes_moved": stat["bytes"],
+            "vm_parts_migrated_total_delta": int(
+                metricslib.REGISTRY.counter(
+                    "vm_parts_migrated_total").get() - mig0),
+            "acked_write_batches": n_batches,
+            "write_errors": 0,
+            "dropped_acked_writes": 0,
+            "post_rebalance_read_wall_ms": round(wall5 * 1e3, 1),
+            "byte_exact": True,
+        }
+        c4b.close()
+        out["metric"] = (
+            f"elastic cluster serving: {CL_SERIES}x{CL_SAMPLES} corpus "
+            f"over real vmstorage processes — ring work-split "
+            f"efficiency {out['scaling']['work_efficiency_1_to_4']} "
+            f"(1->4 nodes), rf2 ring filtering saves "
+            f"{out['rf2_ring_filter']['read_amplification_saved']}x "
+            f"read bytes, down-shard reroute complete, join+rebalance "
+            f"under live ingest with 0 dropped acked writes "
+            f"({stat['parts']} parts / {stat['bytes']} bytes moved)")
+        print(json.dumps(out))
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     import argparse
     _p = argparse.ArgumentParser(prog="bench.py")
     _p.add_argument("--scenario", default="dashboard",
-                    choices=["dashboard", "fleet"],
+                    choices=["dashboard", "fleet", "cluster"],
                     help="dashboard: the classic rolling-window loop "
                          "(default, the BENCH_r* headline); fleet: N "
                          "subscribers x M shared-selector panels via "
-                         "materialized streams (BENCH_r11)")
+                         "materialized streams (BENCH_r11); cluster: "
+                         "elastic scale-out over real vmstorage "
+                         "processes (CLUSTER_r12)")
     _args = _p.parse_args()
     if _args.scenario == "fleet":
         fleet_main()
+    elif _args.scenario == "cluster":
+        cluster_main()
     else:
         main()

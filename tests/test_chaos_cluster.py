@@ -507,3 +507,187 @@ def test_tenant_qos_saturating_tenant_sheds_other_tenant_unharmed(
     assert p99_loaded <= 2 * p99_unloaded, \
         (f"tenant 2 starved: p99 loaded {p99_loaded * 1e3:.0f}ms vs "
          f"unloaded {p99_unloaded * 1e3:.0f}ms")
+
+
+# ---------------------------------------------------------------------------
+# scenario 6: live resharding — join mid-ingest, drain mid-query-storm
+# ---------------------------------------------------------------------------
+
+def _cluster_admin(port: int, action: str, **params):
+    q = urllib.parse.urlencode(params)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/internal/cluster/{action}?{q}",
+        method="POST" if params else "GET")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def _full_vector(vs: Client, name: str, t_s: float):
+    code, body = _query(vs, name, t_s)
+    assert code == 200, body
+    res = json.loads(body)["data"]["result"]
+    return sorted((json.dumps(e["metric"], sort_keys=True),
+                   e["value"][1]) for e in res)
+
+
+def test_join_and_drain_under_chaos(cluster):
+    """ISSUE 15 acceptance: a node joins mid-ingest and a node drains
+    mid-query-storm — no restart, zero dropped acked writes, byte-exact
+    reads post-migration, vm_parts_migrated_total accounting."""
+    procs, ports, d = (cluster["procs"], cluster["ports"], cluster["dir"])
+    vi, vs = Client(procs["vi"].port), Client(procs["vs"].port)
+
+    # ---- phase 1: JOIN mid-ingest --------------------------------------
+    stop = threading.Event()
+    write_codes = []
+    batches_done = [0]
+
+    def writer():
+        b = 0
+        while not stop.is_set() and b < 40:
+            lines = [f'els{{series="{i}"}} {i + b} {T0 + b * 15000}'
+                     for i in range(60)]
+            code, _ = vi.post(
+                "/insert/0/prometheus/api/v1/import/prometheus",
+                "\n".join(lines).encode())
+            write_codes.append(code)
+            b += 1
+            batches_done[0] = b
+            time.sleep(0.02)
+
+    wt = threading.Thread(target=writer)
+    wt.start()
+    time.sleep(0.3)                      # ingest is live mid-join
+    s3h, s3i, s3s = free_ports(3)
+    procs["st3"] = AppProc("vmstorage",
+                           _storage_flags(d, "s3", s3h, s3i, s3s), s3h,
+                           "vmstorage-3")
+    spec = f"127.0.0.1:{s3i}:{s3s}"
+    # reads learn the node FIRST (a read ring missing the node would
+    # not see the writes the insert ring routes to it)
+    _cluster_admin(procs["vs"].port, "join", node=spec)
+    _cluster_admin(procs["vi"].port, "join", node=spec)
+    wt.join(timeout=60)
+    stop.set()
+    assert all(c == 204 for c in write_codes)
+    n_batches = batches_done[0]
+
+    for key in ("st1", "st2", "st3"):
+        _flush(procs[key].port)
+    t_s = (T0 + n_batches * 15000) // 1000
+    code, body = _query(vs, "count(els)", t_s)
+    assert float(json.loads(body)["data"]["result"][0]["value"][1]) == 60.0
+    code, body = _query(vs, "sum(els)", t_s)
+    want_sum = float(sum(i + n_batches - 1 for i in range(60)))
+    assert float(json.loads(body)["data"]["result"][0]["value"][1]) == \
+        want_sum
+    # the joiner actually took writes (no restart anywhere)
+    assert _metric(procs["st3"].port,
+                   "vm_rows_added_to_storage_total") > 0
+
+    # rebalance a byte share of EXISTING parts onto the joiner
+    out = _cluster_admin(procs["vi"].port, "rebalance",
+                         node=f"127.0.0.1:{s3i}")
+    assert out["status"] == "success", out
+    assert _metric(procs["vi"].port, "vm_parts_migrated_total") == \
+        out["data"]["parts"]
+    assert _metric(procs["st3"].port, "vm_parts_migrated_total") == \
+        out["data"]["parts"]
+    if out["data"]["parts"]:
+        assert _metric(procs["vi"].port,
+                       "vm_rebalance_moved_bytes_total") > 0
+
+    want = _full_vector(vs, "els", t_s)
+    assert len(want) == 60
+
+    # ---- phase 2: DRAIN mid-query-storm --------------------------------
+    storm_stop = threading.Event()
+    storm_results = []
+
+    def storm():
+        while not storm_stop.is_set():
+            try:
+                code, body = _query(vs, "sum(els)", t_s)
+                res = json.loads(body)
+                storm_results.append(
+                    (code, float(res["data"]["result"][0]["value"][1]),
+                     res.get("isPartial")))
+            except Exception as e:  # noqa: BLE001 — asserted below
+                storm_results.append((0, None, e))
+            time.sleep(0.03)
+
+    st_threads = [threading.Thread(target=storm) for _ in range(2)]
+    for t in st_threads:
+        t.start()
+    time.sleep(0.3)
+    # the write router drains st2 (stops writes, migrates parts off,
+    # drops it from ITS ring)...
+    out = _cluster_admin(procs["vi"].port, "drain",
+                         node=f"127.0.0.1:{ports[4]}")
+    assert out["status"] == "success", out
+    assert out["data"]["removed"] and out["data"]["parts"] >= 1
+    # ...and only then the read ring lets go of the (now empty) node
+    _cluster_admin(procs["vs"].port, "remove",
+                   node=f"127.0.0.1:{ports[4]}")
+    time.sleep(0.5)
+    storm_stop.set()
+    for t in st_threads:
+        t.join(timeout=30)
+
+    errs = [e for _, _, e in storm_results if not isinstance(e, (bool,
+                                                                 type(None)))]
+    assert not errs, f"storm errors during drain: {errs[:3]}"
+    assert all(c == 200 for c, _, _ in storm_results)
+    # every storm answer saw the COMPLETE sum (migration never dropped
+    # or double-served a row)
+    bad = [(v, p) for _, v, p in storm_results if v != want_sum]
+    assert not bad, f"storm saw wrong sums during drain: {bad[:5]}"
+    # byte-exact post-migration reads, now served without st2
+    procs["st2"].stop()
+    assert _full_vector(vs, "els", t_s) == want
+    code, body = _query(vs, "sum(els)", t_s)
+    res = json.loads(body)
+    assert not res.get("isPartial")
+    assert float(res["data"]["result"][0]["value"][1]) == want_sum
+
+
+# ---------------------------------------------------------------------------
+# scenario 7: multilevel vmselect over the subprocess cluster
+# ---------------------------------------------------------------------------
+
+def test_multilevel_vmselect_matches_flat(cluster):
+    """vmselect -> vmselect -> 2x vmstorage: the top of the tree serves
+    rows byte-identical to the flat fan-out, through real processes."""
+    procs, ports, d = (cluster["procs"], cluster["ports"], cluster["dir"])
+    vi, vs = Client(procs["vi"].port), Client(procs["vs"].port)
+    _ingest(vi, "mlp", 120)
+    for key in ("st1", "st2"):
+        _flush(procs[key].port)
+    (s1h, s1i, s1s, s2h, s2i, s2s, ih, sh) = ports
+    mid_http, mid_native, top_http = free_ports(3)
+    nodes = [f"-storageNode=127.0.0.1:{s1i}:{s1s}",
+             f"-storageNode=127.0.0.1:{s2i}:{s2s}"]
+    procs["vs_mid"] = AppProc(
+        "vmselect",
+        nodes + [f"-httpListenAddr=127.0.0.1:{mid_http}",
+                 f"-clusternativeListenAddr=127.0.0.1:{mid_native}"],
+        mid_http, "vmselect-mid")
+    procs["vs_top"] = AppProc(
+        "vmselect",
+        [f"-storageNode=127.0.0.1:{mid_native}",
+         f"-httpListenAddr=127.0.0.1:{top_http}"],
+        top_http, "vmselect-top")
+    top = Client(top_http)
+    t_s = (T0 + 30000) // 1000
+    code, flat_body = _query(vs, "mlp", t_s)
+    assert code == 200
+    code, top_body = _query(top, "mlp", t_s)
+    assert code == 200
+    flat = json.loads(flat_body)["data"]
+    tree = json.loads(top_body)["data"]
+    assert len(flat["result"]) == 120
+    assert tree == flat
+    # aggregation through the tree too
+    code, body = _query(top, "sum(mlp)", t_s)
+    assert float(json.loads(body)["data"]["result"][0]["value"][1]) == \
+        float(sum(i + 2 for i in range(120)))

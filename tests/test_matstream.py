@@ -725,3 +725,312 @@ class TestMatStreamRace:
         assert cli.result() == polled(s, Q, cli.window[0], cli.window[1],
                                       STEP)
         steady.close()
+
+
+class TestResumeToken:
+    """/api/v1/watch reconnect/resume (ISSUE 15 satellite): a dropped
+    subscriber re-attaches with its last ``<epoch>:<seq>`` token and
+    receives only the missed suffix frames; too-old or foreign tokens
+    degrade LOUDLY to one resync snapshot."""
+
+    def _drain(self, sub, cli, now):
+        frames = []
+        while True:
+            f = sub.next_frame(timeout_s=0.0, now_ms=now)
+            if f is None:
+                return frames
+            frames.append(f)
+            cli.apply(f)
+
+    def test_resume_replays_only_missed_suffix(self, store):
+        s, end = store
+        api = PrometheusAPI(s)
+        reg = api.matstreams
+        sub = reg.subscribe(Q, STEP, DUR)
+        cli = StreamClient()
+        frames = self._drain(sub, cli, end)
+        assert frames and frames[0]["type"] == "snapshot"
+        stream = sub.stream
+        token = stream.resume_token(frames[-1])
+        sub.close()                      # the dashboard drops
+        # the stream advances twice while the client is gone
+        for r in range(2):
+            end += STEP
+            _fresh(s, end, r)
+            reg.advance_due(end)
+        from victoriametrics_tpu.query.matstream import (_RESUME_MISSES,
+                                                         _RESUMES)
+        r0, m0 = _RESUMES.get(), _RESUME_MISSES.get()
+        sub2 = reg.subscribe(Q, STEP, DUR, resume=token)
+        missed = self._drain(sub2, cli, end)
+        assert _RESUMES.get() == r0 + 1
+        assert _RESUME_MISSES.get() == m0
+        # ONLY the two missed deltas — no snapshot replay
+        assert [f["type"] for f in missed] == ["delta", "delta"]
+        # and the reassembled state matches the cold poll bit for bit
+        assert json.loads(json.dumps(cli.result())) == \
+            json.loads(json.dumps(polled(s, Q, end - DUR, end, STEP)))
+        sub2.close()
+
+    def test_resume_current_seq_sends_nothing(self, store):
+        s, end = store
+        api = PrometheusAPI(s)
+        sub = api.matstreams.subscribe(Q, STEP, DUR)
+        cli = StreamClient()
+        frames = self._drain(sub, cli, end)
+        token = sub.stream.resume_token(frames[-1])
+        sub.close()
+        sub2 = api.matstreams.subscribe(Q, STEP, DUR, resume=token)
+        assert self._drain(sub2, cli, end) == []   # nothing missed
+        # the next advance delivers a plain delta (client state valid)
+        end += STEP
+        _fresh(s, end, 9)
+        api.matstreams.advance_due(end)
+        nxt = self._drain(sub2, cli, end)
+        assert [f["type"] for f in nxt] == ["delta"]
+        sub2.close()
+
+    def test_too_old_token_degrades_to_resync_snapshot(self, store):
+        s, end = store
+        api = PrometheusAPI(s)
+        reg = api.matstreams
+        sub = reg.subscribe(Q, STEP, DUR)
+        cli = StreamClient()
+        frames = self._drain(sub, cli, end)
+        token = sub.stream.resume_token(frames[-1])
+        sub.close()
+        # advance PAST the retained ring (VM_MATSTREAM_QUEUE frames)
+        for r in range(matstream.queue_limit() + 2):
+            end += STEP
+            _fresh(s, end, r)
+            reg.advance_due(end)
+        from victoriametrics_tpu.query.matstream import _RESUME_MISSES
+        m0 = _RESUME_MISSES.get()
+        sub2 = reg.subscribe(Q, STEP, DUR, resume=token)
+        got = self._drain(sub2, cli, end)
+        assert _RESUME_MISSES.get() == m0 + 1
+        assert got[0]["type"] == "snapshot" and got[0].get("resync")
+        assert json.loads(json.dumps(cli.result())) == \
+            json.loads(json.dumps(polled(s, Q, end - DUR, end, STEP)))
+        sub2.close()
+
+    def test_foreign_epoch_token_is_a_miss(self, store):
+        s, end = store
+        api = PrometheusAPI(s)
+        api.matstreams.subscribe(Q, STEP, DUR).close()
+        from victoriametrics_tpu.query.matstream import _RESUME_MISSES
+        m0 = _RESUME_MISSES.get()
+        sub = api.matstreams.subscribe(Q, STEP, DUR,
+                                       resume="deadbeef.1:3")
+        cli = StreamClient()
+        got = self._drain(sub, cli, end)
+        assert _RESUME_MISSES.get() == m0 + 1
+        assert got and got[0]["type"] == "snapshot"
+        sub.close()
+
+    def test_sse_frames_carry_resume_id(self, store):
+        """The HTTP surface: every SSE event ships an ``id:`` line the
+        browser echoes back as Last-Event-ID, and h_watch accepts both
+        that header and the resume= arg."""
+        s, _ = store
+        api = PrometheusAPI(s)
+        resp = api.h_watch(FakeReq(query=Q, step="1m", range="20m",
+                                   max_frames="1"))
+        chunks = list(resp.chunks)
+        assert any(b"\nid: " in c for c in chunks)
+        # the id round-trips through the resume path (arg form)
+        idline = next(c for c in chunks if b"\nid: " in c)
+        token = idline.split(b"\nid: ")[1].split(b"\n")[0].decode()
+        from victoriametrics_tpu.query.matstream import _RESUMES
+        r0 = _RESUMES.get()
+        resp2 = api.h_watch(FakeReq(query=Q, step="1m", range="20m",
+                                    max_frames="1", resume=token,
+                                    heartbeat="0.2"))
+        resp2.on_close()
+        assert _RESUMES.get() == r0 + 1
+
+
+class TestInstantShareWithRangeStreams:
+    """ISSUE 15 satellite: rule groups and RANGE streams over one
+    expression share one evaluation per distinct (expr, ts) — the
+    stream's committed tail column serves the instant after a one-time
+    validate-then-trust equality check."""
+
+    def test_stream_tail_serves_instant_after_validation(self, store):
+        s, end = store
+        api = PrometheusAPI(s)
+        reg = api.matstreams
+        sub = reg.subscribe(Q, STEP, DUR)
+        cli = StreamClient()
+        while True:
+            f = sub.next_frame(timeout_s=0.0, now_ms=end)
+            if f is None:
+                break
+            cli.apply(f)
+        st = sub.stream
+        assert st.instant_share is None
+        # first instant at the committed end: validates (one legacy
+        # eval, which was owed anyway) and records the verdict
+        e0 = reg.instant_evals
+        rows1 = reg.instant_vector(Q, end)
+        assert reg.instant_evals == e0 + 1
+        assert st.instant_share is True, \
+            "window-explicit expression must validate as shareable"
+        # advance the stream; the instant at the NEW end is served from
+        # the committed tail column: zero evaluations
+        end += STEP
+        _fresh(s, end, 3)
+        reg.advance_due(end)
+        e1, reuse1 = reg.instant_evals, reg.instant_reuse
+        rows2 = reg.instant_vector(Q, end)
+        assert reg.instant_evals == e1, "shared instant re-evaluated"
+        assert reg.instant_reuse == reuse1 + 1
+        # ...and is bit-equal to what the legacy path would compute
+        from victoriametrics_tpu.query.exec import exec_query as _xq
+        ec = api._ec(end, end, 300_000, (0, 0))
+        want = []
+        for r in _xq(ec, reg.canonical(Q)):
+            v = r.values[-1]
+            if not math.isnan(v):
+                want.append({"metric": r.metric_name.to_dict(),
+                             "value": float(fmt_value(v)),
+                             "ts": end / 1e3})
+        key = lambda r: json.dumps(r, sort_keys=True)  # noqa: E731
+        assert sorted(rows2, key=key) == sorted(want, key=key)
+        assert rows1  # the validated call returned real rows too
+        # every Nth share REVALIDATES against a fresh legacy eval
+        # (bounding divergence from late-arriving samples): drive the
+        # hit counter to the revalidation boundary and observe exactly
+        # one extra eval that restores the True verdict
+        n = reg._SHARE_REVALIDATE_N
+        e2 = reg.instant_evals
+        extra = 0
+        for j in range(n):
+            end += STEP
+            _fresh(s, end, 10 + j)
+            reg.advance_due(end)
+            before = reg.instant_evals
+            reg.instant_vector(Q, end)
+            extra += reg.instant_evals - before
+        assert extra == 1, f"expected exactly one revalidation, {extra}"
+        assert st.instant_share is True
+        assert reg.instant_evals == e2 + 1
+        sub.close()
+
+    def test_unaligned_ts_does_not_share(self, store):
+        s, end = store
+        api = PrometheusAPI(s)
+        reg = api.matstreams
+        sub = reg.subscribe(Q, STEP, DUR)
+        while sub.next_frame(timeout_s=0.0, now_ms=end) is not None:
+            pass
+        e0 = reg.instant_evals
+        reg.instant_vector(Q, end + 7_000)   # off the committed end
+        assert reg.instant_evals == e0 + 1
+        assert sub.stream.instant_share is None  # never consulted
+        sub.close()
+
+    def test_divergent_expression_pins_share_off(self, store):
+        """An expression whose instant value differs from the range
+        tail must validate to False ONCE and never share after."""
+        s, end = store
+        api = PrometheusAPI(s)
+        reg = api.matstreams
+        sub = reg.subscribe(Q, STEP, DUR)
+        while sub.next_frame(timeout_s=0.0, now_ms=end) is not None:
+            pass
+        st = sub.stream
+        # sabotage the committed tail so validation MUST fail
+        with st._lock:
+            st._state.vals[:, -1] += 1.0
+        e0 = reg.instant_evals
+        reg.instant_vector(Q, end)
+        assert reg.instant_evals == e0 + 1
+        assert st.instant_share is False
+        # subsequent instants keep evaluating (no silent wrong shares)
+        reg.instant_vector(Q, end + STEP)
+        assert reg.instant_evals == e0 + 2
+        sub.close()
+
+    def test_resume_across_decline_degrades_to_snapshot(self, store):
+        """A missed suffix that crosses a decline (error frame) must
+        NOT replay — the retained delta after it was diffed against
+        the committed state, not the state a declined client holds —
+        it degrades to the loud snapshot+resync path instead."""
+        s, end = store
+        api = PrometheusAPI(s)
+        reg = api.matstreams
+        sub = reg.subscribe(Q, STEP, DUR)
+        client = StreamClient()
+        frames = []
+        while True:
+            f = sub.next_frame(timeout_s=0.0, now_ms=end)
+            if f is None:
+                break
+            frames.append(f)
+            client.apply(f)
+        token = sub.stream.resume_token(frames[-1])
+        sub.close()
+        # one ERROR advance (evaluation raises), then a clean delta
+        orig = api._exec_range_cached
+        api._exec_range_cached = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("injected decline"))
+        end += STEP
+        reg.advance_due(end)
+        api._exec_range_cached = orig
+        end += STEP
+        _fresh(s, end, 5)
+        reg.advance_due(end)
+        from victoriametrics_tpu.query.matstream import _RESUME_MISSES
+        m0 = _RESUME_MISSES.get()
+        sub2 = reg.subscribe(Q, STEP, DUR, resume=token)
+        got = []
+        while True:
+            f = sub2.next_frame(timeout_s=0.0, now_ms=end)
+            if f is None:
+                break
+            got.append(f)
+            client.apply(f)
+        assert _RESUME_MISSES.get() == m0 + 1
+        assert got and got[0]["type"] == "snapshot" and \
+            got[0].get("resync")
+        assert json.loads(json.dumps(client.result())) == \
+            json.loads(json.dumps(polled(s, Q, end - DUR, end, STEP)))
+        sub2.close()
+
+    def test_resume_token_at_partial_frame_is_a_miss(self, store):
+        """A token naming a PARTIAL snapshot frame must not resume:
+        the client's window holds the uncommitted partial values, so
+        replayed deltas (diffed against the committed state) would
+        leave a silently divergent prefix — resync instead."""
+        s, end = store
+        api = PrometheusAPI(s)
+        reg = api.matstreams
+        sub = reg.subscribe(Q, STEP, DUR)
+        client = StreamClient()
+        while True:
+            f = sub.next_frame(timeout_s=0.0, now_ms=end)
+            if f is None:
+                break
+            client.apply(f)
+        st = sub.stream
+        sub.close()
+        # manufacture a fanned partial-decline frame in the retained
+        # ring (the real path needs a mid-fan-out storage failure)
+        with st._lock:
+            st.seq += 1
+            st._recent.append((st.seq, st._snapshot_frame(partial=True)))
+        token = f"{st.epoch}:{st.seq}"
+        from victoriametrics_tpu.query.matstream import _RESUME_MISSES
+        m0 = _RESUME_MISSES.get()
+        sub2 = reg.subscribe(Q, STEP, DUR, resume=token)
+        got = []
+        while True:
+            f = sub2.next_frame(timeout_s=0.0, now_ms=end)
+            if f is None:
+                break
+            got.append(f)
+        assert _RESUME_MISSES.get() == m0 + 1
+        assert got and got[0]["type"] == "snapshot" and \
+            got[0].get("resync")
+        sub2.close()

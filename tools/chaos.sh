@@ -6,7 +6,13 @@
 #    stalls), storage-side deadline aborts (budget shipped in the
 #    search request, typed error, no node-down marking), RF=2 failover
 #    byte-equality with replica-covered (non-partial) accounting, an
-#    ingest storm racing force_merge, per-tenant QoS isolation.
+#    ingest storm racing force_merge, per-tenant QoS isolation — plus
+#    the PR-15 elasticity scenarios: a vmstorage JOINS mid-ingest and
+#    another DRAINS mid-query-storm over /internal/cluster/* (zero
+#    dropped acked writes, byte-exact post-migration reads,
+#    vm_parts_migrated_total accounting), and a multilevel
+#    vmselect->vmselect->2x-vmstorage tree serving rows byte-identical
+#    to the flat fan-out.
 #
 # 2. Crash recovery (tests/test_crash_recovery.py): the kill -9 matrix —
 #    a subprocess ingest storm racing flush/force_merge/snapshot is

@@ -48,6 +48,16 @@ if [ "${VMT_NO_MATSTREAM_SMOKE:-0}" != "1" ]; then
     env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python -m victoriametrics_tpu.devtools.matstream_overhead
 fi
+# Elastic-cluster reshard smoke (devtools/reshard_smoke.py): a second
+# vmstorage joins a 1-node cluster without a restart, rebalance moves
+# real parts over migrateParts_v1 byte-exactly, and an RF=2 down node
+# serves COMPLETE results through the explicit reroute path.  Skips
+# itself (exit 0) when no zstd codec exists; VMT_NO_RESHARD_SMOKE=1
+# skips it outright.
+if [ "${VMT_NO_RESHARD_SMOKE:-0}" != "1" ]; then
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python -m victoriametrics_tpu.devtools.reshard_smoke
+fi
 if [ "${VMT_NO_DEVICE_SMOKE:-0}" != "1" ]; then
     sh tools/device.sh \
         "tests/test_device_residency.py::test_refresh_uploads_only_tail_on_mesh"

@@ -36,12 +36,13 @@ def parse_flags(argv=None):
 
 
 def make_nodes(specs: list[str], timeout: float = 10.0):
-    from ..parallel.cluster_api import StorageNodeClient
+    from ..parallel.cluster_api import StorageNodeClient, parse_node_spec
     nodes = []
     for spec in specs:
-        host, ip_, sp_ = spec.rsplit(":", 2)
-        nodes.append(StorageNodeClient(host, int(ip_), int(sp_),
-                                       timeout=timeout))
+        # host:insertPort:selectPort (vmstorage) or host:port (a
+        # multilevel child's -clusternativeListenAddr)
+        host, ip_, sp_ = parse_node_spec(spec)
+        nodes.append(StorageNodeClient(host, ip_, sp_, timeout=timeout))
     return nodes
 
 
@@ -64,6 +65,8 @@ def build(args):
             global_limit=args.max_ingestion_rate)
     api = PrometheusAPI(cluster, rate_limiter=rate_limiter)
     api.register(srv, mode="insert")
+    from ..parallel.cluster_api import register_cluster_admin
+    register_cluster_admin(srv, cluster)
     native_srv = None
     if getattr(args, "native_addr", ""):
         from ..parallel.cluster_api import start_native_server

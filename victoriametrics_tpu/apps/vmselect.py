@@ -70,6 +70,8 @@ def build(args):
         max_query_duration_ms=_dur_ms(args.max_query_duration))
     _attach_tpu_engine(api, args.tpu)
     api.register(srv, mode="select")
+    from ..parallel.cluster_api import register_cluster_admin
+    register_cluster_admin(srv, cluster)
     from ..utils import profiler
     profiler.ensure_started()
     from ..httpapi.graphite_api import GraphiteAPI

@@ -570,7 +570,15 @@ class PrometheusAPI:
         (close after N frames — test/CLI hygiene; 0 = until
         disconnect), ``heartbeat`` (idle keepalive seconds, default 15).
         First frame is a full snapshot (replayed from the warm stream
-        when one exists), then deltas.  503 when VM_MATSTREAM=0."""
+        when one exists), then deltas.  503 when VM_MATSTREAM=0.
+
+        Reconnect/resume: every SSE event carries ``id:
+        <epoch>:<seq>``; a dropped dashboard re-attaches with the
+        standard ``Last-Event-ID`` header (or ``resume=`` arg) and
+        receives only the missed suffix frames — bounded by
+        ``VM_MATSTREAM_QUEUE`` retained frames; an older/foreign token
+        degrades loudly to one resync snapshot
+        (``vm_matstream_resume_misses_total``)."""
         from ..query import matstream
         if not matstream.enabled():
             return Response.error(
@@ -597,9 +605,12 @@ class PrometheusAPI:
                 float(req.arg("heartbeat", "15") or 15), 0.2), 3600.0)
         except (QueryError, ValueError) as e:
             return Response.error(str(e))
+        resume = req.arg("resume") or \
+            (getattr(req, "headers", {}).get("Last-Event-ID") or "").strip()
         try:
             sub = self.matstreams.subscribe(q, step, duration,
-                                            self._tenant(req))
+                                            self._tenant(req),
+                                            resume=resume or None)
         except matstream.MatStreamLimitError as e:
             resp = Response.error(str(e), 429, "too_many_requests")
             resp.headers["Retry-After"] = "10"
@@ -619,8 +630,11 @@ class PrometheusAPI:
                         continue
                     # frames are SHARED dicts (one per advance, fanned
                     # to every subscriber): encode once process-wide,
-                    # not once per subscriber
-                    yield (b"event: frame\ndata: " +
+                    # not once per subscriber.  The id line is the
+                    # resume token Last-Event-ID echoes back.
+                    yield (b"event: frame\nid: " +
+                           sub.stream.resume_token(f).encode() +
+                           b"\ndata: " +
                            matstream.encode_frame(f) + b"\n\n")
                     sent += 1
                     if max_frames and sent >= max_frames:

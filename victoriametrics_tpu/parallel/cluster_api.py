@@ -23,6 +23,7 @@ from ..storage.metric_name import MetricName
 from ..storage.tag_filters import TagFilter
 from ..utils import costacc, logger, querytracer
 from ..utils import metrics as metricslib
+from . import ringfilter
 from .consistenthash import ConsistentHash
 from .rpc import (HELLO_INSERT, HELLO_SELECT, RPCClient, RPCClientPool,
                   RPCError, Reader, Writer)
@@ -32,6 +33,12 @@ SERIES_PER_FRAME = 64
 # fan-out failures whose data was provably still served by surviving
 # replicas (RF coverage): NOT marked partial, counted here instead
 _PARTIAL_AVOIDED = metricslib.REGISTRY.counter("vm_partial_avoided_total")
+# live-resharding accounting (README "Elastic cluster serving"): parts
+# adopted over migratePart_v1 (ticks on the receiving storage node AND
+# on the driving router) and bytes moved by a join-rebalance/drain
+_PARTS_MIGRATED = metricslib.REGISTRY.counter("vm_parts_migrated_total")
+_REBALANCE_BYTES = metricslib.REGISTRY.counter(
+    "vm_rebalance_moved_bytes_total")
 
 
 # ---------------------------------------------------------------------------
@@ -86,6 +93,35 @@ def _legacy_meta() -> bool:
     return os.environ.get("VM_RPC_LEGACY_META", "") == "1"
 
 
+#: text series key -> canonical MetricName marshal, the ONE shard-
+#: placement key both write paths and the ring-ownership read filter
+#: agree on (a per-path key — text here, marshal there — would place
+#: the same series on different nodes and break ownership filtering).
+#: Pure function of the key bytes, so the memo is global and safe to
+#: share across tenants/transforms.
+_PLACEMENT_MEMO: dict[bytes, bytes] = {}
+_PLACEMENT_LOCK = make_lock("parallel.cluster_api._PLACEMENT_MEMO")
+_MAX_PLACEMENT_MEMO = 1 << 20
+
+
+def placement_marshal(key: bytes) -> bytes:
+    """Canonical marshal for a raw text series key; falls back to the
+    raw bytes for keys that don't parse (the storage node drops those
+    rows later anyway — consistent placement still holds)."""
+    m = _PLACEMENT_MEMO.get(key)
+    if m is None:
+        from ..ingest.parsers import labels_from_series_key
+        try:
+            m = MetricName.from_labels(labels_from_series_key(key)).marshal()
+        except ValueError:
+            m = key
+        with _PLACEMENT_LOCK:
+            if len(_PLACEMENT_MEMO) >= _MAX_PLACEMENT_MEMO:
+                _PLACEMENT_MEMO.clear()
+            _PLACEMENT_MEMO[key] = m
+    return m
+
+
 def make_storage_handlers(storage, rate_limiter=None) -> dict:
     """RPC dispatch table for a vmstorage node. `rate_limiter` applies
     -maxIngestionRate to RPC writes too (the multilevel/clusternative
@@ -100,9 +136,18 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
             ts = r.i64()
             val = r.f64()
             rows.append((MetricName.unmarshal(raw), ts, val))
+        # optional trailing reroute flag: these rows landed here because
+        # an owner node was down — mark them always-served so the ring
+        # read filter can never hide this (possibly only) copy
+        exempt = bool(r.u64()) if r.remaining else False
         if rate_limiter is not None and rate_limiter.enabled():
             rate_limiter.register(len(rows), tenant)
         storage.add_rows(rows, tenant=tenant)
+        if exempt and hasattr(storage, "add_ring_exempt_names"):
+            # re-marshal is canonical, so this round-trips the wire raw
+            # byte-for-byte; only the RARE reroute batch pays it
+            storage.add_ring_exempt_names(
+                {mn.marshal() for mn, _, _ in rows})
         return Writer().u64(len(rows))
 
     def h_write_rows_columnar(r: Reader):
@@ -116,10 +161,18 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         key_len = r.array()
         tss = r.array()
         vals = r.array()
+        exempt = bool(r.u64()) if r.remaining else False
         if rate_limiter is not None and rate_limiter.enabled():
             rate_limiter.register(int(key_off.size), tenant)
         from .. import native
         cr = native.ColumnarRows(keybuf, key_off, key_len, tss, vals)
+        if exempt and hasattr(storage, "add_ring_exempt_names"):
+            mv = memoryview(keybuf)
+            seen = set()
+            for o, ln in zip(key_off, key_len):
+                seen.add(bytes(mv[int(o):int(o) + int(ln)]))
+            storage.add_ring_exempt_names(
+                [placement_marshal(k) for k in seen])
         if getattr(storage, "add_rows_columnar", None) is not None:
             n = storage.add_rows_columnar(cr, tenant=tenant)
         else:  # storage without a columnar path: materialize rows
@@ -183,7 +236,21 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         # DON'T ack — the client re-issues per-set legacy calls
         return filters, False
 
-    def _meta_frame(qt, cost=None, union_ok=True) -> Writer:
+    def _read_ring(r: Reader):
+        """Optional trailing ring-ownership field (fourth search_v1
+        extension, after or_sets): the caller's consistent-hash view.
+        Honored (and acked via the metadata frame) only by backends
+        that actually hold ring-placed data — a multilevel vmselect's
+        ClusterStorage ignores it and the caller's dedup keeps
+        correctness (see parallel/ringfilter)."""
+        if not r.remaining or _legacy_meta():
+            return None
+        ring_b = r.bytes_()
+        if not getattr(storage, "supports_ring_filter", False):
+            return None
+        return ringfilter.intern_ring(ring_b)
+
+    def _meta_frame(qt, cost=None, union_ok=True, ring_ok=False) -> Writer:
         """Trailing metadata frame: partial-result flag + the
         storage-side span tree (when tracing) + the extras dict (cost
         frame + filter-union ack).  Wire layout, Reader-tolerant both
@@ -210,6 +277,8 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         if _legacy_meta():
             return meta
         extras = {"filterUnion": bool(union_ok)}
+        if ring_ok:
+            extras["ringFiltered"] = True
         if cost is not None:
             extras["cost"] = cost.remote_dict()
         meta.bytes_(json.dumps(extras).encode())
@@ -225,6 +294,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                              max_ts)
         deadline = _read_deadline(r)
         or_sets = _read_or_sets(r)
+        ring = _read_ring(r)
         filters, union_ok = _union_filters(filters, or_sets)
         if hasattr(storage, "reset_partial"):
             storage.reset_partial()
@@ -234,12 +304,24 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         prev_cost = costacc.set_current(cost)
         try:
             with qt.new_child("search_series") as sq:
+                kw = {"deadline": deadline} if deadline else {}
+                if getattr(storage, "supports_search_tracer", False):
+                    # multilevel: a ClusterStorage backend grafts its
+                    # per-node spans under this handler's span, so the
+                    # caller's trace shows the WHOLE fan-out tree
+                    kw["tracer"] = sq
                 series = storage.search_series(filters, min_ts, max_ts,
-                                               tenant=tenant,
-                                               **({"deadline": deadline}
-                                                  if deadline else {}))
+                                               tenant=tenant, **kw)
                 sq.donef("%d series", len(series))
             cost.add_samples(sum(sd.timestamps.size for sd in series))
+            if ring is not None:
+                keep, rerouted = ring.keep_mask(
+                    tenant, [getattr(sd, "raw_name", None) or
+                             sd.metric_name.marshal() for sd in series],
+                    exempt=getattr(storage, "ring_exempt_names", None))
+                series = [sd for sd, k in zip(series, keep) if k]
+                if rerouted:
+                    ringfilter.REROUTE_READS.inc()
         finally:
             costacc.set_current(prev_cost)
         costacc.record_usage(tenant, cost)
@@ -254,7 +336,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                     w.array(sd.timestamps)
                     w.array(sd.values)
                 yield w
-            yield _meta_frame(qt, cost, union_ok)
+            yield _meta_frame(qt, cost, union_ok, ring_ok=ring is not None)
         return frames()
 
     def h_search_columns(r: Reader):
@@ -272,6 +354,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                              max_ts)
         deadline = _read_deadline(r)
         or_sets = _read_or_sets(r)
+        ring = _read_ring(r)
         filters, union_ok = _union_filters(filters, or_sets)
         if hasattr(storage, "reset_partial"):
             storage.reset_partial()
@@ -280,16 +363,29 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         try:
             if getattr(storage, "search_columns", None) is not None:
                 with qt.new_child("search_columns") as sq:
+                    kw = {"deadline": deadline} if deadline else {}
+                    if getattr(storage, "supports_search_tracer", False):
+                        kw["tracer"] = sq
                     cols = storage.search_columns(
-                        filters, min_ts, max_ts, tenant=tenant,
-                        **({"deadline": deadline} if deadline else {}))
+                        filters, min_ts, max_ts, tenant=tenant, **kw)
                     sq.donef("%d series, %d samples", cols.n_series,
                              cols.n_samples)
                 cost.add_samples(cols.n_samples)
                 raw_names = cols.raw_names
                 counts = cols.counts
                 ts2, v2 = cols.ts, cols.vals
-                S = cols.n_series
+                if ring is not None and cols.n_series:
+                    keep, rerouted = ring.keep_mask(
+                        tenant, raw_names,
+                        exempt=getattr(storage, "ring_exempt_names", None))
+                    if not keep.all():
+                        idx = np.flatnonzero(keep)
+                        raw_names = [raw_names[i] for i in idx]
+                        counts = counts[idx]
+                        ts2, v2 = ts2[idx], v2[idx]
+                    if rerouted:
+                        ringfilter.REROUTE_READS.inc()
+                S = len(raw_names)
 
                 def series_arrays(a, b):
                     sel = np.arange(ts2.shape[1])[None, :] < \
@@ -303,6 +399,14 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                 cost.add_samples(sum(sd.timestamps.size for sd in series))
                 raw_names = [getattr(sd, "raw_name", None) or
                              sd.metric_name.marshal() for sd in series]
+                if ring is not None and series:
+                    keep, rerouted = ring.keep_mask(
+                        tenant, raw_names,
+                        exempt=getattr(storage, "ring_exempt_names", None))
+                    series = [sd for sd, k in zip(series, keep) if k]
+                    raw_names = [nm for nm, k in zip(raw_names, keep) if k]
+                    if rerouted:
+                        ringfilter.REROUTE_READS.inc()
                 counts = np.fromiter((sd.timestamps.size for sd in series),
                                      np.int64, len(series))
                 S = len(series)
@@ -333,7 +437,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
                 w.array(np.asarray(ts_cat, np.int64))
                 w.array(np.asarray(v_cat, np.float64))
                 yield w
-            yield _meta_frame(qt, cost, union_ok)
+            yield _meta_frame(qt, cost, union_ok, ring_ok=ring is not None)
         return frames()
 
     def h_search_metric_names(r: Reader):
@@ -466,9 +570,85 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
             snap = {"disabled": True, "stacks": [], "samples": 0}
         return Writer().bytes_(json.dumps(snap).encode())
 
+    # -- live resharding: the migrateParts_v1 family -----------------------
+
+    def h_list_parts(r: Reader):
+        """listParts_v1: finalized-part inventory for the rebalance
+        driver.  Optional flags u64: bit0 = flush pending data to disk
+        first, bit1 = force_merge first (compaction shrinks the part
+        count a drain must move AND leaves no background merge racing
+        the subsequent fetches)."""
+        import json
+        flags = r.u64() if r.remaining else 0
+        if getattr(storage, "list_file_parts", None) is None:
+            return Writer().bytes_(json.dumps([]).encode())
+        if flags & 2 and hasattr(storage, "force_merge"):
+            storage.force_merge()  # force_merge flushes first itself
+        elif flags & 1 and hasattr(storage, "force_flush"):
+            storage.force_flush()
+        return Writer().bytes_(json.dumps(storage.list_file_parts())
+                               .encode())
+
+    def h_fetch_part(r: Reader):
+        """fetchPart_v1: stream one finalized part — a json meta frame
+        (with the file list), one frame per file (header order), then
+        the series-registration frame (tsid marshal + name marshal per
+        distinct series; metric_ids are node-local, the receiver cannot
+        resolve the blocks without them)."""
+        import json
+        partition = r.str_()
+        part = r.str_()
+        files, entries, meta = storage.export_part(partition, part)
+
+        def frames():
+            yield Writer().bytes_(json.dumps(
+                dict(meta, files=[n for n, _ in files])).encode())
+            for _, data in files:
+                yield Writer().bytes_(data)
+            w = Writer().u64(len(entries))
+            for tsid_b, raw in entries:
+                w.bytes_(tsid_b)
+                w.bytes_(raw)
+            yield w
+        return frames()
+
+    def h_migrate_part(r: Reader):
+        """migratePart_v1: adopt a finalized part shipped by the
+        rebalance driver — series registrations first, then the bytes
+        through the PR-10 crc/quarantine gate under the MergeGate
+        (Storage.adopt_part).  Answers (rows, bytes) only after the
+        part is durably published, so the driver's subsequent
+        removeParts_v1 on the source can never strand acked data."""
+        import json
+        hdr = json.loads(r.bytes_())
+        files = [(str(name), r.bytes_()) for name in hdr["files"]]
+        n = r.u64()
+        entries = [(r.bytes_(), r.bytes_()) for _ in range(n)]
+        if getattr(storage, "adopt_part", None) is None:
+            raise RPCError("this node does not support part migration")
+        rows, nbytes = storage.adopt_part(
+            str(hdr["partition"]), files, entries,
+            hdr.get("min_ts"), hdr.get("max_ts"))
+        _PARTS_MIGRATED.inc()
+        return Writer().u64(int(rows)).u64(int(nbytes))
+
+    def h_remove_parts(r: Reader):
+        """removeParts_v1: delist + delete migrated-away parts on the
+        source, after the receiver's durable ack."""
+        partition = r.str_()
+        n = r.u64()
+        names = [r.str_() for _ in range(n)]
+        if getattr(storage, "remove_parts", None) is None:
+            return Writer().u64(0)
+        return Writer().u64(storage.remove_parts(partition, names))
+
     return {
         "writeRows_v1": h_write_rows,
         "writeRowsColumnar_v1": h_write_rows_columnar,
+        "listParts_v1": h_list_parts,
+        "fetchPart_v1": h_fetch_part,
+        "migratePart_v1": h_migrate_part,
+        "removeParts_v1": h_remove_parts,
         "isReadOnly_v1": h_is_readonly,
         "search_v1": h_search,
         "searchColumns_v1": h_search_columns,
@@ -519,18 +699,26 @@ class StorageNodeClient:
                      seconds)
 
     def write_rows(self, rows: list[tuple[bytes, int, float]],
-                   tenant=(0, 0)):
+                   tenant=(0, 0), reroute: bool = False):
+        """``reroute=True`` marks the batch as landing OFF its ring
+        owners (an owner was down): the receiving node records the
+        series as always-served so the ring read filter can never hide
+        what may be their only copy (old nodes ignore the flag — they
+        never filter by ring either)."""
         w = _write_tenant(Writer(), tenant).u64(len(rows))
         for raw, ts, val in rows:
             w.bytes_(raw)
             w.i64(int(ts))
             w.f64(float(val))
+        if reroute:
+            w.u64(1)
         self.insert.call("writeRows_v1", w)
 
     supports_columnar_write = True  # cleared on first unknown-method error
 
     def write_rows_columnar(self, keybuf: bytes, key_off, key_len,
-                            tss, vals, tenant=(0, 0)) -> int:
+                            tss, vals, tenant=(0, 0),
+                            reroute: bool = False) -> int:
         """Ship a ColumnarRows shard raw (writeRowsColumnar_v1); falls
         back to per-row writeRows_v1 against old storage nodes."""
         if self.supports_columnar_write:
@@ -540,6 +728,8 @@ class StorageNodeClient:
             w.array(np.asarray(key_len, np.int64))
             w.array(np.asarray(tss, np.int64))
             w.array(np.asarray(vals, np.float64))
+            if reroute:
+                w.u64(1)
             try:
                 return self.insert.call("writeRowsColumnar_v1", w).u64()
             except RPCError as e:
@@ -557,7 +747,7 @@ class StorageNodeClient:
             except ValueError:
                 continue
             rows.append((mn.marshal(), int(ts), float(val)))
-        self.write_rows(rows, tenant)
+        self.write_rows(rows, tenant, reroute=reroute)
         return len(rows)
 
     @staticmethod
@@ -626,23 +816,29 @@ class StorageNodeClient:
         return bool((extras or {}).get("filterUnion"))
 
     def search_series(self, filters, min_ts, max_ts, tenant=(0, 0),
-                      tracer=querytracer.NOP, deadline: float = 0.0):
+                      tracer=querytracer.NOP, deadline: float = 0.0,
+                      ring=None):
         """Returns (series_list, remote_partial).  Selector-level `or`
         unions (filters = list of sets) ship the extra sets as the
         trailing or_sets field; a peer that doesn't ack the union gets
         one legacy call per remaining set instead (duplicate series
         across sets collapse in the caller's assemble, the same way
-        replica overlap does)."""
+        replica overlap does).  ``ring`` (a ringfilter.RingConfig with
+        this node's self index) asks the node to serve only the series
+        it owns under the caller's hash view — unacked peers return
+        everything and the caller's dedup collapses it."""
         first, extra_sets = _split_filter_sets(filters)
         w = _write_tenant(Writer(), tenant)
         _write_filters(w, first)
         w.i64(min_ts).i64(max_ts)
         w.u64(1 if tracer.enabled else 0)
         w.u64(self._budget_ms(deadline))
-        if extra_sets:
+        if extra_sets or ring is not None:
             w.u64(len(extra_sets))
             for fs in extra_sets:
                 _write_filters(w, fs)
+        if ring is not None:
+            w.bytes_(ring.to_json())
         out = []
         partial = False
         extras = None
@@ -667,7 +863,7 @@ class StorageNodeClient:
             for fs in extra_sets:
                 more, p2 = self.search_series(fs, min_ts, max_ts, tenant,
                                               tracer=tracer,
-                                              deadline=deadline)
+                                              deadline=deadline, ring=ring)
                 out.extend(more)
                 partial = partial or p2
         return out, partial
@@ -675,12 +871,13 @@ class StorageNodeClient:
     supports_columnar_read = True  # cleared on first unknown-method error
 
     def search_columns(self, filters, min_ts, max_ts, tenant=(0, 0),
-                       tracer=querytracer.NOP, deadline: float = 0.0):
+                       tracer=querytracer.NOP, deadline: float = 0.0,
+                       ring=None):
         """Columnar read plane: returns (raw_names list, counts int64[],
         ts_cat int64[], vals_cat float64[], remote_partial). Falls back to
         search_v1 against old nodes (same return shape).  `deadline` is
         the caller's time.monotonic() cutoff, enforced per socket
-        operation by the RPC client."""
+        operation by the RPC client; ``ring`` as in search_series."""
         if self.supports_columnar_read:
             first, extra_sets = _split_filter_sets(filters)
             w = _write_tenant(Writer(), tenant)
@@ -688,10 +885,12 @@ class StorageNodeClient:
             w.i64(min_ts).i64(max_ts)
             w.u64(1 if tracer.enabled else 0)
             w.u64(self._budget_ms(deadline))
-            if extra_sets:
+            if extra_sets or ring is not None:
                 w.u64(len(extra_sets))
                 for fs in extra_sets:
                     _write_filters(w, fs)
+            if ring is not None:
+                w.bytes_(ring.to_json())
             try:
                 frames = self.select.call_stream(
                     "searchColumns_v1", w,
@@ -731,7 +930,7 @@ class StorageNodeClient:
                     for fs in extra_sets:
                         n2, c2, t2, v2, p2 = self.search_columns(
                             fs, min_ts, max_ts, tenant, tracer=tracer,
-                            deadline=deadline)
+                            deadline=deadline, ring=ring)
                         names.extend(n2)
                         cnt_parts.append(c2)
                         ts_parts.append(t2)
@@ -744,7 +943,7 @@ class StorageNodeClient:
                         cat(val_parts, np.float64), partial)
         series, partial = self.search_series(filters, min_ts, max_ts,
                                              tenant, tracer=tracer,
-                                             deadline=deadline)
+                                             deadline=deadline, ring=ring)
         names = [mn.marshal() for mn, _, _ in series]
         counts = np.fromiter((ts.size for _, ts, _ in series), np.int64,
                              len(series))
@@ -843,6 +1042,57 @@ class StorageNodeClient:
             raise
         return json.loads(r.bytes_())
 
+    # -- live resharding (part migration) -------------------------------
+
+    def list_parts(self, flush: bool = False,
+                   merge: bool = False) -> list[dict]:
+        """Finalized-part inventory on this node (listParts_v1);
+        ``flush``/``merge`` compact first — a drain wants few parts and
+        no background merge racing the fetches."""
+        import json
+        w = Writer().u64((1 if flush else 0) | (2 if merge else 0))
+        return json.loads(self.select.call("listParts_v1", w).bytes_())
+
+    def fetch_part(self, partition: str, part: str):
+        """Pull one finalized part (fetchPart_v1): returns
+        (files [(name, bytes)], entries [(tsid, name)], meta dict)."""
+        import json
+        w = Writer().str_(partition).str_(part)
+        frames = list(self.select.call_stream("fetchPart_v1", w))
+        hdr = json.loads(frames[0].bytes_())
+        fnames = hdr.pop("files")
+        files = [(fnames[i], frames[1 + i].bytes_())
+                 for i in range(len(fnames))]
+        reg = frames[1 + len(fnames)]
+        n = reg.u64()
+        entries = [(reg.bytes_(), reg.bytes_()) for _ in range(n)]
+        return files, entries, hdr
+
+    def migrate_part(self, partition: str, files, entries,
+                     meta=None) -> tuple[int, int]:
+        """Push one finalized part into this node (migratePart_v1);
+        returns (rows, bytes) after the node's durable publish."""
+        import json
+        meta = meta or {}
+        w = Writer().bytes_(json.dumps(
+            {"partition": partition, "files": [n for n, _ in files],
+             "min_ts": meta.get("min_ts"),
+             "max_ts": meta.get("max_ts")}).encode())
+        for _, data in files:
+            w.bytes_(data)
+        w.u64(len(entries))
+        for tsid_b, raw in entries:
+            w.bytes_(tsid_b)
+            w.bytes_(raw)
+        r = self.select.call("migratePart_v1", w)
+        return r.u64(), r.u64()
+
+    def remove_parts(self, partition: str, names: list[str]) -> int:
+        w = Writer().str_(partition).u64(len(names))
+        for n in names:
+            w.str_(n)
+        return self.select.call("removeParts_v1", w).u64()
+
     def close(self):
         self.insert.close()
         self.select.close()
@@ -856,11 +1106,119 @@ class PartialResultError(RuntimeError):
     pass
 
 
+def parse_node_spec(spec: str) -> tuple[str, int, int]:
+    """-storageNode spec -> (host, insert_port, select_port).  The
+    3-field ``host:insertPort:selectPort`` form addresses a vmstorage;
+    the 2-field ``host:port`` form addresses a multilevel child
+    (a vmselect/vminsert -clusternativeListenAddr speaks ONE plane, so
+    the same port serves both halves — the unused half connects
+    lazily and is never dialed)."""
+    fields = spec.rsplit(":", 2)
+    if len(fields) == 3 and fields[1].isdigit() and fields[2].isdigit():
+        return fields[0], int(fields[1]), int(fields[2])
+    host, _, port = spec.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"bad storage node spec {spec!r} (want "
+                         f"host:insertPort:selectPort or host:port)")
+    return host, int(port), int(port)
+
+
 class ClusterUnavailableError(RPCError):
     """Every storage node failed the fan-out: there is no data to serve
     at all.  HTTP layers map this to 503 (+ the first node's error)
     rather than a generic 500 — the cluster is degraded, the serving
     code is not broken."""
+
+
+def _node_name_of(spec: str) -> str:
+    """Accept a full node spec OR a bare node name for admin calls."""
+    host, ip_, _ = parse_node_spec(spec)
+    return f"{host}:{ip_}"
+
+
+def register_cluster_admin(srv, cluster: "ClusterStorage") -> None:
+    """``/internal/cluster/*`` admin surface on vminsert/vmselect —
+    the no-restart elasticity endpoints (ROADMAP item 3b) the chaos
+    harness, tools and operators drive:
+
+    - ``GET  /internal/cluster/nodes``                  topology + health
+    - ``POST /internal/cluster/join?node=h:ip:sp[&rebalance=1]``
+    - ``POST /internal/cluster/drain?node=h:ip[&remove=0]``
+    - ``POST /internal/cluster/remove?node=h:ip``       (already-empty node)
+    - ``POST /internal/cluster/rebalance?node=h:ip``
+    - ``POST /internal/cluster/ring_filter?enable=0|1``
+
+    Each process owns its view: a join/drain is announced to the
+    vmselect AND the vminsert (reads first for joins, writes first for
+    drains — the README walks the orderings)."""
+    from ..httpapi.server import Response
+
+    def ok(data):
+        return Response.json({"status": "success", "data": data})
+
+    def h_nodes(req):
+        return ok(cluster.cluster_status())
+
+    def h_join(req):
+        spec = req.arg("node")
+        if not spec:
+            return Response.error("missing 'node' arg")
+        try:
+            out = cluster.add_node(spec)
+            if req.arg("rebalance") == "1":
+                out["rebalance"] = cluster.rebalance_to(
+                    _node_name_of(spec))
+        except (ValueError, KeyError) as e:
+            return Response.error(str(e))
+        except (OSError, RPCError, ConnectionError) as e:
+            return Response.error(f"join failed: {e}", 503, "unavailable")
+        return ok(out)
+
+    def h_drain(req):
+        spec = req.arg("node")
+        if not spec:
+            return Response.error("missing 'node' arg")
+        try:
+            return ok(cluster.drain_node(
+                _node_name_of(spec), remove=req.arg("remove", "1") != "0"))
+        except (ValueError, KeyError) as e:
+            return Response.error(str(e))
+        except (OSError, RPCError, ConnectionError) as e:
+            return Response.error(f"drain failed: {e}", 503, "unavailable")
+
+    def h_remove(req):
+        spec = req.arg("node")
+        if not spec:
+            return Response.error("missing 'node' arg")
+        try:
+            return ok(cluster.remove_node(_node_name_of(spec)))
+        except (ValueError, KeyError) as e:
+            return Response.error(str(e))
+
+    def h_rebalance(req):
+        spec = req.arg("node")
+        if not spec:
+            return Response.error("missing 'node' arg")
+        try:
+            return ok(cluster.rebalance_to(_node_name_of(spec)))
+        except (ValueError, KeyError) as e:
+            return Response.error(str(e))
+        except (OSError, RPCError, ConnectionError) as e:
+            return Response.error(f"rebalance failed: {e}", 503,
+                                  "unavailable")
+
+    def h_ring_filter(req):
+        en = req.arg("enable")
+        if en is not None and en != "":
+            cluster.set_ring_filter(en != "0")
+        return ok({"ringFilter": cluster.ring_filter_active})
+
+    srv.route("/internal/cluster/nodes", h_nodes)
+    srv.route("/internal/cluster/join", h_join)
+    srv.route("/internal/cluster/drain", h_drain)
+    srv.route("/internal/cluster/remove", h_remove)
+    srv.route("/internal/cluster/rebalance", h_rebalance)
+    srv.route("/internal/cluster/ring_filter", h_ring_filter)
 
 
 def start_native_server(addr: str, hello: bytes, storage,
@@ -884,10 +1242,23 @@ class ClusterStorage:
     def __init__(self, nodes: list[StorageNodeClient],
                  replication_factor: int = 1,
                  deny_partial_response: bool = False):
-        self.nodes = nodes
+        # (node list, ring) swap together in ONE attribute assignment so
+        # a topology change (join/drain) can never hand an in-flight
+        # batch a ring index into a different node list
+        self._topology = (list(nodes),
+                          ConsistentHash([n.name for n in nodes]))
         self.rf = replication_factor
         self.deny_partial = deny_partial_response
-        self.ch = ConsistentHash([n.name for n in nodes])
+        #: nodes being drained: excluded from NEW writes while their
+        #: parts migrate off (reads keep hitting them until removal)
+        self._draining: set[str] = set()
+        #: rf>1 + a topology change suspends ring-ownership read
+        #: filtering on this router (full fan-out + dedup): with
+        #: replicas, ownership under the NEW ring does not imply
+        #: possession until a full anti-entropy pass — rf=1 stays
+        #: filtered through every transition (ownership == placement
+        #: there, and orphan/exemption rules cover moved data)
+        self._ring_suspended = False
         # per-tenant raw-key -> send-key verdicts (relabel applied once
         # per distinct series key; see add_rows_columnar)
         self._key_verdicts: dict[tuple, dict] = {}
@@ -916,6 +1287,14 @@ class ClusterStorage:
         self._tls = threading.local()
 
     @property
+    def nodes(self) -> list[StorageNodeClient]:
+        return self._topology[0]
+
+    @property
+    def ch(self) -> ConsistentHash:
+        return self._topology[1]
+
+    @property
     def rows_sent(self) -> int:
         return self._rows_sent.get()
 
@@ -932,28 +1311,34 @@ class ClusterStorage:
 
     # -- write path (vminsert) ------------------------------------------
 
+    def _write_excluded(self, nodes) -> set[int]:
+        """Node indexes NEW writes must avoid: down + draining."""
+        return {i for i, n in enumerate(nodes)
+                if not n.healthy or n.name in self._draining}
+
     def add_rows(self, rows, tenant=(0, 0)) -> int:
         """rows: [(labels-dict-or-MetricName, ts, value)] — shard by
         (tenant, canonical metric name), replicate RF-ways, reroute on
         failure."""
         import struct as _struct
         tkey = _struct.pack(">II", tenant[0], tenant[1])
+        nodes, ch = self._topology
         per_node: dict[int, list] = {}
-        excluded = {i for i, n in enumerate(self.nodes) if not n.healthy}
+        excluded = self._write_excluded(nodes)
         for labels, ts, val in rows:
             mn = labels if isinstance(labels, MetricName) else \
                 MetricName.from_dict(labels) if isinstance(labels, dict) \
                 else MetricName.from_labels(labels)
             raw = mn.marshal()
-            targets = self.ch.nodes_for_key(tkey + raw, self.rf, excluded)
+            targets = ch.nodes_for_key(tkey + raw, self.rf, excluded)
             if not targets:
                 # all nodes down: try everything anyway
-                targets = self.ch.nodes_for_key(tkey + raw, self.rf, set())
+                targets = ch.nodes_for_key(tkey + raw, self.rf, set())
             for i in targets:
                 per_node.setdefault(i, []).append((raw, ts, val))
         sent = 0
         for i, node_rows in per_node.items():
-            node = self.nodes[i]
+            node = nodes[i]
             try:
                 node.write_rows(node_rows, tenant)
                 sent += len(node_rows)
@@ -963,17 +1348,19 @@ class ClusterStorage:
                 self._reroutes_counter.inc()
                 # regroup the failed batch by alternate node: one RPC per
                 # target, not one per row
-                ex = {j for j, n in enumerate(self.nodes)
-                      if not n.healthy} | {i}
+                ex = self._write_excluded(nodes) | {i}
                 alt_batches: dict[int, list] = {}
                 for row in node_rows:
-                    alt = self.ch.nodes_for_key(tkey + row[0], 1, ex)
+                    alt = ch.nodes_for_key(tkey + row[0], 1, ex)
                     if not alt:
                         raise RPCError(
                             f"no healthy storage nodes for reroute: {e}")
                     alt_batches.setdefault(alt[0], []).append(row)
                 for j, batch in alt_batches.items():
-                    self.nodes[j].write_rows(batch, tenant)
+                    # reroute=True: the receiver marks these series
+                    # always-served (ring-exempt) — it may now hold
+                    # their only copy of this window
+                    nodes[j].write_rows(batch, tenant, reroute=True)
                     sent += len(batch)
         self._rows_sent.inc(sent)
         self._rows_sent_counter.inc(sent)
@@ -991,6 +1378,7 @@ class ClusterStorage:
                           drop_stats: dict | None = None) -> int:
         import struct as _struct
         tkey = _struct.pack(">II", tenant[0], tenant[1])
+        nodes, ch = self._topology
         n_rows = len(cr)
         if n_rows == 0:
             return 0
@@ -1015,9 +1403,10 @@ class ClusterStorage:
         if transform is not None:
             with self._lock:
                 vc = self._key_verdicts.setdefault(tenant, {})
-        excluded = {i for i, n in enumerate(self.nodes) if not n.healthy}
-        # per-node shards: node -> (list of key bytes, list of row arrays)
-        shards: dict[int, tuple[list, list]] = {}
+        excluded = self._write_excluded(nodes)
+        # per-node shards: node -> (key bytes list, PLACEMENT marshal
+        # list — reroutes re-place by it — and row index arrays)
+        shards: dict[int, tuple[list, list, list]] = {}
         # series whose transformed labels don't survive the text-key
         # round-trip (names with key-syntax bytes): per-row canonical path
         legacy_shards: dict[int, list] = {}
@@ -1027,7 +1416,11 @@ class ClusterStorage:
             ln = int(uniq[j] & ((1 << 24) - 1))
             key = bytes(mv[o:o + ln])
             if transform is None:
-                sk = key
+                # placement by the CANONICAL marshal (memoized per
+                # distinct key): both write paths and the ring read
+                # filter must agree on one shard key, and spelling
+                # variants of one series must co-locate
+                sk = ("cols", key, placement_marshal(key))
             else:
                 sk = vc.get(key, _MISSING)
                 if sk is _MISSING:
@@ -1042,25 +1435,25 @@ class ClusterStorage:
             if sk is None:
                 dropped_transform += rows_j.size
                 continue
-            if isinstance(sk, tuple):  # ("legacy", canonical_marshal)
+            if sk[0] == "legacy":  # ("legacy", canonical_marshal)
                 raw = sk[1]
-                targets = self.ch.nodes_for_key(tkey + raw, self.rf,
-                                                excluded)
+                targets = ch.nodes_for_key(tkey + raw, self.rf, excluded)
                 if not targets:
-                    targets = self.ch.nodes_for_key(tkey + raw, self.rf,
-                                                    set())
+                    targets = ch.nodes_for_key(tkey + raw, self.rf, set())
                 for i in targets:
                     rl = legacy_shards.setdefault(i, [])
                     for rix in rows_j:
                         rl.append((raw, int(cr.tss[rix]),
                                    float(cr.values[rix])))
                 continue
-            targets = self.ch.nodes_for_key(tkey + sk, self.rf, excluded)
+            _, send_key, pm = sk
+            targets = ch.nodes_for_key(tkey + pm, self.rf, excluded)
             if not targets:
-                targets = self.ch.nodes_for_key(tkey + sk, self.rf, set())
+                targets = ch.nodes_for_key(tkey + pm, self.rf, set())
             for i in targets:
-                keys, rowsl = shards.setdefault(i, ([], []))
-                keys.append(sk)
+                keys, pkeys, rowsl = shards.setdefault(i, ([], [], []))
+                keys.append(send_key)
+                pkeys.append(pm)
                 rowsl.append(rows_j)
         if drop_stats is not None:
             if dropped_transform:
@@ -1074,37 +1467,35 @@ class ClusterStorage:
         sent = 0
         for i, rows in legacy_shards.items():
             try:
-                self.nodes[i].write_rows(rows, tenant)
+                nodes[i].write_rows(rows, tenant)
                 sent += len(rows)
             except (OSError, RPCError, ConnectionError) as e:
-                self.nodes[i].mark_down()
+                nodes[i].mark_down()
                 self._reroutes.inc()
                 self._reroutes_counter.inc()
-                ex = {j2 for j2, n in enumerate(self.nodes)
-                      if not n.healthy} | {i}
+                ex = self._write_excluded(nodes) | {i}
                 alt_batches: dict[int, list] = {}
                 for row in rows:
-                    alt = self.ch.nodes_for_key(tkey + row[0], 1, ex)
+                    alt = ch.nodes_for_key(tkey + row[0], 1, ex)
                     if not alt:
                         raise RPCError(
                             f"no healthy storage nodes for reroute: {e}")
                     alt_batches.setdefault(alt[0], []).append(row)
                 for j2, batch in alt_batches.items():
-                    self.nodes[j2].write_rows(batch, tenant)
+                    nodes[j2].write_rows(batch, tenant, reroute=True)
                     sent += len(batch)
-        for i, (keys, rowsl) in shards.items():
+        for i, (keys, pkeys, rowsl) in shards.items():
             try:
-                sent += self._send_columnar_shard(self.nodes[i], keys,
+                sent += self._send_columnar_shard(nodes[i], keys,
                                                   rowsl, tss, vals, tenant)
             except (OSError, RPCError, ConnectionError) as e:
-                self.nodes[i].mark_down()
+                nodes[i].mark_down()
                 self._reroutes.inc()
                 self._reroutes_counter.inc()
-                ex = {j2 for j2, n in enumerate(self.nodes)
-                      if not n.healthy} | {i}
+                ex = self._write_excluded(nodes) | {i}
                 alt_shards: dict[int, tuple[list, list]] = {}
-                for key, rows_j in zip(keys, rowsl):
-                    alt = self.ch.nodes_for_key(tkey + key, 1, ex)
+                for key, pm, rows_j in zip(keys, pkeys, rowsl):
+                    alt = ch.nodes_for_key(tkey + pm, 1, ex)
                     if not alt:
                         raise RPCError(
                             f"no healthy storage nodes for reroute: {e}")
@@ -1112,8 +1503,9 @@ class ClusterStorage:
                     ks.append(key)
                     rl.append(rows_j)
                 for j2, (ks, rl) in alt_shards.items():
-                    sent += self._send_columnar_shard(self.nodes[j2], ks,
-                                                      rl, tss, vals, tenant)
+                    sent += self._send_columnar_shard(nodes[j2], ks,
+                                                      rl, tss, vals, tenant,
+                                                      reroute=True)
         self._rows_sent.inc(sent)
         self._rows_sent_counter.inc(sent)
         return int(n_rows - dropped_transform - dropped_malformed)
@@ -1121,10 +1513,12 @@ class ClusterStorage:
     @staticmethod
     def _judge_key(key: bytes, transform):
         """One-time verdict for a distinct raw key under `transform`:
-        bytes = ship this (relabeled) text key columnar; None = dropped
-        by the transform; False = malformed; ("legacy", marshal) = the
-        transformed labels don't survive the text round-trip (key-syntax
-        bytes in names) and must go per-row canonical."""
+        ("cols", send_key, placement_marshal) = ship the (relabeled)
+        text key columnar, shard by the canonical marshal; None =
+        dropped by the transform; False = malformed; ("legacy",
+        marshal) = the transformed labels don't survive the text
+        round-trip (key-syntax bytes in names) and must go per-row
+        canonical."""
         from ..ingest.parsers import (labels_from_series_key,
                                       series_key_from_labels)
         try:
@@ -1142,9 +1536,10 @@ class ClusterStorage:
         canon = sorted((k.decode() if isinstance(k, bytes) else k,
                         v.decode() if isinstance(v, bytes) else v)
                        for k, v in labels if v)
+        marshal = MetricName.from_labels(labels).marshal()
         if back is None or sorted(back) != canon:
-            return ("legacy", MetricName.from_labels(labels).marshal())
-        return sk
+            return ("legacy", marshal)
+        return ("cols", sk, marshal)
 
     def reset_columnar_spaces(self) -> None:
         """Invalidate cached raw-key -> send-key verdicts (call after the
@@ -1154,7 +1549,7 @@ class ClusterStorage:
             self._key_verdicts = {}
 
     def _send_columnar_shard(self, node, keys, rowsl, tss, vals,
-                             tenant) -> int:
+                             tenant, reroute: bool = False) -> int:
         """One writeRowsColumnar_v1 call: build the shard's keybuf +
         per-row offset columns from (key, row-index-array) pairs."""
         counts = np.fromiter((r.size for r in rowsl), np.int64, len(rowsl))
@@ -1165,7 +1560,7 @@ class ClusterStorage:
         node.write_rows_columnar(
             b"".join(keys), np.repeat(koffs, counts),
             np.repeat(klens, counts), tss[row_order], vals[row_order],
-            tenant)
+            tenant, reroute=reroute)
         return int(row_order.size)
 
     # -- read path (vmselect) -------------------------------------------
@@ -1196,6 +1591,13 @@ class ClusterStorage:
         results: list = []
         errors: list = []
         lock = make_lock("parallel.cluster_api.fanout_lock")
+        # per-thread record of WHICH nodes failed this fan-out: the
+        # ring-filtered read path re-fans (or goes honestly partial)
+        # when a failure wasn't in the down set the rings shipped —
+        # waited=False failures (pre-exhausted budget, local pool
+        # capacity) never flip node.healthy, so health alone can't
+        # detect that survivors suppressed the failed node's shares
+        self._tls.fanout_failed = frozenset()
 
         def run(node):
             try:
@@ -1212,8 +1614,9 @@ class ClusterStorage:
                 with lock:
                     errors.append((node.name, e))
 
-        live = [n for n in self.nodes if n.healthy]
-        for n in self.nodes:
+        all_nodes = self.nodes
+        live = [n for n in all_nodes if n.healthy]
+        for n in all_nodes:
             if not n.healthy:
                 errors.append((n.name, RPCError("node marked down")))
         if len(live) <= 1:
@@ -1230,6 +1633,7 @@ class ClusterStorage:
                 f"{errors[0][1]}")
         if errors:
             failed = {name for name, _ in errors}
+            self._tls.fanout_failed = frozenset(failed)
             if replica_covered_ok and self.rf > 1 and \
                     len(failed) < self.rf:
                 # every hash range of every failed node is RF-covered by
@@ -1256,6 +1660,29 @@ class ClusterStorage:
     # one query deadline, not a fixed default timeout per hop
     supports_search_deadline = True
 
+    def _read_rings(self) -> tuple[dict, frozenset]:
+        """(per-node RingConfig for one read fan-out — node name ->
+        ring with that node's self index and the current down set —,
+        the down NODE NAMES those rings embed).  ({}, frozenset()) when
+        ring-ownership filtering is off (VM_RING_FILTER=0, a single
+        node, or suspended after an rf>1 topology change).  The down
+        set is returned so the re-fan check compares against exactly
+        what the rings claimed (a second health read could differ).
+        Ticks ``vm_reroute_reads_total`` when the shipped down set is
+        non-empty — survivors will explicitly serve the down nodes'
+        hash ranges from their replicas."""
+        nodes = self.nodes
+        if not ringfilter.enabled() or self._ring_suspended or \
+                len(nodes) <= 1:
+            return {}, frozenset()
+        names = [n.name for n in nodes]
+        down = frozenset(i for i, n in enumerate(nodes) if not n.healthy)
+        if down:
+            ringfilter.REROUTE_READS.inc()
+        return ({n.name: ringfilter.get_ring(names, self.rf, i, down)
+                 for i, n in enumerate(nodes) if n.healthy},
+                frozenset(names[i] for i in down))
+
     def search_columns(self, filters, min_ts, max_ts,
                        dedup_interval_ms=None, max_series=None,
                        tenant=(0, 0), tracer=querytracer.NOP,
@@ -1270,16 +1697,56 @@ class ClusterStorage:
         from ..storage.columnar import ColumnarSeries, assemble
         self._search_fanouts.inc()
         self._search_fanouts_counter.inc()
+        for _attempt in range(2):
+            # down_before = the EXACT down set the shipped rings embed
+            # (a second health snapshot could already differ and hide a
+            # just-failed node from the re-fan check)
+            rings, down_before = self._read_rings()
 
-        def query_node(n):
-            # one child span per storage node; children.append is
-            # GIL-atomic, so concurrent fan-out threads are safe
-            with tracer.new_child("rpc searchColumns_v1 node %s",
-                                  n.name) as nqt:
-                return n.search_columns(filters, min_ts, max_ts, tenant,
-                                        tracer=nqt, deadline=deadline)
+            def query_node(n, rings=rings):
+                # one child span per storage node; children.append is
+                # GIL-atomic, so concurrent fan-out threads are safe
+                with tracer.new_child("rpc searchColumns_v1 node %s",
+                                      n.name) as nqt:
+                    return n.search_columns(filters, min_ts, max_ts,
+                                            tenant, tracer=nqt,
+                                            deadline=deadline,
+                                            ring=rings.get(n.name))
 
-        node_results = self._fanout(query_node)
+            node_results = self._fanout(query_node)
+            if not rings or self.rf <= 1:
+                break
+            # ANY failure the shipped rings didn't list as down means
+            # the survivors suppressed shares the failed node owned —
+            # node.healthy flips cover crashes, fanout_failed covers
+            # waited=False failures (pre-exhausted budget, local pool
+            # capacity) that never mark the node down
+            fresh = (({n.name for n in self.nodes if not n.healthy} |
+                      set(getattr(self._tls, "fanout_failed", ()))) -
+                     down_before)
+            if not fresh:
+                break
+            if _attempt == 1:
+                # the re-fan ALSO failed a node the rings called
+                # healthy: replica coverage cannot be claimed — the
+                # suppressed shares may be missing, so go honestly
+                # partial instead of silently incomplete
+                self._tls.partial = True
+                if self.deny_partial:
+                    raise PartialResultError(
+                        "partial response denied: ring-filtered "
+                        "fan-out kept failing node(s) "
+                        + ",".join(sorted(fresh)))
+                break
+            # a node died DURING this fan-out, after the shipped rings
+            # claimed it healthy: its replicas suppressed the shares it
+            # owned, so the merged result is silently missing them.
+            # One bounded re-fan with the updated down set makes the
+            # survivors serve those ranges explicitly (KNOWN-down nodes
+            # never re-fan — their shares ship rerouted the first time).
+            logger.warnf("cluster: node(s) %s failed mid-fan-out; "
+                         "re-fanning with rerouted ring",
+                         ",".join(sorted(fresh)))
         names_all: list[bytes] = []
         cnt_parts, ts_parts, val_parts = [], [], []
         for names, counts, ts_cat, val_cat, remote_partial in node_results:
@@ -1471,6 +1938,281 @@ class ClusterStorage:
                 "seriesCountByLabelValuePair":
                     merge_top("seriesCountByLabelValuePair")}
 
+    # -- elastic topology: join / drain / rebalance ---------------------
+    #
+    # The cluster grows and shrinks WITHOUT restarts (ROADMAP item 3b):
+    # join adds a node to the hash ring (new writes shard to it at the
+    # next batch), drain write-excludes a node, migrates every
+    # finalized part off it over the migrateParts_v1 family, and only
+    # then drops it — each part is removed from its source AFTER the
+    # receiver's durable ack, so acked writes survive every transition.
+    # Reads stay byte-exact throughout: moved parts are ring-exempt on
+    # their new node and duplicates collapse in the fan-out merge.
+
+    def node_names(self) -> list[str]:
+        return [n.name for n in self.nodes]
+
+    def set_ring_filter(self, enabled: bool) -> None:
+        """Re-arm (or suspend) ring-ownership read filtering on this
+        router — rf>1 topology changes suspend it automatically (see
+        __init__); the operator re-enables once the data layout has
+        settled."""
+        with self._lock:
+            self._ring_suspended = not enabled
+
+    @property
+    def ring_filter_active(self) -> bool:
+        return ringfilter.enabled() and not self._ring_suspended and \
+            len(self.nodes) > 1
+
+    def _set_nodes_locked(self, nodes: list[StorageNodeClient]) -> None:
+        """Swap the (nodes, ring) tuple; caller holds self._lock."""
+        self._topology = (list(nodes),
+                          ConsistentHash([n.name for n in nodes]))
+        if self.rf > 1:
+            # with replicas, ownership under the NEW ring does not
+            # imply possession — suspend ownership filtering until
+            # the operator re-arms it (full fan-out stays correct)
+            self._ring_suspended = True
+
+    def _set_nodes(self, nodes: list[StorageNodeClient]) -> None:
+        with self._lock:
+            self._set_nodes_locked(nodes)
+
+    def add_node(self, spec: str, timeout: float = 10.0) -> dict:
+        """JOIN host:insertPort:selectPort (or host:port for a
+        multilevel child): new writes shard to the node from the next
+        batch on.  Call :meth:`rebalance_to` afterwards to move a fair
+        byte share of existing parts onto it."""
+        host, ip_, sp_ = parse_node_spec(spec)
+        node = StorageNodeClient(host, ip_, sp_, timeout=timeout)
+        # read-modify-write under the topology lock: two concurrent
+        # joins (admin handlers run on separate HTTP threads) must not
+        # lose each other's node
+        with self._lock:
+            if node.name in {n.name for n in self.nodes}:
+                dup = True
+            else:
+                dup = False
+                logger.infof("cluster: joining node %s", node.name)
+                self._draining.discard(node.name)
+                self._set_nodes_locked(self.nodes + [node])
+        if dup:
+            node.close()
+            raise ValueError(f"node {node.name} is already in the ring")
+        return {"nodes": self.node_names()}
+
+    def remove_node(self, name: str) -> dict:
+        """Drop a node from the ring (reads/writes stop immediately).
+        Use :meth:`drain_node` instead when the node still holds data."""
+        with self._lock:
+            nodes = list(self.nodes)
+            keep = [n for n in nodes if n.name != name]
+            if len(keep) == len(nodes):
+                raise KeyError(f"no node named {name!r}")
+            if not keep:
+                raise ValueError("cannot remove the last storage node")
+            logger.infof("cluster: removing node %s", name)
+            self._set_nodes_locked(keep)
+            self._draining.discard(name)
+        for n in nodes:
+            if n.name == name:
+                n.close()
+        return {"nodes": self.node_names()}
+
+    @staticmethod
+    def _migrate_grace_s() -> float:
+        """How long a migrated part's SOURCE copy outlives the
+        receiver's ack (``VM_MIGRATE_GRACE_MS``, default 1500).  A
+        fan-out is not atomic: a query can read the target BEFORE the
+        part lands there and the source AFTER a prompt delete — missing
+        the part on both, silently.  Keeping the source copy for one
+        grace window (>= the longest query's wall time) closes that
+        race: any fan-out that missed the part on the target started
+        early enough to still find it on the source (duplicates from
+        the overlap collapse in the merge like replica overlap)."""
+        import os
+        try:
+            return max(float(os.environ.get("VM_MIGRATE_GRACE_MS",
+                                            "1500")), 0.0) / 1e3
+        except ValueError:
+            return 1.5
+
+    def _copy_one(self, src: StorageNodeClient, dst: StorageNodeClient,
+                  partition: str, part: str) -> tuple[int, int]:
+        """Copy one finalized part src -> dst: pull (fetchPart_v1) and
+        push (migratePart_v1 — the receiver verifies crc32s and
+        publishes durably).  The SOURCE copy stays; callers delete it
+        after the migration grace window (see _migrate_grace_s).
+
+        Known bound: the transfer materializes the part in memory at
+        each hop and the push is one RPC frame, so parts are capped by
+        RAM and rpc.MAX_FRAME (256MB compressed) — an over-cap part
+        fails loudly and stays on its source (ROADMAP names streamed
+        bounded-memory transfer as the follow-up)."""
+        files, entries, meta = src.fetch_part(partition, part)
+        rows, nbytes = dst.migrate_part(partition, files, entries, meta)
+        _PARTS_MIGRATED.inc()
+        _REBALANCE_BYTES.inc(nbytes)
+        logger.infof("cluster: migrated %s/%s %s -> %s (%d rows, %d "
+                     "bytes)", partition, part, src.name, dst.name, rows,
+                     nbytes)
+        return rows, nbytes
+
+    @staticmethod
+    def _remove_after_grace(src: StorageNodeClient, moved: dict) -> None:
+        """Delete migrated-away source copies once the grace window has
+        passed (``moved``: partition -> [part names])."""
+        if not moved:
+            return
+        time.sleep(ClusterStorage._migrate_grace_s())
+        for partition, names in moved.items():
+            src.remove_parts(partition, names)
+
+    def drain_node(self, name: str, remove: bool = True,
+                   max_passes: int = 6) -> dict:
+        """DRAIN: write-exclude the node, then migrate every finalized
+        part off it (each listing flushes first, so rows acked before
+        or during the drain are included; the first pass force-merges
+        so few parts move and no background merge races the fetches).
+        Multiple passes absorb parts that appear between listings.
+        ``remove`` drops the node from the ring once it is empty."""
+        if name not in self.node_names():
+            raise KeyError(f"no node named {name!r}")
+        self._draining.add(name)
+        try:
+            return self._drain_node(name, remove, max_passes)
+        except BaseException:
+            # a failed drain must not leave the node write-excluded
+            # forever (a successful one removes it from the ring, or —
+            # with remove=False — the caller owns the follow-up)
+            self._draining.discard(name)
+            raise
+
+    def _drain_node(self, name: str, remove: bool,
+                    max_passes: int) -> dict:
+        # ONE topology snapshot for the whole (long, sleeping) drain:
+        # part names are node-local counters, so index-addressing
+        # self.nodes across a concurrent topology change could point a
+        # remove_parts at the WRONG node's identically-named parts
+        nodes, ch = self._topology
+        idx = [n.name for n in nodes].index(name)
+        src = nodes[idx]
+        moved = {"parts": 0, "rows": 0, "bytes": 0}
+        for attempt in range(max_passes):
+            parts = src.list_parts(flush=True, merge=attempt == 0)
+            if not parts:
+                break
+            copied: dict[str, list[str]] = {}
+            for row in parts:
+                excluded = {i for i, n in enumerate(nodes)
+                            if not n.healthy or n.name in self._draining}
+                excluded.add(idx)
+                key = (b"part:" + row["partition"].encode() + b"/" +
+                       row["part"].encode() + src.name.encode())
+                tgt = ch.nodes_for_key(key, 1, excluded)
+                if not tgt:
+                    raise RPCError(
+                        f"drain {name}: no healthy target nodes")
+                try:
+                    rows_n, bytes_n = self._copy_one(
+                        src, nodes[tgt[0]], row["partition"],
+                        row["part"])
+                except (RPCError, KeyError) as e:
+                    # merged away since listing (or a racing pass):
+                    # the re-list on the next attempt settles it
+                    logger.warnf("drain %s: part %s/%s skipped: %s",
+                                 name, row["partition"], row["part"], e)
+                    continue
+                copied.setdefault(row["partition"], []).append(row["part"])
+                moved["parts"] += 1
+                moved["rows"] += rows_n
+                moved["bytes"] += bytes_n
+            # source copies outlive the ack by the migration grace so
+            # in-flight fan-outs that read the target pre-adopt still
+            # find the bytes on the source (then the re-list can't see
+            # the removed parts again)
+            self._remove_after_grace(src, copied)
+        else:
+            raise RPCError(f"drain {name}: parts still appearing after "
+                           f"{max_passes} passes")
+        out = dict(moved, node=name, removed=False)
+        if remove:
+            self.remove_node(name)
+            out["removed"] = True
+        return out
+
+    def rebalance_to(self, name: str) -> dict:
+        """After a JOIN: greedily move finalized parts from the most
+        loaded nodes onto ``name`` until it holds ~1/N of the cluster's
+        part bytes.  A part moves when the move brings BOTH sides at
+        least as close to the fair share as staying put — so a single
+        compacted part larger than the fair share still moves to an
+        empty joiner (the 1-node -> 2-node case) instead of silently
+        rebalancing nothing.  Byte-exact reads throughout: adopted
+        parts serve ring-exempt, and each source copy outlives the
+        receiver's durable ack (one grace window for the whole pass)."""
+        # one topology snapshot for the whole pass (see _drain_node:
+        # index- or ring-addressing across a concurrent change could
+        # delete identically-named parts on the WRONG node)
+        nodes, _ = self._topology
+        try:
+            tgt_i = [n.name for n in nodes].index(name)
+        except ValueError:
+            raise KeyError(f"no node named {name!r}")
+        tgt = nodes[tgt_i]
+        inv: dict[int, list] = {}
+        for i, n in enumerate(nodes):
+            if n.healthy and n.name not in self._draining:
+                inv[i] = n.list_parts(flush=True)
+        total = sum(r["bytes"] for parts in inv.values() for r in parts)
+        fair = total / max(len(inv), 1)
+        have = sum(r["bytes"] for r in inv.get(tgt_i, ()))
+        moved = {"parts": 0, "rows": 0, "bytes": 0}
+        copied: dict[int, dict[str, list[str]]] = {}
+        order = sorted((i for i in inv if i != tgt_i),
+                       key=lambda i: -sum(r["bytes"] for r in inv[i]))
+        for i in order:
+            src_bytes = sum(r["bytes"] for r in inv[i])
+            for row in sorted(inv[i], key=lambda r: -r["bytes"]):
+                b = row["bytes"]
+                # move only if neither side ends FARTHER from fair
+                # than it started (<= : a neutral move still fills an
+                # empty joiner)
+                if b <= 0 or b > 2 * (fair - have) or \
+                        b > 2 * (src_bytes - fair):
+                    continue
+                try:
+                    rows_n, bytes_n = self._copy_one(
+                        nodes[i], tgt, row["partition"], row["part"])
+                except (RPCError, KeyError) as e:
+                    logger.warnf("rebalance: part %s/%s skipped: %s",
+                                 row["partition"], row["part"], e)
+                    continue
+                copied.setdefault(i, {}).setdefault(
+                    row["partition"], []).append(row["part"])
+                have += bytes_n
+                src_bytes -= bytes_n
+                moved["parts"] += 1
+                moved["rows"] += rows_n
+                moved["bytes"] += bytes_n
+        if copied:
+            # ONE grace window after the last ack covers every in-flight
+            # fan-out, regardless of how many source nodes contributed
+            time.sleep(self._migrate_grace_s())
+            for i, by_part in copied.items():
+                for partition, names in by_part.items():
+                    nodes[i].remove_parts(partition, names)
+        return dict(moved, node=name)
+
+    def cluster_status(self) -> dict:
+        """Topology worksheet for /internal/cluster/nodes."""
+        return {"nodes": [{"name": n.name, "healthy": n.healthy,
+                           "draining": n.name in self._draining}
+                          for n in self.nodes],
+                "replicationFactor": self.rf,
+                "ringFilter": self.ring_filter_active}
+
     @property
     def search_fanouts(self) -> int:
         """Read fan-outs launched by this vmselect (one per scatter-
@@ -1487,5 +2229,11 @@ class ClusterStorage:
                     sum(1 for n in self.nodes if n.healthy)}
 
     def close(self):
-        for n in self.nodes:
+        # snapshot under the topology lock: nodes constructed by a
+        # join handler thread are published under it (_set_nodes), and
+        # this acquire is the happens-before edge that makes their
+        # freshly-initialized client state visible here
+        with self._lock:
+            nodes = self.nodes
+        for n in nodes:
             n.close()

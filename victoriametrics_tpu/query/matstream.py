@@ -77,6 +77,12 @@ _REUSE = metricslib.REGISTRY.counter("vm_matstream_fanout_reuse_total")
 _DECLINES = metricslib.REGISTRY.counter("vm_matstream_declines_total")
 _DROPS = metricslib.REGISTRY.counter("vm_matstream_dropped_frames_total")
 _EVALS = metricslib.REGISTRY.counter("vm_matstream_evals_total")
+#: reconnect/resume accounting: a hit replays only the missed suffix
+#: frames; a miss (unknown/too-old token) degrades LOUDLY to a full
+#: resync snapshot
+_RESUMES = metricslib.REGISTRY.counter("vm_matstream_resumes_total")
+_RESUME_MISSES = metricslib.REGISTRY.counter(
+    "vm_matstream_resume_misses_total")
 
 
 def enabled() -> bool:
@@ -226,6 +232,9 @@ class Subscription:
         self.stream._unsubscribe(self)
 
 
+_EPOCH_COUNTER = __import__("itertools").count(1)
+
+
 class MatStream:
     """One materialized expression: canonical query text + (step,
     window, tenant), its committed window state, and its subscribers."""
@@ -241,6 +250,23 @@ class MatStream:
         self._advance_lock = make_lock("query.MatStream._advance_lock")
         self._state: _State | None = None
         self._subs: list[Subscription] = []
+        #: resume-token namespace: a token from another stream
+        #: incarnation (evicted + re-created, process restart) must
+        #: never replay against this one's seq space
+        self.epoch = f"{fasttime.unix_ms():x}.{next(_EPOCH_COUNTER):x}"
+        #: the last few fanned frames, (seq, frame), for reconnect
+        #: resume (bounded by VM_MATSTREAM_QUEUE like subscriber queues)
+        self._recent: list[tuple[int, dict]] = []
+        #: instant-share verdict (see MatStreamRegistry.instant_vector):
+        #: None = unvalidated, True = the committed tail column is
+        #: bit-equal to a legacy instant eval at the same ts, False =
+        #: proven divergent for this expression/step — never share.
+        #: A True verdict is REVALIDATED every Nth share (the Nth call
+        #: pays the legacy eval and re-compares), bounding how long a
+        #: workload change — e.g. late-arriving samples inside the
+        #: window — could serve diverging shares
+        self.instant_share: bool | None = None
+        self._share_hits = 0
         self.seq = 0
         self.evals = 0
         self.declines = 0
@@ -252,16 +278,76 @@ class MatStream:
 
     # -- subscriber management (under self._lock) -------------------------
 
-    def subscribe(self) -> Subscription:
+    def subscribe(self, resume: str | None = None) -> Subscription:
+        """``resume`` is a token from a previous subscription's frames
+        (``Last-Event-ID``/``resume=``): when it names THIS stream
+        incarnation and every frame after it is still retained, the
+        subscriber receives only the missed suffix frames; anything
+        else — foreign epoch, too-old seq, malformed — degrades loudly
+        to a full resync snapshot (vm_matstream_resume_misses_total)."""
         sub = Subscription(self)
         with self._lock:
             self._subs.append(sub)
+            if resume:
+                if self._try_resume(sub, resume):
+                    _RESUMES.inc()
+                    return sub
+                _RESUME_MISSES.inc()
+                flightrec.instant("matstream:resume_miss",
+                                  arg=self.q[:120])
+                if self._state is not None:
+                    self._offer(sub, None,
+                                [self._snapshot_frame(resync=True)])
+                    sub.need_snapshot = False
+                return sub
             if self._state is not None:
                 # cold subscribe replays the CURRENT window from the
                 # committed state — no evaluation, no storage read
                 self._offer(sub, None, [self._snapshot_frame()])
                 sub.need_snapshot = False
         return sub
+
+    def _try_resume(self, sub: Subscription, token: str) -> bool:
+        """Replay the missed suffix frames for a valid token (under
+        self._lock).  Valid = same epoch AND every seq in (token_seq,
+        self.seq] still retained — the client's reassembled state at
+        token_seq is then a correct base for the retained deltas."""
+        epoch, _, seq_s = token.rpartition(":")
+        if epoch != self.epoch or not seq_s.isdigit():
+            return False
+        seq = int(seq_s)
+        if seq > self.seq:
+            return False
+        # a token naming a PARTIAL snapshot frame means the client's
+        # window holds the uncommitted partial values (the one fanned
+        # frame that mutates client state away from the committed
+        # line) — deltas diffed against the committed state would
+        # leave its prefix silently divergent, so resync instead
+        at = next((f for s, f in self._recent if s == seq), None)
+        if at is not None and at.get("partial"):
+            return False
+        if seq == self.seq:
+            sub.need_snapshot = False  # nothing missed: deltas continue
+            return True
+        missed = [f for s, f in self._recent if s > seq]
+        if len(missed) != self.seq - seq:
+            return False  # gap: retained ring no longer covers the token
+        if any(f.get("type") != "delta" for f in missed):
+            # the missed suffix crosses a decline (error frame or
+            # partial snapshot): live subscribers were resynced with a
+            # FRESH snapshot after it, but the retained ring holds the
+            # raw delta that was diffed against the COMMITTED state —
+            # replaying it onto a client that applied the partial
+            # values would leave a silently divergent prefix.  Degrade
+            # to the snapshot+resync path instead.
+            return False
+        sub.need_snapshot = False
+        self._offer(sub, self._snapshot_frame, missed)
+        return True
+
+    def resume_token(self, frame: dict) -> str:
+        """The SSE event id for one frame of this stream."""
+        return f"{self.epoch}:{frame.get('seq', self.seq)}"
 
     def _unsubscribe(self, sub: Subscription) -> None:
         with self._lock:
@@ -328,6 +414,11 @@ class MatStream:
         return f
 
     def _fanout(self, frames: list[dict], snapshot_fn, resync_all: bool):
+        # retain for reconnect resume BEFORE fanning (a subscriber that
+        # drops mid-fan can resume into the frame it just missed)
+        for f in frames:
+            self._recent.append((self.seq, f))
+        del self._recent[:-queue_limit()]
         subs = self._subs
         for sub in subs:
             if resync_all:
@@ -444,6 +535,26 @@ class MatStream:
 
     # -- introspection -----------------------------------------------------
 
+    def instant_rows_from_state(self, ts_ms: int) -> list[dict] | None:
+        """Datasource-shaped rows derived from the committed window's
+        LAST column — the shared-instant candidate for rule groups
+        evaluating this stream's expression at exactly the committed
+        end (None otherwise).  Value formatting mirrors instant_vector
+        (float(fmt_value(v))), so a validated share is bit-equal to the
+        legacy poll path."""
+        with self._lock:
+            st = self._state
+            if st is None or st.end != ts_ms:
+                return None
+            out = []
+            for s, meta in enumerate(st.metas):
+                v = st.vals[s, -1]
+                if math.isnan(v):
+                    continue
+                out.append({"metric": meta, "value": float(fmt_value(v)),
+                            "ts": ts_ms / 1e3})
+            return out
+
     def usage_row(self) -> dict:
         with self._lock:
             row = {"query": self.q, "tenant": f"{self.tenant[0]}:"
@@ -469,6 +580,9 @@ class MatStreamRegistry:
     colocated vmalert rule engine routes through."""
 
     _INSTANT_MEMO_MAX = 512
+    #: every Nth validated share re-runs the legacy eval and
+    #: re-compares (see MatStream.instant_share)
+    _SHARE_REVALIDATE_N = 16
 
     def __init__(self, api):
         # the owning PrometheusAPI (cached range executor + gate + _ec);
@@ -492,7 +606,8 @@ class MatStreamRegistry:
         return str(parse_cached(q))
 
     def subscribe(self, q: str, step: int, duration: int,
-                  tenant: tuple = (0, 0)) -> Subscription:
+                  tenant: tuple = (0, 0),
+                  resume: str | None = None) -> Subscription:
         if not enabled():
             raise MatStreamDisabled(
                 "materialized streams disabled (VM_MATSTREAM=0)")
@@ -518,7 +633,7 @@ class MatStreamRegistry:
             # would let a concurrent at-capacity subscribe evict this
             # still-subscriber-less stream and orphan the subscription
             # (two live streams for one key = duplicate evaluations)
-            return st.subscribe()
+            return st.subscribe(resume=resume)
 
     def _evict_locked(self) -> None:
         """Drop the oldest subscriber-less stream (its warm state is
@@ -557,6 +672,22 @@ class MatStreamRegistry:
 
     # -- shared instant evaluation (vmalert rule groups) -------------------
 
+    def _instant_candidate(self, tenant, canonical, ts_ms):
+        """A RANGE stream over the same (tenant, expression) whose
+        committed window ends exactly at ts_ms — its tail column is the
+        shared-instant candidate (None, None when no stream/state
+        lines up or sharing is proven divergent)."""
+        with self._lock:
+            streams = [st for k, st in self._streams.items()
+                       if k[0] == tenant and k[1] == canonical]
+        for st in streams:
+            if st.instant_share is False:
+                continue
+            rows = st.instant_rows_from_state(ts_ms)
+            if rows is not None:
+                return st, rows
+        return None, None
+
     def instant_vector(self, q: str, ts_ms: int,
                        tenant: tuple = (0, 0)) -> list[dict]:
         """One instant evaluation per distinct (expression, timestamp),
@@ -565,10 +696,20 @@ class MatStreamRegistry:
         (``{"metric", "value", "ts"}``), identical to the legacy HTTP
         poll path by construction (same executor, same value
         formatting).  With VM_MATSTREAM=0 the memo is bypassed: every
-        caller evaluates itself (the legacy behavior, the oracle)."""
+        caller evaluates itself (the legacy behavior, the oracle).
+
+        Rule groups and RANGE streams over ONE expression also share:
+        when a stream's committed window ends exactly at ts_ms, its
+        tail column serves the instant — after a one-time
+        validate-then-trust check (the first such call still runs the
+        legacy eval and compares bit-for-bit; a divergent expression —
+        e.g. one whose default rollup window depends on the grid step —
+        pins ``instant_share=False`` and never shares again).  A
+        validated hit costs zero evaluations and zero storage reads."""
         share = enabled()
         canonical = self.canonical(q)
         key = (tenant, canonical, ts_ms)
+        cand_stream = cand_rows = None
         if share:
             with self._lock:
                 hit = self._instant_memo.get(key)
@@ -577,6 +718,25 @@ class MatStreamRegistry:
                     self.instant_reuse += 1
                     _REUSE.inc()
                     return hit
+            cand_stream, cand_rows = self._instant_candidate(
+                tenant, canonical, ts_ms)
+            if cand_stream is not None and cand_stream.instant_share:
+                cand_stream._share_hits += 1
+                if cand_stream._share_hits % self._SHARE_REVALIDATE_N:
+                    _REUSE.inc()
+                    flightrec.instant("matstream:instant_share",
+                                      arg=canonical[:120])
+                    with self._lock:
+                        self.instant_reuse += 1
+                        self._instant_memo[key] = cand_rows
+                        while len(self._instant_memo) > \
+                                self._INSTANT_MEMO_MAX:
+                            self._instant_memo.popitem(last=False)
+                    return cand_rows
+                # every Nth share falls through to the legacy eval and
+                # re-compares below — a workload change (late samples
+                # inside the window) is caught within N shares
+                cand_stream.instant_share = None
         from .exec import exec_query
         api = self.api
         ec = api._ec(ts_ms, ts_ms, 300_000, tenant)
@@ -599,6 +759,16 @@ class MatStreamRegistry:
             # the legacy datasource parses the formatted string
             out.append({"metric": r.metric_name.to_dict(),
                         "value": float(fmt_value(v)), "ts": ts_ms / 1e3})
+        if cand_stream is not None and cand_stream.instant_share is None:
+            # validate-then-trust: this legacy eval ran anyway — record
+            # whether the stream's tail column matches it bit-for-bit
+            # (order-insensitive: rules treat the result as a vector)
+            import json as _json
+
+            def _k(rows):
+                return sorted(_json.dumps(r, sort_keys=True)
+                              for r in rows)
+            cand_stream.instant_share = _k(cand_rows) == _k(out)
         if share:
             with self._lock:
                 self._instant_memo[key] = out

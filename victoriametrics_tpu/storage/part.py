@@ -532,6 +532,27 @@ class Part:
         for h in self.iter_headers(tsid_set, min_ts, max_ts, tsid_lo, tsid_hi):
             yield self.read_block(h)
 
+    def unique_tsids(self) -> list[TSID]:
+        """Every distinct TSID referenced by this part's blocks (the
+        registration manifest a part migration must ship alongside the
+        bytes — metric_ids are node-local counters, so the receiving
+        node cannot resolve them without it)."""
+        out: dict[int, TSID] = {}
+        for h in self.iter_headers():
+            t = h.tsid
+            out.setdefault(t.metric_id, t)
+        return list(out.values())
+
+    def file_bytes(self) -> int:
+        """Total on-disk payload bytes (migration sizing/accounting)."""
+        total = 0
+        for name in os.listdir(self.path):
+            try:
+                total += os.path.getsize(os.path.join(self.path, name))
+            except OSError:
+                pass
+        return total
+
     def header_columns(self):
         """Columnar view of every block header, built ONCE per part
         (immutable): header selection for the batched fetch becomes pure

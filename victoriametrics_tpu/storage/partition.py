@@ -1014,6 +1014,96 @@ class Partition:
                     + sum(m.rows for m in self._mem_parts)
                     + sum(p.rows for p in self._file_parts))
 
+    # -- live resharding (part migration) ----------------------------------
+
+    def list_file_parts(self) -> list[dict]:
+        """Finalized on-disk parts: migration inventory rows
+        ``{part, rows, bytes, min_ts, max_ts}``."""
+        with self._lock:
+            parts = list(self._file_parts)
+        return [{"part": os.path.basename(p.path), "rows": int(p.rows),
+                 "bytes": p.file_bytes(), "min_ts": int(p.min_ts),
+                 "max_ts": int(p.max_ts)} for p in parts]
+
+    def get_file_part(self, name: str):
+        """The open Part for one finalized part name (None when merged
+        away/removed since listing — callers re-list and retry)."""
+        with self._lock:
+            for p in self._file_parts:
+                if os.path.basename(p.path) == name:
+                    return p
+        return None
+
+    def stage_part(self, files: list[tuple[str, bytes]]) -> str:
+        """First half of adopting a part shipped from another node:
+        write the files to a fresh local ``<name>.tmp`` dir, fsync, and
+        VERIFY the recorded crc32s against the transferred bytes (the
+        PR-10 integrity gate — a torn transfer is rejected here, before
+        the caller commits ANY other state for the part, e.g. series
+        registrations).  Returns the reserved part name; a crash leaves
+        only a ``.tmp`` dir the next open sweeps."""
+        for fname, _ in files:
+            if os.sep in fname or fname != os.path.basename(fname) or \
+                    fname.startswith("."):
+                raise ValueError(f"bad part file name {fname!r}")
+        with self._lock:
+            name = f"p_{next(self._seq):016d}"
+        tmp = os.path.join(self.path, name) + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            for fname, data in files:
+                fp = os.path.join(tmp, fname)
+                with open(fp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+            meta = fslib.load_meta_json(os.path.join(tmp, "metadata.json"))
+            fslib.verify_checksums(tmp, meta)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        return name
+
+    def discard_staged(self, name: str) -> None:
+        shutil.rmtree(os.path.join(self.path, name) + ".tmp",
+                      ignore_errors=True)
+
+    def publish_staged(self, name: str):
+        """Second half: durably publish a verified staged part and
+        register it in parts.json.  Returns the opened Part."""
+        final = os.path.join(self.path, name)
+        faultinject.fire("migrate:pre_publish")
+        fslib.rename_durable(final + ".tmp", final)
+        p = Part(final, trusted=True)  # checksums verified at staging
+        with self._lock:
+            self._file_parts.append(p)
+            self._write_parts_json_locked()
+        return p
+
+    def adopt_part(self, files: list[tuple[str, bytes]]):
+        """stage_part + publish_staged in one step (callers with no
+        interleaved state to commit)."""
+        return self.publish_staged(self.stage_part(files))
+
+    def remove_parts(self, names: list[str]) -> int:
+        """Delist + delete finalized parts (the source side of a part
+        migration, after the receiver's durable ack).  Parts merged
+        away since listing count as already gone.  Unlink only:
+        concurrent readers holding the old Part keep valid fds until
+        the last reference drops."""
+        wanted = set(names)
+        with self._flush_mutex:
+            with self._lock:
+                victims = [p for p in self._file_parts
+                           if os.path.basename(p.path) in wanted]
+                if victims:
+                    self._file_parts = [p for p in self._file_parts
+                                        if p not in victims]
+                    self._write_parts_json_locked()
+            for p in victims:
+                shutil.rmtree(p.path, ignore_errors=True)
+        return len(victims)
+
     # -- snapshots ---------------------------------------------------------
 
     def snapshot_to(self, dst: str):

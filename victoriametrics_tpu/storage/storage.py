@@ -321,6 +321,19 @@ class Storage:
         self.metadata: dict[str, dict] = {}
         from ..query.rollup_result_cache import next_storage_token
         self.cache_token = next_storage_token()
+        # series this node must ALWAYS serve regardless of ring
+        # ownership (parallel/ringfilter): adopted via part migration or
+        # landed here by a write reroute — this node may hold the only
+        # copy of some of their samples.  Persisted (append-only) so a
+        # restart keeps serving them.
+        self._ring_exempt: set[bytes] = set()
+        self._ring_exempt_lock = make_lock("storage.Storage._ring_exempt")
+        self._load_ring_exempt()
+        # adopted-foreign-id watermark: the id generator's restart
+        # uniqueness comes from nanotime reseeding, which only covers
+        # LOCALLY generated ids — ids adopted from a clock-ahead node
+        # must stay reserved across restarts too
+        self._load_adopted_watermark()
         self._load_caches()
         # long-lived service timer, not hot-path fan-out: it owns the
         # periodic flush cadence and is joined cleanly in close() (the
@@ -366,9 +379,10 @@ class Storage:
         self.idb.flush()
         self.table.close()
         self.idb.close()
-        for sp in self._cspaces.values():
+        with self._lock:
+            spaces, self._cspaces = self._cspaces, {}
+        for sp in spaces.values():
             sp.close()
-        self._cspaces = {}
         fcntl.flock(self._flock_f, fcntl.LOCK_UN)
         self._flock_f.close()
 
@@ -1570,9 +1584,216 @@ class Storage:
                 lambda k, t: t.metric_id not in dead)
             # AFTER the tombstones land: a racing query that fetched the
             # old data keys its tile under the pre-delete version
-            self.data_version += 1
-            self.structural_version += 1
+            with self._lock:
+                self.data_version += 1
+                self.structural_version += 1
         return int(mids.size)
+
+    # -- live resharding (part migration + ring-ownership exemptions) ------
+
+    #: this backend holds ring-placed data, so it honors (and acks) the
+    #: ring-ownership read filter shipped by vmselects — a multilevel
+    #: ClusterStorage backend does not (see parallel/ringfilter)
+    supports_ring_filter = True
+
+    @property
+    def ring_exempt_names(self) -> set[bytes]:
+        """Canonical marshals exempt from ring-ownership filtering.
+        Append-only for the process lifetime — handlers may read it
+        without the lock."""
+        return self._ring_exempt
+
+    def _ring_exempt_path(self) -> str:
+        return os.path.join(self.path, "ring_exempt.bin")
+
+    def _load_ring_exempt(self) -> None:
+        from ..ops.varint import unmarshal_varuint64
+        try:
+            with open(self._ring_exempt_path(), "rb") as f:
+                data = f.read()
+        except OSError:
+            return
+        off = 0
+        try:
+            while off < len(data):
+                n, off = unmarshal_varuint64(data, off)
+                if off + n > len(data):
+                    break  # torn tail append: keep the complete prefix
+                self._ring_exempt.add(data[off:off + n])
+                off += n
+        except (ValueError, IndexError):
+            pass  # torn record: the loaded prefix still serves
+
+    def add_ring_exempt_names(self, raws) -> int:
+        """Mark canonical metric-name marshals as always-served (write
+        reroutes, adopted parts).  Returns how many were new."""
+        from ..ops.varint import marshal_varuint64
+        with self._ring_exempt_lock:
+            fresh = [r for r in raws if r not in self._ring_exempt]
+            if not fresh:
+                return 0
+            # the durable append IS the critical section: the in-memory
+            # publish must be ordered after it, and concurrent appends
+            # to one file must serialize (reroutes/adoptions are rare —
+            # never a hot path)
+            with open(self._ring_exempt_path(),  # vmt: disable=VMT004
+                      "ab") as f:
+                for r in fresh:
+                    f.write(marshal_varuint64(len(r)) + r)
+                f.flush()
+                os.fsync(f.fileno())
+            # publish AFTER the durable append: a crash between the two
+            # re-derives the entries from the next reroute/adoption
+            self._ring_exempt.update(fresh)
+        return len(fresh)
+
+    def _adopted_watermark_path(self) -> str:
+        return os.path.join(self.path, "adopted_mid.json")
+
+    def _load_adopted_watermark(self) -> None:
+        import json as _json
+        try:
+            with open(self._adopted_watermark_path()) as f:
+                self._mid_gen.reserve_past(int(_json.load(f)["max"]))
+        except (OSError, ValueError, KeyError, TypeError):
+            pass  # no adoptions yet (or torn write: adoption re-writes)
+
+    def _persist_adopted_watermark(self, max_id: int) -> None:
+        """Durably record the highest adopted foreign metric_id (only
+        ratchets upward) so reserve_past survives restarts."""
+        import json as _json
+
+        # rare path (one write per adoption batch); the file I/O IS the
+        # critical section — the ratchet check and the durable replace
+        # must not interleave between concurrent adoptions
+        with self._ring_exempt_lock:
+            try:
+                with open(  # vmt: disable=VMT004 — see above
+                        self._adopted_watermark_path()) as f:
+                    if int(_json.load(f)["max"]) >= max_id:
+                        return
+            except (OSError, ValueError, KeyError, TypeError):
+                pass
+            from ..utils import fs as fslib
+            tmp = self._adopted_watermark_path() + ".tmp"
+            with open(tmp, "w") as f:  # vmt: disable=VMT004 — see above
+                _json.dump({"max": int(max_id)}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            fslib.rename_durable(tmp, self._adopted_watermark_path())
+
+    def list_file_parts(self) -> list[dict]:
+        """Migration inventory: every finalized part across partitions."""
+        return self.table.list_file_parts()
+
+    def export_part(self, partition: str, part: str):
+        """One finalized part as transferable state: (files as
+        [(name, bytes)], series registrations as [(tsid_marshal,
+        name_marshal)], meta dict).  Raises KeyError when the part was
+        merged away since listing (callers re-list and retry)."""
+        pt = self.table.partition_by_name(partition)
+        p = pt.get_file_part(part) if pt is not None else None
+        if p is None:
+            raise KeyError(f"part {partition}/{part} not found "
+                           f"(merged away since listing?)")
+        files = []
+        for fname in sorted(os.listdir(p.path)):
+            with open(os.path.join(p.path, fname), "rb") as f:
+                files.append((fname, f.read()))
+        entries = []
+        for t in p.unique_tsids():
+            got = self.idb.get_metric_name_raw_by_id(t.metric_id)
+            if got is not None:
+                entries.append((t.marshal(), got[1]))
+        meta = {"partition": partition, "part": part, "rows": int(p.rows),
+                "bytes": p.file_bytes(), "min_ts": int(p.min_ts),
+                "max_ts": int(p.max_ts)}
+        return files, entries, meta
+
+    def adopt_series(self, entries, min_ts=None, max_ts=None) -> int:
+        """Register series shipped alongside a migrated part UNDER THEIR
+        FOREIGN metric_ids (ids are node-local counters, so the part's
+        blocks are unreadable without this).  A colliding id bound to a
+        DIFFERENT name rejects the whole adoption — the driver leaves
+        the part on its source node.  Per-day indexes are registered for
+        every day of the part's span (over-inclusive is harmless: the
+        per-day index is a pruning filter, and a part spans at most its
+        monthly partition)."""
+        from .index_db import MS_PER_DAY
+        fresh = []
+        for tsid_b, raw in entries:
+            t = TSID.unmarshal(tsid_b)
+            got = self.idb.get_metric_name_raw_by_id(t.metric_id)
+            if got is not None:
+                if got[1] != raw:
+                    raise ValueError(
+                        f"metric_id collision adopting series: id "
+                        f"{t.metric_id} is already bound to another name")
+                continue
+            self._mid_gen.reserve_past(t.metric_id)
+            fresh.append((MetricName.unmarshal(raw), t))
+        if fresh:
+            # durable BEFORE the index registrations land: a restart
+            # must never re-generate into the adopted id range
+            self._persist_adopted_watermark(
+                max(t.metric_id for _, t in fresh))
+        for mn, t in fresh:
+            self.idb.create_indexes_for_metric(mn, t)
+        if min_ts is not None and max_ts is not None:
+            days = range(int(min_ts) // MS_PER_DAY,
+                         int(max_ts) // MS_PER_DAY + 1)
+            for mn, t in fresh:
+                for d in days:
+                    self.idb.create_per_day_indexes(mn, t, d)
+        return len(fresh)
+
+    def adopt_part(self, partition: str, files, entries,
+                   min_ts=None, max_ts=None) -> tuple[int, int]:
+        """Adopt one migrated part.  Ordering: STAGE + crc-verify the
+        bytes first (a torn transfer must be rejected before any other
+        state lands — index registrations are not rolled back), then
+        register the series (reads of the adopted blocks must resolve
+        the moment the part is published), then durably publish and
+        exempt the series from ring filtering (this node may now hold
+        their only copy).  The heavy write runs under the MergeGate so
+        adoption yields to in-flight serving.  Returns (rows, bytes)."""
+        pt = self.table.partition_by_name(partition, create=True)
+        if pt is None:
+            raise ValueError(f"bad partition name {partition!r}")
+        with workpool.MERGE_GATE:
+            staged = pt.stage_part(files)
+            try:
+                self.adopt_series(entries, min_ts, max_ts)
+            except BaseException:
+                pt.discard_staged(staged)
+                raise
+            p = pt.publish_staged(staged)
+        self.add_ring_exempt_names([raw for _, raw in entries])
+        oldest = int(p.min_ts)
+        with self._lock:
+            self.rows_added += int(p.rows)
+            self.data_version += 1
+            log = self._append_log
+            if log.maxlen is not None and len(log) == log.maxlen:
+                self._append_log_floor = log[0][0]
+            # adopted parts carry OLD timestamps: record the append like
+            # a backfill so rolling device tiles rebuild instead of
+            # serving a stale suffix
+            log.append((self.data_version, oldest))
+        return int(p.rows), p.file_bytes()
+
+    def remove_parts(self, partition: str, names: list[str]) -> int:
+        """Source side of a part migration: delist + delete after the
+        receiver's durable ack."""
+        pt = self.table.partition_by_name(partition)
+        if pt is None:
+            return 0
+        n = pt.remove_parts(names)
+        if n:
+            with self._lock:
+                self.data_version += 1
+                self.structural_version += 1  # visible data moved away
+        return n
 
     # -- maintenance -------------------------------------------------------
 
@@ -1602,8 +1823,10 @@ class Storage:
                             if dk[1] < min_date}
                     shard.day_cache -= dead
         if n:
-            self.data_version += 1  # after the drop; no-op sweeps keep tiles
-            self.structural_version += 1
+            with self._lock:
+                # after the drop; no-op sweeps keep tiles
+                self.data_version += 1
+                self.structural_version += 1
         return n
 
     # -- snapshots ---------------------------------------------------------

@@ -215,6 +215,42 @@ class Table:
         for p in parts:
             p.snapshot_to(os.path.join(dst, p.name))
 
+    # -- live resharding (part migration) ----------------------------------
+
+    def list_file_parts(self) -> list[dict]:
+        """Migration inventory across every partition:
+        ``{partition, part, rows, bytes, min_ts, max_ts}`` rows."""
+        with self._lock:
+            parts = list(self._partitions.items())
+        out = []
+        for name, p in sorted(parts):
+            for row in p.list_file_parts():
+                out.append(dict(row, partition=name))
+        return out
+
+    @staticmethod
+    def is_partition_name(name: str) -> bool:
+        """Strictly YYYY_MM — the form partition_name_for_ts emits.
+        Anything else (in particular path-traversal bytes arriving in
+        a migratePart_v1 partition field) is rejected."""
+        return (len(name) == 7 and name[4] == "_" and
+                name[:4].isdigit() and name[5:7].isdigit())
+
+    def partition_by_name(self, name: str, create: bool = False):
+        """Partition lookup by month name (adoption targets use
+        create=True — the receiving node may not have the month yet).
+        Non-YYYY_MM names never create (and never resolve) a
+        partition: the name may come off the wire."""
+        if not self.is_partition_name(name):
+            return None
+        with self._lock:
+            p = self._partitions.get(name)
+            if p is None and create:
+                p = Partition(os.path.join(self.path, name), name,
+                              self.dedup_interval_ms)
+                self._partitions[name] = p
+            return p
+
     def quarantined(self) -> list[dict]:
         """Open-time integrity quarantines across every partition (the
         loud replacement for silently dropping unopenable parts)."""

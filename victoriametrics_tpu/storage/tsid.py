@@ -78,6 +78,14 @@ class MetricIDGenerator:
             self._next += 1
             return self._next
 
+    def reserve_past(self, metric_id: int) -> None:
+        """Advance the counter past a FOREIGN metric_id (series adopted
+        from another node via part migration): ids this node generates
+        later must never collide with ids it adopted."""
+        with self._lock:
+            if metric_id > self._next:
+                self._next = metric_id
+
 
 def generate_tsid(mn, metric_id: int, tenant=(0, 0)) -> TSID:
     """Derive the clustering hash fields from the metric name."""
