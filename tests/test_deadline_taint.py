@@ -1,0 +1,162 @@
+"""Deadline-taint pass tests (devtools/deadline_taint.py, rule VMT012).
+
+Fixture packages are synthesized in tmp_path so the pass runs against a
+known call graph: a serving entry (RPC dispatch dict) reaching a
+blocking primitive with no deadline seam on the path must be flagged
+with a witness chain; the budget-wrapped twin must be clean.  Also pins
+the runtime fix the pass forced: RPCClientPool's deadline-free acquire
+is bounded by VM_RPC_ACQUIRE_MAX_S instead of parking forever."""
+
+import textwrap
+import threading
+
+import pytest
+
+from victoriametrics_tpu.devtools import deadline_taint as dt
+from victoriametrics_tpu.parallel import rpc
+
+
+def _write_pkg(tmp_path, body: str):
+    d = tmp_path / "fixture_pkg"
+    d.mkdir()
+    (d / "srv.py").write_text(textwrap.dedent(body), encoding="utf-8")
+    return d
+
+
+# An RPC dispatch dict is recognized as a serving entry when it has
+# >= 3 "*_vN" string keys mapping to same-module handler names.
+_DISPATCH = """
+        HANDLERS = {
+            "a_v1": h_a,
+            "b_v1": h_b,
+            "c_v1": h_c,
+        }
+"""
+
+
+def test_blocking_call_behind_entry_is_flagged(tmp_path):
+    pkg = _write_pkg(tmp_path, """
+        import time
+
+        def helper():
+            time.sleep(0.5)
+
+        def h_a(r, w):
+            helper()
+
+        def h_b(r, w):
+            pass
+
+        def h_c(r, w):
+            pass
+    """ + _DISPATCH)
+    findings, _used = dt.run_pass(paths=[str(pkg)])
+    assert len(findings) == 1, findings
+    f = findings[0]
+    assert f.rule == dt.RULE_ID
+    assert "time.sleep" in f.message
+    # the witness chain names the entry handler and the helper
+    assert "h_a" in f.message and "helper" in f.message
+
+
+def test_deadline_seam_cuts_the_taint(tmp_path):
+    """settimeout() on the socket before recv makes the def a seam —
+    blocking below a seam is budgeted, not flagged."""
+    pkg = _write_pkg(tmp_path, """
+        import socket
+
+        def helper(s):
+            s.settimeout(2.0)
+            return s.recv(16)
+
+        def h_a(r, w):
+            helper(socket.socket())
+
+        def h_b(r, w):
+            pass
+
+        def h_c(r, w):
+            pass
+    """ + _DISPATCH)
+    findings, _used = dt.run_pass(paths=[str(pkg)])
+    assert findings == [], [f.message for f in findings]
+
+
+def test_suppressed_site_counts_as_used(tmp_path):
+    pkg = _write_pkg(tmp_path, """
+        import time
+
+        def h_a(r, w):
+            time.sleep(1)  # vmt: disable=VMT012
+
+        def h_b(r, w):
+            pass
+
+        def h_c(r, w):
+            pass
+    """ + _DISPATCH)
+    findings, used = dt.run_pass(paths=[str(pkg)])
+    assert findings == [], [f.message for f in findings]
+    # the disable comment is consumed -> VMT013 won't call it stale
+    (rel,) = used
+    assert any(rule == dt.RULE_ID for _ln, rule in used[rel])
+
+
+def test_unreachable_blocking_code_not_flagged(tmp_path):
+    """Blocking outside the entry closure (no caller path) is out of
+    scope for a *serving* latency pass."""
+    pkg = _write_pkg(tmp_path, """
+        import time
+
+        def offline_maintenance():
+            time.sleep(30)
+
+        def h_a(r, w):
+            pass
+
+        def h_b(r, w):
+            pass
+
+        def h_c(r, w):
+            pass
+    """ + _DISPATCH)
+    findings, _used = dt.run_pass(paths=[str(pkg)])
+    assert findings == [], [f.message for f in findings]
+
+
+def test_repo_tree_is_clean():
+    """The real tree carries ZERO baselined VMT012 findings — the pass
+    found real gaps and they were fixed, not suppressed wholesale."""
+    findings, _used = dt.run_pass()
+    assert findings == [], [f.message for f in findings]
+
+
+# -- the runtime fix VMT012 forced ------------------------------------------
+
+def test_pool_acquire_without_deadline_is_bounded(monkeypatch):
+    """Deadline-free RPCClientPool._acquire must not park forever on the
+    connection semaphore: it waits at most VM_RPC_ACQUIRE_MAX_S and then
+    raises a retryable RPCError (waited=False -> safe to reroute)."""
+    monkeypatch.setenv("VM_RPC_ACQUIRE_MAX_S", "0.05")
+    pool = rpc.RPCClientPool("127.0.0.1", 1, b"hello", max_conns=1)
+    assert pool._sem.acquire(timeout=1)  # wedge the only slot
+    try:
+        with pytest.raises(rpc.RPCError) as ei:
+            pool._acquire("writeRows_v1", 0.0)
+        assert not isinstance(ei.value, rpc.RPCDeadlineError)
+        assert ei.value.waited is False
+    finally:
+        pool._sem.release()
+
+
+def test_pool_acquire_with_deadline_raises_deadline_error(monkeypatch):
+    monkeypatch.setenv("VM_RPC_ACQUIRE_MAX_S", "5")
+    pool = rpc.RPCClientPool("127.0.0.1", 1, b"hello", max_conns=1)
+    assert pool._sem.acquire(timeout=1)
+    try:
+        import time
+        with pytest.raises(rpc.RPCDeadlineError) as ei:
+            pool._acquire("search_v1", time.monotonic() + 0.05)
+        assert ei.value.waited is False
+    finally:
+        pool._sem.release()

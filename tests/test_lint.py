@@ -272,3 +272,114 @@ def test_cli_lists_all_rules(capsys):
     out = capsys.readouterr().out
     for rid in sorted(FIXTURES):
         assert rid in out
+
+
+# -- VMT013: stale suppressions ---------------------------------------------
+
+def _ctxs_for(tmp_path, src):
+    mod = tmp_path / "mod.py"
+    mod.write_text(src, encoding="utf-8")
+    ctxs: list = []
+    findings = lint.lint_paths([str(mod)], collect_ctxs=ctxs)
+    return findings, ctxs
+
+
+def test_vmt013_flags_disable_that_silenced_nothing(tmp_path):
+    findings, ctxs = _ctxs_for(
+        tmp_path,
+        "import time\n"
+        "a = time.monotonic()  # vmt: disable=VMT001\n")
+    assert findings == []
+    stale = lint.stale_disable_findings(ctxs)
+    assert [(f.rule, f.line) for f in stale] == \
+        [(lint.STALE_DISABLE_RULE, 2)]
+    assert "VMT001" in stale[0].message
+
+
+def test_vmt013_quiet_when_disable_is_consumed(tmp_path):
+    findings, ctxs = _ctxs_for(
+        tmp_path,
+        "import time\n"
+        "a = time.time()  # vmt: disable=VMT001\n")
+    assert findings == []  # suppression ate the VMT001 finding
+    assert lint.stale_disable_findings(ctxs) == []
+
+
+def test_vmt013_ignores_disable_text_inside_strings(tmp_path):
+    """Suppressions come from real COMMENT tokens; a docstring that
+    *mentions* the syntax (e.g. the lint module's own docs) is inert —
+    neither a suppression nor a stale-suppression finding."""
+    findings, ctxs = _ctxs_for(
+        tmp_path,
+        '"""usage: add  # vmt: disable=VMT001  to the line."""\n'
+        "import time\n"
+        "a = time.time()\n")
+    assert [f.rule for f in findings] == ["VMT001"]  # NOT suppressed
+    assert lint.stale_disable_findings(ctxs) == []
+
+
+def test_vmt013_judges_only_rules_that_ran(tmp_path):
+    """A path-scoped lint run doesn't execute the program passes, so a
+    VMT012 disable can't be proven stale there — it must not be flagged
+    unless VMT012 is in ran_rules (or consumed via extra_used)."""
+    _findings, ctxs = _ctxs_for(
+        tmp_path,
+        "import time\n"
+        "time.sleep(1)  # vmt: disable=VMT012\n")
+    ran = {r.rule_id for r in lint.all_rules()}
+    assert lint.stale_disable_findings(ctxs, ran_rules=ran) == []
+    # when the pass DID run and consumed it, extra_used clears it too
+    rel = ctxs[0].rel_path
+    ran_all = ran | {"VMT012"}
+    assert lint.stale_disable_findings(
+        ctxs, extra_used={rel: {(2, "VMT012")}}, ran_rules=ran_all) == []
+    # ...and with the pass run but nothing consumed, it IS stale
+    stale = lint.stale_disable_findings(ctxs, ran_rules=ran_all)
+    assert [f.rule for f in stale] == [lint.STALE_DISABLE_RULE]
+
+
+# -- VMT014: env-flag inventory vs README -----------------------------------
+
+def test_vmt014_fires_on_undocumented_flag(tmp_path):
+    _findings, ctxs = _ctxs_for(
+        tmp_path,
+        "import os\n"
+        'w = os.environ.get("VM_NOT_DOCUMENTED_XYZ", "0")\n')
+    flagged = lint.env_flag_findings(ctxs)
+    assert [f.rule for f in flagged] == [lint.ENV_FLAG_RULE]
+    assert "VM_NOT_DOCUMENTED_XYZ" in flagged[0].message
+
+
+def test_vmt014_quiet_on_documented_flag(tmp_path):
+    _findings, ctxs = _ctxs_for(
+        tmp_path,
+        "import os\n"
+        'w = os.environ.get("VM_SEARCH_WORKERS", "0")\n')
+    assert lint.env_flag_findings(ctxs) == []
+
+
+def test_vmt014_rule_ids_do_not_look_like_flags():
+    """The flag regex must not mistake rule ids (VMT012) or prose tokens
+    for env flags."""
+    assert lint._FLAG_RE.match("VM_SEARCH_WORKERS")
+    assert lint._FLAG_RE.match("VMT_NO_CRASH_SMOKE")
+    assert not lint._FLAG_RE.match("VMT012")
+    assert not lint._FLAG_RE.match("VM_")
+    assert not lint._FLAG_RE.match("XVM_FOO")
+
+
+def test_package_flag_inventory_is_fully_documented():
+    """Every VM_*/VMT_* flag read anywhere in the package appears in
+    README.md's flag table (the VMT014 invariant, asserted directly)."""
+    ctxs: list = []
+    lint.lint_paths([os.path.join(lint.REPO_ROOT, "victoriametrics_tpu")],
+                    collect_ctxs=ctxs)
+    inv = set(lint.env_flag_inventory(ctxs))
+    undocumented = sorted(inv - lint.readme_flags())
+    assert undocumented == []
+
+
+def test_cli_list_flags(capsys):
+    assert lint.main(["--list-flags"]) == 0
+    out = capsys.readouterr().out
+    assert "VM_SEARCH_WORKERS" in out

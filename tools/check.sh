@@ -1,0 +1,109 @@
+#!/bin/sh
+# Unified pre-merge gate: every static pass, every overhead/integration
+# smoke, then the fast tier-1 test markers — one command, one exit code,
+# per-stage wall-clock timing so a slow stage is visible instead of
+# smeared into "CI is slow".
+#
+#   tools/check.sh            # run everything
+#   VMT_NO_TIER1=1 tools/check.sh   # static + smokes only
+#
+# Stages (each independently skippable, same flags tools/lint.sh uses):
+#   lint       full lint: per-file rules + call-graph passes (VMT012
+#              deadline taint, VMT013 stale disables, VMT014 env-flag
+#              inventory) + the wire-schema ratchet (exit 4 breaking /
+#              2 additive drift)            VMT_NO_LINT=1
+#   flight     flight-recorder overhead     VMT_NO_FLIGHT_SMOKE=1
+#   profile    continuous-profiler overhead VMT_NO_PROFILE_SMOKE=1
+#   matstream  materialized-stream fan-out  VMT_NO_MATSTREAM_SMOKE=1
+#   reshard    elastic scale-out reshard    VMT_NO_RESHARD_SMOKE=1
+#   device     8-device residency guard     VMT_NO_DEVICE_SMOKE=1
+#   crash      one crashpoint seam + reopen VMT_NO_CRASH_SMOKE=1
+#   tier1      pytest tests/ -m 'not slow'  VMT_NO_TIER1=1
+#
+# All stages run even after a failure (the summary shows every broken
+# stage, not just the first); the exit code is the first failing
+# stage's.
+set -u
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export JAX_PLATFORMS
+
+fail_rc=0
+summary=""
+
+run_stage() {
+    _name=$1
+    shift
+    _t0=$(date +%s)
+    if "$@"; then
+        _st=ok
+    else
+        _rc=$?
+        _st="FAIL(rc=$_rc)"
+        [ "$fail_rc" -eq 0 ] && fail_rc=$_rc
+    fi
+    _dt=$(( $(date +%s) - _t0 ))
+    printf 'check: %-9s %-12s %4ds\n' "$_name" "$_st" "$_dt"
+    summary="$summary
+  $_name: $_st (${_dt}s)"
+}
+
+skipped() {
+    printf 'check: %-9s %-12s\n' "$1" skipped
+    summary="$summary
+  $1: skipped"
+}
+
+if [ "${VMT_NO_LINT:-0}" != "1" ]; then
+    run_stage lint python -m victoriametrics_tpu.devtools.lint
+else
+    skipped lint
+fi
+if [ "${VMT_NO_FLIGHT_SMOKE:-0}" != "1" ]; then
+    run_stage flight python -m victoriametrics_tpu.devtools.flight_overhead
+else
+    skipped flight
+fi
+if [ "${VMT_NO_PROFILE_SMOKE:-0}" != "1" ]; then
+    run_stage profile python -m victoriametrics_tpu.devtools.profile_overhead
+else
+    skipped profile
+fi
+if [ "${VMT_NO_MATSTREAM_SMOKE:-0}" != "1" ]; then
+    run_stage matstream \
+        python -m victoriametrics_tpu.devtools.matstream_overhead
+else
+    skipped matstream
+fi
+if [ "${VMT_NO_RESHARD_SMOKE:-0}" != "1" ]; then
+    run_stage reshard python -m victoriametrics_tpu.devtools.reshard_smoke
+else
+    skipped reshard
+fi
+if [ "${VMT_NO_DEVICE_SMOKE:-0}" != "1" ]; then
+    run_stage device sh tools/device.sh \
+        "tests/test_device_residency.py::test_refresh_uploads_only_tail_on_mesh"
+else
+    skipped device
+fi
+if [ "${VMT_NO_CRASH_SMOKE:-0}" != "1" ]; then
+    run_stage crash python -m pytest \
+        "tests/test_crash_recovery.py::test_crashpoint_seam[part:finalize:pre_rename]" \
+        -q -p no:cacheprovider
+else
+    skipped crash
+fi
+if [ "${VMT_NO_TIER1:-0}" != "1" ]; then
+    run_stage tier1 python -m pytest tests/ -q -m "not slow" \
+        -p no:cacheprovider
+else
+    skipped tier1
+fi
+
+echo "check: summary$summary"
+if [ "$fail_rc" -ne 0 ]; then
+    echo "check: FAILED (exit $fail_rc)"
+else
+    echo "check: all stages passed"
+fi
+exit "$fail_rc"

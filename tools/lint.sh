@@ -26,6 +26,29 @@
 # VMT_NO_DEVICE_SMOKE=1 skips it.
 set -eu
 cd "$(dirname "$0")/.."
+# --changed-only: lint just the .py files that differ from the merge
+# base (VMT_CHANGED_BASE, default main) plus untracked ones — the fast
+# inner loop while editing.  Path-scoped runs skip the program passes
+# (call-graph/wireschema/deadline-taint need the whole package) and the
+# smokes; the full gate is tools/check.sh.
+if [ "${1:-}" = "--changed-only" ]; then
+    shift
+    base=$(git merge-base HEAD "${VMT_CHANGED_BASE:-main}" 2>/dev/null \
+           || git rev-parse HEAD)
+    changed=$( { git diff --name-only "$base" -- '*.py';
+                 git ls-files --others --exclude-standard -- '*.py'; } \
+               | sort -u)
+    files=""
+    for f in $changed; do
+        [ -f "$f" ] && files="$files $f"
+    done
+    if [ -z "$files" ]; then
+        echo "lint: no changed .py files vs $(git rev-parse --short "$base")"
+        exit 0
+    fi
+    # shellcheck disable=SC2086
+    exec python -m victoriametrics_tpu.devtools.lint $files "$@"
+fi
 if [ "$#" -eq 0 ]; then
     set -- victoriametrics_tpu/
 fi

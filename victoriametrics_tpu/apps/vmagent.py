@@ -25,7 +25,7 @@ from ..ingest import remote_write
 from ..ingest.parsers import parse_prometheus
 from ..ingest.persistentqueue import PersistentQueue
 from ..ingest.relabel import parse_relabel_configs
-from ..utils import logger
+from ..utils import fasttime, logger
 
 MAX_ROWS_PER_BLOCK = 10_000
 
@@ -151,7 +151,7 @@ class ScrapeTarget:
             # series AND the auto metrics stale so queries stop extending
             # them (the last scrape may have failed, so _prev can be empty
             # while up=0 etc are still live)
-            now_ms = int(time.time() * 1000)
+            now_ms = fasttime.unix_ms()
             from ..ops.decimal import STALE_NAN
             rows = [(labels, now_ms, STALE_NAN)
                     for labels in self._prev.values()]
@@ -167,9 +167,9 @@ class ScrapeTarget:
         if self._stop.wait(random.random() * self.interval_s):
             return
         while True:
-            t0 = time.time()
+            t0 = fasttime.unix_seconds()
             self._scrape_once()
-            elapsed = time.time() - t0
+            elapsed = fasttime.unix_seconds() - t0
             if self._stop.wait(max(self.interval_s - elapsed, 0.1)):
                 return
 
@@ -179,7 +179,7 @@ class ScrapeTarget:
 
     def _scrape_once(self):
         from ..ops.decimal import STALE_NAN
-        now_ms = int(time.time() * 1000)
+        now_ms = fasttime.unix_ms()
         rows = []
         cur: dict[int, dict] = {}
         up = 1.0
@@ -236,7 +236,7 @@ class ScrapeTarget:
             self._prev = {**self._prev, **cur}
             cur = {}
         dur = time.perf_counter() - t0
-        self.last_scrape = time.time()
+        self.last_scrape = fasttime.unix_seconds()
         self._scraped_once = True
         # staleness markers for series that vanished since the last scrape
         for key, labels in self._prev.items():

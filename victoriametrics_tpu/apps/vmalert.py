@@ -19,7 +19,7 @@ import time
 import urllib.parse
 import urllib.request
 
-from ..utils import logger
+from ..utils import fasttime, logger
 from ..utils import metrics as metricslib
 
 STATE_INACTIVE = "inactive"
@@ -124,7 +124,6 @@ class EngineDatasource:
         self.tenant = tenant
 
     def query(self, expr: str, ts: float | None = None) -> list[dict]:
-        from ..utils import fasttime
         ts_ms = fasttime.unix_ms() if ts is None else int(float(ts) * 1000)
         return self.api.matstreams.instant_vector(expr, ts_ms, self.tenant)
 
@@ -299,16 +298,17 @@ class Group:
         if self._stop.wait(random.random() * self.interval):
             return
         while True:
-            t0 = time.time()
+            t0 = fasttime.unix_seconds()
             try:
                 self.eval_once(t0)
             except Exception as e:  # pragma: no cover
                 logger.errorf("group %s eval: %s", self.name, e)
-            if self._stop.wait(max(self.interval - (time.time() - t0), 0.1)):
+            if self._stop.wait(max(self.interval -
+                                   (fasttime.unix_seconds() - t0), 0.1)):
                 return
 
     def restore(self, ds: Datasource, lookback_s: float = 86_400.0):
-        now = time.time()
+        now = fasttime.unix_seconds()
         for rule in self.rules:
             if isinstance(rule, AlertingRule):
                 rule.restore(ds, now, lookback_s)

@@ -1,0 +1,551 @@
+"""Project-wide AST-derived call graph (the whole-program substrate the
+PR-1/PR-3 per-function lint rules could never see).
+
+Every ``.py`` file under the package is parsed once; every function,
+method, nested function and lambda becomes a :class:`FuncDef` with a
+stable qualified name (``relpath::Class.method``, ``relpath::func``,
+``relpath::outer.inner``).  Call edges are resolved in decreasing order
+of confidence:
+
+1. **Lexical names** — local defs, enclosing-scope defs, module-level
+   defs, and imports (``from ..utils import fs as fslib`` makes
+   ``fslib.write_meta_json(...)`` resolve into ``utils/fs.py``).
+2. **self/cls methods** — ``self.m()`` resolves within the enclosing
+   class, then through project base classes.
+3. **Receiver-type hints** — parameter annotations (``x: RingConfig``),
+   local constructor assignments (``c = RPCClient(...)``), and
+   ``self.attr = ClassName(...)`` bindings collected from ``__init__``
+   (so ``self.insert.call(...)`` resolves through ``RPCClient``).
+4. **Attribute-name fallback** — ``storage.search_series(...)`` links to
+   every project class defining ``search_series`` when the name is
+   distinctive (few definers, not in the ubiquitous-name stoplist).
+   Duck-typed seams (the ``storage`` protocol) stay covered without
+   annotations; ``.get``/``.close``-style names never explode the graph.
+
+Concurrency edges are calls: ``threading.Thread(target=f)``,
+``POOL.run([partial(f, x) for ...])`` and ``pool.submit(f)`` all add an
+edge to ``f`` — work handed to a thread or the shared workpool still
+runs on behalf of the submitting path, which is exactly what the
+deadline-taint pass (VMT012) needs to see.
+
+Consumers: :mod:`devtools.deadline_taint` (serving-path blocking-call
+reachability) and :mod:`devtools.wireschema` (marshal/unmarshal helper
+resolution).  Build cost is one AST parse per file (~100 files, well
+under a second) — cheap enough for every full lint run.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from .lint import dotted_name, iter_py_files, normalize_path
+
+#: attribute names too generic to resolve by name alone: linking every
+#: ``.get()`` to every class with a ``get`` method would connect the
+#: whole graph through dict-shaped noise
+_GENERIC_ATTRS = {
+    "get", "put", "items", "keys", "values", "append", "extend", "add",
+    "pop", "remove", "clear", "copy", "update", "setdefault", "close",
+    "read", "write", "flush", "seek", "tell", "join", "split", "strip",
+    "encode", "decode", "sort", "sorted", "index", "count", "format",
+    "result", "wait", "acquire", "release", "send", "recv", "sendall",
+    "connect", "accept", "start", "stop", "run", "submit", "info",
+    "debug", "warning", "error", "sum", "min", "max", "mean", "all",
+    "any", "tobytes", "astype", "reshape", "item", "fire", "inc", "dec",
+    "set", "name", "startswith", "endswith", "lower", "upper", "replace",
+}
+
+#: max distinct project definers for attribute-name fallback resolution;
+#: past this the name is effectively generic and edges would be noise
+_MAX_ATTR_CANDIDATES = 8
+
+
+@dataclasses.dataclass
+class FuncDef:
+    qname: str                  # "relpath::Class.method" / "relpath::func"
+    rel_path: str
+    name: str                   # bare name ("method", "func", "<lambda>")
+    cls: str | None             # enclosing class name, if any
+    node: object                # ast.FunctionDef/AsyncFunctionDef/Lambda
+    lineno: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    target: str                 # callee qname
+    lineno: int
+    kind: str                   # "call" | "thread" | "submit" | "ref"
+
+
+class CallGraph:
+    def __init__(self):
+        self.defs: dict[str, FuncDef] = {}
+        #: attr/method name -> qnames of project defs with that name
+        self.by_name: dict[str, list[str]] = {}
+        self.edges: dict[str, list[Edge]] = {}
+        #: class qname ("relpath::Class") -> list of base-class qnames
+        self.bases: dict[str, list[str]] = {}
+        #: class qname -> {method name -> qname}
+        self.methods: dict[str, dict[str, str]] = {}
+        #: (relpath, local dotted alias) -> target, for module aliases
+        self._imports: dict[tuple[str, str], str] = {}
+        #: "relpath::Class" -> {attr -> class qname} from __init__ hints
+        self._attr_types: dict[str, dict[str, str]] = {}
+        #: module rel_path -> {top-level def/class name -> qname}
+        self._module_scope: dict[str, dict[str, str]] = {}
+        #: rel_path -> module ast (for passes that re-walk, e.g. wireschema)
+        self.module_trees: dict[str, object] = {}
+        self.sources: dict[str, str] = {}
+
+    # -- queries ----------------------------------------------------------
+
+    def callees(self, qname: str) -> list[Edge]:
+        return self.edges.get(qname, [])
+
+    def lookup(self, rel_path: str, dotted: str) -> str | None:
+        """Resolve a dotted name as seen from ``rel_path`` module scope
+        (``Class.method``, ``func``, imported ``mod.func``)."""
+        scope = self._module_scope.get(rel_path, {})
+        head, _, rest = dotted.partition(".")
+        q = scope.get(head)
+        if q is None:
+            # `from mod import Name` binding
+            bound = self._imports.get((rel_path, head + "@from"))
+            if bound is not None:
+                tgt_rel, _, tgt_name = bound.partition("::")
+                q = self._module_scope.get(tgt_rel, {}).get(tgt_name)
+            if q is None:
+                return self._resolve_import(rel_path, dotted)
+        if not rest:
+            return q
+        # Class.method within this module
+        m = self.methods.get(q, {})
+        return m.get(rest)
+
+    def class_method(self, cls_qname: str, method: str) -> str | None:
+        """Resolve a method through the project class hierarchy."""
+        seen = set()
+        stack = [cls_qname]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            got = self.methods.get(c, {}).get(method)
+            if got is not None:
+                return got
+            stack.extend(self.bases.get(c, []))
+        return None
+
+    def reachable(self, entries, stop=frozenset()) -> set[str]:
+        """Qnames reachable from ``entries`` without descending INTO any
+        function in ``stop`` (the deadline-aware wrapper seams)."""
+        seen: set[str] = set()
+        stack = [q for q in entries if q in self.defs]
+        while stack:
+            q = stack.pop()
+            if q in seen or q in stop:
+                continue
+            seen.add(q)
+            for e in self.edges.get(q, ()):
+                if e.target not in seen and e.target not in stop:
+                    stack.append(e.target)
+        return seen
+
+    def _resolve_import(self, rel_path: str, dotted: str) -> str | None:
+        """``alias.func`` where alias is an imported module."""
+        head, _, rest = dotted.partition(".")
+        target = self._imports.get((rel_path, head))
+        if target is None or not rest:
+            return None
+        # target is a module rel_path; rest may be func or Class.method
+        first, _, tail = rest.partition(".")
+        scope = self._module_scope.get(target, {})
+        q = scope.get(first)
+        if q is None:
+            return None
+        if not tail:
+            return q
+        return self.methods.get(q, {}).get(tail)
+
+
+# -- builder ----------------------------------------------------------------
+
+def _module_rel(pkg_root: str, module: str, cur_rel: str,
+                level: int) -> str | None:
+    """Rel-path of an imported module inside the package, else None."""
+    if level:  # relative import: anchor at the current module's package
+        base = cur_rel.rsplit("/", 1)[0]
+        for _ in range(level - 1):
+            base = base.rsplit("/", 1)[0] if "/" in base else ""
+        parts = ([base] if base else []) + \
+            ([p for p in module.split(".")] if module else [])
+        dotted = "/".join(p for p in parts if p)
+    else:
+        dotted = module.replace(".", "/") if module else ""
+    if not dotted:
+        return None
+    for cand in (dotted + ".py", dotted + "/__init__.py"):
+        if os.path.exists(os.path.join(pkg_root, cand)):
+            return cand
+    return None
+
+
+class _ModuleIndexer(ast.NodeVisitor):
+    """Pass 1: defs, classes, imports, __init__ attr-type hints."""
+
+    def __init__(self, g: CallGraph, rel: str, repo_root: str):
+        self.g = g
+        self.rel = rel
+        self.repo_root = repo_root
+        self.scope: list[str] = []       # qname parts under the module
+        self.cls_stack: list[str] = []   # class qnames
+
+    def _q(self, name: str) -> str:
+        return f"{self.rel}::" + ".".join(self.scope + [name])
+
+    def _add_def(self, node, name: str):
+        q = self._q(name)
+        cls = self.cls_stack[-1].split("::")[-1] if self.cls_stack else None
+        fd = FuncDef(q, self.rel, name, cls, node, node.lineno)
+        self.g.defs[q] = fd
+        self.g.by_name.setdefault(name, []).append(q)
+        if self.cls_stack and len(self.scope) == 1:
+            self.g.methods.setdefault(self.cls_stack[-1], {})[name] = q
+        if not self.scope:
+            self.g._module_scope.setdefault(self.rel, {})[name] = q
+        return q
+
+    def visit_FunctionDef(self, node):
+        self._add_def(node, node.name)
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._add_def(node, f"<lambda@{node.lineno}>")
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        q = f"{self.rel}::{node.name}"
+        if not self.scope:
+            self.g._module_scope.setdefault(self.rel, {})[node.name] = q
+            self.g.by_name.setdefault(node.name, []).append(q)
+        self.g.methods.setdefault(q, {})
+        self.cls_stack.append(q)
+        self.scope.append(node.name)
+        # base names resolved in pass 2 (they may be imports)
+        self.g.bases.setdefault(q, [])
+        for b in node.bases:
+            dn = dotted_name(b)
+            if dn:
+                self.g.bases[q].append(f"?{self.rel}?{dn}")
+        self.generic_visit(node)
+        self.scope.pop()
+        self.cls_stack.pop()
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            tgt = _module_rel(self.repo_root, alias.name, self.rel, 0)
+            if tgt:
+                local = alias.asname or alias.name.split(".")[0]
+                self.g._imports[(self.rel, local)] = tgt
+
+    def visit_ImportFrom(self, node):
+        mod_rel = _module_rel(self.repo_root, node.module or "", self.rel,
+                              node.level)
+        if mod_rel is None:
+            return
+        for alias in node.names:
+            local = alias.asname or alias.name
+            # imported def/class: alias directly into module scope later
+            # (pass 2 may need it before the target module is indexed,
+            # so record as a deferred import binding)
+            sub = _module_rel(self.repo_root, (node.module or "") + "." +
+                              alias.name, self.rel, node.level)
+            if sub is not None:   # `from ..pkg import module`
+                self.g._imports[(self.rel, local)] = sub
+            else:                 # `from ..pkg.module import name`
+                self.g._imports[(self.rel, local + "@from")] = \
+                    mod_rel + "::" + alias.name
+
+
+class _EdgeBuilder:
+    """Pass 2: call edges for every def."""
+
+    def __init__(self, g: CallGraph, rel: str):
+        self.g = g
+        self.rel = rel
+
+    def _resolve_name(self, name: str, scope_defs: list[dict]) -> str | None:
+        for frame in reversed(scope_defs):
+            if name in frame:
+                return frame[name]
+        q = self.g._module_scope.get(self.rel, {}).get(name)
+        if q is not None:
+            return q
+        # `from mod import name` binding
+        bound = self.g._imports.get((self.rel, name + "@from"))
+        if bound is not None:
+            tgt_rel, _, tgt_name = bound.partition("::")
+            return self.g._module_scope.get(tgt_rel, {}).get(tgt_name)
+        return None
+
+    def _resolve_dotted(self, dn: str, scope_defs, cls_q, types) -> \
+            list[str]:
+        """Candidate qnames for a dotted callee name."""
+        head, _, rest = dn.partition(".")
+        if not rest:
+            q = self._resolve_name(dn, scope_defs)
+            return [q] if q else []
+        if head in ("self", "cls") and cls_q:
+            if "." not in rest:
+                q = self.g.class_method(cls_q, rest)
+                if q:
+                    return [q]
+            else:  # self.attr.method(): __init__ type hints
+                attr, _, meth = rest.partition(".")
+                t = self.g._attr_types.get(cls_q, {}).get(attr)
+                if t and "." not in meth:
+                    q = self.g.class_method(t, meth)
+                    if q:
+                        return [q]
+                return self._by_attr_name(meth.rpartition(".")[2])
+            return self._by_attr_name(rest)
+        # typed local receiver
+        t = types.get(head)
+        if t is not None and "." not in rest:
+            q = self.g.class_method(t, rest)
+            if q:
+                return [q]
+        # imported module alias / module-scope class
+        q = self.g.lookup(self.rel, dn)
+        if q is not None:
+            return [q]
+        return self._by_attr_name(rest.rpartition(".")[2])
+
+    def _by_attr_name(self, name: str) -> list[str]:
+        if not name or name in _GENERIC_ATTRS:
+            return []
+        cands = [q for q in self.g.by_name.get(name, ())
+                 if self.g.defs.get(q) and self.g.defs[q].cls]
+        if 0 < len(cands) <= _MAX_ATTR_CANDIDATES:
+            return cands
+        return []
+
+    def _callable_refs(self, node) -> list[object]:
+        """Callable-reference expressions inside a submit/run argument:
+        bare names, ``partial(f, ...)``, list/comprehension elements."""
+        out = []
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            out.append(node)
+        elif isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn and dn.rpartition(".")[2] == "partial" and node.args:
+                out.extend(self._callable_refs(node.args[0]))
+        elif isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            for e in node.elts:
+                out.extend(self._callable_refs(e))
+        elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                               ast.SetComp)):
+            out.extend(self._callable_refs(node.elt))
+        elif isinstance(node, ast.Starred):
+            out.extend(self._callable_refs(node.value))
+        return out
+
+    def build(self, fd: FuncDef, scope_defs: list[dict], cls_q,
+              types: dict):
+        edges = self.g.edges.setdefault(fd.qname, [])
+        seen = set()
+
+        def add(q: str | None, lineno: int, kind: str):
+            if q and q != fd.qname and (q, kind) not in seen:
+                seen.add((q, kind))
+                edges.append(Edge(q, lineno, kind))
+
+        body = fd.node.body if not isinstance(fd.node, ast.Lambda) \
+            else [fd.node.body]
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs get their own edge sets
+            if isinstance(node, ast.Lambda):
+                continue
+            # local constructor type hints: x = ClassName(...)
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                dn = dotted_name(node.value.func)
+                if dn:
+                    tq = self.g.lookup(self.rel, dn) or \
+                        self._resolve_name(dn, scope_defs)
+                    if tq in self.g.methods:  # it's a class
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                types[t.id] = tq
+            if isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+                if dn:
+                    last = dn.rpartition(".")[2]
+                    if last == "Thread":
+                        for kw in node.keywords:
+                            if kw.arg == "target":
+                                for ref in self._callable_refs(kw.value):
+                                    rdn = dotted_name(ref)
+                                    if rdn:
+                                        for q in self._resolve_dotted(
+                                                rdn, scope_defs, cls_q,
+                                                types):
+                                            add(q, node.lineno, "thread")
+                    elif last in ("submit", "run") and \
+                            isinstance(node.func, ast.Attribute):
+                        for a in list(node.args):
+                            for ref in self._callable_refs(a):
+                                rdn = dotted_name(ref)
+                                if rdn:
+                                    for q in self._resolve_dotted(
+                                            rdn, scope_defs, cls_q, types):
+                                        add(q, node.lineno, "submit")
+                    for q in self._resolve_dotted(dn, scope_defs, cls_q,
+                                                  types):
+                        # constructor call -> edge to __init__
+                        if q in self.g.methods:
+                            q = self.g.methods[q].get("__init__")
+                        add(q, node.lineno, "call")
+                # callback handoff: a bare function name passed as an
+                # argument (``self._fan_stripes(by_shard, do_register)``)
+                # still runs on behalf of this caller — lexical
+                # resolution only, so dict/str arguments add no noise
+                for a in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    if isinstance(a, ast.Name):
+                        q = self._resolve_name(a.id, scope_defs)
+                        if q is not None and q in self.g.defs:
+                            add(q, node.lineno, "ref")
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _annotation_types(g: CallGraph, rel: str, node) -> dict[str, str]:
+    """Param-annotation receiver types (``x: RingConfig``)."""
+    types: dict[str, str] = {}
+    if isinstance(node, ast.Lambda):
+        return types
+    args = node.args
+    for a in list(args.args) + list(args.posonlyargs) + \
+            list(args.kwonlyargs):
+        if a.annotation is not None:
+            dn = dotted_name(a.annotation)
+            if dn is None and isinstance(a.annotation, ast.Constant) and \
+                    isinstance(a.annotation.value, str):
+                dn = a.annotation.value.strip("'\" ").split("|")[0].strip()
+            if dn:
+                q = g.lookup(rel, dn)
+                if q in g.methods:
+                    types[a.arg] = q
+    return types
+
+
+def _collect_attr_types(g: CallGraph):
+    """``self.attr = ClassName(...)`` hints from every method (the
+    ``__init__``-heavy case plus lazy constructions elsewhere)."""
+    for cls_q, methods in g.methods.items():
+        hints = g._attr_types.setdefault(cls_q, {})
+        for mq in methods.values():
+            fd = g.defs.get(mq)
+            if fd is None or isinstance(fd.node, ast.Lambda):
+                continue
+            for node in ast.walk(fd.node):
+                if not (isinstance(node, ast.Assign) and
+                        isinstance(node.value, ast.Call)):
+                    continue
+                dn = dotted_name(node.value.func)
+                if not dn:
+                    continue
+                tq = g.lookup(fd.rel_path, dn)
+                if tq not in g.methods:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        hints.setdefault(t.attr, tq)
+
+
+def _resolve_bases(g: CallGraph):
+    for cls_q, bases in g.bases.items():
+        out = []
+        for b in bases:
+            if b.startswith("?"):
+                _, rel, dn = b.split("?", 2)
+                q = g.lookup(rel, dn)
+                if q in g.methods:
+                    out.append(q)
+            elif b in g.methods:
+                out.append(b)
+        g.bases[cls_q] = out
+
+
+def build_callgraph(paths, repo_root: str | None = None) -> CallGraph:
+    """Build the graph over every ``.py`` file under ``paths``."""
+    from .lint import REPO_ROOT
+    repo_root = repo_root or REPO_ROOT
+    g = CallGraph()
+    trees: list[tuple[str, object]] = []
+    for path in iter_py_files(paths):
+        rel = normalize_path(path)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError):
+            continue
+        g.sources[rel] = src
+        g.module_trees[rel] = tree
+        trees.append((rel, tree))
+        _ModuleIndexer(g, rel, repo_root).visit(tree)
+    _resolve_bases(g)
+    _collect_attr_types(g)
+    for rel, tree in trees:
+        eb = _EdgeBuilder(g, rel)
+
+        # walk defs with their lexical scope chains; `scope_names` is the
+        # dotted path OF `node` (empty for the module), so a def is built
+        # against frames that include its OWN nested defs — h_search can
+        # call its local `frames()` helper and POOL.run list-comps over
+        # nested workers resolve
+        def walk(node, scope_defs, scope_names, cls_q):
+            local: dict[str, str] = {}
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    local[child.name] = f"{rel}::" + ".".join(
+                        scope_names + [child.name])
+            frames = scope_defs + [local]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fd = g.defs.get(f"{rel}::" + ".".join(scope_names))
+                if fd is not None:
+                    eb.build(fd, frames, cls_q,
+                             _annotation_types(g, rel, node))
+            elif isinstance(node, ast.Lambda):
+                fd = g.defs.get(f"{rel}::" + ".".join(scope_names))
+                if fd is not None:
+                    eb.build(fd, frames, cls_q, {})
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    walk(child, frames, scope_names + [child.name], cls_q)
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, frames, scope_names + [child.name],
+                         f"{rel}::{child.name}")
+                elif isinstance(child, ast.Lambda):
+                    walk(child, frames,
+                         scope_names + [f"<lambda@{child.lineno}>"],
+                         cls_q)
+                else:
+                    walk(child, frames, scope_names, cls_q)
+        walk(tree, [], [], None)
+    return g

@@ -189,7 +189,9 @@ def fire(point: str) -> None:
             continue
         _injections(f.point, f.action).inc()
         if f.action in ("delay", "stall"):
-            time.sleep(f.param_ms / 1e3)
+            # the injected stall IS the configured fault: an operator
+            # armed VM_FAULTS to model exactly this hang
+            time.sleep(f.param_ms / 1e3)  # vmt: disable=VMT012
         elif f.action == "error":
             raise InjectedError(
                 f"injected fault at {point} (devtools/faultinject)")

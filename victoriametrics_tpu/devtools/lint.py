@@ -33,9 +33,11 @@ from __future__ import annotations
 import argparse
 import ast
 import dataclasses
+import io
 import os
 import re
 import sys
+import tokenize
 from collections import Counter
 
 _DEVTOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -84,20 +86,35 @@ class FileContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
-        # line -> set of rule ids disabled on that line
+        # line -> set of rule ids disabled on that line.  Only REAL
+        # comment tokens count: a disable spelled inside a docstring or
+        # string literal (rule documentation, examples) is inert
         self.suppressed: dict[int, set[str]] = {}
-        for i, line in enumerate(self.lines, start=1):
-            m = _SUPPRESS_RE.search(line)
-            if m:
-                ids = {s.strip().upper() for s in m.group(1).split(",")}
-                self.suppressed[i] = {s for s in ids if s}
+        # (line, rule) pairs whose disable comment actually silenced a
+        # finding this run — VMT013 flags the ones that never fire
+        self.used_suppressions: set[tuple[int, str]] = set()
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    ids = {s.strip().upper()
+                           for s in m.group(1).split(",")}
+                    self.suppressed[tok.start[0]] = {s for s in ids if s}
+        except tokenize.TokenError:  # parsed fine; tolerate odd tails
+            pass
 
     def finding(self, node, rule: str, message: str) -> Finding:
         line = getattr(node, "lineno", 0) if not isinstance(node, int) else node
         return Finding(self.rel_path, line, rule, message)
 
     def is_suppressed(self, f: Finding) -> bool:
-        return f.rule in self.suppressed.get(f.line, ())
+        if f.rule in self.suppressed.get(f.line, ()):
+            self.used_suppressions.add((f.line, f.rule))
+            return True
+        return False
 
 
 def all_rules() -> list:
@@ -136,7 +153,11 @@ def lint_source(source: str, path: str = "<string>",
     return sorted(out, key=lambda f: (f.path, f.line, f.rule))
 
 
-def lint_paths(paths, rules=None) -> list[Finding]:
+def lint_paths(paths, rules=None,
+               collect_ctxs: list | None = None) -> list[Finding]:
+    """Lint files/dirs.  ``collect_ctxs`` (when a list) receives every
+    successfully-parsed :class:`FileContext` — the whole-program checks
+    (VMT013/VMT014) reuse them instead of re-parsing."""
     findings: list[Finding] = []
     for path in iter_py_files(paths):
         try:
@@ -146,11 +167,112 @@ def lint_paths(paths, rules=None) -> list[Finding]:
             print(f"lint: cannot read {path}: {e}", file=sys.stderr)
             continue
         try:
-            findings.extend(lint_source(src, path, rules))
+            ctx = FileContext(path, src)
         except SyntaxError as e:
             findings.append(Finding(normalize_path(path), e.lineno or 0,
                                     "VMT000", f"syntax error: {e.msg}"))
-    return findings
+            continue
+        if collect_ctxs is not None:
+            collect_ctxs.append(ctx)
+        for rule in rules if rules is not None else all_rules():
+            for f in rule.check(ctx):
+                if not ctx.is_suppressed(f):
+                    findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+# -- whole-program checks (need every file, or files outside the lint) ------
+
+STALE_DISABLE_RULE = "VMT013"
+ENV_FLAG_RULE = "VMT014"
+
+#: an env-flag literal: VM_/VMT_ prefix then SCREAMING_SNAKE (rule ids
+#: like "VMT012" don't match — no underscore after the prefix)
+_FLAG_RE = re.compile(r"^VMT?_[A-Z][A-Z0-9_]*$")
+_README = os.path.join(REPO_ROOT, "README.md")
+
+
+def stale_disable_findings(ctxs, extra_used: dict | None = None,
+                           ran_rules: set | None = None) -> list[Finding]:
+    """VMT013: a ``# vmt: disable=X`` comment that silenced nothing.
+
+    Dead disables are worse than dead code — they LOOK like an active
+    exemption and will silently swallow the next real finding on that
+    line.  Only judged for rule ids that actually ran this invocation
+    (``ran_rules``); program-pass suppressions consumed outside the
+    per-file machinery arrive via ``extra_used``
+    (``{rel_path: {(line, rule), ...}}``)."""
+    if ran_rules is None:
+        ran_rules = {r.rule_id for r in all_rules()}
+    out = []
+    for ctx in ctxs:
+        used = set(ctx.used_suppressions)
+        if extra_used:
+            used |= extra_used.get(ctx.rel_path, set())
+        for line, rules in sorted(ctx.suppressed.items()):
+            for rule in sorted(rules):
+                if rule == STALE_DISABLE_RULE or rule not in ran_rules:
+                    continue
+                if (line, rule) not in used:
+                    f = Finding(
+                        ctx.rel_path, line, STALE_DISABLE_RULE,
+                        f"stale '# vmt: disable={rule}': {rule} no "
+                        f"longer fires here; drop the comment (it would "
+                        f"silently swallow the next real finding)")
+                    if not ctx.is_suppressed(f):
+                        out.append(f)
+    return out
+
+
+def readme_flags() -> set[str]:
+    """Every VM_*/VMT_* token mentioned anywhere in README.md."""
+    try:
+        with open(_README, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return set()
+    return {t for t in re.findall(r"\bVMT?_[A-Z][A-Z0-9_]*\b", text)
+            if _FLAG_RE.match(t)}
+
+
+def env_flag_inventory(ctxs) -> dict[str, list[tuple[str, int]]]:
+    """flag -> sorted (rel_path, line) occurrences, from string literals
+    in the code (docstrings/comments don't count: the regex anchors the
+    WHOLE constant, and only env-flag reads carry the bare token)."""
+    inv: dict[str, list[tuple[str, int]]] = {}
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _FLAG_RE.match(node.value):
+                inv.setdefault(node.value, []).append(
+                    (ctx.rel_path, node.lineno))
+    for locs in inv.values():
+        locs.sort()
+    return inv
+
+
+def env_flag_findings(ctxs) -> list[Finding]:
+    """VMT014: a VM_*/VMT_* flag read in code but absent from README.md.
+
+    The README flag table is the operator surface — a knob that isn't
+    in it effectively doesn't exist (nobody can discover it), and knobs
+    documented nowhere rot into booby traps.  One finding per flag, at
+    its first occurrence."""
+    documented = readme_flags()
+    by_rel = {ctx.rel_path: ctx for ctx in ctxs}
+    out = []
+    for flag, locs in sorted(env_flag_inventory(ctxs).items()):
+        if flag in documented:
+            continue
+        rel, line = locs[0]
+        f = Finding(rel, line, ENV_FLAG_RULE,
+                    f"env flag {flag} is read here but missing from "
+                    f"README.md's flag table; document it (or rename "
+                    f"it out of the VM_*/VMT_* namespace)")
+        if not by_rel[rel].is_suppressed(f):
+            out.append(f)
+    return out
 
 
 # -- baseline ---------------------------------------------------------------
@@ -233,16 +355,65 @@ def main(argv=None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from current findings")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--list-flags", action="store_true",
+                    help="print the VM_*/VMT_* env-flag inventory "
+                         "(flag -> read sites) and exit")
+    ap.add_argument("--no-program-passes", action="store_true",
+                    help="skip the whole-program passes (deadline taint, "
+                         "wire schema) on a full-package run")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for r in all_rules():
             print(f"{r.rule_id}  {r.summary}")
+        print(f"VMT012  blocking primitive reachable from a serving "
+              f"entry without a deadline seam (whole-program)")
+        print(f"{STALE_DISABLE_RULE}  stale '# vmt: disable=' comment "
+              f"that silences nothing (whole-program)")
+        print(f"{ENV_FLAG_RULE}  VM_*/VMT_* env flag read in code but "
+              f"missing from README.md (whole-program)")
         return 0
 
+    # the whole-program passes only make sense over the whole package:
+    # an explicit path list lints just those files (fast editor loop)
+    full_run = not args.paths
     paths = args.paths or [os.path.join(REPO_ROOT, "victoriametrics_tpu")]
     linted = {normalize_path(p) for p in iter_py_files(paths)}
-    findings = lint_paths(paths)
+    ctxs: list[FileContext] = []
+    findings = lint_paths(paths, collect_ctxs=ctxs)
+
+    if args.list_flags:
+        if not full_run:
+            ctxs = []
+            lint_paths([os.path.join(REPO_ROOT, "victoriametrics_tpu")],
+                       rules=[], collect_ctxs=ctxs)
+        documented = readme_flags()
+        for flag, locs in sorted(env_flag_inventory(ctxs).items()):
+            mark = " " if flag in documented else "!"
+            sites = ", ".join(f"{rel}:{line}" for rel, line in locs[:3])
+            if len(locs) > 3:
+                sites += f", +{len(locs) - 3} more"
+            print(f"{mark} {flag:32s} {sites}")
+        print(f"\n('!' = missing from README.md's flag table)")
+        return 0
+
+    ran_rules = {r.rule_id for r in all_rules()}
+    extra_used: dict[str, set] = {}
+    schema_exit = 0
+    if full_run:
+        findings.extend(env_flag_findings(ctxs))
+        ran_rules.add(ENV_FLAG_RULE)
+        if not args.no_program_passes:
+            from . import deadline_taint, wireschema
+            dt_findings, extra_used = deadline_taint.run_pass()
+            findings.extend(dt_findings)
+            ran_rules.add(deadline_taint.RULE_ID)
+            schema_exit, schema_msgs, _ = wireschema.check()
+            for m in schema_msgs:
+                print(f"wireschema: {m}", file=sys.stderr)
+        findings.extend(stale_disable_findings(ctxs, extra_used,
+                                               ran_rules))
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
 
     if args.update_baseline:
         write_baseline(args.baseline, findings, linted)
@@ -266,6 +437,11 @@ def main(argv=None) -> int:
               f"Fix, add '# vmt: disable=<RULE>' with a reason, or "
               f"--update-baseline if truly grandfathered.", file=sys.stderr)
         return 1
+    if schema_exit:
+        # wireschema's own message (breaking vs regenerate) already
+        # printed above; its exit codes (4 breaking, 2 additive-drift)
+        # are distinct from lint's 1/3
+        return schema_exit
     if stale:
         for rel, rule in stale:
             print(f"stale baseline entry: {rel}:{rule} no longer fires "

@@ -21,6 +21,7 @@ import time
 import numpy as np
 
 from ..storage.tag_filters import TagFilter
+from ..utils import fasttime
 from .server import HTTPServer, Request, Response
 
 
@@ -36,10 +37,10 @@ def parse_graphite_time(s: str, default_ms: int) -> int:
         return default_ms
     s = s.strip()
     if s == "now":
-        return int(time.time() * 1000)
+        return fasttime.unix_ms()
     m = _REL_RE.match(s)
     if m:
-        return int(time.time() * 1000) - \
+        return fasttime.unix_ms() - \
             int(m.group(1)) * _UNIT_S[m.group(2)] * 1000
     try:
         v = float(s)
@@ -286,7 +287,7 @@ class GraphiteAPI:
 
     def h_find_series(self, req: Request) -> Response:
         filters = [_tag_expr_filter(e) for e in req.args("expr")]
-        now = int(time.time() * 1000)
+        now = fasttime.unix_ms()
         names = self.storage.search_metric_names(
             filters, 0, now, tenant=_tenant(req))
         out = []
@@ -300,7 +301,7 @@ class GraphiteAPI:
     # -- render --------------------------------------------------------------
 
     def h_render(self, req: Request) -> Response:
-        now = int(time.time() * 1000)
+        now = fasttime.unix_ms()
         try:
             frm = parse_graphite_time(req.arg("from"), now - 3600_000)
             until = parse_graphite_time(req.arg("until"), now)

@@ -58,7 +58,7 @@ def parse_time(s: str, default_ms: int) -> int:
             ms, step_based = parse_duration_ms(s[1:])
             if not step_based and ms > 0:
                 return fasttime.unix_ms() - int(ms)
-        except Exception:
+        except ValueError:
             pass
     try:
         dt = datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
@@ -78,7 +78,7 @@ def parse_step(s: str, default_ms: int = 60_000) -> int:
         ms, step_based = parse_duration_ms(s)
         if not step_based and ms > 0:
             return int(ms)
-    except Exception:
+    except ValueError:
         pass
     raise QueryError(f"cannot parse step {s!r}")
 

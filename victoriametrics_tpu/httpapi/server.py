@@ -278,8 +278,9 @@ class HTTPServer:
 
     def start(self):
         self._started = True
-        self._thread = threading.Thread(target=self._srv.serve_forever,
-                                        daemon=True)
+        # long-lived HTTP accept loop, one per server — not fan-out work
+        self._thread = threading.Thread(  # vmt: disable=VMT011
+            target=self._srv.serve_forever, daemon=True)
         self._thread.start()
         logger.infof("http server listening on %s:%d", self.addr, self.port)
 

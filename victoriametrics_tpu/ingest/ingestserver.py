@@ -70,9 +70,12 @@ class IngestServer:
         self._tcp = TCP((addr, port), TCPHandler)
         self.port = self._tcp.server_address[1]
         self._udp = UDP((addr, self.port), UDPHandler)
+        # long-lived TCP/UDP accept loops, one each — not fan-out work
         self._threads = [
-            threading.Thread(target=self._tcp.serve_forever, daemon=True),
-            threading.Thread(target=self._udp.serve_forever, daemon=True),
+            threading.Thread(target=self._tcp.serve_forever,  # vmt: disable=VMT011
+                             daemon=True),
+            threading.Thread(target=self._udp.serve_forever,  # vmt: disable=VMT011
+                             daemon=True),
         ]
 
     def start(self):

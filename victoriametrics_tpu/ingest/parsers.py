@@ -34,7 +34,8 @@ class Row:
 
 
 def _now_ms() -> int:
-    return int(time.time() * 1000)
+    from ..utils import fasttime
+    return fasttime.unix_ms()
 
 
 # -- Prometheus text exposition ----------------------------------------------

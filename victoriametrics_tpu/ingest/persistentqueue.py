@@ -60,7 +60,7 @@ class PersistentQueue:
                        "read_off": self._read_off}, f)
         os.replace(tmp, self._meta_path)
 
-    def _open_write_chunk(self):
+    def _open_write_chunk_locked(self):
         if self._write_f is None:
             self._write_f = open(self._chunk_path(self._write_chunk), "ab")
         elif self._write_f.tell() >= CHUNK_MAX_BYTES:
@@ -69,7 +69,7 @@ class PersistentQueue:
             self._write_f = open(self._chunk_path(self._write_chunk), "ab")
 
     def _write_block_to_disk(self, block: bytes):
-        self._open_write_chunk()
+        self._open_write_chunk_locked()
         self._write_f.write(_U32.pack(len(block)) + block)
         self._write_f.flush()
 

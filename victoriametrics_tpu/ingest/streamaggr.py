@@ -314,8 +314,9 @@ class StreamAggregators:
 
     def start(self):
         for a in self.aggregators:
-            t = threading.Thread(target=self._flush_loop, args=(a,),
-                                 daemon=True)
+            # one long-lived flush ticker per aggregator — not fan-out
+            t = threading.Thread(  # vmt: disable=VMT011
+                target=self._flush_loop, args=(a,), daemon=True)
             t.start()
             self._threads.append(t)
 

@@ -693,7 +693,9 @@ class KeyMap:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except (AttributeError, TypeError, OSError):
+            # interpreter teardown: the ctypes lib handle may already
+            # be gone; __del__ must never raise
             pass
 
 

@@ -135,8 +135,8 @@ def remove_counter_resets(values: np.ndarray) -> np.ndarray:
             from .. import native as _native
             if _native.available():
                 return _native.counter_resets_2d(v)
-        except Exception:
-            pass
+        except (ImportError, OSError, AttributeError, ValueError):
+            pass  # any native-layer trouble falls back to the numpy path
     d = np.diff(v, axis=-1)
     prev = v[..., :-1]
     drop = np.where(d < 0, np.where(-d * 8 < prev, -d, prev), 0.0)

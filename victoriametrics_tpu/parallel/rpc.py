@@ -118,6 +118,18 @@ def _retry_policy() -> tuple[int, float, float]:
             max(_num("VM_RPC_BACKOFF_MAX_MS", 2000.0), 1.0) / 1e3)
 
 
+def _acquire_cap_s() -> float:
+    """Upper bound on waiting for a pooled connection when the call
+    carries NO deadline (insert-path calls): a pool whose connections
+    are all wedged behind a dead peer must surface as an error instead
+    of hanging the caller forever.  ``VM_RPC_ACQUIRE_MAX_S`` (default
+    60) — generous enough that real backpressure never trips it."""
+    try:
+        return float(os.environ.get("VM_RPC_ACQUIRE_MAX_S", "") or 60.0)
+    except ValueError:
+        return 60.0
+
+
 def _read_exact(sock_file, n: int) -> bytes:
     data = sock_file.read(n)
     if data is None or len(data) != n:
@@ -260,8 +272,9 @@ class RPCServer:
 
         self._srv = Srv((addr, port), Handler)
         self.port = self._srv.server_address[1]
-        self._thread = threading.Thread(target=self._srv.serve_forever,
-                                        daemon=True)
+        # long-lived RPC accept loop, one per server — not fan-out work
+        self._thread = threading.Thread(  # vmt: disable=VMT011
+            target=self._srv.serve_forever, daemon=True)
 
     def start(self):
         self._thread.start()
@@ -589,7 +602,17 @@ class RPCClientPool:
                 err.waited = False  # local capacity, not the node
                 raise err
         else:
-            self._sem.acquire()
+            # deadline-free (insert-path) calls still get a bounded
+            # wait: all-connections-wedged must fail loudly, not hang
+            if not self._sem.acquire(timeout=_acquire_cap_s()):
+                _rpc_counter("vm_rpc_client_pool_exhausted_total",
+                             method).inc()
+                err = RPCError(
+                    f"rpc {method} to {self.addr[0]}:{self.addr[1]}: no "
+                    f"pooled connection freed in {_acquire_cap_s():g}s "
+                    f"(pool of {self.max_conns} wedged)")
+                err.waited = False  # local capacity, not the node
+                raise err
         with self._lock:
             if self._idle:
                 return self._idle.pop()

@@ -467,7 +467,8 @@ def warmup(engine: TPUEngine, funcs=("rate", "increase", "default_rollup"),
     n_runs = 0
     try:
         S, N = max(int(engine.min_series), 64), 128
-        start = (int(_time.time() * 1000) - N * 15_000) // 60_000 * 60_000
+        from ..utils import fasttime
+        start = (fasttime.unix_ms() - N * 15_000) // 60_000 * 60_000
         rng = np.random.default_rng(7)
         series = []
         for i in range(S):

@@ -130,8 +130,8 @@ def tf_time(ec, args):
 
 
 def tf_now(ec, args):
-    import time
-    return [const_series(ec, time.time())]
+    from ..utils import fasttime
+    return [const_series(ec, fasttime.unix_seconds())]
 
 
 def tf_step(ec, args):

@@ -443,10 +443,11 @@ class Part:
 
     def __del__(self):
         # merged-away parts are dropped by GC without close(); give their
-        # memo budget back
+        # memo budget back.  __del__ must never raise, and at interpreter
+        # teardown module globals the release path touches may be gone
         try:
             self._release_dec()
-        except Exception:
+        except (AttributeError, TypeError, OSError):
             pass
 
     def _read(self, f, off: int, size: int) -> bytes:

@@ -22,7 +22,7 @@ import numpy as np
 
 from ..devtools import faultinject
 from ..devtools.locktrace import make_lock, make_rlock
-from ..utils import costacc, flightrec, logger
+from ..utils import costacc, fasttime, flightrec, logger
 from ..utils import metrics as metricslib
 from ..utils import workpool
 from ..utils.deadline import Budget, DeadlineExceededError  # noqa: F401 —
@@ -605,7 +605,7 @@ class Storage:
         # minimum is computed ONCE and reused for the append log
         oldest = min(r[1] for r in out)
         from ..query.rollup_result_cache import GLOBAL, OFFSET_MS
-        if oldest < int(time.time() * 1000) - OFFSET_MS:
+        if oldest < fasttime.unix_ms() - OFFSET_MS:
             GLOBAL.reset()
         self.table.add_rows(out)
         _ingest_lap("append", t0)
@@ -769,7 +769,7 @@ class Storage:
             sp.lock.release()
         oldest = int(tss.min())
         from ..query.rollup_result_cache import GLOBAL, OFFSET_MS
-        if oldest < int(time.time() * 1000) - OFFSET_MS:
+        if oldest < fasttime.unix_ms() - OFFSET_MS:
             GLOBAL.reset()
         self.table.add_rows_columnar(sp, ids, tss, vals)
         _ingest_lap("append", t0)
@@ -1465,7 +1465,7 @@ class Storage:
         """Record a query hit for each distinct metric name (called by
         the search paths; drives /api/v1/status/metric_names_stats and
         the metricNamesUsageStats RPC)."""
-        now = int(time.time())
+        now = fasttime.unix_timestamp()
         nu = self._name_usage
         for g in metric_groups:
             e = nu.get(g)
@@ -1807,7 +1807,7 @@ class Storage:
 
     @property
     def min_valid_ts(self) -> int:
-        return int(time.time() * 1e3) - self.retention_ms
+        return fasttime.unix_ms() - self.retention_ms
 
     def enforce_retention(self) -> int:
         n = self.table.enforce_retention(self.min_valid_ts)
@@ -1837,7 +1837,8 @@ class Storage:
     def create_snapshot(self) -> str:
         """Instant snapshot via hardlinks (MustCreateSnapshot,
         storage.go:411); name format YYYYMMDDhhmmss-seq."""
-        name = time.strftime("%Y%m%d%H%M%S") + f"-{int(time.time_ns()) % 10000:04d}"
+        name = time.strftime("%Y%m%d%H%M%S") + \
+            f"-{fasttime.unix_ns() % 10000:04d}"
         dst = os.path.join(self.snapshots_dir(), name)
         self.table.snapshot_to(os.path.join(dst, "data"))
         # crashpoint: dying here leaves a half-built snapshot dir — the

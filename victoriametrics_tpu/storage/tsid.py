@@ -71,7 +71,8 @@ class MetricIDGenerator:
 
     def __init__(self):
         self._lock = make_lock("storage.MetricIDGenerator._lock")
-        self._next = time.time_ns() & ((1 << 62) - 1)
+        from ..utils import fasttime
+        self._next = fasttime.unix_ns() & ((1 << 62) - 1)
 
     def next_id(self) -> int:
         with self._lock:

@@ -30,3 +30,9 @@ def unix_ms() -> int:
 def unix_seconds() -> float:
     """Float unix seconds (for durations/uptime at ms resolution)."""
     return time.time()
+
+
+def unix_ns() -> int:
+    """Integer unix nanoseconds (uniqueness counters, snapshot-name
+    seeds — anything that wants restart-monotonic entropy)."""
+    return time.time_ns()

@@ -161,8 +161,10 @@ class SampleProfiler:
             t0 = time.perf_counter()
             try:
                 self.take_sample(skip={me})
-            except Exception as e:  # vmt: disable=VMT003 — the sampler
-                # must never die; one log line per failure, no re-raise
+            except Exception as e:
+                # the sampler must never die; one log line per failure,
+                # no re-raise
+
                 from . import logger
                 logger.errorf("profiler sample failed: %s", e)
             dt = time.perf_counter() - t0

@@ -21,7 +21,9 @@ class MetricsPusher:
         self.interval_s = interval_s
         self.extra_labels = extra_labels
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        # one long-lived push ticker per process — not fan-out work
+        self._thread = threading.Thread(  # vmt: disable=VMT011
+            target=self._loop, daemon=True)
         # registry-backed (reference metrics_push_total /
         # metrics_push_errors_total, vendor/.../metrics/push.go:128)
         self._pushes = REGISTRY.counter("vm_pushmetrics_pushes_total")
