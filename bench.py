@@ -306,6 +306,32 @@ def _assert_rows_equal(a, b, rtol: float = 0.0) -> None:
         assert ok, "served result diverged from cold evaluation"
 
 
+_SELF_METRIC_FAMS = (
+    "vm_selfscrape_scrapes_total", "vm_selfscrape_rows_total",
+    "vm_selfscrape_errors_total", "vm_slo_evals_total",
+    "vm_slo_eval_rounds_total", "vm_matstream_evals_total",
+    "vm_gc_collections_total", "vm_log_messages_total",
+)
+
+
+def _self_metrics_totals() -> dict:
+    """Key vm_* counters from the process registry, summed per family —
+    the observability plane's own view of a bench leg."""
+    from victoriametrics_tpu.utils import metrics as metricslib
+    out: dict = {}
+    for name, val in metricslib.REGISTRY.collect_values(
+            include_process=False):
+        fam = metricslib.split_name(name)[0]
+        if fam in _SELF_METRIC_FAMS:
+            out[fam] = out.get(fam, 0.0) + val
+    return out
+
+
+def _self_metrics_delta(t0: dict, t1: dict) -> dict:
+    return {k: round(t1.get(k, 0.0) - t0.get(k, 0.0), 3)
+            for k in sorted(set(t0) | set(t1))}
+
+
 def main() -> None:
     # Launch the accelerator probe FIRST and let it run concurrently with
     # ingest (~100s): a slow-but-alive TPU backend is not discarded, and a
@@ -334,8 +360,26 @@ def main() -> None:
     now_ms = int(time.time() * 1000)
     t_start = (now_ms - (N_SAMPLES - 1) * 15_000) // STEP * STEP
     rng = np.random.default_rng(0)
+    scraper = None
     try:
         s = Storage(tmp)
+
+        # the self-monitoring plane runs for the WHOLE bench (acceptance:
+        # the headline is measured with self-scrape + SLO engine ON): the
+        # process's own registry lands in the bench storage as real
+        # series, and burn-rate evals ride each scrape tick
+        from victoriametrics_tpu.httpapi.prometheus_api import \
+            PrometheusAPI as _PlaneAPI
+        from victoriametrics_tpu.utils.selfscrape import SelfScraper
+        plane_api = _PlaneAPI(s)
+        plane_engine = plane_api.init_sloplane()
+        scrape_interval = float(
+            os.environ.get("VM_SELF_SCRAPE_INTERVAL", "5") or 5)
+        scraper = SelfScraper(
+            s.add_rows, instance="bench", interval_s=scrape_interval,
+            extra=plane_api.app_metrics,
+            on_tick=lambda now_ms: plane_engine.maybe_eval(now_ms))
+        scraper.start()
 
         # -- ingest: realistic jittered counters through the real write
         # path — the COLUMNAR pipeline HTTP ingest uses (raw text series
@@ -429,6 +473,7 @@ def main() -> None:
             # the HTTP layer serves (result-cache tail merge + full eval
             # stack) — this is the path a dashboard actually pays
             api = PrometheusAPI(s, engine)
+            selfm0 = _self_metrics_totals()
             start = end0 - duration
             kw = dict(step=STEP, storage=s, tpu=engine)
             # cold: full fetch+decode+compute, result caches off, jit
@@ -503,6 +548,8 @@ def main() -> None:
             # would flood the rings with full-window fetch spans
             flights[backend] = _leg_flight_summary(flight_id0, thresh_ms)
             cost_summary = _cost_leg_summary(leg_costs, lat)
+            self_delta = _self_metrics_delta(selfm0,
+                                             _self_metrics_totals())
             # honesty check: the served refresh must equal a cold
             # (nocache) evaluation of the same window — bit-for-bit on
             # the f64 host path, within the f32 tile bound on device
@@ -513,7 +560,7 @@ def main() -> None:
             _assert_rows_equal(rows, cold_rows, rtol=rtol)
             results[backend] = (float(np.median(lat)), cold_dt,
                                 phase_lbl, ing_lbl, list(lat), cache_stats,
-                                cost_summary)
+                                cost_summary, self_delta)
             if backend == "device":
                 # the residency story in the artifact: a steady refresh
                 # must ship tail columns, not the window (ISSUE 12)
@@ -533,7 +580,7 @@ def main() -> None:
             end0 = end  # the next backend continues on the grown storage
 
         backend, (warm_dt, cold_dt, phase_lbl, ing_lbl, lat,
-                  cache_stats, cost_summary) = min(
+                  cache_stats, cost_summary, _) = min(
             results.items(), key=lambda kv: kv[1][0])
         rate = samples / warm_dt
         # the refresh-latency DISTRIBUTION, not just p50: ROADMAP item 1's
@@ -583,11 +630,17 @@ def main() -> None:
             # visible even when the host leg wins the headline
             "legs": {b: {"refresh_p50_ms": round(r[0] * 1e3, 2),
                          "cold_s": round(r[1], 2),
-                         "cost": r[6]}
+                         "cost": r[6],
+                         # the observability plane's own view of the leg
+                         "self_metrics": r[7]}
                      for b, r in results.items()},
             "device_plane": device_plane,
             "flight": flights,
             "probe": probe_info,
+            # end-of-run verdict from the self-monitoring plane (one
+            # final scrape + eval round so it reflects the full run)
+            "self_monitoring": _bench_health(scraper, plane_api,
+                                             plane_engine, s),
         }))
     finally:
         try:
@@ -597,10 +650,40 @@ def main() -> None:
         except Exception:
             pass
         try:
+            if scraper is not None:
+                # before s.close(): a late scrape must not write into a
+                # closed storage
+                scraper.stop()
+        except Exception:
+            pass
+        try:
             s.close()
         except Exception:
             pass
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_health(scraper, plane_api, plane_engine, storage) -> dict:
+    """One final scrape + eval round, then the health verdict — the
+    artifact carries the plane's own view of the whole run."""
+    from victoriametrics_tpu.query import sloplane
+    try:
+        scraper.scrape_once()
+        plane_engine.maybe_eval(force=True)
+        h = sloplane.local_health(storage=storage, engine=plane_engine,
+                                  role="bench")
+        return {
+            "interval_s": scraper.interval_s,
+            "scrapes": int(_self_metrics_totals().get(
+                "vm_selfscrape_scrapes_total", 0)),
+            "slo_eval_rounds": plane_engine.eval_rounds,
+            "slo_exprs_per_round": plane_engine.exprs_last_round,
+            "verdict": h["verdict"],
+            "reasons": h["reasons"],
+            "firing": [name for name, _ in plane_engine.firing()],
+        }
+    except Exception as e:  # noqa: BLE001 — artifact must still ship
+        return {"error": str(e)}
 
 
 FLEET_PANELS = (

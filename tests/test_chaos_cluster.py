@@ -691,3 +691,174 @@ def test_multilevel_vmselect_matches_flat(cluster):
     code, body = _query(top, "sum(mlp)", t_s)
     assert float(json.loads(body)["data"]["result"][0]["value"][1]) == \
         float(sum(i + 2 for i in range(120)))
+
+
+# ---------------------------------------------------------------------------
+# scenario 8: SLO burn + incident auto-diagnosis through a faulted node
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def slo_cluster(tmp_path_factory):
+    """2 nodes, RF=1, fault toggle armed, the vmselect self-scraping
+    every 250ms; tight burn windows (5s/15s, threshold 5x) so the storm
+    fires within two pumped evals and recovery resolves in seconds.
+    VM_SLO_EVAL_INTERVAL is huge: every eval round is pump-driven, so
+    'within 2 eval intervals' is two ?pump=1 calls, deterministically."""
+    d = tmp_path_factory.mktemp("chaos_slo")
+    ports = free_ports(8)
+    procs = _spawn_cluster(
+        d, ports,
+        select_extra=["-selfScrapeInterval=0.25"],
+        env={"VM_FAULT_INJECT": "1",
+             "VM_SLO_WINDOWS": "5s:15s:5",
+             "VM_SLO_PERIOD": "30s",
+             "VM_SLO_EVAL_INTERVAL": "3600"})
+    try:
+        yield {"procs": procs, "ports": ports}
+    finally:
+        for p in procs.values():
+            p.stop(kill=True)
+
+
+def _slo_status(vs: Client, pump: bool = False) -> dict:
+    params = {"pump": "1"} if pump else {}
+    code, body = vs.get("/api/v1/status/slo", **params)
+    assert code == 200, body
+    return json.loads(body)
+
+
+def _slo_of(status: dict, name: str) -> dict:
+    return next(s for s in status["slos"] if s["slo"] == name)
+
+
+def test_slo_burn_incident_autodiagnosis_and_recovery(slo_cluster):
+    """The ISSUE 17 acceptance chain, end to end through real processes:
+    a fault-injected erroring vmstorage drives a deny_partial 503 storm,
+    the availability SLO burns over threshold within 2 pumped evals, the
+    auto-opened incident links a flight capture + profiler snapshot +
+    a degraded cluster verdict NAMING the faulted node — and after the
+    fault clears, the incident resolves and the verdict returns to ok."""
+    procs, ports = slo_cluster["procs"], slo_cluster["ports"]
+    (s1h, s1i, s1s, s2h, s2i, s2s, ih, sh) = ports
+    vi, vs = Client(procs["vi"].port), Client(procs["vs"].port)
+    _ingest(vi, "slom", 40)
+    for key in ("st1", "st2"):
+        _flush(procs[key].port)
+
+    # the vmselect's self-scrape must be landing in the cluster before
+    # any burn math can see indicator series
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        code, body = _query(vs, "vm_http_requests_total", time.time())
+        if code == 200 and json.loads(body)["data"]["result"]:
+            break
+        time.sleep(0.25)
+    else:
+        pytest.fail("self-scraped series never appeared in the cluster")
+
+    # baseline: availability healthy, verdict ok
+    avail = _slo_of(_slo_status(vs, pump=True), "http-availability")
+    assert not avail["firing"], avail
+    code, body = vs.get("/api/v1/status/health")
+    assert code == 200 and json.loads(body)["verdict"] == "ok", body
+
+    # fault the node that does NOT own the error-indicator series: the
+    # SLO evals (partial-tolerant) keep reading it from the healthy
+    # node.  Placement is the write path's own consistent hash, so the
+    # test reconstructs it instead of guessing.  (If the OTHER side of
+    # the ratio lands on the faulted node, the total<=0 & bad>0 ->
+    # ratio=1.0 fold rule covers it — but determinism beats luck.)
+    import struct
+
+    from victoriametrics_tpu.parallel.consistenthash import ConsistentHash
+    from victoriametrics_tpu.storage.metric_name import MetricName
+    bad_series = {"__name__": "vm_http_request_errors_total",
+                  "path": "/select/", "job": "victoria-metrics",
+                  "instance": f"vmselect:{sh}"}
+    ch = ConsistentHash([f"127.0.0.1:{s1i}", f"127.0.0.1:{s2i}"])
+    owner = ch.nodes_for_key(
+        struct.pack(">II", 0, 0) +
+        MetricName.from_dict(bad_series).marshal(), 1, set())[0]
+    victim = "st2" if owner == 0 else "st1"
+    victim_name = f"127.0.0.1:{s2i if owner == 0 else s1i}"
+
+    _set_faults(procs[victim].port,
+                "rpc:searchColumns_v1=error;rpc:search_v1=error")
+    try:
+        # the error storm: strict clients demand complete answers while
+        # one shard errors -> 503s, ticking the availability indicator
+        t_s = (T0 + 30000) // 1000
+        codes = []
+        for _ in range(40):
+            code, _body = vs.get("/select/0/prometheus/api/v1/query",
+                                 query="count(slom)", time=str(t_s),
+                                 deny_partial="1")
+            codes.append(code)
+            time.sleep(0.02)
+        assert codes.count(503) >= 10, codes
+        time.sleep(0.6)            # >= 2 scrape ticks: errors are stored
+
+        # two pumps = the 2-eval-interval acceptance budget
+        for _ in range(2):
+            avail = _slo_of(_slo_status(vs, pump=True),
+                            "http-availability")
+            if avail["firing"]:
+                break
+        assert avail["firing"], avail
+        assert avail["severity"] == "page"
+        assert avail["openIncidentId"] is not None
+
+        # the frozen incident links every diagnosis surface
+        code, body = vs.get("/api/v1/status/incidents",
+                            id=str(avail["openIncidentId"]))
+        assert code == 200, body
+        rec = json.loads(body)["data"]
+        assert rec["slo"] == "http-availability"
+        assert rec["resolvedMs"] is None
+        assert rec["flightCaptureId"] is not None
+        assert rec["profile"] is not None
+        health_at_breach = rec["health"]
+        assert health_at_breach["verdict"] in ("degraded", "critical")
+        assert any(r.get("node") == victim_name
+                   for r in health_at_breach["reasons"]), \
+            health_at_breach["reasons"]
+        # ...and the flight capture is fetchable as a real trace
+        code, body = vs.get("/api/v1/status/flight",
+                            id=str(rec["flightCaptureId"]))
+        assert code == 200, body
+
+        # the live roll-up names the node too, while it is down
+        code, _body = vs.get("/select/0/prometheus/api/v1/query",
+                             query="count(slom)", time=str(t_s),
+                             deny_partial="1")   # refresh the down mark
+        code, body = vs.get("/api/v1/status/health")
+        h = json.loads(body)
+        assert h["verdict"] in ("degraded", "critical")
+        assert any(r.get("node") == victim_name for r in h["reasons"]), \
+            h["reasons"]
+        assert h["ring"]["rerouteActive"] is True
+    finally:
+        _set_faults(procs[victim].port, "")
+
+    # recovery: the 15s window drains, the incident resolves, and the
+    # verdict returns to ok
+    deadline = time.time() + 45
+    avail = h = None
+    while time.time() < deadline:
+        avail = _slo_of(_slo_status(vs, pump=True), "http-availability")
+        code, body = vs.get("/api/v1/status/health")
+        h = json.loads(body)
+        if not avail["firing"] and h["verdict"] == "ok":
+            break
+        time.sleep(1.0)
+    else:
+        pytest.fail(f"never recovered: firing={avail and avail['firing']}"
+                    f" verdict={h and h['verdict']} reasons="
+                    f"{h and h['reasons']}")
+    assert avail["openIncidentId"] is None
+    # the resolved incident stays in the log, resolvedMs stamped
+    code, body = vs.get("/api/v1/status/incidents")
+    assert code == 200, body
+    summaries = json.loads(body)["data"]
+    mine = [s for s in summaries if s["slo"] == "http-availability"]
+    assert mine and mine[0]["resolvedMs"] is not None, summaries

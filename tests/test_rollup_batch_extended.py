@@ -162,3 +162,57 @@ def test_instant_query_grid():
             np.testing.assert_allclose(got[i], want, rtol=1e-9, atol=1e-9,
                                        equal_nan=True,
                                        err_msg=f"{func} instant")
+
+
+class TestNewSeriesBaseline:
+    """increase/delta for a series born INSIDE the window (no sample before
+    it): the counter is assumed born at 0 — a histogram bucket appearing at
+    value k carries k events — unless the first value dwarfs the first
+    in-window step (already-running counter surfacing mid-window), in which
+    case it is the baseline (rollup.go:2129 rollupDelta). Without this a
+    freshly started process reports zero good events for the whole window
+    and every latency SLO falsely pages."""
+
+    TS = np.arange(10, dtype=np.int64) * 15_000 + T0
+    CFG = RollupConfig(start=T0 + 285_000, end=T0 + 285_000,
+                       step=60_000, window=300_000)
+
+    def _all_engines(self, func, v):
+        import victoriametrics_tpu.native as nat
+        v = np.asarray(v, dtype=np.float64)
+        oracle = rollup_np.rollup(func, self.TS, v, self.CFG)[0]
+        ts2 = self.TS[None, :]
+        counts = np.array([self.TS.size], dtype=np.int64)
+        native = rollup_np.rollup_batch_packed(
+            func, ts2, v[None, :], counts, self.CFG)[0][0]
+        saved = nat.available
+        try:
+            nat.available = lambda: False
+            fallback = rollup_np.rollup_batch_packed(
+                func, ts2, v[None, :], counts, self.CFG)[0][0]
+        finally:
+            nat.available = saved
+        return oracle, native, fallback
+
+    @pytest.mark.parametrize("func", ["increase", "increase_pure", "delta"])
+    def test_flat_bucket_birth_counts_once(self, func):
+        # bucket born at 1, flat: increase over the window is 1, not 0
+        for got in self._all_engines(func, np.ones(10)):
+            assert got == pytest.approx(1.0)
+
+    def test_large_first_value_is_baseline(self):
+        # counter at 1e6 stepping +1: surfaced mid-window, not born here
+        v = 1_000_000.0 + np.arange(10)
+        for got in self._all_engines("increase", v):
+            assert got == pytest.approx(9.0)
+        # increase_pure always counts from 0 (rollup.go:2169)
+        for got in self._all_engines("increase_pure", v):
+            assert got == pytest.approx(1_000_009.0)
+
+    def test_prev_sample_still_wins(self):
+        # a sample BEFORE the window: baseline is that sample, heuristic off
+        cfg = RollupConfig(start=T0 + 400_000, end=T0 + 400_000,
+                           step=60_000, window=300_000)
+        v = np.ones(10)
+        got = rollup_np.rollup("increase", self.TS, v, cfg)[0]
+        assert got == pytest.approx(0.0)

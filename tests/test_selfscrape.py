@@ -1,0 +1,243 @@
+"""Self-scrape plane tests: interval parsing, row collection, the
+single-node e2e loop (a subprocess vmsingle whose own metrics become
+queryable TSDB series within one interval), and the cluster write path
+(a SelfScraper sinking into ClusterStorage shards across real RPC
+nodes)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tests.apptest_helpers import Client, VmSingleProc, free_ports
+from victoriametrics_tpu.parallel.cluster_api import (ClusterStorage,
+                                                      make_storage_handlers)
+from victoriametrics_tpu.parallel.rpc import (HELLO_INSERT, HELLO_SELECT,
+                                              RPCServer)
+from victoriametrics_tpu.storage.storage import Storage
+from victoriametrics_tpu.utils import selfscrape
+from victoriametrics_tpu.utils.selfscrape import (SelfScraper,
+                                                  configured_interval,
+                                                  parse_interval)
+
+
+class TestParseInterval:
+    def test_off_spellings(self):
+        for raw in (None, "", "0", "0s", "false", "no"):
+            assert parse_interval(raw) == 0.0
+
+    def test_bare_one_means_default(self):
+        assert parse_interval("1") == selfscrape.DEFAULT_INTERVAL_S
+
+    def test_durations_and_seconds(self):
+        assert parse_interval("15s") == 15.0
+        assert parse_interval("500ms") == 0.5
+        assert parse_interval("2.5") == 2.5
+        assert parse_interval("1m") == 60.0
+
+    def test_garbage_disables(self):
+        assert parse_interval("often") == 0.0
+
+    def test_env_wins_over_flag(self, monkeypatch):
+        monkeypatch.setenv("VM_SELF_SCRAPE_INTERVAL", "3s")
+        assert configured_interval("30s") == 3.0
+        monkeypatch.delenv("VM_SELF_SCRAPE_INTERVAL")
+        assert configured_interval("30s") == 30.0
+
+
+class TestCollectRows:
+    def test_rows_are_labeled_ingest_shape(self):
+        rows = SelfScraper(lambda rows, tenant: None, job="j",
+                           instance="i").collect_rows(ts_ms=1234)
+        assert rows, "registry snapshot produced no rows"
+        names = set()
+        for labels, ts, val in rows:
+            assert ts == 1234
+            assert labels["job"] == "j" and labels["instance"] == "i"
+            assert labels["__name__"]
+            assert val == val          # no NaN leaks into storage
+            names.add(labels["__name__"])
+        # process-level and vm-level families both present
+        assert "vm_app_uptime_seconds" in names
+        assert any(n.startswith("process_") for n in names)
+
+    def test_extra_metrics_are_included(self):
+        s = SelfScraper(lambda rows, tenant: None,
+                        extra=lambda: {"vm_extra_metric": 7.0})
+        rows = s.collect_rows(ts_ms=1)
+        vals = {labels["__name__"]: v for labels, _, v in rows}
+        assert vals.get("vm_extra_metric") == 7.0
+
+    def test_sink_failure_counts_not_raises(self):
+        def sink(rows, tenant):
+            raise OSError("down")
+        s = SelfScraper(sink)
+        before = selfscrape._ERRORS.get()
+        assert s.scrape_once() == 0
+        assert selfscrape._ERRORS.get() == before + 1
+
+    def test_persistent_handshake_failure_disables_sink(self):
+        # a wrong-plane spec (insert hello at a select port) fails the
+        # handshake deterministically: after 3 consecutive failures the
+        # scraper must stop dialing (each retry can mark healthy nodes
+        # down in the cluster router), not hammer forever
+        calls = []
+
+        def sink(rows, tenant):
+            calls.append(1)
+            raise ConnectionError("handshake failed: b'bad hello'")
+        s = SelfScraper(sink)
+        for _ in range(5):
+            s.scrape_once()
+        assert s._sink_disabled
+        assert len(calls) == 3
+
+    def test_transient_failures_keep_retrying(self):
+        # non-handshake errors (storage restarting) never trip the
+        # disable latch, and a success resets the streak
+        flaky = {"n": 0}
+
+        def sink(rows, tenant):
+            flaky["n"] += 1
+            if flaky["n"] < 5:
+                raise OSError("connection refused")
+        s = SelfScraper(sink)
+        for _ in range(6):
+            s.scrape_once()
+        assert not s._sink_disabled
+        assert s._sink_fails == 0  # reset by the success
+        assert flaky["n"] == 6
+
+
+def test_scrape_into_local_storage_queryable(tmp_path):
+    """Storage.add_rows sink: one scrape, the registry is real series."""
+    s = Storage(str(tmp_path / "data"))
+    try:
+        scraper = SelfScraper(s.add_rows, job="victoria-metrics",
+                              instance="test:1")
+        n = scraper.scrape_once()
+        assert n > 50
+        s.force_flush()
+        from victoriametrics_tpu.storage.tag_filters import \
+            filters_from_dict
+        now_ms = int(time.time() * 1e3)
+        res = s.search_series(filters_from_dict(
+            {"__name__": "vm_app_uptime_seconds"}),
+            now_ms - 60_000, now_ms + 60_000)
+        assert res, "scraped series not found in storage"
+        mn = res[0].metric_name
+        assert mn.get_label(b"job") == b"victoria-metrics"
+        assert mn.get_label(b"instance") == b"test:1"
+    finally:
+        s.close()
+
+
+def test_cluster_sink_shards_across_nodes(tmp_path):
+    """ClusterStorage.add_rows sink: the self-scraped registry shards
+    across both nodes like any ingested data (no special-casing)."""
+    storages = [Storage(str(tmp_path / f"n{i}")) for i in range(2)]
+    servers = []
+    try:
+        specs = []
+        for st in storages:
+            h = make_storage_handlers(st)
+            ins = RPCServer("127.0.0.1", 0, HELLO_INSERT, h)
+            sel = RPCServer("127.0.0.1", 0, HELLO_SELECT, h)
+            ins.start()
+            sel.start()
+            servers += [ins, sel]
+            specs.append((ins.port, sel.port))
+        from victoriametrics_tpu.parallel.cluster_api import \
+            StorageNodeClient
+        cluster = ClusterStorage([
+            StorageNodeClient("127.0.0.1", ip, sp) for ip, sp in specs])
+        scraper = SelfScraper(cluster.add_rows, instance="self")
+        n = scraper.scrape_once()
+        assert n > 50
+        assert cluster.rows_sent == n
+        for st in storages:
+            st.force_flush()
+        from victoriametrics_tpu.storage.tag_filters import \
+            filters_from_dict
+        now_ms = int(time.time() * 1e3)
+        per_node = [len(st.search_series(
+            filters_from_dict({"job": "victoria-metrics"}),
+            now_ms - 60_000, now_ms + 60_000)) for st in storages]
+        # consistent-hash sharding: every node holds a share, and the
+        # union is the whole scrape
+        assert all(c > 0 for c in per_node), per_node
+        assert sum(per_node) == n
+        cluster.close()
+    finally:
+        for srv in servers:
+            srv.stop()
+        for st in storages:
+            st.close()
+
+
+@pytest.mark.slow
+def test_vmsingle_selfscrape_e2e(tmp_path):
+    """The acceptance loop through a real process: a vmsingle started
+    with -selfScrapeInterval serves its OWN history via query_range
+    within one interval, correctly labeled."""
+    port = free_ports(1)[0]
+    app = VmSingleProc(str(tmp_path / "data"), port=port,
+                       extra_flags=["-selfScrapeInterval=0.2"])
+    try:
+        c = Client(port)
+        deadline = time.time() + 15
+        rows = []
+        while time.time() < deadline:
+            now = time.time()
+            res = c.query_range("vm_app_uptime_seconds", now - 60, now,
+                                "1s")
+            rows = res["data"]["result"]
+            # step-fill repeats one sample across steps: demand two
+            # DISTINCT uptime values, i.e. two real scrapes landed
+            if rows and len({v for _, v in rows[0]["values"]}) >= 2:
+                break
+            time.sleep(0.2)
+        assert rows, "self-scraped series never became queryable"
+        metric = rows[0]["metric"]
+        assert metric["job"] == "victoria-metrics"
+        assert metric["instance"] == f"vmsingle:{port}"
+        # uptime counts up between scrapes
+        vals = [float(v) for _, v in rows[0]["values"]]
+        assert vals[-1] > vals[0] >= 0.0
+        # the scraper's own accounting is on /metrics
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "vm_selfscrape_scrapes_total" in text
+    finally:
+        app.stop()
+
+
+@pytest.mark.slow
+def test_vmsingle_health_and_slo_endpoints(tmp_path):
+    """A self-scraping vmsingle serves the whole plane: /status/health
+    verdict ok, /status/slo evaluates on ?pump=1, incident log empty."""
+    port = free_ports(1)[0]
+    app = VmSingleProc(str(tmp_path / "data"), port=port,
+                       extra_flags=["-selfScrapeInterval=0.2"])
+    try:
+        c = Client(port)
+        code, body = c.get("/api/v1/status/health")
+        assert code == 200, body
+        h = json.loads(body)
+        assert h["verdict"] == "ok" and h["role"] == "vmsingle"
+        assert h["reasons"] == []
+        assert h["uptimeSeconds"] >= 0.0
+        code, body = c.get("/api/v1/status/slo", pump="1")
+        assert code == 200, body
+        st = json.loads(body)
+        assert st["evalRounds"] >= 1
+        assert {s["slo"] for s in st["slos"]} >= {
+            "http-availability", "http-latency", "ingest-durability",
+            "search-admission"}
+        assert all(not s["firing"] for s in st["slos"]), st["slos"]
+        code, body = c.get("/api/v1/status/incidents")
+        assert code == 200 and json.loads(body)["data"] == []
+    finally:
+        app.stop()

@@ -1,0 +1,277 @@
+"""SLO plane unit/integration tests: window parsing, fold math, the
+flat-in-SLO-count eval invariant (counter-asserted), the incident
+open/resolve lifecycle with linked diagnosis surfaces, and the health
+roll-up including old-node health_v1 tolerance."""
+
+import time
+
+import pytest
+
+from victoriametrics_tpu.httpapi.prometheus_api import PrometheusAPI
+from victoriametrics_tpu.query import sloplane
+from victoriametrics_tpu.query.sloplane import (IncidentRing, SLOEngine,
+                                                SLOSpec, default_specs,
+                                                latency_fold,
+                                                parse_windows, ratio_fold)
+from victoriametrics_tpu.storage.storage import Storage
+
+T0_MS = int(time.time() * 1e3)
+
+
+class TestParseWindows:
+    def test_default(self):
+        assert parse_windows(None) == [("5m", "1h", 14.4),
+                                       ("30m", "6h", 6.0)]
+
+    def test_custom(self):
+        assert parse_windows("5s:15s:5") == [("5s", "15s", 5.0)]
+
+    def test_garbage_falls_back(self):
+        assert parse_windows("nope,also:bad") == parse_windows(
+            sloplane.DEFAULT_WINDOWS)
+        assert parse_windows("a:b:notafloat") == parse_windows(
+            sloplane.DEFAULT_WINDOWS)
+
+
+class TestFolds:
+    def test_ratio_fold(self):
+        vals = {"bad": [{"value": 3.0}, {"value": 2.0}],
+                "total": [{"value": 100.0}]}
+        assert ratio_fold(vals) == (5.0, 100.0)
+        assert ratio_fold({}) == (0.0, 0.0)
+
+    def test_latency_fold_buckets(self):
+        fold = latency_fold(1.0)
+        vals = {
+            "total": [{"value": 100.0}],
+            "buckets": [
+                {"metric": {"vmrange": "8.799e-01...1.000e+00"},
+                 "value": 90.0},                      # good: <= 1s
+                {"metric": {"vmrange": "1.000e+00...1.136e+00"},
+                 "value": 10.0},                      # bad: > 1s
+                {"metric": {"vmrange": "garbage"}, "value": 5.0},
+            ],
+        }
+        bad, total = fold(vals)
+        assert (bad, total) == (10.0, 100.0)
+
+    def test_latency_fold_clamps_drift(self):
+        # bucket sums past _count (non-atomic registry snapshot)
+        fold = latency_fold(1.0)
+        vals = {"total": [{"value": 10.0}],
+                "buckets": [{"metric":
+                             {"vmrange": "0...1.000e-09"},
+                             "value": 12.0}]}
+        assert fold(vals) == (0.0, 10.0)
+
+
+@pytest.fixture()
+def api(tmp_path):
+    s = Storage(str(tmp_path / "data"))
+    a = PrometheusAPI(s)
+    try:
+        yield a
+    finally:
+        s.close()
+
+
+def _counter_rows(name: str, points):
+    return [({"__name__": name, "job": "t"}, ts, v) for ts, v in points]
+
+
+def test_flat_in_slo_count_counter_asserted(api):
+    """The acceptance invariant: adding an objective over an already-
+    watched indicator adds ZERO expression evals per round — asserted
+    on vm_slo_evals_total itself."""
+    windows = parse_windows("5m:1h:14.4,30m:6h:6")
+    e1 = SLOEngine(api, windows=windows, interval_s=0.01, period="24h")
+    before = sloplane._EVALS.get()
+    assert e1.maybe_eval(force=True)
+    n1 = sloplane._EVALS.get() - before
+    assert n1 == e1.exprs_last_round > 0
+
+    # a fifth objective duplicating the availability indicator
+    specs = default_specs()
+    specs.append(SLOSpec("dup-availability", 99.5,
+                         dict(specs[0].exprs)))
+    e2 = SLOEngine(api, specs=specs, windows=windows, interval_s=0.01,
+                   period="24h")
+    before = sloplane._EVALS.get()
+    assert e2.maybe_eval(force=True)
+    n2 = sloplane._EVALS.get() - before
+    assert n2 == n1, (n1, n2)
+    # ...and the duplicate objective is still independently reported
+    assert {s["slo"] for s in e2.status()["slos"]} == {
+        sp.name for sp in specs}
+
+
+def test_interval_gating(api):
+    eng = SLOEngine(api, specs=[], windows=parse_windows("5s:15s:5"),
+                    interval_s=3600, period="1m")
+    assert eng.maybe_eval(now_ms=T0_MS) is True
+    assert eng.maybe_eval(now_ms=T0_MS + 1000) is False     # gated
+    assert eng.maybe_eval(now_ms=T0_MS + 1000, force=True) is True
+    assert eng.maybe_eval(now_ms=T0_MS + 3601 * 1000) is True
+
+
+def test_burn_incident_lifecycle_and_diagnosis(api):
+    """Synthetic indicator: 30% error ratio -> burn 30x over a 1%
+    budget -> page fires, an incident freezes every diagnosis surface;
+    an eval with empty windows resolves it; gauges track throughout."""
+    s = api.storage
+    spec = SLOSpec(
+        "unit-avail", 99.0,
+        {"bad": "sum(increase(unit_bad_total[{w}]))",
+         "total": "sum(increase(unit_total_total[{w}]))"},
+        description="unit test objective")
+    # counters sampled every 2s over 10s: bad 0->30, total 0->100
+    pts_bad = [(T0_MS - 10_000 + i * 2_000, 3.0 * i) for i in range(6)]
+    pts_total = [(T0_MS - 10_000 + i * 2_000, 10.0 * i)
+                 for i in range(6)]
+    s.add_rows(_counter_rows("unit_bad_total", pts_bad) +
+               _counter_rows("unit_total_total", pts_total))
+    s.force_flush()
+
+    eng = SLOEngine(api, specs=[spec],
+                    windows=parse_windows("5s:10s:2"),
+                    interval_s=0.01, period="1m")
+    eng.maybe_eval(now_ms=T0_MS, force=True)
+    st = eng.status()["slos"][0]
+    assert st["firing"] and st["severity"] == "page"
+    # burn math: 30% ratio over a 1% budget = 30x (windowed increase
+    # wobbles at the edges; the order of magnitude is the contract)
+    assert 10 < st["burn"]["10s"] < 50, st["burn"]
+    assert st["openIncidentId"] is not None
+    assert st["budgetRemaining"] == 0.0  # period window burned through
+
+    rec = eng.incidents.get(st["openIncidentId"])
+    assert rec["slo"] == "unit-avail" and rec["resolvedMs"] is None
+    # every diagnosis surface linked (flightrec + profiler are on by
+    # default in-process)
+    assert rec["flightCaptureId"] is not None
+    assert rec["profile"] is not None and "stacks" in rec["profile"]
+    assert rec["health"] is not None
+    assert rec["health"]["verdict"] == "critical"   # page -> critical
+    assert any(r["code"] == "slo_burn" and r["slo"] == "unit-avail"
+               for r in rec["health"]["reasons"])
+
+    # exported gauges follow the state
+    from victoriametrics_tpu.utils import metrics as metricslib
+    g = metricslib.REGISTRY._metrics[metricslib.format_name(
+        "vm_slo_burn_rate", {"slo": "unit-avail", "window": "10s"})]
+    assert g.get() == st["burn"]["10s"]
+
+    # ten minutes later every window is empty -> ratio 0 -> resolved
+    eng.maybe_eval(now_ms=T0_MS + 600_000, force=True)
+    st = eng.status()["slos"][0]
+    assert not st["firing"] and st["openIncidentId"] is None
+    assert st["budgetRemaining"] == 1.0
+    rec = eng.incidents.get(rec["id"])
+    assert rec["resolvedMs"] is not None
+    # the summary listing reflects the closed incident
+    listed = eng.incidents.list()
+    assert listed[0]["id"] == rec["id"]
+    assert listed[0]["resolvedMs"] == rec["resolvedMs"]
+    assert listed[0]["hasProfile"] is True
+
+
+def test_total_on_dead_shard_still_burns(api):
+    """The chaos fold rule: when the total-series shard is unreadable
+    (total<=0) but bad events exist, the ratio reads 1.0 — a dark
+    denominator must not mask a live error signal."""
+    s = api.storage
+    pts = [(T0_MS - 8_000 + i * 2_000, 2.0 * i) for i in range(5)]
+    s.add_rows(_counter_rows("orphan_bad_total", pts))
+    s.force_flush()
+    spec = SLOSpec(
+        "orphan", 99.0,
+        {"bad": "sum(increase(orphan_bad_total[{w}]))",
+         "total": "sum(increase(orphan_total_total[{w}]))"})
+    eng = SLOEngine(api, specs=[spec],
+                    windows=parse_windows("5s:10s:2"),
+                    interval_s=0.01, period="1m")
+    eng.maybe_eval(now_ms=T0_MS, force=True)
+    st = eng.status()["slos"][0]
+    assert st["firing"], st
+    assert st["burn"]["10s"] == pytest.approx(1.0 / spec.budget)
+
+
+def test_incident_ring_bounded():
+    ring = IncidentRing(2)
+    for i in range(3):
+        ring.open({"slo": f"s{i}", "startedMs": i, "resolvedMs": None})
+    assert [r["slo"] for r in ring.list()] == ["s2", "s1"]
+    assert ring.get(1) is None          # evicted
+    assert ring.get(3)["slo"] == "s2"
+    assert ring.resolve("s0", 9) is None   # evicted: nothing to resolve
+
+
+def test_local_health_reasons():
+    class Quarantined:
+        def quarantine_report(self):
+            return [{"part": "x"}]
+    h = sloplane.local_health(storage=Quarantined(), role="vmstorage")
+    assert h["verdict"] == "degraded"
+    assert [r["code"] for r in h["reasons"]] == ["quarantined_parts"]
+    assert h["stats"]["quarantinedParts"] == 1
+    assert h["role"] == "vmstorage" and h["uptimeSeconds"] >= 0
+
+    class ReadOnly:
+        readonly = True
+    h = sloplane.local_health(storage=ReadOnly())
+    assert any(r["code"] == "readonly" for r in h["reasons"])
+
+    h = sloplane.local_health()
+    assert h["verdict"] == "ok" and h["reasons"] == []
+
+
+def test_cluster_health_tolerates_old_nodes(tmp_path):
+    """A pre-upgrade vmstorage without health_v1 answers 'unknown
+    rpc method'; the roll-up treats it as verdict=unknown, NOT as a
+    degradation — mixed-version clusters stay green."""
+    from victoriametrics_tpu.parallel.cluster_api import (
+        ClusterStorage, StorageNodeClient, make_storage_handlers)
+    from victoriametrics_tpu.parallel.rpc import (HELLO_INSERT,
+                                                  HELLO_SELECT, RPCServer)
+    storages = [Storage(str(tmp_path / f"n{i}")) for i in range(2)]
+    servers = []
+    try:
+        clients = []
+        for i, st in enumerate(storages):
+            h = make_storage_handlers(st)
+            if i == 1:
+                del h["health_v1"]      # the "old binary" node
+            ins = RPCServer("127.0.0.1", 0, HELLO_INSERT, h)
+            sel = RPCServer("127.0.0.1", 0, HELLO_SELECT, h)
+            ins.start()
+            sel.start()
+            servers += [ins, sel]
+            clients.append(
+                StorageNodeClient("127.0.0.1", ins.port, sel.port))
+        cluster = ClusterStorage(clients)
+        # direct client: modern node reports, old node returns None
+        assert clients[0].health()["verdict"] in ("ok", "degraded",
+                                                  "critical")
+        assert clients[0].health()["role"] == "vmstorage"
+        assert clients[1].health() is None
+        reports = cluster.health_report()
+        by_node = {r["node"]: r for r in reports}
+        assert by_node[clients[0].name]["verdict"] in (
+            "ok", "degraded", "critical")
+        assert by_node[clients[1].name]["verdict"] == "unknown"
+        # the roll-up: both nodes up, old node is NOT a reason
+        h = sloplane.cluster_health(cluster, role="vmselect")
+        assert h["verdict"] == "ok", h["reasons"]
+        assert {n["name"] for n in h["nodes"]} == \
+            {c.name for c in clients}
+        # ring-ownership filtering is a healthy-cluster optimization,
+        # reported as state, never as a reason; no node down -> no
+        # reroute
+        assert h["ring"]["rerouteActive"] is False
+        assert isinstance(h["ring"]["filterActive"], bool)
+        cluster.close()
+    finally:
+        for srv in servers:
+            srv.stop()
+        for st in storages:
+            st.close()

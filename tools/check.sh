@@ -15,6 +15,7 @@
 #   flight     flight-recorder overhead     VMT_NO_FLIGHT_SMOKE=1
 #   profile    continuous-profiler overhead VMT_NO_PROFILE_SMOKE=1
 #   matstream  materialized-stream fan-out  VMT_NO_MATSTREAM_SMOKE=1
+#   selfscrape self-scrape+SLO duty cycle   VMT_NO_SELFSCRAPE_SMOKE=1
 #   reshard    elastic scale-out reshard    VMT_NO_RESHARD_SMOKE=1
 #   device     8-device residency guard     VMT_NO_DEVICE_SMOKE=1
 #   crash      one crashpoint seam + reopen VMT_NO_CRASH_SMOKE=1
@@ -74,6 +75,12 @@ if [ "${VMT_NO_MATSTREAM_SMOKE:-0}" != "1" ]; then
         python -m victoriametrics_tpu.devtools.matstream_overhead
 else
     skipped matstream
+fi
+if [ "${VMT_NO_SELFSCRAPE_SMOKE:-0}" != "1" ]; then
+    run_stage selfscrape \
+        python -m victoriametrics_tpu.devtools.selfscrape_overhead
+else
+    skipped selfscrape
 fi
 if [ "${VMT_NO_RESHARD_SMOKE:-0}" != "1" ]; then
     run_stage reshard python -m victoriametrics_tpu.devtools.reshard_smoke

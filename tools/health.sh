@@ -1,0 +1,92 @@
+#!/bin/sh
+# Self-monitoring plane helper: pull the health verdict, SLO burn-rate
+# status and incident log from a running vmsingle/vmselect/vmstorage.
+#
+# Usage:
+#   tools/health.sh [-a host:port] health            # roll-up verdict
+#   tools/health.sh [-a host:port] slo               # burn-rate status
+#   tools/health.sh [-a host:port] slo pump          # force an eval now
+#   tools/health.sh [-a host:port] incidents         # incident log
+#   tools/health.sh [-a host:port] incidents ID      # one full record
+#   tools/health.sh watch A:P [B:Q ...]              # merged cluster view
+#
+# `health` on a vmselect fans health_v1 out to every storage node and
+# rolls the verdicts up (node_down / node_degraded reasons name the
+# node); on a vmstorage/vmsingle it is the node-local verdict.  `watch`
+# polls several processes directly and prints one verdict line each —
+# the poor man's cluster dashboard when no vmselect is running.
+set -eu
+ADDR="127.0.0.1:8428"
+if [ "${1:-}" = "-a" ]; then
+    ADDR="$2"
+    shift 2
+fi
+CMD="${1:-health}"
+
+fetch() {
+    # stdlib only: curl is not guaranteed in the dev containers
+    python - "$1" <<'EOF'
+import json, sys, urllib.request
+body = urllib.request.urlopen(sys.argv[1], timeout=30).read()
+try:
+    out = json.dumps(json.loads(body), indent=2).encode() + b"\n"
+except ValueError:
+    out = body
+try:
+    sys.stdout.buffer.write(out)
+    sys.stdout.buffer.flush()
+except BrokenPipeError:  # reader closed early (| head, | grep -q)
+    sys.exit(0)
+EOF
+}
+
+case "$CMD" in
+health)
+    fetch "http://$ADDR/api/v1/status/health"
+    ;;
+slo)
+    if [ "${2:-}" = "pump" ]; then
+        fetch "http://$ADDR/api/v1/status/slo?pump=1"
+    else
+        fetch "http://$ADDR/api/v1/status/slo"
+    fi
+    ;;
+incidents)
+    if [ -n "${2:-}" ]; then
+        fetch "http://$ADDR/api/v1/status/incidents?id=$2"
+    else
+        fetch "http://$ADDR/api/v1/status/incidents"
+    fi
+    ;;
+watch)
+    shift
+    [ "$#" -ge 1 ] || {
+        echo "usage: tools/health.sh watch host:port [host:port ...]" >&2
+        exit 2
+    }
+    python - "$@" <<'EOF'
+import json, signal, sys, urllib.request
+signal.signal(signal.SIGPIPE, signal.SIG_DFL)  # die quietly on | head
+worst = 0
+rank = {"ok": 0, "degraded": 1, "critical": 2}
+for addr in sys.argv[1:]:
+    try:
+        body = urllib.request.urlopen(
+            f"http://{addr}/api/v1/status/health", timeout=10).read()
+        h = json.loads(body)
+        verdict = h.get("verdict", "unknown")
+        reasons = ",".join(r.get("code", "?") for r in h.get("reasons", []))
+        print(f"{addr:24s} {h.get('role', '?'):10s} {verdict:9s}"
+              f" {reasons or '-'}")
+        worst = max(worst, rank.get(verdict, 2))
+    except Exception as e:
+        print(f"{addr:24s} {'?':10s} {'unreachable':9s} {e}")
+        worst = max(worst, 2)
+sys.exit(0 if worst == 0 else 1)
+EOF
+    ;;
+*)
+    echo "unknown command: $CMD (health|slo|incidents|watch)" >&2
+    exit 2
+    ;;
+esac

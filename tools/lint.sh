@@ -71,6 +71,14 @@ if [ "${VMT_NO_MATSTREAM_SMOKE:-0}" != "1" ]; then
     env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python -m victoriametrics_tpu.devtools.matstream_overhead
 fi
+# Self-monitoring plane overhead smoke (devtools/selfscrape_overhead.py):
+# one scrape+SLO-eval cycle against a real Storage must stay within
+# VM_SELFSCRAPE_SMOKE_PCT (default 2%) duty cycle of the 15s interval.
+# VMT_NO_SELFSCRAPE_SMOKE=1 skips it.
+if [ "${VMT_NO_SELFSCRAPE_SMOKE:-0}" != "1" ]; then
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python -m victoriametrics_tpu.devtools.selfscrape_overhead
+fi
 # Elastic-cluster reshard smoke (devtools/reshard_smoke.py): a second
 # vmstorage joins a 1-node cluster without a restart, rebalance moves
 # real parts over migrateParts_v1 byte-exactly, and an RF=2 down node

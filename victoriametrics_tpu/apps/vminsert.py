@@ -28,6 +28,10 @@ def parse_flags(argv=None):
     p.add_argument("-maxIngestionRate", dest="max_ingestion_rate",
                    type=int, default=0,
                    help="rows/s ingest ceiling, 0 = unlimited")
+    p.add_argument("-selfScrapeInterval", dest="self_scrape_interval",
+                   default="",
+                   help="scrape own /metrics into the cluster every "
+                        "interval (15s when set to 1); empty/0 = off")
     args, _ = p.parse_known_args(argv)
     env = os.environ.get("VM_STORAGENODE")
     if env:
@@ -67,6 +71,12 @@ def build(args):
     api.register(srv, mode="insert")
     from ..parallel.cluster_api import register_cluster_admin
     register_cluster_admin(srv, cluster)
+    # self-monitoring plane: own registry -> cluster write path (no SLO
+    # pump here — a vminsert has no select channel to evaluate over)
+    from ..utils import selfscrape
+    api.selfscraper = selfscrape.maybe_start(
+        cluster.add_rows, "vminsert", int(hp),
+        flag_value=args.self_scrape_interval, extra=api.app_metrics)
     native_srv = None
     if getattr(args, "native_addr", ""):
         from ..parallel.cluster_api import start_native_server
@@ -82,7 +92,7 @@ def main(argv=None):
     faulthandler.register(signal.SIGUSR1)
     args = parse_flags(argv)
     logger.set_level(args.loggerLevel)
-    cluster, srv, _, native_srv = build(args)
+    cluster, srv, _api, native_srv = build(args)
     srv.start()
     logger.infof("vminsert started: nodes=%d rf=%d http=%d",
                  len(cluster.nodes), cluster.rf, srv.port)
@@ -94,6 +104,8 @@ def main(argv=None):
             pass
     finally:
         srv.stop()
+        if getattr(_api, "selfscraper", None) is not None:
+            _api.selfscraper.stop()
         if native_srv is not None:
             native_srv.stop()
         cluster.close()

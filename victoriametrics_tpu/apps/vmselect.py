@@ -41,6 +41,10 @@ def parse_flags(argv=None):
                    help="expose the vmselect RPC API so a higher-level "
                         "vmselect can use this node as a storage backend "
                         "(multilevel federation)")
+    p.add_argument("-selfScrapeInterval", dest="self_scrape_interval",
+                   default="",
+                   help="scrape own /metrics into the cluster every "
+                        "interval (15s when set to 1); empty/0 = off")
     p.add_argument("-loggerLevel", default="INFO")
     args, _ = p.parse_known_args(argv)
     env = os.environ.get("VM_STORAGENODE")
@@ -74,6 +78,13 @@ def build(args):
     register_cluster_admin(srv, cluster)
     from ..utils import profiler
     profiler.ensure_started()
+    # self-monitoring plane: own registry -> cluster write path (sharded
+    # + rerouted like any ingested series); SLO evals ride the tick
+    from ..utils import selfscrape
+    api.selfscraper = selfscrape.maybe_start(
+        cluster.add_rows, "vmselect", int(hp),
+        flag_value=args.self_scrape_interval, extra=api.app_metrics,
+        on_tick=lambda now_ms: api.init_sloplane().maybe_eval(now_ms))
     from ..httpapi.graphite_api import GraphiteAPI
     GraphiteAPI(cluster).register(srv)
     native_srv = None
@@ -90,7 +101,7 @@ def main(argv=None):
     faulthandler.register(signal.SIGUSR1)
     args = parse_flags(argv)
     logger.set_level(args.loggerLevel)
-    cluster, srv, _, native_srv = build(args)
+    cluster, srv, _api, native_srv = build(args)
     srv.start()
     logger.infof("vmselect started: nodes=%d http=%d", len(cluster.nodes),
                  srv.port)
@@ -102,6 +113,8 @@ def main(argv=None):
             pass
     finally:
         srv.stop()
+        if getattr(_api, "selfscraper", None) is not None:
+            _api.selfscraper.stop()
         if native_srv is not None:
             native_srv.stop()
         cluster.close()

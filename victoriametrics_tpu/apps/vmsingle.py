@@ -62,6 +62,10 @@ def parse_flags(argv=None):
     p.add_argument("-maxTenantIngestionRate",
                    dest="max_tenant_ingestion_rate", type=int, default=0,
                    help="per-tenant rows/s ingest ceiling, 0 = unlimited")
+    p.add_argument("-selfScrapeInterval", dest="self_scrape_interval",
+                   default="",
+                   help="scrape own /metrics into storage every "
+                        "interval (15s when set to 1); empty/0 = off")
     p.add_argument("-pushmetrics.url", dest="pushmetrics_urls",
                    action="append", default=[])
     p.add_argument("-pushmetrics.interval", dest="pushmetrics_interval",
@@ -203,6 +207,13 @@ def build(args):
     api.register(srv)
     from ..utils import profiler
     profiler.ensure_started()
+    # self-monitoring plane: own registry -> own storage as real series;
+    # the SLO engine's burn-rate evals ride each scrape tick
+    from ..utils import selfscrape
+    api.selfscraper = selfscrape.maybe_start(
+        storage.add_rows, "vmsingle", int(port),
+        flag_value=args.self_scrape_interval, extra=api.app_metrics,
+        on_tick=lambda now_ms: api.init_sloplane().maybe_eval(now_ms))
     from ..httpapi.graphite_api import GraphiteAPI
     GraphiteAPI(storage).register(srv)
     if args.pushmetrics_urls:
@@ -307,6 +318,10 @@ def main(argv=None):
             isrv.stop()
         if getattr(_api, "pusher", None) is not None:
             _api.pusher.stop()
+        if getattr(_api, "selfscraper", None) is not None:
+            # before storage.close(): a late scrape must not write into
+            # a closed storage
+            _api.selfscraper.stop()
         if _api.stream_aggr is not None:
             # final window flush BEFORE storage closes (streamaggr MustStop
             # ordering): dropping the open window on every restart would

@@ -21,6 +21,10 @@ def parse_flags(argv=None):
     p.add_argument("-retentionPeriod", default="13m")
     p.add_argument("-dedup.minScrapeInterval", dest="dedup_interval",
                    default="0s")
+    p.add_argument("-selfScrapeInterval", dest="self_scrape_interval",
+                   default="",
+                   help="scrape own /metrics into local storage every "
+                        "interval (15s when set to 1); empty/0 = off")
     p.add_argument("-loggerLevel", default="INFO")
     args, _ = p.parse_known_args(argv)
     for name in vars(args):
@@ -88,7 +92,17 @@ def build(args):
         {"status": "success",
          "data": {"tenants": costacc.TENANT_USAGE.snapshot(
              reset=req.arg("reset") == "1")}}))
-    return storage, insert_srv, select_srv, http
+    # node-local health verdict, also served to vmselects as health_v1
+    from ..query import sloplane
+    http.route("/api/v1/status/health", lambda req: Response.json(
+        sloplane.local_health(storage=storage, role="vmstorage")))
+    # self-monitoring plane: own registry -> own storage as real series
+    from ..utils import selfscrape
+    scraper = selfscrape.maybe_start(
+        storage.add_rows, "vmstorage", int(hp),
+        flag_value=args.self_scrape_interval,
+        extra=lambda: dict(storage.metrics()))
+    return storage, insert_srv, select_srv, http, scraper
 
 
 def main(argv=None):
@@ -96,7 +110,7 @@ def main(argv=None):
     faulthandler.register(signal.SIGUSR1)
     args = parse_flags(argv)
     logger.set_level(args.loggerLevel)
-    storage, insert_srv, select_srv, http = build(args)
+    storage, insert_srv, select_srv, http, scraper = build(args)
     insert_srv.start()
     select_srv.start()
     http.start()
@@ -114,6 +128,10 @@ def main(argv=None):
         insert_srv.stop()
         select_srv.stop()
         http.stop()
+        if scraper is not None:
+            # before storage.close(): a late scrape must not write into
+            # a closed storage
+            scraper.stop()
         storage.close()
         logger.infof("vmstorage: shutdown complete")
 

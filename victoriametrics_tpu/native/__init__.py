@@ -494,7 +494,7 @@ def counter_resets_2d(v: np.ndarray) -> np.ndarray:
     return out.reshape(shape)
 
 
-ROLLUP_COUNTER_FUNCS = {"rate": 1, "increase": 2, "increase_pure": 2,
+ROLLUP_COUNTER_FUNCS = {"rate": 1, "increase": 2, "increase_pure": 7,
                         "delta": 3, "deriv_fast": 4, "irate": 5, "idelta": 6}
 
 

@@ -670,6 +670,17 @@ void vm_counter_resets_2d(const double* v, int64_t S, int64_t N,
 #define VM_RF_DERIV_FAST 4
 #define VM_RF_IRATE 5
 #define VM_RF_IDELTA 6
+#define VM_RF_INCREASE_PURE 7
+
+// delta/increase baseline for a series whose first sample lies inside the
+// window (no sample precedes it): assume the counter was born at 0 — unless
+// the first value dwarfs the first in-window step, which marks an
+// already-running counter surfacing mid-window (rollup.go:2129 rollupDelta).
+// Mirrors _new_series_base in ops/rollup_np.py (must stay bit-exact).
+static inline double vm_new_series_base(const double* w, int64_t nwin) {
+    double d = nwin > 1 ? w[1] - w[0] : 0.0;
+    return (fabs(w[0]) < 10.0 * (fabs(d) + 1.0)) ? 0.0 : w[0];
+}
 
 // One pass per row: counter-reset correction into scratch, then a
 // two-pointer window walk over the T output steps. Semantics and float-op
@@ -685,7 +696,7 @@ void vm_rollup_counter_2d(const int64_t* ts, const double* v,
                           double* out, double* scratch) {
     int64_t T = (end - start) / step + 1;
     bool needs_reset = (func == VM_RF_RATE || func == VM_RF_INCREASE ||
-                        func == VM_RF_IRATE);
+                        func == VM_RF_INCREASE_PURE || func == VM_RF_IRATE);
     for (int64_t s = 0; s < S; s++) {
         const int64_t* t = ts + s * N;
         const double* r = v + s * N;
@@ -721,13 +732,21 @@ void vm_rollup_counter_2d(const int64_t* ts, const double* v,
             switch (func) {
             case VM_RF_DELTA:
                 if (have) {
-                    double base = has_prev ? r[prev] : r[a];
+                    double base = has_prev ? r[prev]
+                                           : vm_new_series_base(r + a, nwin);
                     res = r[b - 1] - base;
                 }
                 break;
             case VM_RF_INCREASE:
                 if (have) {
-                    double base = has_prev ? c[prev] : c[a];
+                    double base = has_prev ? c[prev]
+                                           : vm_new_series_base(c + a, nwin);
+                    res = c[b - 1] - base;
+                }
+                break;
+            case VM_RF_INCREASE_PURE:
+                if (have) {
+                    double base = has_prev ? c[prev] : 0.0;
                     res = c[b - 1] - base;
                 }
                 break;

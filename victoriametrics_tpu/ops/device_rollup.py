@@ -405,8 +405,21 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
         lo, hi, t_prev_i, _, grid = run([])
         _, have, has_prev = finish(lo, hi, t_prev_i)
         v_last = _gather(values, hi - 1)
+        v_first = _gather(values, lo)
+        # new-series baseline (rollup.go:2129, mirrors rollup_np): with no
+        # sample before the window the counter is assumed born at 0 unless
+        # its first value dwarfs the first in-window step. The compare and
+        # the zero base live in ABSOLUTE values, so rebased tiles fold v0
+        # back in (same precedent as _remove_counter_resets: the born case
+        # only fires on small absolutes, so the f32 addback stays exact).
+        v0c = jnp.zeros((), dtype) if v0 is None else \
+            v0[:, None].astype(dtype)
+        two = hi - lo >= 2
+        d = jnp.where(two, _gather(values, lo + 1) - v_first,
+                      jnp.zeros((), dtype))
+        born = jnp.abs(v_first + v0c) < 10.0 * (jnp.abs(d) + 1.0)
         base = jnp.where(has_prev, _gather(values, lo - 1),
-                         _gather(values, lo))
+                         jnp.where(born, -v0c, v_first))
         return jnp.where(have, v_last - base, nan)
     if func == "idelta":
         lo, hi, t_prev_i, _, grid = run([])
@@ -434,8 +447,21 @@ def rollup_tile(func: str, ts: jnp.ndarray, values: jnp.ndarray,
         ])
         c_last, c_prev, c_first, t_last_i, t_first_i = red
         n_win, have, has_prev = finish(lo, hi, t_prev_i)
-        base = jnp.where(has_prev, c_prev, c_first)
         if func in ("increase", "increase_pure"):
+            # new-series baseline on the reset-corrected series (see the
+            # delta branch above; increase_pure always counts from 0 —
+            # rollup.go:2169)
+            v0c = jnp.zeros((), dtype) if v0 is None else \
+                v0[:, None].astype(dtype)
+            if func == "increase_pure":
+                nb = jnp.broadcast_to(-v0c, c_first.shape)
+            else:
+                two = hi - lo >= 2
+                d = jnp.where(two, _gather(cv, lo + 1) - c_first,
+                              jnp.zeros((), dtype))
+                born = jnp.abs(c_first + v0c) < 10.0 * (jnp.abs(d) + 1.0)
+                nb = jnp.where(born, -v0c, c_first)
+            base = jnp.where(has_prev, c_prev, nb)
             return jnp.where(have, c_last - base, nan)
         # deriv-family prevValue gate (rollup.go:781): the sample before
         # the window seeds prevValue only within maxPrevInterval of the

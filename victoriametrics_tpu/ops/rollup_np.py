@@ -147,6 +147,17 @@ def remove_counter_resets(values: np.ndarray) -> np.ndarray:
     return v + corr
 
 
+def _new_series_base(w: np.ndarray) -> float:
+    """delta/increase baseline for a series whose first sample lies INSIDE
+    the window (no sample precedes it): assume the counter was born at 0 —
+    a histogram bucket or error counter appearing at value k carries k
+    events — unless the first value dwarfs the first in-window step, which
+    marks an already-running counter surfacing mid-window (churn, index
+    rotation); then it is the baseline (rollup.go:2129 rollupDelta)."""
+    d = float(w[1] - w[0]) if w.size > 1 else 0.0
+    return 0.0 if abs(w[0]) < 10.0 * (abs(d) + 1.0) else float(w[0])
+
+
 def _window_bounds(ts: np.ndarray, cfg: RollupConfig) -> tuple[np.ndarray, np.ndarray]:
     """Per output step: [start_idx, end_idx) half-open index range of samples
     inside (t-window, t]."""
@@ -223,10 +234,15 @@ def rollup(func: str, ts: np.ndarray, values: np.ndarray, cfg: RollupConfig
             if prev is None and w.size:
                 out[j] += 0  # first appearance is not a change
         elif func == "delta":
-            base = v[prev_idx] if prev_idx >= 0 else w[0]
+            base = v[prev_idx] if prev_idx >= 0 else _new_series_base(w)
             out[j] = w[-1] - base
         elif func in ("increase", "increase_pure"):
-            base = corrected[prev_idx] if prev_idx >= 0 else cw[0]
+            if prev_idx >= 0:
+                base = corrected[prev_idx]
+            elif func == "increase_pure":
+                base = 0.0  # rollup.go:2169 rollupIncreasePure
+            else:
+                base = _new_series_base(cw)
             out[j] = cw[-1] - base
         elif func == "rate":
             if gated_prev >= 0:
@@ -585,12 +601,19 @@ def rollup_batch_packed(func: str, ts2: np.ndarray, v2: np.ndarray,
     pidx = np.maximum(prev, 0)
 
     with np.errstate(all="ignore"):
-        if func == "delta":
-            base = np.where(has_prev, gather(v2, pidx), gather(v2, lo))
-            return np.where(have, gather(v2, last_i) - base, np.nan)
-        if func in ("increase", "increase_pure"):
-            base = np.where(has_prev, gather(cw2, pidx), gather(cw2, lo))
-            return np.where(have, gather(cw2, last_i) - base, np.nan)
+        if func in ("delta", "increase", "increase_pure"):
+            arr = v2 if func == "delta" else cw2
+            a_first = gather(arr, lo)
+            if func == "increase_pure":
+                nb = np.zeros_like(a_first)  # always born at 0
+            else:
+                # vectorized _new_series_base (see rollup() above)
+                second = gather(arr, np.clip(lo + 1, 0, N - 1))
+                d = np.where(nwin >= 2, second - a_first, 0.0)
+                nb = np.where(np.abs(a_first) < 10.0 * (np.abs(d) + 1.0),
+                              0.0, a_first)
+            base = np.where(has_prev, gather(arr, pidx), nb)
+            return np.where(have, gather(arr, last_i) - base, np.nan)
         if func in ("rate", "deriv_fast"):
             arr = cw2 if func == "rate" else v2
             has_gated_prev = gated_prev_mask()

@@ -570,6 +570,18 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
             snap = {"disabled": True, "stacks": [], "samples": 0}
         return Writer().bytes_(json.dumps(snap).encode())
 
+    def h_health(r: Reader):
+        """health_v1: this node's local health verdict — quarantine,
+        readonly, merge/work-queue backpressure gauges — as one json
+        object (query/sloplane.local_health).  The vmselect roll-up
+        fans this and merges; an old node without the method is
+        tolerated client-side (verdict "unknown")."""
+        import json
+
+        from ..query import sloplane
+        return Writer().bytes_(json.dumps(sloplane.local_health(
+            storage=storage, role="vmstorage")).encode())
+
     # -- live resharding: the migrateParts_v1 family -----------------------
 
     def h_list_parts(r: Reader):
@@ -666,6 +678,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         "searchMetadata_v1": h_search_metadata,
         "quarantineReport_v1": h_quarantine_report,
         "profile_v1": h_profile,
+        "health_v1": h_health,
     }
 
 
@@ -1039,6 +1052,19 @@ class StorageNodeClient:
         except RPCError as e:
             if "unknown rpc method" in str(e):
                 return None  # pre-profiler storage node
+            raise
+        return json.loads(r.bytes_())
+
+    def health(self) -> dict | None:
+        """This node's health_v1 verdict; None from an old node
+        without the method (tolerated — the roll-up shows the node as
+        verdict "unknown" instead of failing the whole report)."""
+        import json
+        try:
+            r = self.select.call("health_v1", Writer())
+        except RPCError as e:
+            if "unknown rpc method" in str(e):
+                return None  # pre-health storage node
             raise
         return json.loads(r.bytes_())
 
@@ -1898,6 +1924,26 @@ class ClusterStorage:
         for rep in self._fanout(one, replica_covered_ok=False):
             out.extend(rep)
         return out
+
+    def health_report(self) -> list[dict]:
+        """Per-node health_v1 verdicts tagged with node names — the
+        input to the /api/v1/status/health roll-up.  Best-effort by
+        design: a node that cannot answer simply has no report (the
+        roll-up already names it down/unreachable from liveness), and
+        an old node without the method reports verdict "unknown"
+        rather than failing the fan-out."""
+        def one(n):
+            rep = n.health()
+            if rep is None:
+                rep = {"verdict": "unknown"}
+            rep["node"] = n.name
+            return rep
+
+        try:
+            # node-local state: strict accounting like quarantine
+            return self._fanout(one, replica_covered_ok=False)
+        except (ClusterUnavailableError, PartialResultError):
+            return []
 
     def delete_series(self, filters, tenant=(0, 0)):
         # a node that missed the fan-out missed its TOMBSTONES: replica

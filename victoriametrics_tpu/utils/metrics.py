@@ -36,6 +36,12 @@ _NAME_RE = re.compile(
 _started_at = fasttime.unix_seconds()
 
 
+def uptime_seconds() -> float:
+    """Seconds since this process's registry was imported (the
+    vm_app_uptime_seconds / health-report clock)."""
+    return fasttime.unix_seconds() - _started_at
+
+
 # -- name formatting ---------------------------------------------------------
 
 def escape_label_value(v: str) -> str:
@@ -255,22 +261,19 @@ class MetricsRegistry:
         with self._lock:
             self._collectors.append(fn)
 
-    def write_prometheus(self, extra: dict | None = None,
-                         include_process: bool = True) -> str:
-        """Render the registry as Prometheus text exposition.  ``extra``
-        merges a one-shot dict of full-name -> value (e.g. a storage
-        engine's ``.metrics()``); collectors registered via
-        ``register_collector`` are read every call."""
+    def _collect(self, extra: dict | None = None,
+                 include_process: bool = True):
+        """The one collection pass both exposition AND the self-scrape
+        plane share: yields ``(family, type, name, value_str)`` for
+        every sample — registered metrics, ``register_collector``
+        collectors, a one-shot ``extra`` dict, process_* gauges."""
         with self._lock:
             metrics = list(self._metrics.values())
             collectors = list(self._collectors)
-        samples: list[tuple[str, str, str]] = []  # (family, name, value)
-        types: dict[str, str] = {}
         for m in metrics:
             fam = split_name(m.name)[0]
-            types.setdefault(fam, m.type_name)
             for name, value in m._samples():
-                samples.append((fam, name, value))
+                yield fam, m.type_name, name, value
         merged: dict[str, object] = {}
         for fn in collectors:
             try:
@@ -281,15 +284,43 @@ class MetricsRegistry:
             merged.update(extra)
         for name, value in merged.items():
             fam = split_name(name)[0]
-            types.setdefault(
-                fam, "counter" if fam.endswith("_total") else "gauge")
-            samples.append((fam, name, _fmt_number(value)))
+            kind = "counter" if fam.endswith("_total") else "gauge"
+            yield fam, kind, name, _fmt_number(value)
         if include_process:
             for name, value in _process_metrics():
                 fam = split_name(name)[0]
-                samples.append((fam, name, _fmt_number(value)))
-                types.setdefault(
-                    fam, "counter" if fam.endswith("_total") else "gauge")
+                kind = "counter" if fam.endswith("_total") else "gauge"
+                yield fam, kind, name, _fmt_number(value)
+
+    def collect_values(self, extra: dict | None = None,
+                       include_process: bool = True
+                       ) -> list[tuple[str, float]]:
+        """Structured snapshot for the self-scrape plane:
+        ``[(full_sample_name, float_value), ...]`` from the same
+        collection pass ``write_prometheus`` renders — NOT a text
+        round-trip.  Unparseable collector values are skipped (the
+        text path would have rendered them verbatim; the ingest path
+        needs numbers)."""
+        out = []
+        for _fam, _kind, name, value in self._collect(
+                extra, include_process):
+            try:
+                out.append((name, float(value)))
+            except (TypeError, ValueError):
+                continue
+        return out
+
+    def write_prometheus(self, extra: dict | None = None,
+                         include_process: bool = True) -> str:
+        """Render the registry as Prometheus text exposition.  ``extra``
+        merges a one-shot dict of full-name -> value (e.g. a storage
+        engine's ``.metrics()``); collectors registered via
+        ``register_collector`` are read every call."""
+        samples: list[tuple[str, str, str]] = []  # (family, name, value)
+        types: dict[str, str] = {}
+        for fam, kind, name, value in self._collect(extra, include_process):
+            types.setdefault(fam, kind)
+            samples.append((fam, name, value))
         samples.sort()
         out = []
         prev_fam = None
@@ -314,8 +345,14 @@ def _process_metrics():
         yield (f'vm_gc_collected_objects_total{{gen="{gen}"}}',
                st.get("collected", 0))
     yield "process_start_time_seconds", int(_started_at)
-    yield ("vm_app_uptime_seconds",
-           round(fasttime.unix_seconds() - _started_at, 3))
+    yield "vm_app_uptime_seconds", round(uptime_seconds(), 3)
+    # identity/info metrics (reference lib/buildinfo): constant-1 gauge
+    # carrying the version labels, plus the start timestamp — the fleet
+    # inventory the self-scrape plane's job=/instance= series hang off
+    from . import buildinfo
+    yield (f'vm_app_version{{version="{buildinfo.version()}",'
+           f'short_version="{buildinfo.short_version()}"}}', 1)
+    yield "vm_app_start_timestamp", int(_started_at)
     yield "process_num_threads", threading.active_count()
     try:
         t = os.times()
