@@ -1,0 +1,351 @@
+"""Exception-escape audit tests (devtools/errorflow.py, rule VMT016).
+
+Fixture packages are synthesized in tmp_path with the boundary table
+pointed at the fixture's own ``_dispatch``: a project exception type
+escaping a serving entry with no typed boundary mapping must be flagged
+at its raise site with the witness chain; the mapped / re-raised-as-
+typed / swallowed twins must be clean.  The runtime half pins the
+boundary behavior VMT016 forced: typed RPC wire markers that re-raise
+client-side, and the HTTP 503/502 arms."""
+
+import json
+import textwrap
+
+import pytest
+
+from victoriametrics_tpu.devtools import errorflow as ef
+
+# An RPC dispatch dict is recognized as a serving entry when it has
+# >= 3 "*_vN" string keys mapping to same-module handler names.
+_TAIL = """
+        def h_b(r):
+            pass
+
+        def h_c(r):
+            pass
+
+        HANDLERS = {
+            "a_v1": h_a,
+            "b_v1": h_b,
+            "c_v1": h_c,
+        }
+"""
+
+
+def _run(tmp_path, monkeypatch, body: str):
+    d = tmp_path / "fixture_pkg"
+    d.mkdir()
+    p = d / "srv.py"
+    p.write_text(textwrap.dedent(body + _TAIL), encoding="utf-8")
+    # the fixture module IS the boundary: its _dispatch's top-level
+    # except arms are the scanned mapped set
+    monkeypatch.setattr(ef, "BOUNDARIES", (("rpc", str(p), "_dispatch"),))
+    return ef.run_pass(paths=[str(p)])
+
+
+def test_unmapped_escape_is_flagged(tmp_path, monkeypatch):
+    findings, _used = _run(tmp_path, monkeypatch, """
+        class AppError(Exception):
+            pass
+
+        class MappedError(Exception):
+            pass
+
+        def _dispatch(r):
+            try:
+                return h_a(r)
+            except MappedError as e:
+                return ("mapped", str(e))
+
+        def helper():
+            raise AppError("boom")
+
+        def h_a(r):
+            helper()
+    """)
+    assert len(findings) == 1, [f.message for f in findings]
+    f = findings[0]
+    assert f.rule == ef.RULE_ID
+    assert "AppError" in f.message and "rpc boundary" in f.message
+    # witness chain: entry -> ... -> origin
+    assert "h_a -> helper" in f.message
+    # anchored at the raise site, not the entry
+    assert "raise AppError" in open(f.path).read().splitlines()[f.line - 1]
+
+
+def test_boundary_mapping_retires_the_finding(tmp_path, monkeypatch):
+    """Adding the typed except arm at the boundary is the fix — the
+    mapped set is scanned from the AST, so the finding retires without
+    touching the pass."""
+    findings, _used = _run(tmp_path, monkeypatch, """
+        class AppError(Exception):
+            pass
+
+        def _dispatch(r):
+            try:
+                return h_a(r)
+            except AppError as e:
+                return ("mapped", str(e))
+
+        def helper():
+            raise AppError("boom")
+
+        def h_a(r):
+            helper()
+    """)
+    assert findings == [], [f.message for f in findings]
+
+
+def test_mapping_covers_subclasses(tmp_path, monkeypatch):
+    """``except Base`` at the boundary maps every derived type — the
+    catch test walks the project class hierarchy."""
+    findings, _used = _run(tmp_path, monkeypatch, """
+        class AppError(Exception):
+            pass
+
+        class SubError(AppError):
+            pass
+
+        def _dispatch(r):
+            try:
+                return h_a(r)
+            except AppError as e:
+                return ("mapped", str(e))
+
+        def h_a(r):
+            raise SubError("boom")
+    """)
+    assert findings == [], [f.message for f in findings]
+
+
+def test_reraise_as_mapped_type_is_clean(tmp_path, monkeypatch):
+    """Catching en route and re-raising as an already-mapped type is a
+    sanctioned translation, not an escape."""
+    findings, _used = _run(tmp_path, monkeypatch, """
+        class AppError(Exception):
+            pass
+
+        class MappedError(Exception):
+            pass
+
+        def _dispatch(r):
+            try:
+                return h_a(r)
+            except MappedError as e:
+                return ("mapped", str(e))
+
+        def helper():
+            raise AppError("boom")
+
+        def h_a(r):
+            try:
+                helper()
+            except AppError as e:
+                raise MappedError(str(e))
+    """)
+    assert findings == [], [f.message for f in findings]
+
+
+def test_swallowed_en_route_is_clean(tmp_path, monkeypatch):
+    findings, _used = _run(tmp_path, monkeypatch, """
+        class AppError(Exception):
+            pass
+
+        class MappedError(Exception):
+            pass
+
+        def _dispatch(r):
+            try:
+                return h_a(r)
+            except MappedError as e:
+                return ("mapped", str(e))
+
+        def helper():
+            raise AppError("boom")
+
+        def h_a(r):
+            try:
+                helper()
+            except AppError:
+                return None
+    """)
+    assert findings == [], [f.message for f in findings]
+
+
+def test_ext_raiser_builtin_is_flagged(tmp_path, monkeypatch):
+    """json.loads on untrusted bytes raises ValueError — a documented
+    external raiser IS flagged (unlike bare project-raised builtins)."""
+    findings, _used = _run(tmp_path, monkeypatch, """
+        import json
+
+        class MappedError(Exception):
+            pass
+
+        def _dispatch(r):
+            try:
+                return h_a(r)
+            except MappedError as e:
+                return ("mapped", str(e))
+
+        def h_a(r):
+            return json.loads(r)
+    """)
+    assert len(findings) == 1, [f.message for f in findings]
+    assert "json.loads()" in findings[0].message
+
+
+def test_bare_builtin_raise_is_not_flagged(tmp_path, monkeypatch):
+    """A validator raising ValueError itself is handler-layer 4xx
+    territory, not a boundary-contract gap."""
+    findings, _used = _run(tmp_path, monkeypatch, """
+        class MappedError(Exception):
+            pass
+
+        def _dispatch(r):
+            try:
+                return h_a(r)
+            except MappedError as e:
+                return ("mapped", str(e))
+
+        def h_a(r):
+            raise ValueError("bad arg")
+    """)
+    assert findings == [], [f.message for f in findings]
+
+
+def test_suppressed_raise_site_counts_as_used(tmp_path, monkeypatch):
+    findings, used = _run(tmp_path, monkeypatch, """
+        class AppError(Exception):
+            pass
+
+        class MappedError(Exception):
+            pass
+
+        def _dispatch(r):
+            try:
+                return h_a(r)
+            except MappedError as e:
+                return ("mapped", str(e))
+
+        def h_a(r):
+            raise AppError("ok")  # vmt: disable=VMT016
+    """)
+    assert findings == [], [f.message for f in findings]
+    (rel,) = used
+    assert any(rule == ef.RULE_ID for _ln, rule in used[rel])
+
+
+# -- the real tree's boundary contract --------------------------------------
+
+def test_real_boundaries_map_the_typed_failures():
+    """The scanned mapped sets carry the full contract: every typed
+    capacity/degradation failure has a non-anonymous arm at both
+    boundaries."""
+    from victoriametrics_tpu.devtools.callgraph import build_callgraph
+    g = build_callgraph(ef._default_paths())
+    bounds = ef.boundary_mappings(g)
+    http = {k.rpartition("::")[2].rpartition(".")[2]
+            for k in bounds["http"]["mapped"]}
+    for name in ("RateLimitedError", "SearchLimitError",
+                 "ClusterUnavailableError", "PartialResultError",
+                 "RPCError"):
+        assert name in http, (name, sorted(http))
+    rpc = {k.rpartition("::")[2].rpartition(".")[2]
+           for k in bounds["rpc"]["mapped"]}
+    for name in ("RateLimitedError", "SearchLimitError",
+                 "ClusterUnavailableError", "PartialResultError",
+                 "RPCError", "DeadlineExceededError"):
+        assert name in rpc, (name, sorted(rpc))
+
+
+def test_repo_tree_is_clean():
+    """The real tree carries ZERO baselined VMT016 findings — the
+    escapes the pass found got typed mappings (or their invariant
+    disables), not a grandfather list."""
+    findings, _used = ef.run_pass()
+    assert findings == [], [f.message for f in findings]
+
+
+# -- the runtime fixes VMT016 forced ----------------------------------------
+
+def test_rpc_typed_errors_reraise_client_side():
+    """The wire markers VMT016 forced: RateLimitedError crosses as
+    vm:rate-limited (retry_after_s preserved), ClusterUnavailableError
+    as vm:unavailable, PartialResultError as vm:partial-denied, and a
+    generic RPCError still round-trips as exactly RPCError."""
+    from victoriametrics_tpu.ingest.ratelimiter import RateLimitedError
+    from victoriametrics_tpu.parallel.rpc import (
+        HELLO_SELECT, ClusterUnavailableError, PartialResultError,
+        RPCClient, RPCError, RPCServer, Writer)
+
+    def h_rate(r):
+        raise RateLimitedError(7.2)
+
+    def h_unavail(r):
+        raise ClusterUnavailableError("no live storage node")
+
+    def h_partial(r):
+        raise PartialResultError("1 of 2 nodes answered")
+
+    def h_generic(r):
+        raise RPCError("rpc: truncated bytes field")
+
+    srv = RPCServer("127.0.0.1", 0, HELLO_SELECT,
+                    {"rate_v1": h_rate, "unavail_v1": h_unavail,
+                     "partial_v1": h_partial, "generic_v1": h_generic})
+    srv.start()
+    c = RPCClient("127.0.0.1", srv.port, HELLO_SELECT, timeout=30.0)
+    try:
+        with pytest.raises(RateLimitedError) as ei:
+            c.call("rate_v1", Writer())
+        assert ei.value.retry_after_s == 8  # ceil(7.2)
+        with pytest.raises(ClusterUnavailableError) as ei:
+            c.call("unavail_v1", Writer())
+        assert "no live storage node" in str(ei.value)
+        with pytest.raises(PartialResultError) as ei:
+            c.call("partial_v1", Writer())
+        assert "1 of 2 nodes" in str(ei.value)
+        with pytest.raises(RPCError) as ei:
+            c.call("generic_v1", Writer())
+        assert type(ei.value) is RPCError
+        assert "truncated bytes" in str(ei.value)
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_http_boundary_maps_cluster_errors():
+    """The HTTP arms VMT016 forced: ClusterUnavailableError -> 503
+    "unavailable" (capacity: retry elsewhere/later), PartialResultError
+    -> 503, RPCError -> 502 "storage_rpc" (bad backend, not a serving
+    bug) — never the anonymous 500."""
+    from tests.apptest_helpers import Client
+    from victoriametrics_tpu.httpapi.server import HTTPServer
+    from victoriametrics_tpu.parallel.rpc import (ClusterUnavailableError,
+                                                  PartialResultError,
+                                                  RPCError)
+
+    srv = HTTPServer(port=0)
+    srv.route("/boom/unavail",
+              lambda req: (_ for _ in ()).throw(
+                  ClusterUnavailableError("no node")))
+    srv.route("/boom/partial",
+              lambda req: (_ for _ in ()).throw(
+                  PartialResultError("denied")))
+    srv.route("/boom/rpc",
+              lambda req: (_ for _ in ()).throw(
+                  RPCError("peer hung up")))
+    srv.start()
+    cli = Client(srv.port)
+    try:
+        code, body = cli.get("/boom/unavail")
+        assert code == 503, body
+        assert json.loads(body)["errorType"] == "unavailable"
+        code, body = cli.get("/boom/partial")
+        assert code == 503, body
+        assert json.loads(body)["errorType"] == "unavailable"
+        code, body = cli.get("/boom/rpc")
+        assert code == 502, body
+        assert json.loads(body)["errorType"] == "storage_rpc"
+    finally:
+        srv.stop()

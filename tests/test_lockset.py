@@ -1,0 +1,326 @@
+"""Lockset-inference pass tests (devtools/lockset.py, rule VMT015).
+
+Fixture packages are synthesized in tmp_path so the pass runs against a
+known call graph: a field written from two concurrency roots with no
+common lock must be flagged with both witness chains; the consistently
+guarded twin — including guards inherited interprocedurally from a
+locked caller — must be clean.  Also pins the runtime fix VMT015
+forced: SLOEngine.expr_evals no longer loses updates under the
+deterministic scheduler (the counters moved under the engine lock)."""
+
+import textwrap
+
+from victoriametrics_tpu.devtools import lockset as ls
+
+# An RPC dispatch dict is recognized as a serving entry when it has
+# >= 3 "*_vN" string keys mapping to same-module handler names.
+_DISPATCH = """
+        HANDLERS = {
+            "a_v1": h_a,
+            "b_v1": h_b,
+            "c_v1": h_c,
+        }
+"""
+
+
+def _write_pkg(tmp_path, body: str):
+    d = tmp_path / "fixture_pkg"
+    d.mkdir()
+    (d / "srv.py").write_text(textwrap.dedent(body), encoding="utf-8")
+    return d
+
+
+def test_unguarded_two_root_write_is_flagged(tmp_path):
+    """Two serving entries funneling into the same unguarded module-
+    global write: the race condition proper."""
+    pkg = _write_pkg(tmp_path, """
+        import threading
+
+        STATS = {}
+        MU = threading.Lock()
+
+        def locked_read():
+            with MU:
+                return len(STATS)
+
+        def bump():
+            STATS["k"] = 1
+
+        def h_a(r):
+            bump()
+
+        def h_b(r):
+            bump()
+
+        def h_c(r):
+            pass
+    """ + _DISPATCH)
+    findings, _used = ls.run_pass(paths=[str(pkg)])
+    assert len(findings) == 1, [f.message for f in findings]
+    f = findings[0]
+    assert f.rule == ls.RULE_ID
+    assert "STATS" in f.message and "no consistent guard" in f.message
+    # both witness chains name their entry handler
+    assert "h_a" in f.message and "h_b" in f.message
+
+
+def test_guarded_everywhere_is_clean(tmp_path):
+    pkg = _write_pkg(tmp_path, """
+        import threading
+
+        STATS = {}
+        MU = threading.Lock()
+
+        def bump():
+            with MU:
+                STATS["k"] = 1
+
+        def h_a(r):
+            bump()
+
+        def h_b(r):
+            bump()
+
+        def h_c(r):
+            pass
+    """ + _DISPATCH)
+    findings, _used = ls.run_pass(paths=[str(pkg)])
+    assert findings == [], [f.message for f in findings]
+
+
+def test_mixed_guard_is_flagged(tmp_path):
+    """One root takes the lock, the other does not — the disjoint pair
+    is exactly the bug class (a 'mostly guarded' field is unguarded)."""
+    pkg = _write_pkg(tmp_path, """
+        import threading
+
+        STATS = {}
+        MU = threading.Lock()
+
+        def bump_locked():
+            with MU:
+                STATS["k"] = 1
+
+        def bump_bare():
+            STATS["k"] = 2
+
+        def h_a(r):
+            bump_locked()
+
+        def h_b(r):
+            bump_bare()
+
+        def h_c(r):
+            pass
+    """ + _DISPATCH)
+    findings, _used = ls.run_pass(paths=[str(pkg)])
+    assert len(findings) == 1, [f.message for f in findings]
+    assert "bump_bare" in findings[0].message
+
+
+def test_cross_call_guard_propagates(tmp_path):
+    """The write site itself has no ``with`` — the lock is held by the
+    CALLER on every path, which the per-root lockset intersection must
+    recognize as a consistent guard."""
+    pkg = _write_pkg(tmp_path, """
+        import threading
+
+        STATS = {}
+        MU = threading.Lock()
+
+        def inner():
+            STATS["k"] = 1
+
+        def outer():
+            with MU:
+                inner()
+
+        def h_a(r):
+            outer()
+
+        def h_b(r):
+            outer()
+
+        def h_c(r):
+            pass
+    """ + _DISPATCH)
+    findings, _used = ls.run_pass(paths=[str(pkg)])
+    assert findings == [], [f.message for f in findings]
+
+
+def test_cross_call_one_unlocked_path_is_flagged(tmp_path):
+    """Same write site, but one root reaches it around the locked
+    caller: the path intersection drops the lock and the pair races."""
+    pkg = _write_pkg(tmp_path, """
+        import threading
+
+        STATS = {}
+        MU = threading.Lock()
+
+        def inner():
+            STATS["k"] = 1
+
+        def outer():
+            with MU:
+                inner()
+
+        def h_a(r):
+            outer()
+
+        def h_b(r):
+            inner()
+
+        def h_c(r):
+            pass
+    """ + _DISPATCH)
+    findings, _used = ls.run_pass(paths=[str(pkg)])
+    assert len(findings) == 1, [f.message for f in findings]
+
+
+def test_thread_target_is_a_root(tmp_path):
+    """A ``threading.Thread(target=...)`` spawn makes the target its own
+    concurrency root — one serving entry plus one background thread is
+    already a two-root race."""
+    pkg = _write_pkg(tmp_path, """
+        import threading
+
+        STATS = {}
+        MU = threading.Lock()
+
+        def locked_read():
+            with MU:
+                return len(STATS)
+
+        def worker():
+            STATS["k"] = 2
+
+        def start():
+            threading.Thread(target=worker).start()
+
+        def h_a(r):
+            STATS["k"] = 1
+
+        def h_b(r):
+            pass
+
+        def h_c(r):
+            pass
+    """ + _DISPATCH)
+    findings, _used = ls.run_pass(paths=[str(pkg)])
+    assert len(findings) == 1, [f.message for f in findings]
+    assert "thread worker" in findings[0].message
+
+
+def test_single_root_is_not_flagged(tmp_path):
+    """One root cannot race with itself — handler-serial mutation is
+    out of scope no matter how unguarded it looks."""
+    pkg = _write_pkg(tmp_path, """
+        import threading
+
+        STATS = {}
+        MU = threading.Lock()
+
+        def locked_read():
+            with MU:
+                return len(STATS)
+
+        def h_a(r):
+            STATS["k"] = 1
+
+        def h_b(r):
+            pass
+
+        def h_c(r):
+            pass
+    """ + _DISPATCH)
+    findings, _used = ls.run_pass(paths=[str(pkg)])
+    assert findings == [], [f.message for f in findings]
+
+
+def test_suppressed_access_site_counts_as_used(tmp_path):
+    """A disable on ANY access site of the field suppresses the finding
+    and is reported consumed (so VMT013 won't call it stale)."""
+    pkg = _write_pkg(tmp_path, """
+        import threading
+
+        STATS = {}
+        MU = threading.Lock()
+
+        def locked_read():
+            with MU:
+                return len(STATS)
+
+        def bump():
+            STATS["k"] = 1  # vmt: disable=VMT015
+
+        def h_a(r):
+            bump()
+
+        def h_b(r):
+            bump()
+
+        def h_c(r):
+            pass
+    """ + _DISPATCH)
+    findings, used = ls.run_pass(paths=[str(pkg)])
+    assert findings == [], [f.message for f in findings]
+    (rel,) = used
+    assert any(rule == ls.RULE_ID for _ln, rule in used[rel])
+
+
+def test_repo_tree_is_clean():
+    """The real tree carries ZERO baselined VMT015 findings — the races
+    the pass found were fixed (or disabled with their invariant), not
+    grandfathered."""
+    findings, _used = ls.run_pass()
+    assert findings == [], [f.message for f in findings]
+
+
+# -- the runtime fix VMT015 forced ------------------------------------------
+
+def test_sloplane_counters_keep_no_lost_updates():
+    """VMT015 flagged SLOEngine.expr_evals: written from the self-scrape
+    tick and the ``?pump=1`` HTTP seam with no common lock.  Pre-fix,
+    the deterministic scheduler reproduced lost updates (9/12 at
+    seed=1); post-fix (counters under the engine lock) every
+    interleaving lands 12/12 with zero sanitizer reports."""
+    from victoriametrics_tpu.devtools import racetrace, sched
+    from victoriametrics_tpu.query.sloplane import SLOEngine, SLOSpec
+
+    class _Streams:
+        def instant_vector(self, expr, ts_ms, tenant):
+            return []
+
+    class _API:
+        matstreams = _Streams()
+
+    names = ("expr_evals",)
+    racetrace.traced_fields(*names)(SLOEngine)
+    try:
+        for seed in range(5):
+            racetrace.reset()
+            racetrace.enable()
+            try:
+                eng = SLOEngine(
+                    api=_API(),
+                    specs=[SLOSpec("t", 99.0,
+                                   {"bad": "bad{w}", "total": "tot{w}"})],
+                    windows=[("5m", "1h", 14.4)],
+                    interval_s=0.05, period="24h")
+                s = sched.DeterministicScheduler(seed=seed)
+                s.spawn("t0", lambda: eng.maybe_eval(force=True))
+                s.spawn("t1", lambda: eng.maybe_eval(force=True))
+                s.run(timeout=30)
+                # 2 rounds x 2 exprs x 3 windows
+                assert eng.expr_evals == 12, \
+                    f"seed={seed}: lost update ({eng.expr_evals}/12)"
+                races = [r for r in racetrace.reports()
+                         if r.field == "expr_evals"]
+                assert races == [], races
+            finally:
+                racetrace.disable()
+    finally:
+        try:
+            racetrace._registry.remove((SLOEngine, names))
+        except ValueError:
+            pass

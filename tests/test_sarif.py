@@ -1,0 +1,108 @@
+"""SARIF 2.1.0 emitter tests (devtools/sarif.py).
+
+``to_sarif`` output must validate against the vendored structural
+subset of the official SARIF 2.1.0 schema
+(devtools/sarif_schema_2.1.0.json) — so CI/code-scanning upload
+endpoints that consume SARIF accept vmt-lint's logs — and the lint CLI
+``--format=sarif`` path must emit exactly one parseable log on stdout
+with the same exit-code contract as text mode."""
+
+import json
+import os
+
+import jsonschema
+import pytest
+
+from victoriametrics_tpu.devtools import sarif
+from victoriametrics_tpu.devtools.lint import Finding
+
+_SCHEMA_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(sarif.__file__)),
+    "sarif_schema_2.1.0.json")
+
+
+@pytest.fixture(scope="module")
+def schema():
+    with open(_SCHEMA_PATH, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _validate(log, schema):
+    jsonschema.validate(log, schema,
+                        format_checker=jsonschema.FormatChecker())
+
+
+def test_empty_log_validates(schema):
+    log = sarif.to_sarif([])
+    _validate(log, schema)
+    assert log["version"] == "2.1.0"
+    assert log["runs"][0]["results"] == []
+    assert log["runs"][0]["tool"]["driver"]["name"] == "vmt-lint"
+
+
+def test_findings_log_validates_with_rule_catalog(schema):
+    findings = [
+        Finding("victoriametrics_tpu/query/engine.py", 42, "VMT015",
+                "field x has no consistent guard"),
+        Finding("victoriametrics_tpu/parallel/rpc.py", 7, "VMT016",
+                "AppError escapes to the rpc boundary"),
+        Finding("victoriametrics_tpu/utils/fs.py", 0, "VMT001",
+                "zero line anchors clamp to 1"),
+    ]
+    log = sarif.to_sarif(findings, {"VMT015": "lockset inference",
+                                    "VMT016": "exception-escape audit"})
+    _validate(log, schema)
+    run = log["runs"][0]
+    rules = run["tool"]["driver"]["rules"]
+    rule_ids = [r["id"] for r in rules]
+    # every emitted result's ruleIndex points back at its catalog row
+    for res in run["results"]:
+        assert rule_ids[res["ruleIndex"]] == res["ruleId"]
+        assert res["level"] == "error"
+        region = res["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1   # SARIF forbids line 0
+        art = res["locations"][0]["physicalLocation"]["artifactLocation"]
+        assert art["uriBaseId"] == "SRCROOT"
+    # summaries attached where provided
+    by_id = {r["id"]: r for r in rules}
+    assert by_id["VMT015"]["shortDescription"]["text"] == \
+        "lockset inference"
+
+
+def test_mutated_log_fails_validation(schema):
+    """The vendored schema is a real gate, not a rubber stamp: break a
+    required property and validation must reject the log."""
+    log = sarif.to_sarif(
+        [Finding("a.py", 1, "VMT001", "m")])
+    log["version"] = "9.9.9"
+    with pytest.raises(jsonschema.ValidationError):
+        _validate(log, schema)
+    log = sarif.to_sarif([Finding("a.py", 1, "VMT001", "m")])
+    del log["runs"][0]["results"][0]["message"]
+    with pytest.raises(jsonschema.ValidationError):
+        _validate(log, schema)
+
+
+def test_lint_cli_sarif_output_validates(schema, capsys):
+    """``lint --format=sarif`` on one clean file: exit 0, stdout is one
+    valid SARIF log, diagnostics stay off stdout."""
+    from victoriametrics_tpu.devtools import lint
+    rc = lint.main(["--format=sarif", "--no-program-passes",
+                    "victoriametrics_tpu/devtools/sarif.py"])
+    out = capsys.readouterr().out
+    log = json.loads(out)
+    _validate(log, schema)
+    assert rc == 0
+    assert log["runs"][0]["results"] == []
+
+
+def test_errorflow_cli_sarif_output_validates(schema, capsys):
+    """The standalone pass CLIs share the emitter: errorflow
+    --format=sarif over a tiny fixture-free path emits a valid log."""
+    from victoriametrics_tpu.devtools import errorflow
+    rc = errorflow.main(["--format=sarif",
+                         "victoriametrics_tpu/devtools/sarif.py"])
+    out = capsys.readouterr().out
+    log = json.loads(out)
+    _validate(log, schema)
+    assert rc == 0

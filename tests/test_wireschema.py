@@ -52,7 +52,8 @@ def test_lockfile_covers_every_rpc_method_and_format():
     missing = sorted(set(dispatch) - set(lock["rpc"]))
     assert missing == [], f"RPC methods missing from lockfile: {missing}"
     for fmt in ("metadata.json", "parts.json", "ring_exempt.bin",
-                "adopted_mid.json", "ring_config"):
+                "adopted_mid.json", "ring_config", "health_v1_report",
+                "incident_record"):
         assert fmt in lock["formats"], fmt
     # the four search_v1 trailing generations are all tracked tolerant
     req = lock["rpc"]["search_v1"]["request"]
@@ -139,6 +140,65 @@ def test_renamed_json_key_is_breaking(srcs):
                   'int(_json.load(f)["maxid"])')
     code, msgs, _ = ws.check(sources={ST: mut})
     assert code == ws.EXIT_BREAKING, msgs
+
+
+# -- PR-17 surfaces: health_v1 report + incident record ---------------------
+
+SL = "victoriametrics_tpu/query/sloplane.py"
+
+
+def test_health_and_incident_formats_are_locked():
+    """The health_v1 response body and the persisted incident record are
+    under the ratchet, with the keys the repo itself depends on."""
+    with open(ws.LOCKFILE, encoding="utf-8") as fh:
+        lock = json.load(fh)
+    health = lock["formats"]["health_v1_report"]
+    assert health["external_readers"] is True
+    for k in ("status", "verdict", "reasons", "nodes", "ring", "node"):
+        assert k in health["writer_keys"], k
+    # the roll-up must TOLERATE, never require, what an old node omits
+    assert health["reader_required"] == []
+    assert "verdict" in health["reader_tolerated"]
+    assert "reasons" in health["reader_tolerated"]
+    inc = lock["formats"]["incident_record"]
+    assert inc["reader_required"] == ["id", "slo"]
+    for k in ("severity", "burn", "flightCaptureId", "profile",
+              "topQueries", "tenantUsage", "health"):
+        assert k in inc["writer_keys"], k
+
+
+def test_incident_required_key_removal_is_breaking(srcs):
+    """Dropping ``slo`` from the frozen record orphans the ring's own
+    required read — pairing catches it before the lockfile diff."""
+    mut = _mutate(srcs[SL], '"slo": spec.name, ', '')
+    code, msgs, _ = ws.check(sources={SL: mut})
+    assert code == ws.EXIT_BREAKING, msgs
+    assert any("reader requires" in m for m in msgs), msgs
+
+
+def test_incident_reader_new_requirement_is_breaking(srcs):
+    """A summary projection that starts REQUIRING a key old records may
+    lack (pre-upgrade incidents still in the ring) is breaking."""
+    mut = _mutate(srcs[SL], '"burn": rec.get("burn"),',
+                  '"burn": rec["burn"],', count=1)
+    code, msgs, _ = ws.check(sources={SL: mut})
+    assert code == ws.EXIT_BREAKING, msgs
+    assert any("REQUIRES" in m for m in msgs), msgs
+
+
+def test_health_new_writer_key_is_additive(srcs, tmp_path):
+    """external_readers: a new health key with no in-repo reader is NOT
+    a dead-key pairing failure (dashboards read it) — just additive
+    drift until the lockfile is regenerated."""
+    mut = _mutate(srcs[SL], '"status": "success",',
+                  '"status": "success",\n        "buildId": 1,')
+    code, msgs, cur = ws.check(sources={SL: mut})
+    assert code == ws.EXIT_ADDITIVE, msgs
+    assert any("buildId" in m for m in msgs), msgs
+    lockfile = str(tmp_path / "wire_schema.lock.json")
+    ws.write_lockfile(cur, lockfile)
+    code, msgs, _ = ws.check(sources={SL: mut}, lockfile=lockfile)
+    assert code == ws.EXIT_OK, msgs
 
 
 # -- additive extension: drift until --update-schema, then clean ------------

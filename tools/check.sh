@@ -10,8 +10,13 @@
 # Stages (each independently skippable, same flags tools/lint.sh uses):
 #   lint       full lint: per-file rules + call-graph passes (VMT012
 #              deadline taint, VMT013 stale disables, VMT014 env-flag
-#              inventory) + the wire-schema ratchet (exit 4 breaking /
+#              inventory, VMT015 lockset, VMT016 errorflow) + the
+#              wire-schema ratchet (exit 4 breaking /
 #              2 additive drift)            VMT_NO_LINT=1
+#   lockset    VMT015 standalone (guarded-by inference, own timing
+#              and witness output)          VMT_NO_LOCKSET=1
+#   errorflow  VMT016 standalone (exception-escape audit)
+#                                           VMT_NO_ERRORFLOW=1
 #   flight     flight-recorder overhead     VMT_NO_FLIGHT_SMOKE=1
 #   profile    continuous-profiler overhead VMT_NO_PROFILE_SMOKE=1
 #   matstream  materialized-stream fan-out  VMT_NO_MATSTREAM_SMOKE=1
@@ -59,6 +64,16 @@ if [ "${VMT_NO_LINT:-0}" != "1" ]; then
     run_stage lint python -m victoriametrics_tpu.devtools.lint
 else
     skipped lint
+fi
+if [ "${VMT_NO_LOCKSET:-0}" != "1" ]; then
+    run_stage lockset python -m victoriametrics_tpu.devtools.lockset
+else
+    skipped lockset
+fi
+if [ "${VMT_NO_ERRORFLOW:-0}" != "1" ]; then
+    run_stage errorflow python -m victoriametrics_tpu.devtools.errorflow
+else
+    skipped errorflow
 fi
 if [ "${VMT_NO_FLIGHT_SMOKE:-0}" != "1" ]; then
     run_stage flight python -m victoriametrics_tpu.devtools.flight_overhead

@@ -28,9 +28,11 @@ set -eu
 cd "$(dirname "$0")/.."
 # --changed-only: lint just the .py files that differ from the merge
 # base (VMT_CHANGED_BASE, default main) plus untracked ones — the fast
-# inner loop while editing.  Path-scoped runs skip the program passes
-# (call-graph/wireschema/deadline-taint need the whole package) and the
-# smokes; the full gate is tools/check.sh.
+# inner loop while editing.  The call-graph passes (VMT012/VMT015/
+# VMT016) still run — built over the WHOLE package, since they are
+# interprocedural — but report only findings landing in the changed
+# files (--scoped-program-passes); wireschema and the smokes stay
+# full-gate-only (tools/check.sh).
 if [ "${1:-}" = "--changed-only" ]; then
     shift
     base=$(git merge-base HEAD "${VMT_CHANGED_BASE:-main}" 2>/dev/null \
@@ -47,7 +49,8 @@ if [ "${1:-}" = "--changed-only" ]; then
         exit 0
     fi
     # shellcheck disable=SC2086
-    exec python -m victoriametrics_tpu.devtools.lint $files "$@"
+    exec python -m victoriametrics_tpu.devtools.lint \
+        --scoped-program-passes $files "$@"
 fi
 if [ "$#" -eq 0 ]; then
     set -- victoriametrics_tpu/

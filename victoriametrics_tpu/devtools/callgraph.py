@@ -28,10 +28,31 @@ edge to ``f`` — work handed to a thread or the shared workpool still
 runs on behalf of the submitting path, which is exactly what the
 deadline-taint pass (VMT012) needs to see.
 
+Since PR 18 every edge also carries its *context*:
+
+- ``locks`` — the lock identities lexically held at the call site
+  (``with self._lock:`` regions; identities resolve through the
+  ``make_lock``/``make_rlock`` name registry, so ``self._lock`` in two
+  modules guarding the same ``make_lock("storage.Storage._lock")``
+  instance unify).  The lockset pass (VMT015) intersects these along
+  call chains to infer which lock guards each field.
+- ``caught`` — the exception-type keys of every enclosing
+  ``try/except`` at the call site.  The errorflow pass (VMT016) stops
+  propagating an escaping exception type at the first frame that
+  catches it.
+
+Alongside edges the builder now records per-def *field accesses*
+(``self.attr`` and module-global mutable containers, read vs write,
+with the lexically-held locks), *raise sites* (resolved exception-type
+keys with their enclosing handlers) and the ``make_lock`` name
+bindings + exception base-class map those passes resolve against.
+
 Consumers: :mod:`devtools.deadline_taint` (serving-path blocking-call
-reachability) and :mod:`devtools.wireschema` (marshal/unmarshal helper
-resolution).  Build cost is one AST parse per file (~100 files, well
-under a second) — cheap enough for every full lint run.
+reachability), :mod:`devtools.lockset` (VMT015 guarded-by inference),
+:mod:`devtools.errorflow` (VMT016 exception-escape audit) and
+:mod:`devtools.wireschema` (marshal/unmarshal helper resolution).
+Build cost is one AST parse per file (~120 files, well under a
+second) — cheap enough for every full lint run.
 """
 
 from __future__ import annotations
@@ -39,8 +60,10 @@ from __future__ import annotations
 import ast
 import dataclasses
 import os
+import re
 
-from .lint import dotted_name, iter_py_files, normalize_path
+from .lint import _SUPPRESS_RE, dotted_name, iter_py_files, normalize_path
+from .rules_locks import lockish_name
 
 #: attribute names too generic to resolve by name alone: linking every
 #: ``.get()`` to every class with a ``get`` method would connect the
@@ -61,6 +84,47 @@ _GENERIC_ATTRS = {
 #: past this the name is effectively generic and edges would be noise
 _MAX_ATTR_CANDIDATES = 8
 
+#: receiver methods that mutate their container in place — a call like
+#: ``self._cache.pop(k)`` is a WRITE access to the ``_cache`` field
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "discard", "clear", "pop",
+    "popitem", "popleft", "appendleft", "update", "setdefault", "sort",
+    "reverse", "add",
+}
+
+#: constructor names whose module-level result is shared mutable state
+_GLOBAL_CTORS = {
+    "dict", "list", "set", "OrderedDict", "defaultdict", "deque",
+    "Counter", "WeakValueDictionary",
+}
+
+#: keyword-argument names that hand a callable over for deferred
+#: invocation on some other thread of control (service-thread ticks,
+#: completion hooks) — matched literally or by the on_* prefix
+_CALLBACK_KW_RE = re.compile(r"^on_[a-z0-9_]+$|^(callback|cb|hook)$")
+
+#: external (non-project) callables with a documented raise contract the
+#: errorflow pass should see: wire/payload parsing that throws on bad
+#: input.  Kept deliberately tiny — flagging every int()/float() guard
+#: in the tree would drown the real boundary gaps.
+EXT_RAISERS = {
+    "json.loads": "ValueError",
+    "json.load": "ValueError",
+}
+
+
+def _make_lock_name(call) -> str | None:
+    """The registry name of a ``make_lock("...")``/``make_rlock("...")``
+    construction, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    dn = dotted_name(call.func)
+    if dn and dn.rpartition(".")[2] in ("make_lock", "make_rlock") and \
+            call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
 
 @dataclasses.dataclass
 class FuncDef:
@@ -76,7 +140,17 @@ class FuncDef:
 class Edge:
     target: str                 # callee qname
     lineno: int
-    kind: str                   # "call" | "thread" | "submit" | "ref"
+    #: "call" | "thread" | "submit" | "ref" | "cbref" — cbref marks a
+    #: callable handed over via a callback-shaped keyword argument
+    #: (``on_tick=...``): it runs later on whatever thread the receiver
+    #: invokes it from, so lockset treats the target as its own root
+    kind: str
+    #: lock identities lexically held at the call site (VMT015);
+    #: empty for thread/submit edges — the spawned work runs in its own
+    #: context and does not inherit the spawner's critical section
+    locks: tuple = ()
+    #: exception-type keys of enclosing try/except handlers (VMT016)
+    caught: tuple = ()
 
 
 class CallGraph:
@@ -98,6 +172,22 @@ class CallGraph:
         #: rel_path -> module ast (for passes that re-walk, e.g. wireschema)
         self.module_trees: dict[str, object] = {}
         self.sources: dict[str, str] = {}
+        #: qname -> [(field_id, "read"|"write", lineno, locks)] — accesses
+        #: to self.* fields / module-global containers (VMT015)
+        self.accesses: dict[str, list[tuple]] = {}
+        #: qname -> [(type_key, lineno, caught)] raise sites (VMT016);
+        #: type_key is a project class qname or a builtin exception name
+        self.raises: dict[str, list[tuple]] = {}
+        #: qname -> [(dotted, lineno, caught)] calls into EXT_RAISERS
+        self.ext_calls: dict[str, list[tuple]] = {}
+        #: ("relpath::Class", attr) / (relpath, var) -> make_lock name
+        self.lock_names: dict[tuple[str, str], str] = {}
+        #: class qname -> base names with builtins KEPT as bare names
+        #: (g.bases drops non-project bases; exception-hierarchy walks
+        #: need RuntimeError/ValueError/... to stay visible)
+        self.exc_bases: dict[str, list[str]] = {}
+        #: rel_path -> {module-level mutable-global name -> lineno}
+        self.module_globals: dict[str, dict[str, int]] = {}
 
     # -- queries ----------------------------------------------------------
 
@@ -169,6 +259,55 @@ class CallGraph:
         if not tail:
             return q
         return self.methods.get(q, {}).get(tail)
+
+
+# -- shared pass helpers -----------------------------------------------------
+
+def source_suppressed(g: CallGraph, rel: str, lineno: int,
+                      rule_id: str) -> bool:
+    """True when the source line carries ``# vmt: disable=<rule_id>`` —
+    the inline-suppression check shared by the whole-program passes."""
+    src = g.sources.get(rel)
+    if src is None:
+        return False
+    lines = src.splitlines()
+    if not (1 <= lineno <= len(lines)):
+        return False
+    m = _SUPPRESS_RE.search(lines[lineno - 1])
+    return bool(m) and rule_id in {
+        s.strip().upper() for s in m.group(1).split(",")}
+
+
+def lock_identity(g: CallGraph, rel: str, cls_q: str | None, expr,
+                  local_locks: dict[str, str]) -> str | None:
+    """Stable identity of a lock-looking ``with`` context expression.
+
+    A lock constructed via ``make_lock("storage.Storage._lock")`` is
+    identified by that registry name wherever it is held — the name is
+    the cross-module identity.  Unregistered locks fall back to a
+    lexical id (``relpath::Class.attr`` / ``relpath::var``), which still
+    unifies accesses within one class/module."""
+    dn = lockish_name(expr)
+    if dn is None:
+        return None
+    head, _, rest = dn.partition(".")
+    if head in ("self", "cls") and cls_q and rest:
+        seen: set[str] = set()
+        stack = [cls_q]
+        while stack:  # inherited locks bind in a base's __init__
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            named = g.lock_names.get((c, rest))
+            if named is not None:
+                return named
+            stack.extend(g.bases.get(c, []))
+        return f"{cls_q}.{rest}"
+    if not rest and dn in local_locks:
+        return local_locks[dn]
+    named = g.lock_names.get((rel, head if not rest else dn))
+    return named or f"{rel}::{dn}"
 
 
 # -- builder ----------------------------------------------------------------
@@ -341,7 +480,7 @@ class _EdgeBuilder:
         """Callable-reference expressions inside a submit/run argument:
         bare names, ``partial(f, ...)``, list/comprehension elements."""
         out = []
-        if isinstance(node, (ast.Name, ast.Attribute)):
+        if isinstance(node, (ast.Name, ast.Attribute, ast.Lambda)):
             out.append(node)
         elif isinstance(node, ast.Call):
             dn = dotted_name(node.func)
@@ -357,36 +496,200 @@ class _EdgeBuilder:
             out.extend(self._callable_refs(node.value))
         return out
 
+    def _lambda_q(self, lineno: int) -> str | None:
+        suffix = f"<lambda@{lineno}>"
+        for q in self.g.defs:
+            if q.startswith(self.rel + "::") and q.endswith(suffix):
+                return q
+        return None
+
+    def _ref_qnames(self, ref, scope_defs, cls_q, types) -> list[str]:
+        if isinstance(ref, ast.Lambda):
+            q = self._lambda_q(ref.lineno)
+            return [q] if q else []
+        rdn = dotted_name(ref)
+        if not rdn:
+            return []
+        return self._resolve_dotted(rdn, scope_defs, cls_q, types)
+
     def build(self, fd: FuncDef, scope_defs: list[dict], cls_q,
               types: dict):
         edges = self.g.edges.setdefault(fd.qname, [])
+        accesses = self.g.accesses.setdefault(fd.qname, [])
+        raise_sites = self.g.raises.setdefault(fd.qname, [])
+        ext_calls = self.g.ext_calls.setdefault(fd.qname, [])
         seen = set()
+        local_locks: dict[str, str] = {}
+        skip_reads: set[int] = set()    # node ids already counted
 
-        def add(q: str | None, lineno: int, kind: str):
-            if q and q != fd.qname and (q, kind) not in seen:
-                seen.add((q, kind))
-                edges.append(Edge(q, lineno, kind))
+        node0 = fd.node
+        body = [node0.body] if isinstance(node0, ast.Lambda) \
+            else list(node0.body)
 
-        body = fd.node.body if not isinstance(fd.node, ast.Lambda) \
-            else [fd.node.body]
+        # names the function binds locally: a bare Name only refers to a
+        # module global when the function neither assigns it nor takes
+        # it as a parameter (or re-exports it via `global`)
+        local_names: set[str] = set()
+        global_names: set[str] = set()
+        a = node0.args
+        for arg in (list(a.args) + list(a.posonlyargs) +
+                    list(a.kwonlyargs) +
+                    ([a.vararg] if a.vararg else []) +
+                    ([a.kwarg] if a.kwarg else [])):
+            local_names.add(arg.arg)
         stack = list(body)
         while stack:
-            node = stack.pop()
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue  # nested defs get their own edge sets
-            if isinstance(node, ast.Lambda):
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_names.add(n.name)
                 continue
-            # local constructor type hints: x = ClassName(...)
+            if isinstance(n, ast.Lambda):
+                continue
+            if isinstance(n, ast.Global):
+                global_names.update(n.names)
+            elif isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, (ast.Store, ast.Del)):
+                local_names.add(n.id)
+            stack.extend(ast.iter_child_nodes(n))
+        local_names -= global_names
+        mod_globals = self.g.module_globals.get(self.rel, {})
+
+        def add(q: str | None, lineno: int, kind: str,
+                locks: tuple = (), caught: tuple = ()):
+            key = (q, kind, locks, caught)
+            if q and q != fd.qname and key not in seen:
+                seen.add(key)
+                edges.append(Edge(q, lineno, kind, locks, caught))
+
+        def field_of(expr):
+            """Field id for a self-attribute / module-global access,
+            else None.  Subscript chains unwrap to their base
+            (``self._cache[k]`` accesses ``_cache``)."""
+            while isinstance(expr, ast.Subscript):
+                expr = expr.value
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id in ("self", "cls") and cls_q:
+                if lockish_name(expr) or \
+                        self.g.class_method(cls_q, expr.attr) is not None:
+                    return None   # the lock itself / a bound method
+                return f"{cls_q}.{expr.attr}"
+            if isinstance(expr, ast.Name) and expr.id in mod_globals and \
+                    expr.id not in local_names and \
+                    lockish_name(expr) is None:
+                return f"{self.rel}::{expr.id}"
+            return None
+
+        def exc_keys(tnode) -> tuple:
+            """Type keys of an except clause: project class qnames when
+            resolvable, bare builtin names otherwise; "*" for bare
+            except / Exception / BaseException."""
+            if tnode is None:
+                return ("*",)
+            elts = tnode.elts if isinstance(tnode, ast.Tuple) else [tnode]
+            keys = []
+            for t in elts:
+                dn = dotted_name(t)
+                if not dn:
+                    continue
+                last = dn.rpartition(".")[2]
+                if last in ("Exception", "BaseException"):
+                    keys.append("*")
+                    continue
+                q = self.g.lookup(self.rel, dn)
+                if q is None and "." not in dn:
+                    q = self._resolve_name(dn, scope_defs)
+                keys.append(q if q in self.g.methods else last)
+            return tuple(keys) or ("*",)
+
+        def record_raise(node, caught, hvars, htypes):
+            if node.exc is None:       # bare re-raise inside a handler
+                for k in htypes:
+                    if k != "*":
+                        raise_sites.append((k, node.lineno, caught))
+                return
+            e = node.exc
+            target = e.func if isinstance(e, ast.Call) else e
+            dn = dotted_name(target)
+            if not dn:
+                return
+            if dn in hvars:            # `raise e` of the caught exc
+                for k in hvars[dn]:
+                    if k != "*":
+                        raise_sites.append((k, node.lineno, caught))
+                return
+            q = self.g.lookup(self.rel, dn)
+            if q is None and "." not in dn:
+                q = self._resolve_name(dn, scope_defs)
+            key = q if q in self.g.methods else dn.rpartition(".")[2]
+            raise_sites.append((key, node.lineno, caught))
+
+        def visit(node, locks, caught, hvars, htypes):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return   # nested defs get their own edge sets
+            if isinstance(node, ast.Try):
+                handler_keys = tuple(k for h in node.handlers
+                                     for k in exc_keys(h.type))
+                # `try: ... finally: X.release()` brackets a lock region
+                # even when the acquire is out of line (a conditional
+                # try-acquire, or a helper returning with the lock HELD,
+                # e.g. Storage._acquire_cspace) — the body runs under X
+                body_locks = locks
+                for n in node.finalbody:
+                    if isinstance(n, ast.Expr) \
+                            and isinstance(n.value, ast.Call) \
+                            and isinstance(n.value.func, ast.Attribute) \
+                            and n.value.func.attr == "release" \
+                            and lockish_name(n.value.func.value):
+                        lid = lock_identity(self.g, self.rel, cls_q,
+                                            n.value.func.value, local_locks)
+                        if lid and lid not in body_locks:
+                            body_locks = body_locks + (lid,)
+                for n in node.body:
+                    visit(n, body_locks, caught + handler_keys, hvars,
+                          htypes)
+                for h in node.handlers:
+                    keys = exc_keys(h.type)
+                    hv = dict(hvars)
+                    if h.name:
+                        hv[h.name] = keys
+                    for n in h.body:   # handler body: outer tries only
+                        visit(n, locks, caught, hv, keys)
+                for n in node.orelse:  # else runs before finally: held
+                    visit(n, body_locks, caught, hvars, htypes)
+                for n in node.finalbody:
+                    visit(n, locks, caught, hvars, htypes)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_locks = locks
+                for item in node.items:
+                    visit(item.context_expr, locks, caught, hvars, htypes)
+                    lid = lock_identity(self.g, self.rel, cls_q,
+                                        item.context_expr, local_locks)
+                    if lid and lid not in new_locks:
+                        new_locks = new_locks + (lid,)
+                for n in node.body:
+                    visit(n, new_locks, caught, hvars, htypes)
+                return
+            if isinstance(node, ast.Raise):
+                record_raise(node, caught, hvars, htypes)
+            # local lock construction + constructor type hints
             if isinstance(node, ast.Assign) and \
                     isinstance(node.value, ast.Call):
+                lname = _make_lock_name(node.value)
                 dn = dotted_name(node.value.func)
+                tq = None
                 if dn:
                     tq = self.g.lookup(self.rel, dn) or \
                         self._resolve_name(dn, scope_defs)
+                for t in node.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if lname:
+                        local_locks[t.id] = lname
                     if tq in self.g.methods:  # it's a class
-                        for t in node.targets:
-                            if isinstance(t, ast.Name):
-                                types[t.id] = tq
+                        types[t.id] = tq
             if isinstance(node, ast.Call):
                 dn = dotted_name(node.func)
                 if dn:
@@ -395,38 +698,88 @@ class _EdgeBuilder:
                         for kw in node.keywords:
                             if kw.arg == "target":
                                 for ref in self._callable_refs(kw.value):
-                                    rdn = dotted_name(ref)
-                                    if rdn:
-                                        for q in self._resolve_dotted(
-                                                rdn, scope_defs, cls_q,
-                                                types):
-                                            add(q, node.lineno, "thread")
+                                    for q in self._ref_qnames(
+                                            ref, scope_defs, cls_q, types):
+                                        add(q, node.lineno, "thread")
                     elif last in ("submit", "run") and \
                             isinstance(node.func, ast.Attribute):
-                        for a in list(node.args):
-                            for ref in self._callable_refs(a):
-                                rdn = dotted_name(ref)
-                                if rdn:
-                                    for q in self._resolve_dotted(
-                                            rdn, scope_defs, cls_q, types):
-                                        add(q, node.lineno, "submit")
-                    for q in self._resolve_dotted(dn, scope_defs, cls_q,
-                                                  types):
+                        for arg in list(node.args):
+                            for ref in self._callable_refs(arg):
+                                for q in self._ref_qnames(
+                                        ref, scope_defs, cls_q, types):
+                                    add(q, node.lineno, "submit")
+                    # callback-shaped keyword: the callable escapes into
+                    # the receiver and runs on ITS thread later
+                    for kw in node.keywords:
+                        if kw.arg and _CALLBACK_KW_RE.match(kw.arg):
+                            for ref in self._callable_refs(kw.value):
+                                for q in self._ref_qnames(
+                                        ref, scope_defs, cls_q, types):
+                                    add(q, node.lineno, "cbref")
+                    resolved = self._resolve_dotted(dn, scope_defs, cls_q,
+                                                    types)
+                    for q in resolved:
                         # constructor call -> edge to __init__
                         if q in self.g.methods:
                             q = self.g.methods[q].get("__init__")
-                        add(q, node.lineno, "call")
+                        add(q, node.lineno, "call", locks, caught)
+                    if not resolved and dn in EXT_RAISERS:
+                        ext_calls.append((dn, node.lineno, caught))
+                elif isinstance(node.func, ast.Attribute):
+                    # method call on a computed receiver — e.g.
+                    # ``api.init_sloplane().maybe_eval(...)`` — falls
+                    # back to distinctive-attribute-name resolution
+                    for q in self._by_attr_name(node.func.attr):
+                        add(q, node.lineno, "call", locks, caught)
                 # callback handoff: a bare function name passed as an
                 # argument (``self._fan_stripes(by_shard, do_register)``)
                 # still runs on behalf of this caller — lexical
                 # resolution only, so dict/str arguments add no noise
-                for a in list(node.args) + \
+                for arg in list(node.args) + \
                         [kw.value for kw in node.keywords]:
-                    if isinstance(a, ast.Name):
-                        q = self._resolve_name(a.id, scope_defs)
+                    if isinstance(arg, ast.Name):
+                        q = self._resolve_name(arg.id, scope_defs)
                         if q is not None and q in self.g.defs:
-                            add(q, node.lineno, "ref")
-            stack.extend(ast.iter_child_nodes(node))
+                            add(q, node.lineno, "ref", locks, caught)
+                # in-place mutation through a container method is a
+                # write to the container field
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                    base = f.value
+                    fld = field_of(base)
+                    if fld:
+                        accesses.append((fld, "write", node.lineno, locks))
+                        while isinstance(base, ast.Subscript):
+                            base = base.value
+                        skip_reads.add(id(base))
+            # field reads/writes: ctx tells stores from loads
+            if isinstance(node, (ast.Attribute, ast.Name, ast.Subscript)):
+                ctx = getattr(node, "ctx", None)
+                if isinstance(ctx, (ast.Store, ast.Del)):
+                    fld = field_of(node)
+                    if fld is None and isinstance(node, ast.Attribute):
+                        # `self.stats.hits = 3` mutates what `stats`
+                        # refers to — a write to the outer field
+                        fld = field_of(node.value)
+                        if fld:
+                            skip_reads.add(id(node.value))
+                    if fld:
+                        accesses.append((fld, "write", node.lineno, locks))
+                    base = node
+                    while isinstance(base, ast.Subscript):
+                        base = base.value       # self._c[k] = v: the
+                        skip_reads.add(id(base))  # Load of _c is the write
+                elif isinstance(ctx, ast.Load) and \
+                        not isinstance(node, ast.Subscript) and \
+                        id(node) not in skip_reads:
+                    fld = field_of(node)
+                    if fld:
+                        accesses.append((fld, "read", node.lineno, locks))
+            for child in ast.iter_child_nodes(node):
+                visit(child, locks, caught, hvars, htypes)
+
+        for n in body:
+            visit(n, (), (), {}, ())
 
 
 def _annotation_types(g: CallGraph, rel: str, node) -> dict[str, str]:
@@ -477,16 +830,74 @@ def _collect_attr_types(g: CallGraph):
 
 def _resolve_bases(g: CallGraph):
     for cls_q, bases in g.bases.items():
-        out = []
+        out, raw = [], []
         for b in bases:
             if b.startswith("?"):
                 _, rel, dn = b.split("?", 2)
                 q = g.lookup(rel, dn)
                 if q in g.methods:
                     out.append(q)
+                    raw.append(q)
+                else:   # builtin/stdlib base: keep the bare name for
+                    raw.append(dn.rpartition(".")[2])  # hierarchy walks
             elif b in g.methods:
                 out.append(b)
+                raw.append(b)
         g.bases[cls_q] = out
+        g.exc_bases[cls_q] = raw
+
+
+def _mutable_global_value(val) -> bool:
+    if isinstance(val, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                        ast.ListComp, ast.SetComp, ast.Constant)):
+        return True
+    if isinstance(val, ast.Call):
+        dn = dotted_name(val.func)
+        return bool(dn) and dn.rpartition(".")[2] in _GLOBAL_CTORS
+    return False
+
+
+def _index_module_level(g: CallGraph, rel: str, tree):
+    """Module-level ``make_lock`` bindings and mutable globals (shared
+    state a function can reach without going through ``self``).  Scalar
+    constants are included too: ``_N = 0`` rebound via ``global _N`` is
+    just as much shared state as a dict."""
+    globs = g.module_globals.setdefault(rel, {})
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            tgts, val = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgts, val = [node.target], node.value
+        else:
+            continue
+        lname = _make_lock_name(val)
+        for t in tgts:
+            if not isinstance(t, ast.Name):
+                continue
+            if lname:
+                g.lock_names.setdefault((rel, t.id), lname)
+            elif _mutable_global_value(val):
+                globs.setdefault(t.id, node.lineno)
+
+
+def _collect_lock_names(g: CallGraph):
+    """``self.attr = make_lock("name")`` bindings from every method —
+    the registry name is the lock's cross-module identity."""
+    for fd in g.defs.values():
+        if isinstance(fd.node, ast.Lambda) or fd.cls is None:
+            continue
+        cls_q = f"{fd.rel_path}::{fd.cls}"
+        for node in ast.walk(fd.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            lname = _make_lock_name(node.value)
+            if not lname:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    g.lock_names.setdefault((cls_q, t.attr), lname)
 
 
 def build_callgraph(paths, repo_root: str | None = None) -> CallGraph:
@@ -509,6 +920,9 @@ def build_callgraph(paths, repo_root: str | None = None) -> CallGraph:
         _ModuleIndexer(g, rel, repo_root).visit(tree)
     _resolve_bases(g)
     _collect_attr_types(g)
+    _collect_lock_names(g)
+    for rel, tree in trees:
+        _index_module_level(g, rel, tree)
     for rel, tree in trees:
         eb = _EdgeBuilder(g, rel)
 

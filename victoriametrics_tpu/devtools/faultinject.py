@@ -121,7 +121,9 @@ def _injections(point: str, action: str):
     key = (point, action)
     m = _metric_memo.get(key)
     if m is None:
-        m = _metric_memo[key] = metricslib.REGISTRY.counter(
+        # benign double-create: REGISTRY.counter dedups by name, so two
+        # racing fills store the same object
+        m = _metric_memo[key] = metricslib.REGISTRY.counter(  # vmt: disable=VMT015
             metricslib.format_name("vm_fault_injections_total",
                                    {"point": point, "action": action}))
     return m
@@ -193,10 +195,15 @@ def fire(point: str) -> None:
             # armed VM_FAULTS to model exactly this hang
             time.sleep(f.param_ms / 1e3)  # vmt: disable=VMT012
         elif f.action == "error":
-            raise InjectedError(
+            # chaos tool: the anonymous 500/error frame IS the injected
+            # failure mode the harness asserts on — never map it
+            raise InjectedError(  # vmt: disable=VMT016
                 f"injected fault at {point} (devtools/faultinject)")
         elif f.action == "reset":
-            raise ConnectionAbort(f"injected connection reset at {point}")
+            # models a peer dropping the TCP connection mid-call; on an
+            # HTTP-reachable point the resulting 500 is the modeled fault
+            raise ConnectionAbort(  # vmt: disable=VMT016
+                f"injected connection reset at {point}")
         elif f.action == "crash":
             # hard kill, NOW: no atexit, no finally blocks, no flusher
             # shutdown — the whole point is to model kill -9 at this

@@ -186,6 +186,22 @@ def lint_paths(paths, rules=None,
 STALE_DISABLE_RULE = "VMT013"
 ENV_FLAG_RULE = "VMT014"
 
+#: whole-program rule id -> one-line summary.  The per-file rules carry
+#: their own ``summary``; --list-rules and the SARIF rule catalog need
+#: the program passes' ids in one place too.
+PROGRAM_RULE_SUMMARIES = {
+    "VMT012": "blocking primitive reachable from a serving entry "
+              "without a deadline seam (whole-program)",
+    STALE_DISABLE_RULE: "stale '# vmt: disable=' comment that silences "
+                        "nothing (whole-program)",
+    ENV_FLAG_RULE: "VM_*/VMT_* env flag read in code but missing from "
+                   "README.md (whole-program)",
+    "VMT015": "field written from >=2 concurrency roots with no "
+              "consistent guarding lock (whole-program)",
+    "VMT016": "exception type reaching the HTTP/RPC boundary without "
+              "a typed-status mapping (whole-program)",
+}
+
 #: an env-flag literal: VM_/VMT_ prefix then SCREAMING_SNAKE (rule ids
 #: like "VMT012" don't match — no underscore after the prefix)
 _FLAG_RE = re.compile(r"^VMT?_[A-Z][A-Z0-9_]*$")
@@ -360,18 +376,23 @@ def main(argv=None) -> int:
                          "(flag -> read sites) and exit")
     ap.add_argument("--no-program-passes", action="store_true",
                     help="skip the whole-program passes (deadline taint, "
-                         "wire schema) on a full-package run")
+                         "lockset, errorflow, wire schema) on a "
+                         "full-package run")
+    ap.add_argument("--scoped-program-passes", action="store_true",
+                    help="with an explicit path list, still run the "
+                         "call-graph passes (built over the whole "
+                         "package) but report only their findings in "
+                         "the listed files")
+    ap.add_argument("--format", choices=("text", "sarif"), default="text",
+                    help="finding output: text lines (default) or one "
+                         "SARIF 2.1.0 log on stdout (same exit codes)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for r in all_rules():
             print(f"{r.rule_id}  {r.summary}")
-        print(f"VMT012  blocking primitive reachable from a serving "
-              f"entry without a deadline seam (whole-program)")
-        print(f"{STALE_DISABLE_RULE}  stale '# vmt: disable=' comment "
-              f"that silences nothing (whole-program)")
-        print(f"{ENV_FLAG_RULE}  VM_*/VMT_* env flag read in code but "
-              f"missing from README.md (whole-program)")
+        for rid, summary in sorted(PROGRAM_RULE_SUMMARIES.items()):
+            print(f"{rid}  {summary}")
         return 0
 
     # the whole-program passes only make sense over the whole package:
@@ -404,15 +425,36 @@ def main(argv=None) -> int:
         findings.extend(env_flag_findings(ctxs))
         ran_rules.add(ENV_FLAG_RULE)
         if not args.no_program_passes:
-            from . import deadline_taint, wireschema
-            dt_findings, extra_used = deadline_taint.run_pass()
-            findings.extend(dt_findings)
-            ran_rules.add(deadline_taint.RULE_ID)
+            from . import deadline_taint, errorflow, lockset, wireschema
+            from .callgraph import build_callgraph
+            # ONE shared graph: the three call-graph passes see the
+            # same build (and pay its cost once)
+            g = build_callgraph(
+                [os.path.join(REPO_ROOT, "victoriametrics_tpu")])
+            for mod in (deadline_taint, lockset, errorflow):
+                pass_findings, pass_used = mod.run_pass(g)
+                findings.extend(pass_findings)
+                for rel, pairs in pass_used.items():
+                    extra_used.setdefault(rel, set()).update(pairs)
+                ran_rules.add(mod.RULE_ID)
             schema_exit, schema_msgs, _ = wireschema.check()
             for m in schema_msgs:
                 print(f"wireschema: {m}", file=sys.stderr)
         findings.extend(stale_disable_findings(ctxs, extra_used,
                                                ran_rules))
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    elif args.scoped_program_passes and not args.no_program_passes:
+        # editor/changed-only loop: the graph is whole-package (the
+        # passes are interprocedural — a subset graph would lie), the
+        # report is scoped to the listed files.  VMT013 is judged only
+        # on full runs, so consumed suppressions need no merging here.
+        from . import deadline_taint, errorflow, lockset
+        from .callgraph import build_callgraph
+        g = build_callgraph(
+            [os.path.join(REPO_ROOT, "victoriametrics_tpu")])
+        for mod in (deadline_taint, lockset, errorflow):
+            pass_findings, _used = mod.run_pass(g)
+            findings.extend(f for f in pass_findings if f.path in linted)
         findings.sort(key=lambda f: (f.path, f.line, f.rule))
 
     if args.update_baseline:
@@ -429,8 +471,17 @@ def main(argv=None) -> int:
         fresh = new_findings(findings, baseline)
         stale = stale_baseline_entries(findings, baseline, linted)
 
-    for f in fresh:
-        print(f)
+    if args.format == "sarif":
+        import json
+
+        from .sarif import to_sarif
+        summaries = {r.rule_id: r.summary for r in all_rules()}
+        summaries.update(PROGRAM_RULE_SUMMARIES)
+        print(json.dumps(to_sarif(fresh, summaries),
+                         indent=2, sort_keys=True))
+    else:
+        for f in fresh:
+            print(f)
     if fresh:
         print(f"\n{len(fresh)} new finding(s) "
               f"({len(findings)} total incl. baseline). "

@@ -126,6 +126,49 @@ FORMATS = {
         "writer_func": "add_ring_exempt_names",
         "reader_func": "_load_ring_exempt",
     },
+    # health_v1 RPC response body (PR 17): built incrementally as a
+    # local dict (out = {...}; out["k"] = ...) by local_health, widened
+    # by the cluster_health roll-up, and tagged with the node name by
+    # the fan-out.  The authoritative consumers are operators and
+    # dashboards hitting /api/v1/status/health — external_readers keeps
+    # the dead-writer-key pairing check from demanding an in-repo read
+    # of every key — while the in-repo roll-up still ratchets what it
+    # reads back from the nodes (verdict/reasons stay tolerated, never
+    # required: an old node answering health_v1 without them must keep
+    # working).
+    "health_v1_report": {
+        "kind": "json",
+        "external_readers": True,
+        "write_dict_assigns": [
+            ("victoriametrics_tpu/query/sloplane.py",
+             "local_health", "out"),
+            ("victoriametrics_tpu/query/sloplane.py",
+             "cluster_health", "out")],
+        "write_key_assigns": [
+            ("victoriametrics_tpu/parallel/cluster_api.py", "one", "rep")],
+        "read_seed_params": {
+            "victoriametrics_tpu/query/sloplane.py": ("rep",)},
+    },
+    # incident record (PR 17): frozen once at burn-breach time by
+    # _freeze_incident, id-stamped by IncidentRing.open, then served
+    # verbatim over /api/v1/status/incidents — the diagnosis blob keys
+    # (objective, topQueries, tenantUsage, ...) are read by whoever
+    # triages the incident, not by repo code, hence external_readers.
+    # The ring's own reads (id/slo required; the summary projection's
+    # .get()s tolerated) still ratchet: removing a key an old record
+    # carries is breaking.
+    "incident_record": {
+        "kind": "json",
+        "external_readers": True,
+        "write_dict_assigns": [
+            ("victoriametrics_tpu/query/sloplane.py",
+             "_freeze_incident", "rec")],
+        "write_key_assigns": [
+            ("victoriametrics_tpu/query/sloplane.py", "open", "rec"),
+            ("victoriametrics_tpu/query/sloplane.py", "resolve", "rec")],
+        "read_seed_params": {
+            "victoriametrics_tpu/query/sloplane.py": ("rec",)},
+    },
 }
 
 
@@ -135,7 +178,8 @@ def _load_sources(sources=None) -> dict[str, str]:
     reordered field without touching the tree)."""
     rels = set(RPC_MODULES)
     for spec in FORMATS.values():
-        for key in ("write_dict_args", "write_key_assigns"):
+        for key in ("write_dict_args", "write_key_assigns",
+                    "write_dict_assigns"):
             rels.update(s[0] for s in spec.get(key, ()))
         rels.update(spec.get("read_seed_calls", {}))
         rels.update(spec.get("read_seed_params", {}))
@@ -461,6 +505,34 @@ def _extract_json_format(spec, trees) -> dict:
                         k = a.targets[0].slice.value
                         if isinstance(k, str) and k not in writer_keys:
                             writer_keys.append(k)
+    # write_dict_assigns: a format dict BUILT as a named local —
+    # ``var = {...}`` literal init plus every ``var["k"] = ...`` widening
+    # — inside the named function (health_v1 reports and incident
+    # records are assembled this way rather than passed as a literal to
+    # one call).
+    for rel, fname, var in spec.get("write_dict_assigns", ()):
+        for n in ast.walk(trees[rel]):
+            if not (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n.name == fname):
+                continue
+            for a in ast.walk(n):
+                if not isinstance(a, ast.Assign):
+                    continue
+                t = a.targets[0]
+                if isinstance(t, ast.Name) and t.id == var and \
+                        isinstance(a.value, ast.Dict):
+                    for k in a.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str) and \
+                                k.value not in writer_keys:
+                            writer_keys.append(k.value)
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == var and \
+                        isinstance(t.slice, ast.Constant) and \
+                        isinstance(t.slice.value, str) and \
+                        t.slice.value not in writer_keys:
+                    writer_keys.append(t.slice.value)
 
     required: set[str] = set()
     tolerated: set[str] = set()
@@ -473,9 +545,15 @@ def _extract_json_format(spec, trees) -> dict:
         if rel not in spec.get("read_seed_calls", {}):
             _key_reads(trees[rel], (), params, required, tolerated)
     tolerated -= required
-    return {"writer_keys": writer_keys,
-            "reader_required": sorted(required),
-            "reader_tolerated": sorted(tolerated)}
+    out = {"writer_keys": writer_keys,
+           "reader_required": sorted(required),
+           "reader_tolerated": sorted(tolerated)}
+    if spec.get("external_readers"):
+        # the blob's primary consumers live outside the repo
+        # (dashboards, operators): recorded in the lockfile so the
+        # relaxed dead-writer-key pairing is visible in the contract
+        out["external_readers"] = True
+    return out
 
 
 def _key_reads(scope, seed_calls, seed_params, required, tolerated):
@@ -508,7 +586,10 @@ def _key_reads(scope, seed_calls, seed_params, required, tolerated):
         is_seed_root = lambda v: (
             (isinstance(v, ast.Name) and v.id in names) or
             (isinstance(v, ast.Attribute) and v.attr in names) or
-            (isinstance(v, ast.Call) and _last_name(v.func) in seed_calls))
+            (isinstance(v, ast.Call) and _last_name(v.func) in seed_calls) or
+            # the ``(rep or {}).get("k")`` none-tolerant idiom
+            (isinstance(v, ast.BoolOp) and
+             any(is_seed_root(x) for x in v.values)))
         if isinstance(node, ast.Subscript) and \
                 isinstance(node.ctx, ast.Load) and \
                 is_seed_root(node.value) and \
@@ -630,7 +711,7 @@ def _pairing_problems(schema: dict) -> list[str]:
         dead = [k for k in entry["writer_keys"]
                 if k not in entry["reader_required"] and
                 k not in entry["reader_tolerated"]]
-        if dead:
+        if dead and not entry.get("external_readers"):
             out.append(f"{name}: writer key(s) {dead} no reader ever "
                        f"consumes")
     return out

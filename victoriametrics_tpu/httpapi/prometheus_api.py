@@ -447,8 +447,13 @@ class PrometheusAPI:
         if not q:
             return Response.error("missing 'query' arg")
         now = fasttime.unix_ms()
-        ts = parse_time(req.arg("time"), now)
-        step = parse_step(req.arg("step"), 300_000)
+        try:
+            ts = parse_time(req.arg("time"), now)
+            step = parse_step(req.arg("step"), 300_000)
+        except QueryError as e:
+            # bad time=/step= args are the client's mistake: 400, not
+            # an escape to the boundary's anonymous 500 (VMT016)
+            return Response.error(str(e))
         qid = self.active.register(q, ts, ts, step)
         if hasattr(self.storage, "reset_partial"):
             self.storage.reset_partial()
@@ -501,9 +506,14 @@ class PrometheusAPI:
         if not q:
             return Response.error("missing 'query' arg")
         now = fasttime.unix_ms()
-        start = parse_time(req.arg("start"), now - 300_000)
-        end = parse_time(req.arg("end"), now)
-        step = parse_step(req.arg("step"))
+        try:
+            start = parse_time(req.arg("start"), now - 300_000)
+            end = parse_time(req.arg("end"), now)
+            step = parse_step(req.arg("step"))
+        except QueryError as e:
+            # bad start=/end=/step= args are the client's mistake: 400,
+            # not an escape to the boundary's anonymous 500 (VMT016)
+            return Response.error(str(e))
         if end < start:
             return Response.error("end < start")
         # align the grid to the step (AdjustStartEnd analog): start rounds
@@ -624,6 +634,11 @@ class PrometheusAPI:
             resp = Response.error(str(e), 429, "too_many_requests")
             resp.headers["Retry-After"] = "10"
             return resp
+        except matstream.MatStreamDisabled as e:
+            # the enabled() pre-check above races a live VM_MATSTREAM
+            # flip: subscribe re-checks under the registry lock, so map
+            # the raise too — same 503 as the pre-check path (VMT016)
+            return Response.error(str(e), 503, "unavailable")
         except (QueryError, ParseError, ValueError) as e:
             return Response.error(str(e))
 
@@ -1422,8 +1437,7 @@ class PrometheusAPI:
             # evaluations, counted once per interval — not multiplied by
             # the stream's subscriber count
             data["matstreams"] = ms.usage_rows()
-            data["matstreamInstant"] = {"evals": ms.instant_evals,
-                                        "reuse": ms.instant_reuse}
+            data["matstreamInstant"] = ms.instant_stats()
         return Response.json({
             "status": "success",
             "data": data,

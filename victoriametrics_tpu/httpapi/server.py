@@ -13,6 +13,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..ingest.ratelimiter import RateLimitedError
 from ..ops import compress as zstd
+from ..parallel.rpc import (ClusterUnavailableError, PartialResultError,
+                            RPCError)
 from ..utils import logger
 from ..utils import metrics as metricslib
 from ..utils.workpool import SearchLimitError
@@ -148,6 +150,20 @@ class HTTPServer:
                     resp = Response.error(str(e), 429,
                                           "too_many_requests")
                     resp.headers["Retry-After"] = str(e.retry_after_s)
+                except ClusterUnavailableError as e:
+                    # no live storage at all: the promised 503 on every
+                    # route, not just the query handlers' own arms
+                    # (before RPCError — it is a subclass)
+                    resp = Response.error(str(e), 503, "unavailable")
+                except PartialResultError as e:
+                    # deny_partial refusal: capacity degradation, 503
+                    resp = Response.error(str(e), 503, "unavailable")
+                except RPCError as e:
+                    # a storage hop failed (protocol error, dead peer):
+                    # the gateway is degraded, the serving code is not
+                    # broken — 502, so clients and SLO burn rates can
+                    # tell a bad backend from a serving bug
+                    resp = Response.error(str(e), 502, "storage_rpc")
                 except Exception as e:  # noqa: BLE001 - error boundary
                     logger.errorf("http handler %s: %s", req.path, e)
                     import traceback

@@ -124,7 +124,9 @@ class TenantRateLimiters:
     def _tenant_rl(self, tenant) -> RateLimiter | None:
         if self._per_tenant_limit <= 0:
             return None
-        rl = self._tenant_rls.get(tenant)
+        # racy-by-design fast path: a stale miss just falls through to
+        # the locked setdefault, which both racers resolve to ONE limiter
+        rl = self._tenant_rls.get(tenant)  # vmt: disable=VMT015
         if rl is None:
             with self._mu:
                 rl = self._tenant_rls.setdefault(

@@ -52,7 +52,9 @@ def _load():
             lib = _configure(ctypes.CDLL(_SO))
         except (OSError, AttributeError):
             return None
-    _lib = lib
+    # benign double-load: racing loaders dlopen the same .so and store
+    # equivalent handles; the loser's handle is dropped, never used half-set
+    _lib = lib  # vmt: disable=VMT015
     return lib
 
 

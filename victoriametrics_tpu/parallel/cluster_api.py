@@ -25,8 +25,21 @@ from ..utils import costacc, logger, querytracer
 from ..utils import metrics as metricslib
 from . import ringfilter
 from .consistenthash import ConsistentHash
-from .rpc import (HELLO_INSERT, HELLO_SELECT, RPCClient, RPCClientPool,
-                  RPCError, Reader, Writer)
+from .rpc import (HELLO_INSERT, HELLO_SELECT,  # noqa: F401 — re-exports
+                  ClusterUnavailableError, PartialResultError, RPCClient,
+                  RPCClientPool, RPCError, Reader, Writer)
+
+
+def _json_payload(data: bytes, what: str):
+    """Decode a JSON wire payload, converting a malformed peer's bytes
+    into a typed RPCError (which round-trips both error boundaries)
+    instead of a bare ValueError that would surface as an anonymous
+    500 / unmarked error frame (VMT016)."""
+    import json
+    try:
+        return json.loads(data)
+    except ValueError as e:
+        raise RPCError(f"bad {what} payload: {e}") from None
 
 SERIES_PER_FRAME = 64
 
@@ -108,7 +121,9 @@ def placement_marshal(key: bytes) -> bytes:
     """Canonical marshal for a raw text series key; falls back to the
     raw bytes for keys that don't parse (the storage node drops those
     rows later anyway — consistent placement still holds)."""
-    m = _PLACEMENT_MEMO.get(key)
+    # racy-by-design fast path: a stale miss re-parses the key (pure
+    # function), and the locked fill stores the identical marshaled name
+    m = _PLACEMENT_MEMO.get(key)  # vmt: disable=VMT015
     if m is None:
         from ..ingest.parsers import labels_from_series_key
         try:
@@ -631,8 +646,7 @@ def make_storage_handlers(storage, rate_limiter=None) -> dict:
         (Storage.adopt_part).  Answers (rows, bytes) only after the
         part is durably published, so the driver's subsequent
         removeParts_v1 on the source can never strand acked data."""
-        import json
-        hdr = json.loads(r.bytes_())
+        hdr = _json_payload(r.bytes_(), "migratePart_v1 header")
         files = [(str(name), r.bytes_()) for name in hdr["files"]]
         n = r.u64()
         entries = [(r.bytes_(), r.bytes_()) for _ in range(n)]
@@ -998,7 +1012,7 @@ class StorageNodeClient:
         w = _write_tenant(Writer(), tenant).u64(topn)
         w.u64(0 if date is None else date + 1)
         r = self.select.call("tsdbStatus_v1", w)
-        return json.loads(r.bytes_())
+        return _json_payload(r.bytes_(), "tsdbStatus_v1")
 
     def tenants(self):
         r = self.select.call("tenants_v1", Writer())
@@ -1015,29 +1029,26 @@ class StorageNodeClient:
         return [r.str_() for _ in range(r.u64())]
 
     def metric_names_usage_stats(self, limit=1000, le=None):
-        import json
         w = Writer().u64(limit).u64(0 if le is None else le + 1)
         r = self.select.call("metricNamesUsageStats_v1", w)
-        return json.loads(r.bytes_())
+        return _json_payload(r.bytes_(), "metricNamesUsageStats_v1")
 
     def reset_metric_names_stats(self):
         self.select.call("resetMetricNamesStats_v1", Writer())
 
     def search_metadata(self, limit=1000, metric=""):
-        import json
         w = Writer().u64(limit).str_(metric)
         r = self.select.call("searchMetadata_v1", w)
-        return json.loads(r.bytes_())
+        return _json_payload(r.bytes_(), "searchMetadata_v1")
 
     def quarantine_report(self):
-        import json
         try:
             r = self.select.call("quarantineReport_v1", Writer())
         except RPCError as e:
             if "unknown rpc method" in str(e):
                 return []  # pre-quarantine storage node
             raise
-        return json.loads(r.bytes_())
+        return _json_payload(r.bytes_(), "quarantineReport_v1")
 
     def profile(self, reset: bool = False) -> dict | None:
         """This node's continuous-profiler snapshot; None from an
@@ -1128,10 +1139,6 @@ class StorageNodeClient:
 # ClusterStorage: the vminsert/vmselect composite backend
 # ---------------------------------------------------------------------------
 
-class PartialResultError(RuntimeError):
-    pass
-
-
 def parse_node_spec(spec: str) -> tuple[str, int, int]:
     """-storageNode spec -> (host, insert_port, select_port).  The
     3-field ``host:insertPort:selectPort`` form addresses a vmstorage;
@@ -1147,13 +1154,6 @@ def parse_node_spec(spec: str) -> tuple[str, int, int]:
         raise ValueError(f"bad storage node spec {spec!r} (want "
                          f"host:insertPort:selectPort or host:port)")
     return host, int(port), int(port)
-
-
-class ClusterUnavailableError(RPCError):
-    """Every storage node failed the fan-out: there is no data to serve
-    at all.  HTTP layers map this to 503 (+ the first node's error)
-    rather than a generic 500 — the cluster is degraded, the serving
-    code is not broken."""
 
 
 def _node_name_of(spec: str) -> str:
@@ -1329,7 +1329,9 @@ class ClusterStorage:
         return self._reroutes.get()
 
     def reset_partial(self):
-        self._tls.partial = False
+        # threading.local: each request thread reads/writes only its own
+        # slot, so cross-root access is partitioned by construction
+        self._tls.partial = False  # vmt: disable=VMT015
 
     @property
     def last_partial(self) -> bool:

@@ -24,6 +24,7 @@ import numpy as np
 from ..devtools import faultinject
 from ..devtools.locktrace import make_lock
 from ..devtools.racetrace import traced_fields
+from ..ingest.ratelimiter import RateLimitedError
 
 try:
     from ..ops import compress as zstd
@@ -48,6 +49,21 @@ _SHED_PREFIX = "vm:shed-load: "
 #: with waited=False and the fan-out never marks the healthy node down
 _DEADLINE_PREFIX = "vm:deadline: "
 
+#: wire marker for ingestion rate-limit rejections: carries ONLY the
+#: retry-after seconds so the client can rebuild the typed
+#: RateLimitedError and the vminsert HTTP layer keeps its 429 +
+#: Retry-After contract across the RPC hop
+_RATELIMIT_PREFIX = "vm:rate-limited: "
+
+#: wire marker for a multilevel child whose OWN fan-out found no live
+#: storage at all: the parent re-raises ClusterUnavailableError so its
+#: HTTP layer serves the promised 503, not an anonymous 500
+_UNAVAIL_PREFIX = "vm:unavailable: "
+
+#: wire marker for deny_partial rejections on a multilevel child: the
+#: parent re-raises PartialResultError (capacity degradation, 503)
+_PARTIAL_PREFIX = "vm:partial-denied: "
+
 
 # per-(family, method) handle memo: keeps the format_name + name-regex +
 # registry-lock round trip off the per-call path (method sets are tiny and
@@ -59,7 +75,9 @@ def _rpc_counter(name: str, method: str):
     key = (name, method)
     m = _metric_memo.get(key)
     if m is None:
-        m = _metric_memo[key] = metricslib.REGISTRY.counter(
+        # benign double-create: REGISTRY.counter dedups by name, so two
+        # racing fills store the same object
+        m = _metric_memo[key] = metricslib.REGISTRY.counter(  # vmt: disable=VMT015
             metricslib.format_name(name, {"method": method}))
     return m
 
@@ -94,6 +112,23 @@ class RPCDeadlineError(RPCError):
     one over-budget query."""
 
     waited = True
+
+
+class ClusterUnavailableError(RPCError):
+    """Every storage node failed the fan-out: there is no data to serve
+    at all.  HTTP layers map this to 503 (+ the first node's error)
+    rather than a generic 500 — the cluster is degraded, the serving
+    code is not broken.  Defined here (not cluster_api) so both error
+    boundaries can map it without importing the fan-out machinery, and
+    so a multilevel child's unavailability crosses the RPC hop typed
+    (``_UNAVAIL_PREFIX``)."""
+
+
+class PartialResultError(RuntimeError):
+    """deny_partial is set and a fan-out lost node(s): the merged
+    answer would be silently incomplete, so the query is refused.
+    Capacity degradation, not a serving bug — boundaries map it to 503
+    / a typed ``_PARTIAL_PREFIX`` frame, never an anonymous 500."""
 
 
 # cross-method aggregates: the per-method vm_rpc_client_* families stay,
@@ -331,6 +366,48 @@ class RPCServer:
                             b"\x01" + (_SHED_PREFIX + str(e)).encode())
             except OSError:
                 pass
+        except RateLimitedError as e:
+            # ingestion backpressure, the write-plane twin of shed load:
+            # only the retry-after seconds cross the wire, the client
+            # rebuilds the typed error so vminsert's 429 + Retry-After
+            # contract survives the hop instead of becoming a 500
+            _rpc_counter("vm_rpc_server_ratelimited_total", method).inc()
+            try:
+                write_frame(wfile, b"\x01" + (
+                    _RATELIMIT_PREFIX + str(e.retry_after_s)).encode())
+            except OSError:
+                pass
+        except ClusterUnavailableError as e:
+            # a multilevel child found no live storage: typed marker so
+            # the parent's HTTP layer serves the promised 503 (before
+            # the RPCError arm — it is a subclass)
+            _rpc_counter("vm_rpc_server_errors_total", method).inc()
+            try:
+                write_frame(wfile,
+                            b"\x01" + (_UNAVAIL_PREFIX + str(e)).encode())
+            except OSError:
+                pass
+        except PartialResultError as e:
+            # deny_partial refusal on a multilevel child: capacity
+            # degradation the parent must surface as 503, not 500
+            _rpc_counter("vm_rpc_server_errors_total", method).inc()
+            try:
+                write_frame(wfile,
+                            b"\x01" + (_PARTIAL_PREFIX + str(e)).encode())
+            except OSError:
+                pass
+        except RPCError as e:
+            # the unmarked error frame IS the typed encoding of
+            # RPCError: the client re-raises it as RPCError verbatim,
+            # so the type round-trips the hop.  A separate arm (same
+            # body as the anonymous one) keeps that contract explicit
+            # for the VMT016 exception-escape audit.
+            _rpc_counter("vm_rpc_server_errors_total", method).inc()
+            logger.errorf("rpc handler error: %s", e)
+            try:
+                write_frame(wfile, b"\x01" + str(e).encode())
+            except OSError:
+                pass
         except Exception as e:  # noqa: BLE001 — rpc error boundary
             _rpc_counter("vm_rpc_server_errors_total", method).inc()
             logger.errorf("rpc handler error: %s", e)
@@ -509,6 +586,23 @@ class RPCClient:
                                         f"{msg[len(_DEADLINE_PREFIX):]}")
                                     err.waited = False
                                     raise err
+                                if msg.startswith(_RATELIMIT_PREFIX):
+                                    # remote ingestion backpressure:
+                                    # rebuild the typed error so the
+                                    # HTTP layer's 429 + Retry-After
+                                    # fires, not node-down + 500
+                                    raise RateLimitedError(float(
+                                        msg[len(_RATELIMIT_PREFIX):]))
+                                if msg.startswith(_UNAVAIL_PREFIX):
+                                    # child cluster has no live
+                                    # storage: keep the 503 type
+                                    raise ClusterUnavailableError(
+                                        msg[len(_UNAVAIL_PREFIX):])
+                                if msg.startswith(_PARTIAL_PREFIX):
+                                    # child refused a partial answer:
+                                    # capacity degradation, 503 type
+                                    raise PartialResultError(
+                                        msg[len(_PARTIAL_PREFIX):])
                                 raise RPCError(msg)
                             frames.append(Reader(resp[1:]))
                     except RPCError:

@@ -1521,7 +1521,9 @@ def _fused_group_ids(ec: EvalConfig, ae, cols, keep_name: bool,
     group_rows = [np.asarray(r, np.int64) for r in rows_of]
     if len(_FUSED_GIDS_MEMO) >= _FUSED_GIDS_MEMO_MAX:
         _FUSED_GIDS_MEMO.clear()
-    _FUSED_GIDS_MEMO[sig] = (raws_t, group_keys, order, group_rows)
+    # benign memo race: racing fills for one sig store equal values
+    # (pure function of sig); a clear-vs-fill race just re-misses
+    _FUSED_GIDS_MEMO[sig] = (raws_t, group_keys, order, group_rows)  # vmt: disable=VMT015
     return group_keys, order, group_rows
 
 

@@ -347,7 +347,8 @@ class MatStream:
 
     def resume_token(self, frame: dict) -> str:
         """The SSE event id for one frame of this stream."""
-        return f"{self.epoch}:{frame.get('seq', self.seq)}"
+        with self._lock:
+            return f"{self.epoch}:{frame.get('seq', self.seq)}"
 
     def _unsubscribe(self, sub: Subscription) -> None:
         with self._lock:
@@ -431,7 +432,11 @@ class MatStream:
 
     def due(self, now_ms: int) -> bool:
         end = (now_ms // self.step) * self.step
-        st = self._state
+        # racy-by-design fast path: _state is only rebound while BOTH
+        # _advance_lock and _lock are held, and maybe_advance re-checks
+        # due() after taking _advance_lock — a stale ref here costs one
+        # redundant check, never a double advance
+        st = self._state  # vmt: disable=VMT015
         return st is None or end > st.end
 
     def maybe_advance(self, now_ms: int) -> bool:
@@ -467,9 +472,7 @@ class MatStream:
                 rows = api._exec_range_cached(ec, self.q, now_ms)
         except Exception as e:  # noqa: BLE001 — fanned as an error frame
             err = e
-        self.evals += 1
         _EVALS.inc()
-        self.last_samples_scanned = ec.samples_scanned
         partial = bool(getattr(api.storage, "last_partial", False))
         dur = _time.perf_counter() - t0
         flightrec.rec("matstream:advance", t0, dur, arg=self.q[:200])
@@ -477,6 +480,11 @@ class MatStream:
         costacc.record_usage(self.tenant, ec._cost, summary=summary)
         with self._lock:
             self._fold_cost(summary)
+            # stats land under _lock so usage_row's locked reads never
+            # tear against the advance (the advance itself is already
+            # serialized by _advance_lock)
+            self.evals += 1
+            self.last_samples_scanned = ec.samples_scanned
             self.seq += 1
             if err is not None:
                 # loud: the failure reaches every subscriber, and the
@@ -670,6 +678,11 @@ class MatStreamRegistry:
         rows.sort(key=lambda r: -r.get("cpuMs", 0))
         return rows
 
+    def instant_stats(self) -> dict:
+        with self._lock:
+            return {"evals": self.instant_evals,
+                    "reuse": self.instant_reuse}
+
     # -- shared instant evaluation (vmalert rule groups) -------------------
 
     def _instant_candidate(self, tenant, canonical, ts_ms):
@@ -747,7 +760,11 @@ class MatStreamRegistry:
             rows = exec_query(ec, canonical)
         flightrec.rec("matstream:instant", t0,
                       _time.perf_counter() - t0, arg=canonical[:200])
-        self.instant_evals += 1
+        with self._lock:
+            # under _lock like the instant_reuse increments above: the
+            # memo is shared by every instant caller (HTTP, rule groups,
+            # the SLO pump), so the miss counter races without it
+            self.instant_evals += 1
         _EVALS.inc()
         costacc.record_usage(tenant, ec._cost)
         out = []

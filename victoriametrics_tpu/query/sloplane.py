@@ -35,6 +35,7 @@ from __future__ import annotations
 import os
 import threading
 
+from ..devtools.locktrace import make_lock
 from ..utils import costacc, fasttime, flightrec, logger, profiler
 from ..utils import metrics as metricslib
 
@@ -281,7 +282,10 @@ class SLOEngine:
         #: "firing": [pair], "noData": bool, "severity": str|None}
         self._state: dict[str, dict] = {}
         self._gauges: dict[str, metricslib.Gauge] = {}
-        self._lock = threading.Lock()
+        # one lock for ALL engine state (counters, gauge memo, _state,
+        # last_eval_ms): maybe_eval rides the self-scrape tick AND the
+        # ?pump=1 HTTP seam, so rounds race unless every access takes it
+        self._lock = make_lock("query.SLOEngine._lock")
 
     # -- evaluation --------------------------------------------------------
 
@@ -331,10 +335,12 @@ class SLOEngine:
         results: dict[str, list | None] = {}
         for expr in needed:
             results[expr] = self._eval_expr(expr, now_ms)
-            self.expr_evals += 1
+            with self._lock:
+                self.expr_evals += 1
             _EVALS.inc()
-        self.exprs_last_round = len(needed)
-        self.eval_rounds += 1
+        with self._lock:
+            self.exprs_last_round = len(needed)
+            self.eval_rounds += 1
         _ROUNDS.inc()
 
         # 2) fold per spec per window, update gauges + firing state
@@ -381,11 +387,12 @@ class SLOEngine:
 
     def _gauge(self, base: str, labels: dict) -> metricslib.Gauge:
         name = metricslib.format_name(base, labels)
-        g = self._gauges.get(name)
-        if g is None:
-            g = metricslib.REGISTRY.gauge(name)
-            self._gauges[name] = g
-        return g
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = metricslib.REGISTRY.gauge(name)
+                self._gauges[name] = g
+            return g
 
     def _export(self, spec: SLOSpec, state: dict):
         for w, rate in state["burn"].items():
@@ -468,6 +475,10 @@ class SLOEngine:
     def status(self) -> dict:
         with self._lock:
             state = {k: dict(v) for k, v in self._state.items()}
+            counters = {"evalRounds": self.eval_rounds,
+                        "exprEvals": self.expr_evals,
+                        "exprsPerRound": self.exprs_last_round,
+                        "lastEvalMs": self.last_eval_ms}
         slos = []
         for spec in self.specs:
             st = state.get(spec.name, {})
@@ -489,10 +500,7 @@ class SLOEngine:
             "windows": [{"short": s, "long": lw, "threshold": t}
                         for s, lw, t in self.windows],
             "period": self.period,
-            "evalRounds": self.eval_rounds,
-            "exprEvals": self.expr_evals,
-            "exprsPerRound": self.exprs_last_round,
-            "lastEvalMs": self.last_eval_ms,
+            **counters,
             "slos": slos,
         }
 

@@ -28,7 +28,9 @@ def _kernel_histogram(kernel: str, phase: str):
     key = (kernel, phase)
     h = _kernel_hist_memo.get(key)
     if h is None:
-        h = _kernel_hist_memo[key] = metricslib.REGISTRY.histogram(
+        # benign double-create: REGISTRY.histogram dedups by name, so
+        # two racing fills store the same object
+        h = _kernel_hist_memo[key] = metricslib.REGISTRY.histogram(  # vmt: disable=VMT015
             metricslib.format_name("vm_tpu_kernel_duration_seconds",
                                    {"kernel": kernel, "phase": phase}))
     return h

@@ -157,7 +157,9 @@ class IndexDB:
     def _day_table(self, date: int) -> Table:
         """Month table for writes (created on demand)."""
         name = self._month_of_date(date)
-        t = self._month_tables.get(name)
+        # racy-by-design fast path of a double-checked create: a stale
+        # miss re-checks under _lock; a published Table is immutable here
+        t = self._month_tables.get(name)  # vmt: disable=VMT015
         if t is None:
             with self._lock:
                 t = self._month_tables.get(name)

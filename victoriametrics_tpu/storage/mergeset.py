@@ -265,7 +265,10 @@ class Table:
                        os.path.isdir(os.path.join(self.path, n)))
         for n in names:
             try:
-                self._file_parts.append(_FilePart(os.path.join(self.path, n)))
+                # open-phase: runs from __init__ before the Table is
+                # published to any other thread
+                self._file_parts.append(  # vmt: disable=VMT015
+                    _FilePart(os.path.join(self.path, n)))
             except (fslib.IntegrityError, ValueError, KeyError) as e:
                 # torn/corrupt part: quarantine it LOUDLY (counter +
                 # partial flag + status listing) instead of the old
@@ -300,7 +303,8 @@ class Table:
         if self._file_parts:
             seqs = [int(os.path.basename(p.path).split("_")[1])
                     for p in self._file_parts]
-            self._part_seq = itertools.count(max(seqs) + 1)
+            # open-phase (see above): pre-publication, thread-local
+            self._part_seq = itertools.count(max(seqs) + 1)  # vmt: disable=VMT015
 
     def close(self):
         self.flush_to_disk()

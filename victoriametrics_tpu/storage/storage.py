@@ -192,7 +192,10 @@ class _ColumnarSpace:
         self.last_date[i] = -(1 << 62)
 
     def close(self):
-        km, self.keymap = self.keymap, None
+        # every caller holds self.lock via acquire/release bracketing the
+        # static pass cannot see (_acquire_cspace returns with it HELD,
+        # reset_columnar_spaces takes `with sp.lock`)
+        km, self.keymap = self.keymap, None  # vmt: disable=VMT015
         if km is not None:
             km.close()
 
@@ -487,14 +490,17 @@ class Storage:
                 if limited and not self._cardinality_ok(tsid.metric_id):
                     return None
                 return tsid
-            self.slow_row_inserts += 1
+            # monotonic stat, written under _lock; the /metrics reader
+            # takes a lock-free int snapshot — staleness, not corruption
+            self.slow_row_inserts += 1  # vmt: disable=VMT015
             tsid = self.idb.get_tsid_by_name(raw, tenant)
             if tsid is None:
                 tsid = generate_tsid(mn, self._mid_gen.next_id(), tenant)
                 if limited and not self._cardinality_ok(tsid.metric_id):
                     return None
                 self.idb.create_indexes_for_metric(mn, tsid)
-                self.new_series_created += 1
+                # monotonic stat (see slow_row_inserts above)
+                self.new_series_created += 1  # vmt: disable=VMT015
             elif limited and not self._cardinality_ok(tsid.metric_id):
                 return None
             self._tsid_cache[ck] = tsid
@@ -611,7 +617,9 @@ class Storage:
         _ingest_lap("append", t0)
         _INGEST_ROWS.inc(n)
         with self._lock:
-            self.rows_added += n
+            # monotonic stat, written under _lock; the /metrics reader
+            # takes a lock-free int snapshot — staleness, not corruption
+            self.rows_added += n  # vmt: disable=VMT015
             self.data_version += 1
             log = self._append_log
             if log.maxlen is not None and len(log) == log.maxlen:
@@ -930,23 +938,28 @@ class Storage:
         """Minimum timestamp inserted after data_version `version`, or None
         when nothing was appended since. Raises LookupError when `version`
         predates the bounded append log (caller must rebuild)."""
-        if version < self._append_log_floor:
-            raise LookupError("append log does not cover version")
-        lo = None
-        for v, mn in reversed(self._append_log):
-            if v <= version:
-                break
-            lo = mn if lo is None else min(lo, mn)
-        return lo
+        with self._lock:
+            # under _lock: concurrent ingest appends to _append_log, and
+            # a deque mutated mid-iteration raises RuntimeError
+            if version < self._append_log_floor:
+                raise LookupError("append log does not cover version")
+            lo = None
+            for v, mn in reversed(self._append_log):
+                if v <= version:
+                    break
+                lo = mn if lo is None else min(lo, mn)
+            return lo
 
     def _cardinality_ok(self, metric_id: int) -> bool:
         """registerSeriesCardinality (storage.go:2136): hourly/daily bloom
         limiters drop rows for ids beyond the distinct-series budget."""
+        # BloomLimiter.add is internally locked (admissions are atomic);
+        # the fields themselves are rebound only at configure time
         if self.hourly_limiter is not None and \
-                not self.hourly_limiter.add(metric_id):
+                not self.hourly_limiter.add(metric_id):  # vmt: disable=VMT015
             return False
         if self.daily_limiter is not None and \
-                not self.daily_limiter.add(metric_id):
+                not self.daily_limiter.add(metric_id):  # vmt: disable=VMT015
             return False
         return True
 
@@ -1466,48 +1479,57 @@ class Storage:
         the search paths; drives /api/v1/status/metric_names_stats and
         the metricNamesUsageStats RPC)."""
         now = fasttime.unix_timestamp()
-        nu = self._name_usage
-        for g in metric_groups:
-            e = nu.get(g)
-            if e is None:
-                if len(nu) >= self._MAX_NAME_USAGE:
-                    continue
-                e = nu[g] = [0, 0]
-            e[0] += 1
-            e[1] = now
+        with self._lock:
+            # under _lock: the stats/RPC readers iterate this dict, and
+            # a concurrent insert mid-iteration raises RuntimeError
+            nu = self._name_usage
+            for g in metric_groups:
+                e = nu.get(g)
+                if e is None:
+                    if len(nu) >= self._MAX_NAME_USAGE:
+                        continue
+                    e = nu[g] = [0, 0]
+                e[0] += 1
+                e[1] = now
 
     def metric_names_usage_stats(self, limit: int = 1000,
                                  le: int | None = None) -> list[dict]:
-        items = [{"metricName": (g.decode("utf-8", "replace")
-                                 if isinstance(g, bytes) else g),
-                  "requestsCount": c, "lastRequestTimestamp": t}
-                 for g, (c, t) in self._name_usage.items()]
+        with self._lock:
+            items = [{"metricName": (g.decode("utf-8", "replace")
+                                     if isinstance(g, bytes) else g),
+                      "requestsCount": c, "lastRequestTimestamp": t}
+                     for g, (c, t) in self._name_usage.items()]
         if le is not None:
             items = [x for x in items if x["requestsCount"] <= le]
         items.sort(key=lambda x: x["requestsCount"])
         return items[:limit]
 
     def reset_metric_names_stats(self) -> None:
-        self._name_usage.clear()
+        with self._lock:
+            self._name_usage.clear()
 
     # -- metric metadata (TYPE/HELP; /api/v1/metadata storage side) ------
 
     def set_metadata(self, metadata: dict) -> None:
         """Merge parsed # TYPE / # HELP exposition metadata."""
-        if len(self.metadata) < 100_000:
-            self.metadata.update(metadata)
+        with self._lock:
+            # under _lock: search_metadata iterates this dict, and a
+            # concurrent merge mid-iteration raises RuntimeError
+            if len(self.metadata) < 100_000:
+                self.metadata.update(metadata)
 
     def search_metadata(self, limit: int = 1000,
                         metric: str = "") -> dict:
-        if metric:
-            md = self.metadata.get(metric)
-            return {metric: md} if md else {}
-        out = {}
-        for name, md in self.metadata.items():
-            if len(out) >= limit:
-                break
-            out[name] = md
-        return out
+        with self._lock:
+            if metric:
+                md = self.metadata.get(metric)
+                return {metric: md} if md else {}
+            out = {}
+            for name, md in self.metadata.items():
+                if len(out) >= limit:
+                    break
+                out[name] = md
+            return out
 
     def series_count(self, tenant=(0, 0)) -> int:
         return int(self.idb._all_metric_ids(tenant).size)
@@ -1586,7 +1608,10 @@ class Storage:
             # old data keys its tile under the pre-delete version
             with self._lock:
                 self.data_version += 1
-                self.structural_version += 1
+                # monotonic version, bumped under _lock; cache keying
+                # reads a lock-free int snapshot — a stale read keys a
+                # tile one version back, which the ratchet re-checks
+                self.structural_version += 1  # vmt: disable=VMT015
         return int(mids.size)
 
     # -- live resharding (part migration + ring-ownership exemptions) ------

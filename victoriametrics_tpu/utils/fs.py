@@ -165,9 +165,12 @@ def verify_checksums(part_dir: str, meta: dict) -> None:
         try:
             got = checksum_file(full)
         except OSError as e:
-            raise IntegrityError(f"{part_dir}: cannot checksum {name}: "
-                                 f"{e}") from None
+            # on-disk corruption is a TRUE internal error: there is no
+            # typed status that makes it the client's problem, so the
+            # boundary's anonymous 500/error frame is the contract
+            raise IntegrityError(  # vmt: disable=VMT016
+                f"{part_dir}: cannot checksum {name}: {e}") from None
         if got != want:
-            raise IntegrityError(
+            raise IntegrityError(  # vmt: disable=VMT016 — corruption = 500
                 f"{part_dir}: checksum mismatch on {name} "
                 f"(recorded {want}, computed {got}) — torn or corrupt")

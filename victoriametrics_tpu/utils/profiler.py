@@ -126,7 +126,10 @@ class SampleProfiler:
         with self._lock:
             if self.running():
                 return True
-            self._stop = threading.Event()
+            # rebound only here, under _lock; _run reads the ref once at
+            # thread start — the Event handed to a dying thread is never
+            # reused for the next one, so a stale read cannot unstop it
+            self._stop = threading.Event()  # vmt: disable=VMT015
             if not self._started_at:
                 self._started_at = time.monotonic()
             # service thread by design (daemon, joined in stop());
