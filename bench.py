@@ -370,16 +370,25 @@ def main() -> None:
         # series, and burn-rate evals ride each scrape tick
         from victoriametrics_tpu.httpapi.prometheus_api import \
             PrometheusAPI as _PlaneAPI
+        from victoriametrics_tpu.utils import selfscrape as _selfscrape
         from victoriametrics_tpu.utils.selfscrape import SelfScraper
         plane_api = _PlaneAPI(s)
         plane_engine = plane_api.init_sloplane()
-        scrape_interval = float(
-            os.environ.get("VM_SELF_SCRAPE_INTERVAL", "5") or 5)
-        scraper = SelfScraper(
-            s.add_rows, instance="bench", interval_s=scrape_interval,
-            extra=plane_api.app_metrics,
-            on_tick=lambda now_ms: plane_engine.maybe_eval(now_ms))
-        scraper.start()
+        # VM_SELF_SCRAPE_INTERVAL=0 means OFF (the documented flag-table
+        # semantics) — the plane-overhead A/B leg, NOT a 20Hz loop
+        # (SelfScraper clamps interval_s to 0.05s, so passing 0 through
+        # would measure the opposite of "plane disabled")
+        scrape_interval = _selfscrape.configured_interval("5")
+        if scrape_interval > 0:
+            scraper = SelfScraper(
+                s.add_rows, instance="bench", interval_s=scrape_interval,
+                extra=plane_api.app_metrics,
+                on_tick=lambda now_ms: plane_engine.maybe_eval(now_ms))
+            scraper.start()
+        else:
+            print("bench: self-monitoring plane OFF "
+                  "(VM_SELF_SCRAPE_INTERVAL=0) — plane-overhead A/B leg",
+                  file=sys.stderr)
 
         # -- ingest: realistic jittered counters through the real write
         # path — the COLUMNAR pipeline HTTP ingest uses (raw text series
@@ -667,6 +676,9 @@ def _bench_health(scraper, plane_api, plane_engine, storage) -> dict:
     """One final scrape + eval round, then the health verdict — the
     artifact carries the plane's own view of the whole run."""
     from victoriametrics_tpu.query import sloplane
+    if scraper is None:
+        return {"disabled": "VM_SELF_SCRAPE_INTERVAL=0 (plane-overhead "
+                            "A/B leg)"}
     try:
         scraper.scrape_once()
         plane_engine.maybe_eval(force=True)
@@ -950,6 +962,391 @@ def fleet_main() -> None:
             s.close()
         except Exception:
             pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+FLEETD_SERIES = 4096       # 64 instances x 64 jobs, every pair distinct
+FLEETD_INSTANCES = 64
+FLEETD_JOBS = 64
+FLEETD_SAMPLES = 240       # 1h @ 15s
+FLEETD_SCRAPE = 15_000
+FLEETD_DUR = 20 * STEP     # rendered window per subscription
+FLEETD_WARM = 2            # adoption intervals before measurement starts
+FLEETD_PANELS = (
+    "sum by (instance)(rate(http_requests_total[5m]))",
+    "sum by (job)(rate(http_requests_total[5m]))",
+    "max by (instance)(rate(http_requests_total[5m]))",
+    "count by (job)(rate(http_requests_total[5m]))",
+)
+
+
+def fleet_device_main() -> None:
+    """``--scenario=fleet --device``: the MULTICHIP_r07 acceptance leg
+    (ISSUE 19 / ROADMAP item 3) — fleet-batched device serving on the
+    virtual 8-device mesh.
+
+    ``FLEET_SUBS x len(FLEETD_PANELS)`` = 40 subscriptions over a corpus
+    shaped so every panel lands in ONE fleet bucket (4096 counters =
+    64 instances x 64 jobs, so ``by (instance)`` and ``by (job)`` both
+    reduce to G=64 and share the G rung; same selector -> same S=4096
+    rung; same duration/step -> same T rung).  The run then proves, per
+    measured interval: exactly ONE fused mesh launch serves all four
+    member streams, zero backend recompiles (<= 2 XLA compiles per
+    bucket over the whole run), the rows-share cost split of the shared
+    launch sums to the launch wall across the usage rows, and the
+    served windows match BOTH oracles at rtol=1e-12 — a cold host
+    evaluation and a deterministic ``VM_DEVICE_FLEET=0`` per-stream
+    replay of the same sequence.  A two-subprocess probe (same
+    machinery as the tools/lint.sh compile-cache smoke) shows a warm
+    restart compiles 0 kernels with ``VM_COMPILE_CACHE_DIR`` set."""
+    from victoriametrics_tpu import native
+    from victoriametrics_tpu.httpapi.prometheus_api import PrometheusAPI
+    from victoriametrics_tpu.query import rollup_result_cache as rrc
+    from victoriametrics_tpu.query.exec import exec_query
+    from victoriametrics_tpu.query.matstream import StreamClient
+    from victoriametrics_tpu.query.types import EvalConfig
+    from victoriametrics_tpu.utils import flightrec, profiler
+
+    from __graft_entry__ import _provision_devices
+    devices = _provision_devices(8)
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from victoriametrics_tpu.parallel.mesh import make_mesh
+    from victoriametrics_tpu.query.tpu_engine import (TPUEngine,
+                                                      backend_compiles)
+    from victoriametrics_tpu.storage.storage import Storage
+
+    profiler.ensure_started()
+    mesh = make_mesh(n_series=8, n_time=1, devices=devices[:8])
+    now_ms = int(time.time() * 1000)
+    t0 = (now_ms - (FLEETD_SAMPLES - 1) * FLEETD_SCRAPE) // STEP * STEP
+    end0 = t0 + ((FLEETD_SAMPLES - 1) * FLEETD_SCRAPE // STEP + 1) * STEP
+    keys = [(f'http_requests_total{{instance="host-{i // FLEETD_JOBS}",'
+             f'job="job-{i % FLEETD_JOBS}"}}').encode()
+            for i in range(FLEETD_SERIES)]
+    keybuf = b"".join(keys)
+    klens = np.fromiter((len(k) for k in keys), np.int64, FLEETD_SERIES)
+    koffs = np.concatenate([[0], np.cumsum(klens)[:-1]])
+    tmp = tempfile.mkdtemp(prefix="vmtpu-fleetdev-")
+
+    def _rows(entries):
+        return {json.dumps(e["metric"], sort_keys=True):
+                np.array([[float(t), float(v)] for t, v in e["values"]])
+                for e in entries}
+
+    def _max_rel(got, want, ctx):
+        """assert_allclose at the rtol=1e-12 contract AND report the
+        actual worst relative error for the artifact."""
+        assert set(got) == set(want), (ctx, sorted(set(got) ^ set(want))[:4])
+        worst = 0.0
+        for k in sorted(got):
+            g, w = got[k], want[k]
+            assert g.shape == w.shape, (ctx, k, g.shape, w.shape)
+            np.testing.assert_allclose(g, w, rtol=1e-12, atol=0,
+                                       err_msg=f"{ctx} {k}")
+            denom = np.maximum(np.abs(w), 1e-300)
+            worst = max(worst, float(np.max(np.abs(g - w) / denom))
+                        if g.size else 0.0)
+        return worst
+
+    def leg(sub_dir, fleet_on, n_per_panel, n_intervals):
+        """One deterministic serving sequence over a fresh storage (same
+        t0 + same rng seed => identical rows leg-to-leg).  Returns the
+        per-interval reassembled windows plus the fleet counters and, on
+        the fleet leg, the measured interval walls / cost split / cold
+        oracle."""
+        rng = np.random.default_rng(0)
+        last = np.zeros(FLEETD_SERIES)
+        prev_env = os.environ.pop("VM_DEVICE_FLEET", None)
+        if not fleet_on:
+            os.environ["VM_DEVICE_FLEET"] = "0"
+        s = Storage(os.path.join(tmp, sub_dir))
+        orig_rec = flightrec.rec
+        try:
+            base = (np.arange(FLEETD_SAMPLES, dtype=np.int64)
+                    * FLEETD_SCRAPE + t0)
+            chunk = 512
+            for i0 in range(0, FLEETD_SERIES, chunk):
+                i1 = min(i0 + chunk, FLEETD_SERIES)
+                vals2 = np.cumsum(
+                    rng.integers(0, 50, (i1 - i0, FLEETD_SAMPLES)),
+                    axis=1).astype(np.float64)
+                last[i0:i1] = vals2[:, -1]
+                ts2 = np.ascontiguousarray(np.broadcast_to(
+                    base, (i1 - i0, FLEETD_SAMPLES)))
+                s.add_rows_columnar(native.ColumnarRows(
+                    keybuf, np.repeat(koffs[i0:i1], FLEETD_SAMPLES),
+                    np.repeat(klens[i0:i1], FLEETD_SAMPLES),
+                    ts2.reshape(-1), vals2.reshape(-1)))
+            s.force_flush()
+            s.force_merge()
+
+            def ingest_fresh(end_ms):
+                incr = rng.integers(0, 50, (FLEETD_SERIES, 4))
+                vals2 = last[:, None] + np.cumsum(incr, axis=1)
+                last[:] = vals2[:, -1]
+                ts2 = np.broadcast_to(
+                    end_ms - STEP + (np.arange(4, dtype=np.int64) + 1)
+                    * FLEETD_SCRAPE, (FLEETD_SERIES, 4))
+                s.add_rows_columnar(native.ColumnarRows(
+                    keybuf, np.repeat(koffs, 4), np.repeat(klens, 4),
+                    np.ascontiguousarray(ts2).reshape(-1),
+                    vals2.reshape(-1).astype(np.float64)))
+
+            rrc.GLOBAL.reset()
+            engine = TPUEngine(min_series=4, mesh=mesh)
+            api = PrometheusAPI(s, engine)
+            subs = [[(api.matstreams.subscribe(q, STEP, FLEETD_DUR),
+                      StreamClient()) for _ in range(n_per_panel)]
+                    for q in FLEETD_PANELS]
+
+            def drain(now):
+                target = now // STEP * STEP
+                for panel in subs:
+                    for sub, cli in panel:
+                        while not (cli.window and cli.window[1] >= target):
+                            f = sub.next_frame(timeout_s=60.0, now_ms=now)
+                            if f is None:
+                                raise RuntimeError("subscriber starved")
+                            cli.apply(f)
+
+            drain(end0)
+            plane = engine.fleet()
+            walls = []
+
+            def spy(name, t_s, dur, arg=None):
+                if name == "device:fleet_launch":
+                    walls.append(dur)
+                return orig_rec(name, t_s, dur, arg)
+
+            flightrec.rec = spy
+
+            def exec_ms():
+                return sum(ms.usage_row().get("deviceExecMs", 0.0)
+                           for ms in api.matstreams.streams())
+
+            out = {"results": [], "push_wall": [], "intervals": [],
+                   "cost": []}
+            end = end0
+            for r in range(n_intervals):
+                end += STEP
+                ingest_fresh(end)
+                walls.clear()
+                st0 = plane.stats()
+                e0 = exec_ms()
+                tw = time.perf_counter()
+                api.matstreams.advance_due(end)
+                drain(end)
+                wall = time.perf_counter() - tw
+                st1 = plane.stats()
+                out["results"].append(
+                    {q: _rows(panel[0][1].result())
+                     for q, panel in zip(FLEETD_PANELS, subs)})
+                for q, panel in zip(FLEETD_PANELS, subs):
+                    head = panel[0][1].result()
+                    for _, cli in panel[1:]:
+                        assert cli.result() == head, (
+                            f"fan-out subscribers of {q!r} diverged")
+                if not (fleet_on and r >= FLEETD_WARM):
+                    continue
+                out["push_wall"].append(wall)
+                d = {k: st1[k] - st0[k]
+                     for k in ("launches", "served", "compiles")}
+                assert st1["buckets"] == 1, (
+                    f"panels split across {st1['buckets']} buckets — the "
+                    "64x64 corpus no longer shares one G/S/T rung")
+                assert st1["members"] == len(FLEETD_PANELS), st1
+                assert d["launches"] == 1, (
+                    f"interval {r}: {d['launches']} launches for 1 bucket "
+                    "— fleet batching regressed to per-stream programs")
+                assert d["served"] == len(FLEETD_PANELS), (r, d)
+                assert d["compiles"] == 0, (
+                    f"interval {r}: warm interval paid a backend compile")
+                out["intervals"].append(d)
+                billed = exec_ms() - e0
+                launch_ms = sum(walls) * 1e3
+                assert launch_ms > 0, "no fleet launch recorded"
+                assert abs(billed - launch_ms) < \
+                    0.05 + 0.002 * len(FLEETD_PANELS), (
+                    f"interval {r}: usage rows billed {billed:.3f}ms for "
+                    f"{launch_ms:.3f}ms of shared launches")
+                out["cost"].append({"billed_ms": round(billed, 3),
+                                    "launch_ms": round(launch_ms, 3)})
+            out["stats"] = plane.stats()
+            out["usage"] = api.matstreams.usage_rows()
+            if fleet_on:
+                # cold host oracle at the final interval
+                import math as _math
+
+                from victoriametrics_tpu.query.format_value import fmt_value
+                worst = 0.0
+                for q, panel in zip(FLEETD_PANELS, subs):
+                    ec = EvalConfig(start=end - FLEETD_DUR, end=end,
+                                    step=STEP, storage=s,
+                                    disable_cache=True)
+                    grid = ec.timestamps() / 1e3
+                    want = {}
+                    for rr in exec_query(ec, q):
+                        vals = np.array(
+                            [[float(t), float(fmt_value(v))]
+                             for t, v in zip(grid, rr.values)
+                             if not _math.isnan(v)])
+                        if len(vals):
+                            want[json.dumps(rr.metric_name.to_dict(),
+                                            sort_keys=True)] = vals
+                    worst = max(worst, _max_rel(
+                        _rows(panel[0][1].result()), want,
+                        f"cold oracle {q!r}"))
+                out["cold_max_rel"] = worst
+            for panel in subs:
+                for sub, _ in panel:
+                    sub.close()
+            return out
+        finally:
+            flightrec.rec = orig_rec
+            os.environ.pop("VM_DEVICE_FLEET", None)
+            if prev_env is not None:
+                os.environ["VM_DEVICE_FLEET"] = prev_env
+            try:
+                s.close()
+            except Exception:
+                pass
+
+    try:
+        t_leg = time.perf_counter()
+        fleet = leg("fleet-on", True, FLEET_SUBS,
+                    FLEETD_WARM + FLEET_INTERVALS)
+        fleet_wall_s = time.perf_counter() - t_leg
+        compiles_proc = backend_compiles()
+        t_leg = time.perf_counter()
+        off = leg("fleet-off", False, 1, FLEETD_WARM + 4)
+        off_wall_s = time.perf_counter() - t_leg
+        assert off["stats"]["launches"] == 0, (
+            "VM_DEVICE_FLEET=0 still launched fleet programs")
+        # batched == per-stream across every overlapping interval of the
+        # deterministic replay
+        ps_max_rel = 0.0
+        for r, (g, w) in enumerate(zip(fleet["results"], off["results"])):
+            for q in FLEETD_PANELS:
+                ps_max_rel = max(ps_max_rel, _max_rel(
+                    g[q], w[q], f"per-stream oracle interval {r} {q!r}"))
+
+        # warm-restart probe: two cold subprocesses sharing one
+        # VM_COMPILE_CACHE_DIR — the second must compile nothing
+        from victoriametrics_tpu.devtools.compile_cache_smoke import _spawn
+        cache_dir = tempfile.mkdtemp(prefix="vmtpu-fleetdev-ccache-")
+        try:
+            cold = _spawn(cache_dir, own_fmt=False)
+            if not cold["telemetry"]:
+                warm_restart = {"skipped": "compile-event telemetry "
+                                           "unavailable"}
+            else:
+                if cold["native_refused"]:
+                    shutil.rmtree(cache_dir, ignore_errors=True)
+                    cache_dir = tempfile.mkdtemp(
+                        prefix="vmtpu-fleetdev-ccache-")
+                    cold = _spawn(cache_dir, own_fmt=True)
+                warm = _spawn(cache_dir, own_fmt=cold["native_refused"])
+                assert warm["compiles"] == 0, (
+                    f"warm restart recompiled {warm['compiles']} kernels "
+                    "with the persistent cache enabled")
+                warm_restart = {
+                    "mechanism": ("ownfmt" if cold["native_refused"]
+                                  else "native"),
+                    "cold_compiles": cold["compiles"],
+                    "warm_compiles": warm["compiles"],
+                    "warm_cache_hits": warm["hits"],
+                }
+        finally:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+        n_subscriptions = FLEET_SUBS * len(FLEETD_PANELS)
+        window_samples = FLEETD_SERIES * ((FLEETD_DUR + 600_000)
+                                          // FLEETD_SCRAPE)
+        p50_push = float(np.median(fleet["push_wall"]))
+        agg_rate = n_subscriptions * window_samples / p50_push
+        st = fleet["stats"]
+        assert st["compiles"] <= 2 * st["buckets"], (
+            f"{st['compiles']} backend compiles for {st['buckets']} "
+            "bucket(s) — the <=2-per-bucket acceptance bound broke")
+        print(json.dumps({
+            "metric": (
+                f"fleet-batched device serving: {n_subscriptions} "
+                f"subscriptions ({FLEET_SUBS} dashboards x "
+                f"{len(FLEETD_PANELS)} shared-selector panels) over "
+                f"{FLEETD_SERIES} counters ({FLEETD_INSTANCES} instances "
+                f"x {FLEETD_JOBS} jobs, so by(instance)/by(job) share "
+                f"the G=64 rung) on the virtual 8-device mesh — ONE "
+                f"fused launch per interval serves the whole fleet, "
+                f"{st['compiles']} backend compile(s) total, parity at "
+                f"rtol=1e-12 with both the cold host oracle and the "
+                f"VM_DEVICE_FLEET=0 per-stream replay"),
+            "artifact": "MULTICHIP_r07",
+            "value": round(agg_rate),
+            "unit": "samples/sec",
+            "backend": "cpu-device-float64",
+            "scenario": "fleet-device",
+            "n_devices": len(devices),
+            "subscriptions": n_subscriptions,
+            "subscribers_per_panel": FLEET_SUBS,
+            "panels": len(FLEETD_PANELS),
+            "series": FLEETD_SERIES,
+            "groups_per_panel": FLEETD_INSTANCES,
+            "push_interval_ms": [round(x * 1e3, 2)
+                                 for x in fleet["push_wall"]],
+            "push_interval_p50_ms": round(p50_push * 1e3, 2),
+            "fleet": {
+                "buckets": st["buckets"],
+                "members": st["members"],
+                "adoptions": st["adoptions"],
+                "evictions": st["evictions"],
+                "launches_total": st["launches"],
+                "served_total": st["served"],
+                "bucket_compiles_total": st["compiles"],
+                "per_measured_interval": fleet["intervals"],
+            },
+            "cost_split": {
+                "per_interval": fleet["cost"],
+                "max_abs_gap_ms": round(max(
+                    abs(c["billed_ms"] - c["launch_ms"])
+                    for c in fleet["cost"]), 3),
+            },
+            "oracles": {
+                "rtol": 1e-12,
+                "served_vs_cold_max_rel": fleet["cold_max_rel"],
+                "served_vs_per_stream_max_rel": ps_max_rel,
+                "per_stream_leg": {
+                    "intervals_compared": min(len(fleet["results"]),
+                                              len(off["results"])),
+                    "fleet_launches": off["stats"]["launches"],
+                    "wall_s": round(off_wall_s, 1),
+                },
+            },
+            "warm_restart": warm_restart,
+            "process_backend_compiles_after_fleet_leg": compiles_proc,
+            "fleet_leg_wall_s": round(fleet_wall_s, 1),
+            "per_stream_usage": fleet["usage"],
+            "reference": {
+                "BENCH_r11_host_fleet": {
+                    "samples_per_sec": 956106707,
+                    "push_interval_p50_ms": 499.01,
+                },
+                "BENCH_r12_device_leg": {
+                    "refresh_p50_ms": 1406.85,
+                    "device_execute_ms_per_capture": 1332.14,
+                    "device_compile_ms_per_capture": 2825.11,
+                    "note": ("r12 paid one compile and one launch per "
+                             "query shape per process; this run pays "
+                             "one fused launch per interval for the "
+                             "whole fleet and restarts warm"),
+                },
+            },
+            "profiler": {
+                "samples": profiler.PROFILER.snapshot()["samples"],
+                "hz": profiler.configured_hz(),
+            },
+        }))
+    finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
@@ -1315,8 +1712,15 @@ if __name__ == "__main__":
                          "materialized streams (BENCH_r11); cluster: "
                          "elastic scale-out over real vmstorage "
                          "processes (CLUSTER_r12)")
+    _p.add_argument("--device", action="store_true",
+                    help="with --scenario=fleet: the fleet-batched "
+                         "DEVICE serving leg on the virtual 8-device "
+                         "mesh (MULTICHIP_r07) — one fused launch per "
+                         "interval for every resident stream")
     _args = _p.parse_args()
-    if _args.scenario == "fleet":
+    if _args.scenario == "fleet" and _args.device:
+        fleet_device_main()
+    elif _args.scenario == "fleet":
         fleet_main()
     elif _args.scenario == "cluster":
         cluster_main()

@@ -427,8 +427,15 @@ class TestHTTPWatch:
             first = next(chunks)            # the cold snapshot frame
             assert b"event: frame" in first
             t0 = time.monotonic()
-            for _ in range(2):              # then idle keepalives
-                assert next(chunks) == b": keepalive\n\n"
+            got = 0
+            while got < 2:                  # then idle keepalives
+                c = next(chunks)
+                if c.startswith(b"event: frame"):
+                    # a real 1m interval boundary can cross mid-test and
+                    # legitimately emit a frame; only keepalives count
+                    continue
+                assert c == b": keepalive\n\n"
+                got += 1
             assert time.monotonic() - t0 >= 0.3, (
                 "keepalives arrived back-to-back: heartbeat=0 spins")
         finally:

@@ -22,7 +22,10 @@
 #   matstream  materialized-stream fan-out  VMT_NO_MATSTREAM_SMOKE=1
 #   selfscrape self-scrape+SLO duty cycle   VMT_NO_SELFSCRAPE_SMOKE=1
 #   reshard    elastic scale-out reshard    VMT_NO_RESHARD_SMOKE=1
-#   device     8-device residency guard     VMT_NO_DEVICE_SMOKE=1
+#   ccache     persistent compile cache: a second cold process must
+#              compile 0 kernels for a warmed bucket shape (native jax
+#              cache + own-format fallback)  VMT_NO_COMPILE_CACHE_SMOKE=1
+#   device     8-device residency + fleet   VMT_NO_DEVICE_SMOKE=1
 #   crash      one crashpoint seam + reopen VMT_NO_CRASH_SMOKE=1
 #   tier1      pytest tests/ -m 'not slow'  VMT_NO_TIER1=1
 #
@@ -102,9 +105,16 @@ if [ "${VMT_NO_RESHARD_SMOKE:-0}" != "1" ]; then
 else
     skipped reshard
 fi
+if [ "${VMT_NO_COMPILE_CACHE_SMOKE:-0}" != "1" ]; then
+    run_stage ccache \
+        python -m victoriametrics_tpu.devtools.compile_cache_smoke
+else
+    skipped ccache
+fi
 if [ "${VMT_NO_DEVICE_SMOKE:-0}" != "1" ]; then
     run_stage device sh tools/device.sh \
-        "tests/test_device_residency.py::test_refresh_uploads_only_tail_on_mesh"
+        "tests/test_device_residency.py::test_refresh_uploads_only_tail_on_mesh" \
+        "tests/test_device_fleet.py::test_fleet_single_launch_per_interval"
 else
     skipped device
 fi

@@ -11,6 +11,11 @@
 # printed on stderr, and the suite line never appears).
 #
 #   tools/device.sh                      # full device suite
+#   tools/device.sh fleet                # fleet-batched serving suite only
+#   tools/device.sh warmup               # pre-compile fleet kernels into
+#                                        # the persistent compile cache
+#                                        # (VM_COMPILE_CACHE_DIR) so the
+#                                        # next serving restart starts warm
 #   tools/device.sh tests/test_x.py::t   # specific tests (lint smoke)
 #   VMT_DEVICE_PROBE_TIMEOUT_S=30 tools/device.sh
 set -eu
@@ -29,9 +34,22 @@ print(f'device.sh probe OK: {n} virtual cpu devices')
          "(>${TIMEOUT}s); the device suite DID NOT RUN (not a pass)." >&2
     exit 0
 fi
+if [ "${1:-}" = "warmup" ]; then
+    shift
+    exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+        JAX_ENABLE_X64="${JAX_ENABLE_X64:-1}" \
+        python -m victoriametrics_tpu.devtools.compile_cache_smoke \
+        --warmup "$@"
+fi
+if [ "${1:-}" = "fleet" ]; then
+    shift
+    set -- tests/test_device_fleet.py "$@"
+fi
 if [ "$#" -eq 0 ]; then
     set -- tests/test_device_residency.py tests/test_exec_query_mesh.py \
            tests/test_rolling_tile.py tests/test_served_device_path.py \
-           tests/test_device_rollup.py tests/test_f32_tiles.py
+           tests/test_device_rollup.py tests/test_f32_tiles.py \
+           tests/test_device_fleet.py
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider "$@"

@@ -92,6 +92,15 @@ if [ "${VMT_NO_RESHARD_SMOKE:-0}" != "1" ]; then
     env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python -m victoriametrics_tpu.devtools.reshard_smoke
 fi
+# Persistent compile-cache smoke (devtools/compile_cache_smoke.py): a
+# second cold process must compile 0 kernels for a fleet bucket shape
+# the first process warmed — native jax cache AND the own-format
+# serialized-executable fallback.  Skips itself loudly when the runtime
+# supports neither; VMT_NO_COMPILE_CACHE_SMOKE=1 skips it outright.
+if [ "${VMT_NO_COMPILE_CACHE_SMOKE:-0}" != "1" ]; then
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python -m victoriametrics_tpu.devtools.compile_cache_smoke
+fi
 if [ "${VMT_NO_DEVICE_SMOKE:-0}" != "1" ]; then
     sh tools/device.sh \
         "tests/test_device_residency.py::test_refresh_uploads_only_tail_on_mesh"

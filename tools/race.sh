@@ -52,6 +52,13 @@
 # queues stay bounded, and no exception escapes.  VM_MATSTREAM=0 is the
 # escape hatch (watch subscribers fall back to polling query_range).
 #
+# The fleet-batched device plane (query/fleet) is covered by the
+# race-marked stress in tests/test_device_fleet.py: subscriber churn +
+# live ingest + concurrent cooperative pumps while the fleet adopts,
+# launches and serves on the virtual 8-device mesh, asserting the steady
+# subscriber matches the host oracle after quiescing.  VM_DEVICE_FLEET=0
+# is the escape hatch (streams fall back to per-stream rolling serving).
+#
 # The per-tenant admission gate (utils/workpool.TenantGate) is covered
 # by the race-marked stress in tests/test_tenant_gate.py: two tenants'
 # workers under the deterministic scheduler, asserting the per-tenant
@@ -70,5 +77,6 @@ cd "$(dirname "$0")/.."
 exec env VMT_RACETRACE=1 VMT_LOCKTRACE_MAX_HOLD_MS=60000 \
     python -m pytest tests/test_stress_race.py \
     tests/test_result_cache_ring.py tests/test_flightrec.py \
-    tests/test_tenant_gate.py tests/test_matstream.py -q -m race \
+    tests/test_tenant_gate.py tests/test_matstream.py \
+    tests/test_device_fleet.py -q -m race \
     -p no:cacheprovider "$@"

@@ -653,6 +653,99 @@ def aggregate_groups(aggr: str, rolled: jnp.ndarray, group_ids: jnp.ndarray,
         aggr, partial_group_moments(aggr, rolled, group_ids, num_groups))
 
 
+#: stream-axis aggregate selector for the fleet kernel: the aggregate is
+#: a per-stream TRACED code, so streams mixing sum/max/count/... share
+#: ONE compiled program per bucket shape instead of one per aggregate
+FLEET_AGGR_CODES = {"sum": 0, "count": 1, "avg": 2, "min": 3, "max": 4,
+                    "stddev": 5, "stdvar": 6, "group": 7}
+
+
+def _fleet_group_aggregate(rolled: jnp.ndarray, group_ids: jnp.ndarray,
+                           num_groups: int, aggr_code) -> jnp.ndarray:
+    """All-moments segment aggregation + finalize-by-code: computes the
+    same cnt/s1/s2/min/max moments partial_group_moments would (same ops,
+    same order, so each selected aggregate matches the per-stream kernel
+    at f64 resolution), finalizes every aggregate, and gathers the one
+    `aggr_code` (traced int32) names."""
+    present = ~jnp.isnan(rolled)
+    zeroed = jnp.where(present, rolled, 0.0)
+    S = rolled.shape[0]
+    if num_groups * S <= (1 << 24):
+        onehot = (group_ids[None, :] ==
+                  jnp.arange(num_groups, dtype=group_ids.dtype)[:, None]
+                  ).astype(rolled.dtype)
+        all_finite = jnp.all(jnp.isfinite(zeroed))
+
+        def seg(x):
+            return jax.lax.cond(
+                all_finite,
+                lambda y: onehot @ y,
+                lambda y: jax.ops.segment_sum(y, group_ids,
+                                              num_segments=num_groups),
+                x)
+
+        cnt = onehot @ present.astype(rolled.dtype)
+    else:
+        def seg(x):
+            return jax.ops.segment_sum(x, group_ids,
+                                       num_segments=num_groups)
+
+        cnt = seg(present.astype(rolled.dtype))
+    s1 = seg(zeroed)
+    s2 = seg(zeroed * zeroed)
+    mn = jax.ops.segment_min(jnp.where(present, rolled, jnp.inf),
+                             group_ids, num_segments=num_groups)
+    mx = jax.ops.segment_max(jnp.where(present, rolled, -jnp.inf),
+                             group_ids, num_segments=num_groups)
+    mean = s1 / cnt
+    var = jnp.maximum(s2 / cnt - mean * mean, 0.0)
+    outs = jnp.stack([s1, cnt, mean, mn, mx, jnp.sqrt(var), var,
+                      jnp.ones_like(cnt)])
+    out = outs[aggr_code]
+    nan = jnp.asarray(jnp.nan, cnt.dtype)
+    return jnp.where(cnt > 0, out, nan)
+
+
+def fleet_rollup_aggregate_impl(rollup_func: str, cfg: RollupConfig,
+                                num_groups: int, fleet_ts: jnp.ndarray,
+                                fleet_values: jnp.ndarray,
+                                fleet_counts: jnp.ndarray,
+                                fleet_gids: jnp.ndarray,
+                                fleet_aggr: jnp.ndarray,
+                                fleet_shift: jnp.ndarray,
+                                fleet_min_ts: jnp.ndarray,
+                                fleet_v0: jnp.ndarray) -> jnp.ndarray:
+    """Fleet-batched aggr(rollup(m[d])) over [B, S, N] planes -> [B, G, T]:
+    ONE program for every resident stream in a bucket.  Static per bucket:
+    rollup_func, the normalized cfg grid, num_groups.  Per-stream traced:
+    grid shift, fetch bound min_ts, aggregate code, rebase offsets —
+    window masks per stream fall out of shift/min_ts exactly as in the
+    per-stream rolling path (the bit-equality oracle).  Padded streams
+    carry counts == 0 / ts == TS_PAD and roll up to all-NaN rows."""
+
+    def one(ts, values, counts, gids, aggr_code, shift, min_ts, v0):
+        rolled = rollup_tile(rollup_func, ts - jnp.int32(shift), values,
+                             counts, cfg, min_ts, v0)
+        return _fleet_group_aggregate(rolled, gids, num_groups, aggr_code)
+
+    return jax.vmap(one)(fleet_ts, fleet_values, fleet_counts, fleet_gids,
+                         fleet_aggr, fleet_shift, fleet_min_ts, fleet_v0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rollup_func", "cfg", "num_groups"))
+def fleet_rollup_aggregate_tile(rollup_func: str, cfg: RollupConfig,
+                                num_groups: int, fleet_ts, fleet_values,
+                                fleet_counts, fleet_gids, fleet_aggr,
+                                fleet_shift, fleet_min_ts, fleet_v0):
+    """Single-device jit of fleet_rollup_aggregate_impl (mesh engines go
+    through parallel.mesh.cached_fleet_rollup_aggregate instead)."""
+    return fleet_rollup_aggregate_impl(rollup_func, cfg, num_groups,
+                                       fleet_ts, fleet_values, fleet_counts,
+                                       fleet_gids, fleet_aggr, fleet_shift,
+                                       fleet_min_ts, fleet_v0)
+
+
 @functools.partial(jax.jit, static_argnames=("rollup_func", "aggr", "cfg", "num_groups"))
 def rollup_aggregate_tile(rollup_func: str, aggr: str, ts: jnp.ndarray,
                           values: jnp.ndarray, counts: jnp.ndarray,
@@ -672,6 +765,21 @@ def rollup_aggregate_tile(rollup_func: str, aggr: str, ts: jnp.ndarray,
     return aggregate_groups(aggr, rolled, group_ids, num_groups)
 
 
+def _append_tile_body(ts: jnp.ndarray, values: jnp.ndarray,
+                      counts: jnp.ndarray, new_ts: jnp.ndarray,
+                      new_values: jnp.ndarray, new_counts: jnp.ndarray):
+    S, N = ts.shape
+    K = new_ts.shape[1]
+    rows = jnp.arange(S, dtype=jnp.int32)[:, None]
+    k = jnp.arange(K, dtype=jnp.int32)[None, :]
+    live = k < new_counts[:, None]
+    pos = jnp.where(live, counts.astype(jnp.int32)[:, None] + k, N)
+    ts2 = ts.at[rows, pos].set(new_ts, mode="drop")
+    v2 = values.at[rows, pos].set(new_values.astype(values.dtype),
+                                  mode="drop")
+    return ts2, v2, counts + new_counts.astype(counts.dtype)
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
 def append_tile(ts: jnp.ndarray, values: jnp.ndarray, counts: jnp.ndarray,
                 new_ts: jnp.ndarray, new_values: jnp.ndarray,
@@ -684,16 +792,38 @@ def append_tile(ts: jnp.ndarray, values: jnp.ndarray, counts: jnp.ndarray,
     samples must be strictly newer than each row's existing samples (the
     eval layer guarantees this via the storage append watermark); per-row
     positions beyond new_counts[row] scatter out of bounds and are dropped."""
+    return _append_tile_body(ts, values, counts, new_ts, new_values,
+                             new_counts)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def fleet_append_tile(fleet_ts: jnp.ndarray, fleet_values: jnp.ndarray,
+                      fleet_counts: jnp.ndarray, new_ts: jnp.ndarray,
+                      new_values: jnp.ndarray, new_counts: jnp.ndarray):
+    """Batched append over the fleet's leading stream axis: ONE donated
+    launch scatters every staged stream's suffix columns [B, S, K] onto
+    the packed [B, S, N] planes (query/fleet.py).  Streams with nothing
+    staged carry new_counts == 0 rows and are untouched."""
+    return jax.vmap(_append_tile_body)(fleet_ts, fleet_values, fleet_counts,
+                                       new_ts, new_values, new_counts)
+
+
+def _compact_tile_body(ts: jnp.ndarray, values: jnp.ndarray,
+                       counts: jnp.ndarray, cutoff_rel, delta):
     S, N = ts.shape
-    K = new_ts.shape[1]
-    rows = jnp.arange(S, dtype=jnp.int32)[:, None]
-    k = jnp.arange(K, dtype=jnp.int32)[None, :]
+    k = jnp.arange(N, dtype=jnp.int32)[None, :]
+    valid = k < counts[:, None]
+    drop = jnp.sum(valid & (ts < jnp.int32(cutoff_rel)), axis=1,
+                   dtype=jnp.int32)
+    new_counts = counts - drop
+    idx = jnp.clip(drop[:, None] + k, 0, N - 1)
     live = k < new_counts[:, None]
-    pos = jnp.where(live, counts.astype(jnp.int32)[:, None] + k, N)
-    ts2 = ts.at[rows, pos].set(new_ts, mode="drop")
-    v2 = values.at[rows, pos].set(new_values.astype(values.dtype),
-                                  mode="drop")
-    return ts2, v2, counts + new_counts.astype(counts.dtype)
+    ts2 = jnp.where(live,
+                    jnp.take_along_axis(ts, idx, axis=1) - jnp.int32(delta),
+                    TS_PAD)
+    v2 = jnp.where(live, jnp.take_along_axis(values, idx, axis=1),
+                   jnp.zeros((), values.dtype))
+    return ts2, v2, new_counts
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -714,20 +844,19 @@ def compact_tile(ts: jnp.ndarray, values: jnp.ndarray, counts: jnp.ndarray,
     min_ts — see rollup_tile), so compacting at the CURRENT fetch_lo is
     invisible to this and every later query whose fetch bound is >= it;
     older-reaching queries decline via RollingTile.lo_ms and rebuild."""
-    S, N = ts.shape
-    k = jnp.arange(N, dtype=jnp.int32)[None, :]
-    valid = k < counts[:, None]
-    drop = jnp.sum(valid & (ts < jnp.int32(cutoff_rel)), axis=1,
-                   dtype=jnp.int32)
-    new_counts = counts - drop
-    idx = jnp.clip(drop[:, None] + k, 0, N - 1)
-    live = k < new_counts[:, None]
-    ts2 = jnp.where(live,
-                    jnp.take_along_axis(ts, idx, axis=1) - jnp.int32(delta),
-                    TS_PAD)
-    v2 = jnp.where(live, jnp.take_along_axis(values, idx, axis=1),
-                   jnp.zeros((), values.dtype))
-    return ts2, v2, new_counts
+    return _compact_tile_body(ts, values, counts, cutoff_rel, delta)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def fleet_compact_tile(fleet_ts: jnp.ndarray, fleet_values: jnp.ndarray,
+                       fleet_counts: jnp.ndarray, cutoff_rel: jnp.ndarray,
+                       delta: jnp.ndarray):
+    """Batched window-slide compaction: per-stream cutoffs/deltas [B]
+    (traced), one donated launch over the packed [B, S, N] planes.
+    Streams with cutoff_rel <= 0 pass (cutoff 0, delta 0) and come back
+    unchanged."""
+    return jax.vmap(_compact_tile_body)(fleet_ts, fleet_values,
+                                        fleet_counts, cutoff_rel, delta)
 
 
 def pack_series(series: list[tuple[np.ndarray, np.ndarray]], start_ms: int,

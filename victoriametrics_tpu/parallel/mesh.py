@@ -38,8 +38,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.device_rollup import rollup_tile
 from ..ops.rollup_np import RollupConfig
-from .partition import (AXIS_SERIES, AXIS_TIME, input_shardings,
-                        replicated)
+from .partition import (AXIS_SERIES, AXIS_STREAM, AXIS_TIME,
+                        input_shardings, replicated, sharding_for)
 
 
 def make_mesh(n_series: int | None = None, n_time: int = 1,
@@ -53,6 +53,42 @@ def make_mesh(n_series: int | None = None, n_time: int = 1,
         raise ValueError(f"mesh {n_series}x{n_time} != {n} devices")
     arr = np.asarray(devices).reshape(n_series, n_time)
     return Mesh(arr, (AXIS_SERIES, AXIS_TIME))
+
+
+def make_fleet_mesh(devices=None) -> Mesh:
+    """One-axis mesh sharding the fleet's leading STREAM axis over every
+    device: each device runs a contiguous slice of the resident streams'
+    whole programs (rollup windows never cross streams, so this axis
+    needs no halo exchange or cross-device reduction at all)."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (AXIS_STREAM,))
+
+
+@functools.lru_cache(maxsize=256)
+def cached_fleet_rollup_aggregate(mesh: Mesh, rollup_func: str,
+                                  cfg: RollupConfig, num_groups: int):
+    """Memoized fleet kernel for one bucket shape: the [B, S, N] planes
+    shard over AXIS_STREAM per the partition-rule table; the aggregate is
+    a per-stream traced code, so one compile covers every aggregate mix
+    (see ops.device_rollup.fleet_rollup_aggregate_impl).  The [B, G, T]
+    output stays stream-sharded — the single host pull gathers it."""
+    from ..ops.device_rollup import fleet_rollup_aggregate_impl
+    in_sh = input_shardings(
+        mesh, (("fleet_ts", 3), ("fleet_values", 3), ("fleet_counts", 2),
+               ("fleet_gids", 2), ("fleet_aggr", 1), ("fleet_shift", 1),
+               ("fleet_min_ts", 1), ("fleet_v0", 2)))
+
+    @functools.partial(jax.jit, in_shardings=in_sh,
+                       out_shardings=sharding_for(mesh, "fleet_out", 3))
+    def step(fleet_ts, fleet_values, fleet_counts, fleet_gids, fleet_aggr,
+             fleet_shift, fleet_min_ts, fleet_v0):
+        return fleet_rollup_aggregate_impl(
+            rollup_func, cfg, num_groups, fleet_ts, fleet_values,
+            fleet_counts, fleet_gids, fleet_aggr, fleet_shift,
+            fleet_min_ts, fleet_v0)
+
+    from ..query.tpu_engine import with_executable_cache
+    return with_executable_cache(step, f"fleet_rollup:{rollup_func}")
 
 
 @functools.lru_cache(maxsize=256)

@@ -36,11 +36,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_SERIES = "series"
 AXIS_TIME = "time"
+AXIS_STREAM = "stream"
 
 # regex -> spec-per-rank: rank 1 leaves drop the trailing None axes.
 # First match wins; unknown leaf names fail loudly (a silently replicated
 # (S, N) plane would upload S*N bytes to EVERY device).
 PARTITION_RULES: tuple[tuple[str, P], ...] = (
+    # fleet-batched planes: a leading stream axis stacks every resident
+    # window into one [B, ...] program (query/fleet.py) — the batch axis
+    # shards over AXIS_STREAM, everything below it stays device-local so
+    # per-stream rollups never exchange halos
+    (r"^fleet_(ts|values|vals|out)$", P(AXIS_STREAM, None, None)),
+    (r"^fleet_(counts|gids|v0)$", P(AXIS_STREAM, None)),
+    (r"^fleet_(shift|min_ts|aggr)$", P(AXIS_STREAM)),
     # packed (S, N) sample planes / (S, T) rollup blocks / delta planes
     (r"^(ts|values|vals)$", P(AXIS_SERIES, None)),
     (r"_d2$", P(AXIS_SERIES, None)),
@@ -79,10 +87,17 @@ def row_multiple(mesh: Mesh) -> int:
     return int(mesh.shape[AXIS_SERIES])
 
 
-def pad_rows_to_mesh(mesh: Mesh, a: np.ndarray, pad_value=0) -> np.ndarray:
-    """Pad the leading (series) axis to a multiple of the mesh's series
-    axis so the row shards are equal-sized."""
-    n_sh = row_multiple(mesh)
+def axis_multiple(mesh: Mesh, axis: str) -> int:
+    """Padding multiple for tiles whose leading axis shards over `axis`
+    (1 when the mesh doesn't carry that axis)."""
+    return int(mesh.shape.get(axis, 1)) if mesh is not None else 1
+
+
+def pad_rows_to_mesh(mesh: Mesh, a: np.ndarray, pad_value=0,
+                     axis: str = AXIS_SERIES) -> np.ndarray:
+    """Pad the leading axis to a multiple of the mesh axis it shards over
+    so the shards are equal-sized."""
+    n_sh = axis_multiple(mesh, axis)
     S = a.shape[0]
     S_pad = -(-S // n_sh) * n_sh
     if S_pad == S:
@@ -103,8 +118,8 @@ def shard_put(mesh: Mesh | None, name: str, a: np.ndarray, pad_value=0):
     import jax
     a = np.asarray(a)
     spec = match_partition_rules(name, a.ndim)
-    if a.ndim and spec[0] == AXIS_SERIES:
-        a = pad_rows_to_mesh(mesh, a, pad_value)
+    if a.ndim and spec[0] in (AXIS_SERIES, AXIS_STREAM):
+        a = pad_rows_to_mesh(mesh, a, pad_value, axis=spec[0])
     return timed_transfer(
         "device:upload", a.nbytes,
         lambda: jax.device_put(a, NamedSharding(mesh, spec)))

@@ -955,7 +955,12 @@ def device_window_ready(ec: EvalConfig, e: Expr) -> bool:
         return False
     wc = ec.tpu.window_cache()
     if wc.peek(roll_state_key) is None:
-        return False
+        # fleet members carry no per-shape wcache entry (adoption moved
+        # the window into the batched plane); they are device-resident
+        # all the same — and bypass the churn backoff below, because the
+        # fleet advances them without per-shape rebuild churn
+        from . import fleet as fleetmod
+        return fleetmod.resident(ec.tpu, roll_state_key)
     # persistent-churn backoff: consecutive rolling declines mean this
     # shape keeps rebuilding FULL windows on device (each rebuild
     # re-registers the window, so entry existence alone would route the
@@ -1018,6 +1023,20 @@ def _try_device_fused_aggr(ec: EvalConfig, ae: AggrFuncExpr
     if not device_resident_enabled():
         ver = None  # VM_DEVICE_RESIDENT=0: full upload every query — the
         #             loud escape hatch and the residency equality oracle
+    if ver is not None:
+        # fleet shortcut: a matstream advance whose interval the fleet
+        # prepass already served by the SHARED batched launch — the [G, T]
+        # slice is sitting in the plane's result table (version- and
+        # grid-matched), so this eval does zero storage reads and zero
+        # launches.  The ver-gating above keeps every oracle path
+        # (nocache / no_device_roll / VM_DEVICE_RESIDENT=0) off the fleet.
+        rsk_fleet, _ = _device_roll_keys(ec, ae, func, rarg, phi, window)
+        if rsk_fleet is not None:
+            from . import fleet as fleetmod
+            hit = fleetmod.take(ec, rsk_fleet)
+            if hit is not None:
+                count_window_hit()
+                return _emit(hit[0], hit[1])
     if ver is not None:
         aux_key = ("fused-aux", str(rarg.expr), ec.tenant, ec.start, ec.end,
                    ec.step, window, offset, func, ae.name, phi,

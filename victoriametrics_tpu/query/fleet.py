@@ -1,0 +1,974 @@
+"""Fleet-batched device serving (ROADMAP item 3): every active stream
+as ONE mesh program per interval.
+
+The matstream registry (query/matstream.py) already enumerates every
+active (expression, grid) pair; the device plane used to pay one kernel
+launch — and on a cold shape one XLA compile — PER query shape per
+interval anyway.  This module batches all device-resident streams of one
+bucket shape into a single fused launch: their packed (S, N) tile planes
+gain a leading stream axis ([B, S, N], named ``fleet_*`` in the
+partition-rule table so the batch axis shards over the mesh's STREAM
+axis), suffix ingest lands in one donated batched append
+(ops.device_rollup.fleet_append_tile), and one
+``fleet_rollup_aggregate`` launch computes every stream's [G, T]
+aggregate — 40 subscriptions cost one compile and one launch instead of
+40.
+
+Lifecycle of a stream through the fleet:
+
+1. **adoption** — a MatStream advance left a per-stream rolling window
+   resident (the wcache ``("roll-aggr", ...)`` entry).  The next
+   interval's prepass pulls a host copy of that window, CROPS it to the
+   stream's fetch bound, drops the per-stream entry (its buffers may be
+   donated away any time by a concurrent eval of the same selector —
+   the pull races that loudly and skips adoption for an interval), and
+   packs the copy into the stream slot of a bucket.
+2. **bucketing** — buckets are shape classes: (func, step, lookback,
+   S_b, N_b, T_b, G_b, dtype) with every dimension rounded UP a small
+   geometric ladder ({1, 1.5}·2^k, floor ``VM_FLEET_LADDER_MIN``), so
+   series churn and grid drift re-land in an existing compiled shape
+   instead of retriggering XLA.  Padded rows carry counts == 0 /
+   ts == TS_PAD, padded grid columns are sliced off on the host, padded
+   group rows aggregate to NaN and are discarded — the masks the
+   per-stream kernels already honor.
+3. **interval prepass** — MatStream._advance calls :func:`prepass`
+   before evaluating: every due member advances (slice-fetch mirroring
+   ``advance_rolling``'s guards; any violated guard EVICTS the member —
+   the stream's own eval then rebuilds per-stream state, re-adoptable
+   next interval), staged suffixes apply in one donated batched append
+   per bucket, and one fused launch per bucket computes all due
+   members' aggregates.  The [B, G, T] result is pulled once and
+   sliced per stream into a result table.
+4. **serving** — the stream's evaluation reaches
+   eval._try_device_fused_aggr, which consults :func:`take` FIRST: a
+   grid/version-matched result row answers the query with zero storage
+   reads and zero launches.  The shared launch cost is split per stream
+   by rows-share (``device:execute`` / ``device:upload`` laps +
+   uploaded-byte shares, consumed once so the per-stream rows sum
+   exactly to the launch total).
+
+Bucket planes keep an authoritative HOST mirror (numpy) alongside the
+device planes: appends/compactions apply to both (same arithmetic), so
+membership churn re-uploads from the mirror instead of pulling [B, S, N]
+back over the link.
+
+``VM_DEVICE_FLEET=0`` disables the plane entirely — the per-stream
+rolling path then serves every stream individually: the loud escape
+hatch AND the bit-equality oracle (tests/test_device_fleet.py diffs the
+two at rtol=1e-12).
+"""
+
+from __future__ import annotations
+
+import os
+import time as _time
+
+import numpy as np
+
+from ..devtools.locktrace import make_lock
+from ..ops.rollup_np import RollupConfig
+from ..utils import costacc, flightrec
+from ..utils import metrics as metricslib
+
+_LAUNCHES = metricslib.REGISTRY.counter("vm_device_fleet_launches_total")
+#: incremented by the number of due streams each launch served: the
+#: ratio to _LAUNCHES is the amortization factor
+_STREAMS = metricslib.REGISTRY.counter(
+    "vm_device_fleet_streams_per_launch_total")
+_ADOPTIONS = metricslib.REGISTRY.counter("vm_device_fleet_adoptions_total")
+_EVICTIONS = metricslib.REGISTRY.counter("vm_device_fleet_evictions_total")
+_SERVED = metricslib.REGISTRY.counter("vm_device_fleet_served_total")
+
+
+def enabled() -> bool:
+    """Fleet batching on?  VM_DEVICE_FLEET=0 falls back to the
+    per-stream rolling path — the escape hatch and equality oracle."""
+    return os.environ.get("VM_DEVICE_FLEET", "1") != "0"
+
+
+def ladder_min() -> int:
+    try:
+        return max(int(os.environ.get("VM_FLEET_LADDER_MIN", "8")), 1)
+    except ValueError:
+        return 8
+
+
+def max_members() -> int:
+    try:
+        return max(int(os.environ.get("VM_FLEET_MAX", "256")), 1)
+    except ValueError:
+        return 256
+
+
+def bucket_up(n: int, minimum: int | None = None) -> int:
+    """Smallest ladder value >= n from the geometric ladder
+    {1, 1.5} * 2^k scaled from `minimum` (default VM_FLEET_LADDER_MIN):
+    m, 1.5m, 2m, 3m, 4m, 6m, ... — at most 50% padding waste, and churn
+    within a rung never changes the compiled shape.  Rungs are computed
+    directly (m<<k / 3m<<k>>1), NOT by cumulative floored multiplies: a
+    running ``b = b*3//2`` stalls forever at b=1, so a floor of 1 (the
+    1-device mesh, or VM_FLEET_LADDER_MIN=1) would hang the caller."""
+    m = max(minimum if minimum is not None else ladder_min(), 1)
+    b, j = m, 0
+    while b < n:
+        j += 1
+        b = m << (j // 2) if j % 2 == 0 else (3 * m << (j // 2)) >> 1
+    return b
+
+
+class FleetMember:
+    """One adopted stream: identity + grid parameters + host-side series
+    bookkeeping.  The sample data itself lives in the bucket's planes at
+    ``slot``."""
+
+    __slots__ = (
+        "skey", "stream_key", "me", "tenant", "max_series",
+        "func", "aggr", "step", "duration", "window", "lookback",
+        "lookback_delta", "offset", "drop_stale",
+        "S", "G", "T", "group_keys", "gids", "v0",
+        "base_ms", "lo_ms", "hi_ms", "version", "structural",
+        "counts", "row_of_raw", "segments", "bucket", "slot",
+    )
+
+    def samples_in_range(self, fetch_lo: int) -> int:
+        return sum(n for _, seg_hi, n in self.segments if seg_hi >= fetch_lo)
+
+
+class FleetBucket:
+    """One compiled shape class: members' planes stacked on a leading
+    stream axis, device arrays + authoritative host mirrors."""
+
+    __slots__ = ("key", "func", "step", "lookback", "dtype",
+                 "B_pad", "S_b", "N_b", "T_b", "G_b", "cfg",
+                 "members", "ts_h", "vals_h", "counts_h", "gids_h",
+                 "v0_h", "aggr_h", "dev", "dirty", "compiles",
+                 "last_up_bytes", "last_up_wall")
+
+    def __init__(self, key, n_stream: int):
+        (self.func, self.step, self.lookback,
+         self.S_b, self.N_b, self.T_b, self.G_b, self.dtype) = key
+        self.key = key
+        self.B_pad = 0
+        self.members: list[FleetMember] = []
+        self.dev = None
+        self.dirty = True
+        self.compiles = 0
+        self.last_up_bytes = 0
+        self.last_up_wall = 0.0
+        from ..ops.device_rollup import normalized_cfg
+        self.cfg = normalized_cfg(self.func, RollupConfig(
+            start=0, end=(self.T_b - 1) * self.step, step=self.step,
+            window=self.lookback))
+        self._alloc(n_stream)
+
+    def _alloc(self, n_stream: int, b_need: int = 1) -> None:
+        """(Re)allocate mirrors for at least `b_need` stream slots
+        (ladder-bucketed, rounded to the mesh stream-axis size)."""
+        from ..ops.device_rollup import TS_PAD
+        b = bucket_up(max(b_need, 1))
+        b = -(-b // n_stream) * n_stream
+        if b <= self.B_pad:
+            return
+        old = self.B_pad
+        ts = np.full((b, self.S_b, self.N_b), TS_PAD, dtype=np.int32)
+        vals = np.zeros((b, self.S_b, self.N_b), dtype=self.dtype)
+        counts = np.zeros((b, self.S_b), dtype=np.int32)
+        gids = np.zeros((b, self.S_b), dtype=np.int32)
+        v0 = np.zeros((b, self.S_b),
+                      dtype=np.float32 if self.dtype == "float32"
+                      else np.float64)
+        aggr = np.zeros(b, dtype=np.int32)
+        if old:
+            ts[:old] = self.ts_h
+            vals[:old] = self.vals_h
+            counts[:old] = self.counts_h
+            gids[:old] = self.gids_h
+            v0[:old] = self.v0_h
+            aggr[:old] = self.aggr_h
+        self.ts_h, self.vals_h, self.counts_h = ts, vals, counts
+        self.gids_h, self.v0_h, self.aggr_h = gids, v0, aggr
+        self.B_pad = b
+        self.dirty = True
+
+
+class FleetResult:
+    """One served interval of one member, consumed by :func:`take`.
+    Cost shares are consumed ONCE (zeroed on first take) so repeated
+    evals in an interval never double-charge the launch."""
+
+    __slots__ = ("start", "end", "step", "version", "structural",
+                 "lookback_delta", "rows", "group_keys", "samples",
+                 "exec_share_s", "up_share_s", "up_share_b")
+
+
+class FleetPlane:
+    """Per-engine fleet state.  One coarse lock: prepass (adoption,
+    advance, append, launch) and take() serialize on it; it never
+    acquires stream or registry locks, and the wcache/storage locks it
+    reaches into never call back — no cycle."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._lock = make_lock("query.FleetPlane._lock")
+        self._members: dict = {}      # skey -> FleetMember
+        self._buckets: dict = {}      # bucket key -> FleetBucket
+        self._results: dict = {}      # skey -> FleetResult
+        self._memo: dict = {}         # stream key -> shape info | False
+        # skey -> remaining full-eval retries after an eviction: adoption
+        # needs a per-shape device window in the window cache, but the
+        # serving layer only rebuilds one when device_window_ready says
+        # so — which it never would again after the eviction dropped both
+        # the member and the wcache entry.  The retry budget routes a few
+        # refreshes back through the full device eval (the loud cold
+        # rebuild); one success re-registers the window and the next
+        # prepass re-adopts.
+        self._rebuild_retry: dict = {}
+        self.launches = 0
+        self.served = 0
+        self.adoptions = 0
+        self.evictions = 0
+        self.compiles = 0
+        self.last_decline = ""
+        self._mesh = None
+        if engine.mesh is not None:
+            from ..parallel.mesh import make_fleet_mesh
+            self._mesh = make_fleet_mesh(
+                list(engine.mesh.devices.flatten()))
+
+    def n_stream(self) -> int:
+        if self._mesh is None:
+            return 1
+        from ..parallel.partition import AXIS_STREAM, axis_multiple
+        return axis_multiple(self._mesh, AXIS_STREAM)
+
+    def has(self, skey) -> bool:
+        with self._lock:
+            return skey in self._members
+
+    def wants_rebuild(self, skey) -> bool:
+        """Consume one post-eviction retry: True routes this refresh
+        through the full device eval so the per-shape window (and with it
+        the adoption path) can come back."""
+        with self._lock:
+            n = self._rebuild_retry.get(skey)
+            if n is None:
+                return False
+            if n <= 1:
+                self._rebuild_retry.pop(skey, None)
+            else:
+                self._rebuild_retry[skey] = n - 1
+            return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"members": len(self._members),
+                    "buckets": len(self._buckets),
+                    "launches": self.launches, "served": self.served,
+                    "adoptions": self.adoptions,
+                    "evictions": self.evictions,
+                    "compiles": self.compiles}
+
+    # -- stream-shape analysis (memoized per stream identity) -------------
+
+    def _analyze(self, api, st):
+        key = (st.tenant, st.q, st.step, st.duration)
+        info = self._memo.get(key)
+        if info is not None:
+            return info or None
+        info = self._analyze_uncached(api, st)
+        self._memo[key] = info if info is not None else False
+        return info
+
+    def _analyze_uncached(self, api, st):
+        from ..ops import rollup_np
+        from ..ops.device_rollup import FLEET_AGGR_CODES
+        from .eval import _device_aggr_shape, _device_roll_keys
+        from .exec import parse_cached
+        from .metricsql.ast import AggrFuncExpr
+        e = parse_cached(st.q)
+        if not isinstance(e, AggrFuncExpr):
+            return None
+        shape = _device_aggr_shape(e)
+        if shape is None:
+            return None
+        phi, func, rarg = shape
+        # quantile's dense [G, M, T] scatter doesn't batch; per-stream
+        # residency still serves it
+        if phi is not None or e.name not in FLEET_AGGR_CODES or \
+                func not in rollup_np.CORE_SUPPORTED:
+            return None
+        window = rarg.window.value_ms(st.step) if rarg.window is not None \
+            else 0
+        offset = rarg.offset.value_ms(st.step) if rarg.offset is not None \
+            else 0
+        ec = api._ec(0, st.duration, st.step, st.tenant)
+        skey, _ = _device_roll_keys(ec, e, func, rarg, phi, window)
+        if skey is None:
+            return None
+        lookback = window if window > 0 else (
+            ec.lookback_delta if func == "default_rollup" else st.step)
+        return {"skey": skey, "func": func, "aggr": e.name,
+                "aggr_code": FLEET_AGGR_CODES[e.name], "window": window,
+                "offset": offset, "lookback": lookback,
+                "lookback_delta": ec.lookback_delta,
+                "drop_stale": func not in ("default_rollup",
+                                           "stale_samples_over_time"),
+                "me": rarg.expr, "max_series": ec.max_series}
+
+    # -- the per-interval batch scheduler ---------------------------------
+
+    def run(self, api, now_ms: int) -> int:
+        """Advance + launch every due member; adopt newly-resident
+        streams.  Returns the number of fused launches."""
+        with self._lock:
+            return self._run_locked(api, now_ms)
+
+    def _run_locked(self, api, now_ms: int) -> int:
+        reg = getattr(api, "matstreams", None)
+        if reg is None:
+            return 0
+        ver = getattr(api.storage, "data_version", None)
+        if ver is None or \
+                getattr(api.storage, "structural_version", None) is None:
+            return 0
+        work: list[tuple[FleetMember, int]] = []   # (member, query end)
+        for st in reg.streams():
+            if not st.due(now_ms):
+                continue
+            info = self._analyze(api, st)
+            if info is None:
+                continue
+            end_q = (now_ms // st.step) * st.step
+            m = self._members.get(info["skey"])
+            if m is None:
+                m = self._adopt(api, st, info, end_q)
+                if m is None:
+                    continue
+            r = self._results.get(m.skey)
+            if r is not None and r.end == end_q - m.offset and \
+                    r.version == ver:
+                continue  # this interval already served by a prior pump
+            work.append((m, end_q))
+        if not work:
+            return 0
+        t_pack = _time.perf_counter()
+        staged: dict = {}   # bucket -> list[(member, cols, rows_idx)]
+        due: dict = {}      # bucket -> list[(member, end_q)]
+        for m, end_q in work:
+            verdict = self._advance_member(api, m, end_q)
+            if verdict == "evict":
+                self._evict(m, self.last_decline)
+                continue
+            if verdict == "skip":
+                continue
+            if isinstance(verdict, tuple):
+                staged.setdefault(m.bucket, []).append((m,) + verdict)
+            due.setdefault(m.bucket, []).append((m, end_q))
+        touched = set(staged) | {b for b in self._buckets.values()
+                                 if b.dirty and b.members}
+        for b in touched:
+            if b.dirty:
+                self._stage_to_mirror(b, staged.get(b, ()))
+                self._upload(b)
+            else:
+                self._stage_to_mirror(b, staged.get(b, ()))
+                self._append_device(b, staged.get(b, ()))
+        flightrec.rec("device:fleet_pack", t_pack,
+                      _time.perf_counter() - t_pack,
+                      arg=f"{len(work)} streams, {len(touched)} buckets")
+        n = 0
+        for b, mems in due.items():
+            if b.members and b.dev is not None:
+                self._launch(api, b, mems)
+                n += 1
+        return n
+
+    # -- adoption ---------------------------------------------------------
+
+    def _adopt(self, api, st, info, end_q):
+        from ..models.tile_cache import timed_transfer
+        from ..ops.device_rollup import TS_PAD
+        from .tpu_engine import RollingTile, tile_capacity
+        eng = self.engine
+        wcache = eng.window_cache()
+        stv = wcache.peek(info["skey"])
+        if stv is None:
+            return None  # not yet device-resident; the stream's own
+            #              eval builds the per-stream window first
+        rt, gids_dev, group_keys, qx, _rb = stv
+        if qx is not None or not isinstance(rt, RollingTile):
+            return None
+        v0i = rt.tiles[3]
+        if v0i is not None and v0i.wide_range:
+            return None  # f32-unsafe dynamic range: per-stream path only
+        storage = api.storage
+        # the member inherits the tile's version watermark; the advance
+        # pass right after adoption runs the same late-data/deletes
+        # guards advance_rolling would, so version drift since the tile
+        # was built is NOT an adoption blocker — structural drift is
+        # (the tile's series set may no longer match storage)
+        if getattr(storage, "data_version", None) is None or \
+                getattr(storage, "structural_version", None) != \
+                rt.structural or getattr(storage, "dedup_interval_ms", 0):
+            return None
+        if len(self._members) >= max_members():
+            return None
+        S = len(rt.counts_host)
+        start_g = end_q - st.duration - info["offset"]
+        fetch_lo = start_g - info["lookback"] - info["lookback_delta"]
+        if rt.lo_ms > fetch_lo:
+            return None
+        try:
+            # the pull races concurrent donated appends by OTHER shapes
+            # sharing this selector's RollingTile: a donated-away buffer
+            # raises here and adoption just waits an interval
+            N = int(rt.tiles[0].shape[1])
+            nbytes = S * N * (4 + np.dtype(eng.value_dtype).itemsize)
+            ts_full, vals_full = timed_transfer(
+                "device:download", nbytes,
+                lambda: (np.asarray(rt.tiles[0][:S], dtype=np.int32),
+                         np.asarray(rt.tiles[1][:S])))
+        except Exception as e:  # noqa: BLE001 — donation race, loud skip
+            flightrec.instant("fleet:adopt_race", arg=repr(e)[:120])
+            return None
+        counts = np.asarray(rt.counts_host, dtype=np.int32).copy()
+        # crop to this stream's fetch bound and REBASE the origin there:
+        # samples older than fetch_lo can never contribute to a
+        # fixed-shape stream again, and the crop bounds the bucket's
+        # column dimension at ~window size.  cutoff_rel may be NEGATIVE
+        # (cold tiles anchor base_ms at the grid start, with the
+        # lookback prefix at negative relative timestamps) — then
+        # nothing drops and the rebase just shifts every ts up
+        cutoff_rel = fetch_lo - rt.base_ms
+        k = np.arange(ts_full.shape[1])[None, :]
+        valid = k < counts[:, None]
+        drop = ((ts_full < cutoff_rel) & valid).sum(axis=1).astype(np.int32)
+        counts = counts - drop
+        idx = np.clip(drop[:, None] + k, 0, ts_full.shape[1] - 1)
+        ts_full = np.take_along_axis(
+            ts_full.astype(np.int64), idx, axis=1) - cutoff_rel
+        vals_full = np.take_along_axis(vals_full, idx, axis=1)
+        base_ms = fetch_lo
+        live = k < counts[:, None]
+        ts_full = np.where(live, ts_full, TS_PAD).astype(np.int32)
+        vals_full = np.where(live, vals_full, 0)
+        n_need = int(counts.max()) if S else 1
+        m = FleetMember()
+        m.skey = info["skey"]
+        m.stream_key = (st.tenant, st.q, st.step, st.duration)
+        m.me = info["me"]
+        m.tenant = st.tenant
+        m.max_series = info["max_series"]
+        m.func = info["func"]
+        m.aggr = info["aggr"]
+        m.step = st.step
+        m.duration = st.duration
+        m.window = info["window"]
+        m.lookback = info["lookback"]
+        m.lookback_delta = info["lookback_delta"]
+        m.offset = info["offset"]
+        m.drop_stale = info["drop_stale"]
+        m.S = S
+        m.G = len(group_keys)
+        m.T = st.duration // st.step + 1
+        m.group_keys = list(group_keys)
+        m.gids = np.asarray(gids_dev, dtype=np.int32)[:S]
+        m.v0 = None if v0i is None else \
+            np.asarray(v0i.offsets[:S], dtype=np.float64)
+        m.base_ms = base_ms
+        m.lo_ms = max(rt.lo_ms, base_ms)
+        m.hi_ms = rt.hi_ms
+        m.version = rt.version
+        m.structural = rt.structural
+        m.counts = counts.astype(np.int64)
+        m.row_of_raw = dict(rt.row_of_raw)
+        m.segments = [(max(lo, base_ms), hi, nn)
+                      for lo, hi, nn in rt.segments if hi >= base_ms]
+        key = (m.func, m.step, m.lookback, bucket_up(S),
+               bucket_up(tile_capacity(n_need), 64), bucket_up(m.T),
+               bucket_up(m.G), str(np.dtype(self.engine.value_dtype)))
+        b = self._buckets.get(key)
+        if b is None:
+            b = self._buckets[key] = FleetBucket(key, self.n_stream())
+        b._alloc(self.n_stream(), len(b.members) + 1)
+        m.bucket = b
+        m.slot = len(b.members)
+        b.members.append(m)
+        self._fill_slot(b, m, ts_full, vals_full)
+        b.dirty = True
+        self._members[m.skey] = m
+        # the per-stream entry's buffers stay referenced by the shared
+        # roll-tile entry; dropping the SHAPE entry routes this stream's
+        # evals to the fleet (take + device_window_ready) from now on
+        wcache.invalidate(m.skey)
+        self._rebuild_retry.pop(m.skey, None)
+        self.adoptions += 1
+        _ADOPTIONS.inc()
+        flightrec.instant("fleet:adopt", arg=str(m.skey[1])[:120])
+        return m
+
+    def _fill_slot(self, b: FleetBucket, m: FleetMember,
+                   ts: np.ndarray, vals: np.ndarray) -> None:
+        from ..ops.device_rollup import TS_PAD
+        S = ts.shape[0]
+        # live columns all sit left of counts.max() <= N_b after the
+        # adoption crop; the tail beyond the bucket's width is pure pad
+        N = min(ts.shape[1], b.N_b)
+        ts = ts[:, :N]
+        vals = vals[:, :N]
+        sl = m.slot
+        b.ts_h[sl] = TS_PAD
+        b.vals_h[sl] = 0
+        b.counts_h[sl] = 0
+        b.gids_h[sl] = 0
+        b.v0_h[sl] = 0
+        b.ts_h[sl, :S, :N] = ts
+        b.vals_h[sl, :S, :N] = vals.astype(b.vals_h.dtype)
+        b.counts_h[sl, :S] = m.counts
+        b.gids_h[sl, :S] = m.gids
+        if m.v0 is not None:
+            b.v0_h[sl, :S] = m.v0
+        from ..ops.device_rollup import FLEET_AGGR_CODES
+        b.aggr_h[sl] = FLEET_AGGR_CODES[m.aggr]
+
+    # -- advance (mirrors advance_rolling's guard set) --------------------
+
+    def _advance_member(self, api, m: FleetMember, end_q: int):
+        """Returns "ok" (nothing to append), "skip" (decline this
+        interval, keep the member), "evict", or (cols, rows_idx) staged
+        append columns."""
+        def no(reason: str) -> str:
+            self.last_decline = reason
+            return "evict"
+
+        storage = api.storage
+        start_g = end_q - m.duration - m.offset
+        end_g = end_q - m.offset
+        fetch_lo = start_g - m.lookback - m.lookback_delta
+        ver = getattr(storage, "data_version", None)
+        if ver is None or \
+                getattr(storage, "structural_version", None) != m.structural:
+            return no("deletes/retention changed visible data")
+        if getattr(storage, "dedup_interval_ms", 0):
+            return no("dedup interval set")
+        if m.lo_ms > fetch_lo:
+            return no("member history does not reach the lookback")
+        if start_g < m.base_ms:
+            return no("query starts before the member's rebase origin")
+        if end_g - m.base_ms >= 2**31 - 1:
+            if not self._compact(m.bucket, {m.slot: fetch_lo}) or \
+                    end_g - m.base_ms >= 2**31 - 1:
+                return no("int32 rebase exhausted")
+        if ver != m.version:
+            try:
+                lo_new = storage.min_appended_since(m.version)
+            except LookupError:
+                return no("append log trimmed past member version")
+            if lo_new is not None and lo_new <= m.hi_ms:
+                return no("late data landed inside the covered range")
+        staged = "ok"
+        if end_g > m.hi_ms:
+            from .eval import filters_from_metric_expr
+            filters = filters_from_metric_expr(m.me, storage)
+            if hasattr(storage, "reset_partial"):
+                storage.reset_partial()
+            try:
+                cols = storage.search_columns(filters, m.hi_ms + 1, end_g,
+                                              max_series=m.max_series,
+                                              tenant=m.tenant)
+            except Exception:  # noqa: BLE001 — limits etc: per-stream path
+                return no("slice fetch failed")
+            if getattr(storage, "last_partial", False):
+                # never commit a partial interval; retry next interval
+                # (the member keeps its committed coverage)
+                self.last_decline = "partial slice fetch"
+                return "skip"
+            if m.drop_stale:
+                cols.drop_stale_nans()
+            if cols.n_series:
+                staged = self._stage_append(m, cols, fetch_lo)
+                if isinstance(staged, str):
+                    return no(staged) if staged != "ok" else staged
+                m.segments.append((m.hi_ms + 1, end_g, cols.n_samples))
+            m.hi_ms = end_g
+        m.version = ver
+        return staged
+
+    def _stage_append(self, m: FleetMember, cols, fetch_lo: int):
+        """Validate + index one fetched slice for the batched append.
+        Returns (cols, rows_idx) or a decline reason string."""
+        from .tpu_engine import F32_SAFE_RANGE
+        rows_idx = np.empty(cols.n_series, dtype=np.int64)
+        for i, rn in enumerate(cols.raw_names):
+            r = m.row_of_raw.get(rn)
+            if r is None:
+                return "new series appeared"
+            rows_idx[i] = r
+        new_n = m.counts[rows_idx] + cols.counts
+        if int(new_n.max()) > m.bucket.N_b:
+            if not self._compact(m.bucket, {m.slot: fetch_lo}):
+                return "column headroom exhausted"
+            new_n = m.counts[rows_idx] + cols.counts
+            if int(new_n.max()) > m.bucket.N_b:
+                return "column headroom exhausted"
+        if m.v0 is not None:
+            vals_in = cols.vals - m.v0[rows_idx][:, None]
+            live = np.arange(cols.ts.shape[1])[None, :] < \
+                cols.counts[:, None]
+            sub = vals_in[live]
+            finite = sub[np.isfinite(sub)]
+            if finite.size and \
+                    float(np.abs(finite).max()) >= F32_SAFE_RANGE:
+                return "append exceeds the f32-safe rebased range"
+        return (cols, rows_idx)
+
+    # -- packing: mirrors + device ----------------------------------------
+
+    def _stage_to_mirror(self, b: FleetBucket, staged) -> None:
+        """Apply staged appends to the bucket's host mirrors (the same
+        scatter the donated device append performs)."""
+        for m, cols, rows_idx in staged:
+            K = cols.ts.shape[1]
+            kk = np.arange(K)[None, :]
+            live = kk < cols.counts[:, None]
+            r_i, k_i = np.nonzero(live)
+            rows = rows_idx[r_i]
+            col = m.counts[rows] + k_i
+            rel = (cols.ts - m.base_ms).astype(np.int64)
+            vals_in = cols.vals
+            if m.v0 is not None:
+                vals_in = vals_in - m.v0[rows_idx][:, None]
+            b.ts_h[m.slot, rows, col] = rel[r_i, k_i].astype(np.int32)
+            b.vals_h[m.slot, rows, col] = \
+                vals_in[r_i, k_i].astype(b.vals_h.dtype)
+            new_n = m.counts[rows_idx] + cols.counts
+            m.counts[rows_idx] = new_n
+            b.counts_h[m.slot, rows_idx] = new_n.astype(np.int32)
+
+    def _put(self, name: str, a: np.ndarray, pad_value=0):
+        from ..models.tile_cache import chunked_device_put
+        from ..parallel.partition import shard_put
+        if self._mesh is not None:
+            return shard_put(self._mesh, name, a, pad_value)
+        return chunked_device_put(np.asarray(a))
+
+    def _upload(self, b: FleetBucket) -> None:
+        """Full mirror -> device upload (adoption, eviction repack).
+
+        The mirrors are uploaded as PRIVATE COPIES: the CPU backend
+        zero-copies 64-byte-aligned numpy arrays into device buffers
+        (alignment is allocator luck, so it engages nondeterministically),
+        and the mirrors are mutated in place by _stage_to_mirror every
+        interval — an aliased upload would mutate the "device" tile
+        underneath later launches, and the donated append would scribble
+        its output back into the mirror."""
+        from ..ops.device_rollup import TS_PAD
+        t0 = _time.perf_counter()
+        b.dev = {
+            "ts": self._put("fleet_ts", b.ts_h.copy(), TS_PAD),
+            "vals": self._put("fleet_values", b.vals_h.copy()),
+            "counts": self._put("fleet_counts", b.counts_h.copy()),
+            "gids": self._put("fleet_gids", b.gids_h.copy()),
+            "v0": self._put("fleet_v0", b.v0_h.copy()),
+            "aggr": self._put("fleet_aggr", b.aggr_h.copy()),
+        }
+        b.last_up_wall = _time.perf_counter() - t0
+        b.last_up_bytes = (b.ts_h.nbytes + b.vals_h.nbytes +
+                           b.counts_h.nbytes + b.gids_h.nbytes +
+                           b.v0_h.nbytes + b.aggr_h.nbytes)
+        b.dirty = False
+
+    def _append_device(self, b: FleetBucket, staged) -> None:
+        """One donated batched append for every staged slice of this
+        bucket (no-op rows for members with nothing staged)."""
+        if not staged:
+            b.last_up_bytes = 0
+            b.last_up_wall = 0.0
+            return
+        from ..ops.device_rollup import fleet_append_tile
+        from .tpu_engine import timed_kernel_call
+        t0 = _time.perf_counter()
+        K = max(int(c.ts.shape[1]) for _, c, _ in staged)
+        K_pad = (K + 7) // 8 * 8
+        new_ts = np.zeros((b.B_pad, b.S_b, K_pad), dtype=np.int32)
+        new_vals = np.zeros((b.B_pad, b.S_b, K_pad), dtype=b.vals_h.dtype)
+        new_counts = np.zeros((b.B_pad, b.S_b), dtype=np.int32)
+        for m, cols, rows_idx in staged:
+            Kc = cols.ts.shape[1]
+            vals_in = cols.vals
+            if m.v0 is not None:
+                vals_in = vals_in - m.v0[rows_idx][:, None]
+            new_ts[m.slot, rows_idx, :Kc] = \
+                (cols.ts - m.base_ms).astype(np.int32)
+            new_vals[m.slot, rows_idx, :Kc] = \
+                vals_in.astype(b.vals_h.dtype)
+            new_counts[m.slot, rows_idx] = cols.counts
+        ts_d = self._put("fleet_ts", new_ts)
+        vals_d = self._put("fleet_values", new_vals)
+        counts_d = self._put("fleet_counts", new_counts)
+        dev = b.dev
+        out = timed_kernel_call("fleet_append_tile", fleet_append_tile,
+                                dev["ts"], dev["vals"], dev["counts"],
+                                ts_d, vals_d, counts_d)
+        dev["ts"], dev["vals"], dev["counts"] = out
+        b.last_up_wall = _time.perf_counter() - t0
+        b.last_up_bytes = (new_ts.nbytes + new_vals.nbytes +
+                           new_counts.nbytes)
+
+    def _compact(self, b: FleetBucket, cutoffs: dict) -> bool:
+        """Window-slide compaction for the slots in `cutoffs` ({slot:
+        absolute cutoff}): mirrors AND device planes (one donated
+        batched launch) drop samples older than each member's cutoff
+        and rebase its origin there."""
+        from ..ops.device_rollup import TS_PAD
+        cut_rel = np.zeros(b.B_pad, dtype=np.int64)
+        todo = []
+        for m in b.members:
+            c = cutoffs.get(m.slot)
+            if c is None:
+                continue
+            rel = c - m.base_ms
+            if rel <= 0:
+                return False  # nothing would move
+            if rel >= 2**31 - 1:
+                return False  # stale beyond the int32 frame: evict path
+            cut_rel[m.slot] = rel
+            todo.append((m, c, rel))
+        if not todo:
+            return False
+        # host mirrors (authoritative): per-slot crop, same semantics as
+        # _compact_tile_body (drop ts < cutoff, shift left, rebase)
+        k = np.arange(b.N_b)[None, :]
+        for m, cutoff_abs, rel in todo:
+            ts = b.ts_h[m.slot].astype(np.int64)
+            counts = b.counts_h[m.slot].astype(np.int64)
+            valid = k < counts[:, None]
+            drop = ((ts < rel) & valid).sum(axis=1)
+            new_counts = counts - drop
+            idx = np.clip(drop[:, None] + k, 0, b.N_b - 1)
+            ts2 = np.take_along_axis(ts, idx, axis=1) - rel
+            v2 = np.take_along_axis(b.vals_h[m.slot], idx, axis=1)
+            live = k < new_counts[:, None]
+            b.ts_h[m.slot] = np.where(live, ts2, TS_PAD).astype(np.int32)
+            b.vals_h[m.slot] = np.where(live, v2, 0)
+            b.counts_h[m.slot] = new_counts.astype(np.int32)
+            m.counts = new_counts[:m.S].copy()
+            m.base_ms = cutoff_abs
+            m.lo_ms = max(m.lo_ms, cutoff_abs)
+            m.segments = [(max(lo, cutoff_abs), hi, nn)
+                          for lo, hi, nn in m.segments if hi >= cutoff_abs]
+        if b.dev is not None and not b.dirty:
+            from ..models.tile_cache import count_window_compaction
+            from ..ops.device_rollup import fleet_compact_tile
+            from .tpu_engine import timed_kernel_call
+            cut = cut_rel.astype(np.int32)
+            cut_d = self._put("fleet_shift", cut)
+            out = timed_kernel_call("fleet_compact_tile",
+                                    fleet_compact_tile, b.dev["ts"],
+                                    b.dev["vals"], b.dev["counts"],
+                                    cut_d, cut_d)
+            b.dev["ts"], b.dev["vals"], b.dev["counts"] = out
+            count_window_compaction()
+        return True
+
+    # -- eviction ---------------------------------------------------------
+
+    def _evict(self, m: FleetMember, reason: str) -> None:
+        b = m.bucket
+        self._members.pop(m.skey, None)
+        self._results.pop(m.skey, None)
+        self._rebuild_retry[m.skey] = 4
+        last = b.members[-1]
+        if last is not m:
+            # swap-remove: the last slot's planes move into the hole
+            b.ts_h[m.slot] = b.ts_h[last.slot]
+            b.vals_h[m.slot] = b.vals_h[last.slot]
+            b.counts_h[m.slot] = b.counts_h[last.slot]
+            b.gids_h[m.slot] = b.gids_h[last.slot]
+            b.v0_h[m.slot] = b.v0_h[last.slot]
+            b.aggr_h[m.slot] = b.aggr_h[last.slot]
+            b.members[m.slot] = last
+            last.slot = m.slot
+        b.members.pop()
+        from ..ops.device_rollup import TS_PAD
+        sl = len(b.members)
+        b.ts_h[sl] = TS_PAD
+        b.vals_h[sl] = 0
+        b.counts_h[sl] = 0
+        b.gids_h[sl] = 0
+        b.v0_h[sl] = 0
+        b.aggr_h[sl] = 0
+        b.dirty = True
+        if not b.members:
+            self._buckets.pop(b.key, None)
+        self.evictions += 1
+        _EVICTIONS.inc()
+        flightrec.instant("fleet:evict",
+                          arg=f"{reason}: {str(m.skey[1])[:100]}")
+
+    # -- the fused launch -------------------------------------------------
+
+    def _launch(self, api, b: FleetBucket, due) -> None:
+        from ..ops.device_rollup import fleet_rollup_aggregate_tile
+        from .tpu_engine import _pull_host, backend_compiles, \
+            timed_kernel_call
+        shift = np.zeros(b.B_pad, dtype=np.int32)
+        min_ts = np.zeros(b.B_pad, dtype=np.int32)
+        for m, end_q in due:
+            start_g = end_q - m.duration - m.offset
+            shift[m.slot] = start_g - m.base_ms
+            min_ts[m.slot] = -(m.lookback + m.lookback_delta)
+        t0 = _time.perf_counter()
+        shift_d = self._put("fleet_shift", shift)
+        mints_d = self._put("fleet_min_ts", min_ts)
+        dev = b.dev
+        compiles0 = backend_compiles()
+        if self._mesh is not None:
+            from ..parallel.mesh import cached_fleet_rollup_aggregate
+            fn = cached_fleet_rollup_aggregate(self._mesh, b.func, b.cfg,
+                                               b.G_b)
+            out = timed_kernel_call("fleet_rollup_aggregate", fn,
+                                    dev["ts"], dev["vals"], dev["counts"],
+                                    dev["gids"], dev["aggr"], shift_d,
+                                    mints_d, dev["v0"])
+        else:
+            out = timed_kernel_call("fleet_rollup_aggregate",
+                                    fleet_rollup_aggregate_tile, b.func,
+                                    b.cfg, b.G_b, dev["ts"], dev["vals"],
+                                    dev["counts"], dev["gids"],
+                                    dev["aggr"], shift_d, mints_d,
+                                    dev["v0"])
+        # REAL XLA compiles only (monitoring event), NOT jit-cache entry
+        # growth: donation churn creates cpp fastpath entries that resolve
+        # in the Python trace cache without compiling anything
+        grew = backend_compiles() - compiles0
+        if grew > 0:
+            b.compiles += grew
+            self.compiles += grew
+        out_h = _pull_host(out)
+        wall = _time.perf_counter() - t0
+        ver = getattr(api.storage, "data_version", None)
+        structural = getattr(api.storage, "structural_version", None)
+        # rows-share split of the shared launch: the LAST member takes
+        # the exact remainder so per-stream shares sum to the total
+        total_S = sum(m.S for m, _ in due) or 1
+        acc_w = acc_uw = 0.0
+        acc_b = 0
+        n_streams_in_bucket = len(b.members)
+        for i, (m, end_q) in enumerate(due):
+            start_g = end_q - m.duration - m.offset
+            r = FleetResult()
+            r.start = start_g
+            r.end = end_q - m.offset
+            r.step = m.step
+            r.version = ver
+            r.structural = structural
+            r.lookback_delta = m.lookback_delta
+            r.rows = np.asarray(out_h[m.slot, :m.G, :m.T],
+                                dtype=np.float64).copy()
+            r.group_keys = m.group_keys
+            fetch_lo = start_g - m.lookback - m.lookback_delta
+            r.samples = m.samples_in_range(fetch_lo)
+            if i + 1 == len(due):
+                r.exec_share_s = wall - acc_w
+                r.up_share_s = b.last_up_wall - acc_uw
+                r.up_share_b = b.last_up_bytes - acc_b
+            else:
+                frac = m.S / total_S
+                r.exec_share_s = wall * frac
+                r.up_share_s = b.last_up_wall * frac
+                r.up_share_b = int(b.last_up_bytes * frac)
+            acc_w += r.exec_share_s
+            acc_uw += r.up_share_s
+            acc_b += r.up_share_b
+            self._results[m.skey] = r
+        b.last_up_bytes = 0
+        b.last_up_wall = 0.0
+        self.launches += 1
+        _LAUNCHES.inc()
+        _STREAMS.inc(len(due))
+        flightrec.rec(
+            "device:fleet_launch", t0, wall,
+            arg=f"{len(due)}/{n_streams_in_bucket} streams "
+                f"[B={b.B_pad},S={b.S_b},N={b.N_b},G={b.G_b},T={b.T_b}]")
+
+
+# -- module-level seams ------------------------------------------------------
+
+
+def prepass(api, now_ms: int) -> int:
+    """Interval-aligned batch scheduler hook (MatStream._advance calls
+    this before evaluating).  Never raises: a fleet failure falls back
+    to the per-stream paths for the interval, loudly."""
+    eng = getattr(api, "tpu", None)
+    if eng is None or not enabled():
+        return 0
+    from ..models.tile_cache import device_resident_enabled
+    if not device_resident_enabled():
+        return 0
+    try:
+        return eng.fleet().run(api, now_ms)
+    except Exception as e:  # noqa: BLE001 — serving must survive
+        flightrec.instant("fleet:error", arg=repr(e)[:160])
+        import sys
+        print(f"vmtpu: fleet prepass failed (per-stream fallback): {e!r}",
+              file=sys.stderr)
+        return 0
+
+
+def resident(engine, skey) -> bool:
+    """True when the fleet holds a member for this rolling-state key, OR
+    the key was recently evicted and should run one full device eval to
+    rebuild its per-shape window so the fleet can re-adopt it
+    (device_window_ready's fleet extension)."""
+    if engine is None or not enabled():
+        return False
+    plane = engine._fleet
+    return plane is not None and \
+        (plane.has(skey) or plane.wants_rebuild(skey))
+
+
+def take(ec, skey):
+    """Serve one eval from the fleet's result table: (rows [G, T],
+    group_keys) on a grid/version-matched result, else None (the eval
+    falls through to the per-stream paths).  Counts samples, checks the
+    deadline, and laps this stream's share of the shared launch into
+    the query's cost tracker (consume-once)."""
+    eng = ec.tpu
+    if eng is None or not enabled():
+        return None
+    plane = eng._fleet
+    if plane is None:
+        return None
+    from ..models.tile_cache import device_resident_enabled
+    if not device_resident_enabled():
+        return None
+    with plane._lock:
+        r = plane._results.get(skey)
+        m = plane._members.get(skey)
+        if r is None or m is None:
+            return None
+        if (r.start, r.end, r.step) != (ec.start - m.offset,
+                                        ec.end - m.offset, ec.step):
+            return None
+        if r.version != getattr(ec.storage, "data_version", None) or \
+                r.structural != getattr(ec.storage, "structural_version",
+                                        None) or \
+                r.lookback_delta != ec.lookback_delta:
+            return None
+        rows, group_keys, samples = r.rows, r.group_keys, r.samples
+        exec_s, up_s, up_b = r.exec_share_s, r.up_share_s, r.up_share_b
+        r.exec_share_s = r.up_share_s = 0.0
+        r.up_share_b = 0
+        plane.served += 1
+    ec.check_deadline()
+    ec.count_samples(samples)
+    tr = costacc.current()
+    if tr is not None:
+        if exec_s:
+            tr.lap("device:execute", exec_s, 0.0)
+        if up_s or up_b:
+            tr.lap("device:upload", up_s, 0.0)
+            tr.add_device(up=up_b)
+    _SERVED.inc()
+    return rows, group_keys

@@ -166,6 +166,64 @@ def auto_value_dtype():
 
 
 _CACHE_DIR_SET = False
+_COMPILE_EVENTS_SET = False
+# REAL XLA backend compiles (the monitoring event fires only when XLA
+# actually builds an executable — jit tracing-cache hits and cpp-fastpath
+# misses that resolve in the Python cache do NOT tick this), and
+# persistent-compile-cache hits (a warm process deserializes instead of
+# compiling).  The fleet's ≤-compiles-per-bucket guard and the
+# compile-cache smoke both read these; jit _cache_size growth is NOT a
+# compile signal (donation/placement churn grows it without compiling).
+_BACKEND_COMPILES = metricslib.REGISTRY.counter(
+    "vm_device_backend_compiles_total")
+_COMPILE_CACHE_HITS = metricslib.REGISTRY.counter(
+    "vm_device_fleet_compile_cache_hits_total")
+
+
+def _register_compile_listeners():
+    global _COMPILE_EVENTS_SET
+    if _COMPILE_EVENTS_SET:
+        return
+    try:
+        import threading
+
+        from jax._src import monitoring  # no public seam for these events
+
+        # backend_compile_duration fires on persistent-cache HITS too (the
+        # event wraps compile-or-retrieve); the hit event precedes it in
+        # the same call stack, so a thread-local pending flag swallows the
+        # duration event a retrieval (not a real compile) produced.
+        pending_hit = threading.local()
+
+        def _on_dur(name, dur_s, **kw):
+            if name == "/jax/core/compile/backend_compile_duration":
+                if getattr(pending_hit, "n", 0) > 0:
+                    pending_hit.n -= 1
+                else:
+                    _BACKEND_COMPILES.inc()
+
+        def _on_event(name, **kw):
+            if name == "/jax/compilation_cache/cache_hits":
+                pending_hit.n = getattr(pending_hit, "n", 0) + 1
+                _COMPILE_CACHE_HITS.inc()
+
+        monitoring.register_event_duration_secs_listener(_on_dur)
+        monitoring.register_event_listener(_on_event)
+        _COMPILE_EVENTS_SET = True
+    except Exception as e:  # pragma: no cover - jax internals drift
+        import sys
+        print(f"vmtpu: compile-event telemetry unavailable: {e!r}",
+              file=sys.stderr)
+
+
+def backend_compiles() -> int:
+    """Count of REAL XLA compiles this process has paid so far."""
+    return int(_BACKEND_COMPILES.get())
+
+
+def compile_cache_hits() -> int:
+    """Count of persistent-compile-cache hits (compiles NOT paid)."""
+    return int(_COMPILE_CACHE_HITS.get())
 
 
 def enable_compilation_cache():
@@ -173,12 +231,15 @@ def enable_compilation_cache():
     the fused-kernel compiles (~minutes cold on CPU-XLA) are paid once per
     machine, not once per process. The reference's first query doesn't pay
     a compile (docs/victoriametrics/README.md: p99 < 1s); with the cache
-    warm, neither does ours. Idempotent; loud (not silent) on failure."""
+    warm, neither does ours. Idempotent; loud (not silent) on failure.
+    ``VM_COMPILE_CACHE_DIR`` names the directory (``VM_JAX_CACHE_DIR``
+    kept as the historical alias)."""
     global _CACHE_DIR_SET
+    _register_compile_listeners()
     if _CACHE_DIR_SET:
         return
     import jax
-    cache_dir = os.environ.get(
+    cache_dir = os.environ.get("VM_COMPILE_CACHE_DIR") or os.environ.get(
         "VM_JAX_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "vmtpu-jax"))
     try:
@@ -194,6 +255,139 @@ def enable_compilation_cache():
               file=sys.stderr)
 
 
+def jax_cache_refused() -> bool:
+    """True when jax's persistent compilation cache cannot serve this
+    backend (plugin runtimes its support matrix blacklists) — the
+    own-format executable cache below takes over there."""
+    if os.environ.get("VM_OWN_EXEC_CACHE") == "1":
+        return True  # forced: lets CPU CI exercise the fallback format
+    try:
+        import jax
+        from jax._src import compilation_cache as cc
+        return not cc.is_cache_used(jax.devices()[0].client)
+    except Exception:
+        return True
+
+
+class OwnExecutableCache:
+    """Own-format persistent executable cache for backends whose runtime
+    jax's compilation cache refuses to serve: whole compiled executables
+    (jax.experimental.serialize_executable payloads + in/out treedefs)
+    keyed by a fingerprint of the LOWERED program text — the StableHLO
+    module embeds avals, shardings and donation, so any shape/layout/
+    partitioning change keys a different entry.  Entries are atomic
+    single files under <dir>/vmtpu-exec; a corrupt or version-skewed
+    entry deserializes loudly into a miss, never a wrong executable."""
+
+    def __init__(self, root: str):
+        self.root = os.path.join(root, "vmtpu-exec")
+        os.makedirs(self.root, exist_ok=True)
+
+    def fingerprint(self, name: str, lowered) -> str:
+        import hashlib
+
+        import jax
+        h = hashlib.sha256()
+        h.update(jax.__version__.encode())
+        h.update(jax.default_backend().encode())
+        h.update(name.encode())
+        h.update(lowered.as_text().encode())
+        return h.hexdigest()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key + ".vmexec")
+
+    def load(self, key: str):
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+        try:
+            with open(self._path(key), "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            return se.deserialize_and_load(payload, in_tree, out_tree)
+        except FileNotFoundError:
+            return None
+        except Exception as e:  # corrupt / jaxlib-skewed entry: a miss
+            import sys
+            print(f"vmtpu: exec-cache entry {key[:12]} unreadable "
+                  f"({e!r}); recompiling", file=sys.stderr)
+            try:
+                os.unlink(self._path(key))
+            except OSError:
+                pass
+            return None
+
+    def store(self, key: str, compiled) -> None:
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+        try:
+            blob = pickle.dumps(se.serialize(compiled))
+            tmp = self._path(key) + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self._path(key))
+        except Exception as e:  # pragma: no cover - serialization refusal
+            import sys
+            print(f"vmtpu: executable not cacheable ({e!r})",
+                  file=sys.stderr)
+
+
+_OWN_EXEC_CACHE: tuple | None = None
+
+
+def own_executable_cache() -> OwnExecutableCache | None:
+    """The process's own-format executable cache, or None when jax's
+    native persistent cache already covers this backend (the common
+    case) or no cache directory is writable."""
+    global _OWN_EXEC_CACHE
+    if _OWN_EXEC_CACHE is None:
+        cache = None
+        if jax_cache_refused():
+            cache_dir = os.environ.get("VM_COMPILE_CACHE_DIR") or \
+                os.environ.get("VM_JAX_CACHE_DIR") or os.path.join(
+                    os.path.expanduser("~"), ".cache", "vmtpu-jax")
+            try:
+                cache = OwnExecutableCache(cache_dir)
+            except OSError as e:
+                import sys
+                print(f"vmtpu: own-format exec cache unavailable: {e!r}",
+                      file=sys.stderr)
+        _OWN_EXEC_CACHE = (cache,)
+    return _OWN_EXEC_CACHE[0]
+
+
+def with_executable_cache(jit_fn, name: str):
+    """Wrap a jit callable with the own-format executable cache when the
+    backend refuses jax's persistent cache; identity otherwise.  The
+    wrapper AOT-lowers on first call, serves the compiled executable from
+    disk on fingerprint hit (ticking the compile-cache-hit counter), and
+    serializes after a cold compile."""
+    cache = own_executable_cache()
+    if cache is None:
+        return jit_fn
+    state: dict = {}
+
+    def call(*args):
+        # AOT executables are shape-monomorphic; callers reuse one jit fn
+        # across bucket growth, so key the compiled program by signature
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        fn = state.get(sig)
+        if fn is None:
+            lowered = jit_fn.lower(*args)
+            key = cache.fingerprint(name, lowered)
+            fn = cache.load(key)
+            if fn is None:
+                fn = lowered.compile()
+                cache.store(key, fn)
+            else:
+                _COMPILE_CACHE_HITS.inc()
+            state[sig] = fn
+        return fn(*args)
+
+    return call
+
+
 @dataclasses.dataclass
 class TPUEngine:
     cache_bytes: int = 2 << 30
@@ -204,6 +398,7 @@ class TPUEngine:
     _cache: object = None
     _aux: object = None
     _wcache: object = None      # DeviceWindowCache (resident windows)
+    _fleet: object = None       # query.fleet.FleetPlane (batched streams)
 
     def __post_init__(self):
         enable_compilation_cache()
@@ -238,6 +433,15 @@ class TPUEngine:
             from ..models.tile_cache import DeviceWindowCache
             self._wcache = DeviceWindowCache()
         return self._wcache
+
+    def fleet(self):
+        """Fleet-batched stream plane (query.fleet.FleetPlane): every
+        device-resident matstream packed on one leading stream axis and
+        served by ONE fused mesh launch per interval."""
+        if self._fleet is None:
+            from .fleet import FleetPlane
+            self._fleet = FleetPlane(self)
+        return self._fleet
 
     def series_shards(self) -> int:
         """Size of the mesh's series axis (1 = single-device engine)."""
