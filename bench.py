@@ -1701,17 +1701,497 @@ def cluster_main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# r13 multi-workload matrix: churn / backfill / qstorm / longrange.
+#
+# Four workload shapes the single dashboard loop cannot see, each a
+# first-class scenario emitting its own BENCH_r13_<scenario>.json with
+# the standard attribution splits (per-phase fetch time, result-cache
+# merge handling, per-refresh CostTracker, flight-recorder captures):
+#
+#   churn      every refresh retires part of the live fleet and births
+#              replacement identities while part writes run CONCURRENT
+#              with serving — merges must DEFER to refreshes
+#              (vm_merge_gate_yields_total ticks) and the latency
+#              distribution must stay flat (p99 <= 2x p50);
+#   backfill   historical chunks land between refreshes — the result
+#              cache takes the correctness-mandated rebuild instead of
+#              serving stale prefixes;
+#   qstorm     a thread-pool storm of distinct queries through the
+#              SearchGate admission path (queue_wait becomes visible);
+#   longrange  a year-long query over two-tier downsampled data vs the
+#              raw oracle (VM_DOWNSAMPLE_READ=0): >=20x fewer samples
+#              (target 100x), >=10x lower p50, bit-exact result.
+# ---------------------------------------------------------------------------
+
+R13_SERIES = int(os.environ.get("VM_BENCH_R13_SERIES", "2048"))
+R13_SAMPLES = int(os.environ.get("VM_BENCH_R13_SAMPLES", "360"))
+R13_REFRESHES = int(os.environ.get("VM_BENCH_R13_REFRESHES", "16"))
+LR_SERIES = int(os.environ.get("VM_BENCH_R13_LR_SERIES", "16"))
+LR_DAYS = int(os.environ.get("VM_BENCH_R13_LR_DAYS", "365"))
+DAY_MS = 86_400_000
+
+
+def _r13_emit(scenario: str, payload: dict) -> None:
+    path = f"BENCH_r13_{scenario}.json"
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps(payload))
+
+
+def _r13_keys(n_series: int, gen) -> list:
+    """One metric family, identity = (idx, g): bumping g for a slot is
+    CHURN — a brand-new series through index insert + key-map miss."""
+    if isinstance(gen, int):
+        gen = [gen] * n_series
+    return [(f'm{{idx="{i}",g="{gen[i]}",job="job-{i % 17}",'
+             f'instance="host-{i % 64}"}}').encode()
+            for i in range(n_series)]
+
+
+def _r13_ingest(s, keys: list, ts2, vals2) -> None:
+    from victoriametrics_tpu import native
+    klens = np.fromiter((len(k) for k in keys), np.int64, len(keys))
+    koffs = np.concatenate([[0], np.cumsum(klens)[:-1]])
+    k = ts2.shape[1]
+    s.add_rows_columnar(native.ColumnarRows(
+        b"".join(keys), np.repeat(koffs, k), np.repeat(klens, k),
+        ts2.reshape(-1).astype(np.int64),
+        vals2.reshape(-1).astype(np.float64)))
+
+
+def _r13_corpus(s, rng, t_start: int, keys: list):
+    """R13_SERIES jittered counters x R13_SAMPLES @15s; returns the
+    running counter values for the steady-state ingest to continue."""
+    base = np.arange(R13_SAMPLES, dtype=np.int64) * 15_000 + t_start
+    last_val = np.zeros(len(keys))
+    chunk = 256
+    for i0 in range(0, len(keys), chunk):
+        i1 = min(i0 + chunk, len(keys))
+        ts2 = np.sort(base[None, :] + rng.integers(
+            -JITTER_MS, JITTER_MS + 1, (i1 - i0, R13_SAMPLES)), axis=1)
+        vals2 = np.cumsum(rng.integers(0, 50, (i1 - i0, R13_SAMPLES)),
+                          axis=1).astype(np.float64)
+        last_val[i0:i1] = vals2[:, -1]
+        _r13_ingest(s, keys[i0:i1], ts2, vals2)
+    s.force_flush()
+    s.force_merge()
+    return last_val
+
+
+def _r13_steady(api, s, kw, q, end0: int, duration: int, rng, keys,
+                last_val, per_refresh=None, concurrent_flush=False):
+    """The shared steady loop: live ingest + window advance per refresh
+    through the cached-range executor, with the standard attribution
+    snapshots. `per_refresh(i, end)` runs extra workload (churn,
+    backfill) before the timed refresh; `concurrent_flush` overlaps a
+    flush+merge with every timed refresh (the churn merge-pressure
+    leg). Returns (lat, stats dict)."""
+    import threading
+
+    from victoriametrics_tpu.query.types import EvalConfig
+    from victoriametrics_tpu.utils import flightrec
+
+    def ingest_fresh(end_ms: int) -> None:
+        incr = rng.integers(0, 50, (len(keys), 4))
+        vals2 = last_val[:, None] + np.cumsum(incr, axis=1)
+        last_val[:] = vals2[:, -1]
+        ts2 = (end_ms - STEP +
+               (np.arange(4, dtype=np.int64) + 1)[None, :] * 15_000 +
+               rng.integers(-JITTER_MS, JITTER_MS + 1, (len(keys), 4)))
+        ts2.sort(axis=1)
+        _r13_ingest(s, keys, ts2, vals2)
+
+    end = end0
+    api._exec_range_cached(EvalConfig(start=end - duration, end=end,
+                                      **kw), q, end)
+    pre = []
+    for _ in range(2):  # preflight: calibrate the slow-refresh trigger
+        end += STEP
+        ingest_fresh(end)
+        t0 = time.perf_counter()
+        api._exec_range_cached(EvalConfig(start=end - duration, end=end,
+                                          **kw), q, end)
+        pre.append(time.perf_counter() - t0)
+    if "VM_SLOW_REFRESH_MS" not in os.environ:
+        os.environ["VM_SLOW_REFRESH_MS"] = str(
+            max(min(pre) * 1.25e3, 25.0))
+    thresh_ms = float(os.environ["VM_SLOW_REFRESH_MS"])
+    flight_id0 = flightrec.RECORDER.total()
+    ph0, c0 = _phase_totals(), _cache_merge_totals()
+    lat, leg_costs = [], []
+    for i in range(R13_REFRESHES):
+        end += STEP
+        ingest_fresh(end)
+        if per_refresh is not None:
+            per_refresh(i, end)
+        fl = None
+        if concurrent_flush:
+            fl = threading.Thread(
+                target=lambda: (s.force_flush(), s.force_merge()))
+            fl.start()
+        ec = EvalConfig(start=end - duration, end=end, **kw)
+        t0 = time.perf_counter()
+        api._exec_range_cached(ec, q, end)
+        lat.append(time.perf_counter() - t0)
+        leg_costs.append(ec.cost)
+        if fl is not None:
+            fl.join()
+    stats = {
+        "phase": _phase_label(ph0, _phase_totals(), R13_REFRESHES),
+        "cache": _cache_merge_delta(c0),
+        "cost": _cost_leg_summary(leg_costs, lat),
+        "flight": _leg_flight_summary(flight_id0, thresh_ms),
+    }
+    return lat, stats
+
+
+def _r13_setup(tmp: str, downsample=None, retention_ms=None):
+    from victoriametrics_tpu.httpapi.prometheus_api import PrometheusAPI
+    from victoriametrics_tpu.storage.storage import Storage
+    kw = {}
+    if downsample is not None:
+        kw["downsample"] = downsample
+    if retention_ms is not None:
+        kw["retention_ms"] = retention_ms
+    s = Storage(tmp, **kw)
+    return s, PrometheusAPI(s, None)
+
+
+def churn_main() -> None:
+    """Scenario `churn`: identity turnover under merge pressure.
+
+    Every refresh retires ~2% of the live fleet and births replacement
+    identities (new g= label -> index inserts + key-map misses), and a
+    flush+merge runs CONCURRENT with the timed refresh. Acceptance:
+    vm_merge_gate_yields_total ticks (part writes defer to in-flight
+    serving instead of stealing its cores) and refresh p99 stays within
+    2x p50 — churn must degrade the MEDIAN honestly, not fabricate a
+    tail cliff."""
+    tmp = tempfile.mkdtemp(prefix="vmtpu-bench-churn-")
+    rng = np.random.default_rng(13)
+    try:
+        s, api = _r13_setup(tmp)
+        now_ms = int(time.time() * 1000)
+        t_start = (now_ms - (R13_SAMPLES - 1) * 15_000) // STEP * STEP
+        keys = _r13_keys(R13_SERIES, 0)
+        gens = [0] * R13_SERIES
+        last_val = _r13_corpus(s, rng, t_start, keys)
+        q = "sum by (job)(rate(m[5m]))"
+        duration = (R13_SAMPLES - 1) * 15_000 - 300_000
+        end0 = t_start + -(-((R13_SAMPLES - 1) * 15_000 + JITTER_MS)
+                           // STEP) * STEP
+        kw = dict(step=STEP, storage=s, tpu=None)
+        churn_n = max(1, R13_SERIES // 50)
+        churned = 0
+
+        def per_refresh(i, end):
+            nonlocal churned
+            lo = (i * churn_n) % R13_SERIES
+            idxs = [(lo + j) % R13_SERIES for j in range(churn_n)]
+            for j in idxs:
+                gens[j] = i + 1            # new identity for the slot
+                keys[j] = _r13_keys(R13_SERIES, gens)[j]
+                last_val[j] = 0.0          # fresh counter from zero
+            churned += churn_n
+
+        lat, stats = _r13_steady(api, s, kw, q, end0, duration, rng,
+                                 keys, last_val, per_refresh=per_refresh,
+                                 concurrent_flush=True)
+        p50 = float(np.median(lat)) * 1e3
+        p99 = float(np.percentile(lat, 99)) * 1e3
+        yields = stats["cache"]["merge_gate_yields"]
+        assert yields > 0, \
+            "churn loop never deferred a merge to serving"
+        assert p99 <= 2 * p50, (p99, p50)
+        _r13_emit("churn", {
+            "scenario": "churn",
+            "metric": f"series churn: {R13_SERIES} live series, "
+                      f"{churn_n}/refresh replaced over "
+                      f"{R13_REFRESHES} refreshes with concurrent "
+                      f"flush+merge — merges deferred to serving "
+                      f"{yields}x, p99/p50 {p99 / p50:.2f}",
+            "value": round(p50, 2), "unit": "ms refresh p50",
+            "series": R13_SERIES, "churned_total": churned,
+            "refresh_p50_ms": round(p50, 2),
+            "refresh_p99_ms": round(p99, 2),
+            "refresh_ms": [round(x * 1e3, 2) for x in lat],
+            "acceptance": {"merge_gate_yields_gt_0": yields > 0,
+                           "p99_within_2x_p50": p99 <= 2 * p50},
+            **stats,
+        })
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def backfill_main() -> None:
+    """Scenario `backfill`: historical chunks land between refreshes.
+
+    Each refresh is preceded by an out-of-order ingest of a 15-minute
+    historical chunk (2 days old) for every live series — the write
+    path the remote-write backfill/migration tools exercise. The
+    result cache must take the correctness-mandated rebuild (a cached
+    prefix over a window that just changed underneath is a LIE), so
+    the artifact records the rebuild/inplace split plus the sustained
+    backfill rate alongside the refresh distribution."""
+    tmp = tempfile.mkdtemp(prefix="vmtpu-bench-backfill-")
+    rng = np.random.default_rng(17)
+    try:
+        s, api = _r13_setup(tmp)
+        now_ms = int(time.time() * 1000)
+        t_start = (now_ms - (R13_SAMPLES - 1) * 15_000) // STEP * STEP
+        keys = _r13_keys(R13_SERIES, 0)
+        last_val = _r13_corpus(s, rng, t_start, keys)
+        q = "sum by (job)(rate(m[5m]))"
+        duration = (R13_SAMPLES - 1) * 15_000 - 300_000
+        end0 = t_start + -(-((R13_SAMPLES - 1) * 15_000 + JITTER_MS)
+                           // STEP) * STEP
+        kw = dict(step=STEP, storage=s, tpu=None)
+        bf_base = t_start - 2 * DAY_MS
+        bf_chunk = 60                      # 15min @ 15s per refresh
+        bf_rows = [0]
+        bf_secs = [0.0]
+
+        def per_refresh(i, end):
+            ts0 = bf_base + i * bf_chunk * 15_000
+            ts2 = (ts0 + np.arange(bf_chunk, dtype=np.int64)[None, :]
+                   * 15_000 + np.zeros((R13_SERIES, 1), np.int64))
+            vals2 = np.cumsum(
+                rng.integers(0, 50, (R13_SERIES, bf_chunk)),
+                axis=1).astype(np.float64)
+            t0 = time.perf_counter()
+            _r13_ingest(s, keys, ts2, vals2)
+            bf_secs[0] += time.perf_counter() - t0
+            bf_rows[0] += R13_SERIES * bf_chunk
+
+        lat, stats = _r13_steady(api, s, kw, q, end0, duration, rng,
+                                 keys, last_val, per_refresh=per_refresh)
+        p50 = float(np.median(lat)) * 1e3
+        p99 = float(np.percentile(lat, 99)) * 1e3
+        bf_rate = bf_rows[0] / max(bf_secs[0], 1e-9)
+        _r13_emit("backfill", {
+            "scenario": "backfill",
+            "metric": f"backfill under serving: {bf_rows[0]} historical "
+                      f"rows ({bf_rate / 1e6:.2f}M rows/s) interleaved "
+                      f"with {R13_REFRESHES} refreshes — "
+                      + (f"cache took {stats['cache']['rebuild']} "
+                         f"rebuilds / {stats['cache']['inplace']} "
+                         f"in-place merges"
+                         if stats["cache"]["rebuild"]
+                         or stats["cache"]["inplace"] else
+                         "every refresh recomputed cold (the backfill "
+                         "invalidates the cached window — correctness "
+                         "over cache reuse)"),
+            "value": round(p50, 2), "unit": "ms refresh p50",
+            "series": R13_SERIES, "backfill_rows": bf_rows[0],
+            "backfill_rows_per_s": int(bf_rate),
+            "refresh_p50_ms": round(p50, 2),
+            "refresh_p99_ms": round(p99, 2),
+            "refresh_ms": [round(x * 1e3, 2) for x in lat],
+            **stats,
+        })
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def qstorm_main() -> None:
+    """Scenario `qstorm`: a burst of DISTINCT queries through the
+    SearchGate admission path — 8 client threads x 4 rounds x 16
+    different (function, selector) combinations, caches off (every
+    query is a first sight, the anti-dashboard). The per-phase split
+    makes queue_wait visible; VM_SEARCH_CONCURRENCY is pinned to 4 so
+    admission genuinely queues instead of vanishing on a wide host."""
+    os.environ.setdefault("VM_SEARCH_CONCURRENCY", "4")
+    import concurrent.futures as cf
+
+    tmp = tempfile.mkdtemp(prefix="vmtpu-bench-qstorm-")
+    rng = np.random.default_rng(23)
+    try:
+        from victoriametrics_tpu.query.exec import exec_query
+        from victoriametrics_tpu.query.types import EvalConfig
+        from victoriametrics_tpu.utils import flightrec
+        s, _api = _r13_setup(tmp)
+        now_ms = int(time.time() * 1000)
+        t_start = (now_ms - (R13_SAMPLES - 1) * 15_000) // STEP * STEP
+        keys = _r13_keys(R13_SERIES, 0)
+        _r13_corpus(s, rng, t_start, keys)
+        duration = (R13_SAMPLES - 1) * 15_000 - 300_000
+        end = t_start + -(-((R13_SAMPLES - 1) * 15_000 + JITTER_MS)
+                          // STEP) * STEP
+        funcs = ["rate", "increase", "max_over_time", "avg_over_time"]
+        queries = [f'sum by (instance)({fn}(m{{job="job-{j}"}}[5m]))'
+                   for fn in funcs for j in (1, 3, 5, 7)]
+
+        def one(q):
+            ec = EvalConfig(start=end - duration, end=end, step=STEP,
+                            storage=s, tpu=None, disable_cache=True)
+            t0 = time.perf_counter()
+            rows = exec_query(ec, q)
+            dt = time.perf_counter() - t0
+            assert rows, q
+            return dt, ec.cost
+
+        os.environ.setdefault("VM_SLOW_REFRESH_MS", "1000")
+        flight_id0 = flightrec.RECORDER.total()
+        ph0, c0 = _phase_totals(), _cache_merge_totals()
+        lat, leg_costs = [], []
+        rounds = 4
+        t_wall = time.perf_counter()
+        with cf.ThreadPoolExecutor(max_workers=8) as pool:
+            for _ in range(rounds):
+                for dt, cost in pool.map(one, queries):
+                    lat.append(dt)
+                    leg_costs.append(cost)
+        wall = time.perf_counter() - t_wall
+        n = len(lat)
+        p50 = float(np.median(lat)) * 1e3
+        p99 = float(np.percentile(lat, 99)) * 1e3
+        d1 = _phase_totals()
+        _r13_emit("qstorm", {
+            "scenario": "qstorm",
+            "metric": f"query storm: {n} distinct cold queries over "
+                      f"{R13_SERIES} series via 8 threads at "
+                      f"VM_SEARCH_CONCURRENCY="
+                      f"{os.environ['VM_SEARCH_CONCURRENCY']} — "
+                      f"{n / wall:.1f} qps, queue_wait "
+                      f"{(d1['queue_wait'] - ph0['queue_wait']) * 1e3 / n:.0f}"
+                      f"ms/query",
+            "value": round(n / wall, 2), "unit": "queries/sec",
+            "threads": 8, "distinct_queries": len(queries),
+            "rounds": rounds,
+            "query_p50_ms": round(p50, 2),
+            "query_p99_ms": round(p99, 2),
+            "queue_wait_ms_per_query": round(
+                (d1["queue_wait"] - ph0["queue_wait"]) * 1e3 / n, 2),
+            "phase": _phase_label(ph0, d1, n),
+            "cache": _cache_merge_delta(c0),
+            "cost": _cost_leg_summary(leg_costs, lat),
+            "flight": _leg_flight_summary(
+                flight_id0, float(os.environ["VM_SLOW_REFRESH_MS"])),
+        })
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def longrange_main() -> None:
+    """Scenario `longrange`: the downsampling headline (ISSUE 20).
+
+    A year of 30s raw data under VM_DOWNSAMPLE=1d:5m,30d:1h, one
+    re-rollup cycle, then the same year-long `sum_over_time(m[1d])`
+    step-1d query through the tier-serving read path vs the raw oracle
+    (VM_DOWNSAMPLE_READ=0). Acceptance: >=20x fewer samples read
+    (target 100x), >=10x lower p50, bit-exact equality on the
+    day-aligned grid."""
+    tmp = tempfile.mkdtemp(prefix="vmtpu-bench-longrange-")
+    rng = np.random.default_rng(29)
+    try:
+        from victoriametrics_tpu.query.exec import exec_query
+        from victoriametrics_tpu.query.types import EvalConfig
+        from victoriametrics_tpu.utils import flightrec
+        s, _api = _r13_setup(tmp, downsample="1d:5m,30d:1h",
+                             retention_ms=2 * 366 * DAY_MS)
+        now_ms = int(time.time() * 1000)
+        t_start = (now_ms // DAY_MS - LR_DAYS) * DAY_MS
+        keys = _r13_keys(LR_SERIES, 0)
+        n_per_day = DAY_MS // 30_000
+        t0 = time.perf_counter()
+        for d0 in range(0, LR_DAYS, 30):       # monthly ingest chunks
+            nd = min(30, LR_DAYS - d0)
+            base = (t_start + d0 * DAY_MS + np.arange(
+                nd * n_per_day, dtype=np.int64) * 30_000)
+            ts2 = np.broadcast_to(base, (LR_SERIES, base.size))
+            vals2 = rng.integers(
+                0, 1000, (LR_SERIES, base.size)).astype(np.float64)
+            _r13_ingest(s, keys, np.ascontiguousarray(ts2), vals2)
+            s.force_flush()
+        ingest_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        s.run_downsample_cycle(now_ms=now_ms)
+        ds_dt = time.perf_counter() - t0
+
+        q = "sum_over_time(m[1d])"
+        start = t_start + DAY_MS
+        end = (now_ms // DAY_MS) * DAY_MS - DAY_MS
+        raw_samples = LR_SERIES * LR_DAYS * n_per_day
+
+        def leg(n_evals):
+            s.reset_partial()
+            lats, costs, rows = [], [], None
+            id0 = flightrec.RECORDER.total()
+            ph0 = _phase_totals()
+            for _ in range(n_evals):
+                ec = EvalConfig(start=start, end=end, step=DAY_MS,
+                                storage=s, tpu=None, disable_cache=True)
+                t0 = time.perf_counter()
+                rows = exec_query(ec, q)
+                lats.append(time.perf_counter() - t0)
+                costs.append(ec.cost)
+            return rows, {
+                "p50_ms": round(float(np.median(lats)) * 1e3, 2),
+                "samples_read": costs[-1].samples,
+                "phase": _phase_label(ph0, _phase_totals(), n_evals),
+                "cost": _cost_leg_summary(costs, lats),
+                "flight": _leg_flight_summary(
+                    id0, float(os.environ.get("VM_SLOW_REFRESH_MS",
+                                              "1000"))),
+            }
+
+        os.environ.setdefault("VM_SLOW_REFRESH_MS", "10000")
+        tier_rows, tier = leg(3)
+        os.environ["VM_DOWNSAMPLE_READ"] = "0"
+        try:
+            raw_rows, raw = leg(3)
+        finally:
+            del os.environ["VM_DOWNSAMPLE_READ"]
+        _assert_rows_equal(tier_rows, raw_rows)   # bit-exact, host path
+        samples_ratio = raw["samples_read"] / max(tier["samples_read"], 1)
+        p50_ratio = raw["p50_ms"] / max(tier["p50_ms"], 1e-9)
+        assert samples_ratio >= 20, samples_ratio
+        assert p50_ratio >= 10, p50_ratio
+        _r13_emit("longrange", {
+            "scenario": "longrange",
+            "metric": f"long-range over tiers: {LR_DAYS}d x {LR_SERIES} "
+                      f"series @30s ({raw_samples / 1e6:.1f}M raw "
+                      f"samples), year query step 1d reads "
+                      f"{samples_ratio:.0f}x fewer samples and runs "
+                      f"{p50_ratio:.0f}x faster than the raw oracle, "
+                      f"bit-exact",
+            "value": round(samples_ratio, 1),
+            "unit": "x fewer samples read",
+            "tiers": "1d:5m,30d:1h",
+            "raw_samples": raw_samples,
+            "ingest_s": round(ingest_dt, 1),
+            "downsample_pass_s": round(ds_dt, 1),
+            "p50_speedup": round(p50_ratio, 1),
+            "tier_leg": tier, "raw_leg": raw,
+            "acceptance": {"samples_ratio_ge_20": samples_ratio >= 20,
+                           "samples_ratio": round(samples_ratio, 1),
+                           "p50_ratio_ge_10": p50_ratio >= 10,
+                           "p50_ratio": round(p50_ratio, 1),
+                           "oracle_bit_exact": True},
+        })
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     import argparse
     _p = argparse.ArgumentParser(prog="bench.py")
     _p.add_argument("--scenario", default="dashboard",
-                    choices=["dashboard", "fleet", "cluster"],
+                    choices=["dashboard", "fleet", "cluster", "churn",
+                             "backfill", "qstorm", "longrange"],
                     help="dashboard: the classic rolling-window loop "
                          "(default, the BENCH_r* headline); fleet: N "
                          "subscribers x M shared-selector panels via "
                          "materialized streams (BENCH_r11); cluster: "
                          "elastic scale-out over real vmstorage "
-                         "processes (CLUSTER_r12)")
+                         "processes (CLUSTER_r12); churn/backfill/"
+                         "qstorm/longrange: the r13 workload matrix "
+                         "(BENCH_r13_<scenario>.json — identity "
+                         "turnover under merge pressure, historical "
+                         "ingest under serving, an admission-gated "
+                         "query storm, and the downsample-tier "
+                         "long-range headline)")
     _p.add_argument("--device", action="store_true",
                     help="with --scenario=fleet: the fleet-batched "
                          "DEVICE serving leg on the virtual 8-device "
@@ -1724,5 +2204,13 @@ if __name__ == "__main__":
         fleet_main()
     elif _args.scenario == "cluster":
         cluster_main()
+    elif _args.scenario == "churn":
+        churn_main()
+    elif _args.scenario == "backfill":
+        backfill_main()
+    elif _args.scenario == "qstorm":
+        qstorm_main()
+    elif _args.scenario == "longrange":
+        longrange_main()
     else:
         main()

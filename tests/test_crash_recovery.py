@@ -79,6 +79,8 @@ except FileNotFoundError:
 kw = {}
 if scenario == "retention":
     kw["retention_ms"] = 40 * 86_400_000
+elif scenario == "downsample":
+    kw["downsample"] = "1d:5m"
 s = Storage(data_dir, **kw)
 names = [MetricName.from_dict({"__name__": "crashm", "s": str(i)})
          for i in range(N_SERIES)]
@@ -132,6 +134,17 @@ for b in range(acked + 1, acked + 1 + n_batches):
         s.create_snapshot()
     elif scenario == "retention" and b % 2 == 1:
         s.enforce_retention()
+    elif scenario == "downsample" and b % 2 == 1:
+        # fresh AGED samples each cycle so every run_downsample_cycle has
+        # an uncovered (covered, cutoff] range to rewrite — the seam
+        # between tier-part publication and the tier.json commit fires
+        # on every odd batch, not only the first
+        t_hi = T0 - 5 * 86_400_000 + b * 600_000
+        s.add_rows([(MetricName.from_dict({"__name__": "agedm",
+                                           "s": str(i)}),
+                     t_hi - i * 300_000, float(i)) for i in range(3)])
+        s.force_flush()
+        s.run_downsample_cycle(now_ms=t_hi + 86_400_000 + 300_000)
 s.close()
 os._exit(0)
 """
@@ -209,6 +222,23 @@ def _assert_disk_invariants(data_dir: str):
                 listed = json.load(f)["parts"]
         for n in os.listdir(pdir):
             if not os.path.isdir(os.path.join(pdir, n)):
+                continue
+            if n.startswith("ds_"):
+                # downsampled tier dir: every part dir inside must be
+                # listed in the tier's own manifest (tier.json) — the
+                # crash seam between part publication and the manifest
+                # commit must never leak an unlisted dir past recovery
+                tdir = os.path.join(pdir, n)
+                tman = os.path.join(tdir, "tier.json")
+                tlisted = []
+                if os.path.exists(tman):
+                    with open(tman) as f:
+                        tlisted = json.load(f)["parts"]
+                for tn in os.listdir(tdir):
+                    if not os.path.isdir(os.path.join(tdir, tn)):
+                        continue
+                    assert tn in tlisted, \
+                        f"unlisted tier part survived recovery: {tdir}/{tn}"
                 continue
             assert n in listed or n == "quarantine", \
                 f"unlisted part dir survived recovery: {pdir}/{n}"
@@ -409,6 +439,7 @@ _SEAMS = [
     ("part:finalize:post_rename", "flush"),
     ("partition:parts_json:pre_replace", "flush"),
     ("merge:post_rename_pre_manifest", "merge"),
+    ("downsample:post_rename_pre_manifest", "downsample"),
     ("mergeset:flush", "flush"),
     ("indexdb:rotate", "retention"),
     ("snapshot:mid", "snapshot"),

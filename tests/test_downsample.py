@@ -1,0 +1,558 @@
+"""Downsampling & retention tiers (tentpole + satellites).
+
+Covers:
+
+1. ``VM_DOWNSAMPLE`` grammar (offset:resolution[:retention] tiers).
+2. The dedup/downsample GOLDEN agreement: query-time dedup and the
+   downsample bucketing share right-inclusive window semantics —
+   boundary samples at exact interval multiples close their own window,
+   timestamp ties prefer the max non-stale value, staleness markers
+   survive in the ``last`` column and are excluded from min/max/count/
+   sum.  Pinned against BOTH the python ``deduplicate`` and the native
+   ``vm_dedup_rows`` assemble path.
+3. Tier-selection oracle equality: a tier-served rollup equals the same
+   query over raw (``VM_DOWNSAMPLE_READ=0``) at a bucket-aligned step —
+   bit-exact for sum/count/min/max/last (integer-representable values),
+   documented float tolerance for avg.
+4. The partial-resolution flag: raw dropped by retention + no tier
+   satisfying the step => served from the finest surviving tier and
+   LOUDLY flagged (storage flag, EvalConfig accumulator, HTTP
+   ``partialResolution``); ``VM_DOWNSAMPLE_READ=0`` disables even the
+   fallback.
+5. Per-tier retention sweep: raw parts dropped at raw retention while
+   tiers survive to their own deadlines; keep-forever tiers suppress
+   whole-partition and index-month drops.
+6. Tier recovery discipline: reopen round-trip, torn tier.json =>
+   whole-tier quarantine + self-heal from raw on the next pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from victoriametrics_tpu.ops import decimal as dec
+from victoriametrics_tpu.query.exec import exec_query
+from victoriametrics_tpu.query.types import EvalConfig
+from victoriametrics_tpu.storage import downsample as ds
+from victoriametrics_tpu.storage.dedup import _buckets, deduplicate
+from victoriametrics_tpu.storage.storage import Storage
+from victoriametrics_tpu.storage.tag_filters import TagFilter
+
+NOW = 1_754_000_000_000          # fixed "now" for deterministic cycles
+RES = 300_000                    # finest test tier: 5m
+FILTER_M = [TagFilter(b"", b"m")]
+
+
+# ---------------------------------------------------------------------------
+# 1. spec grammar
+# ---------------------------------------------------------------------------
+
+class TestSpec:
+    def test_two_tiers_with_default_retention(self):
+        tiers = ds.parse_spec("30d:5m,180d:1h")
+        assert [(t.offset_ms, t.resolution_ms, t.retention_ms)
+                for t in tiers] == [
+            (30 * 86_400_000, 300_000, 180 * 86_400_000),  # next offset
+            (180 * 86_400_000, 3_600_000, 0),              # forever
+        ]
+
+    def test_explicit_retention_and_units(self):
+        tiers = ds.parse_spec("1h:30s:2d")
+        assert [(t.offset_ms, t.resolution_ms, t.retention_ms)
+                for t in tiers] == [(3_600_000, 30_000, 2 * 86_400_000)]
+
+    def test_empty_spec_is_no_tiers(self):
+        assert ds.parse_spec("") == []
+        assert ds.parse_spec(None) == []
+
+    @pytest.mark.parametrize("spec", [
+        "30d",                       # missing resolution
+        "30d:5m:10d",                # retention <= offset
+        "30d:5m,20d:1h",             # offsets not increasing
+        "30d:1h,180d:5m",            # resolutions not increasing
+        "1h:5m,2h:7m",               # resolutions do not nest (7m % 5m)
+        "30d:0m",                    # zero resolution
+        "xx:5m",                     # bad duration
+    ])
+    def test_rejects(self, spec):
+        with pytest.raises(ValueError):
+            ds.parse_spec(spec)
+
+
+# ---------------------------------------------------------------------------
+# 2. golden dedup/downsample agreement
+# ---------------------------------------------------------------------------
+
+# one shared golden input: boundary samples (exact multiples of the
+# interval), an intra-bucket run, a timestamp tie (stale vs real), and an
+# all-stale bucket.  Interval = 100.
+GOLD_TS = np.array([
+    100,            # exact multiple: closes ITS OWN window (right-incl.)
+    101, 150, 200,  # (100, 200] bucket: last sample at the right edge
+    205, 210,       # (200, 300] bucket: plain run
+    400, 400,       # tie at the boundary of (300, 400]
+    450,            # (400, 500]: lone stale marker
+], dtype=np.int64)
+GOLD_VALS = np.array([
+    1.0,
+    2.0, 3.0, 4.0,
+    5.0, 6.0,
+    7.0, dec.STALE_NAN,     # tie: the NON-stale value must win
+    dec.STALE_NAN,
+], dtype=np.float64)
+# deduplicate keeps the highest-ts sample per bucket; the 400-tie keeps
+# the max non-stale (7.0); the all-stale bucket keeps its marker
+GOLD_KEEP_TS = np.array([100, 200, 210, 400, 450], dtype=np.int64)
+GOLD_KEEP_VALS = [1.0, 4.0, 6.0, 7.0, "stale"]
+
+
+def _assert_vals(got, want):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        if w == "stale":
+            assert dec.is_stale_nan(np.array([g]))[0]
+        else:
+            assert g == w
+
+
+class TestGoldenAgreement:
+    def test_buckets_right_inclusive(self):
+        # an exact multiple lands in its OWN window, not the next one
+        assert _buckets(np.array([100, 101, 200]), 100).tolist() == [1, 2, 2]
+
+    def test_python_dedup(self):
+        ts, vals = deduplicate(GOLD_TS, GOLD_VALS, 100)
+        assert ts.tolist() == GOLD_KEEP_TS.tolist()
+        _assert_vals(vals, GOLD_KEEP_VALS)
+
+    def test_downsample_last_is_dedup_restamped(self):
+        out = ds.aggregate_series(GOLD_TS, GOLD_VALS, 100)
+        lts, lvals = out["last"]
+        # same kept samples, restamped to the bucket right edges
+        assert lts.tolist() == (_buckets(GOLD_KEEP_TS, 100) * 100).tolist()
+        _assert_vals(lvals, GOLD_KEEP_VALS)
+
+    def test_downsample_aggregates_exclude_stale(self):
+        out = ds.aggregate_series(GOLD_TS, GOLD_VALS, 100)
+        # the all-stale (400, 500] bucket appears ONLY in `last`
+        for agg in ("min", "max", "count", "sum"):
+            assert out[agg][0].tolist() == [100, 200, 300, 400]
+        assert out["count"][1].tolist() == [1, 3, 2, 1]  # tie: stale excl.
+        assert out["sum"][1].tolist() == [1.0, 9.0, 11.0, 7.0]
+        assert out["min"][1].tolist() == [1.0, 2.0, 5.0, 7.0]
+        assert out["max"][1].tolist() == [1.0, 4.0, 6.0, 7.0]
+
+    def test_native_assemble_dedup_matches(self):
+        """The same golden input through the columnar assemble path
+        (native vm_dedup_rows when available, its python oracle loop
+        otherwise) keeps identical samples."""
+        from victoriametrics_tpu.storage.columnar import assemble
+        cols = assemble(np.array([0]), 1, np.array([GOLD_TS.size]),
+                        GOLD_TS.copy(), GOLD_VALS.copy(),
+                        0, 1_000, dedup_interval_ms=100)
+        n = int(cols.counts[0])
+        assert cols.ts[0, :n].tolist() == GOLD_KEEP_TS.tolist()
+        _assert_vals(cols.vals[0, :n], GOLD_KEEP_VALS)
+
+
+# ---------------------------------------------------------------------------
+# shared fixtures for storage-level tests
+# ---------------------------------------------------------------------------
+
+def _fill(s, base, span_ms, step_ms=30_000, n_series=3, seed=7):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(0, span_ms, step_ms):
+        for k in range(n_series):
+            rows.append(({"__name__": "m", "i": str(k)}, base + i,
+                         float(int(rng.integers(0, 1000)))))
+    s.add_rows(rows)
+    s.table.flush_to_disk()
+
+
+def _aligned_cfg(s, base, end, step):
+    start = ((base // RES) + 2) * RES
+    start += (step - (start % step)) % step
+    return EvalConfig(start=start, end=end, step=step, storage=s,
+                      disable_cache=True)
+
+
+def _run(s, base, end, step, q):
+    s.reset_partial()
+    ec = _aligned_cfg(s, base, end, step)
+    rows = exec_query(ec, q)
+    return ({bytes(r.metric_name.marshal()): r.values for r in rows}, ec)
+
+
+@pytest.fixture
+def aged_store(tmp_path):
+    """5 days of 30s data for 3 series, aged 60 days: fully covered by
+    the 5m tier of a 30d:5m,180d:1h config."""
+    base = NOW - 60 * 86_400_000
+    s = Storage(str(tmp_path / "s"), retention_ms=10 ** 15,
+                downsample="30d:5m,180d:1h")
+    _fill(s, base, 5 * 86_400_000)
+    s.run_downsample_cycle(now_ms=NOW)
+    yield s, base
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. tier-selection oracle
+# ---------------------------------------------------------------------------
+
+EXACT_QUERIES = ["sum_over_time(m[1h])", "count_over_time(m[1h])",
+                 "min_over_time(m[1h])", "max_over_time(m[1h])",
+                 "last_over_time(m[1h])"]
+
+
+class TestOracle:
+    @pytest.mark.parametrize("q", EXACT_QUERIES)
+    def test_bit_exact(self, aged_store, monkeypatch, q):
+        s, base = aged_store
+        end, step = base + 4 * 86_400_000, 3_600_000
+        tier, ec_t = _run(s, base, end, step, q)
+        monkeypatch.setenv("VM_DOWNSAMPLE_READ", "0")
+        raw, _ = _run(s, base, end, step, q)
+        assert tier.keys() == raw.keys() and len(tier) == 3
+        for k in tier:
+            a, b = tier[k], raw[k]
+            assert (np.isnan(a) == np.isnan(b)).all()
+            m = ~np.isnan(a)
+            # integer-representable values + the sequential reduceat sum:
+            # bit-exact equality, not a tolerance
+            assert (a[m] == b[m]).all(), q
+        assert ec_t._partial_res[0] is False
+
+    def test_avg_composed_within_tolerance(self, aged_store, monkeypatch):
+        """avg composes sum/count; the division reorders float ops vs the
+        raw mean, so equality is to ~1 ulp of the magnitude, not exact."""
+        s, base = aged_store
+        end, step = base + 4 * 86_400_000, 3_600_000
+        tier, _ = _run(s, base, end, step, "avg_over_time(m[1h])")
+        monkeypatch.setenv("VM_DOWNSAMPLE_READ", "0")
+        raw, _ = _run(s, base, end, step, "avg_over_time(m[1h])")
+        assert tier.keys() == raw.keys() and len(tier) == 3
+        for k in tier:
+            a, b = tier[k], raw[k]
+            assert (np.isnan(a) == np.isnan(b)).all()
+            m = ~np.isnan(a)
+            np.testing.assert_allclose(a[m], b[m], rtol=1e-12)
+
+    def test_tier_actually_served(self, aged_store):
+        """The oracle equality must not be vacuous: the tier-served fetch
+        reads ~step_ms/res fewer samples than the raw oracle."""
+        s, base = aged_store
+        end = base + 4 * 86_400_000
+        s.reset_partial()
+        cols = s.search_columns(FILTER_M, base, end, ds=("sum", 3_600_000))
+        raw = s.search_columns(FILTER_M, base, end)
+        assert cols.ds_res == RES
+        assert raw.ds_res == 0
+        assert raw.n_samples >= 9 * cols.n_samples  # 30s -> 5m buckets
+
+    def test_count_mixed_tier_and_raw_tail(self, tmp_path, monkeypatch):
+        """count_over_time across the tier/raw coverage boundary: aged
+        buckets come from the count column, the raw tail contributes 1
+        per sample — the sum of the mixture is the exact count."""
+        base = NOW - 3 * 86_400_000
+        s = Storage(str(tmp_path / "s"), retention_ms=10 ** 15,
+                    downsample="1d:5m")
+        try:
+            _fill(s, base, 3 * 86_400_000 - 3_600_000)
+            # cycle at NOW: covers only the aged (> 1d old) prefix; the
+            # final ~day stays raw-only
+            s.run_downsample_cycle(now_ms=NOW)
+            st = next(iter(s.table._partitions.values()))._tiers[RES]
+            assert base < st.covered_max_ts < NOW - 86_400_000 + RES
+            end, step = base + 3 * 86_400_000 - 2 * 3_600_000, 3_600_000
+            for q in ("count_over_time(m[1h])", "sum_over_time(m[1h])",
+                      "avg_over_time(m[1h])"):
+                tier, _ = _run(s, base, end, step, q)
+                monkeypatch.setenv("VM_DOWNSAMPLE_READ", "0")
+                raw, _ = _run(s, base, end, step, q)
+                monkeypatch.delenv("VM_DOWNSAMPLE_READ")
+                assert tier.keys() == raw.keys() and len(tier) == 3
+                for k in tier:
+                    a, b = tier[k], raw[k]
+                    assert (np.isnan(a) == np.isnan(b)).all()
+                    m = ~np.isnan(a)
+                    np.testing.assert_allclose(a[m], b[m], rtol=1e-12)
+        finally:
+            s.close()
+
+    def test_month_seam_bucket_exact(self, tmp_path, monkeypatch):
+        """A right-inclusive bucket whose edge is midnight of the 1st is
+        SPLIT across two monthly partitions: the old month holds
+        (edge-res, edge) and the new month the sample at exactly the
+        edge.  The old partition's final bucket must restamp INSIDE the
+        partition (its last inclusive ms) — an unclamped edge stamp
+        collides with the new partition's first bucket and assembly
+        drops one of the duplicate-ts rows, under-counting the seam
+        window."""
+        boundary = 1_748_736_000_000          # 2025-06-01T00:00:00Z
+        base = boundary - 86_400_000
+        s = Storage(str(tmp_path / "s"), retention_ms=10 ** 15,
+                    downsample="30d:5m")
+        try:
+            # 30s cadence across the seam INCLUDING a sample at exactly
+            # the boundary (it lands in the June partition)
+            _fill(s, base, 2 * 86_400_000 + 30_000)
+            s.run_downsample_cycle(now_ms=NOW)
+            # both monthly partitions produced a tier; the May one's
+            # final bucket is clamped to the partition's last ms
+            tiers = [p._tiers[RES] for p in
+                     s.table._partitions.values() if p._tiers]
+            assert len(tiers) == 2
+            assert min(t.covered_max_ts for t in tiers) == boundary - 1
+            end, step = base + 2 * 86_400_000, 3_600_000
+            for q in ("sum_over_time(m[1h])", "count_over_time(m[1h])",
+                      "last_over_time(m[1h])"):
+                tier, _ = _run(s, base, end, step, q)
+                monkeypatch.setenv("VM_DOWNSAMPLE_READ", "0")
+                raw, _ = _run(s, base, end, step, q)
+                monkeypatch.delenv("VM_DOWNSAMPLE_READ")
+                assert tier.keys() == raw.keys() and len(tier) == 3
+                for k in tier:
+                    a, b = tier[k], raw[k]
+                    assert (np.isnan(a) == np.isnan(b)).all(), q
+                    m = ~np.isnan(a)
+                    assert (a[m] == b[m]).all(), q
+        finally:
+            s.close()
+
+    def test_tier_cascade_coarse_fine_raw(self, tmp_path, monkeypatch):
+        """A long-range fetch cascades 1h-tier -> 5m-tier -> raw: each
+        finer source serves only the span past the previous watermark,
+        the composition is disjoint, and the result stays bit-exact
+        against the raw oracle."""
+        base = NOW - 5 * 86_400_000
+        s = Storage(str(tmp_path / "s"), retention_ms=10 ** 15,
+                    downsample="1d:5m,3d:1h")
+        try:
+            _fill(s, base, 5 * 86_400_000 - 3_600_000)
+            s.run_downsample_cycle(now_ms=NOW)
+            s.reset_partial()
+            end = base + 5 * 86_400_000 - 2 * 3_600_000
+            cols = s.search_columns(FILTER_M, base, end,
+                                    ds=("sum", 3_600_000))
+            raw = s.search_columns(FILTER_M, base, end)
+            # coarsest contributing tier is reported; the 5m middle span
+            # and raw tail make the fetch strictly richer than 1h-only
+            assert cols.ds_res == 3_600_000
+            n_1h_only = 3 * (4 * 86_400_000 // 3_600_000)
+            assert cols.n_samples > n_1h_only
+            assert raw.n_samples > 4 * cols.n_samples
+            for q in ("sum_over_time(m[1h])", "count_over_time(m[1h])",
+                      "max_over_time(m[1h])"):
+                tier, ec = _run(s, base, end, 3_600_000, q)
+                monkeypatch.setenv("VM_DOWNSAMPLE_READ", "0")
+                oracle, _ = _run(s, base, end, 3_600_000, q)
+                monkeypatch.delenv("VM_DOWNSAMPLE_READ")
+                assert tier.keys() == oracle.keys() and len(tier) == 3
+                for k in tier:
+                    a, b = tier[k], oracle[k]
+                    assert (np.isnan(a) == np.isnan(b)).all(), q
+                    m = ~np.isnan(a)
+                    assert (a[m] == b[m]).all(), q
+                assert ec._partial_res[0] is False
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# 4. partial-resolution flag
+# ---------------------------------------------------------------------------
+
+class TestPartialResolution:
+    def test_fallback_sets_flag(self, aged_store):
+        s, base = aged_store
+        for p in s.table._partitions.values():
+            p.drop_raw_parts()
+        s.reset_partial()
+        # ds asks for finer than any tier -> fallback to finest, flagged
+        cols = s.search_columns(FILTER_M, base, base + 86_400_000,
+                                ds=("sum", 1))
+        assert cols.partial_res is True and cols.ds_res == RES
+        assert cols.n_samples > 0
+        assert s.last_partial_resolution is True
+        s.reset_partial()
+        assert s.last_partial_resolution is False
+
+    def test_flag_reaches_eval_config(self, aged_store):
+        s, base = aged_store
+        for p in s.table._partitions.values():
+            p.drop_raw_parts()
+        # 1m step over 5m buckets: no tier satisfies, fallback + flag
+        _, ec = _run(s, base, base + 6 * 3_600_000, 60_000,
+                     "sum_over_time(m[1m])")
+        assert ec._partial_res[0] is True
+
+    def test_read_disabled_disables_fallback(self, aged_store,
+                                             monkeypatch):
+        s, base = aged_store
+        for p in s.table._partitions.values():
+            p.drop_raw_parts()
+        monkeypatch.setenv("VM_DOWNSAMPLE_READ", "0")
+        s.reset_partial()
+        cols = s.search_columns(FILTER_M, base, base + 86_400_000,
+                                ds=("sum", 1))
+        assert cols.n_samples == 0 and cols.ds_res == 0
+        assert s.last_partial_resolution is False
+
+    def test_http_partial_resolution_field(self, aged_store):
+        from tests.apptest_helpers import Client
+        from victoriametrics_tpu.httpapi.prometheus_api import PrometheusAPI
+        from victoriametrics_tpu.httpapi.server import HTTPServer
+        s, base = aged_store
+        for p in s.table._partitions.values():
+            p.drop_raw_parts()
+        srv = HTTPServer("127.0.0.1", 0)
+        PrometheusAPI(s).register(srv, mode="select")
+        srv.start()
+        try:
+            c = Client(srv.port)
+            t = ((base // RES) + 20) * RES
+            code, body = c.get("/api/v1/query_range",
+                               query="sum_over_time(m[1m])",
+                               start=str(t // 1000),
+                               end=str((t + 3_600_000) // 1000), step="60")
+            assert code == 200
+            rep = json.loads(body)
+            assert rep["partialResolution"] is True
+            assert rep["isPartial"] is False
+            # full-resolution query on a healthy window: flag stays off
+            code, body = c.get("/api/v1/query_range",
+                               query="sum_over_time(m[1h])",
+                               start=str(t // 1000),
+                               end=str((t + 6 * 3_600_000) // 1000),
+                               step="3600")
+            assert json.loads(body)["partialResolution"] is False
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# 5. per-tier retention sweep
+# ---------------------------------------------------------------------------
+
+class TestRetentionSweep:
+    def test_raw_dropped_tiers_survive(self, tmp_path):
+        """Raw retention expires a partition's raw parts while a
+        keep-forever tier still serves it; the index months survive so
+        the tier stays QUERYABLE.  (Retention is partition-granular: the
+        whole MONTH must be past the raw deadline, hence 90d-old data
+        against a 40d raw retention.)"""
+        base = NOW - 90 * 86_400_000
+        s = Storage(str(tmp_path / "s"), retention_ms=40 * 86_400_000,
+                    downsample="30d:5m")
+        try:
+            _fill(s, base, 2 * 86_400_000)
+            s.run_downsample_cycle(now_ms=NOW)
+            assert s.enforce_retention(now_ms=NOW) >= 1
+            p = next(iter(s.table._partitions.values()))
+            assert not p._file_parts and p.has_tier_parts
+            # still queryable straight from the tier (fallback + flag)
+            s.reset_partial()
+            cols = s.search_columns(FILTER_M, base, base + 86_400_000,
+                                    ds=("sum", RES))
+            assert cols.n_samples > 0 and cols.ds_res == RES
+        finally:
+            s.close()
+
+    def test_tier_dropped_at_own_deadline(self, tmp_path):
+        """A bounded tier is dropped once its retention passes while a
+        longer-lived coarser tier keeps the partition alive."""
+        base = NOW - 200 * 86_400_000
+        s = Storage(str(tmp_path / "s"), retention_ms=10 ** 15,
+                    downsample="30d:5m:100d,180d:1h")
+        try:
+            _fill(s, base, 86_400_000)
+            s.run_downsample_cycle(now_ms=NOW)
+            p = next(iter(s.table._partitions.values()))
+            assert sorted(res for res, _ in s.tier_deadlines()) == \
+                [RES, 3_600_000]
+            assert set(st.resolution_ms for st in p.tier_states()) == \
+                {RES, 3_600_000}
+            assert s.enforce_retention(now_ms=NOW) >= 1
+            assert [st.resolution_ms for st in p.tier_states()] == \
+                [3_600_000]
+            assert not os.path.isdir(os.path.join(p.path, f"ds_{RES}"))
+        finally:
+            s.close()
+
+    def test_everything_expired_drops_partition(self, tmp_path):
+        """When raw AND every tier deadline have passed, the partition
+        dir (and its index months) drop whole — same as before tiers."""
+        base = NOW - 200 * 86_400_000
+        s = Storage(str(tmp_path / "s"), retention_ms=40 * 86_400_000,
+                    downsample="30d:5m:100d")
+        try:
+            _fill(s, base, 86_400_000)
+            s.run_downsample_cycle(now_ms=NOW)
+            assert s.enforce_retention(now_ms=NOW) >= 1
+            assert s.table.partition_names == []
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# 6. recovery discipline
+# ---------------------------------------------------------------------------
+
+class TestRecovery:
+    def test_reopen_roundtrip(self, tmp_path):
+        base = NOW - 60 * 86_400_000
+        d = str(tmp_path / "s")
+        s = Storage(d, retention_ms=10 ** 15, downsample="30d:5m")
+        _fill(s, base, 86_400_000)
+        s.run_downsample_cycle(now_ms=NOW)
+        want = s.search_columns(FILTER_M, base, base + 86_400_000,
+                                ds=("sum", 3_600_000))
+        s.close()
+        s2 = Storage(d, retention_ms=10 ** 15, downsample="30d:5m")
+        try:
+            assert s2.table.quarantined() == []
+            got = s2.search_columns(FILTER_M, base, base + 86_400_000,
+                                    ds=("sum", 3_600_000))
+            assert got.ds_res == RES
+            assert got.n_samples == want.n_samples
+        finally:
+            s2.close()
+
+    def test_torn_tier_quarantined_whole_then_self_heals(self, tmp_path):
+        base = NOW - 60 * 86_400_000
+        d = str(tmp_path / "s")
+        s = Storage(d, retention_ms=10 ** 15, downsample="30d:5m")
+        _fill(s, base, 86_400_000)
+        s.run_downsample_cycle(now_ms=NOW)
+        s.close()
+        tj = os.path.join(
+            d, "data",
+            next(n for n in os.listdir(os.path.join(d, "data"))
+                 if os.path.isdir(os.path.join(d, "data", n))),
+            f"ds_{RES}", "tier.json")
+        with open(tj, "r+b") as f:
+            b = bytearray(f.read())
+            b[len(b) // 2] ^= 0xFF
+            f.seek(0)
+            f.write(b)
+        s = Storage(d, retention_ms=10 ** 15, downsample="30d:5m")
+        try:
+            rep = s.table.quarantined()
+            assert [q["store"] for q in rep] == ["downsample"], rep
+            # raw survives: queries fall back to raw, tier ignored
+            cols = s.search_columns(FILTER_M, base, base + 86_400_000,
+                                    ds=("sum", 3_600_000))
+            assert cols.ds_res == 0 and cols.n_samples > 0
+            # next pass rebuilds the tier from raw
+            s.run_downsample_cycle(now_ms=NOW)
+            cols = s.search_columns(FILTER_M, base, base + 86_400_000,
+                                    ds=("sum", 3_600_000))
+            assert cols.ds_res == RES
+        finally:
+            s.close()

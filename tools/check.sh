@@ -22,6 +22,7 @@
 #   matstream  materialized-stream fan-out  VMT_NO_MATSTREAM_SMOKE=1
 #   selfscrape self-scrape+SLO duty cycle   VMT_NO_SELFSCRAPE_SMOKE=1
 #   reshard    elastic scale-out reshard    VMT_NO_RESHARD_SMOKE=1
+#   dsample    downsample tier read path  VMT_NO_DOWNSAMPLE_SMOKE=1
 #   ccache     persistent compile cache: a second cold process must
 #              compile 0 kernels for a warmed bucket shape (native jax
 #              cache + own-format fallback)  VMT_NO_COMPILE_CACHE_SMOKE=1
@@ -104,6 +105,12 @@ if [ "${VMT_NO_RESHARD_SMOKE:-0}" != "1" ]; then
     run_stage reshard python -m victoriametrics_tpu.devtools.reshard_smoke
 else
     skipped reshard
+fi
+if [ "${VMT_NO_DOWNSAMPLE_SMOKE:-0}" != "1" ]; then
+    run_stage dsample \
+        python -m victoriametrics_tpu.devtools.downsample_smoke
+else
+    skipped dsample
 fi
 if [ "${VMT_NO_COMPILE_CACHE_SMOKE:-0}" != "1" ]; then
     run_stage ccache \

@@ -92,6 +92,14 @@ if [ "${VMT_NO_RESHARD_SMOKE:-0}" != "1" ]; then
     env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         python -m victoriametrics_tpu.devtools.reshard_smoke
 fi
+# Downsample tier smoke (devtools/downsample_smoke.py): one re-rollup
+# cycle against a real Storage; the 5m tier must serve a hinted
+# long-range fetch with >=4x fewer samples and stay bit-exact vs the
+# raw oracle.  VMT_NO_DOWNSAMPLE_SMOKE=1 skips it.
+if [ "${VMT_NO_DOWNSAMPLE_SMOKE:-0}" != "1" ]; then
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python -m victoriametrics_tpu.devtools.downsample_smoke
+fi
 # Persistent compile-cache smoke (devtools/compile_cache_smoke.py): a
 # second cold process must compile 0 kernels for a fleet bucket shape
 # the first process warmed — native jax cache AND the own-format

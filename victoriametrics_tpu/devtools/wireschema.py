@@ -104,6 +104,23 @@ FORMATS = {
         "read_seed_calls": {
             "victoriametrics_tpu/storage/partition.py": ("load",)},
     },
+    # downsampled-tier manifest (storage/downsample.py): written via the
+    # same write_meta_json/meta_crc seam as metadata.json, committed
+    # after part publication (downsample:post_rename_pre_manifest)
+    "tier.json": {
+        "kind": "json",
+        "write_dict_args": [
+            ("victoriametrics_tpu/storage/downsample.py",
+             "write_meta_json", 1)],
+        "write_key_assigns": [
+            ("victoriametrics_tpu/utils/fs.py", "write_meta_json", "meta")],
+        "read_seed_calls": {
+            "victoriametrics_tpu/storage/downsample.py":
+                ("load_meta_json",),
+            "victoriametrics_tpu/utils/fs.py": ("load_meta_json",)},
+        "read_seed_params": {
+            "victoriametrics_tpu/utils/fs.py": ("meta",)},
+    },
     "adopted_mid.json": {
         "kind": "json",
         "only_funcs": ("_persist_adopted_watermark",

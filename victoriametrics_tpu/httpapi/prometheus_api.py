@@ -496,6 +496,8 @@ class PrometheusAPI:
         body = {"status": "success",
                 "isPartial": bool(getattr(self.storage, "last_partial",
                                           False)),
+                "partialResolution": bool(getattr(
+                    self.storage, "last_partial_resolution", False)),
                 "data": {"resultType": "vector", "result": result}}
         if qt.enabled:
             body["trace"] = qt.to_dict()
@@ -570,6 +572,8 @@ class PrometheusAPI:
         body = {"status": "success",
                 "isPartial": bool(getattr(self.storage, "last_partial",
                                           False)),
+                "partialResolution": bool(getattr(
+                    self.storage, "last_partial_resolution", False)),
                 "data": {"resultType": "matrix", "result": result}}
         if qt.enabled:
             body["trace"] = qt.to_dict()

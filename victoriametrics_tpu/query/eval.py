@@ -325,12 +325,55 @@ def _fetch_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
             # capture partiality PER QUERY right after the fetch: the
             # shared storage flag is reset by every new incoming request
             ec._partial[0] = True
+        if getattr(ec.storage, "last_partial_resolution", False):
+            # a downsampled tier coarser than the query's step served a
+            # range whose raw data is gone (see storage/downsample.py)
+            ec._partial_res[0] = True
         ec.count_samples(n_samples)
         qt.donef("%d series, %d samples", n_series, n_samples)
     cfg = RollupConfig(start=start, end=end, step=ec.step, window=lookback)
     admission = admit_rollup(str(me), n_series, ec.n_points,
                              ec.max_memory_per_query)
     return payload, cfg, admission, fetch_info
+
+
+# Rollup func -> the downsampled-tier aggregate column that can serve it
+# (storage/downsample.py AGG_COLUMNS).  "last" is literally query-time
+# dedup at the tier resolution, so funcs that consume raw samples
+# (rate/increase/delta/default_rollup) read it as a coarser sample
+# stream; count reads the per-bucket count column (summed — see the
+# count->sum rewrite); avg composes sum/count.
+_DS_AGG = {
+    "min_over_time": "min", "max_over_time": "max",
+    "sum_over_time": "sum", "count_over_time": "count",
+    "avg_over_time": "avg",
+    "last_over_time": "last", "default_rollup": "last",
+    "rate": "last", "increase": "last", "delta": "last",
+}
+
+
+def _ds_hint(ec: EvalConfig, func: str, window: int):
+    """``(agg_column, max_resolution_ms)`` when this rollup may be served
+    from downsampled tiers, else None.  The resolution bound is the
+    rollup's effective lookback: every window then spans at least one
+    whole tier bucket.  None whenever the storage has no tiers or
+    VM_DOWNSAMPLE_READ=0 (the raw-oracle escape hatch)."""
+    st = ec.storage
+    if st is None or not getattr(st, "supports_downsample_read", False):
+        return None
+    if not st.downsample_active:
+        return None
+    from ..storage import downsample as _dsmod
+    if not _dsmod.read_enabled():
+        return None
+    agg = _DS_AGG.get(func)
+    if agg is None:
+        return None
+    lookback = window if window > 0 else (
+        ec.lookback_delta if func == "default_rollup" else ec.step)
+    if lookback <= 0:
+        return None
+    return (agg, int(lookback))
 
 
 def _tracer_kw(ec: EvalConfig, qt) -> dict:
@@ -373,14 +416,18 @@ def _fetch_series_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
 
 
 def _fetch_columns_for_rollup(ec: EvalConfig, func: str, re_: RollupExpr,
-                              window: int, offset: int):
+                              window: int, offset: int, ds=None):
     """Columnar twin of _fetch_series_for_rollup: one batched decode pass
-    into padded (S, N) columns (storage.search_columns)."""
+    into padded (S, N) columns (storage.search_columns).  ``ds`` is the
+    optional downsampled-tier hint (see _ds_hint), passed through only
+    when set — plain storages without tier support never see the kwarg."""
     def fetcher(filters, lo, hi, qt):
+        kw = _tracer_kw(ec, qt)
+        if ds is not None:
+            kw["ds"] = ds
         cols = ec.storage.search_columns(filters, lo, hi,
                                          max_series=ec.max_series,
-                                         tenant=ec.tenant,
-                                         **_tracer_kw(ec, qt))
+                                         tenant=ec.tenant, **kw)
         if func not in ("default_rollup", "stale_samples_over_time"):
             cols.drop_stale_nans()  # dropStaleNaNs (eval.go:2081), batched
         return cols, cols.n_series, cols.n_samples
@@ -396,12 +443,21 @@ def _finish_rollup_cols(cols, rows, keep_name: bool) -> list[Timeseries]:
 
 def _rollup_from_storage_cols(ec: EvalConfig, func: str, re_: RollupExpr,
                               window: int, offset: int, args: tuple,
-                              keep_name: bool, ckey) -> list[Timeseries]:
+                              keep_name: bool, ckey,
+                              ds=None) -> list[Timeseries]:
     """Columnar host rollup: fetch -> (S, N) columns -> batched rollup,
-    zero per-series Python on the hot path."""
+    zero per-series Python on the hot path.  With a ``ds`` hint the fetch
+    may return tier aggregate columns; count_over_time then computes as
+    sum_over_time (count column per aged bucket + 1-per-raw-sample tail —
+    see downsample.count_tail_piece — sum to the true count)."""
     from ..ops import rollup_np
+    if ds is not None and ds[0] == "avg":
+        return _ds_avg_composed(ec, re_, window, offset, args, keep_name,
+                                ckey, ds)
     cols, cfg, admission, _ = _fetch_columns_for_rollup(
-        ec, func, re_, window, offset)
+        ec, func, re_, window, offset, ds)
+    if ds is not None and ds[0] == "count":
+        func = "sum_over_time"
     per_series_cfg = None
     adj = adjusted_windows(func, window, ec.step, cols.ts_list())
     if adj:
@@ -444,6 +500,33 @@ def _rollup_from_storage_cols(ec: EvalConfig, func: str, re_: RollupExpr,
                              _finish_rollup_cols(cols, out_rows, keep_name))
 
 
+def _ds_avg_composed(ec: EvalConfig, re_: RollupExpr, window: int,
+                     offset: int, args: tuple, keep_name: bool, ckey,
+                     ds) -> list[Timeseries]:
+    """avg_over_time over downsampled tiers: sum column / count column.
+    A per-bucket average cannot be re-averaged correctly (buckets hold
+    different sample counts); the sum/count pair can.  The composition
+    is correct even when raw ends up serving the fetch: the count leg
+    then reads 1-per-sample (downsample.count_tail_piece), so the
+    division still yields the exact raw average."""
+    sums = _rollup_from_storage_cols(ec, "sum_over_time", re_, window,
+                                     offset, args, keep_name, None,
+                                     ds=("sum", ds[1]))
+    cnts = _rollup_from_storage_cols(ec, "count_over_time", re_, window,
+                                     offset, args, keep_name, None,
+                                     ds=("count", ds[1]))
+    by_key = {bytes(ts.metric_name.marshal()): ts for ts in cnts}
+    out = []
+    for ts in sums:
+        c = by_key.get(bytes(ts.metric_name.marshal()))
+        if c is None:
+            continue
+        with np.errstate(invalid="ignore", divide="ignore"):
+            vals = np.where(c.values > 0, ts.values / c.values, nan)
+        out.append(Timeseries(ts.metric_name, vals))
+    return _cache_rollup(ec, ckey, out)
+
+
 def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
                          window: int, offset: int, args: tuple,
                          keep_name: bool) -> list[Timeseries]:
@@ -463,8 +546,12 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
 
         from .rollup_result_cache import GLOBAL as rcache
         now_ms = int(_t.time() * 1000)
+        # the ds token splits cache entries computed with tier serving on
+        # vs off (VM_DOWNSAMPLE_READ flips live; tier floats differ from
+        # raw floats, so the two populations must never merge)
         ckey = (f"rollup|{func}|{me}|{window}|{offset}|{args!r}|"
-                f"{keep_name}")
+                f"{keep_name}|"
+                f"ds{0 if _ds_hint(ec, func, window) is None else 1}")
         cached, new_start = rcache.get(ec, ckey, now_ms)
         if cached is not None and new_start > ec.end:
             ec.tracer.printf("eval rollup cache: full hit %s", ckey)
@@ -480,7 +567,7 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
                 fresh = trim_suffix_rows(fresh)
             rows = rcache.merge(cached, fresh, ec, new_start,
                                 now_ms=now_ms)
-            if not ec._partial[0]:
+            if not ec._partial[0] and not ec._partial_res[0]:
                 rcache.put(ec, ckey, rows, now_ms)
             return rows
 
@@ -492,7 +579,8 @@ def _rollup_from_storage(ec: EvalConfig, func: str, re_: RollupExpr,
         # per-series materialization (device tiles go through the series
         # path below so tile caching keys stay unified)
         return _rollup_from_storage_cols(ec, func, re_, window, offset,
-                                         args, keep_name, ckey)
+                                         args, keep_name, ckey,
+                                         ds=_ds_hint(ec, func, window))
 
     series, cfg, admission, fetch_info = _fetch_series_for_rollup(
         ec, func, re_, window, offset)
@@ -668,7 +756,7 @@ def trim_suffix_rows(rows: list[Timeseries]) -> list[Timeseries]:
 
 
 def _cache_rollup(ec, ckey, rows):
-    if ckey is not None and not ec._partial[0]:
+    if ckey is not None and not ec._partial[0] and not ec._partial_res[0]:
         import time as _t
 
         from .rollup_result_cache import GLOBAL as rcache

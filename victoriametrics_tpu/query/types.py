@@ -65,6 +65,10 @@ class EvalConfig:
     _grid: np.ndarray | None = None
     _samples_scanned: list | None = None  # shared per-query accumulator
     _partial: list | None = None          # per-query partial-result flag
+    # per-query partial-RESOLUTION flag: some fetch was served from a
+    # downsampled tier coarser than the query's step allows (raw dropped
+    # by retention) — degraded loudly, never silently wrong
+    _partial_res: list | None = None
     _cost: object | None = None  # shared per-query CostTracker
 
     def __post_init__(self):
@@ -77,6 +81,8 @@ class EvalConfig:
             self._samples_scanned = [0]
         if self._partial is None:
             self._partial = [False]
+        if self._partial_res is None:
+            self._partial_res = [False]
         if self._cost is None:
             # one CostTracker per query, shared by children exactly like
             # the samples accumulator (utils/costacc: the per-query
@@ -114,7 +120,8 @@ class EvalConfig:
                  no_device_roll=self.no_device_roll,
                  tracer=self.tracer, tpu=self.tpu,
                  _samples_scanned=self._samples_scanned,
-                 _partial=self._partial, _cost=self._cost)
+                 _partial=self._partial, _partial_res=self._partial_res,
+                 _cost=self._cost)
         d.update(kw)
         return EvalConfig(**d)
 

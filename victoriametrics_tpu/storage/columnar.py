@@ -27,7 +27,8 @@ class ColumnarSeries:
     metric_ids/raw_names/metric_names."""
 
     __slots__ = ("metric_ids", "ts", "vals", "counts", "raw_names",
-                 "metric_names", "stale_rows", "dropped_rows")
+                 "metric_names", "stale_rows", "dropped_rows", "ds_res",
+                 "partial_res")
 
     def __init__(self, metric_ids, ts, vals, counts, raw_names=None,
                  metric_names=None, stale_rows=None):
@@ -41,6 +42,11 @@ class ColumnarSeries:
         self.stale_rows = stale_rows
         # row indices (pre-drop numbering) removed as empty by the clip
         self.dropped_rows = None
+        # downsampled-tier provenance (storage/downsample.py): coarsest
+        # resolution actually served (0 = raw only), and whether a fetch
+        # fell back to a tier coarser than the query's step allows
+        self.ds_res = 0
+        self.partial_res = False
 
     @classmethod
     def empty(cls) -> "ColumnarSeries":

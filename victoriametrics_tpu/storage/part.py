@@ -181,8 +181,11 @@ class MetaindexRow:
 class PartWriter:
     """Streams blocks (sorted by (tsid, min_ts)) into a new part dir."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, resolution_ms: int = 0):
         self.path = path
+        #: sample resolution this part stores: 0 = raw samples; >0 = one
+        #: aggregated sample per resolution_ms bucket (downsampled tier)
+        self.resolution_ms = resolution_ms
         self.tmp = path + ".tmp"
         os.makedirs(self.tmp, exist_ok=True)
         self._ts_f = open(os.path.join(self.tmp, "timestamps.bin"), "wb")
@@ -336,6 +339,7 @@ class PartWriter:
             os.path.join(self.tmp, "metadata.json"),
             {"rows": self.rows, "blocks": self.blocks,
              "min_ts": self.min_ts, "max_ts": self.max_ts,
+             "resolutionMs": self.resolution_ms,
              "checksums": sums})
         faultinject.fire("part:finalize:pre_rename")
         fslib.rename_durable(self.tmp, self.path)
@@ -372,6 +376,9 @@ class Part:
         self.blocks = meta["blocks"]
         self.min_ts = meta["min_ts"]
         self.max_ts = meta["max_ts"]
+        # additive field (wire-schema ratchet): parts written before
+        # downsampling existed are raw
+        self.resolution_ms = meta.get("resolutionMs", 0)
         raw = zstd.decompress(open(os.path.join(path, "metaindex.bin"), "rb").read())
         self.meta_rows: list[MetaindexRow] = []
         for off in range(0, len(raw), _META_ROW.size):

@@ -29,6 +29,7 @@ from ..utils import flightrec, logger
 from ..utils import fs as fslib
 from ..utils import metrics as metricslib
 from ..utils import workpool
+from . import downsample as dslib
 from .block import MAX_ROWS_PER_BLOCK, Block, rows_to_blocks
 from .dedup import deduplicate
 from .part import Part, PartWriter
@@ -503,6 +504,9 @@ class Partition:
         #: in parts.json — delisting them would hand the bytes to the
         #: next open's unlisted-dir sweep
         self._keep_listed: list[str] = []
+        #: downsampled tiers by resolution_ms (ds_<res> dirs; see
+        #: storage/downsample.py) — raw parts and tier parts never mix
+        self._tiers: dict[int, "dslib.PartitionTier"] = {}
         os.makedirs(path, exist_ok=True)
         self._open_existing()
 
@@ -583,11 +587,22 @@ class Partition:
                 self._keep_listed.append(name)
                 _PARTS_OPEN_ERRORS.inc()
         # remove crash leftovers: only dirs NOT listed in parts.json
-        # (the quarantine dir is bookkeeping, never a leftover)
+        # (the quarantine dir is bookkeeping, never a leftover; ds_* tier
+        # dirs carry their OWN manifest + sweep — see PartitionTier.open)
         for name in os.listdir(self.path):
             full = os.path.join(self.path, name)
             if name == "parts.json" or name == QUARANTINE_DIR or \
                     not os.path.isdir(full):
+                continue
+            if name.startswith(dslib.TIER_DIR_PREFIX):
+                try:
+                    res = int(name[len(dslib.TIER_DIR_PREFIX):])
+                except ValueError:
+                    shutil.rmtree(full, ignore_errors=True)
+                    continue
+                # open-phase (see above): pre-publication
+                self._tiers[res] = dslib.PartitionTier.open(  # vmt: disable=VMT015
+                    full, res, self.quarantined, self.name)
                 continue
             if name not in listed:
                 shutil.rmtree(full, ignore_errors=True)
@@ -607,6 +622,9 @@ class Partition:
             for p in self._file_parts:
                 p.close()
             self._file_parts = []
+            for st in self._tiers.values():
+                st.close()
+            self._tiers = {}
 
     # -- writes ------------------------------------------------------------
 
@@ -897,6 +915,111 @@ class Partition:
             if parts:
                 self._merge_file_parts(parts, deleted_ids, min_valid_ts)
 
+    # -- downsampling (storage/downsample.py drives per-tier state) --------
+
+    def run_downsample(self, tiers, deleted_ids=None, now_ms=None) -> int:
+        """Re-rollup aged raw rows into coarser tier parts (the
+        historicalMergeWatcher-shaped pass).  Consumes DURABLE file parts
+        only — tier coverage must never run ahead of what raw has
+        fsynced (callers flush first); the heavy merge+aggregate runs
+        behind the process-wide MERGE_GATE so it defers to serving
+        exactly like flush/merge.  Returns aggregated rows written."""
+        from .table import _partition_bounds
+        lo_p, hi_p = _partition_bounds(self.name)
+        written = 0
+        for tier in tiers:
+            res = tier.resolution_ms
+            # only COMPLETE buckets whose right edge has aged past the
+            # tier offset (right-inclusive buckets: edge b*res covers
+            # raw ts in ((b-1)*res, b*res])
+            cutoff = ((now_ms - tier.offset_ms) // res) * res
+            hi = min(cutoff, hi_p)
+            with self._flush_mutex:
+                with self._lock:
+                    st = self._tiers.get(res)
+                    covered = (st.covered_max_ts if st is not None
+                               else -(1 << 62))
+                    files = list(self._file_parts)
+                lo = max(covered, lo_p - 1)
+                if hi <= lo or not files:
+                    continue
+                if not any(p.min_ts <= hi and p.max_ts > lo
+                           for p in files):
+                    continue
+                if st is None:
+                    st = dslib.PartitionTier(
+                        os.path.join(self.path,
+                                     f"{dslib.TIER_DIR_PREFIX}{res}"), res)
+                    os.makedirs(st.path, exist_ok=True)
+                with workpool.MERGE_GATE:
+                    t0 = time.perf_counter()
+                    merged = _merge_block_streams(
+                        [p.iter_blocks(min_ts=lo + 1, max_ts=hi)
+                         for p in files],
+                        deleted_ids, lo + 1, self.dedup_interval_ms)
+                    _, rows_out, parts, names = dslib.rewrite_range(
+                        st, merged, hi, res)
+                    dt = time.perf_counter() - t0
+                # tier part dirs are renamed into place but NOT yet in
+                # tier.json: a crash here recovers to the OLD tier state
+                # (the unlisted dirs are swept at reopen) — same seam
+                # shape as merge:post_rename_pre_manifest
+                faultinject.fire("downsample:post_rename_pre_manifest")
+                with self._lock:
+                    if names:
+                        st.publish_parts(names, parts, hi)
+                    else:
+                        st.covered_max_ts = hi  # empty range: advance only
+                    st.write_manifest()
+                    self._tiers[res] = st
+                dslib.note_pass(dt)
+                flightrec.rec("downsample:part", t0, dt, arg=self.name)
+                written += rows_out
+        return written
+
+    def tier_states(self) -> list:
+        """Snapshot of open tiers (metrics/status; read-only)."""
+        with self._lock:
+            return list(self._tiers.values())
+
+    def drop_raw_parts(self) -> int:
+        """Raw retention expired while a downsampled tier still covers
+        this partition: delist + delete every raw part (pending/mem rows
+        included — they are older than raw retention too) and keep the
+        tier dirs.  Returns 1 when anything was dropped."""
+        self._drain_inflight()
+        with self._flush_mutex:
+            with self._lock:
+                victims = self._file_parts
+                had = bool(victims or self._mem_parts or self._pending)
+                if not had:
+                    return 0
+                self._file_parts = []
+                self._mem_parts = []
+                self._take_pending_locked()
+                self._write_parts_json_locked()
+            for p in victims:
+                # unlink only: concurrent readers holding the old Part
+                # keep valid fds until the last reference drops
+                shutil.rmtree(p.path, ignore_errors=True)
+        return 1
+
+    def drop_tier(self, resolution_ms: int) -> int:
+        """Drop one tier past its own retention deadline."""
+        with self._flush_mutex:
+            with self._lock:
+                st = self._tiers.pop(resolution_ms, None)
+            if st is None:
+                return 0
+            st.close()
+            shutil.rmtree(st.path, ignore_errors=True)
+        return 1
+
+    @property
+    def has_tier_parts(self) -> bool:
+        with self._lock:
+            return any(st.has_parts for st in self._tiers.values())
+
     # -- reads -------------------------------------------------------------
 
     def iter_blocks(self, tsid_set=None, min_ts=None, max_ts=None,
@@ -920,7 +1043,7 @@ class Partition:
 
     def collect_units(self, tsid_set=None, min_ts=None, max_ts=None,
                       tsid_lo=None, tsid_hi=None, mids_sorted=None,
-                      as_float=False):
+                      as_float=False, ds=None, note=None):
         """Batched block collection, split into independent work units
         for the shared fetch pool (utils/workpool): returns a list of
         zero-arg callables, each yielding a list of (mids, cnts, scales,
@@ -942,7 +1065,20 @@ class Partition:
         overlap on workers).  Snapshotting the part lists (and converting
         pending rows) happens HERE on the calling thread, under the
         partition lock discipline; the returned closures touch only
-        immutable parts."""
+        immutable parts.
+
+        ``ds`` = ``(agg_column, max_resolution_ms)`` opts the fetch into
+        downsampled tiers, CASCADING coarsest-to-finest: the coarsest
+        tier whose resolution satisfies the bound serves up to its
+        coverage watermark, each finer satisfying tier serves the span
+        between the previous watermark and its own, and raw parts serve
+        only past the finest contributing watermark.  Without any
+        satisfying tier, a partition whose raw parts were dropped by
+        retention falls back to the FINEST surviving tier (``last``
+        column unless ``ds`` names one) and flags the result partial-
+        resolution via ``note`` — loudly degraded, never silently wrong.
+        ``note`` (dict) reports the choice: ``ds_res`` (max resolution
+        actually served) and ``partial_res``."""
         while True:
             self._drain_inflight()
             pend, gen = self._pending_views()
@@ -950,6 +1086,9 @@ class Partition:
                 if self._pending_gen == gen and not self._pending_inflight:
                     mems = list(self._mem_parts)
                     files = list(self._file_parts)
+                    tier_snap = [(st, st.covered_max_ts)
+                                 for st in self._tiers.values()
+                                 if st.has_parts]
                     break
         mems = mems + pend
         if mids_sorted is None and tsid_set is not None:
@@ -959,34 +1098,94 @@ class Partition:
         hi = (1 << 62) if max_ts is None else max_ts
         from .part import _piece_to_float, clip_piece
         units = []
+
+        # -- tier selection (see docstring) --------------------------------
+        # chosen tier SEGMENTS, coarsest first: each (tier, seg_lo,
+        # seg_hi) serves a disjoint span, the next finer tier picks up
+        # at the previous watermark + 1, raw serves only past the FINEST
+        # contributing watermark — a long-range query cascades
+        # 1h-tier -> 5m-tier -> raw instead of paying raw for everything
+        # the coarsest tier has not yet covered.
+        chosen: list = []
+        raw_lo = min_ts
+        # COUNT-hinted fetch: raw samples contribute 1 each (see
+        # downsample.count_tail_piece) — unconditional on whether a tier
+        # serves, so the eval-level count->sum rewrite is always sound
+        count_ones = (note is not None and ds is not None
+                      and ds[0] == "count")
+        # a note dict is the enable switch: Storage only passes one when
+        # tiers are configured AND VM_DOWNSAMPLE_READ is on
+        if tier_snap and note is not None:
+            agg = ds[0] if ds is not None else "last"
+            if ds is not None:
+                cands = [(st, c) for st, c in tier_snap
+                         if st.resolution_ms <= ds[1]]
+                cands.sort(key=lambda tc: -tc[0].resolution_ms)
+                cur_lo, cur_lo_i = min_ts, lo
+                for st, c in cands:
+                    if c < cur_lo_i:
+                        continue  # extends nothing the cascade has
+                    chosen.append((st, cur_lo, min(hi, c)))
+                    cur_lo = cur_lo_i = c + 1
+                    if c >= hi:
+                        break
+                if chosen:
+                    raw_lo = cur_lo
+            if not chosen and not mems and not files:
+                # raw dropped by retention, no satisfying tier: finest
+                # surviving tier, LOUDLY partial-resolution
+                cands = [(st, c) for st, c in tier_snap if c >= lo]
+                if cands:
+                    st, c = min(cands,
+                                key=lambda tc: tc[0].resolution_ms)
+                    chosen = [(st, min_ts, min(hi, c))]
+                    raw_lo = c + 1
+                    note["partial_res"] = True
+            if chosen:
+                # coarsest resolution actually served
+                note["ds_res"] = max(note.get("ds_res", 0),
+                                     chosen[0][0].resolution_ms)
+        raw_lo_i = -(1 << 62) if raw_lo is None else raw_lo
+
         mems = [src for src in mems
-                if src.max_ts >= lo and src.min_ts <= hi]
+                if src.max_ts >= raw_lo_i and src.min_ts <= hi]
         if mems:
-            def mem_unit(mems=mems):
+            def mem_unit(mems=mems, u_lo=raw_lo):
                 pieces = []
                 for src in mems:
-                    piece = src.collect_columns(mids_sorted, min_ts, max_ts)
+                    piece = src.collect_columns(mids_sorted, u_lo, max_ts)
                     if piece is not None:
-                        piece = clip_piece(*piece, min_ts, max_ts)
-                        pieces.append(_piece_to_float(piece) if as_float
-                                      else piece)
+                        piece = clip_piece(*piece, u_lo, max_ts)
+                        piece = (_piece_to_float(piece) if as_float
+                                 else piece)
+                        if count_ones:
+                            piece = dslib.count_tail_piece(piece, as_float)
+                        pieces.append(piece)
                 return pieces
             units.append(mem_unit)
-        for p in files:
-            if p.max_ts < lo or p.min_ts > hi:
+        for p, u_lo, u_hi, is_raw in (
+                [(p, raw_lo, max_ts, True) for p in files] +
+                [(p, s_lo, s_hi, False)
+                 for st, s_lo, s_hi in chosen
+                 for p in st.parts_for(agg)]):
+            u_lo_i = -(1 << 62) if u_lo is None else u_lo
+            u_hi_i = (1 << 62) if u_hi is None else u_hi
+            if p.max_ts < u_lo_i or p.min_ts > u_hi_i:
                 continue
+            ones = count_ones and is_raw
 
-            def file_unit(p=p):
+            def file_unit(p=p, u_lo=u_lo, u_hi=u_hi, ones=ones):
                 if as_float:
-                    piece = p.assemble_columns(mids_sorted, min_ts, max_ts)
+                    piece = p.assemble_columns(mids_sorted, u_lo, u_hi)
                 else:
-                    piece = p.collect_columns(mids_sorted, min_ts, max_ts)
+                    piece = p.collect_columns(mids_sorted, u_lo, u_hi)
                 if piece is False:
                     return []  # vectorized path ran; nothing matched
-                if piece is not None:
-                    return [piece]  # already row-clipped
+                if piece is not None:  # already row-clipped
+                    return [dslib.count_tail_piece(piece, as_float)
+                            if ones else piece]
                 # fallback: native decode unavailable — per-header path
-                hdrs = list(p.iter_headers(tsid_set, min_ts, max_ts,
+                hdrs = list(p.iter_headers(tsid_set, u_lo, u_hi,
                                            tsid_lo, tsid_hi))
                 if not hdrs:
                     return []
@@ -997,14 +1196,16 @@ class Partition:
                                 np.int64, K),
                     np.fromiter((h.rows for h in hdrs), np.int64, K),
                     np.fromiter((h.scale for h in hdrs), np.int64, K),
-                    ts_c, m_c, min_ts, max_ts)
-                return [_piece_to_float(piece) if as_float else piece]
+                    ts_c, m_c, u_lo, u_hi)
+                piece = _piece_to_float(piece) if as_float else piece
+                return [dslib.count_tail_piece(piece, as_float)
+                        if ones else piece]
             units.append(file_unit)
         return units
 
     def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
                         tsid_lo=None, tsid_hi=None, mids_sorted=None,
-                        as_float=False):
+                        as_float=False, ds=None, note=None):
         """Batched block collection: returns (mids, cnts, scales, ts_concat,
         mant_concat) numpy arrays over every matching block in this
         partition (float pieces under ``as_float`` — see collect_units).
@@ -1016,7 +1217,7 @@ class Partition:
         return [piece
                 for unit in self.collect_units(tsid_set, min_ts, max_ts,
                                                tsid_lo, tsid_hi, mids_sorted,
-                                               as_float)
+                                               as_float, ds, note)
                 for piece in unit()]
 
     @property

@@ -254,10 +254,23 @@ class SeriesData:
 class Storage:
     def __init__(self, path: str, retention_ms: int = DEFAULT_RETENTION_MS,
                  dedup_interval_ms: int = 0, max_hourly_series: int = 0,
-                 max_daily_series: int = 0):
+                 max_daily_series: int = 0, downsample: str | None = None):
         self.path = path
         self.retention_ms = retention_ms
         self.dedup_interval_ms = dedup_interval_ms
+        # downsampling tiers (storage/downsample.py): offset:res[:keep],
+        # finest first; None reads the VM_DOWNSAMPLE env grammar
+        from . import downsample as _ds
+        self.downsample_tiers = _ds.parse_spec(
+            os.environ.get("VM_DOWNSAMPLE", "") if downsample is None
+            else downsample)
+        self._downsample_interval_s = float(
+            os.environ.get("VM_DOWNSAMPLE_INTERVAL_S", "60"))
+        self._last_downsample = time.monotonic()
+        # per-request partial-RESOLUTION flag (reset_partial clears it):
+        # set when a fetch fell back to a coarser tier than the query's
+        # step allows (raw dropped, no satisfying tier)
+        self._partial_res_flag = False
         from .cardinality import BloomLimiter
         self.hourly_limiter = (BloomLimiter(max_hourly_series, 3600, "hourly")
                                if max_hourly_series > 0 else None)
@@ -398,8 +411,30 @@ class Storage:
                     self.table.flush_to_disk()
                     self.idb.flush()
                     last_disk = time.monotonic()
+                if self.downsample_tiers and \
+                        time.monotonic() - self._last_downsample >= \
+                        self._downsample_interval_s:
+                    self.run_downsample_cycle()
             except Exception as e:  # pragma: no cover
                 logger.errorf("storage flusher: %s", e)
+
+    def run_downsample_cycle(self, now_ms: int | None = None) -> int:
+        """One background re-rollup pass over every partition x tier
+        (the historicalMergeWatcher cadence; also called directly by
+        tests/bench/smoke to force aging).  Flushes first — tier
+        coverage must only ever run over DURABLE raw parts."""
+        if not self.downsample_tiers:
+            return 0
+        self.table.flush_to_disk()
+        written = self.table.run_downsample(
+            self.downsample_tiers, self.idb.deleted_metric_ids,
+            fasttime.unix_ms() if now_ms is None else now_ms)
+        self._last_downsample = time.monotonic()
+        if written:
+            with self._lock:
+                # new tier parts change what a query may read
+                self.data_version += 1
+        return written
 
     # -- cache persistence (storage.go:1026-1041 mustSaveCache analogs) ----
 
@@ -1122,11 +1157,19 @@ class Storage:
     #: expired budget aborts the scan/fetch mid-flight with the typed
     #: DeadlineExceededError instead of completing for a dead caller
     supports_search_deadline = True
+    #: eval may pass ``ds=(agg_column, max_resolution_ms)`` to opt a
+    #: fetch into downsampled tiers (storage/downsample.py); absent on
+    #: ClusterStorage, so the hint never crosses the RPC untranslated
+    supports_downsample_read = True
+
+    @property
+    def downsample_active(self) -> bool:
+        return bool(self.downsample_tiers)
 
     def search_columns(self, filters: list[TagFilter], min_ts: int,
                        max_ts: int, dedup_interval_ms: int | None = None,
                        max_series: int | None = None, tenant=(0, 0),
-                       _tsids=None, deadline: float = 0.0):
+                       _tsids=None, deadline: float = 0.0, ds=None):
         """Batched columnar search: one native decode pass per part, one
         vectorized assembly into padded (S, N) columns — no per-series
         Python on the fetch path (the netstorage.go:374-421 unpack-worker
@@ -1155,7 +1198,7 @@ class Storage:
                     f"storage:search:{tenant[0]}:{tenant[1]}")
             return self._search_columns_gated(
                 filters, min_ts, max_ts, interval, max_series, tenant,
-                _tsids, ColumnarSeries, assemble, budget)
+                _tsids, ColumnarSeries, assemble, budget, ds)
 
     def _resolve_ordered_names(self, uniq: np.ndarray):
         """Raw-name resolution + canonical (raw-sorted) row order for a
@@ -1203,7 +1246,7 @@ class Storage:
 
     def _search_columns_gated(self, filters, min_ts, max_ts, interval,
                               max_series, tenant, _tsids, ColumnarSeries,
-                              assemble, budget=None):
+                              assemble, budget=None, ds=None):
         t_ph = time.perf_counter()
         costacc.restamp()  # start of this thread's phase-lap chain
         if budget is not None:
@@ -1219,6 +1262,19 @@ class Storage:
         if not tsids:
             return empty
         tsid_set = {t.metric_id for t in tsids}
+        # downsampled-tier serving: a note dict both ENABLES per-
+        # partition tier selection and reports back what was chosen;
+        # VM_DOWNSAMPLE_READ=0 (the raw-oracle escape hatch) keeps every
+        # fetch raw-only, fallback included
+        note = None
+        if self.downsample_tiers:
+            from . import downsample as _dsmod
+            if _dsmod.read_enabled():
+                note = {}
+            else:
+                ds = None
+        else:
+            ds = None
         # the fused native read kernel (vm_assemble_part) merges the
         # collect+decode+clip stages into one GIL-released call per part
         # and hands back float pieces; VM_NATIVE_ASSEMBLE=0 (or a missing
@@ -1230,11 +1286,22 @@ class Storage:
             tsid_set, min_ts, max_ts,
             tsid_lo=tsids[0].sort_key(), tsid_hi=tsids[-1].sort_key(),
             as_float=fused,
-            check=budget.check if budget is not None else None)
+            check=budget.check if budget is not None else None,
+            ds=ds, note=note)
         t_ph = _phase_lap("assemble_native" if fused else "collect", t_ph)
+        if note:
+            if note.get("partial_res"):
+                # per-request flag, surfaced as partialResolution in the
+                # HTTP response metadata (reset_partial clears it).
+                # Benign race: sticky advisory boolean — concurrent
+                # writers all store True, readers only consume it after
+                # their own search returned, and a lost reset merely
+                # over-reports partial resolution (never under-reports).
+                self._partial_res_flag = True  # vmt: disable=VMT015
         if budget is not None:
             budget.check()  # before the decode/assembly tail
         if not pieces:
+            self._note_to_cols(empty, note)
             return empty
         if fused:
             if len(pieces) == 1:
@@ -1346,11 +1413,20 @@ class Storage:
             cols.raw_names = list(raws_final)
             cols.metric_names = list(names_final)
         cols.compute_stale_rows()
+        self._note_to_cols(cols, note)
         if cols.metric_names:
             self.track_name_usage(
                 {mn.metric_group for mn in cols.metric_names})
         _phase_lap("assemble", t_ph)
         return cols
+
+    @staticmethod
+    def _note_to_cols(cols, note) -> None:
+        """Stamp the tier-selection outcome onto the result (eval keys
+        its cache and the avg/count rewrites off these)."""
+        if note:
+            cols.ds_res = int(note.get("ds_res", 0))
+            cols.partial_res = bool(note.get("partial_res", False))
 
     def search_series(self, filters: list[TagFilter], min_ts: int,
                       max_ts: int, dedup_interval_ms: int | None = None,
@@ -1435,10 +1511,19 @@ class Storage:
         partial capture, result-cache puts)."""
         return self._has_quarantine
 
+    @property
+    def last_partial_resolution(self) -> bool:
+        """A fetch since the last reset_partial() fell back to a coarser
+        tier than the query's effective step allows (raw dropped by
+        retention, no satisfying tier) — the response carries
+        ``partialResolution: true`` so degraded data is never silent."""
+        return self._partial_res_flag
+
     def reset_partial(self) -> None:
         """Per-request reset hook (ClusterStorage protocol): quarantine
-        partiality is persistent state, not per-query, so nothing to
-        clear."""
+        partiality is persistent state (nothing to clear), but the
+        partial-RESOLUTION flag is per-request."""
+        self._partial_res_flag = False
 
     def label_names(self, min_ts=None, max_ts=None,
                     tenant=(0, 0)) -> list[str]:
@@ -1834,14 +1919,35 @@ class Storage:
     def min_valid_ts(self) -> int:
         return fasttime.unix_ms() - self.retention_ms
 
-    def enforce_retention(self) -> int:
-        n = self.table.enforce_retention(self.min_valid_ts)
-        dropped_months = self.idb.drop_months_before(self.min_valid_ts)
+    def tier_deadlines(self, now_ms: int | None = None) -> list:
+        """``[(resolution_ms, tier_min_valid_ts_or_None)]`` for the
+        configured tiers (None = that tier keeps its data forever)."""
+        now = fasttime.unix_ms() if now_ms is None else now_ms
+        return [(t.resolution_ms,
+                 (now - t.retention_ms) if t.retention_ms > 0 else None)
+                for t in self.downsample_tiers]
+
+    def enforce_retention(self, now_ms: int | None = None) -> int:
+        now = fasttime.unix_ms() if now_ms is None else now_ms
+        min_valid = now - self.retention_ms
+        deadlines = self.tier_deadlines(now)
+        n = self.table.enforce_retention(min_valid, deadlines)
+        # the index (metric names, per-day entries) must outlive every
+        # tier that still serves samples: months are dropped at the
+        # OLDEST live deadline, and never while a tier keeps-forever
+        idb_min = min_valid
+        for _, d in deadlines:
+            if d is None:
+                idb_min = None
+                break
+            idb_min = min(idb_min, d)
+        dropped_months = (self.idb.drop_months_before(idb_min)
+                          if idb_min is not None else 0)
         n += dropped_months
         if dropped_months:
             # a later backfill into a dropped date must recreate its
             # per-day index entries
-            min_date = self.min_valid_ts // 86_400_000
+            min_date = idb_min // 86_400_000
             for shard in self._shards:
                 with shard.lock:
                     dead = {dk for dk in shard.day_cache
@@ -1904,6 +2010,16 @@ class Storage:
             "vm_timeseries_total": self.idb.all_series_count(),
             "vm_partitions": len(self.table.partition_names),
         }
+        if self.downsample_tiers:
+            by_res: dict[int, int] = {}
+            with self.table._lock:
+                parts = list(self.table._partitions.values())
+            for p in parts:
+                for st in p.tier_states():
+                    by_res[st.resolution_ms] = \
+                        by_res.get(st.resolution_ms, 0) + st.rows
+            for res, rows in sorted(by_res.items()):
+                out[f'vm_downsample_tier_rows{{resolution="{res}"}}'] = rows
         for lim in (self.hourly_limiter, self.daily_limiter):
             if lim is not None:
                 out.update(lim.metrics())

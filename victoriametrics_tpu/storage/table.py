@@ -121,7 +121,7 @@ class Table:
 
     def collect_columns(self, tsid_set=None, min_ts=None, max_ts=None,
                         tsid_lo=None, tsid_hi=None, mids_sorted=None,
-                        as_float=False, check=None):
+                        as_float=False, check=None, ds=None, note=None):
         """Batched per-partition block collection (see
         Partition.collect_units); returns a flat list of pieces —
         mantissa 5-tuples, or float 4-tuples under ``as_float`` (the
@@ -149,27 +149,68 @@ class Table:
         for p in parts:
             units.extend(p.collect_units(tsid_set, min_ts, max_ts,
                                          tsid_lo, tsid_hi, mids_sorted,
-                                         as_float))
+                                         as_float, ds, note))
         if check is not None:
             units = [(lambda u=u: (check(), u())[1]) for u in units]
         from ..utils import workpool
         return [piece for pieces in workpool.POOL.run(units)
                 for piece in pieces]
 
-    def enforce_retention(self, min_valid_ts: int) -> int:
-        """Drop partitions entirely older than retention; returns count
-        (retentionWatcher analog)."""
+    def enforce_retention(self, min_valid_ts: int,
+                          tier_deadlines=None) -> int:
+        """Drop data older than retention, PER TIER (retentionWatcher
+        analog).  ``tier_deadlines`` is ``[(resolution_ms, tier_min_ts)]``
+        with ``tier_min_ts=None`` meaning "keep forever".  A partition dir
+        is removed whole only once EVERY tier (and raw) has expired;
+        partitions past the raw deadline but inside a tier deadline lose
+        only their raw parts, and each tier is dropped at its own
+        deadline.  Returns the number of drop actions."""
         dropped = 0
+        deadlines = list(tier_deadlines or ())
+        full_drop_before = min_valid_ts
+        for _, d in deadlines:
+            if d is None:
+                full_drop_before = None
+                break
+            full_drop_before = min(full_drop_before, d)
         with self._lock:
-            for name in list(self._partitions):
-                _, hi = _partition_bounds(name)
-                if hi < min_valid_ts:
-                    p = self._partitions.pop(name)
-                    p.close()
-                    shutil.rmtree(p.path, ignore_errors=True)
-                    logger.infof("table: dropped partition %s (retention)", name)
+            items = list(self._partitions.items())
+        for name, p in items:
+            _, hi = _partition_bounds(name)
+            if full_drop_before is not None and hi < full_drop_before:
+                with self._lock:
+                    p = self._partitions.pop(name, None)
+                if p is None:
+                    continue
+                p.close()
+                shutil.rmtree(p.path, ignore_errors=True)
+                logger.infof("table: dropped partition %s (retention)",
+                             name)
+                dropped += 1
+                continue
+            if hi < min_valid_ts and deadlines:
+                if p.drop_raw_parts():
+                    logger.infof("table: dropped raw parts of %s "
+                                 "(raw retention; tiers kept)", name)
+                    dropped += 1
+            for res, d in deadlines:
+                if d is not None and hi < d and p.drop_tier(res):
+                    logger.infof("table: dropped tier ds_%d of %s "
+                                 "(tier retention)", res, name)
                     dropped += 1
         return dropped
+
+    def run_downsample(self, tiers, deleted_ids=None,
+                       now_ms=None) -> int:
+        """One downsampling cycle across every partition (see
+        Partition.run_downsample); returns aggregated rows written."""
+        with self._lock:
+            parts = list(self._partitions.values())
+        written = 0
+        with flightrec.span("downsample:table", arg=len(parts)):
+            for p in parts:
+                written += p.run_downsample(tiers, deleted_ids, now_ms)
+        return written
 
     @staticmethod
     def _fan_partitions(parts, fn):
